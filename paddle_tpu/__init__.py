@@ -1,0 +1,77 @@
+"""paddle_tpu — a TPU-native deep learning framework with the API surface of
+PaddlePaddle (reference: /root/reference, a Paddle v2.3 fork).
+
+Not a port: compute lowers to XLA via jax/jnp/pallas; distribution is GSPMD
+over jax.sharding meshes; eager mode is XLA-eager with a lightweight autograd
+tape; the performance path compiles whole train steps with jax.jit.
+"""
+__version__ = "0.1.0"
+
+from . import autograd, framework, tensor
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Parameter,
+    Tensor,
+    TPUPlace,
+    bfloat16,
+    bool,  # noqa: A004
+    complex64,
+    complex128,
+    disable_static,
+    dtype,
+    enable_grad,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    get_device,
+    get_rng_state,
+    in_dynamic_mode,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_rng_state,
+    uint8,
+)
+from .framework.core import to_tensor  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .autograd import grad  # noqa: F401
+
+# subpackages (gate lets the core be imported standalone during bring-up)
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
+    from . import nn  # noqa: F401,E402
+    from . import optimizer  # noqa: F401,E402
+    from . import distributed  # noqa: F401,E402
+    from . import io  # noqa: F401,E402
+    from . import metric  # noqa: F401,E402
+    from . import amp  # noqa: F401,E402
+    from . import vision  # noqa: F401,E402
+    from . import jit  # noqa: F401,E402
+    from . import static  # noqa: F401,E402
+    from . import distribution  # noqa: F401,E402
+    from . import incubate  # noqa: F401,E402
+    from .hapi.model import Model  # noqa: F401,E402
+    from .framework.io import load, save  # noqa: F401,E402
+    from . import fft  # noqa: F401,E402
+    from . import signal  # noqa: F401,E402
+    from . import sparse  # noqa: F401,E402
+    from . import device  # noqa: F401,E402
+    from . import regularizer  # noqa: F401,E402
+    from . import profiler  # noqa: F401,E402
+    from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
+
+    flatten = tensor.manipulation.flatten  # keep function (not module) at top level
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.model_summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)

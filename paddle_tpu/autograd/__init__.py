@@ -1,0 +1,134 @@
+"""paddle_tpu.autograd — eager tape + functional transforms.
+
+Reference: python/paddle/autograd (backward, PyLayer) over the C++ eager
+graph. Here: the tape lives in framework/core.py; functional grad/vjp/jvp
+are direct jax transforms — the idiomatic TPU path.
+"""
+import jax
+
+from ..framework.core import Tensor, _pause_tape, apply_op, backward, is_grad_enabled, no_grad
+
+__all__ = ["backward", "grad", "no_grad", "is_grad_enabled", "PyLayer", "value_and_grad", "vjp", "jvp"]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad: gradients of `outputs` wrt `inputs` via the eager tape."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t, t.grad) for t in ins]
+    for t in ins:
+        t.grad = None
+    for i, o in enumerate(outs):
+        go = None
+        if grad_outputs is not None:
+            gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+            go = gos[i]
+        backward(o, go, retain_graph=True if i < len(outs) - 1 else retain_graph)
+    result = []
+    for t, _ in saved:
+        if t.grad is None and not allow_unused:
+            import jax.numpy as jnp
+            result.append(Tensor(jnp.zeros(t.shape, t.dtype)))
+        else:
+            result.append(t.grad)
+    for t, g in saved:
+        t.grad = g
+    return result
+
+
+def _fnize(func):
+    """Lift a Tensor->Tensor python function to jax arrays for transforms."""
+    def wrapped(*arrs):
+        with _pause_tape():
+            tens = [Tensor(a, stop_gradient=False) for a in arrs]
+            out = func(*tens)
+            return out._value if isinstance(out, Tensor) else out
+    return wrapped
+
+
+def value_and_grad(func, argnums=0, has_aux=False):
+    vg = jax.value_and_grad(_fnize(func), argnums=argnums, has_aux=has_aux)
+
+    def run(*tensors):
+        arrs = [t._value if isinstance(t, Tensor) else t for t in tensors]
+        val, g = vg(*arrs)
+        wrap = lambda v: Tensor(v) if not isinstance(v, Tensor) else v
+        g = jax.tree_util.tree_map(wrap, g)
+        return jax.tree_util.tree_map(wrap, val), g
+    return run
+
+
+def vjp(func, xs, v=None):
+    arrs = [t._value if isinstance(t, Tensor) else t for t in (xs if isinstance(xs, (list, tuple)) else [xs])]
+    out, f_vjp = jax.vjp(_fnize(func), *arrs)
+    if v is None:
+        import jax.numpy as jnp
+        v = jnp.ones_like(out)
+    else:
+        v = v._value if isinstance(v, Tensor) else v
+    grads = f_vjp(v)
+    gt = [Tensor(g) for g in grads]
+    return Tensor(out), gt if len(gt) > 1 else gt[0]
+
+
+def jvp(func, xs, v=None):
+    arrs = [t._value if isinstance(t, Tensor) else t for t in (xs if isinstance(xs, (list, tuple)) else [xs])]
+    if v is None:
+        import jax.numpy as jnp
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._value if isinstance(t, Tensor) else t for t in vs]
+    out, tangent_out = jax.jvp(_fnize(func), arrs, tangents)
+    return Tensor(out), Tensor(tangent_out)
+
+
+class PyLayer:
+    """Custom autograd op (reference python/paddle/autograd/py_layer.py).
+
+    Subclass with static `forward(ctx, *args)` and `backward(ctx, *grads)`.
+    Works with the eager tape: the pair is registered as one tape node whose
+    VJP calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    class _Ctx:
+        def __init__(self):
+            self._saved = ()
+
+        def save_for_backward(self, *tensors):
+            self._saved = tensors
+
+        @property
+        def saved_tensor(self):
+            return self._saved
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = cls._Ctx()
+
+        @jax.custom_vjp
+        def op(*arrs):
+            tens = [Tensor(a) for a in arrs]
+            out = cls.forward(ctx, *tens, **kwargs)
+            return out._value if isinstance(out, Tensor) else tuple(o._value for o in out)
+
+        def fwd(*arrs):
+            return op(*arrs), None
+
+        def bwd(_, ct):
+            cts = ct if isinstance(ct, tuple) else (ct,)
+            gin = cls.backward(ctx, *[Tensor(c) for c in cts])
+            gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+            return tuple(g._value if isinstance(g, Tensor) else g for g in gin)
+
+        op.defvjp(fwd, bwd)
+        return apply_op(op, *args)

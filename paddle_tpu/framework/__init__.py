@@ -1,0 +1,53 @@
+"""paddle_tpu.framework — core runtime state (≈ python/paddle/framework in
+the reference, minus the static-graph Program machinery which lives in
+paddle_tpu.static)."""
+from . import device, dtype, random
+from .core import (
+    Parameter,
+    Tensor,
+    apply_op,
+    backward,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    to_tensor,
+)
+from .device import CPUPlace, CUDAPlace, TPUPlace, get_device, set_device
+from .dtype import (
+    bfloat16,
+    bool,  # noqa: A004
+    complex64,
+    complex128,
+    dtype,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .random import get_rng_state, seed, set_rng_state
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad",
+    "is_grad_enabled", "apply_op", "backward", "seed", "get_rng_state",
+    "set_rng_state", "set_device", "get_device", "TPUPlace", "CPUPlace",
+    "CUDAPlace", "dtype", "set_default_dtype", "get_default_dtype",
+]
+
+
+def in_dynamic_mode():
+    return True
+
+
+def disable_static():
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled execution")

@@ -1,0 +1,478 @@
+"""Core Tensor type, op dispatch, and the eager autograd tape.
+
+Reference architecture being replaced (not ported):
+  - paddle/fluid/eager/* — C++ eager autograd graph with per-op GradNodes
+  - python/paddle/fluid/dygraph/varbase_patch_methods.py — Tensor methods
+Here the accelerator compute path is XLA: every op is a pure function on
+jax.Array values. `Tensor` is a thin mutable handle around a jax.Array (or a
+tracer when inside jax.jit tracing). Eager autograd is a Wengert tape over
+the op dispatch point `_apply`: each recorded node re-derives its VJP with
+jax.vjp at backward time. The high-performance training path does NOT use the
+tape — it uses jax.value_and_grad over `functional_call` (see nn/layers.py)
+so the whole step compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "apply_op",
+    "backward",
+    "TapeState",
+]
+
+_tls = threading.local()
+
+
+def _tape():
+    if not hasattr(_tls, "tape"):
+        _tls.tape = TapeState()
+    return _tls.tape
+
+
+class TapeState:
+    __slots__ = ("nodes", "enabled", "paused")
+
+    def __init__(self):
+        self.nodes = []
+        self.enabled = True
+        self.paused = 0
+
+    @property
+    def recording(self):
+        return self.enabled and self.paused == 0
+
+    def clear(self):
+        self.nodes = []
+
+
+class _TapeNode:
+    """One recorded eager op: enough to rebuild its VJP with jax.vjp."""
+
+    __slots__ = ("fn", "raw_args", "kwargs", "diff_idx", "in_tensors", "outputs")
+
+    def __init__(self, fn, raw_args, kwargs, diff_idx, in_tensors, outputs):
+        self.fn = fn
+        self.raw_args = raw_args      # positional args with Tensors unwrapped
+        self.kwargs = kwargs          # static kwargs
+        self.diff_idx = diff_idx      # positions of differentiable inputs
+        self.in_tensors = in_tensors  # Tensor at each diff position
+        self.outputs = outputs        # list[Tensor] produced
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        t = _tape()
+        self._prev = t.enabled
+        t.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tape().enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        t = _tape()
+        self._prev = t.enabled
+        t.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tape().enabled = self._prev
+        return False
+
+
+def is_grad_enabled():
+    return _tape().recording
+
+
+class _pause_tape:
+    """Internal: used by functional_call / jitted paths where jax.grad is the
+    differentiation mechanism and tape recording would be pure overhead."""
+
+    def __enter__(self):
+        _tape().paused += 1
+
+    def __exit__(self, *exc):
+        _tape().paused -= 1
+
+
+def _is_diff_dtype(v):
+    d = jnp.result_type(v)
+    return jnp.issubdtype(d, np.inexact) or d == dtypes.bfloat16
+
+
+def apply_op(fn, *args, **kwargs):
+    """Central eager dispatch: unwrap Tensors, run `fn`, wrap outputs, and
+    record a tape node when gradients are being tracked.
+
+    `fn` must be pure: positional args may be arrays (differentiable),
+    kwargs are static configuration. Multi-output fns return tuples.
+    """
+    tape = _tape()
+    raw = []
+    diff_idx = []
+    in_tensors = []
+    track = tape.recording
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            raw.append(a._value)
+            if track and not a.stop_gradient and _is_diff_dtype(a._value):
+                diff_idx.append(i)
+                in_tensors.append(a)
+        else:
+            raw.append(a)
+    out = fn(*raw, **kwargs)
+    requires = bool(diff_idx)
+    if isinstance(out, (tuple, list)):
+        outs = [Tensor(o, stop_gradient=not requires) for o in out]
+        if requires:
+            node = _TapeNode(fn, raw, kwargs, diff_idx, in_tensors, outs)
+            for t in outs:
+                t._producer = node
+            tape.nodes.append(node)
+        return type(out)(outs) if isinstance(out, tuple) else outs
+    t = Tensor(out, stop_gradient=not requires)
+    if requires:
+        node = _TapeNode(fn, raw, kwargs, diff_idx, in_tensors, [t])
+        t._producer = node
+        tape.nodes.append(node)
+    return t
+
+
+def _zero_ct(val):
+    d = jnp.result_type(val)
+    if jnp.issubdtype(d, np.inexact) or d == dtypes.bfloat16:
+        return jnp.zeros(jnp.shape(val), d)
+    return np.zeros(jnp.shape(val), jax.dtypes.float0)
+
+
+def backward(loss: "Tensor", grad_tensor=None, retain_graph: bool = False):
+    """Reverse-mode sweep over the eager tape; accumulates into leaf `.grad`.
+
+    Mirrors paddle.autograd.backward semantics: only leaf tensors (not
+    produced by a recorded op) retain `.grad`.
+    """
+    tape = _tape()
+    if loss._producer is None:
+        if not retain_graph:
+            tape.clear()
+        return
+    cts: dict[int, jax.Array] = {}
+    if grad_tensor is None:
+        seed = jnp.ones(loss.shape, jnp.result_type(loss._value))
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    cts[id(loss)] = seed
+
+    with _pause_tape():
+        for node in reversed(tape.nodes):
+            out_cts = [cts.get(id(o)) for o in node.outputs]
+            if all(c is None for c in out_cts):
+                continue
+
+            def closed(*dvals, _node=node):
+                full = list(_node.raw_args)
+                for j, v in zip(_node.diff_idx, dvals):
+                    full[j] = v
+                return _node.fn(*full, **_node.kwargs)
+
+            primals = [node.raw_args[j] for j in node.diff_idx]
+            out_val, vjp_fn = jax.vjp(closed, *primals)
+            if isinstance(out_val, (tuple, list)):
+                ct = type(out_val)(
+                    c if c is not None else _zero_ct(v)
+                    for c, v in zip(out_cts, out_val)
+                )
+            else:
+                ct = out_cts[0] if out_cts[0] is not None else _zero_ct(out_val)
+            in_cts = vjp_fn(ct)
+            for t, g in zip(node.in_tensors, in_cts):
+                if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                    continue
+                key = id(t)
+                cts[key] = g if key not in cts else cts[key] + g
+
+    leaves = {}
+    for node in tape.nodes:
+        for t in node.in_tensors:
+            if t._producer is None and id(t) in cts:
+                leaves[id(t)] = t
+    for t in leaves.values():
+        g = cts[id(t)]
+        t.grad = Tensor(g if t.grad is None else t.grad._value + g, stop_gradient=True)
+    if not retain_graph:
+        tape.clear()
+
+
+class Tensor:
+    """Paddle-compatible tensor handle over a jax.Array.
+
+    Mutable wrapper (supports `x[i] = v`, `add_`, parameter updates) around
+    immutable device buffers; functional-update under the hood (`.at[].set`).
+    """
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_producer", "name", "persistable", "__weakref__")
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if dtype is not None:
+            value = jnp.asarray(value, dtypes.dtype(dtype))
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = _np_default(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._producer = None
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .device import CPUPlace, TPUPlace
+
+        try:
+            dev = list(self._value.devices())[0]
+            return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+        except Exception:
+            return TPUPlace(0)
+
+    @property
+    def T(self):
+        return apply_op(jnp.transpose, self)
+
+    @property
+    def is_leaf(self):
+        return self._producer is None
+
+    def numel(self):
+        return self.size
+
+    # -- conversion -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *idx):
+        v = self._value
+        if idx:
+            v = v[idx if len(idx) > 1 else idx[0]]
+        return v.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, d):
+        return apply_op(lambda x, _d=dtypes.dtype(d): x.astype(_d), self)
+
+    def cast(self, d):
+        return self.astype(d)
+
+    def clone(self):
+        return apply_op(lambda x: x + 0 if x.dtype != jnp.bool_ else x, self)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._value), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                continue
+            try:
+                return self.astype(a)
+            except TypeError:
+                continue
+        return self
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def register_hook(self, hook):  # minimal parity; tape-level hooks
+        return hook
+
+    # -- python protocol --------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        body = np.array2string(np.asarray(jax.device_get(self._value)), separator=", ", prefix="       ")
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, place={self.place}, "
+            f"stop_gradient={sg},\n       {body})"
+        )
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op(lambda x, _i=idx: x[_i], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        self._inplace_update(lambda x, _i=idx, _v=v: x.at[_i].set(jnp.asarray(_v, x.dtype)))
+
+    def _inplace_update(self, fn):
+        """In-place op: rebinds the handle to the new value, tape-consistently."""
+        out = apply_op(fn, self)
+        self._value = out._value
+        self._producer = out._producer
+        if out._producer is not None:
+            out._producer.outputs[out._producer.outputs.index(out)] = self
+            self.stop_gradient = out.stop_gradient
+        return self
+
+    __hash__ = object.__hash__  # identity hash; __eq__ is elementwise (torch-style)
+
+    # arithmetic operators are monkey-patched in tensor/math.py, mirroring
+    # reference python/paddle/fluid/dygraph/math_op_patch.py
+
+
+class Parameter(Tensor):
+    """Trainable tensor (paddle.framework.Parameter / fluid ParamBase)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _np_default(value):
+    """numpy-style coercion with paddle defaults (float data → default dtype)."""
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.dtype(dtypes.get_default_dtype()))
+    return jnp.asarray(arr)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor — ref python/paddle/tensor/creation.py:to_tensor."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if dtype is not None:
+        v = jnp.asarray(data, dtypes.dtype(dtype))
+    else:
+        v = _np_default(data)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+# -- pytree registration: Tensors flow through jax.jit / grad boundaries ----
+def _flatten(t):
+    return (t._value,), (type(t), t.stop_gradient)
+
+
+def _unflatten(aux, children):
+    cls, sg = aux
+    obj = Tensor.__new__(cls)
+    Tensor.__init__(obj, children[0], stop_gradient=sg)
+    return obj
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten, _unflatten)
+jax.tree_util.register_pytree_node(Parameter, _flatten, _unflatten)

@@ -1,0 +1,82 @@
+"""Dtype registry mirroring paddle.framework.dtype.
+
+Reference: /root/reference/python/paddle/framework/dtype.py — paddle exposes
+named dtype singletons (paddle.float32, ...). Here each is a thin alias of a
+numpy/jax dtype so they interop directly with jnp.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (jnp dtype objects compare equal to numpy dtypes/strings).
+uint8 = jnp.dtype("uint8")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+float16 = jnp.dtype("float16")
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+bool = jnp.dtype("bool")  # noqa: A001 - paddle exposes `paddle.bool`
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+_ALIASES = {
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+    "bfloat": bfloat16,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def dtype(name):
+    """Coerce a paddle-style dtype spec (str / np dtype / jnp dtype) to jnp dtype."""
+    if name is None:
+        return None
+    if isinstance(name, str) and name in _ALIASES:
+        return _ALIASES[name]
+    return jnp.dtype(name)
+
+
+def canonical(d):
+    """Map 64-bit dtypes to their 32-bit forms when x64 is disabled (the TPU
+    default) so paddle's int64/float64 defaults don't spam truncation
+    warnings — values are identical for framework-internal uses."""
+    import jax
+
+    d = dtype(d)
+    if not jax.config.jax_enable_x64:
+        if d == int64:
+            return int32
+        if d == float64:
+            return float32
+        if d == complex128:
+            return complex64
+    return d
+
+
+def set_default_dtype(d):
+    d = dtype(d)
+    if d not in (float16, float32, float64, bfloat16):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point_dtype(d):
+    return jnp.issubdtype(jnp.dtype(d), np.floating) or jnp.dtype(d) == bfloat16
+
+
+def is_integer_dtype(d):
+    return jnp.issubdtype(jnp.dtype(d), np.integer)
+
+
+def is_complex_dtype(d):
+    return jnp.issubdtype(jnp.dtype(d), np.complexfloating)

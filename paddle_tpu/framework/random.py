@@ -1,0 +1,44 @@
+"""Global RNG state mirroring paddle.seed / get_rng_state semantics.
+
+Reference: /root/reference/python/paddle/framework/random.py. Paddle keeps a
+global generator per device; the TPU-native equivalent is a root
+`jax.random.key` plus a fold-in counter, so eager ops get fresh keys while a
+single `seed(n)` reproduces an entire run. Inside jitted code users pass keys
+explicitly (idiomatic JAX); eager creation ops draw from this state.
+"""
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.counter = 0
+    return _state
+
+
+def seed(value: int):
+    """Reset the global RNG. Returns None (paddle returns the generator)."""
+    s = _ensure()
+    s.key = jax.random.PRNGKey(int(value))
+    s.counter = 0
+
+
+def next_key():
+    """Fresh PRNG key for one eager random op (deterministic given seed())."""
+    s = _ensure()
+    s.counter += 1
+    return jax.random.fold_in(s.key, s.counter)
+
+
+def get_rng_state():
+    s = _ensure()
+    return (s.key, s.counter)
+
+
+def set_rng_state(state):
+    s = _ensure()
+    s.key, s.counter = state

@@ -1,0 +1,46 @@
+"""paddle_tpu.tensor — aggregates op modules and monkey-patches them as
+Tensor methods (reference: python/paddle/tensor/__init__.py tensor_method_func
++ monkey_patch_varbase)."""
+from ..framework.core import Tensor
+from . import attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+# names that are Tensor properties or core methods — never overwrite
+_SKIP = {
+    "shape", "rank", "to_tensor", "as_tensor", "is_tensor", "numel",
+    "seed", "get_rng_state", "set_rng_state", "rand", "randn", "randint",
+    "randperm", "meshgrid", "broadcast_shape", "is_empty",
+}
+
+
+def _patch_tensor_methods():
+    for mod in (attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat):
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or hasattr(Tensor, name) and name not in getattr(mod, "__all__", []):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # extra aliases paddle exposes as methods
+    Tensor.mean = stat.mean
+    Tensor.var = stat.var
+    Tensor.std = stat.std
+    Tensor.add = math.add
+    Tensor.add_ = math.add_
+    Tensor.subtract = math.subtract
+    Tensor.multiply = math.multiply
+    Tensor.divide = math.divide
+    Tensor.matmul = math.matmul
+    Tensor.numel = lambda self: self.size
+
+
+_patch_tensor_methods()

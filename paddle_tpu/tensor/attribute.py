@@ -1,0 +1,28 @@
+"""Tensor attribute helpers — API of reference python/paddle/tensor/attribute.py."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.dtype import is_complex_dtype, is_floating_point_dtype, is_integer_dtype
+
+__all__ = ["shape", "rank", "is_floating_point", "is_integer", "is_complex"]
+
+
+def shape(input):
+    return Tensor(jnp.asarray(np.array(input.shape, dtype=np.int32)))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim))
+
+
+def is_floating_point(x):
+    return is_floating_point_dtype(x.dtype)
+
+
+def is_integer(x):
+    return is_integer_dtype(x.dtype)
+
+
+def is_complex(x):
+    return is_complex_dtype(x.dtype)

@@ -1,0 +1,145 @@
+"""Tensor creation ops — API of reference python/paddle/tensor/creation.py,
+implemented on jnp (XLA-eager on TPU, constant-folded under jit)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _rng
+from ..framework.core import Tensor, apply_op, to_tensor  # noqa: F401
+from ..framework.dtype import dtype as _dt, get_default_dtype
+
+__all__ = [
+    "to_tensor", "zeros", "zeros_like", "ones", "ones_like", "full",
+    "full_like", "arange", "linspace", "logspace", "eye", "empty",
+    "empty_like", "meshgrid", "diag", "diagflat", "tril", "triu",
+    "assign", "clone", "complex", "as_tensor",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _fdt(dtype):
+    from ..framework.dtype import canonical
+    return canonical(dtype) if dtype is not None else _dt(get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _fdt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _fdt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = "bool" if isinstance(fill_value, bool) else (
+            "int64" if isinstance(fill_value, int) else get_default_dtype())
+    from ..framework.dtype import canonical
+    return Tensor(jnp.full(_shape(shape), fill_value, canonical(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.zeros_like(v, dtype=_dt(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.ones_like(v, dtype=_dt(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(lambda v: jnp.full_like(v, fill_value, dtype=_dt(dtype)), x)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _item(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _item(start), _item(end), _item(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else get_default_dtype()
+    from ..framework.dtype import canonical
+    return Tensor(jnp.arange(start, end, step, dtype=canonical(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_fdt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=_fdt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_fdt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)  # deterministic "empty" (XLA buffers are managed)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply_op(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            return out + jnp.diag(v, k=offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), k=offset)
+        return jnp.diag(v, k=offset)
+    return apply_op(_f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def assign(x, output=None):
+    src = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = apply_op(lambda v: v + 0 if v.dtype != jnp.bool_ else v, src)
+    if output is not None:
+        output._value = out._value
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def as_tensor(data, dtype=None):
+    return to_tensor(data, dtype=dtype)

@@ -1,0 +1,13 @@
+"""Einsum — API of reference python/paddle/tensor/einsum.py; XLA lowers
+contractions straight onto the MXU via dot_general."""
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(lambda *vs: jnp.einsum(equation, *vs), *operands)

@@ -1,0 +1,198 @@
+"""Linear algebra — API of reference python/paddle/tensor/linalg.py.
+Decompositions route through jax.numpy.linalg / lax.linalg (XLA custom calls
+on TPU; QR/SVD/Cholesky run on device, eig falls back to host like the
+reference's LAPACK path for CPU-only ops)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "norm", "dist", "cond", "det", "slogdet", "inv", "pinv", "solve",
+    "cholesky", "cholesky_solve", "triangular_solve", "lstsq", "qr", "svd",
+    "matrix_power", "matrix_rank", "eig", "eigh", "eigvals", "eigvalsh",
+    "lu", "multi_dot", "cross", "t", "histogram", "bincount", "corrcoef",
+    "cov",
+]
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _f(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(flat.astype(jnp.float32) ** 2)).astype(v.dtype)
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == np.inf:
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op(_f, x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=float(p) if p not in ("fro",) else p)
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def _f(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+    return apply_op(_f, x)
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def _f(v):
+        c = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+    return apply_op(_f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _f(b, chol):
+        c = jnp.swapaxes(chol, -1, -2) if upper else chol
+        z = jax.scipy.linalg.solve_triangular(c, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(c, -1, -2), z, lower=False)
+    return apply_op(_f, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply_op(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular),
+        x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return tuple(apply_op(_f, x, y))
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply_op(lambda v: jnp.linalg.qr(v, mode="r"), x)
+    outs = apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+    return tuple(outs)
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+    return tuple(outs)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x)
+
+
+def eig(x, name=None):
+    # general eig is CPU-only in XLA (like reference's LAPACK-on-host)
+    arr = np.asarray(x._value)
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(x._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x)
+    return tuple(outs)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    lu_t, piv_t = apply_op(_f, x)
+    if get_infos:
+        return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
+    return lu_t, piv_t
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+def cross(x, y, axis=9, name=None):
+    def _f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(_f, x, y)
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return apply_op(lambda v: v, x)
+    return apply_op(lambda v: jnp.swapaxes(v, -1, -2), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    return apply_op(_f, input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply_op(lambda v, w: jnp.bincount(v, w, minlength=minlength,
+                                                  length=max(minlength, int(np.asarray(x._value).max()) + 1)),
+                        x, weights)
+    n = max(minlength, int(np.asarray(x._value).max()) + 1 if x.size else minlength)
+    return apply_op(lambda v: jnp.bincount(v, minlength=minlength, length=n), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x)

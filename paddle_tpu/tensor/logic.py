@@ -1,0 +1,59 @@
+"""Comparison / logical ops — API of reference python/paddle/tensor/logic.py."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "equal", "equal_all", "not_equal", "greater_than", "greater_equal",
+    "less_than", "less_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "is_empty", "allclose", "isclose", "is_tensor",
+]
+
+
+def _cmp(fn):
+    def op(x, y, name=None):
+        return apply_op(fn, x, y)
+    return op
+
+
+equal = _cmp(lambda a, b: a == b)
+not_equal = _cmp(lambda a, b: a != b)
+greater_than = _cmp(lambda a, b: a > b)
+greater_equal = _cmp(lambda a, b: a >= b)
+less_than = _cmp(lambda a, b: a < b)
+less_equal = _cmp(lambda a, b: a <= b)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return apply_op(jnp.logical_not, x)
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, x)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,386 @@
+"""Shape/layout manipulation — API of reference python/paddle/tensor/manipulation.py."""
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "unstack",
+    "split", "vsplit", "hsplit", "dsplit", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "flatten", "flip", "roll", "chunk",
+    "unbind", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "masked_select", "take_along_axis", "put_along_axis", "where",
+    "tensordot", "moveaxis", "swapaxes", "repeat_interleave", "flatten_",
+    "as_real", "as_complex", "unique", "unique_consecutive", "strided_slice",
+    "slice", "crop", "fill_", "zero_", "shard_index", "rotate_half",
+]
+
+
+def _ival(v):
+    return int(v._value) if isinstance(v, Tensor) else int(v)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._value)]
+    else:
+        shape = [_ival(s) for s in shape]
+    return apply_op(lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    shape = [_ival(s) for s in shape]
+    return x._inplace_update(lambda v: jnp.reshape(v, shape))
+
+
+def transpose(x, perm=None, name=None):
+    return apply_op(lambda v: jnp.transpose(v, None if perm is None else tuple(perm)), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    axis = _ival(axis)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), *x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    outs = apply_op(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)), x)
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = _ival(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [s if not isinstance(s, Tensor) else int(s._value) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sizes if s in (-1, None))
+        if n_unknown:
+            known = builtins_sum(s for s in sizes if s not in (-1, None))
+            sizes = [(dim - known) if s in (-1, None) else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def _f(v):
+        return tuple(jax.lax.slice_in_dim(v, o, o + s, axis=axis) for o, s in zip(offsets, sizes))
+    return list(apply_op(_f, x))
+
+
+def builtins_sum(it, start=0):
+    total = start
+    for v in it:
+        total = total + v
+    return total
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = None
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a for a in (int(a) for a in axes) if x.shape[a] == 1)
+    return apply_op(lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._producer = out._value, out._producer
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a._value) if isinstance(a, Tensor) else int(a) for a in axes)
+    return apply_op(lambda v: jnp.expand_dims(v, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._producer = out._value, out._producer
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def _f(v):
+        shp = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, shp)
+    return apply_op(_f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value, x._producer = out._value, out._producer
+    return x
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op(lambda v: jnp.flip(v, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), x)
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(_ival(r) for r in repeat_times) if isinstance(repeat_times, (list, tuple)) \
+        else (_ival(repeat_times),)
+    return apply_op(lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._value)]
+    shape = [_ival(s) for s in shape]
+
+    def _f(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(v.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tgt)
+    return apply_op(_f, x)
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda v, t: jnp.broadcast_to(v, t.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs)
+    return list(outs)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = _ival(axis)
+    return apply_op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def _f(v, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[flat_idx]
+    return apply_op(_f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle overwrite=False: zero target rows then accumulate
+        zeroed = v.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply_op(_f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._producer = out._value, out._producer
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def _f(i, u):
+        out = jnp.zeros(tuple(shape), u.dtype)
+        return out.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op(_f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply_op(lambda v, i, u: v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u), x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda v, i: jnp.take(v, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    return apply_op(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index)
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent output shape: eager-only (like reference dygraph op)
+    return Tensor(np.asarray(x._value)[np.asarray(mask._value)])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply_op(lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    def _f(v, i, u):
+        u = jnp.broadcast_to(jnp.asarray(u, v.dtype), i.shape)
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                         for d in range(i.ndim))
+        if reduce == "add":
+            return v.at[full_idx].add(u)
+        if reduce in ("mul", "multiply"):
+            return v.at[full_idx].multiply(u)
+        return v.at[full_idx].set(u)
+    return apply_op(_f, arr, indices, values)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)[:, None]) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes._value).tolist()
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply_op(lambda v, r: jnp.repeat(v, r, axis=axis,
+                                                total_repeat_length=int(np.asarray(repeats._value).sum())),
+                        x, repeats)
+    return apply_op(lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], dtype=bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) if arr.ndim > 1 \
+        else arr[1:] != arr[:-1]
+    out = [Tensor(jnp.asarray(arr[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        out.append(Tensor(jnp.asarray(np.diff(np.append(idx, arr.shape[0])))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def slice(input, axes, starts, ends):
+    def _f(v):
+        out = v
+        for ax, s, e in zip(axes, starts, ends):
+            s = _ival(s); e = _ival(e)
+            e = builtins_min(e, out.shape[ax])
+            out = jax.lax.slice_in_dim(out, s, e, axis=ax)
+        return out
+    return apply_op(_f, input)
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(_ival(s), _ival(e), _ival(st))
+        return v[tuple(idx)]
+    return apply_op(_f, x)
+
+
+builtins_slice = builtins.slice
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    offs = [0] * x.ndim if offsets is None else [_ival(o) for o in offsets]
+    shp = x.shape if shape is None else [x.shape[i] if _ival(s) == -1 else _ival(s)
+                                         for i, s in enumerate(shape)]
+    return apply_op(lambda v: jax.lax.dynamic_slice(v, offs, shp), x)
+
+
+def fill_(x, value):
+    return x._inplace_update(lambda v: jnp.full_like(v, value))
+
+
+def zero_(x):
+    return x._inplace_update(lambda v: jnp.zeros_like(v))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def _f(v):
+        in_shard = (v // size) == shard_id
+        return jnp.where(in_shard, v % size, ignore_value)
+    return apply_op(_f, input)
+
+
+def rotate_half(x):  # helper used by rotary embeddings
+    return apply_op(lambda v: jnp.concatenate([-v[..., v.shape[-1] // 2:], v[..., : v.shape[-1] // 2]], axis=-1), x)

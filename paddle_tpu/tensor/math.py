@@ -1,0 +1,397 @@
+"""Math ops — API of reference python/paddle/tensor/math.py + ops.py,
+lowered to jnp/lax so XLA fuses elementwise chains into MXU-adjacent kernels.
+
+Also installs arithmetic operators on Tensor (reference does this in
+python/paddle/fluid/dygraph/math_op_patch.py via monkey_patch_math_varbase).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import canonical
+from ..framework.core import Tensor, apply_op
+
+__all__ = []  # populated at bottom
+
+
+def _un(name, fn):
+    """Register a unary elementwise op + its inplace alias."""
+    def op(x, name=None):
+        return apply_op(fn, x)
+    op.__name__ = name
+    globals()[name] = op
+    __all__.append(name)
+
+    def op_(x, name=None):
+        return x._inplace_update(fn)
+    op_.__name__ = name + "_"
+    globals()[name + "_"] = op_
+    return op
+
+
+def _bin(name, fn):
+    def op(x, y, name=None):
+        return apply_op(fn, x, y)
+    op.__name__ = name
+    globals()[name] = op
+    __all__.append(name)
+
+    def op_(x, y, name=None):
+        yv = y._value if isinstance(y, Tensor) else y
+        return x._inplace_update(lambda v: fn(v, yv))
+    op_.__name__ = name + "_"
+    globals()[name + "_"] = op_
+    return op
+
+
+# -- elementwise unary ------------------------------------------------------
+for _n, _f in {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt, "abs": jnp.abs, "ceil": jnp.ceil,
+    "floor": jnp.floor, "round": jnp.round, "trunc": jnp.trunc,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+    "acos": jnp.arccos, "atan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh, "atanh": jnp.arctanh, "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv, "sign": jnp.sign, "neg": jnp.negative,
+    "reciprocal": jnp.reciprocal, "square": jnp.square,
+    "sigmoid": jax.nn.sigmoid, "angle": jnp.angle, "conj": jnp.conj,
+    "real": jnp.real, "imag": jnp.imag, "frac": lambda v: v - jnp.trunc(v),
+    "digamma": jax.scipy.special.digamma, "lgamma": jax.scipy.special.gammaln,
+    "i0": jax.scipy.special.i0, "i1": jax.scipy.special.i1,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+    "logit": jax.scipy.special.logit,
+    "nan_to_num": jnp.nan_to_num,
+}.items():
+    _un(_n, _f)
+
+# -- elementwise binary -----------------------------------------------------
+for _n, _f in {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "heaviside": jnp.heaviside, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp, "inner": jnp.inner, "outer": jnp.outer,
+    "kron": jnp.kron,
+}.items():
+    _bin(_n, _f)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _f(v, _s=scale, _b=bias):
+        _s = _s._value if isinstance(_s, Tensor) else _s
+        out = v * jnp.asarray(_s, v.dtype) + jnp.asarray(_b, v.dtype) if bias_after_scale \
+            else (v + jnp.asarray(_b, v.dtype)) * jnp.asarray(_s, v.dtype)
+        return out
+    return apply_op(_f, x)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min._value if isinstance(min, Tensor) else min
+    mx = max._value if isinstance(max, Tensor) else max
+    return apply_op(lambda v: jnp.clip(v, mn, mx), x)
+
+
+def clip_(x, min=None, max=None, name=None):
+    mn = min._value if isinstance(min, Tensor) else min
+    mx = max._value if isinstance(max, Tensor) else max
+    return x._inplace_update(lambda v: jnp.clip(v, mn, mx))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def multiplex(inputs, index, name=None):
+    def _f(idx, *vs):
+        stacked = jnp.stack(vs, axis=0)  # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return apply_op(_f, index, *inputs)
+
+
+# -- reductions -------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    def _f(v):
+        d = canonical(dtype) if dtype is not None else (
+            jnp.int64 if v.dtype in (jnp.bool_, jnp.int32) and jax.config.jax_enable_x64 else None)
+        return jnp.sum(v, axis=ax, dtype=d, keepdims=keepdim)
+    return apply_op(_f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.max(v, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.min(v, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.prod(v, axis=ax, keepdims=keepdim,
+                                       dtype=canonical(dtype) if dtype else None), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.all(v, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.any(v, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), x)
+
+
+# -- cumulative -------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _f(v):
+        vv = v.reshape(-1) if axis is None else v
+        return jnp.cumsum(vv, axis=0 if axis is None else int(axis))
+    return apply_op(_f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _f(v):
+        vv = v.reshape(-1) if dim is None else v
+        return jnp.cumprod(vv, axis=0 if dim is None else int(dim))
+    return apply_op(_f, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.cummax(vv, axis=ax)
+        # indices: position of the running max
+        idx = jnp.arange(vv.shape[ax]).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        inds = jax.lax.cummax(jnp.where(vv == vals, idx, 0), axis=ax)
+        return vals, inds.astype(canonical(dtype))
+    return apply_op(_f, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.cummin(vv, axis=ax)
+        idx = jnp.arange(vv.shape[ax]).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        inds = jax.lax.cummax(jnp.where(vv == vals, idx, 0), axis=ax)
+        return vals, inds.astype(canonical(dtype))
+    return apply_op(_f, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def _f(v):
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.cumlogsumexp(vv, axis=0 if axis is None else int(axis))
+    return apply_op(_f, x)
+
+
+# -- matmul family (MXU path) ----------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(_f, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def matmul_v2(x, y, trans_x=False, trans_y=False):  # legacy fluid op name
+    return matmul(x, y, trans_x, trans_y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    kw = {}
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+    def _f(v, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return apply_op(_f, *args, **kw)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace_update(lambda v: v + jnp.asarray(value, v.dtype))
+
+
+def isnan(x, name=None):
+    return apply_op(jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return apply_op(jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return apply_op(jnp.isfinite, x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def _f(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply_op(_f, *inputs)
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_op(lambda v, i: jnp.take(v.reshape(-1), i, mode="clip" if mode != "wrap" else "wrap"), x, index)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+__all__ += [
+    "scale", "clip", "clip_", "lerp", "stanh", "multiplex", "sum", "mean",
+    "max", "min", "amax", "amin", "prod", "logsumexp", "all", "any",
+    "count_nonzero", "nansum", "nanmean", "cumsum", "cumprod", "cummax",
+    "cummin", "logcumsumexp", "matmul", "mm", "bmm", "dot", "addmm", "mv",
+    "diff", "trace", "increment", "isnan", "isinf", "isfinite",
+    "broadcast_shape", "add_n", "take", "rot90",
+]
+
+
+# -- operator monkey-patch on Tensor ---------------------------------------
+def _patch_operators():
+    import operator as _op  # noqa: F401
+
+    def _binop(fn, reverse=False):
+        def method(self, other):
+            if reverse:
+                return apply_op(lambda b, a: fn(a, b), self, other) if isinstance(other, Tensor) \
+                    else apply_op(lambda a: fn(other, a), self)
+            return apply_op(fn, self, other)
+        return method
+
+    T = Tensor
+    T.__add__ = _binop(jnp.add)
+    T.__radd__ = _binop(jnp.add, True)
+    T.__sub__ = _binop(jnp.subtract)
+    T.__rsub__ = _binop(jnp.subtract, True)
+    T.__mul__ = _binop(jnp.multiply)
+    T.__rmul__ = _binop(jnp.multiply, True)
+    T.__truediv__ = _binop(jnp.divide)
+    T.__rtruediv__ = _binop(jnp.divide, True)
+    T.__floordiv__ = _binop(jnp.floor_divide)
+    T.__rfloordiv__ = _binop(jnp.floor_divide, True)
+    T.__mod__ = _binop(jnp.mod)
+    T.__rmod__ = _binop(jnp.mod, True)
+    T.__pow__ = _binop(jnp.power)
+    T.__rpow__ = _binop(jnp.power, True)
+    T.__matmul__ = _binop(jnp.matmul)
+    T.__rmatmul__ = _binop(jnp.matmul, True)
+    T.__neg__ = lambda self: apply_op(jnp.negative, self)
+    T.__abs__ = lambda self: apply_op(jnp.abs, self)
+    T.__invert__ = lambda self: apply_op(jnp.logical_not, self)
+    T.__eq__ = _binop(lambda a, b: a == b)
+    T.__ne__ = _binop(lambda a, b: a != b)
+    T.__lt__ = _binop(lambda a, b: a < b)
+    T.__le__ = _binop(lambda a, b: a <= b)
+    T.__gt__ = _binop(lambda a, b: a > b)
+    T.__ge__ = _binop(lambda a, b: a >= b)
+    T.__and__ = _binop(jnp.logical_and)
+    T.__or__ = _binop(jnp.logical_or)
+    T.__xor__ = _binop(jnp.logical_xor)
+    T.__hash__ = object.__hash__
+
+
+_patch_operators()

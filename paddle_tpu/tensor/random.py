@@ -1,0 +1,112 @@
+"""Random ops — API of reference python/paddle/tensor/random.py.
+Eager calls draw deterministic keys from the global seeded stream
+(framework/random.py); inside jit users should pass explicit keys via
+paddle_tpu.framework.random or use the functional model APIs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _rng
+from ..framework.core import Tensor, apply_op
+from ..framework.dtype import canonical, dtype as _dt, get_default_dtype
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "uniform_", "normal", "standard_normal", "poisson", "bernoulli",
+    "multinomial", "exponential_", "seed", "get_rng_state", "set_rng_state",
+]
+
+seed = _rng.seed
+get_rng_state = _rng.get_rng_state
+set_rng_state = _rng.set_rng_state
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    d = canonical(dtype) if dtype else _dt(get_default_dtype())
+    return Tensor(jax.random.uniform(_rng.next_key(), _shape(shape), d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = canonical(dtype) if dtype else _dt(get_default_dtype())
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape), d))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_rng.next_key(), _shape(shape), low, high).astype(canonical(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = canonical(dtype) if dtype else x.dtype
+    return Tensor(jax.random.randint(_rng.next_key(), tuple(x.shape), low, high).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.next_key(), int(n)).astype(canonical(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = canonical(dtype) if dtype else _dt(get_default_dtype())
+    return Tensor(jax.random.uniform(_rng.next_key(), _shape(shape), d, min, max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = _rng.next_key()
+    return x._inplace_update(lambda v: jax.random.uniform(key, v.shape, v.dtype, min, max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        d = jnp.result_type(m) if hasattr(m, "dtype") else _dt(get_default_dtype())
+        return Tensor(jax.random.normal(_rng.next_key(), shp, d) * s + m)
+    d = _dt(get_default_dtype())
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape or (1,)), d) * std + mean)
+
+
+def poisson(x, name=None):
+    key = _rng.next_key()
+    return apply_op(lambda v: jax.random.poisson(key, v, v.shape).astype(v.dtype), x)
+
+
+def bernoulli(x, name=None):
+    key = _rng.next_key()
+    return apply_op(lambda v: jax.random.bernoulli(key, v, v.shape).astype(v.dtype), x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _rng.next_key()
+
+    def _f(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=(num_samples,) + v.shape[:-1]).T \
+                if v.ndim > 1 else jax.random.categorical(key, logits, shape=(num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    out = apply_op(_f, x)
+    return out.astype(canonical("int64"))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _rng.next_key()
+    return x._inplace_update(lambda v: jax.random.exponential(key, v.shape, v.dtype) / lam)

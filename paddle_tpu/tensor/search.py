@@ -1,0 +1,119 @@
+"""Search/sort ops — API of reference python/paddle/tensor/search.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import canonical
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "kthvalue",
+    "mode", "masked_fill", "index_fill", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis), keepdims=keepdim and axis is not None)
+        return out.astype(canonical(dtype) if jax.config.jax_enable_x64 else jnp.int32)
+    return apply_op(_f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis), keepdims=keepdim and axis is not None)
+        return out.astype(canonical(dtype) if jax.config.jax_enable_x64 else jnp.int32)
+    return apply_op(_f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _f(v):
+        idx = jnp.argsort(v, axis=axis, descending=descending)
+        return idx
+    return apply_op(_f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply_op(lambda v: jnp.sort(v, axis=axis, descending=descending), x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k._value)
+
+    def _f(v):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(v, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+    vals, idx = apply_op(_f, x)
+    return vals, idx
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def _f(seq, v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int32)
+    return apply_op(_f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _f(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        idx_sorted = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sorted_v, k - 1, axis=axis)
+        idx = jnp.take(idx_sorted, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    v, i = apply_op(_f, x)
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._value)
+    moved = np.moveaxis(arr, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for r in range(flat.shape[0]):
+        uniq, counts = np.unique(flat[r], return_counts=True)
+        best = uniq[np.argmax(counts[::-1])] if False else uniq[len(counts) - 1 - np.argmax(counts[::-1])]
+        vals[r] = best
+        idxs[r] = np.where(flat[r] == best)[0][-1]
+    shp = moved.shape[:-1]
+    v, i = vals.reshape(shp), idxs.reshape(shp)
+    if keepdim:
+        v, i = np.expand_dims(v, axis), np.expand_dims(i, axis)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def index_fill(x, index, axis, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+
+    def _f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[i].set(jnp.asarray(v, a.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op(_f, x, index)

@@ -1,0 +1,46 @@
+"""Statistics ops — API of reference python/paddle/tensor/stat.py."""
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile", "nanquantile", "numel"]
+
+
+def _axis(axis):
+    if axis is None or isinstance(axis, int):
+        return axis
+    return tuple(int(a) for a in axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.median(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim), x)
+
+
+def numel(x, name=None):
+    from .creation import to_tensor
+    return to_tensor(x.size, dtype="int64")
