@@ -1,0 +1,143 @@
+"""AMP — reference python/paddle/amp/{auto_cast,grad_scaler}.py.
+
+On TPU the native mixed-precision story is bf16: auto_cast('bfloat16')
+casts op inputs at the dispatch layer (O1-style allowlist) or whole layers
+(O2). GradScaler keeps fp16 API parity; with bf16 it is a functional no-op
+(scale 1) since bf16 shares fp32's exponent range.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.core import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate"]
+
+# ops that are numerically safe & profitable in low precision (mirrors the
+# reference's white list in fluid/contrib/mixed_precision/fp16_lists.py)
+_FP16_WHITELIST_HINT = {"matmul", "conv2d", "einsum"}
+
+_amp_state = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1"}
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_amp_state)
+    _amp_state.update(enabled=enable, dtype=jnp.dtype(dtype), level=level)
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def amp_enabled():
+    return _amp_state["enabled"]
+
+
+def amp_dtype():
+    return _amp_state["dtype"]
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to bf16; optimizer keeps fp32 masters."""
+    d = jnp.dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.astype(d)
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        o._multi_precision = True
+    return (models if single else model_list), (optimizers if opt_single else opt_list)
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                p.grad._value = g
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good": self._good, "bad": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good = state.get("good", 0)
+        self._bad = state.get("bad", 0)

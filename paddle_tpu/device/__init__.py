@@ -1,0 +1,62 @@
+"""paddle_tpu.device — reference python/paddle/device/__init__.py."""
+from ..framework.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+__all__ = ["set_device", "get_device", "device_count", "TPUPlace", "CPUPlace",
+           "is_compiled_with_cuda", "is_compiled_with_tpu"]
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class cuda:
+    """Namespace parity for paddle.device.cuda on TPU builds."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()

@@ -1,0 +1,68 @@
+"""paddle_tpu.distributed — reference python/paddle/distributed/__init__.py,
+rebuilt on jax.sharding meshes + XLA collectives (no NCCL/gloo)."""
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .mesh import (  # noqa: F401
+    Mesh,
+    NamedSharding,
+    PartitionSpec,
+    axis_scope,
+    build_mesh,
+    get_mesh,
+    in_shard_map,
+    mesh_axis_size,
+    named_sharding,
+    set_mesh,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "DataParallel",
+    "ParallelEnv", "all_reduce", "all_gather", "reduce", "broadcast",
+    "scatter", "reduce_scatter", "alltoall", "send", "recv", "barrier",
+    "ReduceOp", "Group", "new_group", "get_group", "wait", "fleet",
+    "get_mesh", "build_mesh", "Mesh", "PartitionSpec", "NamedSharding",
+    "plan_shardings", "shard_params", "constraint", "spawn", "launch",
+]
+
+
+def get_data_parallel_axis():
+    ctx = __import__("paddle_tpu.distributed.mesh", fromlist=["current_axis_context"])
+    axes = ctx.current_axis_context()
+    return "dp" if "dp" in axes else None
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-controller JAX drives all local devices from one process; spawn
+    therefore just runs func once (multi-host uses one process per host,
+    launched externally with jax.distributed env vars)."""
+    func(*args)
+
+
+def launch():
+    raise NotImplementedError(
+        "use standard multi-host launching (one process per host with "
+        "JAX_COORDINATOR/process env) — see docs/distributed.md")

@@ -1,0 +1,225 @@
+"""Collective communication — reference python/paddle/distributed/collective.py.
+
+The reference binds NCCL; here every collective is an XLA collective over the
+mesh ('dp' by default), usable in two contexts:
+
+  * inside shard_map (axis_scope active): lax.psum / all_gather / ppermute …
+    compiled onto ICI — the performance path
+  * eager / outside shard_map: single-controller semantics. Arrays are global,
+    so sum-like collectives are identities for replicated values; world size 1
+    is always an identity. This keeps reference scripts runnable unchanged.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from .mesh import current_axis_context, get_mesh, in_shard_map, mesh_axis_size
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "reduce", "broadcast", "scatter",
+    "reduce_scatter", "alltoall", "send", "recv", "barrier", "get_group",
+    "new_group", "wait", "Group",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, rank, nranks, id=0, ranks=None, axis="dp"):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis = axis
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank)
+
+
+_default_group = None
+
+
+def _group_axis(group):
+    return group.axis if isinstance(group, Group) else "dp"
+
+
+def get_group(id=0):
+    global _default_group
+    if _default_group is None:
+        import jax
+        _default_group = Group(jax.process_index(), max(jax.process_count(), 1))
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis="dp"):
+    return Group(0, len(ranks) if ranks else mesh_axis_size(axis), axis=axis)
+
+
+def _live_axis(axis):
+    """The axis name to reduce over, or None for identity semantics."""
+    ctx = current_axis_context()
+    if axis in ctx:
+        return axis
+    return None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor  # replicated global array: sum across ranks is itself
+
+    def _f(v):
+        if op in (ReduceOp.SUM, "sum"):
+            return jax.lax.psum(v, axis)
+        if op in (ReduceOp.MAX, "max"):
+            return jax.lax.pmax(v, axis)
+        if op in (ReduceOp.MIN, "min"):
+            return jax.lax.pmin(v, axis)
+        if op in (ReduceOp.AVG, "avg"):
+            return jax.lax.pmean(v, axis)
+        return jax.lax.psum(v, axis)  # prod unsupported by ICI; sum fallback
+    if isinstance(tensor, Tensor):
+        out = apply_op(_f, tensor)
+        tensor._value = out._value
+        return tensor
+    return _f(tensor)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """paddle signature: all_gather(list, tensor). Inside shard_map returns
+    the concatenated array as well."""
+    if tensor is None:  # functional form: all_gather(x) -> gathered
+        tensor, tensor_list = tensor_list, None
+    ax = _live_axis(_group_axis(group))
+    if ax is None:
+        out = tensor
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+        return out
+
+    def _f(v):
+        return jax.lax.all_gather(v, ax, tiled=True)
+    out = apply_op(_f, tensor) if isinstance(tensor, Tensor) else _f(tensor)
+    if tensor_list is not None:
+        n = mesh_axis_size(ax)
+        from ..tensor.manipulation import split
+        tensor_list.extend(split(out, n, axis=0))
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor
+
+    def _f(v):
+        # take src's value: gather then index (XLA folds this into a broadcast)
+        g = jax.lax.all_gather(v, axis)
+        return g[src]
+    if isinstance(tensor, Tensor):
+        out = apply_op(_f, tensor)
+        tensor._value = out._value
+        return tensor
+    return _f(tensor)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor
+
+    def _f(v):
+        idx = jax.lax.axis_index(axis)
+        n = mesh_axis_size(axis)
+        chunk = v.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(v, idx * chunk, chunk, axis=0)
+    if isinstance(tensor, Tensor):
+        return apply_op(_f, tensor)
+    return _f(tensor)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor
+
+    def _f(v):
+        return jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+    if isinstance(tensor, Tensor):
+        return apply_op(_f, tensor)
+    return _f(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    x = in_tensor_list
+    stacked = None
+    if isinstance(x, (list, tuple)):
+        from ..tensor.manipulation import stack
+        stacked = stack(list(x), axis=0)
+    else:
+        stacked = x
+    if axis is None:
+        out = stacked
+    else:
+        def _f(v):
+            return jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0, tiled=False)
+        out = apply_op(_f, stacked) if isinstance(stacked, Tensor) else _f(stacked)
+    if out_tensor_list is not None:
+        n = mesh_axis_size(axis) if axis else 1
+        from ..tensor.manipulation import unstack
+        out_tensor_list.extend(unstack(out, axis=0))
+        return None
+    return out
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor
+    n = mesh_axis_size(axis)
+
+    def _f(v):
+        # point-to-point on ICI = ppermute ring hop
+        perm = [(i, dst) for i in range(n)]
+        return jax.lax.ppermute(v, axis, perm)
+    return apply_op(_f, tensor) if isinstance(tensor, Tensor) else _f(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    axis = _live_axis(_group_axis(group))
+    if axis is None:
+        return tensor
+    n = mesh_axis_size(axis)
+
+    def _f(v):
+        perm = [(src, i) for i in range(n)]
+        return jax.lax.ppermute(v, axis, perm)
+    out = apply_op(_f, tensor) if isinstance(tensor, Tensor) else _f(tensor)
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        return tensor
+    return out
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
+        tensor._value.block_until_ready()

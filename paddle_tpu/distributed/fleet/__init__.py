@@ -1,0 +1,162 @@
+"""Fleet — reference python/paddle/distributed/fleet/__init__.py.
+
+fleet.init(strategy) builds the global mesh from hybrid_configs;
+distributed_model/distributed_optimizer return GSPMD-aware wrappers whose
+jitted train step shards params per plan_shardings and batches over
+('dp','fsdp'). No NCCL process groups: XLA emits the collectives.
+"""
+import jax
+
+from ...framework.core import Tensor
+from ..mesh import build_mesh, get_mesh, mesh_axis_size
+from ..sharding_utils import plan_shardings, shard_params
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+
+__all__ = [
+    "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "worker_index", "worker_num", "is_first_worker",
+    "HybridCommunicateGroup", "ColumnParallelLinear", "RowParallelLinear",
+    "VocabParallelEmbedding", "ParallelCrossEntropy", "get_rng_state_tracker",
+]
+
+
+class DistributedStrategy:
+    """reference python/paddle/distributed/fleet/base/distributed_strategy.py"""
+
+    def __init__(self):
+        self.hybrid_configs = {}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.dgc = False
+        self.lamb = False
+        self.find_unused_parameters = False
+
+    def _degrees(self):
+        cfg = self.hybrid_configs or {}
+        return dict(
+            dp=int(cfg.get("dp_degree", 1)),
+            tp=int(cfg.get("mp_degree", 1)),
+            pp=int(cfg.get("pp_degree", 1)),
+            fsdp=int(cfg.get("sharding_degree", 1)),
+            sp=int(cfg.get("sep_degree", cfg.get("sp_degree", 1))),
+            ep=int(cfg.get("ep_degree", 1)),
+        )
+
+
+class HybridCommunicateGroup:
+    def __init__(self, strategy):
+        d = strategy._degrees()
+        self._d = d
+
+    def get_data_parallel_world_size(self):
+        return self._d["dp"] * self._d["fsdp"]
+
+    def get_model_parallel_world_size(self):
+        return self._d["tp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._d["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._d["fsdp"]
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self._d["tp"], axis="tp")
+
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self._d["dp"], axis="dp")
+
+    def get_sharding_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self._d["fsdp"], axis="fsdp")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self._d["pp"], axis="pp")
+
+
+_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    strategy = strategy or DistributedStrategy()
+    d = strategy._degrees()
+    n_dev = len(jax.devices())
+    import numpy as np
+    need = int(np.prod(list(d.values())))
+    if need == 1 and n_dev > 1:
+        d["dp"] = n_dev
+    build_mesh(**d)
+    _state.update(strategy=strategy, hcg=HybridCommunicateGroup(strategy), initialized=True)
+    return None
+
+
+def get_hybrid_communicate_group():
+    return _state["hcg"]
+
+
+def worker_index():
+    return jax.process_index()
+
+
+def worker_num():
+    return max(jax.process_count(), 1)
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+class DistributedModel:
+    """Wraps a Layer: params physically sharded over the mesh; calls pass
+    through (GSPMD handles comms). reference meta_parallel model wrappers."""
+
+    def __init__(self, layer):
+        self._layers = layer
+        self.sharding_plan = shard_params(layer)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
+def distributed_model(model):
+    if not _state["initialized"]:
+        init()
+    return DistributedModel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    # optimizer state inherits parameter shardings automatically in the
+    # functional path; eager path updates sharded arrays in place
+    return optimizer

@@ -1,0 +1,71 @@
+"""init_parallel_env / DataParallel — reference python/paddle/distributed/parallel.py."""
+import jax
+
+from ..nn.layer_base import Layer
+from .mesh import build_mesh, get_mesh
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel", "ParallelEnv"]
+
+
+def init_parallel_env():
+    """Initializes the default 1-axis dp mesh over all visible devices.
+    Multi-host: call jax.distributed.initialize first (env-driven)."""
+    get_mesh(create_default=True)
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return max(jax.process_count(), 1)
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+class DataParallel(Layer):
+    """reference DataParallel wraps NCCL allreduce of grads; here batches are
+    globally sharded over 'dp' and grad reduction happens inside the compiled
+    step, so this wrapper only marks intent + shards params."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        from .sharding_utils import shard_params
+        shard_params(layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def _sub_layers_passthrough(self):
+        return self._layers
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

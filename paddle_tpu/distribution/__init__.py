@@ -1,0 +1,321 @@
+"""Probability distributions — reference python/paddle/distribution/*."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from ..framework.random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+           "Dirichlet", "Multinomial", "ExponentialFamily", "Independent",
+           "TransformedDistribution", "kl_divergence", "register_kl"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale), self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        z = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = jnp.square(self.scale)
+        return Tensor(-jnp.square(v - self.loc) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), self.batch_shape))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        lv = _val(logits)
+        self.logits = lv - jax.scipy.special.logsumexp(lv, axis=-1, keepdims=True)
+        super().__init__(lv.shape[:-1])
+
+    @property
+    def probs_array(self):
+        return jnp.exp(self.logits)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(next_key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self.logits, idx[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self.probs_array, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = self.probs_array
+        return Tensor(-jnp.sum(p * self.logits, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (jnp.square(t) * (t + 1)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha) + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        dg = jax.scipy.special.digamma
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        a = self.concentration
+        norm = jnp.sum(jax.scipy.special.gammaln(a), -1) \
+            - jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - norm)
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(a0)
+        dg = jax.scipy.special.digamma
+        return Tensor(lnB + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.p = _val(probs)
+        self.p = self.p / jnp.sum(self.p, -1, keepdims=True)
+        super().__init__(self.p.shape[:-1], self.p.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        logits = jnp.log(jnp.maximum(self.p, 1e-30))
+        draws = jax.random.categorical(next_key(), logits,
+                                       shape=(self.total_count,) + shape)
+        k = self.p.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _val(value)
+        logf = jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0)) \
+            - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+        return Tensor(logf + jnp.sum(v * jnp.log(jnp.maximum(self.p, 1e-30)), -1))
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        super().__init__(base.batch_shape[:-reinterpreted_batch_rank],
+                         base.batch_shape[-reinterpreted_batch_rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply_op(lambda v: jnp.sum(v, axis=tuple(range(-self.rank, 0))), lp)
+
+    def entropy(self):
+        e = self.base.entropy()
+        return apply_op(lambda v: jnp.sum(v, axis=tuple(range(-self.rank, 0))), e)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ladj = t.forward_log_det_jacobian(x)
+            lp = ladj if lp is None else lp + ladj
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp - lp
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    raise NotImplementedError(f"no KL({type(p).__name__} || {type(q).__name__}) registered")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pr = p.probs_array
+    return Tensor(jnp.sum(pr * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+
+    def lbeta(a, b):
+        return gl(a) + gl(b) - gl(a + b)
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (lbeta(a2, b2) - lbeta(a1, b1)
+         + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+         + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+    return Tensor(t)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    t = (gl(a0) - jnp.sum(gl(a), -1) - gl(jnp.sum(b, -1)) + jnp.sum(gl(b), -1)
+         + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
+    return Tensor(t)

@@ -1,0 +1,68 @@
+"""FFT — reference python/paddle/fft.py, on jnp.fft (XLA FFT on device)."""
+import jax.numpy as jnp
+
+from .framework.core import apply_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _make1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda v: fn(v, n=n, axis=axis, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+def _make2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda v: fn(v, s=s, axes=axes, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+fft = _make1("fft", jnp.fft.fft)
+ifft = _make1("ifft", jnp.fft.ifft)
+rfft = _make1("rfft", jnp.fft.rfft)
+irfft = _make1("irfft", jnp.fft.irfft)
+hfft = _make1("hfft", jnp.fft.hfft)
+ihfft = _make1("ihfft", jnp.fft.ihfft)
+fft2 = _make2("fft2", jnp.fft.fft2)
+ifft2 = _make2("ifft2", jnp.fft.ifft2)
+rfft2 = _make2("rfft2", jnp.fft.rfft2)
+irfft2 = _make2("irfft2", jnp.fft.irfft2)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: jnp.fft.fftn(v, s=s, axes=axes, norm=norm), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: jnp.fft.ifftn(v, s=s, axes=axes, norm=norm), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: jnp.fft.rfftn(v, s=s, axes=axes, norm=norm), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: jnp.fft.irfftn(v, s=s, axes=axes, norm=norm), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x)

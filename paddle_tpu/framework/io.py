@@ -1,0 +1,51 @@
+"""paddle.save / paddle.load — reference python/paddle/framework/io.py.
+Pickle-based state persistence (numpy payloads); for sharded/async
+checkpoints of big models use paddle_tpu.incubate.checkpoint (orbax)."""
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else Tensor(jnp.asarray(obj.array))
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array):
+        self.array = array
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=configs.get("return_numpy", False))

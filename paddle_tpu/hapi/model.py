@@ -1,0 +1,217 @@
+"""High-level Model API — reference python/paddle/hapi/model.py.
+
+TPU-first: Model.fit compiles one whole train step (forward+loss+grads+update)
+with jax.jit via the functional optimizer path, donating params/opt-state so
+updates are in-place in HBM. Eager fallback keeps paddle debugging UX.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..metric import Metric
+from ..nn.layer_base import Layer, buffer_pytree, functional_call, state_pytree
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._compiled_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+
+    # -- compiled train step -------------------------------------------------
+    def _build_train_step(self):
+        net = self.network
+        loss_fn = self._loss
+        opt = self._optimizer
+
+        def step(params, buffers, opt_state, lr, inputs, labels):
+            def compute_loss(p):
+                with functional_call(net, {**p, **buffers}):
+                    out = net(*inputs)
+                loss = loss_fn(out, *labels)
+                lv = loss._value if isinstance(loss, Tensor) else loss
+                return jnp.mean(lv), out._value if isinstance(out, Tensor) else out
+
+            (loss_v, out), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+            new_params, new_state = opt.apply_gradients_pytree(params, grads, opt_state, lr)
+            return new_params, new_state, loss_v, out
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        net = self.network
+        net.train()
+        if self._compiled_step is None:
+            self._params = state_pytree(net, trainable_only=True)
+            self._buffers = {k: v for k, v in {**dict(
+                (n, p._value) for n, p in net.named_parameters() if p.stop_gradient),
+                **buffer_pytree(net)}.items() if k not in self._params}
+            self._opt_state = self._optimizer.init_state_pytree(self._params)
+            self._compiled_step = self._build_train_step()
+        in_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in inputs]
+        lab_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in labels]
+        lr = self._optimizer.get_lr()
+        self._params, self._opt_state, loss_v, out = self._compiled_step(
+            self._params, self._buffers, self._opt_state, lr, in_vals, lab_vals)
+        if self._optimizer._lr_scheduler is not None:
+            self._optimizer._lr_scheduler.step()
+        metrics_out = []
+        for m in self._metrics:
+            correct = m.compute(Tensor(out), labels[0])
+            m.update(correct)
+            metrics_out.append(m.accumulate())
+        return (float(loss_v), metrics_out) if metrics_out else float(loss_v)
+
+    def _sync_params_back(self):
+        if self._compiled_step is not None:
+            from ..nn.layer_base import load_state_pytree
+            load_state_pytree(self.network, self._params)
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        self._sync_params_back()
+        net = self.network
+        net.eval()
+        out = net(*inputs)
+        result = {}
+        if self._loss is not None and labels:
+            loss = self._loss(out, *labels)
+            result["loss"] = float(loss.item() if hasattr(loss, "item") else loss)
+        for m in self._metrics:
+            correct = m.compute(out, labels[0])
+            m.update(correct)
+        return result
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._sync_params_back()
+        self.network.eval()
+        return self.network(*inputs)
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kwargs):
+        from ..io import DataLoader, Dataset
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers)
+        cbs = CallbackList([ProgBarLogger(log_freq, verbose)] + (callbacks or []))
+        cbs.set_model(self)
+        try:
+            cbs.set_params({"epochs": epochs, "steps": len(loader)})
+        except TypeError:
+            cbs.set_params({"epochs": epochs, "steps": None})
+        cbs.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                res = self.train_batch(inputs, labels)
+                if isinstance(res, tuple):
+                    loss, mvals = res
+                    logs = {"loss": loss}
+                    for m, v in zip(self._metrics, mvals):
+                        names = m.name() if isinstance(m.name(), list) else [m.name()]
+                        vals = v if isinstance(v, list) else [v]
+                        logs.update(dict(zip(names, vals)))
+                else:
+                    logs = {"loss": res}
+                cbs.on_train_batch_end(step, logs)
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbs.on_train_end()
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return [batch[0]], []
+        return [batch], []
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, **kwargs):
+        from ..io import DataLoader
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            if "loss" in res:
+                losses.append(res["loss"])
+        out = {}
+        if losses:
+            out["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            out.update(dict(zip(names, vals)))
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1, **kwargs):
+        from ..io import DataLoader
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        self._sync_params_back()
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._compiled_step = None  # rebuild with fresh params
+        import os
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if not p.stop_gradient)
+        return {"total_params": n_params, "trainable_params": trainable}

@@ -1,0 +1,49 @@
+"""Model summary — reference python/paddle/hapi/model_summary.py."""
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inputs, outputs):
+            n_params = sum(p.size for p in l._parameters.values() if p is not None)
+            out_shape = outputs.shape if isinstance(outputs, Tensor) else "-"
+            rows.append((name, type(l).__name__, out_shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(make_hook(name, layer)))
+
+    if input is not None:
+        net(input)
+    elif input_size is not None:
+        import jax.numpy as jnp
+        shape = input_size if isinstance(input_size, (list, tuple)) else [input_size]
+        if isinstance(shape[0], (list, tuple)):
+            xs = [Tensor(jnp.zeros(s, jnp.float32)) for s in shape]
+            net(*xs)
+        else:
+            net(Tensor(jnp.zeros(shape, jnp.float32)))
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
+    if rows:
+        w = max(len(r[0]) for r in rows) + 2
+        print(f"{'Layer':<{w}}{'Type':<24}{'Output Shape':<20}{'Params':>12}")
+        print("-" * (w + 56))
+        for name, typ, shape, n in rows:
+            print(f"{name:<{w}}{typ:<24}{str(shape):<20}{n:>12,}")
+        print("-" * (w + 56))
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

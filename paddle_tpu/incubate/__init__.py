@@ -1,0 +1,10 @@
+"""paddle_tpu.incubate — reference python/paddle/incubate (fused ops, MoE,
+checkpointing). Fused ops map to the Pallas/XLA kernels in paddle_tpu.ops."""
+from . import checkpoint, nn  # noqa: F401
+
+__all__ = ["nn", "checkpoint", "autotune"]
+
+
+def autotune(config=None):
+    """XLA autotunes its own tilings; accepted for API parity."""
+    return None

@@ -1,0 +1,112 @@
+"""Fused layers — reference python/paddle/incubate/nn/layer/fused_transformer.py.
+On TPU, "fused" = flash-attention Pallas kernel + XLA-fused FFN; these classes
+keep the reference API while routing to those paths."""
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.core import Tensor
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "functional"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 normalize_before=False, qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, **kwargs):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        # single fused QKV projection — one MXU matmul
+        self.qkv_proj = nn.Linear(embed_dim, 3 * embed_dim, qkv_weight_attr, qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim, linear_weight_attr, linear_bias_attr)
+        self.norm = nn.LayerNorm(embed_dim, epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        qkv = self.qkv_proj(x)
+        B, L = x.shape[0], x.shape[1]
+        from ...tensor.manipulation import reshape, split
+        qkv = reshape(qkv, [B, L, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             dropout_p=self.attn_dropout_rate if self.training else 0.0,
+                                             training=self.training)
+        out = reshape(out, [B, L, self.embed_dim])
+        out = residual + self.dropout(self.out_proj(out))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-05,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, **kwargs):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward, linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model, linear2_weight_attr, linear2_bias_attr)
+        self.norm = nn.LayerNorm(d_model, epsilon)
+        self.dropout1 = nn.Dropout(act_dropout_rate if act_dropout_rate is not None else dropout_rate)
+        self.dropout2 = nn.Dropout(dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.linear2(self.dropout1(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm(src)
+        return src
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, **kwargs):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before)
+        self.ffn = FusedFeedForward(d_model, dim_feedforward, dropout_rate,
+                                    activation=activation,
+                                    act_dropout_rate=act_dropout_rate,
+                                    normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class functional:
+    """incubate.nn.functional namespace."""
+
+    @staticmethod
+    def fused_multi_head_attention(*args, **kwargs):
+        return F.scaled_dot_product_attention(*args, **kwargs)
+
+    @staticmethod
+    def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight, linear2_bias,
+                          ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None,
+                          dropout1_rate=0.5, dropout2_rate=0.5, activation="relu",
+                          training=True, **kwargs):
+        h = F.linear(x, linear1_weight, linear1_bias)
+        h = getattr(F, activation)(h)
+        h = F.dropout(h, dropout1_rate, training=training)
+        h = F.linear(h, linear2_weight, linear2_bias)
+        return x + F.dropout(h, dropout2_rate, training=training)

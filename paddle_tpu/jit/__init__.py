@@ -1,0 +1,132 @@
+"""paddle_tpu.jit — reference python/paddle/jit (dy2static to_static, save/load).
+
+TPU-native: to_static wraps a Layer/function in jax.jit over its functional
+form. jit.save exports StableHLO text + weights; jit.load restores a callable
+(same artifact role as the reference's saved inference Program).
+"""
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn.layer_base import Layer, functional_call, state_pytree
+from ..static.input_spec import InputSpec
+
+__all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer"]
+
+
+class _StaticFunction:
+    """jax.jit-compiled wrapper around a Layer or python function."""
+
+    def __init__(self, fn_or_layer, input_spec=None, donate_params=False):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        if self._is_layer:
+            layer = fn_or_layer
+
+            def pure(params, buffers, *args, **kwargs):
+                merged = {**params, **buffers}
+                with functional_call(layer, merged):
+                    out = layer(*args, **kwargs)
+                return out
+            self._jitted = jax.jit(pure)
+        else:
+            fn = fn_or_layer
+
+            def pure(*args, **kwargs):
+                return fn(*args, **kwargs)
+            self._jitted = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if self._is_layer:
+            layer = self._target
+            params = state_pytree(layer)
+            from ..nn.layer_base import buffer_pytree
+            bufs = buffer_pytree(layer)
+            return self._jitted(params, bufs, *args, **kwargs)
+        return self._jitted(*args, **kwargs)
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    if function is None:
+        def deco(fn):
+            return _StaticFunction(fn, input_spec)
+        return deco
+    return _StaticFunction(function, input_spec)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def _example_from_spec(spec):
+    shape = [1 if (s is None or s < 0) else int(s) for s in spec.shape]
+    return jnp.zeros(shape, jnp.dtype(spec.dtype or "float32"))
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Exports {path}.pdiparams (weights pickle) + {path}.stablehlo.mlir."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, _StaticFunction):
+        layer = layer._target
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    meta = {"class": type(layer).__name__}
+    if input_spec:
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        meta["input_spec"] = [(list(s.shape), str(s.dtype)) for s in specs]
+        try:
+            params = state_pytree(layer)
+            from ..nn.layer_base import buffer_pytree
+            bufs = buffer_pytree(layer)
+
+            def pure(params, buffers, *args):
+                with functional_call(layer, {**params, **buffers}):
+                    out = layer(*args)
+                return out._value if isinstance(out, Tensor) else out
+            examples = [_example_from_spec(s) for s in specs]
+            lowered = jax.jit(pure).lower(params, bufs, *examples)
+            with open(path + ".stablehlo.mlir", "w") as f:
+                f.write(lowered.as_text())
+        except Exception as e:  # export is best-effort; weights always saved
+            meta["export_error"] = str(e)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference artifact (reference fluid/dygraph/io.py:TranslatedLayer)."""
+
+    def __init__(self, state, meta):
+        super().__init__()
+        self._state = {k: jnp.asarray(v) for k, v in state.items()}
+        self._meta = meta
+
+    def forward(self, *args):
+        raise NotImplementedError(
+            "TranslatedLayer holds weights + exported StableHLO; rebuild the "
+            "python Layer and set_state_dict(layer.state_dict()) to run, or "
+            "execute the .stablehlo.mlir with any StableHLO runtime")
+
+    def state_dict(self, *a, **k):
+        return {k: Tensor(v) for k, v in self._state.items()}
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta = {}
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta)

@@ -1,0 +1,191 @@
+"""Activation functionals — reference python/paddle/nn/functional/activation.py.
+All map to jax.nn / lax primitives that XLA fuses into adjacent matmuls."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "sigmoid", "tanh", "tanh_",
+    "softmax", "softmax_", "log_softmax", "leaky_relu", "elu", "elu_", "celu",
+    "selu", "softplus", "softsign", "softshrink", "hardshrink", "tanhshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "prelu", "rrelu", "swish", "mish",
+    "maxout", "thresholded_relu", "log_sigmoid", "glu", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return x._inplace_update(jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, x)
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, x)
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    return x._inplace_update(jnp.tanh)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def _f(v):
+        if dtype is not None:
+            v = v.astype(jnp.dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply_op(_f, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_update(lambda v: jax.nn.softmax(v, axis=axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def _f(v):
+        if dtype is not None:
+            v = v.astype(jnp.dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply_op(_f, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_update(lambda v: jax.nn.elu(v, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        lambda v: jnp.where(v * beta > threshold, v, jnp.log1p(jnp.exp(beta * v)) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda v: v - jnp.tanh(v), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v > 0, v, wb * v)
+    return apply_op(_f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    from ...framework.random import next_key
+    if training:
+        key = next_key()
+        def _f(v):
+            a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, a * v)
+        return apply_op(_f, x)
+    mid = (lower + upper) / 2.0
+    return apply_op(lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply_op(_f, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(lambda v: jnp.where(v > threshold, v, 0.0), x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, x)
+
+
+def glu(x, axis=-1, name=None):
+    def _f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op(_f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    key = next_key()
+
+    def _f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            onehot = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return apply_op(_f, x)

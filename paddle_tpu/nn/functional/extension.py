@@ -1,0 +1,102 @@
+"""Extension functionals — reference python/paddle/nn/functional/extension.py
++ transformer attention entry points (fused path in paddle_tpu.ops)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = ["diag_embed", "gather_tree", "temporal_shift",
+           "scaled_dot_product_attention", "sparse_attention"]
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def _f(v):
+        k = v.shape[-1]
+        n = k + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        rng = jnp.arange(k)
+        r = rng + max(-offset, 0)
+        c = rng + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply_op(_f, input)
+
+
+def gather_tree(ids, parents):
+    def _f(idv, par):
+        T = idv.shape[0]
+
+        def body(carry, t):
+            beams, cur = carry
+            new_beams = jnp.take_along_axis(par[t], cur, axis=-1)
+            tok = jnp.take_along_axis(idv[t], new_beams if t > 0 else cur, axis=-1)
+            return (beams, new_beams), tok
+        # walk from last step to first
+        init = jnp.broadcast_to(jnp.arange(idv.shape[-1]), idv.shape[1:])
+        outs = []
+        cur = init
+        for t in range(T - 1, -1, -1):
+            outs.append(jnp.take_along_axis(idv[t], cur, axis=-1))
+            cur = jnp.take_along_axis(par[t], cur, axis=-1)
+        return jnp.stack(outs[::-1], axis=0)
+    return apply_op(_f, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def _f(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.pad(v5[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        right = jnp.pad(v5[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        rest = v5[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(_f, x)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """[B, L, H, D] layout (paddle). Routes to the Pallas flash kernel on TPU
+    for the fused path; this jnp fallback is used on CPU/interpret tests."""
+    from ...ops.attention import flash_attention_available, flash_attention
+
+    if flash_attention_available(query, attn_mask, dropout_p):
+        return flash_attention(query, key, value, causal=is_causal)
+
+    def _f(q, k, v, *rest):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        qh = jnp.swapaxes(q, 1, 2)  # [B,H,L,D]
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = (qh @ jnp.swapaxes(kh, -1, -2)) * scale
+        logits = logits.astype(jnp.float32)
+        if is_causal:
+            L, S = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((L, S), bool))
+            logits = jnp.where(causal, logits, -1e30)
+        if rest:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -1e30)
+            else:
+                logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = probs @ vh
+        return jnp.swapaxes(out, 1, 2)
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return apply_op(_f, *args)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
+    raise NotImplementedError(
+        "block-sparse attention lands with the Pallas kernel set; use "
+        "scaled_dot_product_attention (flash) instead")

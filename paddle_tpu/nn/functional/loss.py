@@ -1,0 +1,290 @@
+"""Loss functionals — reference python/paddle/nn/functional/loss.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "ctc_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """reference python/paddle/nn/functional/loss.py:cross_entropy.
+    Computes in fp32 regardless of input dtype (matches phi kernel behavior)."""
+    def _f(logits, lab, *rest):
+        lg = logits.astype(jnp.float32)
+        if use_softmax:
+            logp = jax.nn.log_softmax(lg, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(lg, 1e-30))
+        if soft_label:
+            sl = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                k = logp.shape[axis]
+                sl = (1 - label_smoothing) * sl + label_smoothing / k
+            loss = -jnp.sum(sl * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:  # [N, 1]-style
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0] \
+                if axis in (-1, logp.ndim - 1) else \
+                jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            if label_smoothing > 0.0:
+                k = logp.shape[axis]
+                smooth = jnp.mean(logp, axis=axis)
+                loss = -( (1 - label_smoothing) * picked + label_smoothing * smooth )
+            else:
+                loss = -picked
+            if rest:  # class weights
+                w = rest[0].astype(jnp.float32)
+                loss = loss * jnp.take(w, safe)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                if rest:
+                    w = rest[0].astype(jnp.float32)
+                    denom = jnp.maximum(jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0)), 1e-10)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op(_f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, reduction="none", soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _f(p, y, *rest):
+        p32, y32 = p.astype(jnp.float32), y.astype(jnp.float32)
+        out = -(y32 * jnp.log(jnp.maximum(p32, 1e-12))
+                + (1 - y32) * jnp.log(jnp.maximum(1 - p32, 1e-12)))
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op(_f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _f(z, y, *rest):
+        z32, y32 = z.astype(jnp.float32), y.astype(jnp.float32)
+        i = 0
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        else:
+            w = None
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is None:
+            out = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        else:
+            log_sig = jax.nn.log_sigmoid(z32)
+            log_sig_neg = jax.nn.log_sigmoid(-z32)
+            out = -(pw * y32 * log_sig + (1 - y32) * log_sig_neg)
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return apply_op(_f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _f(logp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else
+                                     jnp.expand_dims(safe, 1), axis=1)
+        picked = picked[:, 0] if logp.ndim == 2 else picked.squeeze(1)
+        loss = -picked
+        if rest:
+            loss = loss * jnp.take(rest[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(valid.astype(jnp.float32)) if not rest else \
+                jnp.sum(jnp.where(valid, jnp.take(rest[0], safe), 0.0))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-10)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op(_f, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(out, reduction)
+    return apply_op(_f, input, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _f(logp, y):
+        out = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce(out, reduction)
+    return apply_op(_f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def _f(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_op(_f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def _f(a, y):
+        out = jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(out, reduction)
+    return apply_op(_f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def _f(a, b, y):
+        sim = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(y == 1, 1 - sim, jnp.maximum(0.0, sim - margin))
+        return _reduce(out, reduction)
+    return apply_op(_f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def _f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        out = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_op(_f, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def _f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply_op(_f, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            out = out / rest[0]
+        return _reduce(out, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply_op(_f, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _f(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * y1, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + jnp.sum(y1, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(_f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _f(a, p, y):
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(jnp.float32)
+        targets = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) / 2
+        return ce + reg
+    return apply_op(_f, anchor, positive, labels)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+    log_probs: [T, N, C] (paddle layout), labels: [N, S]."""
+    def _f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)), constant_values=True)
+
+        def step(alpha, lp_t):
+            a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+            a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+            a2 = jnp.where(same, neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None] & (t > 0), new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(T))
+        last = jnp.take_along_axis(alpha, (L - 1)[:, None], axis=1)[:, 0]
+        prev = jnp.take_along_axis(alpha, jnp.maximum(L - 2, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(last, prev)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return apply_op(_f, log_probs, labels, input_lengths, label_lengths)

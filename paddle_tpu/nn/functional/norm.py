@@ -1,0 +1,160 @@
+"""Normalization functionals — reference python/paddle/nn/functional/norm.py.
+layer_norm/rms_norm have Pallas fused variants in paddle_tpu.ops; these jnp
+forms are the reference implementations XLA already fuses well."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = ["normalize", "layer_norm", "batch_norm", "instance_norm", "group_norm", "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _f(v):
+        nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return apply_op(_f, x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    n_axes = len(ns)
+
+    def _f(v, *rest):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        x32 = v.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(v.dtype)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op(_f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    def _f(v, *rest):
+        x32 = v.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = (x32 * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0].astype(v.dtype)
+        return out
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply_op(_f, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    use_global = (not training) if use_global_stats is None else use_global_stats
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def _f(v, rm, rv, *rest):
+        ax = ch_axis % v.ndim
+        shape = [1] * v.ndim
+        shape[ax] = v.shape[ax]
+        reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+        if use_global:
+            mean, var = rm, rv
+        else:
+            x32 = v.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
+        out = (v.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(v.dtype)
+        return out
+    args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
+    out = apply_op(_f, *args)
+
+    # eager stat update (mirrors reference batch_norm_kernel running-stat path)
+    if training and not use_global and isinstance(running_mean, Tensor) \
+            and not isinstance(x._value, jax.core.Tracer):
+        v = x._value.astype(jnp.float32)
+        ax = ch_axis % v.ndim
+        reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+        batch_mean = jnp.mean(v, axis=reduce_axes)
+        batch_var = jnp.var(v, axis=reduce_axes)
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * batch_mean.astype(running_mean.dtype))
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * batch_var.astype(running_var.dtype))
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    def _f(v, *rest):
+        spatial = tuple(range(2, v.ndim))
+        x32 = v.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=spatial, keepdims=True)
+        var = jnp.var(x32, axis=spatial, keepdims=True)
+        out = ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[1] = v.shape[1]
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(v.dtype)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op(_f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    def _f(v, *rest):
+        n = v.shape[0]
+        if data_format == "NHWC":
+            v_nchw = jnp.moveaxis(v, -1, 1)
+        else:
+            v_nchw = v
+        c = v_nchw.shape[1]
+        g = num_groups
+        grouped = v_nchw.reshape((n, g, c // g) + v_nchw.shape[2:]).astype(jnp.float32)
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_nchw.shape).astype(v.dtype)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(v.dtype)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op(_f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def _f(v):
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
+        win = jax.lax.reduce_window(
+            padded, jnp.asarray(0, v.dtype), jax.lax.add,
+            (1,) * (moved.ndim - 1) + (size,), (1,) * moved.ndim, "VALID")
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return v / jnp.power(k + alpha * win, beta)
+    return apply_op(_f, x)

@@ -1,0 +1,141 @@
+"""Pooling — reference python/paddle/nn/functional/pooling.py, via
+lax.reduce_window (fuses well on TPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import apply_op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return (int(v),) * n
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        if len(padding) == n:
+            return [(int(p), int(p)) for p in padding]
+        if len(padding) == 2 * n:
+            return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+
+    def _f(v):
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            wpads = ([(0, 0)] + list(pads) + [(0, 0)]) if not isinstance(pads, str) else pads
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            wpads = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
+        if isinstance(wpads, str):
+            wpads = jax.lax.padtype_to_pads(v.shape, window, strides, wpads)
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, np.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), jax.lax.max,
+                                         window, strides, wpads)
+        # avg
+        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add,
+                                       window, strides, wpads)
+        if exclusive and not count_include_pad:
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add,
+                                           window, strides, wpads)
+            return summed / counts
+        return summed / float(np.prod(kernel))
+    return apply_op(_f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", False, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", data_format == "NHWC", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format == "NDHWC", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", False, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format == "NHWC",
+                 ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format == "NDHWC",
+                 ceil_mode, exclusive)
+
+
+def _adaptive(x, output_size, n, mode, channel_last=False):
+    def _f(v):
+        spatial = list(range(1, 1 + n)) if channel_last else list(range(v.ndim - n, v.ndim))
+        osz = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+        osz = [v.shape[ax] if o is None else int(o) for ax, o in zip(spatial, osz)]
+        out = v
+        for ax, o in zip(spatial, osz):
+            s_in = out.shape[ax]
+            starts = (np.arange(o) * s_in) // o
+            ends = ((np.arange(o) + 1) * s_in + o - 1) // o
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+    return apply_op(_f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max")

@@ -1,0 +1,112 @@
+"""Vision functionals — reference python/paddle/nn/functional/vision.py."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import apply_op
+
+__all__ = ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "affine_grid", "grid_sample"]
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op(_f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return apply_op(_f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, groups, c // groups, h, w)
+            return jnp.swapaxes(out, 1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(out, 3, 4).reshape(n, h, w, c)
+    return apply_op(_f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def _f(th):
+        n, _, h, w = [int(s) for s in out_shape] if len(out_shape) == 4 else \
+            (int(out_shape[0]), 0, int(out_shape[1]), int(out_shape[2]))
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+        grid = base @ jnp.swapaxes(th, 1, 2)  # [n, h*w, 2]
+        return grid.reshape(th.shape[0], h, w, 2).astype(th.dtype)
+    return apply_op(_f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def _f(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            if padding_mode == "border":
+                ixc, iyc = jnp.clip(ix, 0, w - 1), jnp.clip(iy, 0, h - 1)
+                inb = jnp.ones_like(inb)
+            elif padding_mode == "reflection":
+                span_x = 2 * (w - 1) if align_corners else 2 * w
+                span_y = 2 * (h - 1) if align_corners else 2 * h
+                ixc = jnp.abs(jnp.mod(ix + (w - 1), span_x) - (w - 1)) if align_corners else ix
+                iyc = jnp.abs(jnp.mod(iy + (h - 1), span_y) - (h - 1)) if align_corners else iy
+                ixc, iyc = jnp.clip(ixc, 0, w - 1), jnp.clip(iyc, 0, h - 1)
+                inb = jnp.ones_like(inb)
+            else:
+                ixc, iyc = jnp.clip(ix, 0, w - 1), jnp.clip(iy, 0, h - 1)
+            # v:[n,c,h,w], idx:[n,hg,wg]
+            vals = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n,hg,wg,c]
+            vals = jnp.moveaxis(vals, -1, 1)
+            return vals * inb[:, None].astype(v.dtype)
+
+        if mode == "nearest":
+            return sample(jnp.round(fx).astype(jnp.int32), jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(v.dtype)[:, None]
+        wy = (fy - y0).astype(v.dtype)[:, None]
+        out = (sample(x0, y0) * (1 - wx) * (1 - wy) + sample(x1, y0) * wx * (1 - wy)
+               + sample(x0, y1) * (1 - wx) * wy + sample(x1, y1) * wx * wy)
+        return out
+    return apply_op(_f, x, grid)

@@ -1,0 +1,21 @@
+"""Distance layers — reference python/paddle/nn/layer/distance.py."""
+import jax.numpy as jnp
+
+from ...framework.core import apply_op
+from ..layer_base import Layer
+
+__all__ = ["PairwiseDistance"]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        def _f(a, b):
+            d = a - b + self.epsilon
+            return jnp.sum(jnp.abs(d) ** self.p, axis=-1, keepdims=self.keepdim) ** (1.0 / self.p)
+        return apply_op(_f, x, y)

@@ -1,0 +1,301 @@
+"""Recurrent layers — reference python/paddle/nn/layer/rnn.py.
+
+TPU-first: the time loop is a single lax.scan (one compiled XLA while-op with
+static shapes) rather than the reference's per-step dygraph loop / cuDNN RNN.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+from ..initializer import Uniform
+from ..layer_base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value, jnp.float32))
+                         for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply_op(_f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply_op(_f, inputs, h, c, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(ic + r * hc)
+            return (1 - z) * n + z * h
+        h = apply_op(_f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence op (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        time_range = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in time_range:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+        y = stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (None, None) if initial_states is None else initial_states
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        from ...tensor.manipulation import concat
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+class _MultiLayerRNN(Layer):
+    """num_layers × (optionally bidirectional) scanned recurrence. The whole
+    stack runs as lax.scan per layer-direction — static shapes, one XLA loop."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        num_dirs = 2 if self.bidirectional else 1
+        self.state_components = 2 if self.MODE == "LSTM" else 1
+        from .container import LayerList
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            for _ in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                if self.MODE == "LSTM":
+                    self.cells.append(LSTMCell(in_sz, hidden_size))
+                elif self.MODE == "GRU":
+                    self.cells.append(GRUCell(in_sz, hidden_size))
+                else:
+                    self.cells.append(SimpleRNNCell(in_sz, hidden_size, activation))
+
+    def _cell_step(self, cell):
+        mode = self.MODE
+
+        def step(params, carry, x_t):
+            wi, wh, bi, bh = params
+            if mode == "LSTM":
+                h, c = carry
+                gates = x_t @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            if mode == "GRU":
+                h = carry
+                gi = x_t @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(ic + r * hc)
+                h_new = (1 - z) * n + z * h
+                return h_new, h_new
+            h = carry
+            act = jnp.tanh if cell.activation == "tanh" else jax.nn.relu
+            h_new = act(x_t @ wi.T + bi + h @ wh.T + bh)
+            return h_new, h_new
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        num_dirs = 2 if self.bidirectional else 1
+        mode = self.MODE
+
+        def _f(x, *flat_params):
+            xs = x if self.time_major else jnp.swapaxes(x, 0, 1)  # [T,B,I]
+            B = xs.shape[1]
+            per_cell = 4
+            finals_h, finals_c = [], []
+            for layer in range(self.num_layers):
+                dir_outs = []
+                for d in range(num_dirs):
+                    ci = layer * num_dirs + d
+                    params = flat_params[ci * per_cell: (ci + 1) * per_cell]
+                    cell = self.cells[ci]
+                    step = self._cell_step(cell)
+                    h0 = jnp.zeros((B, self.hidden_size), xs.dtype)
+                    carry0 = (h0, h0) if mode == "LSTM" else h0
+                    seq = jnp.flip(xs, 0) if d == 1 else xs
+
+                    def body(carry, x_t, _step=step, _params=params):
+                        return _step(_params, carry, x_t)
+                    carry, ys = jax.lax.scan(body, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    if mode == "LSTM":
+                        finals_h.append(carry[0])
+                        finals_c.append(carry[1])
+                    else:
+                        finals_h.append(carry)
+                xs = jnp.concatenate(dir_outs, axis=-1) if num_dirs == 2 else dir_outs[0]
+            y = xs if self.time_major else jnp.swapaxes(xs, 0, 1)
+            h_stack = jnp.stack(finals_h, axis=0)
+            if mode == "LSTM":
+                return y, h_stack, jnp.stack(finals_c, axis=0)
+            return y, h_stack
+
+        flat = []
+        for cell in self.cells:
+            flat += [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+        outs = apply_op(_f, inputs, *flat)
+        if mode == "LSTM":
+            y, h, c = outs
+            return y, (h, c)
+        y, h = outs
+        return y, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN"
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"

@@ -1,0 +1,379 @@
+"""Layer: the module system (reference python/paddle/fluid/dygraph/layers.py).
+
+TPU-first twist: a Layer tree is also a *functional* model. `functional_call`
+binds an arbitrary params pytree (e.g. tracers inside jax.jit, or sharded
+arrays) to the tree, runs forward purely, and restores — so the same model
+object serves eager debugging and compiled GSPMD training.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# sys.modules lookup: the attribute `framework.dtype` is shadowed by the
+# dtype() function that paddle exposes at top level
+import importlib
+
+dtypes = importlib.import_module("paddle_tpu.framework.dtype")
+from ..framework.core import Parameter, Tensor, _pause_tape
+from ..framework.random import next_key
+
+__all__ = ["Layer", "ParamAttr", "functional_call", "state_pytree", "load_state_pytree"]
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        return ParamAttr(initializer=attr)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        d = self.__dict__
+        d["_parameters"] = collections.OrderedDict()
+        d["_sub_layers"] = collections.OrderedDict()
+        d["_buffers"] = collections.OrderedDict()
+        d["_non_persistable_buffer_names"] = set()
+        d["training"] = True
+        d["_dtype"] = dtypes.dtype(dtype)
+        d["_name_scope"] = name_scope or type(self).__name__.lower()
+        d["_forward_pre_hooks"] = collections.OrderedDict()
+        d["_forward_post_hooks"] = collections.OrderedDict()
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            params[name] = value
+        elif layers is not None and name in layers:
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value if isinstance(value, Tensor) or value is None else Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- parameter management ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from .initializer import Constant, XavierUniform
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtypes.dtype(dtype) if dtype is not None else self._dtype
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else XavierUniform())
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal ----------------------------------------------------------
+    def children(self):
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self=False):
+        return [m for _, m in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None or id(sub) in layers_set:
+                continue
+            layers_set.add(id(sub))
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield sub_prefix, sub
+            yield from sub.named_sublayers(prefix=sub_prefix, layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters()]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self._traverse(prefix):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, p)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self._traverse(prefix):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, b)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers()]
+
+    def _traverse(self, prefix=""):
+        yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield from sub._traverse(prefix + ("." if prefix else "") + name)
+
+    # -- mode / dtype -------------------------------------------------------
+    def train(self):
+        for _, layer in self._traverse():
+            layer.__dict__["training"] = True
+        return self
+
+    def eval(self):
+        for _, layer in self._traverse():
+            layer.__dict__["training"] = False
+        return self
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast(dtypes.dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast(dtypes.dtype(dtype))
+        return self
+
+    def _cast(self, d, floating_only=True):
+        for _, layer in self._traverse():
+            layer.__dict__["_dtype"] = d
+            for name, p in layer._parameters.items():
+                if p is not None and (not floating_only or dtypes.is_floating_point_dtype(p.dtype)):
+                    p._value = p._value.astype(d)
+            for name, b in layer._buffers.items():
+                if b is not None and (not floating_only or dtypes.is_floating_point_dtype(b.dtype)):
+                    b._value = b._value.astype(d)
+
+    def float(self):
+        return self.astype(dtypes.float32)
+
+    def bfloat16(self):
+        return self.astype(dtypes.bfloat16)
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[name] = p
+        for name, b in self.named_buffers():
+            last = name.rsplit(".", 1)[-1]
+            if last not in self._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                v = src._value if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
+                t._value = v.astype(t.dtype).reshape(t._value.shape)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): " + ("\n  ".join(sub_repr)))
+        body = ""
+        if extra:
+            body = extra
+        if lines:
+            body = (body + "\n" if body else "") + "\n".join(lines)
+            body = "\n  " + body.replace("\n", "\n  ") + "\n"
+        return f"{type(self).__name__}({body})"
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)
+
+
+# -- functional bridge -------------------------------------------------------
+def state_pytree(layer: Layer, trainable_only=False):
+    """Collect {name: jax.Array} of parameters (and buffers unless
+    trainable_only) — the pytree fed to jax transforms."""
+    params = {}
+    for name, p in layer.named_parameters():
+        if not trainable_only or not p.stop_gradient:
+            params[name] = p._value
+    return params
+
+
+def buffer_pytree(layer: Layer):
+    return {name: b._value for name, b in layer.named_buffers()}
+
+
+def load_state_pytree(layer: Layer, values: dict):
+    for name, p in layer.named_parameters():
+        if name in values:
+            p._value = values[name]
+    for name, b in layer.named_buffers():
+        if name in values:
+            b._value = values[name]
+
+
+class functional_call:
+    """Run `layer(*args)` with `params` (a {name: array} pytree) temporarily
+    bound — pure w.r.t. params, so it composes with jax.grad / jax.jit:
+
+        params = state_pytree(model, trainable_only=True)
+        def loss_fn(params, batch):
+            with functional_call(model, params):
+                return model(batch).mean()
+        grads = jax.grad(loss_fn)(params, batch)
+
+    Also callable directly: functional_call(model, params, x) -> out.
+    """
+
+    def __new__(cls, layer, params, *args, **kwargs):
+        self = super().__new__(cls)
+        self.layer = layer
+        self.params = params
+        if args or kwargs:
+            with self:
+                return layer(*args, **kwargs)
+        return self
+
+    def __enter__(self):
+        self._saved = {}
+        by_name = dict(self.params)
+        for name, p in list(self.layer.named_parameters()) + list(self.layer.named_buffers()):
+            if name in by_name:
+                self._saved[name] = (p, p._value)
+                p._value = by_name[name]
+        self._pause = _pause_tape()
+        self._pause.__enter__()
+        return self.layer
+
+    def __exit__(self, *exc):
+        self._pause.__exit__(*exc)
+        for name, (p, v) in self._saved.items():
+            p._value = v
+        return False

@@ -1,0 +1,4 @@
+"""paddle_tpu.ops — hand-written TPU kernels (Pallas) and their jnp
+reference implementations (≈ the reference's paddle/phi/kernels/gpu fused
+ops: fused_attention, fused_layer_norm, fused_adam, …)."""
+from . import attention  # noqa: F401

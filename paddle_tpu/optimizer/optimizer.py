@@ -1,0 +1,337 @@
+"""Optimizers — reference python/paddle/optimizer/*.py.
+
+Every optimizer defines one pure per-parameter update rule. Two consumption
+modes share it:
+
+  eager (paddle UX):  loss.backward(); opt.step(); opt.clear_grad()
+  compiled (TPU path): state = opt.init_state_pytree(params)
+                       params, state = opt.apply_gradients_pytree(params, grads, state, lr)
+    — called inside jax.jit/value_and_grad train steps; with GSPMD-sharded
+    params the slots inherit the param sharding (ZeRO-style when params are
+    sharded over 'fsdp').
+
+multi_precision keeps an fp32 master copy for bf16/fp16 params (reference
+adamw multi_precision flag).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+def _is_low_precision(d):
+    return jnp.dtype(d) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+
+
+class Optimizer:
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._wd = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._wd = float(weight_decay)
+        else:  # L2Decay object
+            self._wd = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- update rule (override) ---------------------------------------------
+    def _init_slot(self, name, p_value):
+        return jnp.zeros_like(p_value, dtype=jnp.float32)
+
+    def _update_rule(self, p, g, slots, lr, step):
+        """Returns (new_p, new_slots). p/g are fp32 here (master weights)."""
+        raise NotImplementedError
+
+    def _decoupled_wd(self):
+        return False
+
+    # -- eager path ----------------------------------------------------------
+    def _ensure_slots(self, pid, p):
+        if pid not in self._accumulators:
+            base = p._value.astype(jnp.float32) if self._multi_precision or True else p._value
+            slots = {name: self._init_slot(name, base) for name in self._slot_names}
+            if self._multi_precision and _is_low_precision(p.dtype):
+                slots["master"] = p._value.astype(jnp.float32)
+            self._accumulators[pid] = slots
+        return self._accumulators[pid]
+
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            slots = self._ensure_slots(id(p), p)
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if isinstance(p, Parameter) else lr
+            master = slots.get("master")
+            pv = master if master is not None else p._value.astype(jnp.float32)
+            gv = g._value.astype(jnp.float32)
+            if self._wd and not self._decoupled_wd() and p.regularizer is None:
+                gv = gv + self._wd * pv
+            rule_slots = {k: v for k, v in slots.items() if k != "master"}
+            new_p, new_slots = self._update_rule(pv, gv, rule_slots, p_lr, self._step_count)
+            if self._wd and self._decoupled_wd():
+                new_p = new_p - p_lr * self._wd * pv
+            if master is not None:
+                slots["master"] = new_p
+            slots.update(new_slots)
+            p._value = new_p.astype(p.dtype)
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # -- pure/functional path -------------------------------------------------
+    def init_state_pytree(self, params):
+        """params: {name: array} → state pytree (dict of slot dicts)."""
+        state = {}
+        for name, v in params.items():
+            v32 = v.astype(jnp.float32)
+            slots = {s: self._init_slot(s, v32) for s in self._slot_names}
+            if self._multi_precision and _is_low_precision(v.dtype):
+                slots["master"] = v32
+            state[name] = slots
+        return {"slots": state, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients_pytree(self, params, grads, state, lr=None):
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        if self._grad_clip is not None:
+            grads = self._grad_clip.clip_pytree(grads)
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name) if isinstance(grads, dict) else grads[name]
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state["slots"][name]
+                continue
+            slots = dict(state["slots"][name])
+            master = slots.pop("master", None)
+            pv = master if master is not None else p.astype(jnp.float32)
+            gv = g.astype(jnp.float32)
+            if self._wd and not self._decoupled_wd():
+                gv = gv + self._wd * pv
+            new_p, new_slots = self._update_rule(pv, gv, slots, lr, step)
+            if self._wd and self._decoupled_wd():
+                new_p = new_p - lr * self._wd * pv
+            out_slots = dict(new_slots)
+            if master is not None:
+                out_slots["master"] = new_p
+            new_params[name] = new_p.astype(p.dtype)
+            new_state[name] = out_slots
+        return new_params, {"slots": new_state, "step": step}
+
+    # -- checkpointing --------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        for i, p in enumerate(self._parameter_list or []):
+            slots = self._accumulators.get(id(p), {})
+            for k, v in slots.items():
+                out[f"{p.name or i}.{k}"] = Tensor(v)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step", 0))
+        for i, p in enumerate(self._parameter_list or []):
+            slots = {}
+            for k in self._slot_names + (("master",) if self._multi_precision else ()):
+                key = f"{p.name or i}.{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slots[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if slots:
+                self._accumulators[id(p)] = slots
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def _update_rule(self, p, g, slots, lr, step):
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_rule(self, p, g, slots, lr, step):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_rule(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** stepf)
+        vhat = v / (1 - b2 ** stepf)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return True
+
+
+class Adamax(Optimizer):
+    _slot_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_rule(self, p, g, slots, lr, step):
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        stepf = jnp.asarray(step, jnp.float32)
+        new_p = p - (lr / (1 - self._beta1 ** stepf)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _init_slot(self, name, v):
+        return jnp.full_like(v, self._initial, dtype=jnp.float32)
+
+    def _update_rule(self, p, g, slots, lr, step):
+        mom = slots["moment"] + jnp.square(g)
+        new_p = p - lr * g / (jnp.sqrt(mom) + self._epsilon)
+        return new_p, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_rule(self, p, g, slots, lr, step):
+        eg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = -jnp.sqrt((slots["avg_squared_update"] + self._epsilon)
+                           / (eg + self._epsilon)) * g
+        eu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return p + lr * update, {"avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    _slot_names = ("mean_square", "mean_grad", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update_rule(self, p, g, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = ms - jnp.square(mg) + self._epsilon
+        else:
+            mg = slots["mean_grad"]
+            denom = ms + self._epsilon
+        mom = self._momentum * slots["momentum"] + lr * g / jnp.sqrt(denom)
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_rule(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** stepf)
+        vhat = v / (1 - b2 ** stepf)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}

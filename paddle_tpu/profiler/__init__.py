@@ -1,0 +1,93 @@
+"""Profiler — reference python/paddle/profiler. Wraps jax.profiler (perfetto
+trace viewable in XProf/TensorBoard) plus lightweight host-side timers."""
+import contextlib
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "RecordEvent", "profiler_guard", "export_chrome_tracing"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "tpu"  # alias: reference name kept for API parity
+    TPU = "tpu"
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir="./profiler_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._events = []
+        self._started = False
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+        self._t0 = time.perf_counter()
+        self._started = True
+
+    def stop(self):
+        if self._started and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._started = False
+
+    def step(self, num_samples=None):
+        pass
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        return f"trace written to {self.log_dir}" if not self.timer_only else "timer-only run"
+
+    def export(self, path=None, format="json"):
+        return self.log_dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Annotates a named region (shows up in XLA trace via named_scope)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._scope = jax.named_scope(name)
+
+    def begin(self):
+        self._scope.__enter__()
+
+    def end(self):
+        self._scope.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def profiler_guard(log_dir="./profiler_log"):
+    p = Profiler(log_dir=log_dir)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        return dir_name
+    return handler
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("load exported traces with XProf/TensorBoard")

@@ -1,0 +1,22 @@
+"""Regularizers — reference python/paddle/regularizer.py."""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+    def __call__(self, param):
+        import jax.numpy as jnp
+        return self._coeff * jnp.sign(param)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+    def __call__(self, param):
+        return self._coeff * param

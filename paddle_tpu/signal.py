@@ -1,0 +1,91 @@
+"""Signal ops — reference python/paddle/signal.py (stft/istft/frame/overlap_add)."""
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def _f(v):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = np.arange(num) * hop_length
+        moved = jnp.moveaxis(v, axis, -1)
+        frames = jnp.stack([moved[..., s:s + frame_length] for s in starts], axis=-1)
+        # paddle: frames on axis=-2 → [..., frame_length, num_frames]
+        return jnp.moveaxis(frames, (-2, -1), (-2, -1)) if axis in (-1, v.ndim - 1) \
+            else jnp.moveaxis(frames, -1, axis)
+    return apply_op(_f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def _f(v):
+        # [..., frame_length, num_frames]
+        fl, num = v.shape[-2], v.shape[-1]
+        n = (num - 1) * hop_length + fl
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length: i * hop_length + fl].add(v[..., i])
+        return out
+    return apply_op(_f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def _f(v, *rest):
+        w = rest[0] if rest else jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            pad = (n_fft - wl) // 2
+            w = jnp.pad(w, (pad, n_fft - wl - pad))
+        sig = v
+        if center:
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                          mode="reflect" if pad_mode == "reflect" else "constant")
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        frames = jnp.stack([sig[..., s * hop: s * hop + n_fft] for s in range(num)], axis=-2)
+        frames = frames * w
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+    args = (x,) + ((window,) if window is not None else ())
+    return apply_op(_f, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def _f(spec, *rest):
+        w = rest[0] if rest else jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            pad = (n_fft - wl) // 2
+            w = jnp.pad(w, (pad, n_fft - wl - pad))
+        frames_fd = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            frames_fd = frames_fd * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(frames_fd, n=n_fft, axis=-1) if onesided \
+            else jnp.real(jnp.fft.ifft(frames_fd, axis=-1))
+        frames = frames * w
+        num = frames.shape[-2]
+        n = (num - 1) * hop + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop: i * hop + n_fft].add(frames[..., i, :])
+            wsum = wsum.at[i * hop: i * hop + n_fft].add(w * w)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    args = (x,) + ((window,) if window is not None else ())
+    return apply_op(_f, *args)

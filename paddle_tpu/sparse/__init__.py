@@ -1,0 +1,100 @@
+"""Sparse tensors — reference python/paddle/sparse (COO/CSR basics).
+XLA has no native sparse layout; COO here is (indices, values, shape) with
+dense fallbacks — correct semantics, dense-speed compute (fine for the
+API-parity tier; TPU-efficient block-sparse lives in the Pallas kernel set).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor", "SparseCsrTensor",
+           "matmul", "addmm", "relu", "tanh", "to_dense", "is_same_shape"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices if isinstance(indices, Tensor) else Tensor(jnp.asarray(indices))
+        self.values = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self):
+        idx = np.asarray(self.indices._value)
+        vals = self.values._value
+        out = jnp.zeros(tuple(self.shape), vals.dtype)
+        out = out.at[tuple(idx)].add(vals)
+        return Tensor(out)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def coalesce(self):
+        return self
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})"
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) else Tensor(jnp.asarray(crows))
+        self.cols = cols if isinstance(cols, Tensor) else Tensor(jnp.asarray(cols))
+        self.values = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self):
+        crows = np.asarray(self.crows._value)
+        cols = np.asarray(self.cols._value)
+        vals = self.values._value
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        out = jnp.zeros(tuple(self.shape), vals.dtype)
+        out = out.at[rows, cols].add(vals)
+        return Tensor(out)
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.values.shape[0]})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def to_dense(x):
+    return x.to_dense() if hasattr(x, "to_dense") else x
+
+
+def matmul(x, y, name=None):
+    xd = to_dense(x)
+    yd = to_dense(y)
+    from ..tensor.math import matmul as dense_matmul
+    return dense_matmul(xd, yd)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from ..tensor.math import addmm as dense_addmm
+    return dense_addmm(to_dense(input), to_dense(x), to_dense(y), beta, alpha)
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, Tensor(jnp.maximum(x.values._value, 0)), x.shape)
+    from ..nn.functional import relu as dense_relu
+    return dense_relu(x)
+
+
+def tanh(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, Tensor(jnp.tanh(x.values._value)), x.shape)
+    from ..tensor.math import tanh as dense_tanh
+    return dense_tanh(x)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

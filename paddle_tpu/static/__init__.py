@@ -1,0 +1,68 @@
+"""paddle_tpu.static — static-graph façade (reference python/paddle/static).
+
+The reference's Program/Executor machinery is replaced by XLA compilation:
+a "Program" here is a traced, jit-compiled callable. The façade keeps the
+most-used static APIs importable so reference-style scripts run.
+"""
+import jax
+
+from ..framework.core import Tensor
+from .input_spec import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "data", "Program", "Executor", "default_main_program",
+           "default_startup_program", "name_scope", "py_func", "save", "load"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Program:
+    """Placeholder graph container; real compilation happens via jax.jit."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        raise NotImplementedError(
+            "paddle_tpu is eager/jit-first: wrap your computation in "
+            "paddle_tpu.jit.to_static instead of Executor.run")
+
+
+def name_scope(prefix=None):
+    return jax.named_scope(prefix or "scope")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("use paddle_tpu.autograd.PyLayer for custom ops")
+
+
+def save(program, model_path, protocol=4):
+    raise NotImplementedError("use paddle_tpu.jit.save")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError("use paddle_tpu.jit.load")

@@ -26,6 +26,7 @@ def _un(name, fn):
         return x._inplace_update(fn)
     op_.__name__ = name + "_"
     globals()[name + "_"] = op_
+    __all__.append(name + "_")
     return op
 
 
@@ -41,6 +42,7 @@ def _bin(name, fn):
         return x._inplace_update(lambda v: fn(v, yv))
     op_.__name__ = name + "_"
     globals()[name + "_"] = op_
+    __all__.append(name + "_")
     return op
 
 

@@ -1,0 +1,152 @@
+"""Vision ops — reference python/paddle/vision/ops.py (roi_align, nms, box ops)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "DeformConv2D",
+           "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Greedy NMS (host-side; data-dependent output like reference CPU kernel)."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores) \
+        if scores is not None else np.ones(len(b), np.float32)
+    order = np.argsort(-s)
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs._value if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+    else:
+        cats = np.zeros(len(b), np.int64)
+    keep = []
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= (iou > iou_threshold) & (cats == cats[i])
+        suppressed[i] = True
+    keep = np.asarray(keep[:top_k] if top_k else keep, np.int64)
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _f(feat, rois, _nums):
+        n_rois = rois.shape[0]
+        c = feat.shape[1]
+        # map rois to batch indices
+        counts = _nums
+        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                               total_repeat_length=n_rois)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        # sample grid centers
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (rh[:, None] / oh)  # [R, oh]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (rw[:, None] / ow)  # [R, ow]
+
+        # vectorized bilinear gather: for each roi r, grid point (i,j)
+        def per_roi(bi, yy, xx):
+            fb = feat[bi]  # [C,H,W]
+            h, w = fb.shape[-2:]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (yy - y0)[:, None]
+            wx = (xx - x0)[None, :]
+            g = lambda yi, xi: fb[:, yi[:, None], xi[None, :]]
+            out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1_) * (1 - wy) * wx
+                   + g(y1_, x0) * wy * (1 - wx) + g(y1_, x1_) * wy * wx)
+            return out  # [C, oh, ow]
+        return jax.vmap(per_roi)(batch_idx, ys, xs)
+    return apply_op(_f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale, aligned=False)
+
+
+class _RoIBase:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+
+class RoIAlign(_RoIBase):
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class RoIPool(_RoIBase):
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class PSRoIPool(_RoIBase):
+    def __call__(self, x, boxes, boxes_num):
+        raise NotImplementedError("position-sensitive RoI pool: planned with detection suite")
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def _f(prior, var, target):
+        pw = prior[:, 2] - prior[:, 0] + (0 if box_normalized else 1)
+        ph = prior[:, 3] - prior[:, 1] + (0 if box_normalized else 1)
+        px = prior[:, 0] + pw * 0.5
+        py = prior[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + (0 if box_normalized else 1)
+            th = target[:, 3] - target[:, 1] + (0 if box_normalized else 1)
+            tx = target[:, 0] + tw * 0.5
+            ty = target[:, 1] + th * 0.5
+            ox = (tx - px) / pw / var[:, 0]
+            oy = (ty - py) / ph / var[:, 1]
+            ow = jnp.log(tw / pw) / var[:, 2]
+            oh = jnp.log(th / ph) / var[:, 3]
+            return jnp.stack([ox, oy, ow, oh], axis=1)
+        # decode
+        ox = var[:, 0] * target[:, 0] * pw + px
+        oy = var[:, 1] * target[:, 1] * ph + py
+        ow = jnp.exp(var[:, 2] * target[:, 2]) * pw
+        oh = jnp.exp(var[:, 3] * target[:, 3]) * ph
+        return jnp.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2, oy + oh / 2], axis=1)
+    return apply_op(_f, prior_box, prior_box_var, target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    raise NotImplementedError("yolo_box decode lands with the detection suite")
+
+
+def distribute_fpn_proposals(*args, **kwargs):
+    raise NotImplementedError("FPN ops land with the detection suite")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError("RPN ops land with the detection suite")
+
+
+class DeformConv2D:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("deformable conv: planned Pallas kernel")

@@ -1,0 +1,348 @@
+"""Vision transforms — reference python/paddle/vision/transforms (numpy/HWC
+based host-side preprocessing, feeding the DataLoader pipeline)."""
+import numbers
+import random
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter", "RandomRotation",
+    "Pad", "Grayscale", "to_tensor", "resize", "normalize", "hflip", "vflip",
+    "center_crop", "crop", "pad", "adjust_brightness", "adjust_contrast",
+    "to_grayscale",
+]
+
+
+def _to_hwc_array(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _to_hwc_array(img).astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    import jax
+    import jax.numpy as jnp
+    arr = _to_hwc_array(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
+    out_shape = (oh, ow) + arr.shape[2:]
+    return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape, method=method))
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_hwc_array(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_hwc_array(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = (h - th) // 2
+    left = (w - tw) // 2
+    return crop(arr, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if self.padding:
+            arr = pad(arr, self.padding)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = random.randint(0, max(h - th, 0))
+        left = random.randint(0, max(w - tw, 0))
+        return crop(arr, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(arr, top, left, ch, cw), self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size, self.interpolation)
+
+
+def hflip(img):
+    return _to_hwc_array(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_hwc_array(img)[::-1]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _to_hwc_array(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _to_hwc_array(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._value)
+    else:
+        arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = [mean] * 3 if isinstance(mean, numbers.Number) else mean
+        self.std = [std] * 3 if isinstance(std, numbers.Number) else std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        return arr.transpose(self.order)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_hwc_array(img)
+    if isinstance(padding, numbers.Number):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    l, t, r, b = padding
+    widths = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, widths, mode=mode, constant_values=fill)
+    return np.pad(arr, widths, mode=mode)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_hwc_array(img).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_hwc_array(img).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    mean = arr.mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0, hi)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_hwc_array(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_hwc_array(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img).astype(np.float32)
+        if self.value == 0:
+            return arr
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = arr.mean(axis=-1, keepdims=True)
+        hi = 255.0 if arr.max() > 1.5 else 1.0
+        return np.clip(gray + (arr - gray) * f, 0, hi)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return _to_hwc_array(img)  # hue rotation: HSV roundtrip omitted (rare path)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness), ContrastTransform(contrast),
+                           SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        ts = list(self.transforms)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+
+    def _apply_image(self, img):
+        import scipy.ndimage as ndi
+        angle = random.uniform(*self.degrees)
+        arr = _to_hwc_array(img)
+        return ndi.rotate(arr, angle, axes=(0, 1), reshape=False, order=1)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_hwc_array(img).astype(np.float32)
+    gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    return np.repeat(gray[..., None], num_output_channels, axis=-1)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)

@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Must run before any jax backend initialization. Also strips the axon TPU
+tunnel plugin so CPU test runs never block on the (single, shared) real chip.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+assert jax.default_backend() == "cpu"

@@ -1,0 +1,93 @@
+"""Eager tape + functional autograd tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, grad as pgrad
+
+
+def test_simple_backward():
+    a = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    b = paddle.to_tensor([4.0, 5.0], stop_gradient=False)
+    loss = paddle.sum(a * b + paddle.exp(a))
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4 + np.exp(2), 5 + np.exp(3)], rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), [2, 3], rtol=1e-6)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = x * x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3 * 1.5 ** 2], rtol=1e-6)
+    # second backward accumulates
+    (x * 2.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3 * 1.5 ** 2 + 2], rtol=1e-6)
+
+
+def test_stop_gradient():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = paddle.to_tensor([2.0])  # stop_gradient=True
+    loss = paddle.sum(a * b)
+    loss.backward()
+    assert b.grad is None
+    np.testing.assert_allclose(a.grad.numpy(), [2.0])
+
+
+def test_no_grad():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = a * 3.0
+    assert y.stop_gradient
+    y2 = a * 3.0
+    assert not y2.stop_gradient
+
+
+def test_matmul_grad():
+    w = paddle.to_tensor(np.eye(3, dtype="float32"), stop_gradient=False)
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    loss = paddle.sum(x @ w)
+    loss.backward()
+    np.testing.assert_allclose(w.grad.numpy(), np.ones((3, 3)) * 2, rtol=1e-6)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = pgrad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0], rtol=1e-6)
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2.0
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 2.0
+    b = x * 3.0
+    loss = paddle.sum(a + b)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3), stop_gradient=False)
+    p1, p2 = paddle.split(x, 2, axis=0)
+    loss = paddle.sum(p1) + paddle.sum(p2 * 2.0)
+    loss.backward()
+    expect = np.concatenate([np.ones((1, 3)), np.full((1, 3), 2.0)])
+    np.testing.assert_allclose(x.grad.numpy(), expect)

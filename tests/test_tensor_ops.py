@@ -1,0 +1,209 @@
+"""Per-op unit tests vs numpy golden — mirrors the reference test strategy
+(python/paddle/fluid/tests/unittests/test_*_op.py, numpy-checked)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return t.numpy()
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).tolist() == np.zeros([2, 3]).tolist()
+        assert _np(paddle.ones([2])).tolist() == [1, 1]
+        assert _np(paddle.full([2, 2], 7, "int32")).tolist() == [[7, 7], [7, 7]]
+
+    def test_arange_linspace_eye(self):
+        assert _np(paddle.arange(5)).tolist() == [0, 1, 2, 3, 4]
+        assert _np(paddle.arange(1, 10, 3)).tolist() == [1, 4, 7]
+        np.testing.assert_allclose(_np(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(_np(paddle.eye(3)), np.eye(3))
+
+    def test_to_tensor_dtype(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        assert t.dtype == paddle.float32
+        t64 = paddle.to_tensor([1, 2])
+        assert "int" in t64.dtype.name
+
+    def test_tril_triu_diag(self):
+        x = paddle.to_tensor(np.arange(9).reshape(3, 3).astype("float32"))
+        np.testing.assert_array_equal(_np(paddle.tril(x)), np.tril(_np(x)))
+        np.testing.assert_array_equal(_np(paddle.triu(x, 1)), np.triu(_np(x), 1))
+        np.testing.assert_array_equal(_np(paddle.diag(paddle.to_tensor([1.0, 2.0]))),
+                                      np.diag([1.0, 2.0]))
+
+
+class TestMath:
+    def setup_method(self, _):
+        paddle.seed(42)
+        self.x = paddle.rand([4, 5])
+        self.y = paddle.rand([4, 5])
+
+    def test_elementwise(self):
+        a, b = _np(self.x), _np(self.y)
+        np.testing.assert_allclose(_np(self.x + self.y), a + b, rtol=1e-6)
+        np.testing.assert_allclose(_np(self.x * self.y), a * b, rtol=1e-6)
+        np.testing.assert_allclose(_np(self.x / (self.y + 1)), a / (b + 1), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.exp(self.x)), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.log(self.x + 1)), np.log(a + 1), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.rsqrt(self.x + 1)), 1 / np.sqrt(a + 1), rtol=1e-5)
+
+    def test_scalar_ops_keep_dtype(self):
+        z = self.x * 2 + 1
+        assert z.dtype == paddle.float32
+        np.testing.assert_allclose(_np(z), _np(self.x) * 2 + 1, rtol=1e-6)
+
+    def test_reductions(self):
+        a = _np(self.x)
+        np.testing.assert_allclose(_np(paddle.sum(self.x)), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.mean(self.x, axis=1)), a.mean(1), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.max(self.x, axis=0)), a.max(0), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.prod(self.x, axis=1)), a.prod(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.logsumexp(self.x)),
+                                   np.log(np.exp(a).sum()), rtol=1e-5)
+
+    def test_matmul(self):
+        m = paddle.rand([3, 4])
+        n = paddle.rand([4, 5])
+        np.testing.assert_allclose(_np(paddle.matmul(m, n)), _np(m) @ _np(n), rtol=1e-5)
+        np.testing.assert_allclose(_np(m @ n), _np(m) @ _np(n), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.matmul(m, n.T if False else paddle.to_tensor(_np(n).T), transpose_y=True)),
+            _np(m) @ _np(n), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = _np(self.x)
+        np.testing.assert_allclose(_np(paddle.cumsum(self.x, axis=1)), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.clip(self.x, 0.2, 0.8)), a.clip(0.2, 0.8), rtol=1e-6)
+
+    def test_inplace(self):
+        t = paddle.to_tensor([1.0, 4.0, 9.0])
+        t.sqrt_()
+        np.testing.assert_allclose(_np(t), [1, 2, 3], rtol=1e-6)
+        t.add_(paddle.to_tensor([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(_np(t), [2, 3, 4], rtol=1e-6)
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self):
+        x = paddle.arange(12, dtype="float32")
+        r = x.reshape([3, 4])
+        assert r.shape == [3, 4]
+        t = r.transpose([1, 0])
+        assert t.shape == [4, 3]
+        c = paddle.concat([r, r], axis=0)
+        assert c.shape == [6, 4]
+        s = paddle.stack([r, r], axis=0)
+        assert s.shape == [2, 3, 4]
+
+    def test_split_chunk(self):
+        x = paddle.arange(12, dtype="float32").reshape([3, 4])
+        p = paddle.split(x, [1, 3], axis=1)
+        assert p[0].shape == [3, 1] and p[1].shape == [3, 3]
+        p = paddle.split(x, [1, -1], axis=1)
+        assert p[1].shape == [3, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype("float32"))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        np.testing.assert_array_equal(_np(g), _np(x)[[0, 2]])
+        upd = paddle.ones([2, 3])
+        s = paddle.scatter(x, idx, upd)
+        expect = _np(x).copy()
+        expect[[0, 2]] = 1
+        np.testing.assert_array_equal(_np(s), expect)
+
+    def test_where_masked(self):
+        x = paddle.to_tensor([1.0, -2.0, 3.0])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_array_equal(_np(out), [1, 0, 3])
+
+    def test_squeeze_tile_flip(self):
+        x = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.unsqueeze(paddle.ones([3]), [0, 2]).shape == [1, 3, 1]
+        np.testing.assert_array_equal(_np(paddle.flip(paddle.arange(3), 0)), [2, 1, 0])
+        assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+
+    def test_getitem_setitem(self):
+        x = paddle.arange(12, dtype="float32").reshape([3, 4])
+        assert x[1, 2].item() == 6.0
+        assert x[:, 1].shape == [3]
+        x[0, 0] = 100.0
+        assert x[0, 0].item() == 100.0
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal(_np(x < y), [True, False, False])
+        np.testing.assert_array_equal(_np(paddle.equal(x, y)), [False, True, False])
+        assert paddle.allclose(x, x).item()
+
+    def test_topk_argsort(self):
+        x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+        v, i = paddle.topk(x, 2)
+        np.testing.assert_array_equal(_np(v), [5, 4])
+        np.testing.assert_array_equal(_np(i), [4, 2])
+        np.testing.assert_array_equal(_np(paddle.argsort(x)), np.argsort(_np(x), kind="stable"))
+
+    def test_argmax_unique(self):
+        x = paddle.to_tensor([[1.0, 5.0], [7.0, 2.0]])
+        assert paddle.argmax(x).item() == 2
+        np.testing.assert_array_equal(_np(paddle.argmax(x, axis=1)), [1, 0])
+        u = paddle.unique(paddle.to_tensor([3, 1, 2, 1, 3]))
+        np.testing.assert_array_equal(_np(u), [1, 2, 3])
+
+
+class TestLinalgStat:
+    def test_norm_det_inv(self):
+        a = np.array([[2.0, 0.0], [1.0, 3.0]], np.float32)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.det(x).item(), np.linalg.det(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.linalg.inv(x)), np.linalg.inv(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.norm(x).item(), np.linalg.norm(a), rtol=1e-5)
+
+    def test_svd_qr_eigh(self):
+        paddle.seed(1)
+        x = paddle.rand([4, 3])
+        u, s, vt = paddle.linalg.svd(x)
+        rec = _np(u) @ np.diag(_np(s)) @ _np(vt)
+        np.testing.assert_allclose(rec, _np(x), atol=1e-5)
+        q, r = paddle.linalg.qr(x)
+        np.testing.assert_allclose(_np(q) @ _np(r), _np(x), atol=1e-5)
+
+    def test_stat(self):
+        paddle.seed(2)
+        x = paddle.rand([10, 5])
+        a = _np(x)
+        np.testing.assert_allclose(_np(paddle.std(x)), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.var(x, axis=0)), a.var(0, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.median(x)), np.median(a), rtol=1e-5)
+
+    def test_einsum(self):
+        a = paddle.rand([2, 3])
+        b = paddle.rand([3, 4])
+        np.testing.assert_allclose(_np(paddle.einsum("ij,jk->ik", a, b)),
+                                   _np(a) @ _np(b), rtol=1e-5)
+
+
+class TestRandom:
+    def test_determinism(self):
+        paddle.seed(123)
+        a = paddle.randn([8])
+        paddle.seed(123)
+        b = paddle.randn([8])
+        np.testing.assert_array_equal(_np(a), _np(b))
+
+    def test_shapes_ranges(self):
+        r = paddle.randint(0, 10, [100])
+        assert _np(r).min() >= 0 and _np(r).max() < 10
+        u = paddle.uniform([50], min=2.0, max=3.0)
+        assert _np(u).min() >= 2.0 and _np(u).max() <= 3.0
+        p = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(_np(p)), np.arange(10))
