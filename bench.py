@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT pretraining train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+vs_baseline = achieved MFU / 0.35 (BASELINE.json north-star: GPT-3 1.3B
+pretraining at >=35% MFU on v5e). Falls back to smaller GPT configs if the
+1.3B Adam state can't fit the chip.
+"""
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def chip_peak_flops():
+    """bf16 peak FLOP/s for the attached chip."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12
+
+
+def run_config(cfg_name, batch_size, seq_len, steps=10):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.models import GPT, GPTPretrainingCriterion
+
+    cfg = getattr(gpt_mod, cfg_name)(max_seq_len=seq_len)
+    paddle.seed(0)
+    build_mesh(dp=1)
+    log(f"building {cfg_name}: {cfg.num_params()/1e6:.0f}M params, "
+        f"batch={batch_size} seq={seq_len}")
+    model = GPT(cfg)
+    model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=2e-4, weight_decay=0.1,
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+        accumulator_dtype="bfloat16")
+
+    def loss_fn(m, batch):
+        logits = m(paddle.to_tensor(batch["input_ids"]))
+        return crit(logits, paddle.to_tensor(batch["labels"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+
+    t0 = time.time()
+    loss = trainer.step(batch)
+    float(loss)
+    log(f"compile+first step: {time.time()-t0:.1f}s, loss={float(loss):.3f}")
+    float(trainer.step(batch))  # warm
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = trainer.step(batch)
+    float(loss)  # sync
+    dt = (time.time() - t0) / steps
+    tokens_per_sec = batch_size * seq_len / dt
+    n_params = cfg.num_params()
+    flops_per_token = 6 * n_params  # fwd+bwd heuristic
+    mfu = flops_per_token * tokens_per_sec / chip_peak_flops()
+    log(f"{cfg_name}: {dt*1e3:.1f} ms/step, {tokens_per_sec:.0f} tok/s, MFU={mfu:.3f}")
+    return tokens_per_sec, mfu, n_params
+
+
+def main():
+    attempts = [
+        ("gpt_1p3b", 8, 1024),
+        ("gpt_1p3b", 4, 1024),
+        ("gpt_760m", 8, 1024),
+        ("gpt_350m", 16, 1024),
+        ("gpt_125m", 16, 1024),
+    ]
+    last_err = None
+    for cfg_name, bs, seq in attempts:
+        try:
+            tok_s, mfu, n_params = run_config(cfg_name, bs, seq)
+            print(json.dumps({
+                "metric": f"{cfg_name}_train_tokens_per_sec_per_chip",
+                "value": round(tok_s, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "mfu": round(mfu, 4),
+                "params": n_params,
+                "batch": bs, "seq": seq,
+            }))
+            return
+        except Exception as e:  # OOM or tunnel issues → try smaller
+            last_err = e
+            log(f"{cfg_name} failed: {type(e).__name__}: {str(e)[:300]}")
+    print(json.dumps({"metric": "gpt_train_tokens_per_sec_per_chip",
+                      "value": 0.0, "unit": "tokens/s/chip",
+                      "vs_baseline": 0.0, "error": str(last_err)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,11 @@ class _pause_tape:
         _tape().paused -= 1
 
 
+def _is_jax_value(v):
+    """jax.Array or any tracer (tracer classes moved across jax versions)."""
+    return isinstance(v, jax.Array) or hasattr(v, "aval")
+
+
 def _is_diff_dtype(v):
     d = jnp.result_type(v)
     return jnp.issubdtype(d, np.inexact) or d == dtypes.bfloat16
@@ -244,7 +249,7 @@ class Tensor:
             value = value._value
         if dtype is not None:
             value = jnp.asarray(value, dtypes.dtype(dtype))
-        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+        elif not _is_jax_value(value):
             value = _np_default(value)
         self._value = value
         self.stop_gradient = stop_gradient
@@ -410,7 +415,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (paddle.framework.Parameter / fluid ParamBase)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip",
+                 "partition_spec")
 
     def __init__(self, value, dtype=None, name=None, trainable=True):
         super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -419,6 +425,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.need_clip = True
+        self.partition_spec = None  # GSPMD mesh axes, set by parallel layers
 
     @property
     def trainable(self):
@@ -457,6 +464,8 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         return Tensor(v, stop_gradient=stop_gradient)
     if dtype is not None:
         v = jnp.asarray(data, dtypes.dtype(dtype))
+    elif _is_jax_value(data):
+        v = data
     else:
         v = _np_default(data)
     return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,197 @@
+"""GPT-3-style decoder — the flagship pretraining model.
+
+Role parity: PaddleNLP gpt-3 recipe the reference benchmarks
+(BASELINE.json: "GPT-3 1.3B tokens/sec/chip"). TPU-first design:
+
+  * bf16 params/activations, fp32 LayerNorm + softmax + loss
+  * flash attention (Pallas) on the causal path
+  * per-block jax.checkpoint (remat) — activation memory ~O(L·1 block)
+  * tensor parallel via GSPMD partition specs on qkv/proj/mlp/vocab
+    (see distributed/fleet/meta_parallel.py for the mechanism)
+  * sequence-parallel activation constraints over 'sp' when that axis >1
+  * tied input/output embedding (logits = h @ E^T)
+"""
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.core import Tensor, apply_op
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal
+from ..nn.layer_base import Layer, functional_call
+
+__all__ = ["GPTConfig", "GPT", "GPTPretrainingCriterion",
+           "gpt_tiny", "gpt_125m", "gpt_350m", "gpt_760m", "gpt_1p3b"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # multiple of 128 → clean vocab sharding
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 0              # 0 → 4*hidden
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: str = "bfloat16"          # compute/param dtype
+    remat: bool = True               # jax.checkpoint each block
+    tie_embeddings: bool = True
+    init_std: float = 0.02
+
+    def __post_init__(self):
+        if self.ffn_hidden == 0:
+            self.ffn_hidden = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    def num_params(self):
+        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_block = 4 * h * h + 2 * h * self.ffn_hidden + 9 * h + 2 * self.ffn_hidden
+        return v * h + self.max_seq_len * h + L * per_block + 2 * h
+
+
+class GPTBlock(Layer):
+    """Pre-LN decoder block. qkv/out and mlp projections carry 'tp'
+    partition specs; with tp=1 those specs are inert."""
+
+    def __init__(self, cfg: GPTConfig, layer_idx: int):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        init = Normal(0.0, cfg.init_std)
+        # scaled init on residual-out projections (GPT-2/3 recipe)
+        out_init = Normal(0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers))
+        self.ln1 = nn.LayerNorm(h)
+        self.qkv = nn.Linear(h, 3 * h, weight_attr=nn.ParamAttr(initializer=init))
+        self.qkv.weight.partition_spec = (None, "tp")
+        self.qkv.bias.partition_spec = ("tp",)
+        self.proj = nn.Linear(h, h, weight_attr=nn.ParamAttr(initializer=out_init))
+        self.proj.weight.partition_spec = ("tp", None)
+        self.ln2 = nn.LayerNorm(h)
+        self.fc1 = nn.Linear(h, cfg.ffn_hidden, weight_attr=nn.ParamAttr(initializer=init))
+        self.fc1.weight.partition_spec = (None, "tp")
+        self.fc1.bias.partition_spec = ("tp",)
+        self.fc2 = nn.Linear(cfg.ffn_hidden, h, weight_attr=nn.ParamAttr(initializer=out_init))
+        self.fc2.weight.partition_spec = ("tp", None)
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, L = x.shape[0], x.shape[1]
+        res = x
+        y = self.ln1(x)
+        qkv = self.qkv(y)
+        from ..tensor.manipulation import reshape
+        qkv = reshape(qkv, [B, L, 3, cfg.num_heads, cfg.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              dropout_p=cfg.dropout, training=self.training)
+        attn = reshape(attn, [B, L, cfg.hidden_size])
+        x = res + self.proj(attn)
+        res = x
+        y = self.ln2(x)
+        y = self.fc2(F.gelu(self.fc1(y), approximate=True))
+        return res + y
+
+
+class GPT(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = Normal(0.0, cfg.init_std)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wte.weight.partition_spec = ("tp", None)  # vocab-parallel
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg, i) for i in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=nn.ParamAttr(initializer=init),
+                                     bias_attr=False)
+            self.lm_head.weight.partition_spec = (None, "tp")
+
+    def _run_block(self, block, x):
+        """Apply one block, optionally under jax.checkpoint: the block's
+        params become explicit inputs of a pure function so XLA rematerializes
+        its activations in the backward pass instead of storing them."""
+        if not self.cfg.remat:
+            return block(x)
+        names = [n for n, _ in block.named_parameters()]
+        vals = [p._value for _, p in block.named_parameters()]
+
+        @partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+        def pure_block(pvals, xv):
+            with functional_call(block, dict(zip(names, pvals))):
+                out = block(Tensor(xv))
+            return out._value
+
+        return apply_op(lambda xv, *pv: pure_block(list(pv), xv), x, *vals)
+
+    def forward(self, input_ids):
+        cfg = self.cfg
+        B, L = input_ids.shape[0], input_ids.shape[1]
+        from ..tensor.creation import arange
+        pos = arange(L, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = x.astype(cfg.dtype)
+        # batch over data axes, sequence over 'sp' (GSPMD inserts the
+        # gather/scatter collectives around attention when sp > 1)
+        from ..distributed.sharding_utils import constraint
+        from ..distributed.mesh import get_mesh
+        if get_mesh(create_default=False) is not None:
+            x = constraint(x, ("dp", "fsdp"), "sp", None)
+        x = self.drop(x)
+        for block in self.blocks:
+            x = self._run_block(block, x)
+        x = self.ln_f(x)
+        # tied head: [B,L,H] @ [H,V] — the big MXU matmul; fp32 accum via
+        # preferred_element_type to keep loss numerics honest in bf16
+        if cfg.tie_embeddings:
+            logits = apply_op(
+                lambda h, e: jax.lax.dot_general(
+                    h, e, (((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32),
+                x, self.wte.weight)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+
+class GPTPretrainingCriterion(Layer):
+    """Causal LM loss (fp32), ignoring pad label -100."""
+
+    def forward(self, logits, labels):
+        V = logits.shape[-1]
+        from ..tensor.manipulation import reshape
+        flat = reshape(logits, [-1, V])
+        flat_labels = reshape(labels, [-1])
+        return F.cross_entropy(flat, flat_labels, ignore_index=-100, reduction="mean")
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+                     max_seq_len=256, **kw)
+
+
+def gpt_125m(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_350m(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_760m(**kw):
+    return GPTConfig(hidden_size=1536, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)

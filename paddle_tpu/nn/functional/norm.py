@@ -81,7 +81,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
     # eager stat update (mirrors reference batch_norm_kernel running-stat path)
     if training and not use_global and isinstance(running_mean, Tensor) \
-            and not isinstance(x._value, jax.core.Tracer):
+            and isinstance(x._value, jax.Array):
         v = x._value.astype(jnp.float32)
         ax = ch_axis % v.ndim
         reduce_axes = tuple(i for i in range(v.ndim) if i != ax)

@@ -33,11 +33,15 @@ class Optimizer:
     _slot_names = ()
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, multi_precision=False, name=None):
+                 grad_clip=None, multi_precision=False, name=None,
+                 accumulator_dtype=None):
         self._parameter_list = list(parameters) if parameters is not None else None
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        # TPU HBM saver: keep moment slots in bf16 (compute stays fp32).
+        # Halves Adam state for 1B+ models on a 16GB chip.
+        self._acc_dtype = jnp.dtype(accumulator_dtype) if accumulator_dtype else None
         if weight_decay is None:
             self._wd = 0.0
         elif isinstance(weight_decay, (int, float)):
@@ -62,7 +66,15 @@ class Optimizer:
 
     # -- update rule (override) ---------------------------------------------
     def _init_slot(self, name, p_value):
-        return jnp.zeros_like(p_value, dtype=jnp.float32)
+        return jnp.zeros_like(p_value, dtype=self._acc_dtype or jnp.float32)
+
+    def _slots_to_f32(self, slots):
+        return {k: v.astype(jnp.float32) for k, v in slots.items()}
+
+    def _slots_from_f32(self, slots):
+        if self._acc_dtype is None:
+            return slots
+        return {k: v.astype(self._acc_dtype) for k, v in slots.items()}
 
     def _update_rule(self, p, g, slots, lr, step):
         """Returns (new_p, new_slots). p/g are fp32 here (master weights)."""
@@ -98,8 +110,9 @@ class Optimizer:
             gv = g._value.astype(jnp.float32)
             if self._wd and not self._decoupled_wd() and p.regularizer is None:
                 gv = gv + self._wd * pv
-            rule_slots = {k: v for k, v in slots.items() if k != "master"}
+            rule_slots = self._slots_to_f32({k: v for k, v in slots.items() if k != "master"})
             new_p, new_slots = self._update_rule(pv, gv, rule_slots, p_lr, self._step_count)
+            new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
                 new_p = new_p - p_lr * self._wd * pv
             if master is not None:
@@ -148,7 +161,8 @@ class Optimizer:
             gv = g.astype(jnp.float32)
             if self._wd and not self._decoupled_wd():
                 gv = gv + self._wd * pv
-            new_p, new_slots = self._update_rule(pv, gv, slots, lr, step)
+            new_p, new_slots = self._update_rule(pv, gv, self._slots_to_f32(slots), lr, step)
+            new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
                 new_p = new_p - lr * self._wd * pv
             out_slots = dict(new_slots)
@@ -213,8 +227,10 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+                 multi_precision=False, use_multi_tensor=False, name=None,
+                 accumulator_dtype=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, accumulator_dtype=accumulator_dtype)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
@@ -233,9 +249,11 @@ class Adam(Optimizer):
 class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
+                 accumulator_dtype=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode, multi_precision)
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         accumulator_dtype=accumulator_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decoupled_wd(self):

@@ -1,0 +1,117 @@
+"""GPT model + distributed compiled train step on the virtual 8-device mesh.
+Covers: dp/fsdp/tp sharding equivalence, remat, loss decrease, fleet API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh, fleet
+from paddle_tpu.distributed.trainer import Trainer, shard_batch
+from paddle_tpu.models import GPT, GPTConfig, GPTPretrainingCriterion
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64, dtype="float32", remat=False)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def make_batch(bs=8, L=16, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (bs, L + 1))
+    return {"input_ids": ids[:, :-1].astype("int32"),
+            "labels": ids[:, 1:].astype("int32")}
+
+
+def loss_fn(model, batch):
+    logits = model(paddle.to_tensor(batch["input_ids"]))
+    return GPTPretrainingCriterion()(logits, paddle.to_tensor(batch["labels"]))
+
+
+def test_gpt_forward_shapes():
+    paddle.seed(0)
+    cfg = tiny_cfg()
+    model = GPT(cfg)
+    ids = paddle.to_tensor(np.zeros((2, 16), "int32"))
+    logits = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+
+
+def test_gpt_train_loss_decreases():
+    paddle.seed(0)
+    build_mesh(dp=8)
+    model = GPT(tiny_cfg())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    trainer = Trainer(model, opt, loss_fn)
+    batch = make_batch()
+    losses = [float(trainer.step(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_dp_equals_single_device():
+    """Same data, same init → dp=8 loss == dp=1 loss (GSPMD grad psum)."""
+    batch = make_batch(bs=8)
+    losses = {}
+    for dp in (1, 8):
+        paddle.seed(42)
+        build_mesh(dp=dp)
+        model = GPT(tiny_cfg())
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        trainer = Trainer(model, opt, loss_fn)
+        losses[dp] = [float(trainer.step(batch)) for _ in range(3)]
+    np.testing.assert_allclose(losses[1], losses[8], rtol=1e-4)
+
+
+def test_tp_fsdp_equals_single_device():
+    batch = make_batch(bs=4)
+    losses = {}
+    for axes in ({"dp": 1}, {"tp": 4, "fsdp": 2}):
+        paddle.seed(7)
+        build_mesh(**axes)
+        model = GPT(tiny_cfg())
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        trainer = Trainer(model, opt, loss_fn)
+        key = tuple(sorted(axes.items()))
+        losses[key] = [float(trainer.step(batch)) for _ in range(3)]
+    vals = list(losses.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-3)
+
+
+def test_remat_matches_no_remat():
+    batch = make_batch(bs=2, L=8)
+    results = {}
+    for remat in (False, True):
+        paddle.seed(3)
+        build_mesh(dp=1)
+        model = GPT(tiny_cfg(remat=remat))
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+        trainer = Trainer(model, opt, loss_fn)
+        results[remat] = [float(trainer.step(batch)) for _ in range(2)]
+    np.testing.assert_allclose(results[False], results[True], rtol=1e-4)
+
+
+def test_fleet_hybrid_init_and_sharded_params():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_data_parallel_world_size() == 4  # dp*fsdp
+    paddle.seed(0)
+    model = GPT(tiny_cfg())
+    dmodel = fleet.distributed_model(model)
+    # qkv weight must actually be sharded over tp
+    from paddle_tpu.distributed import get_mesh
+    qkv = model.blocks[0].qkv.weight
+    spec = dmodel.sharding_plan["blocks.0.qkv.weight"].spec
+    assert "tp" in str(spec)
+    logits = dmodel(paddle.to_tensor(np.zeros((4, 16), "int32")))
+    assert logits.shape == [4, 16, 256]
+
+
+def test_shard_batch_layout():
+    build_mesh(dp=4, fsdp=2)
+    b = shard_batch({"x": np.zeros((8, 4), "float32")})
+    assert b["x"].shape == (8, 4)
+    # 8 rows over dp(4)×fsdp(2) → each shard 1 row
+    assert len(b["x"].sharding.device_set) == 8
