@@ -89,8 +89,18 @@ class GPTBlock(Layer):
         from ..tensor.manipulation import reshape
         qkv = reshape(qkv, [B, L, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                              dropout_p=cfg.dropout, training=self.training)
+        from ..distributed.mesh import get_mesh
+        mesh = get_mesh(create_default=False)
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # sequence parallel: exact ring attention over ICI ('sp' axis)
+            from ..ops.ring_attention import ring_attention
+            attn = apply_op(
+                lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
+                q, k, v)
+        else:
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                  dropout_p=cfg.dropout,
+                                                  training=self.training)
         attn = reshape(attn, [B, L, cfg.hidden_size])
         x = res + self.proj(attn)
         res = x

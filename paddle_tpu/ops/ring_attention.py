@@ -1,0 +1,81 @@
+"""Ring attention: exact flash attention over a sequence-sharded 'sp' axis.
+
+Replaces the reference's sequence-parallel attention (fleet sep/
+sparse_attention CUDA paths) with the TPU-native ring algorithm: K/V shards
+rotate around the ICI ring via ppermute while each device accumulates its
+queries' online-softmax partials — memory O(L/sp), comms overlap with compute.
+
+Used inside shard_map with q/k/v sharded on the sequence dim:
+    out = shard_map(partial(ring_attention_local, axis_name="sp", causal=True),
+                    mesh, in_specs=P(dp, "sp", None, None), ...)(q, k, v)
+Layout: [batch, seq_local, heads, head_dim].
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+
+def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None):
+    """Runs INSIDE shard_map. q,k,v: [B, L_local, H, D] (this shard)."""
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [B,H,Lq,D]
+    B, H, Lq, D = qh.shape
+    Lk = k.shape[1]
+
+    q_pos = my_idx * Lq + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+
+    # derive from qh so the carry inits inherit its varying-axes type
+    m0 = jnp.full_like(qh[..., :1], -1e30)
+    l0 = jnp.zeros_like(qh[..., :1])
+    acc0 = jnp.zeros_like(qh)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my_idx - step) % sp  # which shard's k/v we hold this step
+        kh = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)
+        vh = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
+        s = qh @ jnp.swapaxes(kh, -1, -2)  # [B,H,Lq,Lk]
+        if causal:
+            k_pos = src * Lk + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + p @ vh
+        # rotate k/v to the next device; overlaps with next step's matmul
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, m_new, l_new, acc_new
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, sp, body, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
+                   batch_axes=("dp", "fsdp"), scale=None):
+    """shard_map wrapper: q,k,v are GLOBAL [B, L, H, D] arrays (or already
+    sharded); the sequence dim is split over `axis_name`."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from ..distributed.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    spec = P(batch_axes, axis_name, None, None)
+    fn = functools.partial(ring_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
