@@ -1,0 +1,71 @@
+"""Pipeline parallelism: SPMD GPipe over the 'pp' mesh axis.
+
+Replaces reference fleet pipeline_parallel.py (P2P send/recv between rank
+processes, 1F1B scheduler in python) with the TPU-native formulation: ONE
+compiled program in which every stage runs the same code, activations hop
+stages via ppermute on ICI, and the microbatch schedule is a lax.scan over
+ticks. shard_map is manual ONLY over 'pp' (axis_names={'pp'}) so tensor/data
+parallel dims inside each stage stay GSPMD-managed — pp×tp×dp×sp compose.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stacked_params, x, n_microbatch, mesh=None,
+                   axis_name="pp", param_specs=None):
+    """Run layers stacked on leading dim through a GPipe schedule.
+
+    stage_fn(local_params, x) -> y   applies this stage's layer slice
+    stacked_params: pytree, leaves [L_total, ...], sharded over 'pp' on dim 0
+    x: [B, ...] activations (replicated w.r.t. 'pp')
+    """
+    from .mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    n_stages = mesh.shape.get(axis_name, 1)
+    if n_stages == 1:
+        return stage_fn(stacked_params, x)
+
+    n_micro = n_microbatch
+    assert x.shape[0] % n_micro == 0, "batch must divide microbatches"
+
+    def local_fn(params_local, xv):
+        idx = jax.lax.axis_index(axis_name)
+        B = xv.shape[0]
+        mb = xv.reshape((n_micro, B // n_micro) + xv.shape[1:])
+        T = n_micro + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+        out_buf0 = jax.lax.pcast(jnp.zeros_like(mb), (axis_name,), to="varying")
+        recv0 = jax.lax.pcast(jnp.zeros_like(mb[0]), (axis_name,), to="varying")
+
+        def tick(carry, t):
+            out_buf, recv = carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x_t = jax.lax.dynamic_index_in_dim(mb, mb_idx, 0, keepdims=False)
+            x_in = jnp.where(idx == 0, x_t, recv)
+            y = stage_fn(params_local, x_in)
+            widx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, widx, 0, keepdims=False)
+            write = jnp.where(t >= n_stages - 1, y, cur)
+            out_buf = jax.lax.dynamic_update_index_in_dim(out_buf, write, widx, 0)
+            recv = jax.lax.ppermute(y, axis_name, perm)
+            return (out_buf, recv), None
+
+        (out_buf, _), _ = jax.lax.scan(tick, (out_buf0, recv0), jnp.arange(T))
+        # only the LAST stage's buffer holds the model output; psum-broadcast
+        out_buf = jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
+        out_buf = jax.lax.psum(out_buf, axis_name)
+        return out_buf.reshape(xv.shape[:1] + out_buf.shape[2:])
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda v: P(axis_name, *([None] * (v.ndim - 1))), stacked_params)
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+    )(stacked_params, x)

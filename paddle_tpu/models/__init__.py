@@ -1,4 +1,14 @@
 """paddle_tpu.models — flagship model zoo (NLP side; vision lives in
 paddle_tpu.vision.models). Mirrors the PaddleNLP model recipes the reference
 headline benchmarks use (GPT-3, BERT/ERNIE, GPT-MoE)."""
-from .gpt import GPT, GPTConfig, GPTPretrainingCriterion, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPT,
+    GPTConfig,
+    GPTPretrainingCriterion,
+    GPTStacked,
+    gpt_125m,
+    gpt_350m,
+    gpt_760m,
+    gpt_1p3b,
+    gpt_tiny,
+)

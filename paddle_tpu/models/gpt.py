@@ -205,3 +205,120 @@ def gpt_760m(**kw):
 
 def gpt_1p3b(**kw):
     return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Stacked-layer GPT: scan-over-layers (fast compile) + pipeline parallelism
+# ---------------------------------------------------------------------------
+class GPTStacked(Layer):
+    """GPT with all decoder blocks stored as STACKED parameters
+    ([num_layers, ...]).
+
+    Why: (a) lax.scan over the layer dim compiles O(1) in depth instead of
+    O(L); (b) the 'pp' mesh axis shards the layer dim, and the same stacked
+    layout feeds the GPipe schedule in distributed/pipeline.py — the TPU
+    rendering of reference fleet meta_parallel/pipeline_parallel.py.
+    Attention uses the jnp path (GSPMD-sharded); dropout is not applied
+    inside stacked blocks.
+    """
+
+    def __init__(self, cfg: GPTConfig, pp_microbatches: int = 4):
+        super().__init__()
+        self.cfg = cfg
+        self.pp_microbatches = pp_microbatches
+        h, f, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers
+        init = Normal(0.0, cfg.init_std)
+        out_init = Normal(0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers))
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wte.weight.partition_spec = ("tp", None)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.ln_f = nn.LayerNorm(h)
+
+        def mk(name, shape, initializer, spec):
+            p = self.create_parameter(shape, default_initializer=initializer)
+            p.partition_spec = spec
+            self.add_parameter(name, p)
+
+        one, zero = Constant(1.0), Constant(0.0)
+        mk("ln1_w", [L, h], one, ("pp", None))
+        mk("ln1_b", [L, h], zero, ("pp", None))
+        mk("qkv_w", [L, h, 3 * h], init, ("pp", None, "tp"))
+        mk("qkv_b", [L, 3 * h], zero, ("pp", "tp"))
+        mk("proj_w", [L, h, h], out_init, ("pp", "tp", None))
+        mk("proj_b", [L, h], zero, ("pp", None))
+        mk("ln2_w", [L, h], one, ("pp", None))
+        mk("ln2_b", [L, h], zero, ("pp", None))
+        mk("fc1_w", [L, h, f], init, ("pp", None, "tp"))
+        mk("fc1_b", [L, f], zero, ("pp", "tp"))
+        mk("fc2_w", [L, f, h], out_init, ("pp", "tp", None))
+        mk("fc2_b", [L, h], zero, ("pp", None))
+
+    _BLOCK_KEYS = ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                   "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+
+    def _block_step(self, p, xv):
+        """One decoder block on raw arrays. p: one layer's param dict."""
+        cfg = self.cfg
+
+        def ln(z, w, b):
+            z32 = z.astype(jnp.float32)
+            mu = jnp.mean(z32, -1, keepdims=True)
+            var = jnp.mean(jnp.square(z32 - mu), -1, keepdims=True)
+            return ((z32 - mu) * jax.lax.rsqrt(var + 1e-5)).astype(z.dtype) \
+                * w.astype(z.dtype) + b.astype(z.dtype)
+
+        B, L = xv.shape[0], xv.shape[1]
+        y = ln(xv, p["ln1_w"], p["ln1_b"])
+        qkv = y @ p["qkv_w"].astype(y.dtype) + p["qkv_b"].astype(y.dtype)
+        qkv = qkv.reshape(B, L, 3, cfg.num_heads, cfg.head_dim)
+        from ..ops.attention import mha_reference
+        attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True)
+        attn = attn.reshape(B, L, cfg.hidden_size)
+        xv = xv + attn @ p["proj_w"].astype(y.dtype) + p["proj_b"].astype(y.dtype)
+        y = ln(xv, p["ln2_w"], p["ln2_b"])
+        y = jax.nn.gelu(y @ p["fc1_w"].astype(y.dtype) + p["fc1_b"].astype(y.dtype),
+                        approximate=True)
+        return xv + y @ p["fc2_w"].astype(y.dtype) + p["fc2_b"].astype(y.dtype)
+
+    def _stage_fn(self, params_local, xv):
+        """Apply a contiguous slice of layers (scan + per-layer remat)."""
+        step = self._block_step
+        if self.cfg.remat:
+            step = jax.checkpoint(step, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def body(carry, pslice):
+            return step(pslice, carry), None
+
+        out, _ = jax.lax.scan(body, xv, params_local)
+        return out
+
+    def forward(self, input_ids):
+        cfg = self.cfg
+        from ..tensor.creation import arange
+        from ..distributed.mesh import get_mesh
+        from ..distributed.pipeline import pipeline_apply
+
+        L = input_ids.shape[1]
+        pos = arange(L, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = x.astype(cfg.dtype)
+        mesh = get_mesh(create_default=False)
+        stacked_names = list(self._BLOCK_KEYS)
+        stacked_tensors = [self._parameters[k] for k in stacked_names]
+        n_micro = self.pp_microbatches
+
+        def run(xv, *pvals):
+            stacked = dict(zip(stacked_names, pvals))
+            if mesh is not None and mesh.shape.get("pp", 1) > 1:
+                return pipeline_apply(self._stage_fn, stacked, xv, n_micro, mesh=mesh)
+            return self._stage_fn(stacked, xv)
+
+        x = apply_op(run, x, *stacked_tensors)
+        x = self.ln_f(x)
+        logits = apply_op(
+            lambda h, e: jax.lax.dot_general(
+                h, e, (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32),
+            x, self.wte.weight)
+        return logits
