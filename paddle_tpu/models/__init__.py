@@ -12,3 +12,4 @@ from .gpt import (  # noqa: F401
     gpt_1p3b,
     gpt_tiny,
 )
+from .moe import GPTMoE, MoEConfig, MoEMLP, gpt_moe_tiny  # noqa: F401

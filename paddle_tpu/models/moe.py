@@ -1,0 +1,183 @@
+"""Mixture-of-Experts — reference python/paddle/incubate/distributed/models/moe
+(MoELayer: gate + per-rank experts + NCCL all_to_all dispatch).
+
+TPU-native (GShard recipe): experts live STACKED on an 'ep'-sharded leading
+dim; token dispatch/combine are einsums against a capacity-bounded one-hot
+dispatch tensor, so shapes stay static and XLA lowers the dispatch to
+all_to_all over ICI automatically. Top-2 gating with load-balance aux loss.
+"""
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.core import Tensor, apply_op
+from ..nn.initializer import Constant, Normal
+from ..nn.layer_base import Layer
+from .gpt import GPT, GPTBlock, GPTConfig, GPTPretrainingCriterion
+
+__all__ = ["MoEConfig", "MoEMLP", "GPTMoE", "gpt_moe_tiny"]
+
+
+@dataclasses.dataclass
+class MoEConfig(GPTConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    moe_every: int = 2  # every Nth block gets an MoE MLP
+
+
+def _moe_dispatch(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor):
+    """x: [T, H] tokens. Returns (y [T, H], aux_loss scalar).
+    Pure function — runs under jit/GSPMD; the E dim of w1/w2 is 'ep'-sharded.
+    """
+    T, H = x.shape
+    E = w1.shape[0]
+    C = max(1, int(capacity_factor * T * top_k / E))
+
+    logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k selection, one expert at a time (k small)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    dispatch = jnp.zeros((T, E, C), bool)
+    remaining = probs
+    # track per-expert slot usage across the k rounds
+    base_count = jnp.zeros((E,), jnp.int32)
+    aux_me = jnp.mean(probs, axis=0)  # mean gate prob per expert
+    frac_tokens = jnp.zeros((E,), jnp.float32)
+    for _ in range(top_k):
+        expert = jnp.argmax(remaining, axis=-1)              # [T]
+        gate = jnp.take_along_axis(remaining, expert[:, None], axis=1)[:, 0]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+        # position of each token within its expert's queue this round
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot + base_count[None, :]
+        pos = jnp.sum(pos_in_expert * onehot, axis=1)        # [T]
+        keep = pos < C
+        frac_tokens = frac_tokens + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        slot = jax.nn.one_hot(jnp.where(keep, pos, C), C + 1, dtype=jnp.float32)[:, :C]
+        contrib = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        combine = combine + gate[:, None, None] * contrib
+        dispatch = dispatch | (contrib > 0)
+        base_count = base_count + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+    # renormalize combine weights over selected experts
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+
+    aux = E * jnp.sum(aux_me * frac_tokens / top_k)
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)  # a2a here
+    h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w1.astype(x.dtype))
+                    + b1[:, None, :].astype(x.dtype), approximate=True)
+    expert_out = jnp.einsum("ecf,efh->ech", h, w2.astype(x.dtype)) \
+        + b2[:, None, :].astype(x.dtype)
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)  # a2a back
+    return y, aux.astype(jnp.float32)
+
+
+class MoEMLP(Layer):
+    """Drop-in MLP replacement: top-k routed experts over the 'ep' axis."""
+
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, f, E = cfg.hidden_size, cfg.ffn_hidden, cfg.num_experts
+        init = Normal(0.0, cfg.init_std)
+        out_init = Normal(0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers))
+        self.gate_w = self.create_parameter([h, E], default_initializer=init)
+        self.w1 = self.create_parameter([E, h, f], default_initializer=init)
+        self.w1.partition_spec = ("ep", None, "tp")
+        self.b1 = self.create_parameter([E, f], default_initializer=Constant(0.0))
+        self.b1.partition_spec = ("ep", "tp")
+        self.w2 = self.create_parameter([E, f, h], default_initializer=out_init)
+        self.w2.partition_spec = ("ep", "tp", None)
+        self.b2 = self.create_parameter([E, h], default_initializer=Constant(0.0))
+        self.b2.partition_spec = ("ep", None)
+        self.last_aux_loss = None
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, L, H = x.shape[0], x.shape[1], x.shape[2]
+        from ..tensor.manipulation import reshape
+        flat = reshape(x, [B * L, H])
+        out = apply_op(
+            lambda xv, gw, w1, b1, w2, b2: _moe_dispatch(
+                xv, gw, w1, b1, w2, b2, cfg.top_k, cfg.capacity_factor),
+            flat, self.gate_w, self.w1, self.b1, self.w2, self.b2)
+        y, aux = out
+        self.last_aux_loss = aux
+        return reshape(y, [B, L, H])
+
+
+class GPTMoEBlock(GPTBlock):
+    def __init__(self, cfg: MoEConfig, layer_idx: int):
+        super().__init__(cfg, layer_idx)
+        if layer_idx % cfg.moe_every == cfg.moe_every - 1:
+            # replace dense MLP with routed experts
+            del self.fc1
+            del self.fc2
+            self.moe = MoEMLP(cfg)
+        else:
+            self.moe = None
+
+    def forward(self, x):
+        from ..nn import functional as F
+        from ..tensor.manipulation import reshape
+        if self.moe is None:
+            return super().forward(x)
+        cfg = self.cfg
+        B, L = x.shape[0], x.shape[1]
+        res = x
+        y = self.ln1(x)
+        qkv = self.qkv(y)
+        qkv = reshape(qkv, [B, L, 3, cfg.num_heads, cfg.head_dim])
+        attn = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], is_causal=True,
+            dropout_p=cfg.dropout, training=self.training)
+        x = res + self.proj(reshape(attn, [B, L, cfg.hidden_size]))
+        return x + self.moe(self.ln2(x))
+
+
+class GPTMoE(GPT):
+    """GPT with routed-expert MLPs every `moe_every` blocks (reference
+    GPT-MoE recipe: PaddleNLP MoE + fleet expert parallel)."""
+
+    def __init__(self, cfg: MoEConfig):
+        Layer.__init__(self)
+        self.cfg = cfg
+        init = Normal(0.0, cfg.init_std)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wte.weight.partition_spec = ("tp", None)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTMoEBlock(cfg, i) for i in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def _run_block(self, block, x):
+        # no per-block remat here: MoE aux losses are read back from the
+        # blocks after forward, which must stay in the same trace
+        return block(x)
+
+    def aux_loss(self):
+        total = None
+        for b in self.blocks:
+            if getattr(b, "moe", None) is not None and b.moe.last_aux_loss is not None:
+                total = b.moe.last_aux_loss if total is None else total + b.moe.last_aux_loss
+        if total is None:
+            return Tensor(jnp.zeros((), jnp.float32))
+        return total * self.cfg.aux_loss_weight
+
+
+def gpt_moe_tiny(**kw):
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64, dtype="float32", num_experts=4, top_k=2,
+                remat=False)
+    base.update(kw)
+    return MoEConfig(**base)
