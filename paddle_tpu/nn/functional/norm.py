@@ -20,6 +20,12 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
     n_axes = len(ns)
 
+    if n_axes == 1 and weight is not None and bias is not None:
+        # fused Pallas path (falls back internally on odd shapes)
+        from ...ops.layer_norm import fused_layer_norm
+        return apply_op(lambda v, w, b: fused_layer_norm(v, w, b, epsilon),
+                        x, weight, bias)
+
     def _f(v, *rest):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
         x32 = v.astype(jnp.float32)
@@ -39,6 +45,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    if weight is not None:
+        from ...ops.layer_norm import fused_rms_norm
+        return apply_op(lambda v, w: fused_rms_norm(v, w, epsilon), x, weight)
+
     def _f(v, *rest):
         x32 = v.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)

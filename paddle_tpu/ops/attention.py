@@ -56,40 +56,42 @@ _BLOCK_K = 256
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * scale  # [bq, d]
-    bq = q.shape[0]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
+    bq, d = q.shape
     q_idx = pl.program_id(2)
 
     m = jnp.full((bq, 1), -1e30, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, q_ref.shape[-1]), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
 
     n_k = seq_k // block_k
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T  # [bq, bk]
+        k = k_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
         if causal:
             q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + p @ v
         return m_new, l_new, acc_new
 
     if causal:
-        # only blocks with k_start <= q_end participate
+        # only k-blocks at or before this q-block's end participate
         q_end = (q_idx + 1) * bq
         n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
         m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
     else:
         m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -97,9 +99,11 @@ def _flash(q, k, v, causal, scale):
     return _flash_fwd(q, k, v, causal, scale)
 
 
-def _flash_fwd_impl(q, k, v, causal, scale):
+def _flash_fwd_impl(q, k, v, causal, scale, interpret=None):
     from jax.experimental import pallas as pl
 
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     bq = min(_BLOCK_Q, Lq)
@@ -121,6 +125,7 @@ def _flash_fwd_impl(q, k, v, causal, scale):
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        interpret=interpret,
     )(qh, kh, vh)
     return jnp.swapaxes(out, 1, 2)
 

