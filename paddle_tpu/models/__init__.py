@@ -13,3 +13,17 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
 )
 from .moe import GPTMoE, MoEConfig, MoEMLP, gpt_moe_tiny  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    ErnieConfig,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
+from .generation import generate  # noqa: F401

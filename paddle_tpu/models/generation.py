@@ -1,0 +1,88 @@
+"""Autoregressive generation — role parity with PaddleNLP's
+generation_utils (greedy / sampling / top-k / top-p) on the reference side.
+
+TPU-first: prefill is one batched forward that fills the KV cache; the decode
+loop is a single lax.scan over steps (one compiled program, static shapes),
+sampling with explicit PRNG keys.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer_base import buffer_pytree, functional_call, state_pytree
+
+__all__ = ["generate"]
+
+
+def _sample(logits, key, temperature, top_k, top_p):
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+             top_p=1.0, eos_token_id=None, seed=0):
+    """Returns [B, L_in + max_new_tokens] token ids (greedy when
+    temperature=0). The full prefill+decode runs as two compiled programs."""
+    ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    B, L_in = ids.shape
+    max_len = L_in + max_new_tokens
+    assert max_len <= model.cfg.max_seq_len, "exceeds model max_seq_len"
+
+    params = state_pytree(model)
+    params.update(buffer_pytree(model))
+    model.eval()
+
+    def prefill(params, ids):
+        with functional_call(model, params):
+            cache = model.init_cache(B, max_len)
+            logits, cache = model(Tensor(ids), cache=cache, pos=0)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        return lv[:, -1], cache
+
+    def decode(params, cache, first_tok, key):
+        def step(carry, _):
+            cache, tok, p, key = carry
+            key, sub = jax.random.split(key)
+            with functional_call(model, params):
+                logits, cache = model(Tensor(tok[:, None]), cache=cache, pos=p)
+            lv = (logits._value if isinstance(logits, Tensor) else logits)[:, -1]
+            nxt = _sample(lv, sub, temperature, top_k, top_p).astype(jnp.int32)
+            return (cache, nxt, p + 1, key), nxt
+
+        key, sub = jax.random.split(key)
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (cache, first_tok, jnp.asarray(L_in, jnp.int32), key),
+            None, length=max_new_tokens - 1)
+        return toks
+
+    last_logits, cache = jax.jit(prefill)(params, ids)
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    first_tok = _sample(last_logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+    if max_new_tokens == 1:
+        out = jnp.concatenate([ids, first_tok[:, None]], axis=1)
+        return Tensor(out)
+    toks = jax.jit(decode)(params, cache, first_tok, key)
+    out = jnp.concatenate([ids, first_tok[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+    if eos_token_id is not None:
+        # mask everything after the first EOS with EOS (post-hoc, host-side)
+        gen = out[:, L_in:]
+        hit = jnp.cumsum((gen == eos_token_id).astype(jnp.int32), axis=1) > 0
+        prev_hit = jnp.pad(hit[:, :-1], ((0, 0), (1, 0)))
+        gen = jnp.where(prev_hit, eos_token_id, gen)
+        out = jnp.concatenate([out[:, :L_in], gen], axis=1)
+    return Tensor(out)

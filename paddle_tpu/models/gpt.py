@@ -80,7 +80,7 @@ class GPTBlock(Layer):
         self.fc2 = nn.Linear(cfg.ffn_hidden, h, weight_attr=nn.ParamAttr(initializer=out_init))
         self.fc2.weight.partition_spec = ("tp", None)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         cfg = self.cfg
         B, L = x.shape[0], x.shape[1]
         res = x
@@ -89,24 +89,58 @@ class GPTBlock(Layer):
         from ..tensor.manipulation import reshape
         qkv = reshape(qkv, [B, L, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        from ..distributed.mesh import get_mesh
-        mesh = get_mesh(create_default=False)
-        if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            # sequence parallel: exact ring attention over ICI ('sp' axis)
-            from ..ops.ring_attention import ring_attention
-            attn = apply_op(
-                lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
-                q, k, v)
+        if cache is not None:
+            attn, cache = self._attend_cached(q, k, v, cache, pos)
         else:
-            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                                  dropout_p=cfg.dropout,
-                                                  training=self.training)
+            from ..distributed.mesh import get_mesh
+            mesh = get_mesh(create_default=False)
+            if mesh is not None and mesh.shape.get("sp", 1) > 1:
+                # sequence parallel: exact ring attention over ICI ('sp' axis)
+                from ..ops.ring_attention import ring_attention
+                attn = apply_op(
+                    lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
+                    q, k, v)
+            else:
+                attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                      dropout_p=cfg.dropout,
+                                                      training=self.training)
         attn = reshape(attn, [B, L, cfg.hidden_size])
         x = res + self.proj(attn)
         res = x
         y = self.ln2(x)
         y = self.fc2(F.gelu(self.fc1(y), approximate=True))
-        return res + y
+        out = res + y
+        return out if cache is None else (out, cache)
+
+    def _attend_cached(self, q, k, v, cache, pos):
+        """Decode-time attention against a static KV buffer (lengths stay
+        compile-time constant; validity enforced by position mask)."""
+        import math as _math
+
+        def _f(qv, kv, vv, k_buf, v_buf, p):
+            k_buf = jax.lax.dynamic_update_slice(k_buf, kv.astype(k_buf.dtype),
+                                                 (0, p, 0, 0))
+            v_buf = jax.lax.dynamic_update_slice(v_buf, vv.astype(v_buf.dtype),
+                                                 (0, p, 0, 0))
+            Lq = qv.shape[1]
+            Lmax = k_buf.shape[1]
+            scale = 1.0 / _math.sqrt(qv.shape[-1])
+            qh = jnp.swapaxes(qv, 1, 2).astype(jnp.float32) * scale
+            kh = jnp.swapaxes(k_buf, 1, 2).astype(jnp.float32)
+            vh = jnp.swapaxes(v_buf, 1, 2).astype(jnp.float32)
+            s = qh @ jnp.swapaxes(kh, -1, -2)  # [B,H,Lq,Lmax]
+            q_pos = p + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lmax), 0)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lmax), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+            probs = jax.nn.softmax(s, axis=-1)
+            out = jnp.swapaxes(probs @ vh, 1, 2).astype(qv.dtype)
+            return out, k_buf, v_buf
+
+        pos_v = pos._value if isinstance(pos, Tensor) else pos
+        res = apply_op(lambda qv, kv, vv, kb, vb: _f(qv, kv, vv, kb, vb, pos_v),
+                       q, k, v, cache[0], cache[1])
+        out, k_buf, v_buf = res
+        return out, (k_buf, v_buf)
 
 
 class GPT(Layer):
@@ -145,22 +179,36 @@ class GPT(Layer):
 
         return apply_op(lambda xv, *pv: pure_block(list(pv), xv), x, *vals)
 
-    def forward(self, input_ids):
+    def init_cache(self, batch_size, max_len):
+        """Decode KV cache: per-block (k, v) buffers [B, max_len, H, D]."""
+        cfg = self.cfg
+        d = jnp.dtype(cfg.dtype)
+        shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, d), jnp.zeros(shape, d)) for _ in self.blocks]
+
+    def forward(self, input_ids, cache=None, pos=0):
         cfg = self.cfg
         B, L = input_ids.shape[0], input_ids.shape[1]
         from ..tensor.creation import arange
-        pos = arange(L, dtype="int32")
-        x = self.wte(input_ids) + self.wpe(pos)
+        positions = arange(L, dtype="int32") + pos if cache is not None \
+            else arange(L, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(positions)
         x = x.astype(cfg.dtype)
         # batch over data axes, sequence over 'sp' (GSPMD inserts the
         # gather/scatter collectives around attention when sp > 1)
         from ..distributed.sharding_utils import constraint
         from ..distributed.mesh import get_mesh
-        if get_mesh(create_default=False) is not None:
+        if cache is None and get_mesh(create_default=False) is not None:
             x = constraint(x, ("dp", "fsdp"), "sp", None)
         x = self.drop(x)
-        for block in self.blocks:
-            x = self._run_block(block, x)
+        if cache is not None:
+            new_cache = []
+            for block, c in zip(self.blocks, cache):
+                x, c = block(x, cache=c, pos=pos)
+                new_cache.append(c)
+        else:
+            for block in self.blocks:
+                x = self._run_block(block, x)
         x = self.ln_f(x)
         # tied head: [B,L,H] @ [H,V] — the big MXU matmul; fp32 accum via
         # preferred_element_type to keep loss numerics honest in bf16
@@ -172,7 +220,7 @@ class GPT(Layer):
                 x, self.wte.weight)
         else:
             logits = self.lm_head(x)
-        return logits
+        return logits if cache is None else (logits, new_cache)
 
 
 class GPTPretrainingCriterion(Layer):

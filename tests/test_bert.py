@@ -1,0 +1,63 @@
+"""BERT/ERNIE encoder tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    bert_tiny,
+)
+
+
+def _ids(bs=2, L=16, vocab=1024, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(1, vocab, (bs, L)).astype("int32"))
+
+
+def test_bert_model_shapes():
+    paddle.seed(0)
+    cfg = bert_tiny()
+    m = BertModel(cfg)
+    seq, pooled = m(_ids())
+    assert seq.shape == [2, 16, cfg.hidden_size]
+    assert pooled.shape == [2, cfg.hidden_size]
+
+
+def test_bert_attention_mask():
+    paddle.seed(0)
+    m = BertModel(bert_tiny())
+    m.eval()
+    ids = _ids()
+    mask = paddle.to_tensor(np.ones((2, 16), "float32"))
+    seq1, _ = m(ids, attention_mask=mask)
+    seq2, _ = m(ids)
+    np.testing.assert_allclose(seq1.numpy(), seq2.numpy(), atol=1e-5)
+
+
+def test_bert_pretraining_loss_decreases():
+    paddle.seed(0)
+    cfg = bert_tiny()
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    ids = _ids()
+    mlm_labels = _ids(seed=1)
+    nsp_labels = paddle.to_tensor(np.array([0, 1], "int32"))
+    losses = []
+    for _ in range(5):
+        mlm_logits, nsp_logits = model(ids)
+        loss = crit(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0]
+
+
+def test_bert_classifier():
+    paddle.seed(0)
+    m = BertForSequenceClassification(bert_tiny(), num_classes=3)
+    logits = m(_ids())
+    assert logits.shape == [2, 3]
