@@ -1,0 +1,142 @@
+"""Native runtime — C++ components behind ctypes, with pure-Python fallbacks.
+
+Reference counterpart: paddle/fluid's C++ reader/feeder machinery. First
+component: the token-stream loader feeding GPT pretraining (mmap + worker
+pool + prefetch ring, all off-GIL).
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["NativeTokenLoader", "PyTokenLoader", "TokenLoader", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "lib", "libptl_loader.so")
+_SRC = os.path.join(_HERE, "cxx", "data_loader.cpp")
+_lock = threading.Lock()
+_lib = None
+_build_err = None
+
+
+def _build():
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO_PATH]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _get_lib():
+    global _lib, _build_err
+    with _lock:
+        if _lib is not None or _build_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH) or \
+                    os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.ptl_open.restype = ctypes.c_void_p
+            lib.ptl_open.argtypes = [ctypes.c_char_p]
+            lib.ptl_num_tokens.restype = ctypes.c_int64
+            lib.ptl_num_tokens.argtypes = [ctypes.c_void_p]
+            lib.ptl_start.restype = ctypes.c_int
+            lib.ptl_start.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_uint64]
+            lib.ptl_next.restype = ctypes.c_int
+            lib.ptl_next.argtypes = [ctypes.c_void_p,
+                                     np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            lib.ptl_stop.argtypes = [ctypes.c_void_p]
+            lib.ptl_close.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:  # toolchain missing → python fallback
+            _build_err = e
+        return _lib
+
+
+def native_available():
+    return _get_lib() is not None
+
+
+class NativeTokenLoader:
+    """Endless sampler of [batch, seq+1] windows from a flat int32 token file
+    (C++ mmap + worker pool; batches appear without touching the GIL)."""
+
+    def __init__(self, path, batch_size, seq_len, num_workers=2,
+                 prefetch_depth=4, seed=0):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native loader unavailable: {_build_err}")
+        self._lib = lib
+        self._h = lib.ptl_open(os.fsencode(path))
+        if not self._h:
+            raise IOError(f"cannot open token file {path}")
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        rc = lib.ptl_start(self._h, batch_size, seq_len, num_workers,
+                           prefetch_depth, seed)
+        if rc != 0:
+            raise RuntimeError(f"ptl_start failed rc={rc}")
+
+    @property
+    def num_tokens(self):
+        return self._lib.ptl_num_tokens(self._h)
+
+    def next(self):
+        out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+        rc = self._lib.ptl_next(self._h, out)
+        if rc != 0:
+            raise RuntimeError("loader stopped")
+        return out
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self._h:
+            self._lib.ptl_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PyTokenLoader:
+    """numpy.memmap fallback with identical semantics."""
+
+    def __init__(self, path, batch_size, seq_len, num_workers=0,
+                 prefetch_depth=0, seed=0):
+        self.tokens = np.memmap(path, np.int32, "r")
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.rng = np.random.default_rng(seed)
+
+    @property
+    def num_tokens(self):
+        return self.tokens.shape[0]
+
+    def next(self):
+        n = self.seq_len + 1
+        starts = self.rng.integers(0, self.num_tokens - n, self.batch_size)
+        return np.stack([np.asarray(self.tokens[s:s + n]) for s in starts])
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        pass
+
+
+def TokenLoader(path, batch_size, seq_len, **kw):
+    """Native if the toolchain built the .so, else the python fallback."""
+    if native_available():
+        return NativeTokenLoader(path, batch_size, seq_len, **kw)
+    return PyTokenLoader(path, batch_size, seq_len, **kw)

@@ -1,0 +1,152 @@
+// Native data-loader runtime.
+//
+// Role parity: the reference's C++ reader/feeder stack
+// (paddle/fluid/operators/reader + DoubleBufferReader) — host-side batch
+// assembly off the Python GIL. TPU-native twist: the hot pretraining input is
+// a flat token stream; this library mmaps the token file, and a worker pool
+// fills a lock-guarded ring of ready [batch, seq+1] int32 batches that the
+// Python side copies out and device_puts while workers run ahead.
+//
+// C ABI (ctypes): ptl_open / ptl_start / ptl_next / ptl_stop / ptl_close.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Loader {
+  // mmap'd token file
+  int fd = -1;
+  const int32_t* tokens = nullptr;
+  size_t n_tokens = 0;
+  size_t map_len = 0;
+
+  // batch geometry
+  int64_t batch = 0;
+  int64_t seq = 0;
+
+  // prefetch ring
+  std::deque<std::vector<int32_t>> ready;
+  size_t capacity = 0;
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits
+  std::condition_variable cv_space;   // producers wait
+  std::vector<std::thread> workers;
+  std::atomic<bool> running{false};
+  uint64_t seed = 0;
+  std::atomic<uint64_t> batch_counter{0};
+};
+
+void worker_main(Loader* L, int wid) {
+  std::mt19937_64 rng(L->seed + 0x9e3779b97f4a7c15ULL * (wid + 1));
+  const int64_t sample_len = L->seq + 1;
+  while (L->running.load(std::memory_order_relaxed)) {
+    std::vector<int32_t> buf(static_cast<size_t>(L->batch) * sample_len);
+    const size_t max_start = L->n_tokens - sample_len;
+    for (int64_t b = 0; b < L->batch; ++b) {
+      size_t start = rng() % max_start;
+      std::memcpy(buf.data() + b * sample_len, L->tokens + start,
+                  sample_len * sizeof(int32_t));
+    }
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_space.wait(lk, [L] {
+      return !L->running.load() || L->ready.size() < L->capacity;
+    });
+    if (!L->running.load()) return;
+    L->ready.emplace_back(std::move(buf));
+    L->cv_ready.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptl_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < (long)sizeof(int32_t)) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mapped = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (mapped == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  ::madvise(mapped, st.st_size, MADV_RANDOM);
+  auto* L = new Loader();
+  L->fd = fd;
+  L->tokens = static_cast<const int32_t*>(mapped);
+  L->n_tokens = st.st_size / sizeof(int32_t);
+  L->map_len = st.st_size;
+  return L;
+}
+
+int64_t ptl_num_tokens(void* handle) {
+  return static_cast<Loader*>(handle)->n_tokens;
+}
+
+int ptl_start(void* handle, int64_t batch, int64_t seq, int n_workers,
+              int prefetch_depth, uint64_t seed) {
+  auto* L = static_cast<Loader*>(handle);
+  if (L->running.load()) return -1;
+  if ((size_t)(seq + 1) > L->n_tokens) return -2;
+  L->batch = batch;
+  L->seq = seq;
+  L->capacity = prefetch_depth > 0 ? prefetch_depth : 2;
+  L->seed = seed;
+  L->running.store(true);
+  for (int i = 0; i < (n_workers > 0 ? n_workers : 1); ++i)
+    L->workers.emplace_back(worker_main, L, i);
+  return 0;
+}
+
+// Copies one ready batch ([batch, seq+1] int32, row-major) into out.
+int ptl_next(void* handle, int32_t* out) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_ready.wait(lk, [L] { return !L->running.load() || !L->ready.empty(); });
+  if (L->ready.empty()) return -1;
+  std::vector<int32_t> buf = std::move(L->ready.front());
+  L->ready.pop_front();
+  L->cv_space.notify_one();
+  lk.unlock();
+  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  L->batch_counter.fetch_add(1);
+  return 0;
+}
+
+void ptl_stop(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  L->running.store(false);
+  L->cv_space.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers)
+    if (t.joinable()) t.join();
+  L->workers.clear();
+  std::lock_guard<std::mutex> lk(L->mu);
+  L->ready.clear();
+}
+
+void ptl_close(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  if (L->running.load()) ptl_stop(handle);
+  if (L->tokens) ::munmap(const_cast<int32_t*>(L->tokens), L->map_len);
+  if (L->fd >= 0) ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

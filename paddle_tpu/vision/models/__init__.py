@@ -1,6 +1,36 @@
-"""Vision models — reference python/paddle/vision/models/__init__.py.
-(alexnet/vgg/mobilenet/... land as the catalog widens; resnet + lenet first.)"""
+"""Vision models — reference python/paddle/vision/models/__init__.py."""
+from .alexnet import AlexNet, alexnet  # noqa: F401
 from .lenet import LeNet  # noqa: F401
+from .misc import (  # noqa: F401
+    DenseNet,
+    GoogLeNet,
+    InceptionV3,
+    ShuffleNetV2,
+    SqueezeNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    googlenet,
+    inception_v3,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+    squeezenet1_0,
+    squeezenet1_1,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
 from .resnet import (  # noqa: F401
     ResNet,
     resnet18,
@@ -11,3 +41,4 @@ from .resnet import (  # noqa: F401
     wide_resnet50_2,
     wide_resnet101_2,
 )
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,337 @@
+"""SqueezeNet, ShuffleNetV2, DenseNet, GoogLeNet, InceptionV3 — API of the
+corresponding reference python/paddle/vision/models/*.py files."""
+from ... import nn
+from ...nn import functional as F
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+           "GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+# ---------------------------------------------------------------------------
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.expand1 = nn.Conv2D(squeeze_c, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = F.relu(self.squeeze(x))
+        return concat([F.relu(self.expand1(x)), F.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+                nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+            x = flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    _cfgs = {0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+             1.0: [24, 116, 232, 464, 1024], 1.5: [24, 176, 352, 704, 1024],
+             2.0: [24, 244, 488, 976, 2048]}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chs = self._cfgs[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(chs[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = chs[0]
+        for i, reps in enumerate([4, 8, 4]):
+            out_c = chs[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, chs[4], 1, bias_attr=False),
+            nn.BatchNorm2D(chs[4]), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chs[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size, dropout):
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False))
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return concat([x, self.dropout(self.block(x))], axis=1)
+
+
+class DenseNet(nn.Layer):
+    _cfgs = {121: (64, 32, [6, 12, 24, 16]), 161: (96, 48, [6, 12, 36, 24]),
+             169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32])}
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        init_c, growth, blocks = self._cfgs[layers]
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
+        ch = init_c
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(blocks) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+# ---------------------------------------------------------------------------
+class _InceptionA(nn.Layer):
+    """GoogLeNet inception module (BN flavor)."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        def cbr(i, o, k, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, padding=p, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        self.b1 = cbr(in_c, c1, 1)
+        self.b3 = nn.Sequential(cbr(in_c, c3r, 1), cbr(c3r, c3, 3, 1))
+        self.b5 = nn.Sequential(cbr(in_c, c5r, 1), cbr(c5r, c5, 5, 2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, 1, padding=1), cbr(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        def cbr(i, o, k, s=1, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, stride=s, padding=p, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        self.stem = nn.Sequential(
+            cbr(3, 64, 7, 2, 3), nn.MaxPool2D(3, 2, padding=1),
+            cbr(64, 64, 1), cbr(64, 192, 3, 1, 1), nn.MaxPool2D(3, 2, padding=1))
+        self.inc3 = nn.Sequential(
+            _InceptionA(192, 64, 96, 128, 16, 32, 32),
+            _InceptionA(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc4 = nn.Sequential(
+            _InceptionA(480, 192, 96, 208, 16, 48, 64),
+            _InceptionA(512, 160, 112, 224, 24, 64, 64),
+            _InceptionA(512, 128, 128, 256, 24, 64, 64),
+            _InceptionA(512, 112, 144, 288, 32, 64, 64),
+            _InceptionA(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc5 = nn.Sequential(
+            _InceptionA(832, 256, 160, 320, 32, 128, 128),
+            _InceptionA(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        # reference returns (out, aux1, aux2); aux heads omitted → None
+        return x, None, None
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+class InceptionV3(nn.Layer):
+    """Simplified InceptionV3 trunk (stem + A/C blocks + classifier);
+    aux logits omitted."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        def cbr(i, o, k, s=1, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, stride=s, padding=p, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        self.stem = nn.Sequential(
+            cbr(3, 32, 3, 2), cbr(32, 32, 3), cbr(32, 64, 3, 1, 1),
+            nn.MaxPool2D(3, 2), cbr(64, 80, 1), cbr(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.mixed = nn.Sequential(
+            _InceptionA(192, 64, 48, 64, 64, 96, 32),
+            _InceptionA(256, 64, 48, 64, 64, 96, 64),
+            _InceptionA(288, 64, 48, 64, 64, 96, 64),
+            nn.MaxPool2D(3, 2),
+            _InceptionA(288, 192, 128, 192, 128, 192, 192),
+            _InceptionA(768, 192, 160, 192, 160, 192, 192),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
