@@ -67,6 +67,11 @@ if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
     from . import device  # noqa: F401,E402
     from . import regularizer  # noqa: F401,E402
     from . import profiler  # noqa: F401,E402
+    from . import linalg  # noqa: F401,E402
+    from . import text  # noqa: F401,E402
+    from . import hub  # noqa: F401,E402
+    from . import debug  # noqa: F401,E402
+    from . import models  # noqa: F401,E402
     from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
 
     flatten = tensor.manipulation.flatten  # keep function (not module) at top level

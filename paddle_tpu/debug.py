@@ -1,0 +1,71 @@
+"""Failure detection & numerics debugging.
+
+Reference counterpart: FLAGS_check_nan_inf + paddle/fluid/framework/details/
+nan_inf_utils (per-op NaN/Inf scan). TPU-native: a jit-compatible checker
+based on jax error-checking semantics — `check_numerics` inserts a device-side
+assert-like guard; `enable_check_nan_inf` flips a global that the Trainer and
+eager dispatch honor on loss/grads.
+"""
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor, apply_op
+
+__all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
+           "assert_finite_pytree", "TensorCheckerConfig"]
+
+_state = {"enabled": False}
+
+
+def enable_check_nan_inf(enable=True):
+    _state["enabled"] = bool(enable)
+
+
+def check_nan_inf_enabled():
+    return _state["enabled"]
+
+
+class TensorCheckerConfig:  # reference paddle.amp.debugging API parity
+    def __init__(self, enable=True, debug_mode=None, **kw):
+        self.enable = enable
+
+    def __enter__(self):
+        self._prev = _state["enabled"]
+        _state["enabled"] = self.enable
+        return self
+
+    def __exit__(self, *exc):
+        _state["enabled"] = self._prev
+        return False
+
+
+def check_numerics(x, name="tensor"):
+    """Returns x unchanged; poisons it to NaN-free guarantee by erroring the
+    step if non-finite values appear. Works inside jit via jnp.where +
+    debug check: non-finite → replaced with inf-signal that callers assert on
+    host; eagerly raises immediately."""
+    def _f(v):
+        finite = jnp.all(jnp.isfinite(v.astype(jnp.float32)))
+        # keep a data dependency so XLA can't DCE the check
+        return jax.lax.cond(finite, lambda t: t,
+                            lambda t: t * jnp.float32(jnp.nan).astype(t.dtype), v)
+    if isinstance(x, Tensor):
+        out = apply_op(_f, x)
+        if isinstance(out._value, jax.Array):
+            import numpy as np
+            if not np.isfinite(np.asarray(out._value.astype(jnp.float32))).all():
+                raise FloatingPointError(f"non-finite values detected in {name}")
+        return out
+    return _f(x)
+
+
+def assert_finite_pytree(tree, name="pytree"):
+    """Host-side assertion over a pytree of concrete arrays (post-step)."""
+    import numpy as np
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf._value if isinstance(leaf, Tensor) else leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad[:8]}")

