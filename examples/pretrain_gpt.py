@@ -1,0 +1,95 @@
+"""GPT pretraining recipe — the paddle_tpu rendering of the reference's
+PaddleNLP gpt-3 + fleet run scripts.
+
+Usage (synthetic data):
+    python examples/pretrain_gpt.py --config gpt_125m --steps 50
+With a token file (flat int32 binary):
+    python examples/pretrain_gpt.py --data tokens.bin --config gpt_1p3b
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="gpt_125m")
+    ap.add_argument("--data", default=None, help="flat int32 token file")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup", type=int, default=100)
+    ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.models import GPT, GPTPretrainingCriterion
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.runtime import TokenLoader
+
+    n_dev = len(jax.devices())
+    dp = args.dp or n_dev // (args.tp * args.fsdp)
+    build_mesh(dp=dp, tp=args.tp, fsdp=args.fsdp)
+
+    cfg = getattr(gpt_mod, args.config)(max_seq_len=args.seq)
+    paddle.seed(0)
+    model = GPT(cfg)
+    model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    sched = paddle.optimizer.lr.LinearWarmup(
+        paddle.optimizer.lr.CosineAnnealingDecay(args.lr, args.steps),
+        args.warmup, 0.0, args.lr)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=sched, weight_decay=0.1,
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+        accumulator_dtype="bfloat16")
+
+    def loss_fn(m, batch):
+        logits = m(paddle.to_tensor(batch["input_ids"]))
+        return crit(logits, paddle.to_tensor(batch["labels"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    mgr = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
+
+    if args.data:
+        loader = TokenLoader(args.data, args.batch, args.seq)
+        def batches():
+            for window in loader:
+                yield {"input_ids": window[:, :-1], "labels": window[:, 1:]}
+    else:
+        rng = np.random.RandomState(0)
+        def batches():
+            while True:
+                ids = rng.randint(0, cfg.vocab_size, (args.batch, args.seq + 1))
+                yield {"input_ids": ids[:, :-1].astype("int32"),
+                       "labels": ids[:, 1:].astype("int32")}
+
+    t0 = time.time()
+    for step, batch in enumerate(batches()):
+        if step >= args.steps:
+            break
+        loss = trainer.step(batch)
+        if step % 10 == 0:
+            dt = time.time() - t0
+            tok_s = args.batch * args.seq * (step + 1) / max(dt, 1e-9)
+            print(f"step {step}: loss={float(loss):.4f} "
+                  f"({tok_s:.0f} tok/s, lr={opt.get_lr():.2e})")
+        if mgr and step and step % 100 == 0:
+            trainer.sync_to_model()
+            mgr.save(step, {"model": model.state_dict(),
+                            "opt": opt.state_dict(), "step": step})
+    print(f"done: {args.steps} steps in {time.time()-t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

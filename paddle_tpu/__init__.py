@@ -72,9 +72,45 @@ if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
     from . import hub  # noqa: F401,E402
     from . import debug  # noqa: F401,E402
     from . import models  # noqa: F401,E402
+    from . import utils  # noqa: F401,E402
+    from .hapi import callbacks  # noqa: F401,E402
     from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
 
     flatten = tensor.manipulation.flatten  # keep function (not module) at top level
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (reference python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def get_flags(flags):
+    names = flags if isinstance(flags, (list, tuple)) else [flags]
+    return {n: _FLAGS.get(n) for n in names}
+
+
+def set_flags(flags):
+    _FLAGS.update(flags)
+
+
+_FLAGS = {}
+
+
+def disable_signal_handler():
+    pass
+
+
+version = type("version", (), {"full_version": __version__,
+                               "commit": "tpu-native", "istaged": True})
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

@@ -1,0 +1,74 @@
+"""paddle_tpu.utils — reference python/paddle/utils (deprecated decorator,
+unique_name, download stub, try_import, flops helper lives in hapi)."""
+import functools
+import importlib
+import threading
+import warnings
+
+__all__ = ["deprecated", "try_import", "unique_name", "run_check", "download"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}; {reason} "
+                f"{'use ' + update_to if update_to else ''}",
+                DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return wrapper
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required") from e
+
+
+class _UniqueName:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            saved = dict(self._counters)
+            try:
+                yield
+            finally:
+                self._counters = saved
+        return ctx()
+
+
+unique_name = _UniqueName()
+
+
+def run_check():
+    """paddle.utils.run_check parity: verifies the accelerator works."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    (x @ x).block_until_ready()
+    print(f"paddle_tpu is installed successfully! device(s): {devs}")
+    return True
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise NotImplementedError(
+            "zero-egress environment: place weights locally and load with "
+            "set_state_dict / paddle.load")
