@@ -1,0 +1,56 @@
+"""Elastic training hooks — reference python/paddle/distributed/elastic.
+
+JAX's single-controller model restarts whole processes rather than patching
+collectives mid-flight; elasticity = checkpoint-resume. This module provides
+the watch/trigger surface: a heartbeat file + resume helper that pairs with
+incubate.checkpoint.CheckpointManager.
+"""
+import json
+import os
+import signal
+import time
+
+__all__ = ["ElasticManager", "enable_elastic", "launch_elastic"]
+
+
+class ElasticManager:
+    def __init__(self, checkpoint_dir, heartbeat_path=None, interval_s=30):
+        self.checkpoint_dir = checkpoint_dir
+        self.heartbeat_path = heartbeat_path or os.path.join(checkpoint_dir, "heartbeat.json")
+        self.interval_s = interval_s
+        self._last_beat = 0.0
+        self._should_exit = False
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._should_exit = True
+
+    def heartbeat(self, step, extra=None):
+        now = time.time()
+        if now - self._last_beat < self.interval_s:
+            return
+        self._last_beat = now
+        os.makedirs(os.path.dirname(self.heartbeat_path), exist_ok=True)
+        tmp = self.heartbeat_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "ts": now, **(extra or {})}, f)
+        os.replace(tmp, self.heartbeat_path)
+
+    @property
+    def should_exit(self):
+        return self._should_exit
+
+    def resume_step(self):
+        """Latest checkpointed step (or None) to resume from after restart."""
+        from ..incubate.checkpoint import CheckpointManager
+        return CheckpointManager(self.checkpoint_dir).latest_step()
+
+
+def enable_elastic(args=None, distribute_mode=None):
+    return None
+
+
+def launch_elastic(*a, **k):
+    raise NotImplementedError(
+        "run under an external supervisor (k8s/systemd restart) + "
+        "ElasticManager heartbeat/resume")

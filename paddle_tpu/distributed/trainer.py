@@ -40,11 +40,13 @@ class Trainer:
         loss = trainer.step(batch)                      # batch: dict of arrays
     """
 
-    def __init__(self, model, optimizer, loss_fn, mesh=None, donate=True):
+    def __init__(self, model, optimizer, loss_fn, mesh=None, donate=True,
+                 grad_accum_steps=1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or get_mesh()
+        self.grad_accum_steps = grad_accum_steps
         self._plan = plan_shardings(model, self.mesh)
 
         trainable, consts = {}, {}
@@ -62,16 +64,36 @@ class Trainer:
 
     def _build(self, donate):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
-        consts_keys = tuple(self.consts)
+        accum = self.grad_accum_steps
+
+        def compute_loss(p, consts, batch):
+            with functional_call(model, {**p, **consts}):
+                loss = loss_fn(model, batch)
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv.astype(jnp.float32)
 
         def step(params, opt_state, consts, lr, batch):
-            def compute_loss(p):
-                with functional_call(model, {**p, **consts}):
-                    loss = loss_fn(model, batch)
-                lv = loss._value if isinstance(loss, Tensor) else loss
-                return lv.astype(jnp.float32)
+            if accum <= 1:
+                loss_v, grads = jax.value_and_grad(compute_loss)(params, consts, batch)
+            else:
+                # gradient merge (reference DistributedStrategy.gradient_merge):
+                # microbatch scan accumulating mean grads before ONE update
+                micro = jax.tree_util.tree_map(
+                    lambda v: v.reshape((accum, v.shape[0] // accum) + v.shape[1:]),
+                    batch)
 
-            loss_v, grads = jax.value_and_grad(compute_loss)(params)
+                def body(carry, mb):
+                    loss_acc, grad_acc = carry
+                    lv, g = jax.value_and_grad(compute_loss)(params, consts, mb)
+                    grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, g)
+                    return (loss_acc + lv, grad_acc), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), params)
+                (loss_sum, grad_sum), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zeros), micro)
+                loss_v = loss_sum / accum
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grad_sum)
             new_params, new_state = optimizer.apply_gradients_pytree(
                 params, grads, opt_state, lr)
             return new_params, new_state, loss_v

@@ -1,0 +1,14 @@
+"""paddle_tpu.onnx — reference python/paddle/onnx/export.py.
+The TPU-native exchange format is StableHLO (jit.save emits it); ONNX export
+would need onnx (not in this image)."""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "onnx is not available in this environment; use paddle_tpu.jit.save "
+            "which exports StableHLO (portable across XLA runtimes)") from None

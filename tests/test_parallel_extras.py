@@ -84,3 +84,38 @@ def test_inference_predictor():
     (out,) = pred.run([x])
     np.testing.assert_allclose(out.numpy(), x @ m.weight.numpy() + m.bias.numpy(),
                                rtol=1e-5)
+
+
+def test_grad_accum_matches_full_batch():
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import GPT, GPTConfig, GPTPretrainingCriterion
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+                    max_seq_len=16, dtype="float32", remat=False)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 17))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(m, b):
+        return crit(m(paddle.to_tensor(b["input_ids"])), paddle.to_tensor(b["labels"]))
+
+    results = {}
+    for accum in (1, 4):
+        paddle.seed(9)
+        build_mesh(dp=1)
+        model = GPT(cfg)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        tr = Trainer(model, opt, loss_fn, grad_accum_steps=accum)
+        results[accum] = [float(tr.step(batch)) for _ in range(3)]
+    np.testing.assert_allclose(results[1], results[4], rtol=1e-4)
+
+
+def test_group_sharded_parallel():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    build_mesh(fsdp=8)
+    paddle.seed(0)
+    m = nn.Linear(64, 256)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    m2, opt2 = group_sharded_parallel(m, opt)
+    assert len(m2.weight._value.sharding.device_set) == 8
