@@ -40,6 +40,16 @@ from .framework import (  # noqa: F401
     set_rng_state,
     uint8,
 )
+from .framework import (  # noqa: F401
+    create_parameter,
+    enable_static,
+    in_dygraph_mode,
+    set_grad_enabled,
+    set_printoptions,
+)
+from .framework.device import CUDAPinnedPlace, NPUPlace  # noqa: F401
+from .framework.random import get_rng_state as get_cuda_rng_state  # noqa: F401
+from .framework.random import set_rng_state as set_cuda_rng_state  # noqa: F401
 from .framework.core import to_tensor  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .autograd import grad  # noqa: F401
@@ -75,8 +85,37 @@ if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
     from . import utils  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402
     from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
+    from .nn.layer_base import ParamAttr  # noqa: F401,E402
+    from .distributed.parallel import DataParallel  # noqa: F401,E402
 
     flatten = tensor.manipulation.flatten  # keep function (not module) at top level
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count the forward FLOPs of a model (reference python/paddle/hapi/
+    dynamic_flops.py). Uses jax's cost analysis on the traced forward — the
+    XLA-native answer rather than per-layer hooks."""
+    import numpy as _np
+    import jax as _jax
+    from .framework.core import Tensor as _T
+
+    x = _np.zeros(input_size, _np.float32)
+
+    def fwd(v):
+        out = net(_T(v))
+        return out._value if isinstance(out, _T) else out
+
+    try:
+        lowered = _jax.jit(fwd).lower(x)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        total = int(cost.get("flops", 0))
+    except Exception:
+        total = 0
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
 
 
 def batch(reader, batch_size, drop_last=False):

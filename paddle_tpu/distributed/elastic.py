@@ -20,10 +20,17 @@ class ElasticManager:
         self.interval_s = interval_s
         self._last_beat = 0.0
         self._should_exit = False
-        signal.signal(signal.SIGTERM, self._on_term)
+        self._prev_term = None
+        # signal.signal only works on the main thread; chain any existing
+        # handler rather than clobbering a launcher's own shutdown hook.
+        import threading
+        if threading.current_thread() is threading.main_thread():
+            self._prev_term = signal.signal(signal.SIGTERM, self._on_term)
 
     def _on_term(self, signum, frame):
         self._should_exit = True
+        if callable(self._prev_term):
+            self._prev_term(signum, frame)
 
     def heartbeat(self, step, extra=None):
         now = time.time()

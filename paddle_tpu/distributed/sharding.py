@@ -18,11 +18,19 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
                            sync_comm=False):
     mesh = get_mesh(create_default=False)
-    if mesh is None or mesh.shape.get("fsdp", 1) == 1:
+    if mesh is None:
         import jax
         build_mesh(fsdp=len(jax.devices()))
+    elif mesh.shape.get("fsdp", 1) == 1:
+        # An app-built mesh exists but has no fsdp axis: replacing it would
+        # invalidate placements already made against it, so keep it and warn.
+        import warnings
+        warnings.warn(
+            "group_sharded_parallel: current mesh has fsdp=1; parameters "
+            "stay replicated. Call build_mesh(fsdp=N) before "
+            "group_sharded_parallel to shard over N devices.")
     shard_params(model)
-    return (model, optimizer, scaler) if scaler is not None else (model, optimizer)
+    return model, optimizer, scaler
 
 
 def save_group_sharded_model(model, output, optimizer=None):

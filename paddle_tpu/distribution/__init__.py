@@ -10,7 +10,11 @@ from ..framework.random import next_key
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
            "Dirichlet", "Multinomial", "ExponentialFamily", "Independent",
-           "TransformedDistribution", "kl_divergence", "register_kl"]
+           "TransformedDistribution", "kl_divergence", "register_kl",
+           "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
 
 
 def _val(x):
@@ -319,3 +323,10 @@ def _kl_dirichlet(p, q):
     t = (gl(a0) - jnp.sum(gl(a), -1) - gl(jnp.sum(b, -1)) + jnp.sum(gl(b), -1)
          + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
     return Tensor(t)
+
+
+from .transform import (  # noqa: E402
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
+from . import transform  # noqa: E402

@@ -1,0 +1,425 @@
+"""Bijective transforms for distributions.
+
+API parity with reference python/paddle/distribution/transform.py (class
+names, forward/inverse/log-det-jacobian/shape methods). Implementation is
+jnp-native so transforms compose under jax.jit and autodiff.
+"""
+import enum
+import functools
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        from . import Distribution, TransformedDistribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    def forward(self, x):
+        return Tensor(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._call_forward_log_det_jacobian(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(self._call_inverse_log_det_jacobian(_val(y)))
+
+    def forward_shape(self, shape):
+        return self._forward_shape(tuple(shape))
+
+    def inverse_shape(self, shape):
+        return self._inverse_shape(tuple(shape))
+
+    # -- overridable raw-array hooks -------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _call_forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self._forward(x))
+        raise NotImplementedError
+
+    def _call_inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self._inverse(y))
+        raise NotImplementedError
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return -y, y
+
+    def _inverse_log_det_jacobian(self, y):
+        zero = jnp.zeros_like(y)
+        return zero, zero
+
+    def inverse(self, y):
+        lo, hi = self._inverse(_val(y))
+        return Tensor(lo), Tensor(hi)
+
+    def inverse_log_det_jacobian(self, y):
+        lo, hi = self._inverse_log_det_jacobian(_val(y))
+        return Tensor(lo), Tensor(hi)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _val(loc)
+        self._scale = _val(scale)
+
+    @property
+    def loc(self):
+        return Tensor(self._loc)
+
+    @property
+    def scale(self):
+        return Tensor(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), x.shape)
+
+    def _forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(
+            shape, self._loc.shape, self._scale.shape))
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _call_forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_rank = 0
+        for t in self.transforms:
+            value += _sum_rightmost(
+                t._call_forward_log_det_jacobian(x), event_rank)
+            x = t._forward(x)
+        return value
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t._forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t._inverse_shape(shape)
+        return shape
+
+
+def _sum_rightmost(value, n):
+    return value.sum(axis=tuple(range(-n, 0))) if n > 0 else value
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _call_forward_log_det_jacobian(self, x):
+        return _sum_rightmost(
+            self._base._call_forward_log_det_jacobian(x),
+            self._reinterpreted_batch_rank)
+
+    def _forward_shape(self, shape):
+        return self._base._forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base._inverse_shape(shape)
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _val(power)
+
+    @property
+    def power(self):
+        return Tensor(self._power)
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(x, self._power - 1)))
+
+    def _forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(shape, self._power.shape))
+
+    _inverse_shape = _forward_shape
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        in_event_shape = tuple(in_event_shape)
+        out_event_shape = tuple(out_event_shape)
+        if (functools.reduce(operator.mul, in_event_shape, 1)
+                != functools.reduce(operator.mul, out_event_shape, 1)):
+            raise ValueError("in/out event sizes must match")
+        self._in_event_shape = in_event_shape
+        self._out_event_shape = out_event_shape
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    def _forward(self, x):
+        n = len(self._in_event_shape)
+        batch = x.shape[:x.ndim - n] if n else x.shape
+        return x.reshape(batch + self._out_event_shape)
+
+    def _inverse(self, y):
+        n = len(self._out_event_shape)
+        batch = y.shape[:y.ndim - n] if n else y.shape
+        return y.reshape(batch + self._in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        n = len(self._in_event_shape)
+        batch = x.shape[:x.ndim - n] if n else x.shape
+        return jnp.zeros(batch, x.dtype)
+
+    def _forward_shape(self, shape):
+        n = len(self._in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._in_event_shape:
+            raise ValueError("shape mismatch for ReshapeTransform")
+        return tuple(shape[:len(shape) - n]) + self._out_event_shape
+
+    def _inverse_shape(self, shape):
+        n = len(self._out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._out_event_shape:
+            raise ValueError("shape mismatch for ReshapeTransform")
+        return tuple(shape[:len(shape) - n]) + self._in_event_shape
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        x = jnp.exp(x - jnp.max(x, axis=-1, keepdims=True))
+        return x / x.sum(axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("input must have rank >= 1")
+        return shape
+
+    _inverse_shape = _forward_shape
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self._axis)
+                for s in jnp.split(x, len(self._transforms), axis=self._axis)]
+
+    def _forward(self, x):
+        return jnp.stack(
+            [t._forward(s) for t, s in zip(self._transforms, self._split(x))],
+            axis=self._axis)
+
+    def _inverse(self, y):
+        return jnp.stack(
+            [t._inverse(s) for t, s in zip(self._transforms, self._split(y))],
+            axis=self._axis)
+
+    def _call_forward_log_det_jacobian(self, x):
+        return jnp.stack(
+            [t._call_forward_log_det_jacobian(s)
+             for t, s in zip(self._transforms, self._split(x))],
+            axis=self._axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K via the stick-breaking construction."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        z_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, 1)]
+        return (jnp.pad(z, pad, constant_values=1.0)
+                * jnp.pad(z_cumprod, [(0, 0)] * (x.ndim - 1) + [(1, 0)],
+                          constant_values=1.0))
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = jnp.arange(y_crop.shape[-1], 0, -1, dtype=y.dtype)
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.concatenate(
+            [jnp.ones_like(y_crop[..., :1]), sf[..., :-1]], axis=-1)
+        z = y_crop / sf
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        y = self._forward(x)
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        xs = x - jnp.log(offset)
+        return jnp.sum(-xs + jax.nn.log_sigmoid(xs) + jnp.log(y[..., :-1]),
+                       axis=-1)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError("input must have rank >= 1")
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape:
+            raise ValueError("input must have rank >= 1")
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log|d tanh(x)/dx| = log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))

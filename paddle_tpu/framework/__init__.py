@@ -40,14 +40,91 @@ __all__ = [
 ]
 
 
+_static_mode = [False]
+
+
 def in_dynamic_mode():
-    return True
+    return not _static_mode[0]
+
+
+def in_dygraph_mode():
+    return not _static_mode[0]
 
 
 def disable_static():
-    pass
+    _static_mode[0] = False
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled execution")
+    """Switch to static-graph mode: paddle_tpu.static.data placeholders +
+    Executor.run compile the whole fetched graph as one XLA program."""
+    _static_mode[0] = True
+
+
+class set_grad_enabled:
+    """Mirror paddle.set_grad_enabled(mode): applies immediately on call
+    (statement form) AND works as a context manager that restores the
+    previous mode on exit."""
+
+    def __init__(self, mode):
+        from .core import _tape
+        self.mode = True if mode else False  # builtin bool is shadowed by the dtype
+        t = _tape()
+        self._prev = t.enabled
+        t.enabled = self.mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        from .core import _tape
+        _tape().enabled = self._prev
+        return False
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level parameter factory (reference python/paddle/framework →
+    fluid layers.create_parameter)."""
+    import jax.numpy as jnp
+    from .core import Parameter
+    from .dtype import dtype as _dt
+    import numpy as _np
+    shape = tuple(int(s) for s in shape)
+    if default_initializer is not None:
+        p = Parameter(jnp.zeros(shape, _dt(dtype)), name=name)
+        default_initializer(p)
+        return p
+    if is_bias:
+        val = jnp.zeros(shape, _dt(dtype))
+    else:
+        fan_in = shape[0] if shape else 1
+        limit = float(_np.sqrt(6.0 / max(1, fan_in)))
+        from .random import next_key
+        import jax as _jax
+        val = _jax.random.uniform(next_key(), shape, _dt(dtype), -limit, limit)
+    return Parameter(val, name=name)
+
+
+_printoptions = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+                 "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Mirror paddle.set_printoptions by configuring numpy's printer (our
+    Tensor repr prints via numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        _printoptions["precision"] = kw["precision"] = int(precision)
+    if threshold is not None:
+        _printoptions["threshold"] = kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _printoptions["edgeitems"] = kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _printoptions["linewidth"] = kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        _printoptions["sci_mode"] = sci_mode
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)

@@ -138,7 +138,12 @@ def apply_op(fn, *args, **kwargs):
 
     `fn` must be pure: positional args may be arrays (differentiable),
     kwargs are static configuration. Multi-output fns return tuples.
+
+    If any input is a SymbolicVar (static-graph mode), the op is deferred
+    into the graph instead of executed.
     """
+    if any(type(a) is SymbolicVar for a in args):
+        return _defer_symbolic(fn, args, kwargs)
     tape = _tape()
     raw = []
     diff_idx = []
@@ -485,3 +490,67 @@ def _unflatten(aux, children):
 
 jax.tree_util.register_pytree_node(Tensor, _flatten, _unflatten)
 jax.tree_util.register_pytree_node(Parameter, _flatten, _unflatten)
+
+
+class _SymOp:
+    """One deferred op in a static graph (symbolic trace node)."""
+
+    __slots__ = ("fn", "args", "kwargs", "n_out")
+
+    def __init__(self, fn, args, kwargs, n_out):
+        self.fn = fn
+        self.args = args      # mix of SymbolicVar / Tensor (captured) / consts
+        self.kwargs = kwargs
+        self.n_out = n_out    # None for single output, else tuple arity
+
+
+class SymbolicVar(Tensor):
+    """Static-graph variable (≈ reference fluid.framework.Variable).
+
+    Holds no data — only a ShapeDtypeStruct aval plus either a feed name
+    (placeholder from static.data) or the _SymOp that produces it. The
+    Executor evaluates the op DAG under jax.jit; see paddle_tpu/static.
+    """
+
+    __slots__ = ("_feed_name", "_sym_op", "_out_index", "_declared_shape")
+
+    def __init__(self, aval, feed_name=None, op=None, out_index=None, name=None):
+        self._value = aval  # ShapeDtypeStruct: .shape/.dtype/.ndim still work
+        self.stop_gradient = True
+        self.grad = None
+        self._producer = None
+        self.name = name or feed_name
+        self.persistable = False
+        self._feed_name = feed_name
+        self._sym_op = op
+        self._out_index = out_index
+        self._declared_shape = None  # holds -1 dynamic dims for .shape parity
+
+    @property
+    def shape(self):
+        if self._declared_shape is not None:
+            return list(self._declared_shape)
+        return list(self._value.shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static mode); fetch it via "
+            "Executor.run(feed=..., fetch_list=[...]) to get a value")
+
+    item = numpy
+    tolist = numpy
+
+    def __repr__(self):
+        return (f"SymbolicVar(name={self.name}, shape={list(self._value.shape)}, "
+                f"dtype={self._value.dtype})")
+
+
+def _defer_symbolic(fn, args, kwargs):
+    """apply_op path when any input is symbolic: record, don't execute."""
+    avals = [a._value if isinstance(a, Tensor) else a for a in args]
+    out_aval = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *avals)
+    if isinstance(out_aval, (tuple, list)):
+        op = _SymOp(fn, args, kwargs, len(out_aval))
+        outs = [SymbolicVar(av, op=op, out_index=i) for i, av in enumerate(out_aval)]
+        return type(out_aval)(outs) if isinstance(out_aval, tuple) else outs
+    return SymbolicVar(out_aval, op=_SymOp(fn, args, kwargs, None))

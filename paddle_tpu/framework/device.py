@@ -31,6 +31,21 @@ class CPUPlace:
 # Aliases so code written against the CUDA reference maps over.
 CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
+NPUPlace = TPUPlace
+MLUPlace = TPUPlace
+IPUPlace = TPUPlace
+
+
+class CUDAPinnedPlace:
+    """Host-pinned staging memory place. On TPU, host buffers handed to
+    jax.device_put are already staged through pinned memory; this is an
+    API-parity handle (reference fluid CUDAPinnedPlace)."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace()"
+
+    def __eq__(self, other):
+        return isinstance(other, CUDAPinnedPlace)
 
 _current = [None]  # lazily resolved default device string
 

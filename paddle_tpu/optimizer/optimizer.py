@@ -127,6 +127,13 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..framework.core import SymbolicVar
+        if isinstance(loss, SymbolicVar):
+            # static mode: register a train spec; Executor.run differentiates
+            # the fetched graph and applies this optimizer's update.
+            from .. import static
+            static._register_minimize(loss, self)
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

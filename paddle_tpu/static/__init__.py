@@ -1,33 +1,71 @@
-"""paddle_tpu.static — static-graph façade (reference python/paddle/static).
+"""paddle_tpu.static — working static-graph mode over XLA.
 
-The reference's Program/Executor machinery is replaced by XLA compilation:
-a "Program" here is a traced, jit-compiled callable. The façade keeps the
-most-used static APIs importable so reference-style scripts run.
+The reference's Program/Executor machinery (python/paddle/static,
+paddle/fluid/framework Program + fluid/executor.cc) is rebuilt TPU-first:
+`static.data` creates SymbolicVar placeholders, every paddle op applied to
+one records a deferred node (see framework.core._defer_symbolic) instead of
+executing, and `Executor.run` evaluates the fetched sub-graph as ONE
+jit-compiled XLA program (cached per feed signature). `optimizer.minimize`
+on a symbolic loss registers a train spec: Executor.run then computes the
+loss, differentiates it w.r.t. every trainable parameter captured in the
+graph (jax.value_and_grad), and applies the optimizer update.
+
+No op-by-op interpreter, no Program protobuf: XLA *is* the executor.
 """
 import jax
+import jax.numpy as jnp
+import numpy as np
 
-from ..framework.core import Tensor
+from ..framework.dtype import dtype as _as_dtype
+from ..framework.core import SymbolicVar, Tensor, _pause_tape
 from .input_spec import InputSpec  # noqa: F401
 
 __all__ = ["InputSpec", "data", "Program", "Executor", "default_main_program",
-           "default_startup_program", "name_scope", "py_func", "save", "load"]
+           "default_startup_program", "name_scope", "py_func", "save", "load",
+           "gradients", "append_backward", "global_scope", "scope_guard",
+           "cpu_places", "cuda_places"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Create a feed placeholder (reference python/paddle/static/input.py).
+
+    Dims given as -1/0 are dynamic: `.shape` reports -1 (paddle semantics,
+    so build-time code like reshape(x, [x.shape[0], ...]) records -1 and
+    stays batch-polymorphic), while the tracing aval uses 1. Run-time shapes
+    come from the actual feed arrays, so any batch size can be fed.
+    """
+    declared = tuple(int(s) for s in shape)
+    concrete = tuple(s if s > 0 else 1 for s in declared)
+    aval = jax.ShapeDtypeStruct(concrete, _as_dtype(dtype))
+    var = SymbolicVar(aval, feed_name=name)
+    if any(s <= 0 for s in declared):
+        var._declared_shape = [s if s > 0 else -1 for s in declared]
+    _main._feeds[name] = var
+    return var
 
 
 class Program:
-    """Placeholder graph container; real compilation happens via jax.jit."""
+    """Graph container; actual compilation happens in Executor.run."""
 
     def __init__(self):
-        self._ops = []
+        self._feeds = {}
+        self._train_specs = {}   # id(loss var) -> (loss var, optimizer)
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return self
+        if not for_test:
+            return self
+        # Test clone shares the graph but drops train specs so Executor.run
+        # on it never applies optimizer updates (reference Program.clone
+        # strips backward/optimize ops when for_test=True).
+        test = Program()
+        test._feeds = self._feeds
+        return test
+
+    def all_parameters(self):
+        return []
 
 
 _main = Program()
@@ -42,14 +80,245 @@ def default_startup_program():
     return _startup
 
 
+def _register_minimize(loss, optimizer):
+    """Called by Optimizer.minimize when the loss is symbolic."""
+    _main._train_specs[id(loss)] = (loss, optimizer)
+
+
+def _toposort(fetch_vars):
+    """Iterative post-order over the SymbolicVar DAG.
+
+    Returns (ordered vars, feed names in deterministic order, captured
+    concrete Tensors in deterministic order).
+    """
+    order, feeds, consts = [], [], []
+    seen_v, seen_c = set(), set()
+    stack = [(v, False) for v in reversed(fetch_vars) if isinstance(v, SymbolicVar)]
+    while stack:
+        var, done = stack.pop()
+        if done:
+            order.append(var)
+            continue
+        if id(var) in seen_v:
+            continue
+        seen_v.add(id(var))
+        stack.append((var, True))
+        if var._feed_name is not None:
+            if var._feed_name not in feeds:
+                feeds.append(var._feed_name)
+            continue
+        if var._sym_op is None:
+            raise ValueError(f"symbolic var {var.name} has no producer or feed")
+        for a in var._sym_op.args:
+            if isinstance(a, SymbolicVar):
+                stack.append((a, False))
+            elif isinstance(a, Tensor) and id(a) not in seen_c:
+                seen_c.add(id(a))
+                consts.append(a)
+    return order, feeds, consts
+
+
+def _eval_graph(fetch_vars, order, feed_map, const_map):
+    """Evaluate the DAG given value maps; returns fetched raw arrays."""
+    memo = {}   # id(SymbolicVar) -> array
+    opmemo = {}  # id(_SymOp) -> raw multi-output
+    for var in order:
+        if var._feed_name is not None:
+            memo[id(var)] = feed_map[var._feed_name]
+            continue
+        op = var._sym_op
+        if id(op) in opmemo:
+            out = opmemo[id(op)]
+        else:
+            vals = [memo[id(a)] if isinstance(a, SymbolicVar)
+                    else (const_map[id(a)] if isinstance(a, Tensor) else a)
+                    for a in op.args]
+            out = op.fn(*vals, **op.kwargs)
+            opmemo[id(op)] = out
+        memo[id(var)] = out[var._out_index] if var._out_index is not None else out
+    return [memo[id(v)] if isinstance(v, SymbolicVar)
+            else (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+            for v in fetch_vars]
+
+
 class Executor:
+    """Compile-and-run over the symbolic graph (reference fluid/executor.py).
+
+    Each distinct (fetch set, feed signature) compiles once; repeated run()
+    calls hit the jit cache — the static-mode analogue of the reference's
+    ParallelExecutor graph reuse.
+    """
+
     def __init__(self, place=None):
         self.place = place
+        self._cache = {}
 
-    def run(self, program=None, feed=None, fetch_list=None):
-        raise NotImplementedError(
-            "paddle_tpu is eager/jit-first: wrap your computation in "
-            "paddle_tpu.jit.to_static instead of Executor.run")
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or _main
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        train = [program._train_specs[id(v)] for v in fetch_list
+                 if id(v) in program._train_specs]
+
+        order, feed_names, consts = _toposort(fetch_list)
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feed entries: {missing}")
+        feed_vals = tuple(jnp.asarray(np.asarray(feed[n])) for n in feed_names)
+        key = (tuple(id(v) for v in fetch_list),
+               tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals)))
+
+        if train:
+            outs = None
+            for spec_i, (loss_var, opt) in enumerate(train):
+                params = [p for p in (opt._parameter_list or [])
+                          if not getattr(p, "stop_gradient", True)]
+                if not params:  # fall back: every captured trainable tensor
+                    params = [c for c in consts if not c.stop_gradient]
+                param_ids = {id(p) for p in params}
+                others = [c for c in consts if id(c) not in param_ids]
+                if opt._parameter_list is None:
+                    opt._parameter_list = params
+
+                skey = key + (id(loss_var),)
+                if skey not in self._cache:
+                    def step(fvals, pvals, ovals, _params=params,
+                             _others=others, _loss=loss_var):
+                        cmap = {id(p): v for p, v in zip(_params, pvals)}
+                        cmap.update({id(c): v for c, v in zip(_others, ovals)})
+                        fmap = dict(zip(feed_names, fvals))
+                        outs = _eval_graph(fetch_list, order, fmap, cmap)
+                        li = fetch_list.index(_loss)
+                        return jnp.sum(outs[li]), outs
+
+                    self._cache[skey] = jax.jit(
+                        jax.value_and_grad(step, argnums=1, has_aux=True))
+                pvals = tuple(p._value for p in params)
+                ovals = tuple(c._value for c in others)
+                with _pause_tape():
+                    (_, step_outs), grads = self._cache[skey](feed_vals, pvals, ovals)
+                    outs = step_outs if outs is None else outs
+                    for p, g in zip(params, grads):
+                        p.grad = Tensor(g, stop_gradient=True) if p.grad is None \
+                            else Tensor(p.grad._value + g, stop_gradient=True)
+                    opt.step()
+                    opt.clear_grad()
+        else:
+            if key not in self._cache:
+                def fwd(fvals, cvals):
+                    cmap = {id(c): v for c, v in zip(consts, cvals)}
+                    return _eval_graph(fetch_list, order, dict(zip(feed_names, fvals)), cmap)
+
+                self._cache[key] = jax.jit(fwd)
+            cvals = tuple(c._value for c in consts)
+            with _pause_tape():
+                outs = self._cache[key](feed_vals, cvals)
+
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """Symbolic gradients (reference python/paddle/static/gradient.py →
+    fluid backward.gradients): returns d(sum targets)/d(inputs) as new
+    symbolic vars evaluated through jax.grad at run time."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    order, feed_names, consts = _toposort(list(targets) + list(inputs))
+
+    from ..framework.core import apply_op
+
+    def grad_fn(*vals):
+        n_in = len(inputs)
+        in_vals, rest = vals[:n_in], vals[n_in:]
+
+        def f(iv):
+            fmap, cmap = {}, {}
+            it_rest = iter(rest)
+            for n in feed_names:
+                fmap[n] = next(it_rest)
+            for c in consts:
+                cmap[id(c)] = next(it_rest)
+            # substitute differentiated inputs
+            sub = {id(v): x for v, x in zip(inputs, iv)}
+            memo_outs = _eval_graph_sub(targets, order, fmap, cmap, sub)
+            return sum(jnp.sum(o) for o in memo_outs)
+
+        return jax.grad(f)(tuple(in_vals))
+
+    feed_vars = [v for v in order if v._feed_name is not None]
+    args = list(inputs) + [feed_vars[[v._feed_name for v in feed_vars].index(n)]
+                           for n in feed_names] + list(consts)
+    out = apply_op(grad_fn, *args)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _eval_graph_sub(fetch_vars, order, feed_map, const_map, substitute):
+    memo, opmemo = dict(substitute), {}
+    for var in order:
+        if id(var) in memo:
+            continue
+        if var._feed_name is not None:
+            memo[id(var)] = feed_map[var._feed_name]
+            continue
+        op = var._sym_op
+        if id(op) in opmemo:
+            out = opmemo[id(op)]
+        else:
+            vals = [memo[id(a)] if isinstance(a, SymbolicVar)
+                    else (const_map[id(a)] if isinstance(a, Tensor) else a)
+                    for a in op.args]
+            out = op.fn(*vals, **op.kwargs)
+            opmemo[id(op)] = out
+        memo[id(var)] = out[var._out_index] if var._out_index is not None else out
+    return [memo[id(v)] for v in fetch_vars]
+
+
+def append_backward(loss, parameter_list=None):
+    """API-parity shim (reference fluid/backward.py append_backward):
+    gradients are generated inside Executor.run via jax.value_and_grad, so
+    this only validates the loss is symbolic."""
+    if not isinstance(loss, SymbolicVar):
+        raise TypeError("append_backward expects a symbolic loss")
+    return []
+
+
+class _Scope:
+    def var(self, name):
+        return None
+
+    def find_var(self, name):
+        return None
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+def cpu_places(device_count=None):
+    from ..framework.device import CPUPlace
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.device import TPUPlace
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
 
 
 def name_scope(prefix=None):

@@ -384,3 +384,21 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 def rotate_half(x):  # helper used by rotary embeddings
     return apply_op(lambda v: jnp.concatenate([-v[..., v.shape[-1] // 2:], v[..., : v.shape[-1] // 2]], axis=-1), x)
+
+
+def cast(x, dtype):
+    """paddle.cast — reference python/paddle/tensor/manipulation.py cast()."""
+    return x.astype(dtype)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (legacy name, reference fluid.layers.reverse)."""
+    return flip(x, axis)
+
+
+def tolist(x):
+    """Function form of Tensor.tolist (reference tensor/manipulation.py)."""
+    return x.tolist()
+
+
+__all__ += ["cast", "reverse", "tolist", "nonzero"]

@@ -397,3 +397,23 @@ def _patch_operators():
 
 
 _patch_operators()
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """reference python/paddle/tensor/math.py:2750 diagonal()."""
+    return apply_op(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def renorm(x, p, axis, max_norm):
+    """Clamp the p-norm of every sub-tensor along `axis` to max_norm
+    (reference python/paddle/tensor/math.py:1649)."""
+    def _renorm(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+    return apply_op(_renorm, x)
+
+
+__all__ += ["diagonal", "renorm"]

@@ -110,3 +110,16 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 def exponential_(x, lam=1.0, name=None):
     key = _rng.next_key()
     return x._inplace_update(lambda v: jax.random.exponential(key, v.shape, v.dtype) / lam)
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference python/paddle/tensor/random.py
+    check_shape): entries must be positive ints (or -1 placeholders)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and not hasattr(s, "_value"):
+                raise TypeError(f"shape entries must be int, got {type(s)}")
+    return shape
+
+
+__all__ += ["check_shape"]

@@ -1,0 +1,123 @@
+"""Distribution + transform tests — reference python/paddle/distribution/*.
+
+Log-det-jacobians are checked against jax autodiff rather than closed forms.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Normal, Uniform, Categorical, Beta, Dirichlet, Multinomial,
+    Independent, TransformedDistribution, kl_divergence,
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform)
+
+
+def test_normal_logprob_entropy_kl():
+    d = Normal(1.0, 2.0)
+    lp = float(d.log_prob(paddle.to_tensor(np.float32(0.5))).numpy())
+    expect = -0.5 * ((0.5 - 1.0) / 2.0) ** 2 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    assert np.allclose(lp, expect, atol=1e-5)
+    q = Normal(0.0, 1.0)
+    kl = float(kl_divergence(d, q).numpy())
+    assert np.allclose(kl, np.log(1 / 2) + (4 + 1) / 2 - 0.5, atol=1e-5)
+
+
+def test_uniform_categorical():
+    u = Uniform(0.0, 4.0)
+    assert np.allclose(float(u.entropy().numpy()), np.log(4.0), atol=1e-6)
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    c = Categorical(paddle.to_tensor(logits))
+    assert np.allclose(float(c.log_prob(paddle.to_tensor(2)).numpy()),
+                       np.log(0.7), atol=1e-5)
+
+
+def test_beta_dirichlet_multinomial_logprob():
+    b = Beta(2.0, 3.0)
+    # Beta(2,3) pdf at 0.4: x^(a-1)(1-x)^(b-1)/B(a,b), B(2,3)=1/12
+    pdf = 12 * 0.4 * 0.6 ** 2
+    assert np.allclose(float(b.log_prob(paddle.to_tensor(np.float32(0.4))).numpy()),
+                       np.log(pdf), atol=1e-4)
+    d = Dirichlet(paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32)))
+    # uniform over simplex: pdf = 2! = 2
+    v = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+    assert np.allclose(float(d.log_prob(v).numpy()), np.log(2.0), atol=1e-4)
+    m = Multinomial(4, paddle.to_tensor(np.array([0.5, 0.5], np.float32)))
+    v = paddle.to_tensor(np.array([2.0, 2.0], np.float32))
+    assert np.allclose(float(m.log_prob(v).numpy()), np.log(6 * 0.5 ** 4), atol=1e-4)
+
+
+@pytest.mark.parametrize("t", [
+    AffineTransform(1.5, 2.0), ExpTransform(), SigmoidTransform(),
+    TanhTransform(), PowerTransform(2.0)])
+def test_transform_roundtrip_and_ladj(t):
+    x = jnp.asarray(np.random.RandomState(0).uniform(0.1, 0.9, (5,)).astype("float32"))
+    y = t._forward(x)
+    xr = t._inverse(y)
+    assert np.allclose(np.asarray(x), np.asarray(xr), atol=5e-4)
+    ladj = t._call_forward_log_det_jacobian(x)
+    g = jax.vmap(jax.grad(lambda s: t._forward(s)))(x)
+    assert np.allclose(np.asarray(ladj), np.log(np.abs(np.asarray(g))), atol=1e-4)
+
+
+def test_stick_breaking():
+    t = StickBreakingTransform()
+    x = jnp.asarray(np.random.RandomState(1).randn(4).astype("float32"))
+    y = t._forward(x)
+    assert y.shape == (5,)
+    assert np.allclose(np.asarray(y).sum(), 1.0, atol=1e-5)
+    assert np.allclose(np.asarray(t._inverse(y)), np.asarray(x), atol=1e-4)
+    J = jax.jacfwd(t._forward)(x)[:-1, :]
+    _, logdet = np.linalg.slogdet(np.asarray(J).T)
+    assert np.allclose(float(t._call_forward_log_det_jacobian(x)), logdet, atol=1e-4)
+    assert t.forward_shape((4,)) == (5,)
+    assert t.inverse_shape((5,)) == (4,)
+
+
+def test_transformed_distribution_lognormal():
+    base = Normal(0.0, 1.0)
+    td = TransformedDistribution(base, [AffineTransform(0.0, 2.0), ExpTransform()])
+    lp = float(td.log_prob(paddle.to_tensor(np.float32(1.7))).numpy())
+    expect = (float(base.log_prob(paddle.to_tensor(np.float32(np.log(1.7) / 2))).numpy())
+              - np.log(2.0) - np.log(1.7))
+    assert np.allclose(lp, expect, atol=1e-5)
+
+
+def test_chain_softmax_reshape_stack_independent_abs():
+    ct = ChainTransform([AffineTransform(0.0, 2.0), ExpTransform()])
+    x = jnp.asarray([0.3], jnp.float32)
+    assert np.allclose(np.asarray(ct._inverse(ct._forward(x))), np.asarray(x), atol=1e-5)
+    sm = SoftmaxTransform()
+    y = sm._forward(jnp.asarray([1.0, 2.0, 3.0], jnp.float32))
+    assert np.allclose(np.asarray(y).sum(), 1.0, atol=1e-6)
+    rt = ReshapeTransform((2, 3), (6,))
+    assert rt._forward(jnp.zeros((4, 2, 3))).shape == (4, 6)
+    assert rt.forward_shape((4, 2, 3)) == (4, 6)
+    st = StackTransform([ExpTransform(), TanhTransform()], axis=0)
+    assert st._forward(jnp.ones((2, 3))).shape == (2, 3)
+    it = IndependentTransform(ExpTransform(), 1)
+    assert it._call_forward_log_det_jacobian(jnp.ones((4, 3))).shape == (4,)
+    lo, hi = AbsTransform().inverse(paddle.to_tensor(np.float32(2.0)))
+    assert float(lo.numpy()) == -2.0 and float(hi.numpy()) == 2.0
+
+
+def test_independent_distribution():
+    base = Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+    ind = Independent(base, 1)
+    assert ind.event_shape == (3,)
+    v = paddle.to_tensor(np.zeros(3, np.float32))
+    assert np.allclose(float(ind.log_prob(v).numpy()),
+                       3 * float(Normal(0.0, 1.0).log_prob(paddle.to_tensor(np.float32(0))).numpy()),
+                       atol=1e-5)
+
+
+def test_transform_call_operator():
+    base = Normal(0.0, 1.0)
+    td = ExpTransform()(base)
+    assert isinstance(td, TransformedDistribution)
+    chained = ExpTransform()(AffineTransform(0.0, 2.0))
+    assert isinstance(chained, ChainTransform)

@@ -117,5 +117,6 @@ def test_group_sharded_parallel():
     paddle.seed(0)
     m = nn.Linear(64, 256)
     opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
-    m2, opt2 = group_sharded_parallel(m, opt)
+    m2, opt2, scaler = group_sharded_parallel(m, opt)
+    assert scaler is None
     assert len(m2.weight._value.sharding.device_set) == 8
