@@ -84,6 +84,14 @@ if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
     from . import models  # noqa: F401,E402
     from . import utils  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402
+    from . import compat  # noqa: F401,E402
+    from . import cost_model  # noqa: F401,E402
+    from . import dataset  # noqa: F401,E402
+    from . import reader  # noqa: F401,E402
+    from . import sysconfig  # noqa: F401,E402
+    from . import inference  # noqa: F401,E402
+    from . import onnx  # noqa: F401,E402
+    from . import autograd as _autograd_ns  # noqa: F401,E402
     from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
     from .nn.layer_base import ParamAttr  # noqa: F401,E402
     from .distributed.parallel import DataParallel  # noqa: F401,E402

@@ -1,0 +1,48 @@
+"""py2/3 text helpers — reference python/paddle/compat.py."""
+
+__all__ = ["to_text", "to_bytes", "floor_division", "get_exception_message"]
+
+
+def _convert(obj, conv, inplace):
+    if obj is None or isinstance(obj, (int, float)):
+        return obj
+    if isinstance(obj, (bytes, str)):
+        return conv(obj)
+    if isinstance(obj, list):
+        if inplace:
+            for i, v in enumerate(obj):
+                obj[i] = _convert(v, conv, inplace)
+            return obj
+        return [_convert(v, conv, inplace) for v in obj]
+    if isinstance(obj, set):
+        out = {_convert(v, conv, False) for v in obj}
+        if inplace:
+            obj.clear()
+            obj.update(out)
+            return obj
+        return out
+    if isinstance(obj, dict):
+        out = {_convert(k, conv, False): _convert(v, conv, False)
+               for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(out)
+            return obj
+        return out
+    return obj
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    return _convert(obj, lambda s: s.decode(encoding) if isinstance(s, bytes) else s, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    return _convert(obj, lambda s: s.encode(encoding) if isinstance(s, str) else s, inplace)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

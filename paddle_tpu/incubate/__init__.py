@@ -1,8 +1,18 @@
 """paddle_tpu.incubate — reference python/paddle/incubate (fused ops, MoE,
-checkpointing). Fused ops map to the Pallas/XLA kernels in paddle_tpu.ops."""
-from . import checkpoint, nn  # noqa: F401
+checkpointing, ASP, segment/graph ops, LookAhead/ModelAverage)."""
+from . import asp, checkpoint, nn, operators, optimizer, tensor  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_send_recv,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .tensor import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
 
-__all__ = ["nn", "checkpoint", "autotune"]
+__all__ = ["nn", "checkpoint", "autotune", "asp", "operators", "optimizer",
+           "tensor", "segment_sum", "segment_mean", "segment_max",
+           "segment_min", "graph_send_recv", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage"]
 
 
 def autotune(config=None):

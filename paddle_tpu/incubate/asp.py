@@ -1,0 +1,94 @@
+"""Automatic SParsity (2:4 structured sparsity) — reference
+python/paddle/fluid/contrib/sparsity + incubate ASP API.
+
+The reference targets Ampere sparse tensor cores; TPU MXUs have no 2:4
+hardware path, so here ASP is a *pruning* workflow with identical masks and
+semantics: magnitude-based n:m masks computed per row-block, re-applied
+after each optimizer step so pruned weights stay zero. The masked matmul
+itself runs dense on the MXU (dense bf16 is the fast path on TPU).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "create_mask", "check_mask_1d", "check_mask_2d"]
+
+_EXCLUDED = set()
+_MASKS = {}  # id(param) -> mask jnp array
+
+
+def set_excluded_layers(main_program=None, param_names=None):
+    for n in param_names or []:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size) if arr.size else 0.0
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m magnitude mask along the last axis (keep n largest of every m)."""
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
+    flat = arr.reshape(-1, arr.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(np.abs(groups), axis=-1)
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., :m - n], False, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols].reshape(arr.shape)
+    return mask
+
+
+def check_mask_1d(mask, n=2, m=4):
+    arr = np.asarray(mask).reshape(-1, np.asarray(mask).shape[-1])
+    cols = arr.shape[1]
+    pad = (-cols) % m
+    if pad:
+        arr = np.pad(arr, ((0, 0), (0, pad)), constant_values=0)
+    groups = arr.reshape(arr.shape[0], -1, m)
+    return bool(((groups != 0).sum(-1) <= n).all())
+
+
+def check_mask_2d(mask, n=2, m=4):
+    return check_mask_1d(mask, n, m) and check_mask_1d(np.asarray(mask).T, n, m)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable weight (>=2D, not excluded)."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if p.stop_gradient or len(p.shape) < 2 or name in _EXCLUDED:
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        jmask = jnp.asarray(mask, p._value.dtype)
+        p._value = p._value * jmask
+        _MASKS[id(p)] = jmask
+        pruned[name] = float(mask.mean())
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so masks are re-applied after every update —
+    the reference's OptimizerWithSparsityGuarantee."""
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._parameter_list or []:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+    optimizer.step = step
+    return optimizer

@@ -1,0 +1,61 @@
+"""Graph message passing + fused helper ops — reference
+python/paddle/incubate/operators/{graph_send_recv,softmax_mask_fuse}.py.
+
+graph_send_recv gathers source-node features along edges and
+scatter-reduces them at destinations: on TPU this is take() + one XLA
+scatter-reduce (segment op), fusing under jit.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["graph_send_recv", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+_POOLS = {"sum": jax.ops.segment_sum, "mean": None,
+          "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    pool_type = pool_type.lower()
+    if pool_type not in _POOLS:
+        raise ValueError(f"pool_type must be one of {list(_POOLS)}, got {pool_type}")
+    dst = dst_index._value if isinstance(dst_index, Tensor) else np.asarray(dst_index)
+    n = int(out_size) if out_size is not None else (
+        x.shape[0] if hasattr(x, "shape") else None)
+    if out_size is None:
+        # reference semantics: output has as many rows as x (node count)
+        n = x.shape[0]
+
+    def f(xv, si, di):
+        gathered = jnp.take(xv, si, axis=0)
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(gathered, di, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(di, xv.dtype), di, num_segments=n)
+            return s / jnp.maximum(cnt.reshape((-1,) + (1,) * (xv.ndim - 1)), 1)
+        out = _POOLS[pool_type](gathered, di, num_segments=n)
+        if pool_type in ("max", "min"):
+            # empty segments come back +/-inf from XLA; reference returns 0
+            return jnp.where(jnp.isfinite(out), out, 0)
+        return out
+    return apply_op(f, x, src_index, dst_index)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Masked softmax (reference fused_softmax_mask CUDA op): mask is added
+    to the logits before softmax — XLA fuses this chain into one kernel."""
+    return apply_op(lambda v, m: jax.nn.softmax(
+        v.astype(jnp.float32) + m.astype(jnp.float32), axis=-1).astype(v.dtype),
+        x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference fused_softmax_mask_upper_triangle)."""
+    def f(v):
+        L, S = v.shape[-2], v.shape[-1]
+        mask = jnp.tril(jnp.ones((L, S), bool))
+        logits = jnp.where(mask, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return apply_op(f, x)

@@ -1,0 +1,141 @@
+"""Incubate optimizers — reference python/paddle/incubate/optimizer/
+{lookahead,modelaverage}.py.
+
+Both wrap an inner optimizer and keep auxiliary parameter copies; updates
+are pure jnp expressions so a jitted train step folds them in.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (Zhang et al. 2019).
+
+    slow += alpha * (fast - slow) every k inner steps; fast := slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._k_count = 0
+        self._slow = {}
+        self._parameter_list = inner_optimizer._parameter_list
+        # base-class plumbing expected by inherited helpers
+        self._learning_rate = inner_optimizer._learning_rate
+        self._accumulators = {}
+        self._step_count = 0
+        self._slot_names = ()
+        self._multi_precision = False
+        self._grad_clip = None
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k:
+            return
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, lr):
+        return self.inner_optimizer.set_lr(lr)
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "k_count": self._k_count}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state["inner"])
+        self._k_count = state.get("k_count", 0)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, []
+
+
+class ModelAverage(Optimizer):
+    """Maintain a running average of parameters for evaluation (reference
+    incubate/optimizer/modelaverage.py). apply()/restore() swap the
+    averaged weights in and out."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        self._parameter_list = list(parameters) if parameters is not None else []
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum = {}
+        self._cnt = 0
+        self._backup = None
+        # base-class plumbing expected by inherited helpers
+        self._learning_rate = 0.0
+        self._accumulators = {}
+        self._step_count = 0
+        self._slot_names = ()
+        self._multi_precision = False
+        self._grad_clip = None
+
+    def step(self):
+        self._cnt += 1
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            acc = self._sum.get(id(p))
+            f32 = p._value.astype(jnp.float32)
+            self._sum[id(p)] = f32 if acc is None else acc + f32
+        # bound the window: restart accumulation when it outgrows max_w
+        if self._cnt > self.max_w:
+            for p in self._parameter_list:
+                if id(p) in self._sum:
+                    self._sum[id(p)] = p._value.astype(jnp.float32)
+            self._cnt = 1
+
+    def apply(self, executor=None, need_restore=True):
+        if need_restore:
+            self._backup = {id(p): p._value for p in self._parameter_list}
+        for p in self._parameter_list:
+            acc = self._sum.get(id(p))
+            if acc is not None and self._cnt:
+                p._value = (acc / self._cnt).astype(p.dtype)
+        return self
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list:
+                if id(p) in self._backup:
+                    p._value = self._backup[id(p)]
+        self._backup = None
+
+    def __enter__(self):
+        self.apply(need_restore=True)
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad

@@ -1,0 +1,47 @@
+"""Segment reductions — reference python/paddle/incubate/tensor/math.py.
+
+TPU-native: jax.ops.segment_* lowers to one XLA scatter-reduce (the
+reference dispatches a CUDA segment kernel per op). num_segments is taken
+from the ids tensor so results match the reference's dynamic sizing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _num_segments(segment_ids):
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    return int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.shape[0] else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply_op(lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                    data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+
+    def f(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(i, d.dtype), i, num_segments=n)
+        cnt = cnt.reshape((-1,) + (1,) * (d.ndim - 1))
+        return s / jnp.maximum(cnt, 1)
+    return apply_op(f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply_op(lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
+                    data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply_op(lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
+                    data, segment_ids)

@@ -1,0 +1,165 @@
+"""Legacy reader combinators — reference python/paddle/reader/decorator.py.
+
+Readers are zero-arg callables returning iterators. The reference's
+multiprocess/xmap variants exist for CPU-bound python decode; here the fast
+path is paddle_tpu.io.DataLoader (+ native worker pool in runtime/), so
+these combinators run threaded/serial but keep identical semantics.
+"""
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return cached
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*rs):
+                yield sum((make_tuple(i) for i in items if i is not None), ())
+    return composed
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a worker thread."""
+    end = object()
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            yield item
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (the reference uses
+    processes; decode workloads here should use io.DataLoader instead)."""
+    end = object()
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is end:
+                    out_q.put(end)
+                    return
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            pending = {}
+            want = 0
+            while done < process_num:
+                got = out_q.get()
+                if got is end:
+                    done += 1
+                    continue
+                i, val = got
+                pending[i] = val
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                got = out_q.get()
+                if got is end:
+                    done += 1
+                    continue
+                yield got[1]
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Serial-fallback of the reference's fork-based multiprocess reader
+    (single-controller JAX processes shouldn't fork); semantics preserved."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader

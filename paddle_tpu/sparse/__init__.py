@@ -98,3 +98,154 @@ def tanh(x, name=None):
 
 def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
+
+
+def _unary_coo(fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, Tensor(fn(x.values._value)), x.shape)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows, x.cols, Tensor(fn(x.values._value)), x.shape)
+        from ..framework.core import apply_op
+        return apply_op(fn, x)
+    return op
+
+
+# zero-preserving unary ops on sparse values (reference sparse/functional)
+sqrt = _unary_coo(jnp.sqrt)
+sin = _unary_coo(jnp.sin)
+square = _unary_coo(jnp.square)
+abs = _unary_coo(jnp.abs)  # noqa: A001
+neg = _unary_coo(jnp.negative)
+expm1 = _unary_coo(jnp.expm1)
+log1p = _unary_coo(jnp.log1p)
+asin = _unary_coo(jnp.arcsin)
+atan = _unary_coo(jnp.arctan)
+sinh = _unary_coo(jnp.sinh)
+asinh = _unary_coo(jnp.arcsinh)
+atanh = _unary_coo(jnp.arctanh)
+pow = _unary_coo(None)  # replaced below  # noqa: A001
+
+
+def pow(x, factor, name=None):  # noqa: F811,A001
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, Tensor(jnp.power(x.values._value, factor)), x.shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, Tensor(jnp.power(x.values._value, factor)), x.shape)
+    from ..framework.core import apply_op
+    return apply_op(lambda v: jnp.power(v, factor), x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices.astype(index_dtype) if index_dtype else x.indices
+        vals = x.values.astype(value_dtype) if value_dtype else x.values
+        return SparseCooTensor(idx, vals, x.shape)
+    if isinstance(x, SparseCsrTensor):
+        vals = x.values.astype(value_dtype) if value_dtype else x.values
+        return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+    return x.astype(value_dtype)
+
+
+def add(x, y, name=None):
+    return _ewise(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _ewise(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    return _ewise(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _ewise(x, y, jnp.divide)
+
+
+def _ewise(x, y, fn):
+    """Elementwise over two same-pattern sparse tensors (dense fallback
+    when patterns differ — correct, not compressed)."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if np.array_equal(np.asarray(x.indices._value), np.asarray(y.indices._value)):
+            return SparseCooTensor(x.indices, Tensor(fn(x.values._value, y.values._value)), x.shape)
+        d = fn(x.to_dense()._value, y.to_dense()._value)
+        return dense_to_coo(Tensor(d))
+    from ..framework.core import apply_op
+    return apply_op(fn, to_dense(x), to_dense(y))
+
+
+def dense_to_coo(x, sparse_dim=None):
+    """Tensor -> SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    idx = np.stack(np.nonzero(arr))
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(idx, vals, arr.shape)
+
+
+def coo_to_csr(x):
+    """2-D COO -> CSR."""
+    if len(x.shape) != 2:
+        raise ValueError("CSR requires 2-D")
+    idx = np.asarray(x.indices._value)
+    order = np.lexsort((idx[1], idx[0]))
+    rows, cols = idx[0][order], idx[1][order]
+    vals = np.asarray(x.values._value)[order]
+    crows = np.zeros(x.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, vals, x.shape)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense@dense restricted to mask's sparsity pattern (reference
+    sparse.masked_matmul): compute dense then sample — XLA fuses."""
+    from ..tensor.math import matmul as dense_matmul
+    d = dense_matmul(to_dense(x), to_dense(y))
+    if isinstance(mask, SparseCooTensor):
+        idx = np.asarray(mask.indices._value)
+        vals = d._value[tuple(idx)]
+        return SparseCooTensor(idx, vals, mask.shape)
+    return d
+
+
+# -- sparse.nn layer namespace (reference python/paddle/sparse/layer) -------
+class _SparseNN:
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class BatchNorm:
+        """BatchNorm over sparse values (reference sparse/layer/norm.py):
+        normalizes the value array channel-wise."""
+
+        def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+            self.num_features = num_features
+            self.eps = epsilon
+
+        def __call__(self, x):
+            vals = x.values._value
+            mean = vals.mean(axis=0, keepdims=True)
+            var = vals.var(axis=0, keepdims=True)
+            out = (vals - mean) / jnp.sqrt(var + self.eps)
+            return SparseCooTensor(x.indices, Tensor(out), x.shape)
+
+    class MaxPool3D:
+        def __init__(self, kernel_size, stride=None, padding=0):
+            self.kernel_size = kernel_size
+            self.stride = stride or kernel_size
+            self.padding = padding
+
+        def __call__(self, x):
+            from ..nn.functional.pooling import max_pool3d
+            dense = to_dense(x)
+            out = max_pool3d(dense, self.kernel_size, self.stride, self.padding)
+            return dense_to_coo(out)
+
+
+nn = _SparseNN()
+
+__all__ += ["sqrt", "sin", "square", "abs", "neg", "expm1", "log1p", "asin",
+            "atan", "sinh", "asinh", "atanh", "pow", "cast", "add", "subtract",
+            "multiply", "divide", "masked_matmul", "dense_to_coo", "coo_to_csr",
+            "nn"]

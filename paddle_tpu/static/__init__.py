@@ -335,3 +335,85 @@ def save(program, model_path, protocol=4):
 
 def load(program, model_path, executor=None, var_list=None):
     raise NotImplementedError("use paddle_tpu.jit.load")
+
+
+class program_guard:
+    """Context manager scoping graph construction to a Program (reference
+    python/paddle/static/__init__.py program_guard)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _main
+        self._prev = _main
+        _main = self.main
+        return self.main
+
+    def __exit__(self, *exc):
+        global _main
+        _main = self._prev
+        return False
+
+
+class _StaticNN:
+    """static.nn op-style layer builders (reference python/paddle/static/nn):
+    each call creates fresh parameters, like the reference's unique-named
+    per-call params."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as dyn_nn
+        from ..nn import functional as F
+        in_dim = 1
+        for s in x.shape[num_flatten_dims:]:
+            in_dim *= abs(int(s))
+        layer = dyn_nn.Linear(in_dim, size)
+        out = layer(x if len(x.shape) == num_flatten_dims + 1
+                    else _reshape_keep(x, num_flatten_dims, in_dim))
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None, name=None):
+        from .. import nn as dyn_nn
+        layer = dyn_nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+        return layer(input)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               groups=1, name=None, act=None):
+        from .. import nn as dyn_nn
+        from ..nn import functional as F
+        in_ch = int(input.shape[1])
+        layer = dyn_nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                              padding=padding, groups=groups)
+        out = layer(input)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, name=None):
+        from .. import nn as dyn_nn
+        from ..nn import functional as F
+        ch = int(input.shape[1])
+        layer = dyn_nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon) \
+            if len(input.shape) == 4 else dyn_nn.BatchNorm1D(ch, momentum=momentum,
+                                                             epsilon=epsilon)
+        out = layer(input)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+
+def _reshape_keep(x, keep_dims, flat):
+    from ..tensor.manipulation import reshape
+    lead = [int(s) for s in x.shape[:keep_dims]]
+    return reshape(x, lead + [flat])
+
+
+nn = _StaticNN()
+__all__ += ["program_guard", "nn"]

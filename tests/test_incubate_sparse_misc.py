@@ -1,0 +1,165 @@
+"""incubate (segment/graph/ASP/LookAhead/ModelAverage), sparse breadth,
+reader combinators, legacy dataset, static.nn — parity vs reference
+python/paddle/{incubate,sparse,reader,dataset,static}."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import sparse
+
+
+def test_sparse_roundtrip_and_ops():
+    d = np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+    s = sparse.dense_to_coo(paddle.to_tensor(d))
+    assert s.nnz() == 3
+    np.testing.assert_allclose(s.to_dense().numpy(), d)
+    np.testing.assert_allclose(sparse.coo_to_csr(s).to_dense().numpy(), d)
+    np.testing.assert_allclose(sparse.sqrt(s).to_dense().numpy(),
+                               np.sqrt(d) * (d != 0))
+    np.testing.assert_allclose(sparse.add(s, s).to_dense().numpy(), 2 * d)
+    np.testing.assert_allclose(sparse.matmul(s, paddle.to_tensor(d.T)).numpy(),
+                               d @ d.T, rtol=1e-6)
+    masked = sparse.masked_matmul(paddle.to_tensor(d), paddle.to_tensor(d.T),
+                                  sparse.dense_to_coo(paddle.to_tensor(np.eye(2, dtype=np.float32))))
+    np.testing.assert_allclose(masked.to_dense().numpy(),
+                               np.diag(np.diag(d @ d.T)), rtol=1e-6)
+
+
+def test_segment_ops():
+    from paddle_tpu.incubate import segment_max, segment_mean, segment_min, segment_sum
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(segment_sum(data, ids).numpy(), [[4, 6], [5, 6]])
+    np.testing.assert_allclose(segment_mean(data, ids).numpy(), [[2, 3], [5, 6]])
+    np.testing.assert_allclose(segment_max(data, ids).numpy(), [[3, 4], [5, 6]])
+    np.testing.assert_allclose(segment_min(data, ids).numpy(), [[1, 2], [5, 6]])
+
+
+def test_graph_send_recv():
+    from paddle_tpu.incubate import graph_send_recv
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    np.testing.assert_allclose(graph_send_recv(x, src, dst, "sum").numpy(),
+                               [[1, 2], [6, 8], [3, 4]])
+    np.testing.assert_allclose(graph_send_recv(x, src, dst, "mean").numpy(),
+                               [[1, 2], [3, 4], [3, 4]])
+    np.testing.assert_allclose(graph_send_recv(x, src, dst, "max").numpy(),
+                               [[1, 2], [5, 6], [3, 4]])
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle
+    sm = softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32)))
+    row0 = np.asarray(sm.numpy())[0, 0, 0]
+    np.testing.assert_allclose(row0, [1, 0, 0, 0], atol=1e-6)
+    row3 = np.asarray(sm.numpy())[0, 0, 3]
+    np.testing.assert_allclose(row3, [0.25] * 4, atol=1e-6)
+
+
+def test_lookahead_converges():
+    from paddle_tpu.incubate import LookAhead
+    paddle.seed(0)
+    m = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    la = LookAhead(inner, alpha=0.5, k=5)
+    xs = np.random.RandomState(0).randn(32, 4).astype("float32")
+    W = np.array([[1.], [-2.], [0.5], [3.]], np.float32)
+    ys = xs @ W
+    losses = []
+    for i in range(200):
+        loss = ((m(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 1e-2
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate import ModelAverage
+    m = nn.Linear(2, 2)
+    ma = ModelAverage(0.15, parameters=m.parameters())
+    w0 = m.weight.numpy().copy()
+    ma.step()
+    m.weight._value = m.weight._value + 1.0  # simulate an update
+    ma.step()
+    with ma:  # averaged weights active
+        avg = m.weight.numpy()
+        np.testing.assert_allclose(avg, w0 + 0.5, atol=1e-5)
+    np.testing.assert_allclose(m.weight.numpy(), w0 + 1.0, atol=1e-6)  # restored
+
+
+def test_asp_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    asp.prune_model(m)
+    assert asp.check_mask_1d(m.weight.numpy())
+    assert abs(asp.calculate_density(m.weight) - 0.5) < 1e-6
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=m.parameters()))
+    loss = (m(paddle.to_tensor(np.ones((2, 8), np.float32))) ** 2).sum()
+    loss.backward()
+    opt.step()
+    assert asp.check_mask_1d(m.weight.numpy())  # mask survives the update
+
+
+def test_reader_combinators():
+    from paddle_tpu import reader as rd
+    r = lambda: iter(range(10))  # noqa: E731
+    assert list(rd.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(rd.shuffle(r, 4)()) == list(range(10))
+    assert list(rd.chain(r, r)()) == list(range(10)) * 2
+    assert list(rd.map_readers(lambda a, b: a + b, r, r)()) == [2 * i for i in range(10)]
+    assert list(rd.buffered(r, 2)()) == list(range(10))
+    assert list(rd.cache(r)()) == list(range(10))
+    assert sorted(rd.xmap_readers(lambda v: v * 2, r, 2, 4)()) == [2 * i for i in range(10)]
+    assert list(rd.xmap_readers(lambda v: v * 2, r, 2, 4, order=True)()) == [2 * i for i in range(10)]
+    assert list(rd.compose(r, r)()) == [(i, i) for i in range(10)]
+
+
+def test_legacy_dataset_readers():
+    img, lbl = next(paddle.dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lbl < 10
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    ids, lbl = next(paddle.dataset.imdb.train()())
+    assert isinstance(ids, list) and lbl in (0, 1)
+    with pytest.raises(RuntimeError):
+        paddle.dataset.common.download("http://x", "m", "0")
+
+
+def test_static_nn_and_program_guard():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("snn_x", [4, 6], "float32")
+            h = static.nn.fc(x, 3, activation="relu")
+        exe = static.Executor()
+        (o,) = exe.run(prog, feed={"snn_x": np.ones((4, 6), np.float32)},
+                       fetch_list=[h])
+        assert o.shape == (4, 3) and (o >= 0).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_cost_model():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel()
+    sp, mp = cm.build_program()
+    try:
+        cost = cm.profile_measure(sp, mp)
+        assert cost["time"] > 0
+    finally:
+        paddle.disable_static()
+
+
+def test_compat():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes(["a", "b"]) == [b"a", b"b"]
+    assert paddle.compat.to_text({b"k": b"v"}) == {"k": "v"}
