@@ -92,11 +92,34 @@ if _os.environ.get("PADDLE_TPU_CORE_ONLY") != "1":
     from . import inference  # noqa: F401,E402
     from . import onnx  # noqa: F401,E402
     from . import autograd as _autograd_ns  # noqa: F401,E402
-    from .device import is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401,E402
+    from .device import (  # noqa: F401,E402
+        CustomPlace,
+        IPUPlace,
+        MLUPlace,
+        XPUPlace,
+        get_cudnn_version,
+        is_compiled_with_cinn,
+        is_compiled_with_cuda,
+        is_compiled_with_ipu,
+        is_compiled_with_mlu,
+        is_compiled_with_npu,
+        is_compiled_with_rocm,
+        is_compiled_with_tpu,
+        is_compiled_with_xpu,
+    )
     from .nn.layer_base import ParamAttr  # noqa: F401,E402
     from .distributed.parallel import DataParallel  # noqa: F401,E402
 
     flatten = tensor.manipulation.flatten  # keep function (not module) at top level
+
+
+def monkey_patch_math_varbase():
+    """Tensor operators are patched at import (reference patches lazily)."""
+    return None
+
+
+def monkey_patch_variable():
+    return None
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

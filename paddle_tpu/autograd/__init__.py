@@ -8,7 +8,7 @@ import jax
 
 from ..framework.core import Tensor, _pause_tape, apply_op, backward, is_grad_enabled, no_grad
 
-__all__ = ["backward", "grad", "no_grad", "is_grad_enabled", "PyLayer", "value_and_grad", "vjp", "jvp"]
+__all__ = ["PyLayerContext", "backward", "grad", "no_grad", "is_grad_enabled", "PyLayer", "value_and_grad", "vjp", "jvp"]
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
@@ -84,6 +84,21 @@ def jvp(func, xs, v=None):
     return Tensor(out), Tensor(tangent_out)
 
 
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (reference
+    python/paddle/autograd/py_layer.py:PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
 class PyLayer:
     """Custom autograd op (reference python/paddle/autograd/py_layer.py).
 
@@ -100,16 +115,7 @@ class PyLayer:
     def backward(ctx, *grads):
         raise NotImplementedError
 
-    class _Ctx:
-        def __init__(self):
-            self._saved = ()
-
-        def save_for_backward(self, *tensors):
-            self._saved = tensors
-
-        @property
-        def saved_tensor(self):
-            return self._saved
+    _Ctx = PyLayerContext
 
     @classmethod
     def apply(cls, *args, **kwargs):

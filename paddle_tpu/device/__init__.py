@@ -2,6 +2,9 @@
 from ..framework.device import (  # noqa: F401
     CPUPlace,
     CUDAPlace,
+    CustomPlace,
+    IPUPlace,
+    MLUPlace,
     TPUPlace,
     XPUPlace,
     device_count,
@@ -12,7 +15,14 @@ from ..framework.device import (  # noqa: F401
 )
 
 __all__ = ["set_device", "get_device", "device_count", "TPUPlace", "CPUPlace",
-           "is_compiled_with_cuda", "is_compiled_with_tpu"]
+           "CustomPlace", "IPUPlace", "MLUPlace", "XPUPlace",
+           "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "is_compiled_with_cinn", "is_compiled_with_ipu",
+           "is_compiled_with_mlu", "is_compiled_with_npu",
+           "is_compiled_with_rocm", "is_compiled_with_xpu",
+           "get_cudnn_version", "get_all_custom_device_type",
+           "get_available_custom_device", "get_all_device_type",
+           "get_available_device"]
 
 
 def is_compiled_with_rocm():
@@ -37,6 +47,19 @@ def is_compiled_with_ipu():
 
 def is_compiled_with_cinn():
     return False
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU build (reference returns None when not compiled)."""
+    return None
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []
 
 
 def get_all_device_type():

@@ -66,3 +66,130 @@ def launch():
     raise NotImplementedError(
         "use standard multi-host launching (one process per host with "
         "JAX_COORDINATOR/process env) — see docs/distributed.md")
+
+
+class ParallelMode:
+    """Reference python/paddle/distributed/parallel.py:ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel building block — reference
+    python/paddle/distributed/collective.py:1547:split. Builds the matching
+    meta_parallel layer (GSPMD shards the weight over the 'tp'/'mp' mesh axis;
+    no manual partition bookkeeping needed) and applies it."""
+    from .fleet.meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                                      VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    else:
+        layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    return layer(x)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous — jax.distributed handles multi-host setup; accepted
+    for parity (reference uses gloo for CPU-only collectives)."""
+    return None
+
+
+def gloo_barrier():
+    return None
+
+
+def gloo_release():
+    return None
+
+
+class _EntryBase:
+    """Sparse-table entry configs (reference distributed/entry_attr.py) —
+    parameter-server artifacts, kept as config carriers."""
+
+    def __init__(self, *args):
+        self._args = args
+
+
+class CountFilterEntry(_EntryBase):
+    def __init__(self, count_filter=0):
+        super().__init__(count_filter)
+
+
+class ShowClickEntry(_EntryBase):
+    def __init__(self, show_name="", click_name=""):
+        super().__init__(show_name, click_name)
+
+
+class ProbabilityEntry(_EntryBase):
+    def __init__(self, probability=1.0):
+        super().__init__(probability)
+
+
+class InMemoryDataset:
+    """Reference distributed/fleet/dataset:InMemoryDataset — host-side sample
+    store feeding the data loader (parameter-server era API; file-list based)."""
+
+    def __init__(self):
+        self._files = []
+        self._records = []
+        self._batch_size = 1
+        self._parse_fn = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat", **kwargs):
+        self._batch_size = batch_size
+
+    def set_filelist(self, filelist):
+        self._files = list(filelist)
+
+    def load_into_memory(self):
+        self._records = []
+        for fn in self._files:
+            with open(fn) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    self._records.append(
+                        self._parse_fn(line) if self._parse_fn else line)
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant: iterates files lazily instead of loading to memory."""
+
+    def __iter__(self):
+        for fn in self._files:
+            with open(fn) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+
+__all__ += ["ParallelMode", "split", "gloo_init_parallel_env", "gloo_barrier",
+            "gloo_release", "CountFilterEntry", "ShowClickEntry",
+            "ProbabilityEntry", "InMemoryDataset", "QueueDataset"]

@@ -66,3 +66,42 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a Hermitian-symmetric signal — reference python/paddle/fft.py:hfft2."""
+    return apply_op(lambda v: _hermitian_fftn(v, s, axes, norm, inverse=False), x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda v: _hermitian_fftn(v, s, axes, norm, inverse=True), x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: _hermitian_fftn(v, s, axes, norm, inverse=False), x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda v: _hermitian_fftn(v, s, axes, norm, inverse=True), x)
+
+
+def _hermitian_fftn(v, s, axes, norm, inverse):
+    """hfftn = conj-irfftn analog: full FFT over leading axes, Hermitian
+    transform on the last axis (numpy hfft/ihfft composition)."""
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    axes = tuple(a % v.ndim for a in axes)
+    last = axes[-1]
+    lead = axes[:-1]
+    if inverse:
+        out = jnp.fft.ihfft(v, n=None if s is None else s[-1], axis=last, norm=norm)
+        if lead:
+            out = jnp.fft.ifftn(out, s=None if s is None else s[:-1], axes=lead, norm=norm)
+        return out
+    out = v
+    if lead:
+        out = jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=lead, norm=norm)
+    return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=last, norm=norm)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

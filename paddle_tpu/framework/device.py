@@ -32,8 +32,19 @@ class CPUPlace:
 CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
 NPUPlace = TPUPlace
-MLUPlace = TPUPlace
 IPUPlace = TPUPlace
+MLUPlace = TPUPlace
+
+
+class CustomPlace:
+    """Place for a custom device type (reference core.CustomPlace)."""
+
+    def __init__(self, device_type="tpu", device_id=0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
 
 
 class CUDAPinnedPlace:

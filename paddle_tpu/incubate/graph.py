@@ -1,0 +1,100 @@
+"""Graph-learning sampling ops — reference python/paddle/incubate/operators/
+graph_sample_neighbors.py, graph_reindex.py, graph_khop_sampler.py.
+
+These are host-side data-preparation ops (dynamic output shapes — not XLA
+territory): numpy implementations feeding device compute, mirroring the
+reference's CPU kernels.
+"""
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["graph_sample_neighbors", "graph_reindex", "graph_khop_sampler"]
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    """Sample up to sample_size neighbors per input node from a CSC graph."""
+    rownp = _np(row).reshape(-1)
+    colnp = _np(colptr).reshape(-1)
+    nodes = _np(input_nodes).reshape(-1)
+    eidnp = _np(eids).reshape(-1) if eids is not None else None
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        beg, end = int(colnp[n]), int(colnp[n + 1])
+        neigh = rownp[beg:end]
+        eid = eidnp[beg:end] if eidnp is not None else None
+        if sample_size != -1 and len(neigh) > sample_size:
+            pick = np.random.choice(len(neigh), sample_size, replace=False)
+            neigh = neigh[pick]
+            eid = eid[pick] if eid is not None else None
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eid is not None:
+            out_e.append(eid)
+    out_neighbors = Tensor(np.concatenate(out_n) if out_n else np.zeros(0, rownp.dtype))
+    out_count = Tensor(np.asarray(out_c, dtype=np.int32))
+    if return_eids:
+        return out_neighbors, out_count, Tensor(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64))
+    return out_neighbors, out_count
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Renumber (x + neighbors) to contiguous ids with x first."""
+    xs = _np(x).reshape(-1)
+    ns = _np(neighbors).reshape(-1)
+    cnt = _np(count).reshape(-1)
+    mapping = {}
+    order = []
+    for v in xs:
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+    for v in ns:
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+    reindex_src = np.asarray([mapping[int(v)] for v in ns], np.int64)
+    reindex_dst = np.repeat(
+        np.asarray([mapping[int(v)] for v in xs], np.int64), cnt)
+    out_nodes = np.asarray(order, xs.dtype)
+    return Tensor(reindex_src), Tensor(reindex_dst), Tensor(out_nodes)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                       return_eids=False, name=None):
+    """Multi-hop neighbor sampling + subgraph reindex."""
+    frontier = _np(input_nodes).reshape(-1)
+    all_neigh, all_cnt, all_dst, all_eids = [], [], [], []
+    for size in sample_sizes:
+        if return_eids:
+            neigh, cnt, eid = graph_sample_neighbors(
+                row, colptr, Tensor(frontier), eids=sorted_eids,
+                sample_size=size, return_eids=True)
+            all_eids.append(_np(eid))
+        else:
+            neigh, cnt = graph_sample_neighbors(
+                row, colptr, Tensor(frontier), sample_size=size)
+        all_neigh.append(_np(neigh))
+        all_cnt.append(_np(cnt))
+        all_dst.append(frontier)
+        frontier = np.unique(np.concatenate([frontier, _np(neigh)]))
+    neighbors = np.concatenate(all_neigh) if all_neigh else np.zeros(0, np.int64)
+    counts = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int32)
+    dsts = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    src, dst, out_nodes = graph_reindex(Tensor(dsts), Tensor(neighbors), Tensor(counts))
+    xs = _np(input_nodes).reshape(-1)
+    pos = {int(v): i for i, v in enumerate(_np(out_nodes))}
+    reindex_x = Tensor(np.asarray([pos[int(v)] for v in xs], np.int64))
+    if return_eids:
+        return src, dst, out_nodes, reindex_x, Tensor(np.concatenate(all_eids))
+    return src, dst, out_nodes, reindex_x

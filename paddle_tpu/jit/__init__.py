@@ -130,3 +130,58 @@ def load(path, **configs):
         with open(path + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
     return TranslatedLayer(state, meta)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dy2static debug verbosity — no bytecode translation stage here
+    (jax.jit traces Python directly), accepted for parity."""
+    return None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    return None
+
+
+class ProgramTranslator:
+    """Reference dy2static ProgramTranslator singleton façade."""
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+class TracedLayer:
+    """Reference fluid.dygraph.TracedLayer: trace a layer once, replay the
+    jitted program."""
+
+    def __init__(self, fn, example_inputs):
+        self._fn = fn
+        self._example = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        import jax
+        from ..nn.layer_base import functional_call, state_pytree
+        params = state_pytree(layer)
+
+        def pure(p, *xs):
+            return functional_call(layer, p, *xs)
+        jitted = jax.jit(lambda *xs: pure(params, *xs))
+        traced = TracedLayer(jitted, inputs)
+        outs = layer(*inputs)
+        return outs, traced
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        import pickle
+        with open(path + ".traced", "wb") as f:
+            pickle.dump({"note": "use jit.save for StableHLO export"}, f)

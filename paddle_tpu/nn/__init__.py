@@ -1,6 +1,10 @@
 """paddle_tpu.nn — reference python/paddle/nn/__init__.py."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
+from . import utils  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .utils import spectral_norm  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer_base import Layer, ParamAttr, functional_call, state_pytree  # noqa: F401

@@ -1,0 +1,175 @@
+"""Beam-search decoding — reference python/paddle/fluid/layers/rnn.py:870
+(BeamSearchDecoder) and :1587 (dynamic_decode).
+
+The decode loop runs as a host loop over jitted step functions (decode is
+latency-bound, not FLOP-bound; the per-step cell is still XLA-compiled).
+Production generation uses models.generate() (lax.scan + KV cache) — this
+class exists for API parity with paddle.nn.BeamSearchDecoder.
+"""
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decoder interface: initialize / step / finalize."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _tree_gather_beams(tree, beam_indices, batch_size, beam_size):
+    """Reorder the beam axis of every (B*K, ...) leaf by beam_indices (B, K)."""
+    def _g(leaf):
+        leaf = _unwrap(leaf)
+        if not hasattr(leaf, "shape") or leaf.ndim == 0:
+            return leaf
+        shaped = leaf.reshape((batch_size, beam_size) + leaf.shape[1:])
+        out = jnp.take_along_axis(
+            shaped, beam_indices.reshape((batch_size, beam_size) +
+                                         (1,) * (shaped.ndim - 2)).astype(jnp.int32),
+            axis=1)
+        return out.reshape(leaf.shape)
+    return jax.tree_util.tree_map(_g, tree)
+
+
+class BeamSearchDecoder(Decoder):
+    """Reference python/paddle/fluid/layers/rnn.py:BeamSearchDecoder."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*beam, ...) by tiling each batch item beam_size times."""
+        arr = _unwrap(x)
+        tiled = jnp.repeat(arr[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + arr.shape[1:]))
+
+    def _expand_to_beam_size(self, x):
+        arr = _unwrap(x)
+        return jnp.repeat(arr[:, None], self.beam_size, axis=1)
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size)._value
+            if hasattr(_unwrap(s), "shape") else s, initial_cell_states)
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        self._batch_size = int(leaf.shape[0]) // self.beam_size
+        b, k = self._batch_size, self.beam_size
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (k - 1), jnp.float32), (b, 1))
+        finished = jnp.zeros((b, k), jnp.bool_)
+        lengths = jnp.zeros((b, k), jnp.int32)
+        init_ids = jnp.full((b, k), self.start_token, jnp.int32)
+        init_inputs = self.embedding_fn(Tensor(init_ids.reshape(-1))) \
+            if self.embedding_fn is not None else Tensor(init_ids.reshape(-1))
+        state = self.StateWrapper(states, log_probs, finished, lengths)
+        return init_inputs, state, Tensor(finished)
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        b, k = self._batch_size, self.beam_size
+        logits = _unwrap(logits).astype(jnp.float32)
+        vocab = logits.shape[-1]
+        step_log_probs = jax.nn.log_softmax(logits.reshape(b, k, vocab))
+        # finished beams only extend with end_token at probability 1
+        noend = jnp.full((vocab,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_log_probs = jnp.where(beam_state.finished[:, :, None],
+                                   noend[None, None, :], step_log_probs)
+        total = beam_state.log_probs[:, :, None] + step_log_probs
+        flat = total.reshape(b, k * vocab)
+        topk_scores, topk_idx = jax.lax.top_k(flat, k)
+        beam_idx = (topk_idx // vocab).astype(jnp.int32)
+        token_ids = (topk_idx % vocab).astype(jnp.int32)
+        next_finished = jnp.take_along_axis(beam_state.finished, beam_idx, axis=1)
+        next_lengths = jnp.take_along_axis(beam_state.lengths, beam_idx, axis=1)
+        next_lengths = next_lengths + jnp.where(next_finished, 0, 1)
+        next_finished = next_finished | (token_ids == self.end_token)
+        cell_states = _tree_gather_beams(next_cell_states, beam_idx, b, k)
+        next_state = self.StateWrapper(cell_states, topk_scores,
+                                       next_finished, next_lengths)
+        output = self.OutputWrapper(Tensor(topk_scores), Tensor(token_ids),
+                                    Tensor(beam_idx.astype(jnp.int32)))
+        return output, next_state
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, next_cell_states = self.cell(inputs, states.cell_states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        outputs, next_state = self._beam_search_step(
+            time, cell_outputs, next_cell_states, states)
+        next_inputs = self.embedding_fn(outputs.predicted_ids.reshape([-1])) \
+            if self.embedding_fn is not None else outputs.predicted_ids
+        return outputs, next_state, next_inputs, Tensor(next_state.finished)
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Back-trace parent pointers (gather_tree) to emit final beams."""
+        pred = np.stack([np.asarray(_unwrap(o.predicted_ids)) for o in outputs])   # (T, B, K)
+        parents = np.stack([np.asarray(_unwrap(o.parent_ids)) for o in outputs])
+        t_max, b, k = pred.shape
+        out = np.zeros_like(pred)
+        beams = np.tile(np.arange(k), (b, 1))
+        for t in range(t_max - 1, -1, -1):
+            out[t] = np.take_along_axis(pred[t], beams, axis=1)
+            beams = np.take_along_axis(parents[t], beams, axis=1)
+        # (T, B, K) -> (B, T, K) as in reference finalize
+        return Tensor(jnp.asarray(out.transpose(1, 0, 2))), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run decoder.initialize/step until finished — reference
+    python/paddle/fluid/layers/rnn.py:dynamic_decode."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    limit = max_step_num if max_step_num is not None else 256
+    while step <= limit:
+        out, states, inputs, finished = decoder.step(step, inputs, states, **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(_unwrap(finished)).all()):
+            break
+    seq_len = Tensor(states.lengths) if hasattr(states, "lengths") else None
+    final_outputs, final_states = decoder.finalize(outputs, states, seq_len)
+    if output_time_major and isinstance(final_outputs, Tensor):
+        final_outputs = Tensor(jnp.swapaxes(_unwrap(final_outputs), 0, 1))
+    if return_length:
+        return final_outputs, final_states, seq_len
+    return final_outputs, final_states

@@ -11,7 +11,7 @@ __all__ = [
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "ctc_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
-    "npair_loss",
+    "npair_loss", "hsigmoid_loss", "margin_cross_entropy",
 ]
 
 
@@ -288,3 +288,68 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
         return _reduce(loss, reduction)
     return apply_op(_f, log_probs, labels, input_lengths, label_lengths)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid — reference python/paddle/nn/functional/loss.py:
+    hsigmoid_loss + phi hsigmoid_loss kernel (SimpleCode: for label l the path
+    code is c = l + num_classes; bit b's internal node is (c >> (b+1)) - 1 and
+    its binary target is (c >> b) & 1)."""
+    def _f(x, lab, w, b, ptab, pcode):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        n = x.shape[0]
+        if ptab is not None:
+            node = ptab[lab] if ptab.ndim == 1 else ptab  # (N, D) path rows
+            code = pcode[lab] if pcode.ndim == 1 else pcode
+            node = node.astype(jnp.int32)
+            valid = node >= 0
+            bit = code.astype(x.dtype)
+        else:
+            c = lab + num_classes
+            max_bits = int(np.ceil(np.log2(2 * num_classes)))
+            bits = jnp.arange(max_bits, dtype=jnp.int32)
+            shifted = c[:, None] >> (bits[None, :] + 1)
+            node = shifted - 1                       # (N, B) internal node ids
+            valid = shifted >= 1
+            bit = ((c[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+        node_safe = jnp.maximum(node, 0)
+        wrows = w[node_safe]                          # (N, B, D)
+        pre = jnp.einsum("nd,nbd->nb", x.astype(jnp.float32),
+                         wrows.astype(jnp.float32))
+        if b is not None:
+            pre = pre + b.reshape(-1)[node_safe].astype(jnp.float32)
+        # BCE-with-logits against the path bit, masked beyond the path length
+        losses = jax.nn.softplus(pre) - pre * bit.astype(jnp.float32)
+        losses = jnp.where(valid, losses, 0.0)
+        return jnp.sum(losses, axis=1, keepdims=True).astype(x.dtype)
+    return apply_op(_f, input, label, weight, bias, path_table, path_code)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-style margin softmax — reference python/paddle/nn/functional/
+    loss.py:margin_cross_entropy. Single-shard form; model-parallel sharded
+    classes are handled by meta_parallel.ParallelCrossEntropy."""
+    def _f(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        lg32 = lg.astype(jnp.float32)
+        onehot = jax.nn.one_hot(lab, lg.shape[-1], dtype=jnp.float32)
+        cos_t = jnp.clip(jnp.sum(lg32 * onehot, axis=-1), -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        target_logit = jnp.cos(margin1 * theta + margin2) - margin3
+        modified = lg32 + onehot * (target_logit[:, None] - cos_t[:, None])
+        modified = modified * scale
+        logsm = jax.nn.log_softmax(modified, axis=-1)
+        loss = -jnp.sum(logsm * onehot, axis=-1, keepdims=True)
+        if reduction == "mean":
+            lossr = jnp.mean(loss)
+        elif reduction == "sum":
+            lossr = jnp.sum(loss)
+        else:
+            lossr = loss
+        if return_softmax:
+            return lossr, jnp.exp(logsm).astype(lg.dtype)
+        return lossr
+    return apply_op(_f, logits, label)

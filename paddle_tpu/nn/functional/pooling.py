@@ -10,7 +10,7 @@ __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
-    "adaptive_max_pool3d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -64,19 +64,142 @@ def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
     return apply_op(_f, x)
 
 
+def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last=False,
+                        ceil_mode=False):
+    """Max pool (n spatial dims) returning (out, mask). The mask holds the
+    argmax position within the flattened input spatial plane — the contract
+    max_unpool* consumes (reference phi max_pool2d_with_index kernel).
+    Channel-last inputs are transposed to NC-first for the plane indexing,
+    then transposed back."""
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+    if isinstance(pads, str):
+        raise ValueError("string padding is not supported with return_mask")
+
+    def _raw(v):
+        spatial = v.shape[2:]
+        flat_iota = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+        idx = jnp.broadcast_to(flat_iota, v.shape)
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        wpads = [(0, 0), (0, 0)] + list(pads)
+        if ceil_mode:
+            # extend right padding so the last partial window is kept
+            for i in range(n):
+                size = spatial[i] + pads[i][0] + pads[i][1]
+                rem = (size - kernel[i]) % stride[i]
+                if rem:
+                    wpads[2 + i] = (pads[i][0], pads[i][1] + stride[i] - rem)
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(v.dtype, np.floating)
+                          else jnp.iinfo(v.dtype).min, v.dtype)
+        # variadic reduce: track (max value, its flat source index) per window
+        def reducer(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+        return jax.lax.reduce_window(
+            (v, idx), (neg, jnp.asarray(-1, jnp.int32)), reducer,
+            window, strides, wpads)
+
+    # the variadic reduce_window has no AD rule; the gradient of max-pool
+    # w.r.t. the input is exactly "scatter g at the argmax" — i.e. unpool.
+    @jax.custom_vjp
+    def _pool_op(v):
+        return _raw(v)
+
+    def _pool_fwd(v):
+        out, mask = _raw(v)
+        return (out, mask), (mask, v.shape)
+
+    def _pool_bwd(res, g):
+        mask, in_shape = res
+        g_out, _ = g
+        nc = in_shape[0] * in_shape[1]
+        flat_in = int(np.prod(in_shape[2:]))
+        vals = g_out.reshape(nc, -1)
+        flat_idx = mask.reshape(nc, -1).astype(jnp.int32)
+        dv = jnp.zeros((nc, flat_in), dtype=g_out.dtype)
+        dv = dv.at[jnp.arange(nc)[:, None], flat_idx].add(vals)
+        return (dv.reshape(in_shape),)
+
+    _pool_op.defvjp(_pool_fwd, _pool_bwd)
+
+    def _f(v):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        out, mask = _pool_op(v)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
+        return out, mask
+    return apply_op(_f, x)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, "max", False, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   channel_last=data_format == "NHWC",
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, "max", data_format == "NHWC", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   channel_last=data_format == "NDHWC",
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, "max", data_format == "NDHWC", ceil_mode)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n):
+    """Scatter pooled values back to their argmax positions — reference
+    python/paddle/nn/functional/pooling.py:max_unpool2d."""
+    kernel = _tuple(kernel_size, n)
+    stride = _tuple(stride if stride is not None else kernel_size, n)
+    pads = [p[0] for p in _pads(padding, n)]
+
+    def _f(v, idx):
+        in_spatial = v.shape[2:]
+        if output_size is not None:
+            osz = tuple(int(s) for s in output_size[-n:])
+        else:
+            osz = tuple((in_spatial[i] - 1) * stride[i] - 2 * pads[i] + kernel[i]
+                        for i in range(n))
+        nc = v.shape[0] * v.shape[1]
+        flat_out = int(np.prod(osz))
+        vals = v.reshape(nc, -1)
+        flat_idx = idx.reshape(nc, -1).astype(jnp.int32)
+        out = jnp.zeros((nc, flat_out), dtype=v.dtype)
+        out = out.at[jnp.arange(nc)[:, None], flat_idx].set(vals)
+        return out.reshape(v.shape[:2] + osz)
+    return apply_op(_f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -4,7 +4,7 @@ from ..initializer import Constant
 from ..layer_base import Layer
 
 __all__ = [
-    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
+    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "Softmax2D", "LogSoftmax",
     "LeakyReLU", "ELU", "CELU", "SELU", "Silu", "Swish", "Mish", "Hardswish",
     "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink", "Softplus",
     "Softsign", "Tanhshrink", "ThresholdedReLU", "LogSigmoid", "Maxout",
@@ -52,6 +52,17 @@ class Softmax(Layer):
 
     def forward(self, x):
         return F.softmax(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW / CHW inputs — reference
+    python/paddle/nn/layer/activation.py:Softmax2D."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
 
 
 class LogSoftmax(Layer):

@@ -1,0 +1,168 @@
+"""Quantization-aware-training layers — reference
+python/paddle/nn/quant/quant_layers.py. Fake-quant: quantize→dequantize in
+forward with a straight-through estimator, so XLA still sees dense bf16/fp32
+matmuls (real int8 execution lives in paddle_tpu.quantization)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from ..layer_base import Layer
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax", "FakeQuantChannelWiseAbsMax",
+    "QuantizedConv2D", "QuantizedConv2DTranspose", "QuantizedLinear",
+    "MovingAverageAbsMaxScale", "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+    "QuantStub",
+]
+
+
+def _fake_quant(x, scale, bits):
+    """Quantize-dequantize with straight-through gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    def _f(v, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+        # straight-through: forward q, backward identity
+        return v + jax.lax.stop_gradient(q - v)
+    return apply_op(_f, x, scale)
+
+
+class FakeQuantAbsMax(Layer):
+    def __init__(self, name=None, quant_bits=8, dtype="float32", quant_on_weight=False):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, input):
+        scale = input.abs().max()
+        return _fake_quant(input, scale, self._quant_bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", quant_on_weight=False):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+
+    def forward(self, input):
+        def _f(v):
+            axes = tuple(a for a in range(v.ndim) if a != self._quant_axis)
+            return jnp.max(jnp.abs(v), axis=axes, keepdims=True)
+        scale = apply_op(_f, input)
+        return _fake_quant(input, scale, self._quant_bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self._seen = False
+        self.register_buffer("scale", Tensor(jnp.ones([])), persistable=True)
+
+    def forward(self, input):
+        if self.training:
+            cur = input.abs().max()
+            if not self._seen:   # seed the EMA with the first observation
+                new = cur._value
+                self._seen = True
+            else:
+                new = self.scale._value * self._moving_rate \
+                    + cur._value * (1 - self._moving_rate)
+            self.scale._value = jax.lax.stop_gradient(new)
+        return _fake_quant(input, Tensor(self.scale._value), self._quant_bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observes abs-max scale of activations without quantizing."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones([])), persistable=True)
+
+    def forward(self, input):
+        if self.training:
+            cur = input.abs().max()
+            new = self.scale._value * self._moving_rate \
+                + cur._value * (1 - self._moving_rate)
+            self.scale._value = jax.lax.stop_gradient(new)
+        return input
+
+
+class _QuantizedWrapper(Layer):
+    """Wraps a float layer: fake-quants weight + activation, then calls it."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="abs_max", activation_quantize_type="moving_average_abs_max",
+                 **kwargs):
+        super().__init__()
+        self._inner = layer
+        if weight_quantize_type == "channel_wise_abs_max":
+            self._fake_quant_weight = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits)
+        else:
+            self._fake_quant_weight = FakeQuantAbsMax(quant_bits=weight_bits, quant_on_weight=True)
+        self._fake_quant_input = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, input):
+        qin = self._fake_quant_input(input)
+        w = self._inner.weight
+        qw = self._fake_quant_weight(Tensor(w._value, stop_gradient=w.stop_gradient))
+        saved = w._value
+        try:
+            self._inner.weight._value = qw._value
+            return self._inner(qin)
+        finally:
+            self._inner.weight._value = saved
+
+
+class QuantizedLinear(_QuantizedWrapper):
+    pass
+
+
+class QuantizedConv2D(_QuantizedWrapper):
+    pass
+
+
+class QuantizedConv2DTranspose(_QuantizedWrapper):
+    pass
+
+
+class QuantStub(Layer):
+    """Marks a quantization entry point; observes activation scale."""
+
+    def __init__(self, name=None, moving_rate=0.9):
+        super().__init__()
+        self._observer = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, input):
+        return self._observer(input)
+
+
+class MAOutputScaleLayer(Layer):
+    def __init__(self, layer=None, moving_rate=0.9, name=None, dtype="float32"):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._ma_output_scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 name=None, *args, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._fake_quant_output(out)

@@ -1,0 +1,137 @@
+"""nn.utils — reference python/paddle/nn/utils/__init__.py
+(weight_norm_hook.py, spectral_norm_hook.py, transform_parameters.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Parameter, Tensor
+from ... import tensor as _T
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    """L2 norm of w over all axes except `dim` (dim=None reduces everything)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(a for a in range(w.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        vv = v._value if isinstance(v, Tensor) else v
+        gv = g._value if isinstance(g, Tensor) else g
+        w = vv * (gv / _norm_except(vv, self.dim))
+        return w
+
+    def __call__(self, layer, inputs):
+        w = self.compute(layer)
+        object.__setattr__(layer, self.name, Tensor(w, stop_gradient=False))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.name` as g * v / ||v|| — reference
+    python/paddle/nn/utils/weight_norm_hook.py."""
+    w = getattr(layer, name)
+    arr = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    g0 = _norm_except(arr, dim)
+    # replace the original parameter with (g, v)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(arr))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = (hook, handle)
+    hook(layer, ())  # materialize layer.<name> immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm of '{name}' not found in {type(layer).__name__}")
+    hook, handle = hooks.pop(name)
+    w = hook.compute(layer)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, dim, eps):
+        self.name = name
+        self.n = n_power_iterations
+        self.dim = dim
+        self.eps = eps
+
+    def compute(self, layer):
+        w = getattr(layer, self.name + "_orig")
+        arr = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+        mat = jnp.moveaxis(arr, self.dim, 0).reshape(arr.shape[self.dim], -1)
+        u = layer.__dict__["_sn_u_" + self.name]
+        v = None
+        for _ in range(max(self.n, 1)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        layer.__dict__["_sn_u_" + self.name] = u
+        sigma = u @ (mat @ v)
+        return arr / sigma
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name,
+                           Tensor(self.compute(layer), stop_gradient=False))
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Spectral normalization hook — reference
+    python/paddle/nn/utils/spectral_norm_hook.py."""
+    if dim is None:
+        dim = 1 if type(layer).__name__ in ("Linear", "Embedding") else 0
+    w = getattr(layer, name)
+    arr = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(arr))
+    rows = arr.shape[dim]
+    key = np.random.RandomState(0).normal(size=(rows,)).astype(np.float32)
+    layer.__dict__["_sn_u_" + name] = jnp.asarray(key / (np.linalg.norm(key) + eps))
+    hook = _SpectralNormHook(name, n_power_iterations, dim, eps)
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = (hook, handle)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a list of parameters into one 1-D tensor — reference
+    python/paddle/nn/utils/transform_parameters.py."""
+    parts = []
+    for p in parameters:
+        arr = p._value if isinstance(p, Tensor) else jnp.asarray(p)
+        parts.append(arr.reshape(-1))
+    return Tensor(jnp.concatenate(parts))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Slice a flat vector back into the given parameters (in place)."""
+    arr = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        chunk = arr[offset:offset + n].reshape(p.shape)
+        p._value = chunk.astype(p._value.dtype)
+        offset += n

@@ -94,6 +94,218 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                          block_k, seq_k):
+    """Forward that also writes logsumexp rows (for the Pallas backward)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    bq, d = q.shape
+    q_idx = pl.program_id(2)
+    m = jnp.full((bq, 1), -1e30, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+    n_k = seq_k // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + p @ v
+        return m_new, l_new, acc_new
+
+    if causal:
+        q_end = (q_idx + 1) * bq
+        n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
+        m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
+    lsafe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / lsafe).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(lsafe))[:, 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale, causal, block_k, seq_k):
+    """dQ = sum_k dS @ K with dS = P * (dP - delta) * scale, P recomputed
+    blockwise from the saved logsumexp (standard flash backward)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]
+    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
+    bq, d = q.shape
+    q_idx = pl.program_id(2)
+    n_k = seq_k // block_k
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    def body(i, dq):
+        k = k_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * scale
+        return dq + ds @ k
+
+    if causal:
+        q_end = (q_idx + 1) * bq
+        n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
+        dq = jax.lax.fori_loop(0, n_live, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, n_k, body, dq)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    """dK/dV for one k block, looping over q blocks."""
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k.shape
+    k_idx = pl.program_id(2)
+    n_q = seq_q // block_q
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)[:, None]
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)[:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = k_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * scale
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        return dk_new, dv_new
+
+    if causal:
+        # only q blocks at or after this k block's start participate
+        q_start = (k_idx * bk) // block_q
+        dk, dv = jax.lax.fori_loop(q_start, n_q, body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, n_q, body, (dk, dv))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd_lse_impl(q, k, v, causal, scale, interpret=None):
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    bq = min(_BLOCK_Q, Lq)
+    bk = min(_BLOCK_K, Lk)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    grid = (B, H, Lq // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_lse_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=Lk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out, 1, 2), lse
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    bq = min(_BLOCK_Q, Lq)
+    bk = min(_BLOCK_K, Lk)
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    doh = jnp.swapaxes(g, 1, 2)
+    oh = jnp.swapaxes(out, 1, 2)
+    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=Lk),
+        grid=(B, H, Lq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, seq_q=Lq),
+        grid=(B, H, Lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Lq), lambda b, h, j: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     return _flash_fwd(q, k, v, causal, scale)
@@ -138,13 +350,22 @@ def _flash_fwd(q, k, v, causal, scale):
 
 
 def _flash_fwd_vjp(q, k, v, causal, scale):
-    out = _flash(q, k, v, causal, scale)
-    return out, (q, k, v, out)
+    try:
+        out, lse = _flash_fwd_lse_impl(q, k, v, causal, scale)
+        return out, (q, k, v, out, lse)
+    except Exception:
+        out = mha_reference(q, k, v, causal=causal, scale=scale)
+        return out, (q, k, v, out, None)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v, out = res
-    # reference backward (XLA-fused); a Pallas bwd kernel is a later round's win
+    q, k, v, out, lse = res
+    if lse is not None:
+        try:
+            return _flash_bwd_impl(q, k, v, out, lse, g, causal, scale)
+        except Exception:
+            pass
+    # fallback: XLA vjp of the reference (materializes L x L probs)
     def f(q, k, v):
         return mha_reference(q, k, v, causal=causal, scale=scale)
     _, vjp = jax.vjp(f, q, k, v)

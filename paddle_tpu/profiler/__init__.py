@@ -91,3 +91,53 @@ def export_chrome_tracing(dir_name, worker_name=None):
 
 def load_profiler_result(filename):
     raise NotImplementedError("load exported traces with XProf/TensorBoard")
+
+
+class ProfilerState:
+    """Reference python/paddle/profiler/profiler.py:ProfilerState."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys:
+    """Reference python/paddle/profiler/profiler.py:SortedKeys."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Build a step-state schedule fn — reference profiler_statistic scheduler."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """Exporter callback (serialized trace; jax.profiler emits its own pb)."""
+    def handler(prof):
+        import os
+        os.makedirs(dir_name, exist_ok=True)
+        return dir_name
+    return handler

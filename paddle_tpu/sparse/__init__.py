@@ -176,9 +176,18 @@ def _ewise(x, y, fn):
 
 
 def dense_to_coo(x, sparse_dim=None):
-    """Tensor -> SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    """Tensor -> SparseCooTensor (reference Tensor.to_sparse_coo). With
+    sparse_dim < ndim, indices cover the leading sparse_dim axes and values
+    keep the trailing dense axes (e.g. NDHWC with sparse_dim=4 -> per-site
+    channel vectors)."""
     arr = np.asarray(x._value if isinstance(x, Tensor) else x)
-    idx = np.stack(np.nonzero(arr))
+    if sparse_dim is None or sparse_dim == arr.ndim:
+        idx = np.stack(np.nonzero(arr))
+        vals = arr[tuple(idx)]
+        return SparseCooTensor(idx, vals, arr.shape)
+    lead = arr.reshape(arr.shape[:sparse_dim] + (-1,))
+    active = np.abs(lead).sum(axis=-1) != 0
+    idx = np.stack(np.nonzero(active))
     vals = arr[tuple(idx)]
     return SparseCooTensor(idx, vals, arr.shape)
 
@@ -238,12 +247,85 @@ class _SparseNN:
 
         def __call__(self, x):
             from ..nn.functional.pooling import max_pool3d
-            dense = to_dense(x)
-            out = max_pool3d(dense, self.kernel_size, self.stride, self.padding)
-            return dense_to_coo(out)
+            dense = to_dense(x)   # NDHWC (reference sparse pooling is channel-last)
+            out = max_pool3d(dense, self.kernel_size, self.stride, self.padding,
+                             data_format="NDHWC")
+            return dense_to_coo(out, sparse_dim=4)
 
+
+class _SparseConv3DBase:
+    """Sparse 3-D convolution over NDHWC COO tensors — reference
+    python/paddle/sparse/layer/conv.py:_Conv3D. Computes via densify →
+    XLA conv → re-sparsify; on TPU the dense conv IS the fast path (MXU),
+    gather/scatter sparse kernels are not."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        from ..nn.initializer import XavierUniform
+        from ..framework.core import Parameter
+        from ..framework.random import next_key
+        import jax
+        if data_format != "NDHWC":
+            raise ValueError("sparse Conv3D only supports NDHWC")
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 3
+        self.kernel_size = list(ks)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        fan_in = in_channels * int(np.prod(self.kernel_size))
+        bound = float(np.sqrt(6.0 / max(fan_in + out_channels * int(np.prod(self.kernel_size)), 1)))
+        # weight layout matches reference: (kd, kh, kw, in_c/groups, out_c)
+        wshape = self.kernel_size + [in_channels // groups, out_channels]
+        self.weight = Parameter(jax.random.uniform(next_key(), wshape, jnp.float32,
+                                                   -bound, bound))
+        self.bias = Parameter(jnp.zeros([out_channels], jnp.float32))             if bias_attr is not False else None
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None else [])
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def forward(self, x):
+        from ..nn.functional.conv import conv3d
+        dense = to_dense(x)                           # (N, D, H, W, C)
+        # our conv weights are (out_c, in_c/groups, kd, kh, kw)
+        w = Tensor(jnp.transpose(self.weight._value, (4, 3, 0, 1, 2)))
+        out = conv3d(dense, w, self.bias, stride=self.stride, padding=self.padding,
+                     dilation=self.dilation, groups=self.groups, data_format="NDHWC")
+        if self._subm:
+            # submanifold: keep only the input's active sites
+            mask_vals = jnp.ones((x.indices.shape[1], 1), jnp.float32)
+            mask = SparseCooTensor(x.indices, Tensor(mask_vals),
+                                   list(x.shape[:-1]) + [1])
+            dm = to_dense(mask)._value
+            out = Tensor(out._value * (dm > 0))
+        return dense_to_coo(out, sparse_dim=4)
+
+
+class Conv3D(_SparseConv3DBase):
+    _subm = False
+
+
+class SubmConv3D(_SparseConv3DBase):
+    _subm = True
+
+
+_SparseNN.Conv3D = Conv3D
+_SparseNN.SubmConv3D = SubmConv3D
 
 nn = _SparseNN()
+
+# v2.3 exposes the sparse layers at paddle.sparse top level too
+ReLU = _SparseNN.ReLU
+BatchNorm = _SparseNN.BatchNorm
+MaxPool3D = _SparseNN.MaxPool3D
 
 __all__ += ["sqrt", "sin", "square", "abs", "neg", "expm1", "log1p", "asin",
             "atan", "sinh", "asinh", "atanh", "pow", "cast", "add", "subtract",

@@ -12,6 +12,8 @@ graph (jax.value_and_grad), and applies the optimizer update.
 
 No op-by-op interpreter, no Program protobuf: XLA *is* the executor.
 """
+import pickle
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -154,6 +156,11 @@ class Executor:
         self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        if isinstance(program, CompiledProgram):
+            program = program._program or _main
+        if hasattr(program, "run_feed"):   # deserialized inference program
+            outs = program.run_feed(feed or {})
+            return [np.asarray(o) for o in outs] if return_numpy else outs
         program = program or _main
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -416,4 +423,717 @@ def _reshape_keep(x, keep_dims, flat):
 
 
 nn = _StaticNN()
-__all__ += ["program_guard", "nn"]
+__all__ += ["program_guard", "nn", "Variable", "BuildStrategy", "ExecutionStrategy",
+            "IpuStrategy", "CompiledProgram", "IpuCompiledProgram", "ipu_shard_guard",
+            "ParallelExecutor", "device_guard", "Print", "WeightNormParamAttr",
+            "ExponentialMovingAverage", "create_global_var", "create_parameter",
+            "accuracy", "auc", "xpu_places", "npu_places", "mlu_places",
+            "normalize_program", "serialize_program", "serialize_persistables",
+            "save_to_file", "load_from_file", "deserialize_program",
+            "deserialize_persistables", "save_inference_model",
+            "load_inference_model", "load_program_state", "set_program_state"]
+
+
+# ---------------------------------------------------------------------------
+# Program compilation / execution config façades — reference
+# python/paddle/static/__init__.py. Under XLA there is exactly one build
+# pipeline (trace -> StableHLO -> XLA), so these carry config for parity and
+# feed the same Executor path.
+
+Variable = SymbolicVar
+
+
+class BuildStrategy:
+    """reference fluid/compiler.py BuildStrategy (attribute bag)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.config = {}
+
+    def set_graph_config(self, **kw):
+        self.config.update(kw)
+
+    def set_pipelining_config(self, **kw):
+        self.config.update(kw)
+
+    def set_precision_config(self, **kw):
+        self.config.update(kw)
+
+
+class CompiledProgram:
+    """reference fluid/compiler.py:CompiledProgram — XLA compiles every
+    program; this wrapper only carries the strategy."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None, places=None):
+        return self
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_program"), item)
+
+
+class IpuCompiledProgram(CompiledProgram):
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        super().__init__(program)
+        self._ipu_strategy = ipu_strategy
+
+
+class ipu_shard_guard:
+    def __init__(self, index=-1, stage=-1):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ParallelExecutor:
+    """Legacy multi-card executor — GSPMD replaces graph replication; runs the
+    plain Executor underneath."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+class device_guard:
+    """reference static device_guard context — XLA owns placement."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Identity op with host-side printing (reference fluid Print op)."""
+    from ..framework.core import apply_op
+
+    def _f(v):
+        jax.debug.print((message or "Var") + ": {}", v)
+        return v
+    return apply_op(_f, input)
+
+
+from ..nn.layer_base import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """reference python/paddle/fluid/param_attr.py:WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable, need_clip=need_clip)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters — reference
+    python/paddle/fluid/optimizer.py:ExponentialMovingAverage."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameter_list=None):
+        self._decay = decay
+        self._params = list(parameter_list) if parameter_list is not None else []
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def _param_iter(self):
+        return [(id(p), p) for p in self._params]
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for key, p in self._param_iter():
+            cur = p._value
+            prev = self._ema.get(key, cur)
+            self._ema[key] = d * prev + (1 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        ema = self
+
+        class _ApplyCtx:
+            def __enter__(ctx):
+                for key, p in ema._param_iter():
+                    ema._backup[key] = p._value
+                    if key in ema._ema:
+                        p._value = ema._ema[key]
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+        return _ApplyCtx()
+
+    def restore(self, executor=None):
+        for key, p in self._param_iter():
+            if key in self._backup:
+                p._value = self._backup.pop(key)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    return Tensor(jnp.full([int(s) for s in shape], value, _as_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy op (reference python/paddle/static/nn/metric.py)."""
+    from ..framework.core import apply_op
+
+    def _f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab.reshape(-1, 1)
+        hit = (topk == lab2).any(axis=-1)
+        return hit.mean(dtype=jnp.float32)
+    return apply_op(_f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """Batch AUC (reference static.auc). Returns (auc, [stat placeholders])."""
+    from ..framework.core import apply_op
+
+    def _f(pred, lab):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+        lab_ = lab.reshape(-1)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(jnp.arange(1, score.shape[0] + 1))
+        npos = jnp.sum(lab_ == 1)
+        nneg = jnp.sum(lab_ == 0)
+        rank_sum = jnp.sum(jnp.where(lab_ == 1, ranks, 0))
+        return ((rank_sum - npos * (npos + 1) / 2.0)
+                / jnp.maximum(npos * nneg, 1)).astype(jnp.float32)
+    a = apply_op(_f, input, label)
+    return a, [a]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# --- inference program serialization (jax.export-backed) -------------------
+
+class _LoadedProgram(Program):
+    """Deserialized inference program: a callable XLA artifact + metadata."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        super().__init__()
+        self._exported = exported
+        self._feed_names = list(feed_names)
+        self._n_fetch = n_fetch
+
+    def run_feed(self, feed):
+        args = [jnp.asarray(np.asarray(feed[n])) for n in self._feed_names]
+        out = self._exported.call(*args)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Attach feed/fetch info to the program (reference prunes + normalizes;
+    our traced graphs are already minimal)."""
+    program._norm_feed = [v._feed_name for v in feed_vars]
+    program._norm_fetch = list(fetch_vars)
+    return program
+
+
+def _build_inference_fn(feed_vars, fetch_vars):
+    order, feed_names, consts = _toposort(list(fetch_vars))
+    const_map = {id(c): c._value for c in consts}
+    names = [v._feed_name for v in feed_vars]
+
+    def fn(*args):
+        fmap = dict(zip(names, args))
+        return tuple(_eval_graph(list(fetch_vars), order, fmap, const_map))
+    examples = [jnp.zeros(v._value.shape, v._value.dtype) for v in feed_vars]
+    return fn, names, examples
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """Serialize the traced inference graph via jax.export (StableHLO bytes)."""
+    from jax import export as jexport
+    fn, names, examples = _build_inference_fn(feed_vars, fetch_vars)
+    exported = jexport.export(jax.jit(fn))(*examples)
+    blob = exported.serialize()
+    header = pickle.dumps({"feed_names": names, "n_fetch": len(fetch_vars)})
+    return len(header).to_bytes(8, "little") + header + bytes(blob)
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    _, _, consts = _toposort(list(fetch_vars))
+    state = {f"const_{i}": np.asarray(c._value) for i, c in enumerate(consts)}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    from jax import export as jexport
+    hlen = int.from_bytes(data[:8], "little")
+    meta = pickle.loads(data[8:8 + hlen])
+    exported = jexport.deserialize(bytearray(data[8 + hlen:]))
+    return _LoadedProgram(exported, meta["feed_names"], meta["n_fetch"])
+
+
+def deserialize_persistables(program, data, executor=None):
+    return pickle.loads(data)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """reference python/paddle/static/io.py:save_inference_model — emits
+    {path}.pdmodel (serialized XLA artifact) + {path}.pdiparams."""
+    import os as _os
+    _os.makedirs(_os.path.dirname(path_prefix) or ".", exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel", serialize_program(feed_vars, fetch_vars))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    prog = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    fetch_handles = list(range(prog._n_fetch))
+    return [prog, prog._feed_names, fetch_handles]
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdiparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    program._loaded_state = dict(state_dict)
+
+
+# --- static.nn op-style builders (reference python/paddle/static/nn) --------
+
+def _static_nn_extend():
+    from .. import nn as dyn_nn
+    from ..nn import functional as F
+    from ..framework.core import apply_op as _apply_op
+
+    def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                         stride=1, padding=0, groups=1, dilation=1, act=None,
+                         name=None):
+        in_ch = int(input.shape[1])
+        layer = dyn_nn.Conv2DTranspose(in_ch, num_filters, filter_size,
+                                       stride=stride, padding=padding,
+                                       groups=groups, dilation=dilation)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+               groups=1, act=None, name=None):
+        in_ch = int(input.shape[1])
+        layer = dyn_nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                              padding=padding, dilation=dilation, groups=groups)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                         stride=1, padding=0, groups=1, dilation=1, act=None,
+                         name=None):
+        in_ch = int(input.shape[1])
+        layer = dyn_nn.Conv3DTranspose(in_ch, num_filters, filter_size,
+                                       stride=stride, padding=padding,
+                                       groups=groups, dilation=dilation)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                   epsilon=1e-5, act=None, name=None):
+        shape = [int(s) for s in input.shape[begin_norm_axis:]]
+        layer = dyn_nn.LayerNorm(shape, epsilon=epsilon,
+                                 weight_attr=None if scale else False,
+                                 bias_attr=None if shift else False)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def group_norm(input, groups, epsilon=1e-5, act=None, name=None,
+                   param_attr=None, bias_attr=None, data_layout="NCHW"):
+        ch = int(input.shape[1])
+        layer = dyn_nn.GroupNorm(groups, ch, epsilon=epsilon)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                      name=None):
+        ch = int(input.shape[1])
+        cls = dyn_nn.InstanceNorm2D if len(input.shape) == 4 else dyn_nn.InstanceNorm1D
+        return cls(ch, epsilon=epsilon)(input)
+
+    def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+                  data_layout="NCHW", in_place=False, name=None,
+                  moving_mean_name=None, moving_variance_name=None,
+                  do_model_average_for_mean_and_var=True, slot_dim=-1,
+                  sync_stats=False, summary_decay_rate=0.9999999, enable_scale_and_shift=False):
+        def _f(v):
+            mean = v.mean(axis=0, keepdims=True)
+            var = v.var(axis=0, keepdims=True)
+            return (v - mean) / jnp.sqrt(var + epsilon)
+        out = _apply_op(_f, input)
+        return getattr(F, act)(out) if act else out
+
+    def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+        if mode == "all":
+            n = 1
+        elif mode == "channel":
+            n = int(x.shape[1])
+        else:
+            n = int(np.prod([int(s) for s in x.shape[1:]]))
+        layer = dyn_nn.PReLU(num_parameters=n, data_format=data_format)
+        return layer(x)
+
+    def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+        layer = dyn_nn.SpectralNorm(
+            [int(s) for s in weight.shape], dim=dim, power_iters=power_iters, eps=eps)
+        return layer(weight)
+
+    def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                      padding=0, dilation=1, groups=1, deformable_groups=1,
+                      im2col_step=1, param_attr=None, bias_attr=None,
+                      modulated=True, name=None):
+        from ..vision.ops import DeformConv2D as _DC
+        ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+            else tuple(filter_size)
+        layer = _DC(int(x.shape[1]), num_filters, ks, stride=stride,
+                    padding=padding, dilation=dilation,
+                    deformable_groups=deformable_groups, groups=groups,
+                    bias_attr=bias_attr)
+        return layer(x, offset, mask if modulated else None)
+
+    def bilinear_tensor_product(x, y, size, act=None, name=None,
+                                param_attr=None, bias_attr=None):
+        layer = dyn_nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size)
+        out = layer(x, y)
+        return getattr(F, act)(out) if act else out
+
+    def crf_decoding(input, param_attr=None, label=None, length=None):
+        from ..text import viterbi_decode
+        raise NotImplementedError(
+            "use paddle_tpu.text.ViterbiDecoder (lax.scan CRF decode)")
+
+    def row_conv(input, future_context_size, param_attr=None, act=None):
+        """Lookahead row convolution (reference fluid row_conv op):
+        out[t] = sum_{k=0..K} w[k] * in[t+k]."""
+        k = future_context_size + 1
+        d = int(input.shape[-1])
+        from ..framework.core import Parameter
+        from ..framework.random import next_key
+        w = Parameter(jax.random.normal(next_key(), (k, d)) * 0.1)
+
+        def _f(v, wv):
+            pad = jnp.pad(v, [(0, 0), (0, k - 1), (0, 0)])
+            out = sum(pad[:, i:i + v.shape[1]] * wv[i] for i in range(k))
+            return out
+        out = _apply_op(_f, input, w)
+        return getattr(F, act)(out) if act else out
+
+    def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+            bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+            custom_dist=None, seed=0, is_sparse=False):
+        """Noise-contrastive estimation loss (reference fluid nce op),
+        uniform negative sampling."""
+        from ..framework.core import Parameter
+        from ..framework.random import next_key
+        d = int(input.shape[-1])
+        k = num_neg_samples or 10
+        w = Parameter(jax.random.normal(next_key(), (num_total_classes, d)) * 0.01)
+        b = Parameter(jnp.zeros((num_total_classes,)))
+
+        def _f(x, lab, wv, bv):
+            n = x.shape[0]
+            lab = lab.reshape(-1).astype(jnp.int32)
+            pos_logit = jnp.einsum("nd,nd->n", x, wv[lab]) + bv[lab]
+            neg_ids = jax.random.randint(jax.random.PRNGKey(seed), (n, k),
+                                         0, num_total_classes)
+            neg_logit = jnp.einsum("nd,nkd->nk", x, wv[neg_ids]) + bv[neg_ids]
+            loss = jax.nn.softplus(-pos_logit) + \
+                jnp.sum(jax.nn.softplus(neg_logit), axis=1)
+            return loss.reshape(-1, 1)
+        return _apply_op(_f, input, label, w, b)
+
+    def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                       min_ratio=None, max_ratio=None, min_sizes=None,
+                       max_sizes=None, steps=None, step_w=None, step_h=None,
+                       offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                       clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                       min_max_aspect_ratios_order=False):
+        """SSD detection head (reference fluid multi_box_head): per-feature-map
+        loc/conf convs + prior boxes."""
+        n_in = len(inputs)
+        if min_sizes is None:
+            step = int(np.floor((max_ratio - min_ratio) / max(n_in - 2, 1)))
+            min_sizes, max_sizes = [], []
+            for r in range(min_ratio, max_ratio + 1, step):
+                min_sizes.append(base_size * r / 100.0)
+                max_sizes.append(base_size * (r + step) / 100.0)
+            min_sizes = [base_size * 0.1] + min_sizes
+            max_sizes = [base_size * 0.2] + max_sizes
+        locs, confs, priors, vars_ = [], [], [], []
+        img_h = int(image.shape[2])
+        img_w = int(image.shape[3])
+        for i, x in enumerate(inputs):
+            ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+                else [aspect_ratios[i]]
+            n_prior = 2 + len(ar) * (2 if flip else 1)
+            loc = _StaticNN.conv2d(x, n_prior * 4, kernel_size, stride=stride,
+                                   padding=pad)
+            conf = _StaticNN.conv2d(x, n_prior * num_classes, kernel_size,
+                                    stride=stride, padding=pad)
+            fh, fw = int(x.shape[2]), int(x.shape[3])
+            # prior boxes for this feature map
+            smin, smax = min_sizes[i], max_sizes[i]
+            widths, heights = [smin, float(np.sqrt(smin * smax))], \
+                [smin, float(np.sqrt(smin * smax))]
+            for a in ar:
+                widths += [smin * float(np.sqrt(a))] + ([smin / float(np.sqrt(a))] if flip else [])
+                heights += [smin / float(np.sqrt(a))] + ([smin * float(np.sqrt(a))] if flip else [])
+            sw = step_w or img_w / fw
+            sh = step_h or img_h / fh
+            cy, cx = np.meshgrid((np.arange(fh) + offset) * sh,
+                                 (np.arange(fw) + offset) * sw, indexing="ij")
+            boxes = []
+            for w_, h_ in zip(widths, heights):
+                x1 = (cx - w_ / 2) / img_w
+                y1 = (cy - h_ / 2) / img_h
+                x2 = (cx + w_ / 2) / img_w
+                y2 = (cy + h_ / 2) / img_h
+                boxes.append(np.stack([x1, y1, x2, y2], -1))
+            pb = np.stack(boxes, 2).reshape(-1, 4)
+            if clip:
+                pb = np.clip(pb, 0, 1)
+            priors.append(pb.astype(np.float32))
+            vars_.append(np.tile(np.asarray(variance, np.float32), (pb.shape[0], 1)))
+            from ..tensor.manipulation import reshape, transpose
+            locs.append(reshape(transpose(loc, [0, 2, 3, 1]), [int(loc.shape[0]), -1, 4]))
+            confs.append(reshape(transpose(conf, [0, 2, 3, 1]),
+                                 [int(conf.shape[0]), -1, num_classes]))
+        from ..tensor.manipulation import concat
+        mbox_loc = concat(locs, axis=1)
+        mbox_conf = concat(confs, axis=1)
+        prior_boxes = Tensor(jnp.asarray(np.concatenate(priors)))
+        box_vars = Tensor(jnp.asarray(np.concatenate(vars_)))
+        return mbox_loc, mbox_conf, prior_boxes, box_vars
+
+    # control flow (host-evaluated: dygraph semantics; inside jit use
+    # paddle_tpu's lax-backed cond/while wrappers)
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        p = bool(np.asarray(pred._value if isinstance(pred, Tensor) else pred))
+        if p:
+            return true_fn() if true_fn else None
+        return false_fn() if false_fn else None
+
+    def case(pred_fn_pairs, default=None, name=None):
+        for pred, fn in pred_fn_pairs:
+            if bool(np.asarray(pred._value if isinstance(pred, Tensor) else pred)):
+                return fn()
+        return default() if default else None
+
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        idx = int(np.asarray(branch_index._value if isinstance(branch_index, Tensor)
+                             else branch_index))
+        fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+        if idx in fns:
+            return fns[idx]()
+        return default() if default else None
+
+    def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+        vars_ = list(loop_vars)
+        while True:
+            c = cond_fn(*vars_)
+            if not bool(np.asarray(c._value if isinstance(c, Tensor) else c)):
+                break
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        res = func(*[np.asarray(v._value) for v in xs])
+        return Tensor(jnp.asarray(res))
+
+    def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                        entry=None, param_attr=None, dtype="float32"):
+        return _StaticNN.embedding(input, size, is_sparse=True,
+                                   padding_idx=padding_idx)
+
+    # sequence ops: LoD-era API; here inputs are dense (B, T, ...) tensors
+    # (the padded form paddle 2.x prefers anyway).
+    def sequence_softmax(input, use_cudnn=False, name=None):
+        return F.softmax(input, axis=1)
+
+    def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                      padding=True, padding_start=None, bias_attr=None,
+                      param_attr=None, act=None, name=None):
+        d = int(input.shape[-1])
+        layer = dyn_nn.Conv1D(d, num_filters, filter_size,
+                              stride=filter_stride,
+                              padding=(filter_size // 2) if padding else 0,
+                              data_format="NLC")
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def sequence_pool(input, pool_type="average", is_test=False, pad_value=0.0):
+        from ..framework.core import apply_op as _ap
+        ops = {"average": jnp.mean, "sum": jnp.sum, "max": jnp.max,
+               "min": jnp.min, "sqrt": None, "last": None, "first": None}
+        pt = pool_type.lower()
+
+        def _f(v):
+            if pt == "last":
+                return v[:, -1]
+            if pt == "first":
+                return v[:, 0]
+            if pt == "sqrt":
+                return jnp.sum(v, axis=1) / jnp.sqrt(jnp.asarray(v.shape[1], v.dtype))
+            return ops[pt](v, axis=1)
+        return _ap(_f, input)
+
+    def sequence_concat(input, name=None):
+        from ..tensor.manipulation import concat as _cat
+        return _cat(list(input), axis=1)
+
+    def sequence_first_step(input):
+        return sequence_pool(input, "first")
+
+    def sequence_last_step(input):
+        return sequence_pool(input, "last")
+
+    def sequence_slice(input, offset, length, name=None):
+        from ..framework.core import apply_op as _ap
+
+        def _f(v, off, ln):
+            off0 = int(np.asarray(off).reshape(-1)[0])
+            ln0 = int(np.asarray(ln).reshape(-1)[0])
+            return jax.lax.dynamic_slice_in_dim(v, off0, ln0, axis=1)
+        return _ap(_f, input, offset, length)
+
+    def sequence_expand(x, y, ref_level=-1, name=None):
+        from ..framework.core import apply_op as _ap
+        return _ap(lambda a, b: jnp.repeat(a, b.shape[1] // max(a.shape[1], 1),
+                                           axis=1), x, y)
+
+    def sequence_expand_as(x, y, name=None):
+        return sequence_expand(x, y)
+
+    def sequence_pad(x, pad_value, maxlen=None, name=None):
+        from ..framework.core import apply_op as _ap
+
+        def _f(v, pv):
+            tgt = maxlen or v.shape[1]
+            if tgt <= v.shape[1]:
+                return v[:, :tgt], jnp.full((v.shape[0],), v.shape[1], jnp.int32)
+            padded = jnp.pad(v, [(0, 0), (0, tgt - v.shape[1])] +
+                             [(0, 0)] * (v.ndim - 2),
+                             constant_values=np.asarray(pv).item())
+            return padded, jnp.full((v.shape[0],), v.shape[1], jnp.int32)
+        return _ap(_f, x, pad_value)
+
+    def sequence_unpad(x, length, name=None):
+        from ..framework.core import apply_op as _ap
+
+        def _f(v, ln):
+            keep = int(np.asarray(ln).max())
+            return v[:, :keep]
+        return _ap(_f, x, length)
+
+    def sequence_reshape(input, new_dim):
+        from ..tensor.manipulation import reshape as _rs
+        b = int(input.shape[0])
+        return _rs(input, [b, -1, new_dim])
+
+    def sequence_scatter(input, index, updates, name=None):
+        from ..framework.core import apply_op as _ap
+
+        def _f(v, i, u):
+            return v.at[:, i.reshape(-1)].add(u)
+        return _ap(_f, input, index, updates)
+
+    def sequence_enumerate(input, win_size, pad_value=0, name=None):
+        from ..framework.core import apply_op as _ap
+
+        def _f(v):
+            t = v.shape[1]
+            outs = []
+            for k in range(win_size):
+                shifted = jnp.pad(v[:, k:], [(0, 0), (0, k)],
+                                  constant_values=pad_value)
+                outs.append(shifted)
+            return jnp.stack(outs, axis=-1)
+        return _ap(_f, input)
+
+    def sequence_reverse(x, name=None):
+        from ..framework.core import apply_op as _ap
+        return _ap(lambda v: jnp.flip(v, axis=1), x)
+
+    for k, v in list(locals().items()):
+        if callable(v) and not k.startswith("_"):
+            setattr(_StaticNN, k, staticmethod(v))
+
+
+_static_nn_extend()

@@ -1,8 +1,10 @@
 """paddle_tpu.tensor — aggregates op modules and monkey-patches them as
 Tensor methods (reference: python/paddle/tensor/__init__.py tensor_method_func
 + monkey_patch_varbase)."""
+from ..framework import set_printoptions  # noqa: F401
 from ..framework.core import Tensor
-from . import attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat
+from . import array, attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat
+from .array import *  # noqa: F401,F403
 from .attribute import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .einsum import *  # noqa: F401,F403

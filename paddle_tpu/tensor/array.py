@@ -1,0 +1,39 @@
+"""TensorArray ops — reference python/paddle/tensor/array.py. In dygraph the
+array is a plain Python list (matches reference dygraph branch); static mode
+uses the same list captured by the tracer."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["create_array", "array_read", "array_write", "array_length"]
+
+
+def _idx(i):
+    if isinstance(i, Tensor):
+        return int(i.numpy().reshape(()))
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = []
+    if initialized_list is not None:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    i = _idx(i)
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_idx(i)]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int32))

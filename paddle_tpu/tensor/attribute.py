@@ -26,3 +26,4 @@ def is_integer(x):
 
 def is_complex(x):
     return is_complex_dtype(x.dtype)
+

@@ -196,3 +196,38 @@ def corrcoef(x, rowvar=True, name=None):
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply_op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack an LU factorization — reference
+    python/paddle/tensor/linalg.py:lu_unpack (pivots are 1-based as from lu())."""
+    def _f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        if unpack_ludata:
+            l = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+            u = jnp.triu(lu_[..., :k, :])
+        else:
+            l = jnp.zeros(lu_.shape[:-2] + (m, k), lu_.dtype)
+            u = jnp.zeros(lu_.shape[:-2] + (k, n), lu_.dtype)
+        if unpack_pivots:
+            # pivots (1-based row swaps) -> permutation matrix P with A = P L U
+            def perm_from_piv(pv):
+                perm = jnp.arange(m)
+                def body(i, perm):
+                    j = pv[i] - 1
+                    pi, pj = perm[i], perm[j]
+                    return perm.at[i].set(pj).at[j].set(pi)
+                return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+            flat_piv = piv.reshape((-1, piv.shape[-1]))
+            perms = jax.vmap(perm_from_piv)(flat_piv)
+            p = jax.nn.one_hot(perms, m, dtype=lu_.dtype)          # rows of P^T
+            p = jnp.swapaxes(p, -1, -2)
+            p = p.reshape(lu_.shape[:-2] + (m, m))
+        else:
+            p = jnp.zeros(lu_.shape[:-2] + (m, m), lu_.dtype)
+        return p, l, u
+    return apply_op(_f, x, y)
+
+
+__all__ += ["lu_unpack"]

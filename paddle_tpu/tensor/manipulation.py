@@ -402,3 +402,26 @@ def tolist(x):
 
 
 __all__ += ["cast", "reverse", "tolist", "nonzero"]
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign"):
+    """Inplace put_along_axis — reference
+    python/paddle/tensor/manipulation.py:put_along_axis_."""
+    iv = indices._value if hasattr(indices, "_value") else indices
+    uv = values._value if hasattr(values, "_value") else values
+
+    def _f(v):
+        u = jnp.broadcast_to(jnp.asarray(uv, v.dtype), iv.shape)
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(iv.ndim)])
+                for d, s in enumerate(iv.shape)]
+        full_idx = tuple(iv if d == axis else jnp.broadcast_to(dims[d], iv.shape)
+                         for d in range(iv.ndim))
+        if reduce == "add":
+            return v.at[full_idx].add(u)
+        if reduce in ("mul", "multiply"):
+            return v.at[full_idx].multiply(u)
+        return v.at[full_idx].set(u)
+    return arr._inplace_update(_f)
+
+
+__all__ += ["put_along_axis_"]

@@ -417,3 +417,28 @@ def renorm(x, p, axis, max_norm):
 
 
 __all__ += ["diagonal", "renorm"]
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Inplace scale — reference python/paddle/tensor/math.py:scale_."""
+    if bias_after_scale:
+        return x._inplace_update(lambda v: v * jnp.asarray(scale, v.dtype)
+                                 + jnp.asarray(bias, v.dtype))
+    return x._inplace_update(lambda v: (v + jnp.asarray(bias, v.dtype))
+                             * jnp.asarray(scale, v.dtype))
+
+
+def lerp_(x, y, weight, name=None):
+    """Inplace lerp — reference python/paddle/tensor/math.py:lerp_."""
+    yv = y._value if hasattr(y, "_value") else y
+    wv = weight._value if hasattr(weight, "_value") else weight
+    return x._inplace_update(lambda v: v + jnp.asarray(wv, v.dtype)
+                             * (jnp.asarray(yv, v.dtype) - v))
+
+
+def inverse(x, name=None):
+    """Matrix inverse — reference python/paddle/tensor/math.py:inverse."""
+    return apply_op(jnp.linalg.inv, x)
+
+
+__all__ += ["scale_", "lerp_", "inverse"]

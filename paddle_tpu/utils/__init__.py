@@ -72,3 +72,20 @@ class download:
         raise NotImplementedError(
             "zero-egress environment: place weights locally and load with "
             "set_state_dict / paddle.load")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version — reference
+    python/paddle/utils/install_check.py:require_version."""
+    from .. import __version__
+
+    def _tup(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    cur = _tup(__version__)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required min {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed max {max_version}")
+    return True

@@ -11,9 +11,12 @@ from .misc import (  # noqa: F401
     densenet161,
     densenet169,
     densenet201,
+    densenet264,
     googlenet,
     inception_v3,
+    shufflenet_v2_swish,
     shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
     shufflenet_v2_x0_5,
     shufflenet_v2_x1_0,
     shufflenet_v2_x1_5,
@@ -38,6 +41,12 @@ from .resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2,
 )

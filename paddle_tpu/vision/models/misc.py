@@ -66,8 +66,12 @@ def squeezenet1_1(pretrained=False, **kwargs):
 
 
 # ---------------------------------------------------------------------------
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
@@ -76,19 +80,19 @@ class _ShuffleUnit(nn.Layer):
                 nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), _act_layer(act))
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), _act_layer(act),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), _act_layer(act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -101,7 +105,8 @@ class _ShuffleUnit(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    _cfgs = {0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+    _cfgs = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+             0.5: [24, 48, 96, 192, 1024],
              1.0: [24, 116, 232, 464, 1024], 1.5: [24, 176, 352, 704, 1024],
              2.0: [24, 244, 488, 976, 2048]}
 
@@ -112,20 +117,20 @@ class ShuffleNetV2(nn.Layer):
         chs = self._cfgs[scale]
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(chs[0]), nn.ReLU())
+            nn.BatchNorm2D(chs[0]), _act_layer(act))
         self.maxpool = nn.MaxPool2D(3, 2, padding=1)
         stages = []
         in_c = chs[0]
         for i, reps in enumerate([4, 8, 4]):
             out_c = chs[i + 1]
-            units = [_ShuffleUnit(in_c, out_c, 2)]
-            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(reps - 1)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            units += [_ShuffleUnit(out_c, out_c, 1, act) for _ in range(reps - 1)]
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.LayerList(stages)
         self.conv5 = nn.Sequential(
             nn.Conv2D(in_c, chs[4], 1, bias_attr=False),
-            nn.BatchNorm2D(chs[4]), nn.ReLU())
+            nn.BatchNorm2D(chs[4]), _act_layer(act))
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -145,6 +150,14 @@ class ShuffleNetV2(nn.Layer):
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
     return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
@@ -180,7 +193,8 @@ class _DenseLayer(nn.Layer):
 
 class DenseNet(nn.Layer):
     _cfgs = {121: (64, 32, [6, 12, 24, 16]), 161: (96, 48, [6, 12, 36, 24]),
-             169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32])}
+             169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32]),
+             264: (64, 32, [6, 12, 64, 48])}
 
     def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
         super().__init__()
@@ -229,6 +243,10 @@ def densenet169(pretrained=False, **kw):
 
 def densenet201(pretrained=False, **kw):
     return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
 
 
 # ---------------------------------------------------------------------------

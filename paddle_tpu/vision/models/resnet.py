@@ -4,7 +4,9 @@ NCHW is kept for weight-compat, XLA layout-assigns for the MXU anyway)."""
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-           "wide_resnet50_2", "wide_resnet101_2"]
+           "wide_resnet50_2", "wide_resnet101_2",
+           "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+           "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d"]
 
 
 class BasicBlock(nn.Layer):
@@ -142,6 +144,36 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet("resnet152", BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=32, width=4)
+    return _resnet("resnext50_32x4d", BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet("resnext50_64x4d", BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=32, width=4)
+    return _resnet("resnext101_32x4d", BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet("resnext101_64x4d", BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=32, width=4)
+    return _resnet("resnext152_32x4d", BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet("resnext152_64x4d", BottleneckBlock, 152, pretrained, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):

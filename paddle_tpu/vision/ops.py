@@ -5,7 +5,8 @@ import numpy as np
 
 from ..framework.core import Tensor, apply_op
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "DeformConv2D",
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
+           "deform_conv2d", "DeformConv2D", "psroi_pool", "read_file", "decode_jpeg",
            "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
 
 
@@ -104,7 +105,7 @@ class RoIPool(_RoIBase):
 
 class PSRoIPool(_RoIBase):
     def __call__(self, x, boxes, boxes_num):
-        raise NotImplementedError("position-sensitive RoI pool: planned with detection suite")
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
@@ -136,7 +137,302 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
              clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
              iou_aware_factor=0.5):
-    raise NotImplementedError("yolo_box decode lands with the detection suite")
+    """YOLOv3 box decode — reference python/paddle/vision/ops.py:yolo_box +
+    phi yolo_box kernel."""
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+
+    def _f(v, imsz):
+        n, c, h, w = v.shape
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bx = (jax.nn.sigmoid(v[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + gy[None, None, :, None]) / h
+        bw = jnp.exp(v[:, :, 2]) * an[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * an[None, :, 1, None, None] / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        probs = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+        keep = conf.reshape(n, -1) >= conf_thresh
+        boxes = boxes * keep[..., None]
+        scores = scores * keep[..., None]
+        return boxes, scores
+    return apply_op(_f, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss — reference python/paddle/vision/ops.py:yolo_loss
+    + fluid yolov3_loss op (coordinate BCE/L1, objectness with ignore mask,
+    per-class BCE)."""
+    all_an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_idx = np.asarray(anchor_mask, np.int32)
+    an = all_an[mask_idx]                  # anchors used at this scale
+    na = an.shape[0]
+
+    def _bce(logit, target):
+        return jax.nn.softplus(logit) - logit * target
+
+    def _f(v, gbox, glabel, gscore):
+        n, c, h, w = v.shape
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        px, py = v[:, :, 0], v[:, :, 1]
+        pw, ph = v[:, :, 2], v[:, :, 3]
+        pconf, pcls = v[:, :, 4], v[:, :, 5:]
+        nb = gbox.shape[1]
+        gx, gy = gbox[..., 0], gbox[..., 1]              # (N, B) normalized
+        gw, gh = gbox[..., 2], gbox[..., 3]
+        valid = (gw > 0) & (gh > 0)
+        # best anchor over ALL anchors by centered shape-IoU
+        gw_pix = gw * w * downsample_ratio
+        gh_pix = gh * h * downsample_ratio
+        inter = jnp.minimum(gw_pix[..., None], all_an[None, None, :, 0]) *             jnp.minimum(gh_pix[..., None], all_an[None, None, :, 1])
+        union = gw_pix[..., None] * gh_pix[..., None]             + all_an[None, None, :, 0] * all_an[None, None, :, 1] - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # (N, B)
+        # position in this scale's anchor list (or -1)
+        in_mask = (best_anchor[..., None] == mask_idx[None, None, :])
+        a_idx = jnp.argmax(in_mask, axis=-1)
+        responsible = valid & in_mask.any(axis=-1)
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        tx = gx * w - gi
+        ty = gy * h - gj
+        tw = jnp.log(jnp.maximum(gw_pix, 1e-9) / jnp.maximum(an[:, 0][a_idx], 1e-9))
+        th = jnp.log(jnp.maximum(gh_pix, 1e-9) / jnp.maximum(an[:, 1][a_idx], 1e-9))
+        box_scale = 2.0 - gw * gh
+        score_w = gscore if gscore is not None else jnp.ones_like(gx)
+        bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+        sel = (bidx, a_idx, gj, gi)                       # (N, B) gather indices
+        wpos = (responsible * box_scale * score_w)
+        loc = _bce(px[sel], tx) + _bce(py[sel], ty)             + jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)
+        loss_loc = jnp.sum(loc * wpos, axis=1)
+        # objectness: positives at responsible cells; negatives elsewhere
+        # unless their best pred-gt IoU exceeds ignore_thresh
+        obj_target = jnp.zeros((n, na, h, w))
+        obj_weight = jnp.ones((n, na, h, w))
+        obj_target = obj_target.at[sel].max(responsible.astype(jnp.float32))
+        pos_w = jnp.where(responsible, score_w, 0.0)
+        obj_pos_w = jnp.ones((n, na, h, w)).at[sel].max(pos_w)
+        # predicted boxes for ignore mask
+        cx = (jax.nn.sigmoid(px) + jnp.arange(w, dtype=jnp.float32)[None, None, None, :]) / w
+        cy = (jax.nn.sigmoid(py) + jnp.arange(h, dtype=jnp.float32)[None, None, :, None]) / h
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * an[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * an[None, :, 1, None, None] / (h * downsample_ratio)
+        p1x, p1y = cx - bw / 2, cy - bh / 2
+        p2x, p2y = cx + bw / 2, cy + bh / 2
+        g1x, g1y = gx - gw / 2, gy - gh / 2
+        g2x, g2y = gx + gw / 2, gy + gh / 2
+        def iou_with_gt(b):
+            ix = jnp.maximum(0.0, jnp.minimum(p2x, g2x[:, b, None, None, None])
+                             - jnp.maximum(p1x, g1x[:, b, None, None, None]))
+            iy = jnp.maximum(0.0, jnp.minimum(p2y, g2y[:, b, None, None, None])
+                             - jnp.maximum(p1y, g1y[:, b, None, None, None]))
+            inter = ix * iy
+            uni = bw * bh + (gw * gh)[:, b, None, None, None] - inter
+            return jnp.where(valid[:, b, None, None, None],
+                             inter / jnp.maximum(uni, 1e-9), 0.0)
+        best_iou = jnp.max(jnp.stack([iou_with_gt(b) for b in range(nb)]), axis=0)
+        ignore = (best_iou > ignore_thresh) & (obj_target < 0.5)
+        obj_weight = jnp.where(ignore, 0.0, obj_weight) * jnp.where(
+            obj_target > 0.5, obj_pos_w, 1.0)
+        loss_obj = jnp.sum(_bce(pconf, obj_target) * obj_weight, axis=(1, 2, 3))
+        # classification at responsible cells (label smooth as in the
+        # yolov3_loss kernel: positive -> 1 - 1/C, negative -> 1/C)
+        onehot = jax.nn.one_hot(glabel.astype(jnp.int32), class_num)
+        if use_label_smooth and class_num > 1:
+            delta = 1.0 / class_num
+            tcls = onehot * (1.0 - 2.0 * delta) + delta
+        else:
+            tcls = onehot
+        pcls_sel = jnp.moveaxis(pcls, 2, -1)[sel]         # (N, B, class_num)
+        loss_cls = jnp.sum(jnp.sum(_bce(pcls_sel, tcls), axis=-1) * wpos, axis=1)
+        return loss_loc + loss_obj + loss_cls
+    return apply_op(_f, x, gt_box, gt_label, gt_score)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling — reference python/paddle/vision/ops.py:
+    psroi_pool (bin (i, j) of output channel c averages input channel
+    c*ph*pw + i*pw + j over the bin's spatial region)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph_, pw_ = output_size
+
+    def _f(v, bx):
+        n, c, h, w = v.shape
+        oc = c // (ph_ * pw_)
+        rois = bx * spatial_scale                        # (R, 4) x1 y1 x2 y2
+        # reference repeats image features per roi according to boxes_num;
+        # here boxes are all against image 0 unless boxes_num maps them
+        counts = np.asarray(boxes_num._value if isinstance(boxes_num, Tensor)
+                            else boxes_num)
+        img_of_roi = np.repeat(np.arange(len(counts)), counts)
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one_roi(roi, img):
+            x1, y1, x2, y2 = roi
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_h = rh / ph_
+            bin_w = rw / pw_
+            feat = v[img]                                # (C, H, W)
+            outs = []
+            for i in range(ph_):
+                row = []
+                for j in range(pw_):
+                    hs = y1 + i * bin_h
+                    he = y1 + (i + 1) * bin_h
+                    ws_ = x1 + j * bin_w
+                    we = x1 + (j + 1) * bin_w
+                    mask_y = (ys >= jnp.floor(hs)) & (ys < jnp.ceil(he))
+                    mask_x = (xs >= jnp.floor(ws_)) & (xs < jnp.ceil(we))
+                    m = (mask_y[:, None] & mask_x[None, :]).astype(v.dtype)
+                    cnt = jnp.maximum(m.sum(), 1.0)
+                    chans = feat.reshape(oc, ph_ * pw_, h, w)[:, i * pw_ + j]
+                    row.append(jnp.sum(chans * m[None], axis=(1, 2)) / cnt)
+                outs.append(jnp.stack(row, axis=-1))      # (oc, pw)
+            return jnp.stack(outs, axis=-2)               # (oc, ph, pw)
+        return jnp.stack([one_roi(rois[r], int(img_of_roi[r]))
+                          for r in range(rois.shape[0])])
+    return apply_op(_f, x, boxes)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable convolution v1/v2 — reference python/paddle/vision/ops.py:
+    deform_conv2d. Bilinear-samples input at offset positions per kernel tap,
+    then contracts with the weight (one big einsum -> MXU-friendly)."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _f(v, off, wgt, b, msk):
+        n, cin, h, w = v.shape
+        cout, cin_g, kh, kw = wgt.shape
+        ho = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        wo = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        k = kh * kw
+        off = off.reshape(n, deformable_groups, k, 2, ho, wo)
+        base_y = (jnp.arange(ho) * st[0] - pd[0]).astype(jnp.float32)
+        base_x = (jnp.arange(wo) * st[1] - pd[1]).astype(jnp.float32)
+        ky = (jnp.arange(kh) * dl[0]).astype(jnp.float32)
+        kx = (jnp.arange(kw) * dl[1]).astype(jnp.float32)
+        kyx = jnp.stack(jnp.meshgrid(ky, kx, indexing="ij"), -1).reshape(k, 2)
+        # sample positions: (N, dg, k, ho, wo)
+        py = base_y[None, None, None, :, None] + kyx[None, None, :, 0, None, None]             + off[:, :, :, 0]
+        px = base_x[None, None, None, None, :] + kyx[None, None, :, 1, None, None]             + off[:, :, :, 1]
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        dy = py - y0
+        dx = px - x0
+
+        def gather(img, iy, ix):
+            """img (N, dg, cpg, H, W); iy/ix (N, dg, k, ho, wo) int."""
+            valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            flat = img.reshape(n, deformable_groups, -1, h * w)
+            cpg = flat.shape[2]
+            idx = (iyc * w + ixc).reshape(n, deformable_groups, 1, -1)
+            idx = jnp.broadcast_to(idx, (n, deformable_groups, cpg, idx.shape[-1]))
+            got = jnp.take_along_axis(flat, idx, axis=-1)
+            got = got.reshape(n, deformable_groups, cpg, k, ho, wo)
+            return got * valid[:, :, None].astype(img.dtype)
+        imgg = v.reshape(n, deformable_groups, cin // deformable_groups, h, w)
+        p00 = gather(imgg, y0, x0)
+        p01 = gather(imgg, y0, x0 + 1)
+        p10 = gather(imgg, y0 + 1, x0)
+        p11 = gather(imgg, y0 + 1, x0 + 1)
+        wy = dy[:, :, None]
+        wx = dx[:, :, None]
+        samp = (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx
+                + p10 * wy * (1 - wx) + p11 * wy * wx)     # (N, dg, cpg, k, ho, wo)
+        if msk is not None:
+            samp = samp * msk.reshape(n, deformable_groups, 1, k, ho, wo)
+        samp = samp.reshape(n, cin, k, ho, wo)
+        wflat = wgt.reshape(groups, cout // groups, cin_g, k)
+        sg = samp.reshape(n, groups, cin // groups, k, ho, wo)
+        out = jnp.einsum("gock,ngckhw->ngohw", wflat, sg, optimize=True)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+    return apply_op(_f, x, offset, weight, bias, mask)
+
+
+class DeformConv2D:
+    """Layer wrapper over deform_conv2d — reference vision/ops.py:DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..nn.layer_base import Layer  # reuse parameter machinery
+        from ..framework.core import Parameter
+        from ..framework.random import next_key
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        bound = float(1.0 / np.sqrt(fan_in))
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (out_channels, in_channels // groups, ks[0], ks[1]),
+            jnp.float32, -bound, bound))
+        self.bias = None if bias_attr is False else Parameter(
+            jax.random.uniform(next_key(), (out_channels,), jnp.float32, -bound, bound))
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None else [])
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation, self.deformable_groups,
+                             self.groups, mask)
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor — reference vision/ops.py:read_file."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 — reference
+    vision/ops.py:decode_jpeg (host-side via PIL; data loading is host work)."""
+    import io
+    from PIL import Image
+    raw = bytes(np.asarray(x._value if isinstance(x, Tensor) else x, np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
 
 
 def distribute_fpn_proposals(*args, **kwargs):
@@ -147,6 +443,4 @@ def generate_proposals(*args, **kwargs):
     raise NotImplementedError("RPN ops land with the detection suite")
 
 
-class DeformConv2D:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("deformable conv: planned Pallas kernel")
+

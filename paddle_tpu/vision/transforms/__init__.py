@@ -1,5 +1,6 @@
 """Vision transforms — reference python/paddle/vision/transforms (numpy/HWC
 based host-side preprocessing, feeding the DataLoader pipeline)."""
+import math
 import numbers
 import random
 
@@ -300,10 +301,14 @@ class SaturationTransform(BaseTransform):
 class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = value
+        if isinstance(value, numbers.Number):
+            value = (-value, value)
+        self.value = tuple(value)
 
     def _apply_image(self, img):
-        return _to_hwc_array(img)  # hue rotation: HSV roundtrip omitted (rare path)
+        if self.value == (0, 0):
+            return _to_hwc_array(img)
+        return adjust_hue(img, random.uniform(*self.value))
 
 
 class ColorJitter(BaseTransform):
@@ -346,3 +351,253 @@ class Grayscale(BaseTransform):
 
     def _apply_image(self, img):
         return to_grayscale(img, self.num_output_channels)
+
+# ---------------------------------------------------------------------------
+# Geometric warps — reference python/paddle/vision/transforms/functional.py
+# (affine/rotate/perspective/erase). Implemented as a single inverse
+# homography warp with bilinear sampling in numpy (host-side preprocessing;
+# device compute stays in the jitted training step).
+
+
+def _warp(arr, inv_matrix, fill=0, interpolation="bilinear"):
+    """Inverse-map warp: out[y, x] = in[H @ (x, y, 1)]. inv_matrix is 3x3."""
+    arr = np.asarray(arr, dtype=np.float32)
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], axis=-1).reshape(-1, 3).astype(np.float32)
+    src = coords @ np.asarray(inv_matrix, dtype=np.float32).T
+    denom = np.where(np.abs(src[:, 2:3]) < 1e-8, 1e-8, src[:, 2:3])
+    sx, sy = src[:, 0] / denom[:, 0], src[:, 1] / denom[:, 0]
+    if interpolation == "nearest":
+        ix, iy = np.round(sx).astype(np.int64), np.round(sy).astype(np.int64)
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        out = np.full((h * w,) + arr.shape[2:], float(fill), dtype=np.float32)
+        out[valid] = arr[iy[valid], ix[valid]]
+        return out.reshape(arr.shape)
+    x0, y0 = np.floor(sx).astype(np.int64), np.floor(sy).astype(np.int64)
+    dx, dy = sx - x0, sy - y0
+    out = np.zeros((h * w,) + arr.shape[2:], dtype=np.float32)
+    wsum = np.zeros((h * w,), dtype=np.float32)
+    for ox, oy, wt in ((0, 0, (1 - dx) * (1 - dy)), (1, 0, dx * (1 - dy)),
+                       (0, 1, (1 - dx) * dy), (1, 1, dx * dy)):
+        px, py = x0 + ox, y0 + oy
+        valid = (px >= 0) & (px < w) & (py >= 0) & (py < h)
+        wv = np.where(valid, wt, 0.0).astype(np.float32)
+        pxc, pyc = np.clip(px, 0, w - 1), np.clip(py, 0, h - 1)
+        sample = arr[pyc, pxc]
+        out += (wv.reshape(-1, *([1] * (arr.ndim - 2)))) * sample
+        wsum += wv
+    out += np.where(wsum < 1e-6, float(fill), 0.0).reshape(-1, *([1] * (arr.ndim - 2)))
+    return out.reshape(arr.shape)
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    # forward matrix M = T(translate) @ T(center) @ R(rot) @ Shear @ S(scale) @ T(-center)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[scale * a, scale * b, 0.0],
+                  [scale * c, scale * d, 0.0],
+                  [0.0, 0.0, 1.0]], dtype=np.float64)
+    m[0, 2] = translate[0] + cx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = translate[1] + cy - m[1, 0] * cx - m[1, 1] * cy
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _to_hwc_array(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    inv = _affine_inv_matrix(angle, translate, scale, shear, center)
+    return _warp(arr, inv, fill=fill, interpolation=interpolation)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    arr = _to_hwc_array(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if expand:
+        rot = math.radians(angle)
+        nw = int(abs(w * math.cos(rot)) + abs(h * math.sin(rot)) + 0.5)
+        nh = int(abs(h * math.cos(rot)) + abs(w * math.sin(rot)) + 0.5)
+        pad_x, pad_y = (nw - w) // 2, (nh - h) // 2
+        arr = np.pad(arr, [(pad_y, nh - h - pad_y), (pad_x, nw - w - pad_x)]
+                     + [(0, 0)] * (arr.ndim - 2), constant_values=fill)
+        center = ((nw - 1) * 0.5, (nh - 1) * 0.5)
+    inv = _affine_inv_matrix(angle, (0, 0), 1.0, (0.0, 0.0), center)
+    return _warp(arr, inv, fill=fill, interpolation=interpolation)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the homography mapping endpoints -> startpoints (inverse warp)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, dtype=np.float64),
+                             np.asarray(b, dtype=np.float64))
+    return np.append(coeffs, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    arr = _to_hwc_array(img)
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _warp(arr, inv, fill=fill, interpolation=interpolation)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the rectangle [i:i+h, j:j+w] with value v. Accepts HWC/CHW arrays
+    and paddle Tensors (reference erase works on CHW tensors)."""
+    from ...tensor.creation import to_tensor as _tt
+    if isinstance(img, Tensor):
+        arr = np.array(img.numpy())
+        if arr.ndim == 3:  # CHW
+            arr[:, i:i + h, j:j + w] = np.broadcast_to(np.asarray(v, arr.dtype),
+                                                       arr[:, i:i + h, j:j + w].shape)
+        else:
+            arr[..., i:i + h, j:j + w] = v
+        return _tt(arr)
+    arr = np.asarray(img) if inplace else np.array(img)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via an RGB->HSV->RGB roundtrip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor is not in [-0.5, 0.5].")
+    arr = _to_hwc_array(img).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    x = arr / hi
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc, minc = x.max(axis=-1), x.min(axis=-1)
+    v = maxc
+    deltac = maxc - minc
+    s = np.where(maxc > 0, deltac / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(deltac, 1e-12)
+    rc, gc, bc = (maxc - r) / dz, (maxc - g) / dz, (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc, np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(deltac == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r2, g2, b2], axis=-1) * hi
+
+
+class RandomAffine(BaseTransform):
+    """Reference python/paddle/vision/transforms/transforms.py:RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale is not None else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        elif len(self.shear) == 2:
+            sh = (random.uniform(self.shear[0], self.shear[1]), 0.0)
+        else:
+            sh = (random.uniform(self.shear[0], self.shear[1]),
+                  random.uniform(self.shear[2], self.shear[3]))
+        return affine(arr, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Reference python/paddle/vision/transforms/transforms.py:RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def get_params(self, width, height, distortion_scale):
+        half_w, half_h = width // 2, height // 2
+        dx, dy = int(distortion_scale * half_w), int(distortion_scale * half_h)
+        tl = (random.randint(0, dx), random.randint(0, dy))
+        tr = (random.randint(width - dx - 1, width - 1), random.randint(0, dy))
+        br = (random.randint(width - dx - 1, width - 1),
+              random.randint(height - dy - 1, height - 1))
+        bl = (random.randint(0, dx), random.randint(height - dy - 1, height - 1))
+        start = [(0, 0), (width - 1, 0), (width - 1, height - 1), (0, height - 1)]
+        return start, [tl, tr, br, bl]
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        start, end = self.get_params(w, h, self.distortion_scale)
+        return perspective(arr, start, end, interpolation=self.interpolation,
+                           fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Reference python/paddle/vision/transforms/transforms.py:RandomErasing."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = math.exp(random.uniform(math.log(self.ratio[0]),
+                                             math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * aspect)))
+            ew = int(round(math.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i, j = random.randint(0, h - eh), random.randint(0, w - ew)
+                v = (np.random.normal(size=(eh, ew) + arr.shape[2:])
+                     if self.value == "random" else self.value)
+                return erase(arr, i, j, eh, ew, v, inplace=self.inplace)
+        return arr

