@@ -224,13 +224,31 @@ class GPT(Layer):
 
 
 class GPTPretrainingCriterion(Layer):
-    """Causal LM loss (fp32), ignoring pad label -100."""
+    """Causal LM loss (fp32), ignoring pad label -100.
+
+    On TPU-friendly shapes the loss runs through the fused Pallas
+    softmax-cross-entropy kernel (ops/fused_ops.py — one vocab pass forward,
+    (softmax - onehot)·g backward without a second fp32 prob tensor);
+    otherwise the jnp cross_entropy path."""
 
     def forward(self, logits, labels):
         V = logits.shape[-1]
         from ..tensor.manipulation import reshape
         flat = reshape(logits, [-1, V])
         flat_labels = reshape(labels, [-1])
+        n = flat.shape[0]
+        from ..ops.fused_ops import can_fuse_xent
+        if can_fuse_xent(n, V):
+            from ..framework.core import apply_op
+            from ..ops.fused_ops import fused_softmax_cross_entropy
+
+            def _f(lg, lab):
+                lab = lab.astype(jnp.int32)
+                valid = lab >= 0
+                rows = fused_softmax_cross_entropy(lg, jnp.maximum(lab, 0))
+                rows = jnp.where(valid, rows, 0.0)
+                return jnp.sum(rows) / jnp.maximum(jnp.sum(valid), 1)
+            return apply_op(_f, flat, flat_labels)
         return F.cross_entropy(flat, flat_labels, ignore_index=-100, reduction="mean")
 
 

@@ -1,10 +1,10 @@
 """nn.utils — reference python/paddle/nn/utils/__init__.py
 (weight_norm_hook.py, spectral_norm_hook.py, transform_parameters.py)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...framework.core import Parameter, Tensor
-from ... import tensor as _T
+from ...framework.core import Parameter, Tensor, apply_op
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
            "parameters_to_vector", "vector_to_parameters"]
@@ -24,16 +24,15 @@ class _WeightNormHook:
         self.dim = dim
 
     def compute(self, layer):
+        """Effective weight as a taped op of (g, v) so loss.backward()
+        accumulates into weight_g.grad / weight_v.grad."""
         g = getattr(layer, self.name + "_g")
         v = getattr(layer, self.name + "_v")
-        vv = v._value if isinstance(v, Tensor) else v
-        gv = g._value if isinstance(g, Tensor) else g
-        w = vv * (gv / _norm_except(vv, self.dim))
-        return w
+        dim = self.dim
+        return apply_op(lambda vv, gv: vv * (gv / _norm_except(vv, dim)), v, g)
 
     def __call__(self, layer, inputs):
-        w = self.compute(layer)
-        object.__setattr__(layer, self.name, Tensor(w, stop_gradient=False))
+        object.__setattr__(layer, self.name, self.compute(layer))
         return None
 
 
@@ -59,7 +58,7 @@ def remove_weight_norm(layer, name="weight"):
     if name not in hooks:
         raise ValueError(f"weight_norm of '{name}' not found in {type(layer).__name__}")
     hook, handle = hooks.pop(name)
-    w = hook.compute(layer)
+    w = hook.compute(layer)._value
     handle.remove()
     del layer._parameters[name + "_g"]
     del layer._parameters[name + "_v"]
@@ -77,23 +76,39 @@ class _SpectralNormHook:
         self.eps = eps
 
     def compute(self, layer):
+        """W / sigma(W) with the power-iteration vectors detached (torch
+        semantics); taped on weight_orig so gradients reach it."""
         w = getattr(layer, self.name + "_orig")
-        arr = w._value if isinstance(w, Tensor) else jnp.asarray(w)
-        mat = jnp.moveaxis(arr, self.dim, 0).reshape(arr.shape[self.dim], -1)
-        u = layer.__dict__["_sn_u_" + self.name]
-        v = None
-        for _ in range(max(self.n, 1)):
+        dim, n_it, eps = self.dim, max(self.n, 1), self.eps
+        u0 = layer.__dict__["_sn_u_" + self.name]
+
+        def _f(arr):
+            mat = jnp.moveaxis(arr, dim, 0).reshape(arr.shape[dim], -1)
+            u = u0
+            v = None
+            for _ in range(n_it):
+                v = jax.lax.stop_gradient(mat).T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = jax.lax.stop_gradient(mat) @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (mat @ v)        # grad flows through mat here
+            return arr / sigma
+
+        out = apply_op(_f, w)
+        # update the persistent power-iteration vector (host-side state)
+        arr = w._value
+        mat = jnp.moveaxis(arr, dim, 0).reshape(arr.shape[dim], -1)
+        u = u0
+        for _ in range(n_it):
             v = mat.T @ u
-            v = v / (jnp.linalg.norm(v) + self.eps)
+            v = v / (jnp.linalg.norm(v) + eps)
             u = mat @ v
-            u = u / (jnp.linalg.norm(u) + self.eps)
+            u = u / (jnp.linalg.norm(u) + eps)
         layer.__dict__["_sn_u_" + self.name] = u
-        sigma = u @ (mat @ v)
-        return arr / sigma
+        return out
 
     def __call__(self, layer, inputs):
-        object.__setattr__(layer, self.name,
-                           Tensor(self.compute(layer), stop_gradient=False))
+        object.__setattr__(layer, self.name, self.compute(layer))
         return None
 
 

@@ -53,6 +53,15 @@ _BLOCK_Q = 256
 _BLOCK_K = 256
 
 
+def _block(L, pref):
+    """Largest of (pref, 128) dividing L, else L itself — the grids below use
+    exact tiling (L // block), so the block MUST divide L."""
+    for cand in (pref, 128):
+        if L % cand == 0:
+            return cand
+    return L
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
     from jax.experimental import pallas as pl
 
@@ -133,7 +142,7 @@ def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
     lsafe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / lsafe).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(lsafe))[:, 0]
+    lse_ref[0, 0] = m + jnp.log(lsafe)          # (bq, 1) trailing unit lane
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -144,8 +153,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]
-    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
+    lse = lse_ref[0, 0].astype(jnp.float32)          # (bq, 1)
+    delta = delta_ref[0, 0].astype(jnp.float32)      # (bq, 1)
     bq, d = q.shape
     q_idx = pl.program_id(2)
     n_k = seq_k // block_k
@@ -190,8 +199,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)[:, None]
-        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)[:, None]
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [bq, bk]
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
@@ -221,8 +230,8 @@ def _flash_fwd_lse_impl(q, k, v, causal, scale, interpret=None):
         interpret = jax.default_backend() == "cpu"
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    bq = min(_BLOCK_Q, Lq)
-    bk = min(_BLOCK_K, Lk)
+    bq = _block(Lq, _BLOCK_Q)
+    bk = _block(Lk, _BLOCK_K)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -238,11 +247,11 @@ def _flash_fwd_lse_impl(q, k, v, causal, scale, interpret=None):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Lq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qh, kh, vh)
@@ -256,12 +265,13 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
         interpret = jax.default_backend() == "cpu"
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    bq = min(_BLOCK_Q, Lq)
-    bk = min(_BLOCK_K, Lk)
+    bq = _block(Lq, _BLOCK_Q)
+    bk = _block(Lk, _BLOCK_K)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     doh = jnp.swapaxes(g, 1, 2)
     oh = jnp.swapaxes(out, 1, 2)
-    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
+                    axis=-1, keepdims=True)           # (B, H, Lq, 1)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
@@ -272,8 +282,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
             pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
@@ -289,8 +299,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Lq), lambda b, h, j: (b, h, 0)),
-            pl.BlockSpec((1, 1, Lq), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
@@ -318,8 +328,8 @@ def _flash_fwd_impl(q, k, v, causal, scale, interpret=None):
         interpret = jax.default_backend() == "cpu"
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    bq = min(_BLOCK_Q, Lq)
-    bk = min(_BLOCK_K, Lk)
+    bq = _block(Lq, _BLOCK_Q)
+    bk = _block(Lk, _BLOCK_K)
     # [B,L,H,D] -> [B,H,L,D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)

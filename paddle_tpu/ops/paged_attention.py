@@ -1,0 +1,177 @@
+"""Paged KV cache + paged attention for inference decode.
+
+TPU-native analogue of the reference's paged attention path (vLLM-style
+block KV management the reference exposes through fused decode ops). KV
+lives in fixed-size pages in HBM; each sequence owns a list of page ids
+(page_table). Decode-time attention gathers only that sequence's pages.
+
+Shapes:
+  k_pages/v_pages : (num_pages, page_size, H, D)
+  page_table      : (B, max_pages)  int32 page ids (-1 = unused)
+  seq_lens        : (B,)            int32 current lengths
+  q               : (B, 1, H, D)    single decode step
+
+The compute path is jnp (XLA fuses the gather + masked softmax well on TPU
+for decode's tiny FLOP count — latency is HBM-bound on page reads); a Pallas
+kernel variant processes one (batch, head) per grid cell for long contexts.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVCache", "paged_attention"]
+
+
+class PagedKVCache:
+    """Fixed-pool paged KV storage with host-side page allocation."""
+
+    def __init__(self, num_pages, page_size, num_heads, head_dim,
+                 dtype=jnp.bfloat16):
+        self.page_size = page_size
+        self.k_pages = jnp.zeros((num_pages, page_size, num_heads, head_dim), dtype)
+        self.v_pages = jnp.zeros((num_pages, page_size, num_heads, head_dim), dtype)
+        self._free = list(range(num_pages - 1, -1, -1))
+        self.page_tables = {}   # seq id -> list of page ids
+        self.seq_lens = {}
+
+    def new_seq(self, seq_id):
+        self.page_tables[seq_id] = []
+        self.seq_lens[seq_id] = 0
+
+    def _ensure_capacity(self, seq_id, new_len):
+        need = (new_len + self.page_size - 1) // self.page_size
+        table = self.page_tables[seq_id]
+        while len(table) < need:
+            if not self._free:
+                raise RuntimeError("PagedKVCache out of pages")
+            table.append(self._free.pop())
+
+    def append(self, seq_id, k, v):
+        """Append one step's K/V (1, H, D) for a sequence."""
+        pos = self.seq_lens[seq_id]
+        self._ensure_capacity(seq_id, pos + 1)
+        page = self.page_tables[seq_id][pos // self.page_size]
+        slot = pos % self.page_size
+        self.k_pages = self.k_pages.at[page, slot].set(
+            jnp.asarray(k, self.k_pages.dtype).reshape(self.k_pages.shape[2:]))
+        self.v_pages = self.v_pages.at[page, slot].set(
+            jnp.asarray(v, self.v_pages.dtype).reshape(self.v_pages.shape[2:]))
+        self.seq_lens[seq_id] = pos + 1
+
+    def free_seq(self, seq_id):
+        self._free.extend(reversed(self.page_tables.pop(seq_id, [])))
+        self.seq_lens.pop(seq_id, None)
+
+    def batch_view(self, seq_ids):
+        """Dense (page_table, seq_lens) arrays for a batch of sequences."""
+        max_pages = max((len(self.page_tables[s]) for s in seq_ids), default=1)
+        max_pages = max(max_pages, 1)
+        table = np.full((len(seq_ids), max_pages), -1, np.int32)
+        lens = np.zeros((len(seq_ids),), np.int32)
+        for i, s in enumerate(seq_ids):
+            ids = self.page_tables[s]
+            table[i, :len(ids)] = ids
+            lens[i] = self.seq_lens[s]
+        return jnp.asarray(table), jnp.asarray(lens)
+
+
+def _paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens, scale):
+    b, _, h, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    safe_table = jnp.maximum(page_table, 0)
+    # gather this batch's pages: (B, max_pages, page_size, H, D)
+    k = k_pages[safe_table].reshape(b, max_pages * page_size, h, d)
+    v = v_pages[safe_table].reshape(b, max_pages * page_size, h, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page_size)
+    valid = pos[None, :] < seq_lens[:, None]          # (B, K)
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(q_ref, kp_ref, vp_ref, pt_ref, len_ref, o_ref, *,
+                  scale, page_size, max_pages):
+    """One (batch, head) per grid cell; loops pages with masking. All
+    intermediates are kept 2-D (Mosaic requires >=2-D vector shapes)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0, 0].astype(jnp.float32).reshape(1, -1) * scale  # (1, D)
+    d = q.shape[1]
+    seq_len = len_ref[0]
+    m = jnp.full((1, 1), -1e30, jnp.float32)
+    s = jnp.zeros((1, 1), jnp.float32)
+    acc = jnp.zeros((1, d), jnp.float32)
+
+    def body(i, carry):
+        m, s, acc = carry
+        page = pt_ref[0, i]
+        k = kp_ref[pl.dslice(page, 1), :, 0, :][0].astype(jnp.float32)  # (P, D)
+        v = vp_ref[pl.dslice(page, 1), :, 0, :][0].astype(jnp.float32)
+        logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))    # (1, P)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        logits = jnp.where(pos < seq_len, logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + p @ v
+        return m_new, s_new, acc_new
+
+    n_live = (seq_len + page_size - 1) // page_size
+    m, s, acc = jax.lax.fori_loop(0, n_live, body, (m, s, acc))
+    o_ref[0, 0, 0] = (acc / jnp.maximum(s, 1e-30))[0].astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
+                    use_kernel=False, interpret=None):
+    """Decode attention over a paged KV cache. q: (B, 1, H, D)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if not use_kernel:
+        return _paged_attention_ref(q, k_pages, v_pages, page_table,
+                                    seq_lens, scale)
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, _, h, d = q.shape
+    n_pages, page_size = k_pages.shape[:2]
+    max_pages = page_table.shape[1]
+    try:
+        return _paged_kernel_call(q, k_pages, v_pages, page_table, seq_lens,
+                                  scale, interpret)
+    except Exception:
+        return _paged_attention_ref(q, k_pages, v_pages, page_table,
+                                    seq_lens, scale)
+
+
+def _paged_kernel_call(q, k_pages, v_pages, page_table, seq_lens, scale,
+                       interpret):
+    from jax.experimental import pallas as pl
+
+    b, _, h, d = q.shape
+    n_pages, page_size = k_pages.shape[:2]
+    max_pages = page_table.shape[1]
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, page_size=page_size,
+                          max_pages=max_pages),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((n_pages, page_size, 1, d), lambda i, j: (0, 0, j, 0)),
+            pl.BlockSpec((n_pages, page_size, 1, d), lambda i, j: (0, 0, j, 0)),
+            pl.BlockSpec((1, max_pages), lambda i, j: (i, 0)),
+            pl.BlockSpec((1,), lambda i, j: (i,)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, 0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        interpret=interpret,
+    )(q, k_pages, v_pages, page_table.astype(jnp.int32),
+      seq_lens.astype(jnp.int32))

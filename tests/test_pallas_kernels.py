@@ -85,3 +85,84 @@ def test_flash_bwd_pallas_matches_xla_vjp():
         np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), atol=1e-4)
         np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), atol=1e-4)
         np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), atol=1e-4)
+
+
+def test_fused_softmax_cross_entropy():
+    """ops/fused_ops.py streaming CE kernel vs logsumexp reference, incl. the
+    GPT vocab (50304) whose block divisor is 384."""
+    from paddle_tpu.ops.fused_ops import (_xent_fwd_impl, _xent_ref,
+                                          fused_softmax_cross_entropy)
+    rng = np.random.RandomState(0)
+    for n, v in [(256, 1024), (256, 50304)]:
+        logits = jnp.asarray(rng.randn(n, v).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, v, n).astype(np.int32))
+        loss, _ = _xent_fwd_impl(logits, labels, interpret=True)
+        np.testing.assert_allclose(np.asarray(loss),
+                                   np.asarray(_xent_ref(logits, labels)),
+                                   atol=1e-4)
+        grad = jax.grad(lambda l: fused_softmax_cross_entropy(l, labels).sum())(logits)
+        ref = jax.nn.softmax(logits, axis=-1) - jax.nn.one_hot(labels, v)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_adamw_matches_torch():
+    import torch
+    from paddle_tpu.ops.fused_ops import fused_adamw
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(1000).astype(np.float32))
+    g = jnp.asarray(rng.randn(1000).astype(np.float32))
+    po, mo, vo = fused_adamw(p, g, jnp.zeros(1000), jnp.zeros(1000),
+                             step=1, lr=1e-3, interpret=True)
+    tp = torch.tensor(np.asarray(p), requires_grad=True)
+    opt = torch.optim.AdamW([tp], lr=1e-3, weight_decay=0.01, eps=1e-8)
+    tp.grad = torch.tensor(np.asarray(g))
+    opt.step()
+    np.testing.assert_allclose(np.asarray(po), tp.detach().numpy(), atol=1e-6)
+
+
+def test_fused_dropout_residual_layer_norm_eval():
+    from paddle_tpu.ops.fused_ops import (_dropout_res_ln_ref,
+                                          fused_dropout_residual_layer_norm)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(256, 256).astype(np.float32))
+    r = jnp.asarray(rng.randn(256, 256).astype(np.float32))
+    w = jnp.asarray(rng.randn(256).astype(np.float32))
+    b = jnp.asarray(rng.randn(256).astype(np.float32))
+    out_k, h_k = fused_dropout_residual_layer_norm(x, r, w, b, p=0.1,
+                                                   training=False, interpret=True)
+    out_r, h_r = _dropout_res_ln_ref(x, r, w, b, jax.random.PRNGKey(0),
+                                     0.1, 1e-5, False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_k), np.asarray(h_r), atol=1e-6)
+
+
+def test_paged_attention_matches_dense():
+    """ops/paged_attention.py — paged gather+softmax == dense attention over
+    the sequence's actual history, jnp and kernel paths."""
+    from paddle_tpu.ops.paged_attention import PagedKVCache, paged_attention
+    rng = np.random.RandomState(0)
+    H, D, P = 2, 64, 4
+    cache = PagedKVCache(16, P, H, D, dtype=jnp.float32)
+    hist = {}
+    for sid, L in enumerate([6, 3]):
+        cache.new_seq(sid)
+        hist[sid] = []
+        for _ in range(L):
+            k = rng.randn(1, H, D).astype(np.float32)
+            v = rng.randn(1, H, D).astype(np.float32)
+            cache.append(sid, k, v)
+            hist[sid].append((k, v))
+    table, lens = cache.batch_view([0, 1])
+    q = jnp.asarray(rng.randn(2, 1, H, D).astype(np.float32))
+    out = paged_attention(q, cache.k_pages, cache.v_pages, table, lens)
+    for b in range(2):
+        ks = np.concatenate([k for k, _ in hist[b]], 0)
+        vs = np.concatenate([v for _, v in hist[b]], 0)
+        s = np.einsum("hd,lhd->hl", np.asarray(q[b, 0]), ks) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hl,lhd->hd", p, vs)
+        np.testing.assert_allclose(np.asarray(out[b, 0]), ref, atol=1e-5)
+    out_k = paged_attention(q, cache.k_pages, cache.v_pages, table, lens,
+                            use_kernel=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out), atol=1e-5)
