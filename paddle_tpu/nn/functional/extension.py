@@ -72,26 +72,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if flash_attention_available(query, attn_mask, dropout_p):
         return flash_attention(query, key, value, causal=is_causal)
 
+    # CPU / masked / odd-shape fallback: the shared jnp reference (fp32
+    # softmax, GQA + additive/bool mask support) in ops/attention.py
+    from ...ops.attention import mha_reference
+
     def _f(q, k, v, *rest):
-        scale = 1.0 / np.sqrt(q.shape[-1])
-        qh = jnp.swapaxes(q, 1, 2)  # [B,H,L,D]
-        kh = jnp.swapaxes(k, 1, 2)
-        vh = jnp.swapaxes(v, 1, 2)
-        logits = (qh @ jnp.swapaxes(kh, -1, -2)) * scale
-        logits = logits.astype(jnp.float32)
-        if is_causal:
-            L, S = logits.shape[-2], logits.shape[-1]
-            causal = jnp.tril(jnp.ones((L, S), bool))
-            logits = jnp.where(causal, logits, -1e30)
-        if rest:
-            m = rest[0]
-            if m.dtype == jnp.bool_:
-                logits = jnp.where(m, logits, -1e30)
-            else:
-                logits = logits + m.astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        out = probs @ vh
-        return jnp.swapaxes(out, 1, 2)
+        m = rest[0] if rest else None
+        return mha_reference(q, k, v, causal=is_causal, attn_mask=m)
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
     return apply_op(_f, *args)
 

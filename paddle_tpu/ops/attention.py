@@ -33,16 +33,42 @@ def flash_attention_available(query, attn_mask, dropout_p):
     return _on_tpu() and L % 128 == 0 and D in (64, 128, 256)
 
 
-def mha_reference(q, k, v, causal=False, scale=None):
-    """jnp reference (fp32 softmax) — [B,L,H,D] in/out."""
+def mha_reference(q, k, v, causal=False, scale=None, attn_mask=None):
+    """jnp reference (fp32 softmax) — [B,L,H,D] in/out. Supports GQA
+    (fewer K/V heads: Hq % Hkv == 0) and an additive attn_mask broadcastable
+    to [B, H, Lq, Lk] (bool masks: True = keep)."""
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     logits = (qh @ jnp.swapaxes(kh, -1, -2)).astype(jnp.float32) * scale
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
     if causal:
         L, S = logits.shape[-2], logits.shape[-1]
         logits = jnp.where(jnp.tril(jnp.ones((L, S), bool)), logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.swapaxes(probs @ vh, 1, 2)
+
+
+def _fold_gqa(qh, hkv):
+    """(B, Hq, Lq, D) -> (B, Hkv, G*Lq, D): query heads sharing a KV head are
+    stacked along the row axis (rows are independent in attention). Head
+    ordering is h = h_kv * G + g, matching repeat-interleave GQA."""
+    B, Hq, Lq, D = qh.shape
+    g = Hq // hkv
+    return qh.reshape(B, hkv, g * Lq, D), Lq
+
+
+def _unfold_gqa(out, hq, lq):
+    B, hkv, gl, D = out.shape
+    return out.reshape(B, hq, lq, D)
 
 
 # ---------------------------------------------------------------------------
@@ -62,12 +88,16 @@ def _block(L, pref):
     return L
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                      seq_k, seq_q_real=None):
     from jax.experimental import pallas as pl
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
     bq, d = q.shape
     q_idx = pl.program_id(2)
+    # with GQA the group is folded into the row axis; causal positions are
+    # modulo the real sequence length (blocks never straddle heads: bq | Lq)
+    row0 = q_idx * bq if seq_q_real is None else (q_idx * bq) % seq_q_real
 
     m = jnp.full((bq, 1), -1e30, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
@@ -81,7 +111,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq
         v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
         if causal:
-            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -95,7 +125,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq
 
     if causal:
         # only k-blocks at or before this q-block's end participate
-        q_end = (q_idx + 1) * bq
+        q_end = row0 + bq
         n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
         m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
     else:
@@ -104,13 +134,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq
 
 
 def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                          block_k, seq_k):
+                          block_k, seq_k, seq_q_real=None):
     """Forward that also writes logsumexp rows (for the Pallas backward)."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0, 0].astype(jnp.float32) * scale
     bq, d = q.shape
     q_idx = pl.program_id(2)
+    row0 = q_idx * bq if seq_q_real is None else (q_idx * bq) % seq_q_real
     m = jnp.full((bq, 1), -1e30, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
@@ -122,7 +153,7 @@ def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
         if causal:
-            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -135,7 +166,7 @@ def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         return m_new, l_new, acc_new
 
     if causal:
-        q_end = (q_idx + 1) * bq
+        q_end = row0 + bq
         n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
         m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
     else:
@@ -146,7 +177,8 @@ def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, scale, causal, block_k, seq_k):
+                         dq_ref, *, scale, causal, block_k, seq_k,
+                         seq_q_real=None):
     """dQ = sum_k dS @ K with dS = P * (dP - delta) * scale, P recomputed
     blockwise from the saved logsumexp (standard flash backward)."""
     from jax.experimental import pallas as pl
@@ -157,6 +189,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[0, 0].astype(jnp.float32)      # (bq, 1)
     bq, d = q.shape
     q_idx = pl.program_id(2)
+    row0 = q_idx * bq if seq_q_real is None else (q_idx * bq) % seq_q_real
     n_k = seq_k // block_k
     dq = jnp.zeros((bq, d), jnp.float32)
 
@@ -165,7 +198,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         if causal:
-            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         p = jnp.exp(s - lse)
@@ -174,7 +207,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dq + ds @ k
 
     if causal:
-        q_end = (q_idx + 1) * bq
+        q_end = row0 + bq
         n_live = jnp.minimum((q_end + block_k - 1) // block_k, n_k)
         dq = jax.lax.fori_loop(0, n_live, body, dq)
     else:
@@ -183,7 +216,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+                          dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
+                          seq_q_real=None):
     """dK/dV for one k block, looping over q blocks."""
     from jax.experimental import pallas as pl
 
@@ -203,7 +237,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [bq, bk]
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            r0 = i * block_q if seq_q_real is None else (i * block_q) % seq_q_real
+            q_pos = r0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = k_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         p = jnp.exp(s - lse)
@@ -213,11 +248,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
         return dk_new, dv_new
 
-    if causal:
+    if causal and seq_q_real is None:
         # only q blocks at or after this k block's start participate
         q_start = (k_idx * bk) // block_q
         dk, dv = jax.lax.fori_loop(q_start, n_q, body, (dk, dv))
     else:
+        # folded GQA rows repeat positions; masking handles the skips
         dk, dv = jax.lax.fori_loop(0, n_q, body, (dk, dv))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
@@ -228,17 +264,23 @@ def _flash_fwd_lse_impl(q, k, v, causal, scale, interpret=None):
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    B, Lq, H, D = q.shape
+    B, Lq, Hq, D = q.shape
     Lk = k.shape[1]
+    Hkv = k.shape[2]
     bq = _block(Lq, _BLOCK_Q)
     bk = _block(Lk, _BLOCK_K)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    grid = (B, H, Lq // bq)
+    seq_q_real = None
+    if Hq != Hkv:
+        qh, seq_q_real = _fold_gqa(qh, Hkv)
+    H = Hkv
+    Lq_f = qh.shape[2]
+    grid = (B, H, Lq_f // bq)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_lse_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_k=Lk),
+                          block_k=bk, seq_k=Lk, seq_q_real=seq_q_real),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
@@ -250,11 +292,13 @@ def _flash_fwd_lse_impl(q, k, v, causal, scale, interpret=None):
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Lq_f, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq_f, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qh, kh, vh)
+    if seq_q_real is not None:
+        out = _unfold_gqa(out, Hq, Lq)
     return jnp.swapaxes(out, 1, 2), lse
 
 
@@ -263,20 +307,29 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    B, Lq, H, D = q.shape
+    B, Lq, Hq, D = q.shape
     Lk = k.shape[1]
+    Hkv = k.shape[2]
     bq = _block(Lq, _BLOCK_Q)
     bk = _block(Lk, _BLOCK_K)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     doh = jnp.swapaxes(g, 1, 2)
     oh = jnp.swapaxes(out, 1, 2)
+    seq_q_real = None
+    if Hq != Hkv:
+        qh, seq_q_real = _fold_gqa(qh, Hkv)
+        doh, _ = _fold_gqa(doh, Hkv)
+        oh, _ = _fold_gqa(oh, Hkv)
+        # lse from the folded forward is already (B, Hkv, G*Lq, 1)
+    H = Hkv
+    Lq_f = qh.shape[2]
     delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
-                    axis=-1, keepdims=True)           # (B, H, Lq, 1)
+                    axis=-1, keepdims=True)           # (B, H, Lq_f, 1)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_k=Lk),
-        grid=(B, H, Lq // bq),
+                          block_k=bk, seq_k=Lk, seq_q_real=seq_q_real),
+        grid=(B, H, Lq_f // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
@@ -286,21 +339,21 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq_f, D), q.dtype),
         interpret=interpret,
     )(qh, kh, vh, doh, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, seq_q=Lq),
+                          block_q=bq, seq_q=Lq_f, seq_q_real=seq_q_real),
         grid=(B, H, Lk // bk),
         in_specs=[
-            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq_f, D), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq_f, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq_f, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq_f, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
@@ -312,6 +365,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, scale, interpret=None):
         ],
         interpret=interpret,
     )(qh, kh, vh, doh, lse, delta)
+    if seq_q_real is not None:
+        dq = _unfold_gqa(dq, Hq, Lq)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
 
@@ -326,19 +381,26 @@ def _flash_fwd_impl(q, k, v, causal, scale, interpret=None):
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    B, Lq, H, D = q.shape
+    B, Lq, Hq, D = q.shape
     Lk = k.shape[1]
+    Hkv = k.shape[2]
     bq = _block(Lq, _BLOCK_Q)
     bk = _block(Lk, _BLOCK_K)
     # [B,L,H,D] -> [B,H,L,D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
+    seq_q_real = None
+    if Hq != Hkv:
+        qh, lq_real = _fold_gqa(qh, Hkv)
+        seq_q_real = lq_real
+    H = Hkv
+    Lq_f = qh.shape[2]
 
-    grid = (B, H, Lq // bq)
+    grid = (B, H, Lq_f // bq)
     out = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_k=Lk),
+                          block_k=bk, seq_k=Lk, seq_q_real=seq_q_real),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
@@ -346,9 +408,11 @@ def _flash_fwd_impl(q, k, v, causal, scale, interpret=None):
             pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq_f, D), q.dtype),
         interpret=interpret,
     )(qh, kh, vh)
+    if seq_q_real is not None:
+        out = _unfold_gqa(out, Hq, Lq)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -385,10 +449,24 @@ def _flash_bwd(causal, scale, res, g):
 _flash.defvjp(_flash_fwd_vjp, _flash_bwd)
 
 
-def flash_attention(query, key, value, causal=False, scale=None):
-    """Public fused attention — Tensor in/out, [B,L,H,D]."""
-    sc = scale if scale is not None else 1.0 / np.sqrt(
-        (query.shape if isinstance(query, Tensor) else query.shape)[-1])
+def flash_attention(query, key, value, causal=False, scale=None,
+                    attn_mask=None):
+    """Public fused attention — Tensor in/out, [B,L,H,D]. Supports GQA
+    (key/value with fewer heads; folded into the same kernels) and additive
+    or boolean attn_mask (masked path runs the XLA reference — the mask is
+    O(L^2) HBM anyway, so the flash win is gone)."""
+    sc = scale if scale is not None else 1.0 / np.sqrt(query.shape[-1])
+    hq = query.shape[2]
+    hkv = key.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads ({hq}) must be a multiple of "
+                         f"key/value heads ({hkv}) for GQA")
+    if attn_mask is not None:
+        fn = lambda q, k, v, m: mha_reference(q, k, v, causal=causal,
+                                              scale=sc, attn_mask=m)
+        if isinstance(query, Tensor):
+            return apply_op(fn, query, key, value, attn_mask)
+        return fn(query, key, value, attn_mask)
     if isinstance(query, Tensor):
         return apply_op(lambda q, k, v: _flash(q, k, v, causal, sc), query, key, value)
     return _flash(query, key, value, causal, sc)

@@ -89,3 +89,11 @@ def require_version(min_version, max_version=None):
         raise Exception(
             f"installed version {__version__} > allowed max {max_version}")
     return True
+
+
+from . import checkpoint_convert  # noqa: F401,E402
+from .checkpoint_convert import (  # noqa: F401,E402
+    apply_reference_checkpoint,
+    convert_checkpoint,
+    load_reference_state_dict,
+)

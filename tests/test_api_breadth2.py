@@ -342,3 +342,25 @@ def test_decode_jpeg_roundtrip(tmp_path):
     dec = O.decode_jpeg(raw)
     assert dec.shape == [3, 8, 8]
     assert abs(int(dec.numpy()[0, 0, 0]) - 200) < 30
+
+
+def test_checkpoint_conversion(tmp_path):
+    """utils/checkpoint_convert.py — tolerant load of reference .pdparams
+    (plain and paddle-2.1 tuple forms) + apply to a Layer."""
+    import pickle
+    m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    sd = {k: np.asarray(v.numpy(), np.float32) * 0 + i
+          for i, (k, v) in enumerate(m.state_dict().items())}
+    blob = {k: ((f"var_{i}", v) if i % 2 else v)
+            for i, (k, v) in enumerate(sd.items())}
+    fn = str(tmp_path / "ref.pdparams")
+    pickle.dump(blob, open(fn, "wb"), protocol=4)
+    ref = paddle.utils.load_reference_state_dict(fn)
+    assert sorted(ref.keys()) == sorted(sd.keys())
+    missing, unexpected = paddle.utils.apply_reference_checkpoint(m, fn)
+    assert not missing and not unexpected
+    vals = [float(v.numpy().ravel()[0]) for v in m.state_dict().values()]
+    assert vals == [0.0, 1.0, 2.0, 3.0]
+    dst = str(tmp_path / "ours.pdparams")
+    keys = paddle.utils.convert_checkpoint(fn, dst)
+    assert len(keys) == 4
