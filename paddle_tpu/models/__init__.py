@@ -27,3 +27,9 @@ from .bert import (  # noqa: F401
     bert_tiny,
 )
 from .generation import generate  # noqa: F401
+from .transformer import (  # noqa: F401
+    CrossEntropyCriterion,
+    TransformerModel,
+    transformer_base,
+    transformer_big,
+)

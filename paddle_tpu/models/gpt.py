@@ -37,6 +37,7 @@ class GPTConfig:
     ffn_hidden: int = 0              # 0 → 4*hidden
     max_seq_len: int = 1024
     dropout: float = 0.0
+    sp_mode: str = "ring"            # 'ring' | 'ulysses' sequence parallelism
     dtype: str = "bfloat16"          # compute/param dtype
     remat: bool = True               # jax.checkpoint each block
     tie_embeddings: bool = True
@@ -45,6 +46,9 @@ class GPTConfig:
     def __post_init__(self):
         if self.ffn_hidden == 0:
             self.ffn_hidden = 4 * self.hidden_size
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got "
+                             f"{self.sp_mode!r}")
 
     @property
     def head_dim(self):
@@ -95,11 +99,21 @@ class GPTBlock(Layer):
             from ..distributed.mesh import get_mesh
             mesh = get_mesh(create_default=False)
             if mesh is not None and mesh.shape.get("sp", 1) > 1:
-                # sequence parallel: exact ring attention over ICI ('sp' axis)
-                from ..ops.ring_attention import ring_attention
-                attn = apply_op(
-                    lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
-                    q, k, v)
+                # sequence parallel over the 'sp' ICI axis: exact ring
+                # attention, or Ulysses all-to-all head-resharding when
+                # configured and the head count divides
+                if cfg.sp_mode == "ulysses":
+                    # ops/ulysses.py raises if heads don't divide 'sp' —
+                    # an explicit error beats silently measuring ring
+                    from ..ops.ulysses import ulysses_attention
+                    attn = apply_op(
+                        lambda qv, kv, vv: ulysses_attention(
+                            qv, kv, vv, mesh=mesh, causal=True), q, k, v)
+                else:
+                    from ..ops.ring_attention import ring_attention
+                    attn = apply_op(
+                        lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
+                        q, k, v)
             else:
                 attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                       dropout_p=cfg.dropout,
