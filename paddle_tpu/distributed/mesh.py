@@ -34,13 +34,14 @@ def build_mesh(dp=1, fsdp=1, pp=1, tp=1, sp=1, ep=1, devices=None):
     sizes = {"dp": dp, "fsdp": fsdp, "pp": pp, "tp": tp, "sp": sp, "ep": ep}
     total = int(np.prod(list(sizes.values())))
     if total != len(devices):
-        # allow leftover devices to fold into dp
-        if len(devices) % max(total // max(dp, 1), 1) == 0 and dp == 1:
-            sizes["dp"] = len(devices) // (total)
-            total = len(devices)
-        if int(np.prod(list(sizes.values()))) != len(devices):
+        if dp == 1 and len(devices) % total == 0:
+            # dp left at its default of 1: absorb the remaining devices
+            sizes["dp"] = len(devices) // total
+        else:
             raise ValueError(
-                f"mesh {sizes} needs {total} devices, have {len(devices)}")
+                f"mesh axes {sizes} multiply to {total} but {len(devices)} "
+                "devices were given; make the product match (dp=1 may be "
+                "left unset to absorb the remainder)")
     arr = np.asarray(devices).reshape([sizes[a] for a in ("dp", "fsdp", "pp", "tp", "sp", "ep")])
     mesh = Mesh(arr, ("dp", "fsdp", "pp", "tp", "sp", "ep"))
     set_mesh(mesh)

@@ -56,11 +56,42 @@ def shard_params(layer, mesh=None):
     return plan
 
 
+def feasible_spec(shape, spec, mesh):
+    """Drop mesh axes from `spec` that do not evenly divide their dim.
+
+    GSPMD rejects (or worse, silently pads) shardings whose axis-size
+    product doesn't divide the dimension; eager constraints on user-sized
+    batches (e.g. batch 2 on a dp=8 mesh) must degrade to replication
+    instead of raising."""
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            out.append(None)
+            continue
+        axes = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+        kept, size = [], 1
+        for a in axes:
+            s = mesh.shape.get(a, 1)
+            if s > 1 and shape[i] % (size * s) == 0:
+                kept.append(a)
+                size *= s
+        out.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    return out
+
+
 def constraint(x, *spec):
-    """with_sharding_constraint on a Tensor/array with the global mesh."""
+    """with_sharding_constraint on a Tensor/array with the global mesh.
+
+    Axes that don't divide the tensor's dims are dropped (replicated)
+    rather than raising, so model code can annotate unconditionally."""
     from ..framework.core import Tensor, apply_op
 
-    sh = NamedSharding(get_mesh(), PartitionSpec(*spec))
+    mesh = get_mesh()
+    v = x._value if isinstance(x, Tensor) else x
+    shape = getattr(v, "shape", None)
+    if shape is not None:
+        spec = feasible_spec(shape, spec, mesh)
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
     if isinstance(x, Tensor):
-        return apply_op(lambda v: jax.lax.with_sharding_constraint(v, sh), x)
-    return jax.lax.with_sharding_constraint(x, sh)
+        return apply_op(lambda u: jax.lax.with_sharding_constraint(u, sh), x)
+    return jax.lax.with_sharding_constraint(v, sh)

@@ -16,19 +16,22 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..framework.core import Tensor
 from ..nn.layer_base import functional_call, load_state_pytree
 from .mesh import get_mesh
-from .sharding_utils import plan_shardings
+from .sharding_utils import feasible_spec, plan_shardings
 
 __all__ = ["Trainer", "shard_batch"]
 
 
 def shard_batch(batch, mesh=None, spec=("dp", "fsdp")):
-    """device_put a batch pytree with its leading dim sharded over data axes."""
+    """device_put a batch pytree with its leading dim sharded over data axes.
+
+    Axes that don't divide the batch dim are dropped (replicated) so user
+    batches of any size are accepted, mirroring `sharding_utils.constraint`."""
     mesh = mesh or get_mesh()
-    axes = tuple(a for a in spec if mesh.shape.get(a, 1) >= 1)
 
     def put(x):
         v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-        sh = NamedSharding(mesh, PartitionSpec(axes, *([None] * (v.ndim - 1))))
+        fspec = feasible_spec(v.shape, (tuple(spec),) + (None,) * (v.ndim - 1), mesh)
+        sh = NamedSharding(mesh, PartitionSpec(*fspec))
         return jax.device_put(v, sh)
     return jax.tree_util.tree_map(put, batch)
 

@@ -19,3 +19,19 @@ except Exception:
     pass
 
 assert jax.default_backend() == "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_global_mesh():
+    """Each test module starts and ends with no global mesh, so sharding
+    state (e.g. a dp=8 mesh from a distributed module) can't leak into
+    later modules' eager constraints."""
+    from paddle_tpu.distributed import mesh as _mesh
+
+    _mesh._state["mesh"] = None
+    _mesh._state["axis_context"] = ()
+    yield
+    _mesh._state["mesh"] = None
+    _mesh._state["axis_context"] = ()
