@@ -1,0 +1,67 @@
+"""Microbenchmark: 1F1B vs GPipe pipeline schedules on the virtual CPU mesh.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+          python examples/bench_pipeline.py
+
+Measures wall-clock per training step and compiled temp (activation) memory
+for GPTStacked at pp=4 x dp=2, 8 microbatches. Representative result
+(this machine, 2026-07):
+
+    gpipe: 25.3 s/step, temp=304.5 MB
+    1f1b : 16.2 s/step, temp= 53.5 MB   -> 1.56x faster, 5.7x less temp
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.models import GPTConfig, GPTPretrainingCriterion, GPTStacked
+
+
+def main():
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=8, num_heads=8,
+                    max_seq_len=128, dtype="float32", remat=False)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(m, b):
+        return crit(m(paddle.to_tensor(b["input_ids"])),
+                    paddle.to_tensor(b["labels"]))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (16, 129))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        paddle.seed(0)
+        build_mesh(pp=4, dp=2)
+        model = GPTStacked(cfg, pp_microbatches=8, pp_schedule=sched)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        trainer = Trainer(model, opt, loss_fn)
+        loss = trainer.step(batch)  # compile
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            loss = trainer.step(batch)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / 5
+        lowered = trainer._step_fn.lower(
+            trainer.params, trainer.opt_state, trainer.consts, 1e-3,
+            {k: jnp.asarray(v) for k, v in batch.items()})
+        ma = lowered.compile().memory_analysis()
+        temp = getattr(ma, "temp_size_in_bytes", 0)
+        results[sched] = (dt, temp)
+        print(f"{sched}: {dt:.2f} s/step, temp={temp / 1e6:.1f} MB, "
+              f"loss={float(loss):.4f}")
+
+    g, f = results["gpipe"], results["1f1b"]
+    print(f"1f1b speedup: {g[0] / f[0]:.2f}x, temp reduction: {g[1] / f[1]:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

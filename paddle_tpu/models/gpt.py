@@ -302,10 +302,12 @@ class GPTStacked(Layer):
     inside stacked blocks.
     """
 
-    def __init__(self, cfg: GPTConfig, pp_microbatches: int = 4):
+    def __init__(self, cfg: GPTConfig, pp_microbatches: int = 4,
+                 pp_schedule: str = "1f1b"):
         super().__init__()
         self.cfg = cfg
         self.pp_microbatches = pp_microbatches
+        self.pp_schedule = pp_schedule
         h, f, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers
         init = Normal(0.0, cfg.init_std)
         out_init = Normal(0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers))
@@ -392,7 +394,8 @@ class GPTStacked(Layer):
         def run(xv, *pvals):
             stacked = dict(zip(stacked_names, pvals))
             if mesh is not None and mesh.shape.get("pp", 1) > 1:
-                return pipeline_apply(self._stage_fn, stacked, xv, n_micro, mesh=mesh)
+                return pipeline_apply(self._stage_fn, stacked, xv, n_micro,
+                                      mesh=mesh, schedule=self.pp_schedule)
             return self._stage_fn(stacked, xv)
 
         x = apply_op(run, x, *stacked_tensors)

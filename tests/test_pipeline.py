@@ -12,7 +12,8 @@ from paddle_tpu.distributed.trainer import Trainer
 from paddle_tpu.models import GPTConfig, GPTPretrainingCriterion, GPTStacked
 
 
-def test_pipeline_apply_matches_sequential():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_apply_matches_sequential(schedule):
     build_mesh(pp=4)
     L_total, B, H = 8, 4, 16
     rng = np.random.RandomState(0)
@@ -26,11 +27,12 @@ def test_pipeline_apply_matches_sequential():
 
     x = jnp.asarray(rng.randn(B, H), jnp.float32)
     seq = stage_fn(w, x)
-    piped = pipeline_apply(stage_fn, w, x, n_microbatch=2)
+    piped = pipeline_apply(stage_fn, w, x, n_microbatch=2, schedule=schedule)
     np.testing.assert_allclose(np.asarray(piped), np.asarray(seq), atol=1e-5)
 
 
-def test_pipeline_grads_match():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_grads_match(schedule):
     build_mesh(pp=2)
     rng = np.random.RandomState(1)
     w = jnp.asarray(rng.randn(4, 8, 8) * 0.1, jnp.float32)
@@ -46,7 +48,8 @@ def test_pipeline_grads_match():
         return jnp.sum(stage_fn(w, x) ** 2)
 
     def loss_pipe(w):
-        return jnp.sum(pipeline_apply(stage_fn, w, x, n_microbatch=2) ** 2)
+        return jnp.sum(pipeline_apply(stage_fn, w, x, n_microbatch=2,
+                                      schedule=schedule) ** 2)
 
     g1 = jax.grad(loss_seq)(w)
     g2 = jax.grad(loss_pipe)(w)
@@ -70,13 +73,15 @@ def _loss_fn(model, batch):
     return GPTPretrainingCriterion()(logits, paddle.to_tensor(batch["labels"]))
 
 
-def test_gpt_stacked_pp_equals_pp1():
+@pytest.mark.parametrize("schedule", [
+    "1f1b", pytest.param("gpipe", marks=pytest.mark.slow)])
+def test_gpt_stacked_pp_equals_pp1(schedule):
     batch = _batch()
     losses = {}
     for axes in ({"dp": 1}, {"pp": 4}, {"pp": 2, "tp": 2}):
         paddle.seed(11)
         build_mesh(**axes)
-        model = GPTStacked(_cfg(), pp_microbatches=2)
+        model = GPTStacked(_cfg(), pp_microbatches=2, pp_schedule=schedule)
         opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
         trainer = Trainer(model, opt, _loss_fn)
         losses[tuple(sorted(axes.items()))] = [float(trainer.step(batch)) for _ in range(3)]
