@@ -8,6 +8,7 @@ host loop over a jit-compiled step (decode is latency-bound).
 """
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -146,11 +147,10 @@ class CrossEntropyCriterion(Layer):
         def _f(logits, lab):
             v = logits.shape[-1]
             lab = lab.reshape(lab.shape[0], lab.shape[1]).astype(jnp.int32)
-            logsm = logits.astype(jnp.float32) - \
-                jnp.log(jnp.sum(jnp.exp(logits.astype(jnp.float32)),
-                                axis=-1, keepdims=True))
+            logsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             onehot = (jnp.arange(v)[None, None, :] == lab[..., None])
-            smooth = onehot * (1.0 - self.eps) + (1.0 - onehot) * self.eps / (v - 1)
+            # reference F.label_smooth: (1-eps)*onehot + eps/V over ALL classes
+            smooth = onehot * (1.0 - self.eps) + self.eps / v
             token_loss = -jnp.sum(smooth * logsm, axis=-1)
             mask = (lab != self.pad_id).astype(jnp.float32)
             total = jnp.sum(token_loss * mask)

@@ -57,19 +57,21 @@ class FakeQuantMovingAverageAbsMax(Layer):
         super().__init__()
         self._moving_rate = moving_rate
         self._quant_bits = quant_bits
-        self._seen = False
+        # persisted like the reference's `state`/`accum` tensors so a
+        # restored QAT checkpoint keeps its EMA instead of re-seeding
+        self.register_buffer("seen", Tensor(jnp.zeros([], jnp.int32)),
+                             persistable=True)
         self.register_buffer("scale", Tensor(jnp.ones([])), persistable=True)
 
     def forward(self, input):
         if self.training:
             cur = input.abs().max()
-            if not self._seen:   # seed the EMA with the first observation
-                new = cur._value
-                self._seen = True
-            else:
-                new = self.scale._value * self._moving_rate \
-                    + cur._value * (1 - self._moving_rate)
+            seeded = self.seen._value > 0
+            ema = self.scale._value * self._moving_rate \
+                + cur._value * (1 - self._moving_rate)
+            new = jnp.where(seeded, ema, cur._value)
             self.scale._value = jax.lax.stop_gradient(new)
+            self.seen._value = jnp.ones([], jnp.int32)
         return _fake_quant(input, Tensor(self.scale._value), self._quant_bits)
 
 

@@ -1,0 +1,24 @@
+"""One-time fallback signalling for Pallas kernels.
+
+Kernel dispatch keeps a defensive try/except (Pallas lowering support
+varies across backends and interpret mode), but abandoning a kernel must
+never be silent: a production run quietly using the O(L^2)-HBM reference
+path is a perf/memory cliff. Each (kernel, reason) pair warns once.
+"""
+import warnings
+
+_warned = set()
+
+__all__ = ["kernel_fallback"]
+
+
+def kernel_fallback(name, err):
+    """Record that Pallas kernel `name` was abandoned because of `err`."""
+    key = (name, type(err).__name__)
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(
+        f"Pallas kernel '{name}' unavailable ({type(err).__name__}: {err}); "
+        "falling back to the XLA reference path (slower / more HBM)",
+        RuntimeWarning, stacklevel=3)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply_op
+from ._fallback import kernel_fallback
 
 __all__ = ["flash_attention", "flash_attention_available", "mha_reference"]
 
@@ -419,7 +420,8 @@ def _flash_fwd_impl(q, k, v, causal, scale, interpret=None):
 def _flash_fwd(q, k, v, causal, scale):
     try:
         return _flash_fwd_impl(q, k, v, causal, scale)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("flash_attention_fwd", e)
         return mha_reference(q, k, v, causal=causal, scale=scale)
 
 
@@ -427,7 +429,8 @@ def _flash_fwd_vjp(q, k, v, causal, scale):
     try:
         out, lse = _flash_fwd_lse_impl(q, k, v, causal, scale)
         return out, (q, k, v, out, lse)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("flash_attention_fwd_lse", e)
         out = mha_reference(q, k, v, causal=causal, scale=scale)
         return out, (q, k, v, out, None)
 
@@ -437,8 +440,8 @@ def _flash_bwd(causal, scale, res, g):
     if lse is not None:
         try:
             return _flash_bwd_impl(q, k, v, out, lse, g, causal, scale)
-        except Exception:
-            pass
+        except Exception as e:
+            kernel_fallback("flash_attention_bwd", e)
     # fallback: XLA vjp of the reference (materializes L x L probs)
     def f(q, k, v):
         return mha_reference(q, k, v, causal=causal, scale=scale)

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._fallback import kernel_fallback
+
 __all__ = ["fused_softmax_cross_entropy", "fused_adamw",
            "fused_dropout_residual_layer_norm"]
 
@@ -155,7 +157,8 @@ def _xent_fwd_impl(logits, labels, interpret=None):
 def _xent_fwd(logits, labels):
     try:
         loss, lse = _xent_fwd_impl(logits, labels)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("fused_softmax_xent_fwd", e)
         loss = _xent_ref(logits, labels)
         lse = None
     return loss, (logits, labels, lse)
@@ -195,8 +198,8 @@ def _xent_vjp_bwd(res, g):
     if lse is not None:
         try:
             return _xent_bwd_impl(logits, labels, lse, g), None
-        except Exception:
-            pass
+        except Exception as e:
+            kernel_fallback("fused_softmax_xent_bwd", e)
     p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
     return ((p - onehot) * g[:, None]).astype(logits.dtype), None
@@ -251,7 +254,8 @@ def fused_adamw(p, g, m, v, step, lr, beta1=0.9, beta2=0.999, eps=1e-8,
                        jax.ShapeDtypeStruct((flat,), v.dtype)],
             interpret=interpret,
         )(*args)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("fused_adamw", e)
         pf, gf, mf, vf = (t.astype(jnp.float32) for t in args)
         mo = beta1 * mf + (1 - beta1) * gf
         vo = beta2 * vf + (1 - beta2) * gf * gf
@@ -340,7 +344,7 @@ def fused_dropout_residual_layer_norm(x, residual, weight, bias, p=0.1,
                            jax.ShapeDtypeStruct((n, h), x.dtype)],
                 interpret=interpret,
             )(x, residual, w, b, jnp.asarray([seed], jnp.int32)))
-        except Exception:
-            pass
+        except Exception as e:
+            kernel_fallback("fused_dropout_residual_ln", e)
     key = jax.random.PRNGKey(seed)
     return _dropout_res_ln_ref(x, residual, w, b, key, p, eps, training)

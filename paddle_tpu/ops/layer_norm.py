@@ -11,6 +11,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ._fallback import kernel_fallback
+
 __all__ = ["fused_layer_norm", "fused_rms_norm"]
 
 
@@ -81,7 +83,8 @@ def _ln_fwd_impl(x, weight, bias, eps):
             interpret=jax.default_backend() == "cpu",
         )(flat, weight, bias)
         return out.reshape(x.shape)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("fused_layer_norm", e)
         return _ln_ref(x, weight, bias, eps)
 
 
@@ -125,7 +128,8 @@ def _rms_fwd_impl(x, weight, eps):
             interpret=jax.default_backend() == "cpu",
         )(flat, weight)
         return out.reshape(x.shape)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("fused_rms_norm", e)
         return _rms_ref(x, weight, eps)
 
 

@@ -19,6 +19,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ._fallback import kernel_fallback
 import numpy as np
 
 __all__ = ["PagedKVCache", "paged_attention"]
@@ -147,7 +149,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     try:
         return _paged_kernel_call(q, k_pages, v_pages, page_table, seq_lens,
                                   scale, interpret)
-    except Exception:
+    except Exception as e:
+        kernel_fallback("paged_attention", e)
         return _paged_attention_ref(q, k_pages, v_pages, page_table,
                                     seq_lens, scale)
 
