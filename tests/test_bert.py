@@ -46,7 +46,7 @@ def test_bert_pretraining_loss_decreases():
     mlm_labels = _ids(seed=1)
     nsp_labels = paddle.to_tensor(np.array([0, 1], "int32"))
     losses = []
-    for _ in range(5):
+    for _ in range(3):
         mlm_logits, nsp_logits = model(ids)
         loss = crit(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
         loss.backward()

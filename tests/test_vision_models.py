@@ -6,19 +6,20 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
 
+slow = pytest.mark.slow
 @pytest.mark.parametrize("ctor,size", [
     (lambda: models.LeNet(num_classes=10), 28),
-    (lambda: models.alexnet(num_classes=10), 224),
+    pytest.param(lambda: models.alexnet(num_classes=10), 224, marks=slow),
     (lambda: models.resnet18(num_classes=10), 64),
     (lambda: models.resnet50(num_classes=10), 64),
-    (lambda: models.vgg11(num_classes=10), 64),
-    (lambda: models.mobilenet_v1(num_classes=10), 64),
+    pytest.param(lambda: models.vgg11(num_classes=10), 64, marks=slow),
+    pytest.param(lambda: models.mobilenet_v1(num_classes=10), 64, marks=slow),
     (lambda: models.mobilenet_v2(num_classes=10), 64),
-    (lambda: models.mobilenet_v3_small(num_classes=10), 64),
-    (lambda: models.squeezenet1_1(num_classes=10), 96),
-    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
-    (lambda: models.densenet121(num_classes=10), 64),
-    (lambda: models.inception_v3(num_classes=10), 128),
+    pytest.param(lambda: models.mobilenet_v3_small(num_classes=10), 64, marks=slow),
+    pytest.param(lambda: models.squeezenet1_1(num_classes=10), 96, marks=slow),
+    pytest.param(lambda: models.shufflenet_v2_x0_25(num_classes=10), 64, marks=slow),
+    pytest.param(lambda: models.densenet121(num_classes=10), 64, marks=slow),
+    pytest.param(lambda: models.inception_v3(num_classes=10), 128, marks=slow),
 ])
 def test_model_forward(ctor, size):
     paddle.seed(0)
@@ -33,6 +34,7 @@ def test_model_forward(ctor, size):
     assert np.isfinite(out.numpy()).all()
 
 
+@pytest.mark.slow
 def test_googlenet_forward():
     paddle.seed(0)
     m = models.googlenet(num_classes=10)
