@@ -1,6 +1,7 @@
 """paddle_tpu.distributed — reference python/paddle/distributed/__init__.py,
 rebuilt on jax.sharding meshes + XLA collectives (no NCCL/gloo)."""
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401  (the launcher module — python -m ...launch)
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,
@@ -55,17 +56,80 @@ def get_data_parallel_axis():
     return "dp" if "dp" in axes else None
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """Single-controller JAX drives all local devices from one process; spawn
-    therefore just runs func once (multi-host uses one process per host,
-    launched externally with jax.distributed env vars)."""
+def _spawn_worker(func, args):
+    from .parallel import init_parallel_env
+    init_parallel_env()
     func(*args)
 
 
-def launch():
-    raise NotImplementedError(
-        "use standard multi-host launching (one process per host with "
-        "JAX_COORDINATOR/process env) — see docs/distributed.md")
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Start `nprocs` local worker processes, each joining one
+    jax.distributed job, and run func in every one (reference
+    python/paddle/distributed/spawn.py). With nprocs<=1 — the normal TPU
+    situation, where ONE process drives all local chips — func simply runs
+    inline.
+
+    options: cpu_devices_per_rank=N gives each worker N virtual CPU
+    devices (emulation/testing); master="ip:port" pins the coordinator."""
+    if nprocs is None or nprocs <= 1:
+        from .parallel import init_parallel_env
+        init_parallel_env()
+        func(*args)
+        return []
+    import multiprocessing as mp
+    import os
+
+    from .launch import _free_port, force_cpu_devices
+
+    master = options.get("master") or f"127.0.0.1:{_free_port()}"
+    cpu_devices = int(options.get("cpu_devices_per_rank", 0))
+    ctx = mp.get_context("spawn")
+    procs = []
+    # the child inherits os.environ at start(); plugin/backends load at
+    # interpreter start (sitecustomize), so env must be staged HERE
+    saved = dict(os.environ)
+    try:
+        os.environ["PADDLE_MASTER"] = master
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        if cpu_devices:
+            force_cpu_devices(os.environ, cpu_devices)
+        for rank in range(nprocs):
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            p = ctx.Process(target=_spawn_worker, args=(func, args),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    if join:
+        import time
+
+        # fail fast: a dead worker leaves peers blocked in collectives, so
+        # terminate the group as soon as any exitcode is nonzero
+        first_bad = None
+        while any(p.is_alive() for p in procs):
+            for p in procs:
+                if p.exitcode not in (None, 0) and first_bad is None:
+                    first_bad = p.exitcode
+                    for q in procs:
+                        if q.is_alive():
+                            q.terminate()
+            time.sleep(0.2)
+        for p in procs:
+            p.join()
+        if first_bad is None:
+            bad = [p.exitcode for p in procs if p.exitcode]
+            first_bad = bad[0] if bad else None
+        if first_bad is not None:
+            raise RuntimeError(f"spawn worker failed with exit code {first_bad}")
+    return procs
+
+
+# NOTE: `paddle_tpu.distributed.launch` is the launcher MODULE (run it with
+# `python -m paddle_tpu.distributed.launch`), mirroring reference
+# python/paddle/distributed/launch/. No function of the same name is bound
+# here — it would be shadowed by the submodule import anyway.
 
 
 class ParallelMode:

@@ -1,4 +1,6 @@
 """init_parallel_env / DataParallel — reference python/paddle/distributed/parallel.py."""
+import os
+
 import jax
 
 from ..nn.layer_base import Layer
@@ -7,9 +9,29 @@ from .mesh import build_mesh, get_mesh
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel", "ParallelEnv"]
 
 
+def _dist_client_active():
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
 def init_parallel_env():
-    """Initializes the default 1-axis dp mesh over all visible devices.
-    Multi-host: call jax.distributed.initialize first (env-driven)."""
+    """Join the multi-host job if launched by paddle_tpu.distributed.launch
+    (PADDLE_MASTER/PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM env), then create
+    the default dp mesh over all (global) devices.
+
+    After jax.distributed.initialize, jax.devices() is the job-wide device
+    list, so every mesh built afterwards spans all hosts and XLA lowers
+    cross-host collectives onto ICI/DCN per the mesh layout."""
+    master = os.environ.get("PADDLE_MASTER")
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if master and world > 1 and not _dist_client_active():
+        jax.distributed.initialize(
+            coordinator_address=master,
+            num_processes=world,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     get_mesh(create_default=True)
     return ParallelEnv()
 

@@ -1,0 +1,118 @@
+"""Multi-host launch path: 2 launched processes form ONE jax.distributed job
+(2 procs x 4 virtual CPU devices = 8 global devices), run a sharded train
+step, and the grads match the single-process computation.
+
+Reference: python/paddle/distributed/launch/ + spawn.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_SCRIPT = """
+import os, sys
+import numpy as np
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()           # joins the jax.distributed job from env
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+mesh = dist.build_mesh(dp=2, fsdp=4)
+rng = np.random.RandomState(0)
+W1 = jnp.asarray(rng.randn(16, 32) * 0.1, jnp.float32)
+W2 = jnp.asarray(rng.randn(32, 8) * 0.1, jnp.float32)
+X = rng.randn(32, 16).astype("float32")
+Y = rng.randn(32, 8).astype("float32")
+
+data_sh = NamedSharding(mesh, P(("dp", "fsdp")))
+Xg = jax.make_array_from_callback(X.shape, data_sh, lambda i: X[i])
+Yg = jax.make_array_from_callback(Y.shape, data_sh, lambda i: Y[i])
+
+def loss(w1, w2, x, y):
+    h = jnp.tanh(x @ w1)
+    return jnp.mean((h @ w2 - y) ** 2)
+
+g1, g2 = jax.jit(
+    jax.grad(loss, argnums=(0, 1)),
+    in_shardings=(NamedSharding(mesh, P(None, "fsdp")),
+                  NamedSharding(mesh, P("fsdp", None)), data_sh, data_sh),
+    out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P())),
+)(W1, W2, Xg, Yg)
+
+if jax.process_index() == 0:
+    np.savez(sys.argv[1], g1=np.asarray(g1), g2=np.asarray(g2))
+"""
+
+
+def test_launch_two_process_grads_match(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN_SCRIPT)
+    out = tmp_path / "grads.npz"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--nproc_per_node", "2",
+         "--cpu_devices_per_rank", "4", str(script), str(out)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    got = np.load(out)
+
+    # single-process reference
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    W1 = jnp.asarray(rng.randn(16, 32) * 0.1, jnp.float32)
+    W2 = jnp.asarray(rng.randn(32, 8) * 0.1, jnp.float32)
+    X = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    Y = jnp.asarray(rng.randn(32, 8), jnp.float32)
+
+    def loss(w1, w2, x, y):
+        h = jnp.tanh(x @ w1)
+        return jnp.mean((h @ w2 - y) ** 2)
+
+    g1, g2 = jax.grad(loss, argnums=(0, 1))(W1, W2, X, Y)
+    np.testing.assert_allclose(got["g1"], np.asarray(g1), atol=1e-5)
+    np.testing.assert_allclose(got["g2"], np.asarray(g2), atol=1e-5)
+
+
+def test_launch_cli_parses():
+    from paddle_tpu.distributed.launch import _parse
+    args = _parse(["--nnodes", "2", "--rank", "1", "--master", "10.0.0.1:1234",
+                   "train.py", "--lr", "0.1"])
+    assert args.nnodes == 2 and args.rank == 1
+    assert args.master == "10.0.0.1:1234"
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+
+
+def _spawn_fn(out_dir):
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    import jax.numpy as jnp
+
+    # a cross-process collective actually runs
+    total = jax.jit(jnp.sum)(jnp.arange(jax.device_count(), dtype=jnp.float32))
+    with open(os.path.join(out_dir, f"rank{jax.process_index()}.ok"), "w") as f:
+        f.write(str(float(total)))
+
+
+def test_spawn_two_workers(tmp_path):
+    from paddle_tpu.distributed import spawn
+
+    spawn(_spawn_fn, args=(str(tmp_path),), nprocs=2, cpu_devices_per_rank=2)
+    for r in (0, 1):
+        assert (tmp_path / f"rank{r}.ok").read_text() == "6.0"
