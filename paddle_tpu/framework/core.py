@@ -132,6 +132,10 @@ def _is_diff_dtype(v):
     return jnp.issubdtype(d, np.inexact) or d == dtypes.bfloat16
 
 
+# set by paddle_tpu.profiler.Profiler.start() to time eager op dispatch
+_op_profiler = None
+
+
 def apply_op(fn, *args, **kwargs):
     """Central eager dispatch: unwrap Tensors, run `fn`, wrap outputs, and
     record a tape node when gradients are being tracked.
@@ -157,7 +161,17 @@ def apply_op(fn, *args, **kwargs):
                 in_tensors.append(a)
         else:
             raw.append(a)
-    out = fn(*raw, **kwargs)
+    if _op_profiler is None:
+        out = fn(*raw, **kwargs)
+    else:
+        import time as _time
+        _t0 = _time.perf_counter()
+        out = fn(*raw, **kwargs)
+        jax.block_until_ready(out)   # honest host timing while profiling
+        _name = getattr(fn, "__qualname__", None) or getattr(fn, "__name__", "op")
+        # lambdas carry their defining fn in __qualname__: "linear.<locals>.<lambda>"
+        _name = _name.replace(".<locals>.<lambda>", "").replace(".<locals>", ".")
+        _op_profiler._record_op(_name, _t0, _time.perf_counter())
     requires = bool(diff_idx)
     if isinstance(out, (tuple, list)):
         outs = [Tensor(o, stop_gradient=not requires) for o in out]
@@ -430,6 +444,12 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.need_clip = True
+        from . import _static_mode
+        if _static_mode[0]:
+            # static mode: register with the default Program so
+            # Program.all_parameters() reports real parameters
+            from ..static import _register_parameter
+            _register_parameter(self)
         self.partition_spec = None  # GSPMD mesh axes, set by parallel layers
 
     @property

@@ -52,6 +52,7 @@ class Program:
     def __init__(self):
         self._feeds = {}
         self._train_specs = {}   # id(loss var) -> (loss var, optimizer)
+        self._params = []        # Parameters created while this is default
 
     def global_block(self):
         return self
@@ -64,10 +65,13 @@ class Program:
         # strips backward/optimize ops when for_test=True).
         test = Program()
         test._feeds = self._feeds
+        test._params = self._params
         return test
 
     def all_parameters(self):
-        return []
+        """Parameters created under static mode while this Program was the
+        default (reference Program.all_parameters over persistable vars)."""
+        return list(self._params)
 
 
 _main = Program()
@@ -80,6 +84,10 @@ def default_main_program():
 
 def default_startup_program():
     return _startup
+
+
+def _register_parameter(param):
+    _main._params.append(param)
 
 
 def _register_minimize(loss, optimizer):
