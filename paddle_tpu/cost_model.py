@@ -82,8 +82,6 @@ class CostModel:
     def get_static_op_time(self, op_name, forward=True, dtype="float32"):
         if op_name not in self._OP_BENCH:
             return {"op_time": "0"}
-        if forward and dtype == "float32":
-            return {"op_time": str(self.static_cost_data()[op_name])}
         cache = getattr(self, "_op_cost_cache", None)
         if cache is None:
             cache = self._op_cost_cache = {}

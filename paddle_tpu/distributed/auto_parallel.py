@@ -1,9 +1,20 @@
 """Auto-parallel API — reference python/paddle/distributed/auto_parallel
-(shard_tensor / shard_op / ProcessMesh + cost-model planner).
+(interface.py shard_tensor/shard_op, process_mesh.py, planner_v2.py,
+engine.py).
 
-On TPU the planner IS the compiler: users annotate intent (shard_tensor →
-sharding constraint; engine = jit with GSPMD), XLA's SPMD partitioner does
-placement + collective insertion. ProcessMesh maps onto jax.sharding.Mesh.
+On TPU the partitioner IS the compiler: users annotate intent and XLA's
+SPMD pass does placement + collective insertion. The pieces:
+
+- ProcessMesh            → jax.sharding.Mesh wrapper (named axes)
+- shard_tensor/shard_op  → persistent partition_spec annotations +
+                           physical placement / sharding constraints
+- Planner                → derives the Mesh from the annotations' axis
+                           names + DistributedStrategy degrees (the
+                           reference's search-based planner becomes a
+                           deterministic degree solver; XLA handles the
+                           per-op placement search)
+- Engine                 → prepare/fit/evaluate/predict over the
+                           GSPMD-compiled Trainer step
 """
 import numpy as np
 
@@ -11,9 +22,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..framework.core import Tensor, apply_op
-from .mesh import get_mesh, set_mesh
+from .mesh import build_mesh, get_mesh, set_mesh
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Planner", "Engine"]
 
 
 class ProcessMesh:
@@ -31,11 +42,17 @@ class ProcessMesh:
 
 
 def shard_tensor(x, process_mesh=None, shard_spec=None, **kwargs):
-    """Annotate (and physically place) a tensor's sharding."""
+    """Annotate a tensor's sharding and place it.
+
+    The annotation is PERSISTENT: it is stored as `partition_spec` on the
+    tensor (the same attribute meta_parallel layers use), so the Engine /
+    Trainer re-applies it when compiling the training step — reference
+    dist_tensor dims_mapping semantics."""
     mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) else get_mesh()
-    spec = PartitionSpec(*(shard_spec or []))
-    sh = NamedSharding(mesh, spec)
+    spec = tuple(shard_spec or [])
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
     if isinstance(x, Tensor):
+        x.partition_spec = spec
         if isinstance(x._value, jax.Array):
             x._value = jax.device_put(x._value, sh)
             return x
@@ -45,7 +62,6 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, **kwargs):
 
 def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
     """Wrap an op so its inputs/outputs carry sharding constraints."""
-    mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) else get_mesh()
 
     def wrapped(*args, **kwargs):
         if in_shard_specs is not None:
@@ -63,36 +79,187 @@ def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None
     return wrapped
 
 
-class Engine:
-    """auto_parallel.Engine parity: fit/evaluate over the auto-sharded step
-    (delegates to distributed.trainer.Trainer)."""
+class Planner:
+    """Derives the device mesh from the model's sharding annotations
+    (reference planner_v2.Planner; the op-level placement search is XLA's).
 
-    def __init__(self, model=None, loss=None, optimizer=None, metrics=None, strategy=None):
+    Axis sizing: axes named in annotations get their degree from the
+    DistributedStrategy (mp_degree→tp, sharding_degree→fsdp, …) when
+    given; otherwise an annotated axis defaults to the largest power-of-2
+    that divides the remaining device count; whatever remains goes to dp.
+    """
+
+    KNOWN_AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+    def __init__(self, strategy=None):
+        self.strategy = strategy
+
+    def collect_axes(self, model):
+        axes = []
+        for _, p in model.named_parameters():
+            for entry in (getattr(p, "partition_spec", None) or ()):
+                for a in (entry if isinstance(entry, (tuple, list)) else [entry]):
+                    if a is not None and a not in axes:
+                        axes.append(a)
+        return axes
+
+    def plan(self, model, n_devices=None):
+        n = n_devices or len(jax.devices())
+        degrees = {}
+        if self.strategy is not None and hasattr(self.strategy, "_degrees"):
+            degrees = {k: v for k, v in self.strategy._degrees().items() if v > 1}
+        axes = self.collect_axes(model)
+        sizes = {a: 1 for a in self.KNOWN_AXES}
+        remaining = n
+        # explicit strategy degrees are binding and claim devices FIRST
+        for a, d in degrees.items():
+            if a not in sizes:
+                raise ValueError(f"strategy names unknown axis {a!r}")
+            if remaining % d != 0:
+                raise ValueError(f"axis {a!r} degree {d} does not divide "
+                                 f"remaining {remaining} devices")
+            sizes[a] = d
+            remaining //= d
+        # annotated axes without an explicit degree: largest 2^k that fits
+        for a in axes:
+            if a not in sizes:
+                raise ValueError(
+                    f"annotation uses axis {a!r}; Planner understands "
+                    f"{self.KNOWN_AXES} — pass a ProcessMesh for custom axes")
+            if a in degrees:
+                continue
+            d = 1
+            while remaining % (d * 2) == 0 and d * 2 <= remaining:
+                d *= 2
+            sizes[a] = d
+            remaining //= d
+        sizes["dp"] *= remaining
+        return build_mesh(devices=jax.devices()[:n], **sizes)
+
+
+class Engine:
+    """reference auto_parallel/engine.py:Engine — prepare/fit/evaluate/
+    predict over ONE GSPMD-compiled step. The annotated partition_specs
+    land in the compiled HLO as sharding ops; XLA inserts the collectives."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        self.strategy = strategy
         self._trainer = None
+        self._mesh = None
+        self._history = {"loss": []}
 
-    def _ensure(self):
-        if self._trainer is None:
+    # -- planning ---------------------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                n_devices=None):
+        if self._mesh is None:
+            self._mesh = Planner(self.strategy).plan(self.model, n_devices)
+        if self._trainer is None and mode != "predict" and \
+                self.optimizer is not None:
             from .trainer import Trainer
 
             loss_layer = self.loss
 
             def loss_fn(m, batch):
                 out = m(batch["x"])
+                if loss_layer is None:
+                    return out
                 return loss_layer(out, batch["y"])
-            self._trainer = Trainer(self.model, self.optimizer, loss_fn)
-        return self._trainer
 
-    def fit(self, train_data, epochs=1, batch_size=1, **kwargs):
+            self._trainer = Trainer(self.model, self.optimizer, loss_fn,
+                                    mesh=self._mesh)
+        return self
+
+    def compiled_hlo(self, batch):
+        """Lowered+compiled HLO text of the train step for `batch` —
+        lets callers (and tests) inspect the GSPMD shardings."""
+        self.prepare()
+        import jax.numpy as jnp
+        t = self._trainer
+        b = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()}
+        lowered = t._step_fn.lower(t.params, t.opt_state, t.consts,
+                                   self.optimizer.get_lr(), b)
+        return lowered.as_text()
+
+    # -- loops ------------------------------------------------------------
+    def _loader(self, data, batch_size):
         from ..io import DataLoader
-        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
-            train_data, batch_size=batch_size)
-        trainer = self._ensure()
-        history = []
-        for _ in range(epochs):
-            for batch in loader:
+        return data if isinstance(data, DataLoader) else DataLoader(
+            data, batch_size=batch_size)
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=0, **kwargs):
+        self.prepare()
+        if self._trainer is None:
+            raise ValueError("Engine.fit needs an optimizer")
+        self._history = {"loss": []}    # fresh per fit() call
+        loader = self._loader(train_data, batch_size)
+        for ep in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
                 x, y = batch if isinstance(batch, (list, tuple)) else (batch, None)
-                history.append(float(trainer.step({"x": x, "y": y})))
-        return history
+                loss = float(self._trainer.step({"x": x, "y": y}))
+                self._history["loss"].append(loss)
+                if log_freq and i % log_freq == 0:
+                    print(f"[auto_parallel] epoch {ep} step {i} loss {loss:.4f}")
+        return self._history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, **kwargs):
+        self.prepare()
+        if self._trainer is not None:
+            self._trainer.sync_to_model()
+        self.model.eval()
+        losses, n = 0.0, 0
+        for m in self.metrics:
+            if hasattr(m, "reset"):
+                m.reset()
+        for i, batch in enumerate(self._loader(valid_data, batch_size)):
+            if steps is not None and i >= steps:
+                break
+            x, y = batch if isinstance(batch, (list, tuple)) else (batch, None)
+            out = self.model(x)
+            if self.loss is not None:
+                losses += float(self.loss(out, y))
+                n += 1
+            for m in self.metrics:
+                m.update(m.compute(out, y)) if hasattr(m, "compute") else None
+        self.model.train()
+        res = {"loss": losses / max(n, 1)}
+        for m in self.metrics:
+            if hasattr(m, "accumulate"):
+                res[getattr(m, "name", lambda: m.__class__.__name__)()
+                    if callable(getattr(m, "name", None)) else "metric"] = m.accumulate()
+        return res
+
+    def predict(self, test_data, batch_size=1, steps=None, **kwargs):
+        self.prepare(mode="predict")
+        if self._trainer is not None:
+            self._trainer.sync_to_model()
+        self.model.eval()
+        outs = []
+        for i, batch in enumerate(self._loader(test_data, batch_size)):
+            if steps is not None and i >= steps:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.model(x))
+        self.model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+        if self._trainer is not None:
+            self._trainer.sync_to_model()
+        save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None and \
+                hasattr(self.optimizer, "state_dict"):
+            save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        from ..framework.io import load
+        self.model.set_state_dict(load(path + ".pdparams"))

@@ -122,6 +122,7 @@ class Profiler:
         self._timeline = []         # (name, start_s, dur_s) host events
         self._step_t0 = None
         self._num_samples = 0
+        self._pending_cycle = False    # recorded data not yet handed to handler
 
     # -- op hook (called from framework.core.apply_op) --------------------
     def _record_op(self, name, t0, t1):
@@ -131,6 +132,8 @@ class Profiler:
         self._op_stats.setdefault(name, _Stat()).add(t1 - t0)
 
     def _record_event(self, name, t0, t1):
+        if not self._recording_now():
+            return
         self._event_stats.setdefault(name, _Stat()).add(t1 - t0)
         self._timeline.append((name, t0, t1 - t0))
 
@@ -142,11 +145,16 @@ class Profiler:
         self._step_t0 = time.perf_counter()
         _active_profiler = self
         if self._scheduler is None:
+            self._pending_cycle = True
             self._set_op_hook(True)
             if not self.timer_only:
                 self._start_trace()
         else:
             self._apply_state(self._scheduler(self._step_idx))
+
+    def _recording_now(self):
+        return self._scheduler is None or self._state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
 
     def stop(self):
         global _active_profiler
@@ -158,8 +166,9 @@ class Profiler:
         if self._tracing:
             self._stop_trace()
         self._started = False
-        if self.on_trace_ready is not None:
+        if self.on_trace_ready is not None and self._pending_cycle:
             self.on_trace_ready(self)
+            self._pending_cycle = False
 
     def _set_op_hook(self, on):
         """The op hook syncs the device per dispatch (honest timings), so it
@@ -192,16 +201,19 @@ class Profiler:
             self._start_trace()
         elif not recording and self._tracing:
             self._stop_trace()
+        if recording:
+            self._pending_cycle = True
         if self._state == ProfilerState.RECORD_AND_RETURN and not recording \
                 and self.on_trace_ready is not None:
             self.on_trace_ready(self)      # cycle boundary (reference behavior)
+            self._pending_cycle = False
         self._state = state
 
     def step(self, num_samples=None):
         """Marks a training-step boundary: times the step, advances the
         trace scheduler."""
         now = time.perf_counter()
-        if self._step_t0 is not None:
+        if self._step_t0 is not None and self._recording_now():
             self._step_stat.add(now - self._step_t0)
             self._timeline.append((f"step#{self._step_idx}", self._step_t0,
                                    now - self._step_t0))
