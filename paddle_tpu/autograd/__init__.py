@@ -7,8 +7,21 @@ are direct jax transforms — the idiomatic TPU path.
 import jax
 
 from ..framework.core import Tensor, _pause_tape, apply_op, backward, is_grad_enabled, no_grad
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    batch_hessian,
+    batch_jacobian,
+    hessian,
+    jacobian,
+    vhp,
+)
 
-__all__ = ["PyLayerContext", "backward", "grad", "no_grad", "is_grad_enabled", "PyLayer", "value_and_grad", "vjp", "jvp"]
+__all__ = ["PyLayerContext", "backward", "grad", "no_grad", "is_grad_enabled",
+           "PyLayer", "value_and_grad", "vjp", "jvp", "Jacobian", "Hessian",
+           "jacobian", "batch_jacobian", "hessian", "batch_hessian", "vhp",
+           "functional"]
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,

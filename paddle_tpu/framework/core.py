@@ -261,7 +261,8 @@ class Tensor:
     immutable device buffers; functional-update under the hood (`.at[].set`).
     """
 
-    __slots__ = ("_value", "stop_gradient", "grad", "_producer", "name", "persistable", "__weakref__")
+    __slots__ = ("_value", "stop_gradient", "grad", "_producer", "name",
+                 "persistable", "partition_spec", "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None):
         if isinstance(value, Tensor):
@@ -276,6 +277,7 @@ class Tensor:
         self._producer = None
         self.name = name
         self.persistable = False
+        self.partition_spec = None  # GSPMD mesh axes (auto_parallel/fleet)
 
     # -- basic properties -------------------------------------------------
     @property
@@ -320,6 +322,9 @@ class Tensor:
     # -- conversion -------------------------------------------------------
     def numpy(self):
         return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._value, dtype=dtype)
 
     def item(self, *idx):
         v = self._value
@@ -434,8 +439,7 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (paddle.framework.Parameter / fluid ParamBase)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip",
-                 "partition_spec")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
 
     def __init__(self, value, dtype=None, name=None, trainable=True):
         super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)

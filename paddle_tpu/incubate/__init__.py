@@ -1,6 +1,6 @@
 """paddle_tpu.incubate — reference python/paddle/incubate (fused ops, MoE,
 checkpointing, ASP, segment/graph ops, LookAhead/ModelAverage)."""
-from . import asp, checkpoint, graph, nn, operators, optimizer, tensor  # noqa: F401
+from . import asp, autograd, checkpoint, graph, nn, operators, optimizer, tensor  # noqa: F401
 from .graph import graph_khop_sampler, graph_reindex, graph_sample_neighbors  # noqa: F401
 from .operators import (  # noqa: F401
     graph_send_recv,
@@ -10,7 +10,7 @@ from .operators import (  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .tensor import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
 
-__all__ = ["nn", "checkpoint", "autotune", "asp", "operators", "optimizer",
+__all__ = ["nn", "checkpoint", "autotune", "asp", "autograd", "operators", "optimizer",
            "tensor", "segment_sum", "segment_mean", "segment_max",
            "segment_min", "graph_send_recv", "graph_khop_sampler",
            "graph_reindex", "graph_sample_neighbors", "softmax_mask_fuse",

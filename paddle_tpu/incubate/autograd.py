@@ -1,0 +1,20 @@
+"""paddle_tpu.incubate.autograd — reference
+python/paddle/incubate/autograd/__init__.py:14-17 (re-exports the functional
+higher-order autograd surface: vjp, jvp, Jacobian, Hessian)."""
+from ..autograd import Hessian, Jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def enable_prim():
+    """Reference's primitive-op (prim2orig) switch; jax transforms are
+    already composable primitives, so this is a parity no-op."""
+    return None
+
+
+def disable_prim():
+    return None
+
+
+def prim_enabled():
+    return False

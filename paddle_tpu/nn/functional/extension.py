@@ -69,16 +69,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     for the fused path; this jnp fallback is used on CPU/interpret tests."""
     from ...ops.attention import flash_attention_available, flash_attention
 
+    rate = float(dropout_p or 0.0) if training else 0.0
     if flash_attention_available(query, attn_mask, dropout_p):
-        return flash_attention(query, key, value, causal=is_causal)
+        return flash_attention(query, key, value, causal=is_causal,
+                               attn_mask=attn_mask, dropout_rate=rate)
 
-    # CPU / masked / odd-shape fallback: the shared jnp reference (fp32
-    # softmax, GQA + additive/bool mask support) in ops/attention.py
-    from ...ops.attention import mha_reference
+    # CPU fallback: the shared jnp reference (fp32 softmax, GQA +
+    # additive/bool mask + hash dropout) in ops/attention.py
+    from ...ops.attention import _next_seed, mha_reference
+
+    seed = _next_seed() if rate else 0
 
     def _f(q, k, v, *rest):
         m = rest[0] if rest else None
-        return mha_reference(q, k, v, causal=is_causal, attn_mask=m)
+        return mha_reference(q, k, v, causal=is_causal, attn_mask=m,
+                             dropout_rate=rate, dropout_seed=seed)
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
     return apply_op(_f, *args)
 

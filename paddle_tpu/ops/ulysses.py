@@ -75,5 +75,7 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     spec = P(batch_axes, axis_name, None, None)
     fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
                            causal=causal, scale=scale)
+    # check_vma=False: the vma checker can't see through pallas_call's
+    # out_shape, so it would force the flash kernel onto the fallback path
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)
