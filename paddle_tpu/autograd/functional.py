@@ -145,12 +145,16 @@ def jacobian(func, inputs, create_graph=False, allow_unused=False):
     arrs = [_arr(t) for t in _as_list(inputs)]
     in_seq = isinstance(inputs, (list, tuple))
 
+    out_is_seq = [False]
+
     def raw(*xs):
         with _pause_tape():
-            return [_arr(o) for o in _as_list(func(*[Tensor(x, stop_gradient=False) for x in xs]))]
+            res = func(*[Tensor(x, stop_gradient=False) for x in xs])
+        out_is_seq[0] = isinstance(res, (list, tuple))
+        return [_arr(o) for o in _as_list(res)]
 
-    outs = jax.eval_shape(raw, *arrs)
-    out_seq = isinstance(func(*[Tensor(a) for a in arrs]), (list, tuple))
+    outs = jax.eval_shape(raw, *arrs)   # abstract: also records out_is_seq
+    out_seq = out_is_seq[0]
     jacs = jax.jacrev(raw, argnums=tuple(range(len(arrs))))(*arrs)
     rows = []
     for i, oshape in enumerate(outs):
@@ -167,11 +171,16 @@ def batch_jacobian(func, inputs, create_graph=False, allow_unused=False):
     in_seq = isinstance(inputs, (list, tuple))
     b = arrs[0].shape[0]
 
+    out_is_seq = [False]
+
     def raw(*xs):
         with _pause_tape():
-            return [_arr(o) for o in _as_list(func(*[Tensor(x, stop_gradient=False) for x in xs]))]
+            res = func(*[Tensor(x, stop_gradient=False) for x in xs])
+        out_is_seq[0] = isinstance(res, (list, tuple))
+        return [_arr(o) for o in _as_list(res)]
 
-    out_seq = isinstance(func(*[Tensor(a) for a in arrs]), (list, tuple))
+    jax.eval_shape(raw, *arrs)          # abstract: records out_is_seq
+    out_seq = out_is_seq[0]
 
     def per_sample(*xs):
         # xs are single samples; run func on a size-1 batch

@@ -1,15 +1,22 @@
 """Data loading — reference python/paddle/io/__init__.py (+ the C++
 fluid/operators/reader machinery it fronts).
 
-TPU-native: workers are threads feeding a bounded prefetch queue (XLA releases
-the GIL during device compute, so threads overlap host preprocessing with
-device steps); batches are optionally device_put ahead of use. A native C++
-worker pool (paddle_tpu/runtime) can plug in as the `num_workers` backend.
+TPU-native: `num_workers > 0` runs PROCESS workers with shared-memory ndarray
+transport (reference fluid/dataloader/dataloader_iter.py:341
+_DataLoaderIterMultiProcess), so python-bound transforms scale past the GIL.
+Workers collate to numpy only — jax is never touched in a forked child — and
+the parent wraps batches as Tensors. A thread-pool mode
+(`worker_mode="thread"`) remains for transforms that already release the GIL
+(numpy, the native image ops in paddle_tpu/runtime/image.py).
 """
 import itertools
 import math
+import multiprocessing as _mp
 import queue as _queue
 import threading
+import traceback as _traceback
+
+from multiprocessing import shared_memory as _shm
 
 import numpy as np
 
@@ -233,6 +240,31 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _np_collate(batch):
+    """Numpy-only collate used inside worker PROCESSES (no jax in children)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_np_collate([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(t._value) for t in batch])
+    return np.asarray(batch) if not isinstance(sample, np.ndarray) \
+        else np.stack(batch)
+
+
+def _tensorize(tree):
+    """Parent-side: numpy leaves -> Tensor (matches default_collate_fn)."""
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tensorize(t) for t in tree)
+    if isinstance(tree, dict):
+        return {k: _tensorize(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    return tree
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
@@ -250,17 +282,270 @@ def default_collate_fn(batch):
     return Tensor(np.asarray(batch))
 
 
+# ---------------------------------------------------------------------------
+# Multiprocess workers (reference _DataLoaderIterMultiProcess): fork'd
+# processes, numpy-only collate, shared-memory segments for large arrays.
+# ---------------------------------------------------------------------------
+
+_SHM_MIN_BYTES = 4096  # below this, pickling through the queue is cheaper
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.message = "".join(_traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+
+    def reraise(self):
+        raise RuntimeError(f"DataLoader worker failed:\n{self.message}")
+
+
+def _encode_tree(tree, use_shm):
+    """numpy leaves -> ('shm', name, shape, dtype) markers (big arrays) or
+    inline values; containers preserved."""
+    if isinstance(tree, (list, tuple)):
+        return ("__seq__", type(tree).__name__,
+                [_encode_tree(t, use_shm) for t in tree])
+    if isinstance(tree, dict):
+        return ("__map__", {k: _encode_tree(v, use_shm) for k, v in tree.items()})
+    if isinstance(tree, np.ndarray) and use_shm and tree.nbytes >= _SHM_MIN_BYTES:
+        seg = _shm.SharedMemory(create=True, size=tree.nbytes)
+        np.ndarray(tree.shape, tree.dtype, buffer=seg.buf)[...] = tree
+        name = seg.name
+        seg.close()
+        try:
+            # ownership transfers to the parent (which unlinks after decode);
+            # drop the worker-side tracker registration so its exit doesn't
+            # double-clean or warn about "leaked" segments
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister("/" + name, "shared_memory")
+        except Exception:
+            pass
+        return ("__shm__", name, tree.shape, str(tree.dtype))
+    return ("__val__", tree)
+
+
+def _decode_tree(node):
+    tag = node[0]
+    if tag == "__seq__":
+        items = [_decode_tree(t) for t in node[2]]
+        return tuple(items) if node[1] == "tuple" else items
+    if tag == "__map__":
+        return {k: _decode_tree(v) for k, v in node[1].items()}
+    if tag == "__shm__":
+        _, name, shape, dtype = node
+        seg = _shm.SharedMemory(name=name)
+        try:
+            arr = np.array(np.ndarray(shape, dtype, buffer=seg.buf))  # copy out
+        finally:
+            seg.close()
+            seg.unlink()
+        return arr
+    return node[1]
+
+
+def _release_tree(node):
+    """Unlink shm segments of a payload that will never be decoded."""
+    tag = node[0]
+    if tag == "__seq__":
+        for t in node[2]:
+            _release_tree(t)
+    elif tag == "__map__":
+        for v in node[1].values():
+            _release_tree(v)
+    elif tag == "__shm__":
+        try:
+            seg = _shm.SharedMemory(name=node[1])
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _mp_map_worker(dataset, collate_fn, index_q, result_q, wid, num_workers,
+                   worker_init_fn, use_shm):
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn:
+        worker_init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        epoch, i, idxs = item
+        try:
+            data = collate_fn([dataset[j] for j in idxs])
+            result_q.put((epoch, i, _encode_tree(data, use_shm)))
+        except Exception as e:
+            result_q.put((epoch, i, _WorkerError(e)))
+
+
+def _mp_iterable_worker(dataset, collate_fn, batch_size, drop_last, result_q,
+                        wid, num_workers, worker_init_fn, use_shm):
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn:
+        worker_init_fn(wid)
+    try:
+        batch = []
+        for item in dataset:
+            batch.append(item)
+            if len(batch) == batch_size:
+                result_q.put((0, None, _encode_tree(collate_fn(batch), use_shm)))
+                batch = []
+        if batch and not drop_last:
+            result_q.put((0, None, _encode_tree(collate_fn(batch), use_shm)))
+    except Exception as e:
+        result_q.put((0, None, _WorkerError(e)))
+    result_q.put((0, None, "__end__"))
+
+
+def _poll_result(result_q, user_timeout, check_alive):
+    """Blocking result_q.get with worker-liveness polling: a worker that is
+    OOM-killed or segfaults mid-batch must raise, not hang the training loop
+    (reference _DataLoaderIterMultiProcess watches worker exit the same way)."""
+    import time
+    deadline = time.monotonic() + user_timeout if user_timeout else None
+    while True:
+        wait = 5.0
+        if deadline is not None:
+            wait = min(wait, max(0.01, deadline - time.monotonic()))
+        try:
+            return result_q.get(timeout=wait)
+        except _queue.Empty:
+            check_alive()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"DataLoader timed out after {user_timeout}s waiting on "
+                    "worker output")
+
+
+def _drain_release(result_q):
+    """Release shm of any undecoded payloads left in a result queue."""
+    while True:
+        try:
+            _, _, payload = result_q.get_nowait()
+        except _queue.Empty:
+            return
+        except Exception:
+            return
+        if not isinstance(payload, (_WorkerError, str)):
+            _release_tree(payload)
+
+
+def _start_quiet(procs):
+    """Start worker processes, muting the fork-vs-threads warnings: the
+    children never touch jax (numpy-only collate), so the JAX/CPython
+    fork-with-threads caveat does not apply to them."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        warnings.simplefilter("ignore", DeprecationWarning)
+        for p in procs:
+            p.start()
+
+
+class _MultiprocessPool:
+    """Worker processes + queues, reusable across epochs (persistent_workers)."""
+
+    def __init__(self, loader):
+        ctx = _mp.get_context("fork" if "fork" in _mp.get_all_start_methods()
+                              else "spawn")
+        self.loader = loader
+        self.epoch = 0
+        self.index_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        collate = loader.collate_fn
+        if collate is default_collate_fn:
+            collate = _np_collate  # never touch jax inside a forked child
+        self.procs = [
+            ctx.Process(
+                target=_mp_map_worker,
+                args=(loader.dataset, collate, self.index_q, self.result_q,
+                      w, loader.num_workers, loader.worker_init_fn,
+                      loader.use_shared_memory),
+                daemon=True)
+            for w in range(loader.num_workers)]
+        _start_quiet(self.procs)
+
+    def shutdown(self):
+        for _ in self.procs:
+            try:
+                self.index_q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        # release shm of any results the consumer never decoded
+        _drain_release(self.result_q)
+
+    def _check_workers_alive(self):
+        dead = [p for p in self.procs if not p.is_alive()]
+        if dead:
+            codes = [p.exitcode for p in dead]
+            raise RuntimeError(
+                f"{len(dead)} DataLoader worker(s) exited unexpectedly "
+                f"(exit codes {codes}) — e.g. OOM-killed or segfaulted in "
+                "dataset.__getitem__")
+
+    def run_epoch(self):
+        loader = self.loader
+        self.epoch += 1
+        epoch = self.epoch
+        batches = list(loader.batch_sampler)
+        n = len(batches)
+        depth = min(n, loader.num_workers * loader.prefetch_factor)
+        for j in range(depth):
+            self.index_q.put((epoch, j, batches[j]))
+        sent = depth
+        pending, next_i, received = {}, 0, 0
+        try:
+            while received < n:
+                payload = _poll_result(self.result_q, loader.timeout,
+                                       self._check_workers_alive)
+                ep, i, payload = payload
+                if ep != epoch:       # stale result from an abandoned epoch
+                    if not isinstance(payload, (_WorkerError, str)):
+                        _release_tree(payload)
+                    continue
+                received += 1
+                if sent < n:
+                    self.index_q.put((epoch, sent, batches[sent]))
+                    sent += 1
+                pending[i] = payload
+                while next_i in pending:
+                    payload = pending.pop(next_i)
+                    next_i += 1
+                    if isinstance(payload, _WorkerError):
+                        payload.reraise()
+                    yield _tensorize(_decode_tree(payload))
+        finally:
+            # error or early consumer break: release out-of-order results we
+            # already popped; in-flight queue results drain on the next epoch
+            # (epoch tag) or in shutdown()
+            for payload in pending.values():
+                if not isinstance(payload, (_WorkerError, str)):
+                    _release_tree(payload)
+            if not loader.persistent_workers:
+                self.shutdown()
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 worker_mode="process"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self.worker_mode = worker_mode  # "process" (reference parity) | "thread"
+        self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -286,6 +571,48 @@ class DataLoader:
                 batch = []
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
+
+    def _iter_iterable_multiprocess(self):
+        """Each worker iterates its own copy of the dataset (shard inside
+        __iter__ via get_worker_info, reference semantics); batches arrive
+        worker-interleaved."""
+        ctx = _mp.get_context("fork" if "fork" in _mp.get_all_start_methods()
+                              else "spawn")
+        result_q = ctx.Queue()
+        collate = self.collate_fn
+        if collate is default_collate_fn:
+            collate = _np_collate
+        procs = [ctx.Process(
+            target=_mp_iterable_worker,
+            args=(self.dataset, collate, self.batch_size, self.drop_last,
+                  result_q, w, self.num_workers, self.worker_init_fn,
+                  self.use_shared_memory), daemon=True)
+            for w in range(self.num_workers)]
+        _start_quiet(procs)
+        live = len(procs)
+
+        def check_alive():
+            if any(not p.is_alive() and p.exitcode not in (0, None)
+                   for p in procs):
+                raise RuntimeError(
+                    "a DataLoader iterable worker exited unexpectedly")
+
+        try:
+            while live:
+                _, _, payload = _poll_result(result_q, self.timeout, check_alive)
+                if payload == "__end__":
+                    live -= 1
+                    continue
+                if isinstance(payload, _WorkerError):
+                    payload.reraise()
+                yield _tensorize(_decode_tree(payload))
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            _drain_release(result_q)
 
     def _iter_map_sync(self):
         for idxs in self.batch_sampler:
@@ -337,9 +664,28 @@ class DataLoader:
         finally:
             stop.set()
 
+    def _iter_map_multiprocess(self):
+        # pool is created lazily HERE (inside the generator) so that an
+        # iterator that is never advanced doesn't strand worker processes
+        if self._pool is None or not self.persistent_workers:
+            self._pool = _MultiprocessPool(self)
+        yield from self._pool.run_epoch()
+
     def __iter__(self):
         if self._iterable_mode:
+            if self.num_workers and self.num_workers > 0:
+                return self._iter_iterable_multiprocess()
             return self._iter_iterable()
         if self.num_workers and self.num_workers > 0:
-            return self._iter_map_threaded()
+            if self.worker_mode == "thread":
+                return self._iter_map_threaded()
+            return self._iter_map_multiprocess()
         return self._iter_map_sync()
+
+    def __del__(self):
+        pool, self._pool = self._pool, None
+        if pool is not None and self.persistent_workers:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass

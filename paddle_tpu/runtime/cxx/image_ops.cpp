@@ -1,0 +1,153 @@
+// Native image pipeline for the DataLoader — the TPU-side equivalent of the
+// reference's C++ data feeding ops (paddle/fluid/operators/data_norm_op,
+// image decode in paddle/fluid/operators/reader). All entry points are
+// plain-C ABI for ctypes and run entirely off the GIL; the Python wrapper
+// (paddle_tpu/runtime/image.py) falls back to PIL/numpy when this .so is
+// unavailable.
+//
+//   pti_jpeg_info        — parse header: height/width/channels
+//   pti_decode_jpeg      — decode into caller-provided HWC uint8 buffer
+//   pti_resize_bilinear  — HWC uint8 bilinear resize
+//   pti_normalize_chw    — HWC uint8 -> CHW float32 (x/255 - mean)/std
+//   pti_pipeline         — fused decode -> resize -> normalize, one call
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 image_ops.cpp -ljpeg
+
+#include <cstdint>
+#include <cstdio>  // jpeglib.h needs FILE declared
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+#include <csetjmp>
+
+extern "C" {
+
+struct PtiErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+static void pti_error_exit(j_common_ptr cinfo) {
+  PtiErrMgr* err = reinterpret_cast<PtiErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+int pti_jpeg_info(const uint8_t* buf, int64_t len, int* h, int* w, int* c) {
+  jpeg_decompress_struct cinfo;
+  PtiErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = pti_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = cinfo.num_components >= 3 ? 3 : 1;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// out must hold h*w*c bytes (c from pti_jpeg_info: 3 for color, 1 for gray).
+int pti_decode_jpeg(const uint8_t* buf, int64_t len, uint8_t* out) {
+  jpeg_decompress_struct cinfo;
+  PtiErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = pti_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = cinfo.num_components >= 3 ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+  const int stride = cinfo.output_width * cinfo.output_components;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// HWC uint8 bilinear resize (align_corners=false, pixel-center sampling —
+// matches PIL/torchvision antialias=off semantics closely enough for
+// training pipelines).
+void pti_resize_bilinear(const uint8_t* src, int h, int w, int c,
+                         uint8_t* dst, int oh, int ow) {
+  const float sy = static_cast<float>(h) / oh;
+  const float sx = static_cast<float>(w) / ow;
+  for (int y = 0; y < oh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    const float wy = fy - y0;
+    for (int x = 0; x < ow; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      const float wx = fx - x0;
+      const uint8_t* p00 = src + (static_cast<size_t>(y0) * w + x0) * c;
+      const uint8_t* p01 = src + (static_cast<size_t>(y0) * w + x1) * c;
+      const uint8_t* p10 = src + (static_cast<size_t>(y1) * w + x0) * c;
+      const uint8_t* p11 = src + (static_cast<size_t>(y1) * w + x1) * c;
+      uint8_t* out = dst + (static_cast<size_t>(y) * ow + x) * c;
+      for (int ch = 0; ch < c; ++ch) {
+        const float top = p00[ch] + (p01[ch] - p00[ch]) * wx;
+        const float bot = p10[ch] + (p11[ch] - p10[ch]) * wx;
+        const float val = top + (bot - top) * wy;
+        out[ch] = static_cast<uint8_t>(val + 0.5f);
+      }
+    }
+  }
+}
+
+// HWC uint8 -> CHW float32, (x*scale - mean[ch]) / std[ch]. scale is
+// typically 1/255; pass mean/std in the scaled domain.
+void pti_normalize_chw(const uint8_t* src, int h, int w, int c,
+                       const float* mean, const float* stddev, float scale,
+                       float* out) {
+  const size_t plane = static_cast<size_t>(h) * w;
+  for (int ch = 0; ch < c; ++ch) {
+    const float m = mean[ch];
+    const float inv = 1.0f / stddev[ch];
+    float* dst = out + ch * plane;
+    const uint8_t* s = src + ch;
+    for (size_t i = 0; i < plane; ++i) {
+      dst[i] = (s[i * c] * scale - m) * inv;
+    }
+  }
+}
+
+// Fused decode -> resize -> normalize. out is CHW float32 [c, oh, ow]
+// (c resolved from the JPEG: 3 or 1). Returns the channel count, or -1.
+int pti_pipeline(const uint8_t* buf, int64_t len, int oh, int ow,
+                 const float* mean, const float* stddev, float scale,
+                 float* out) {
+  int h, w, c;
+  if (pti_jpeg_info(buf, len, &h, &w, &c) != 0) return -1;
+  std::vector<uint8_t> decoded(static_cast<size_t>(h) * w * c);
+  if (pti_decode_jpeg(buf, len, decoded.data()) != 0) return -1;
+  if (h == oh && w == ow) {
+    pti_normalize_chw(decoded.data(), oh, ow, c, mean, stddev, scale, out);
+    return c;
+  }
+  std::vector<uint8_t> resized(static_cast<size_t>(oh) * ow * c);
+  pti_resize_bilinear(decoded.data(), h, w, c, resized.data(), oh, ow);
+  pti_normalize_chw(resized.data(), oh, ow, c, mean, stddev, scale, out);
+  return c;
+}
+
+}  // extern "C"

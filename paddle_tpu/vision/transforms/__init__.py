@@ -67,8 +67,6 @@ class ToTensor(BaseTransform):
 
 
 def resize(img, size, interpolation="bilinear"):
-    import jax
-    import jax.numpy as jnp
     arr = _to_hwc_array(img)
     h, w = arr.shape[:2]
     if isinstance(size, int):
@@ -78,6 +76,12 @@ def resize(img, size, interpolation="bilinear"):
             oh, ow = int(size * h / w), size
     else:
         oh, ow = size
+    if interpolation == "bilinear" and arr.dtype == np.uint8 and arr.ndim == 3:
+        # hot path: native C++ bilinear (off-GIL), torch-compatible sampling
+        from ...runtime.image import resize_bilinear
+        return resize_bilinear(arr, (oh, ow)).astype(np.float32)
+    import jax
+    import jax.numpy as jnp
     method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
     out_shape = (oh, ow) + arr.shape[2:]
     return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape, method=method))

@@ -1,0 +1,191 @@
+"""Multiprocess DataLoader + native image ops (VERDICT #8).
+
+Reference: fluid/dataloader/dataloader_iter.py:341 (_DataLoaderIterMultiProcess,
+shared-memory transport) and the C++ reader image pipeline.
+"""
+import io as _io
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+
+
+class _ArrayDS(Dataset):
+    def __init__(self, n=64, shape=(3, 8, 8)):
+        self.x = np.arange(n * int(np.prod(shape)), dtype=np.float32).reshape((n,) + shape)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_process_workers_match_sync():
+    ds = _ArrayDS()
+    sync = [tuple(t.numpy() for t in b) for b in DataLoader(ds, batch_size=8)]
+    mp = [tuple(t.numpy() for t in b)
+          for b in DataLoader(ds, batch_size=8, num_workers=2)]
+    assert len(sync) == len(mp)
+    for (sx, sy), (mx, my) in zip(sync, mp):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+
+
+def test_process_workers_small_payload_no_shm():
+    # below the shm threshold, payloads travel through the queue
+    ds = _ArrayDS(n=16, shape=(2,))
+    out = list(DataLoader(ds, batch_size=4, num_workers=2))
+    assert len(out) == 4 and out[0][0].shape == [4, 2]
+
+
+def test_persistent_workers_multi_epoch():
+    ds = _ArrayDS(n=32)
+    loader = DataLoader(ds, batch_size=8, num_workers=2, persistent_workers=True)
+    e1 = [b[1].numpy() for b in loader]
+    e2 = [b[1].numpy() for b in loader]
+    for a, b in zip(e1, e2):
+        np.testing.assert_array_equal(a, b)
+    assert loader._pool is not None and loader._pool.procs[0].is_alive()
+    loader._pool.shutdown()
+
+
+def test_worker_exception_propagates():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(3, np.float32)
+
+        def __len__(self):
+            return 8
+
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+def test_iterable_dataset_process_workers():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            from paddle_tpu.io import get_worker_info
+            info = get_worker_info()
+            wid = info.id if info else 0
+            nw = info.num_workers if info else 1
+            for i in range(wid, 20, nw):
+                yield np.full((2,), i, np.float32)
+
+    out = list(DataLoader(Stream(), batch_size=5, num_workers=2))
+    got = sorted(int(v) for b in out for v in b.numpy()[:, 0])
+    assert got == sorted(list(range(20)))
+
+
+def test_worker_init_fn_and_info():
+    seen = []
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            from paddle_tpu.io import get_worker_info
+            info = get_worker_info()
+            return np.asarray([i, info.id if info else -1], np.int64)
+
+        def __len__(self):
+            return 8
+
+    out = list(DataLoader(DS(), batch_size=2, num_workers=2))
+    wids = {int(b.numpy()[0, 1]) for b in out}
+    assert wids <= {0, 1} and len(wids) >= 1
+
+
+def test_batches_come_from_worker_processes():
+    """Proof of process (not thread) execution: __getitem__ reports its pid,
+    which must differ from the parent's."""
+    import os
+
+    class PidDS(Dataset):
+        def __getitem__(self, i):
+            return np.asarray([os.getpid()], np.int64)
+
+        def __len__(self):
+            return 8
+
+    out = list(DataLoader(PidDS(), batch_size=2, num_workers=2))
+    pids = {int(v) for b in out for v in b.numpy()[:, 0]}
+    assert os.getpid() not in pids
+    assert 1 <= len(pids) <= 2
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(len(__import__("os").sched_getaffinity(0)) < 4,
+                    reason="needs >=4 CPUs to demonstrate parallel speedup "
+                           "(single-core CI box caps the ratio at ~1x)")
+def test_process_beats_threads_on_gil_bound_transform():
+    """VERDICT #8 done-criterion: >2x over thread mode on a CPU-bound
+    (pure-python, GIL-holding) transform."""
+
+    class PyHeavy(Dataset):
+        def __getitem__(self, i):
+            acc = 0
+            for j in range(600000):     # pure python: holds the GIL
+                acc += (i * j) % 7
+            return np.asarray([acc], np.float32)
+
+        def __len__(self):
+            return 48
+
+    ds = PyHeavy()
+
+    def run(mode):
+        loader = DataLoader(ds, batch_size=4, num_workers=4, worker_mode=mode)
+        t0 = time.perf_counter()
+        n = sum(1 for _ in loader)
+        return time.perf_counter() - t0, n
+
+    t_thread, n1 = run("thread")
+    t_proc, n2 = run("process")
+    assert n1 == n2 == 12
+    ratio = t_thread / t_proc
+    print(f"thread={t_thread:.2f}s process={t_proc:.2f}s ratio={ratio:.2f}x")
+    assert ratio > 2.0, f"process workers only {ratio:.2f}x over threads"
+
+
+def test_native_image_ops_pipeline():
+    from PIL import Image
+
+    from paddle_tpu.runtime import image as I
+
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(50, 70, 3) * 255).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=95)
+    data = buf.getvalue()
+
+    dec = I.decode_jpeg(data)
+    assert dec.shape == (50, 70, 3)
+    pil = np.asarray(Image.open(_io.BytesIO(data)))
+    assert np.abs(dec.astype(int) - pil.astype(int)).max() <= 1
+
+    r = I.resize_bilinear(dec, (32, 48))
+    assert r.shape == (32, 48, 3)
+
+    n = I.normalize_chw(r, [0.5, 0.5, 0.5], [0.25, 0.25, 0.25])
+    gold = ((r.astype(np.float32) / 255 - 0.5) / 0.25).transpose(2, 0, 1)
+    np.testing.assert_allclose(n, gold, atol=1e-5)
+
+    fused = I.decode_resize_normalize(data, (32, 48), [0.5] * 3, [0.25] * 3)
+    np.testing.assert_allclose(fused, n, atol=1e-5)
+
+
+def test_transforms_resize_uses_native_path():
+    from paddle_tpu.vision import transforms as T
+
+    rng = np.random.RandomState(1)
+    img = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+    out = T.resize(img, (20, 30))
+    assert out.shape == (20, 30, 3) and out.dtype == np.float32
+    # parity vs torch-style bilinear (computed via the runtime module itself
+    # on a float path): just sanity-range here
+    assert 0 <= out.min() and out.max() <= 255
