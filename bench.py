@@ -32,7 +32,7 @@ def chip_peak_flops():
     return 197e12
 
 
-def run_config(cfg_name, batch_size, seq_len, steps=10):
+def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full"):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -41,7 +41,8 @@ def run_config(cfg_name, batch_size, seq_len, steps=10):
     from paddle_tpu.models import gpt as gpt_mod
     from paddle_tpu.models import GPT, GPTPretrainingCriterion
 
-    cfg = getattr(gpt_mod, cfg_name)(max_seq_len=seq_len)
+    cfg = getattr(gpt_mod, cfg_name)(max_seq_len=seq_len,
+                                     remat_policy=remat_policy)
     paddle.seed(0)
     build_mesh(dp=1)
     log(f"building {cfg_name}: {cfg.num_params()/1e6:.0f}M params, "
@@ -83,34 +84,165 @@ def run_config(cfg_name, batch_size, seq_len, steps=10):
     return tokens_per_sec, mfu, n_params
 
 
+def run_resnet50(batch_size=128, steps=10):
+    """BASELINE.json config 1: ResNet-50 train step, imgs/sec/chip."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = paddle.vision.models.resnet50(num_classes=1000)
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    weight_decay=1e-4)
+
+    def loss_fn(m, batch):
+        logits = m(paddle.to_tensor(batch["image"]))
+        return paddle.nn.functional.cross_entropy(
+            logits, paddle.to_tensor(batch["label"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.randn(batch_size, 3, 224, 224).astype("float32"),
+             "label": rng.randint(0, 1000, (batch_size,)).astype("int64")}
+    t0 = time.time()
+    float(trainer.step(batch))
+    log(f"resnet50 compile+first step: {time.time()-t0:.1f}s")
+    float(trainer.step(batch))
+    t0 = time.time()
+    for _ in range(steps):
+        loss = trainer.step(batch)
+    float(loss)
+    dt = (time.time() - t0) / steps
+    imgs_s = batch_size / dt
+    # ~4.09e9 MACs fwd at 224^2 -> 8.2 GFLOP fwd, x3 for train
+    mfu = 3 * 8.2e9 * imgs_s / chip_peak_flops()
+    log(f"resnet50: {dt*1e3:.1f} ms/step, {imgs_s:.0f} imgs/s, MFU={mfu:.3f}")
+    return imgs_s, mfu
+
+
+def run_bert_base(batch_size=32, seq_len=512, steps=10):
+    """BASELINE.json config 2: BERT-base MLM+NSP pretraining, seqs/sec/chip."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models.bert import (
+        BertForPretraining,
+        BertPretrainingCriterion,
+        bert_base,
+    )
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    cfg = bert_base(dtype="bfloat16")
+    model = BertForPretraining(cfg)
+    model.bfloat16()
+    model.train()
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 accumulator_dtype="bfloat16")
+
+    def loss_fn(m, batch):
+        mlm_logits, nsp_logits = m(paddle.to_tensor(batch["input_ids"]))
+        return crit(mlm_logits, nsp_logits,
+                    paddle.to_tensor(batch["mlm_labels"]),
+                    paddle.to_tensor(batch["nsp_labels"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, cfg.vocab_size, (batch_size, seq_len))
+    labels[rng.rand(batch_size, seq_len) > 0.15] = -100  # MLM masking rate
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size,
+                                      (batch_size, seq_len)).astype("int32"),
+             "mlm_labels": labels.astype("int32"),
+             "nsp_labels": rng.randint(0, 2, (batch_size,)).astype("int64")}
+    t0 = time.time()
+    float(trainer.step(batch))
+    log(f"bert_base compile+first step: {time.time()-t0:.1f}s")
+    float(trainer.step(batch))
+    t0 = time.time()
+    for _ in range(steps):
+        loss = trainer.step(batch)
+    float(loss)
+    dt = (time.time() - t0) / steps
+    seqs_s = batch_size / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    mfu = 6 * n_params * seqs_s * seq_len / chip_peak_flops()
+    log(f"bert_base: {dt*1e3:.1f} ms/step, {seqs_s:.1f} seqs/s, MFU={mfu:.3f}")
+    return seqs_s, mfu
+
+
 def main():
-    attempts = [
-        ("gpt_1p3b", 8, 1024),
-        ("gpt_1p3b", 4, 1024),
-        ("gpt_760m", 8, 1024),
-        ("gpt_350m", 16, 1024),
-        ("gpt_125m", 16, 1024),
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    # each group: variants of the same headline config — run all that fit,
+    # keep the fastest; fall to the next (smaller) group only if none ran
+    groups = [
+        [("gpt_1p3b", 8, 1024, "dots"),  # cheaper remat: bwd skips matmul recompute
+         ("gpt_1p3b", 8, 1024, "full")],
+        [("gpt_1p3b", 4, 1024, "full")],
+        [("gpt_760m", 8, 1024, "full")],
+        [("gpt_350m", 16, 1024, "full")],
+        [("gpt_125m", 16, 1024, "full")],
     ]
-    last_err = None
-    for cfg_name, bs, seq in attempts:
+    result, last_err = None, None
+    if only in (None, "gpt"):
+        for group in groups:
+            for cfg_name, bs, seq, rp in group:
+                try:
+                    tok_s, mfu, n_params = run_config(cfg_name, bs, seq,
+                                                      remat_policy=rp)
+                except Exception as e:  # OOM or tunnel issues → try smaller
+                    last_err = e
+                    log(f"{cfg_name}/{rp} failed: {type(e).__name__}: {str(e)[:300]}")
+                    continue
+                if result is None or tok_s > result["value"]:
+                    result = {
+                        "metric": f"{cfg_name}_train_tokens_per_sec_per_chip",
+                        "value": round(tok_s, 1),
+                        "unit": "tokens/s/chip",
+                        "vs_baseline": round(mfu / 0.35, 4),
+                        "mfu": round(mfu, 4),
+                        "params": n_params,
+                        "batch": bs, "seq": seq, "remat": rp,
+                    }
+            if result is not None:
+                break
+    if result is None:
+        if only in (None, "gpt"):   # real failure of the headline config
+            result = {"metric": "gpt_train_tokens_per_sec_per_chip",
+                      "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+            if last_err is not None:
+                result["error"] = str(last_err)[:200]
+        else:                       # gpt intentionally skipped via CLI filter
+            result = {"metric": f"bench_only_{only}", "value": 0.0,
+                      "unit": "see extras", "vs_baseline": 0.0}
+    # secondary BASELINE.json configs ride along in the same JSON line
+    extras = {}
+    if only in (None, "resnet"):
         try:
-            tok_s, mfu, n_params = run_config(cfg_name, bs, seq)
-            print(json.dumps({
-                "metric": f"{cfg_name}_train_tokens_per_sec_per_chip",
-                "value": round(tok_s, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(mfu / 0.35, 4),
-                "mfu": round(mfu, 4),
-                "params": n_params,
-                "batch": bs, "seq": seq,
-            }))
-            return
-        except Exception as e:  # OOM or tunnel issues → try smaller
-            last_err = e
-            log(f"{cfg_name} failed: {type(e).__name__}: {str(e)[:300]}")
-    print(json.dumps({"metric": "gpt_train_tokens_per_sec_per_chip",
-                      "value": 0.0, "unit": "tokens/s/chip",
-                      "vs_baseline": 0.0, "error": str(last_err)[:200]}))
+            imgs_s, mfu = run_resnet50()
+            extras["resnet50_imgs_per_sec_per_chip"] = round(imgs_s, 1)
+            extras["resnet50_mfu"] = round(mfu, 4)
+        except Exception as e:
+            log(f"resnet50 bench failed: {type(e).__name__}: {str(e)[:300]}")
+            extras["resnet50_error"] = str(e)[:160]
+    if only in (None, "bert"):
+        try:
+            seqs_s, mfu = run_bert_base()
+            extras["bert_base_seqs_per_sec_per_chip"] = round(seqs_s, 2)
+            extras["bert_base_mfu"] = round(mfu, 4)
+        except Exception as e:
+            log(f"bert bench failed: {type(e).__name__}: {str(e)[:300]}")
+            extras["bert_base_error"] = str(e)[:160]
+    if extras:
+        result["extras"] = extras
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -38,7 +38,9 @@ from .parallel import (  # noqa: F401
     get_world_size,
     init_parallel_env,
 )
+from .localsgd import LocalSGDTrainer  # noqa: F401
 from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401
+from .trainer import Trainer  # noqa: F401
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "DataParallel",
@@ -47,6 +49,7 @@ __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "wait", "fleet",
     "get_mesh", "build_mesh", "Mesh", "PartitionSpec", "NamedSharding",
     "plan_shardings", "shard_params", "constraint", "spawn", "launch",
+    "Trainer", "LocalSGDTrainer",
 ]
 
 

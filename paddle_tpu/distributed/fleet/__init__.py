@@ -43,6 +43,10 @@ class DistributedStrategy:
         self.gradient_merge_configs = {}
         self.dgc = False
         self.lamb = False
+        self.lars = False
+        self.lars_configs = {}
+        self.localsgd = False
+        self.localsgd_configs = {}
         self.find_unused_parameters = False
 
     def _degrees(self):

@@ -18,7 +18,25 @@ from ..nn.layer_base import functional_call, load_state_pytree
 from .mesh import get_mesh
 from .sharding_utils import feasible_spec, plan_shardings
 
-__all__ = ["Trainer", "shard_batch"]
+__all__ = ["Trainer", "shard_batch", "make_compute_loss", "batch_to_arrays"]
+
+
+def make_compute_loss(model, loss_fn):
+    """Pure (params, consts, batch) -> fp32 scalar loss via functional_call.
+    Shared by Trainer and LocalSGDTrainer so loss/dtype handling can't drift."""
+    def compute_loss(p, consts, batch):
+        with functional_call(model, {**p, **consts}):
+            loss = loss_fn(model, batch)
+        lv = loss._value if isinstance(loss, Tensor) else loss
+        return lv.astype(jnp.float32)
+    return compute_loss
+
+
+def batch_to_arrays(batch):
+    """Tensor leaves -> raw arrays, for any pytree-shaped batch."""
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else jnp.asarray(v),
+        batch, is_leaf=lambda x: isinstance(x, Tensor))
 
 
 def shard_batch(batch, mesh=None, spec=("dp", "fsdp")):
@@ -69,11 +87,7 @@ class Trainer:
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
         accum = self.grad_accum_steps
 
-        def compute_loss(p, consts, batch):
-            with functional_call(model, {**p, **consts}):
-                loss = loss_fn(model, batch)
-            lv = loss._value if isinstance(loss, Tensor) else loss
-            return lv.astype(jnp.float32)
+        compute_loss = make_compute_loss(model, loss_fn)
 
         def step(params, opt_state, consts, lr, batch):
             if accum <= 1:
@@ -105,8 +119,7 @@ class Trainer:
 
     def step(self, batch, lr=None):
         lr = self.optimizer.get_lr() if lr is None else lr
-        batch = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
-                 for k, v in batch.items()}
+        batch = batch_to_arrays(batch)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, self.consts, lr, batch)
         sched = self.optimizer._lr_scheduler

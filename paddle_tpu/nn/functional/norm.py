@@ -163,7 +163,7 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
         pad_hi = size - 1 - pad_lo
         padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
         win = jax.lax.reduce_window(
-            padded, jnp.asarray(0, v.dtype), jax.lax.add,
+            padded, 0.0, jax.lax.add,  # python scalar: keeps the monoid path
             (1,) * (moved.ndim - 1) + (size,), (1,) * moved.ndim, "VALID")
         win = jnp.moveaxis(win, -1, ch_axis)
         return v / jnp.power(k + alpha * win, beta)

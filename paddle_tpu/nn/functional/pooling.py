@@ -48,16 +48,21 @@ def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
             wpads = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
         if isinstance(wpads, str):
             wpads = jax.lax.padtype_to_pads(v.shape, window, strides, wpads)
+        # init values MUST be python scalars: an array init is a traced
+        # constant under jit, which defeats lax's monoid specialization and
+        # lands on the generic reduce_window (not reverse-differentiable)
         if mode == "max":
-            init = -jnp.inf if jnp.issubdtype(v.dtype, np.floating) else jnp.iinfo(v.dtype).min
-            return jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), jax.lax.max,
+            init = -float("inf") if jnp.issubdtype(v.dtype, np.floating) \
+                else int(jnp.iinfo(v.dtype).min)
+            return jax.lax.reduce_window(v, init, jax.lax.max,
                                          window, strides, wpads)
         # avg
-        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add,
+        zero = 0.0 if jnp.issubdtype(v.dtype, np.floating) else 0
+        summed = jax.lax.reduce_window(v, zero, jax.lax.add,
                                        window, strides, wpads)
         if exclusive and not count_include_pad:
             ones = jnp.ones_like(v)
-            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add,
+            counts = jax.lax.reduce_window(ones, zero, jax.lax.add,
                                            window, strides, wpads)
             return summed / counts
         return summed / float(np.prod(kernel))

@@ -111,6 +111,7 @@ class Optimizer:
             if self._wd and not self._decoupled_wd() and p.regularizer is None:
                 gv = gv + self._wd * pv
             rule_slots = self._slots_to_f32({k: v for k, v in slots.items() if k != "master"})
+            self._current_param_name = getattr(p, "name", None)
             new_p, new_slots = self._update_rule(pv, gv, rule_slots, p_lr, self._step_count)
             new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
@@ -168,6 +169,7 @@ class Optimizer:
             gv = g.astype(jnp.float32)
             if self._wd and not self._decoupled_wd():
                 gv = gv + self._wd * pv
+            self._current_param_name = name
             new_p, new_slots = self._update_rule(pv, gv, self._slots_to_f32(slots), lr, step)
             new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
@@ -226,6 +228,58 @@ class Momentum(Optimizer):
             new_p = p - lr * (g + self._momentum * v)
         else:
             new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-adaptive rate scaling for large-batch training (reference
+    python/paddle/fluid/optimizer.py:1964 LarsMomentumOptimizer, surfaced by
+    fleet's lars meta_optimizer
+    python/paddle/distributed/fleet/meta_optimizers/lars_optimizer.py:21).
+
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + lars_wd * ||p|| + eps)
+        v = mu * v + local_lr * (g + lars_wd * p)
+        p = p - v
+    """
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = tuple(exclude_from_weight_decay or ())
+        self._epsilon = epsilon
+        self._rescale = rescale_grad
+
+    def _wd_for(self):
+        # the caller loops set _current_param_name (Parameter.name in the
+        # eager path, the pytree key in the functional path) — static python
+        # strings, so this specializes per-param at trace time
+        name = getattr(self, "_current_param_name", None) or ""
+        if any(tok in name for tok in self._exclude):
+            return 0.0
+        return self._lars_wd
+
+    def _update_rule(self, p, g, slots, lr, step):
+        wd = self._wd_for()
+        g = g * self._rescale
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        denom = g_norm + wd * p_norm + self._epsilon
+        # reference kernel leaves lr unscaled when norms are zero
+        local_lr = jnp.where(denom > 0.0,
+                             lr * self._lars_coeff * p_norm / jnp.maximum(denom, 1e-30),
+                             lr)
+        v = self._momentum * slots["velocity"] + local_lr * (g32 + wd * p32)
+        new_p = (p32 - v).astype(p.dtype)
         return new_p, {"velocity": v}
 
 
