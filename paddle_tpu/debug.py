@@ -51,7 +51,10 @@ def check_numerics(x, name="tensor"):
                             lambda t: t * jnp.float32(jnp.nan).astype(t.dtype), v)
     if isinstance(x, Tensor):
         out = apply_op(_f, x)
-        if isinstance(out._value, jax.Array):
+        # host-side readback only outside tracing (tracers poison via the
+        # lax.cond above instead)
+        if isinstance(out._value, jax.Array) and \
+                not isinstance(out._value, jax.core.Tracer):
             import numpy as np
             if not np.isfinite(np.asarray(out._value.astype(jnp.float32))).all():
                 raise FloatingPointError(f"non-finite values detected in {name}")

@@ -53,9 +53,11 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, **kwargs):
     sh = NamedSharding(mesh, PartitionSpec(*spec))
     if isinstance(x, Tensor):
         x.partition_spec = spec
-        if isinstance(x._value, jax.Array):
+        if isinstance(x._value, jax.Array) and \
+                not isinstance(x._value, jax.core.Tracer):
             x._value = jax.device_put(x._value, sh)
             return x
+        # symbolic/traced values get a GSPMD constraint instead of a placement
         return apply_op(lambda v: jax.lax.with_sharding_constraint(v, sh), x)
     return jax.device_put(x, sh)
 

@@ -15,13 +15,47 @@ __all__ = [
 ]
 
 
+def amp_compute_cast(v, w):
+    """AMP O2 rule shared by linear and conv: low-precision weights define
+    the compute dtype — f32 activations are cast DOWN so a bf16 model rides
+    the MXU instead of silently promoting the whole chain to f32."""
+    if jnp.dtype(w.dtype) in (jnp.bfloat16, jnp.float16) and \
+            jnp.dtype(v.dtype) == jnp.float32:
+        return v.astype(w.dtype)
+    return v
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W (+ b). Weight layout [in, out] (reference
-    python/paddle/nn/functional/common.py:linear → matmul_v2). The matmul
-    stays in the input dtype so bf16 rides the MXU."""
-    if bias is None:
-        return apply_op(lambda v, w: v @ w, x, weight)
-    return apply_op(lambda v, w, b: v @ w + b, x, weight, bias)
+    python/paddle/nn/functional/common.py:linear → matmul_v2)."""
+    def _f(v, w, *r):
+        v = amp_compute_cast(v, w)
+        out = v @ w
+        if r:
+            out = out + r[0].astype(out.dtype)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(_f, *args)
+
+
+def _hash_keep(seed_key, mask_shape, p):
+    """Counter-hash bernoulli(1-p) — same lowbias32 mixer as the flash
+    attention kernel's in-kernel dropout. ~8 int ops/element on the VPU vs
+    ~hundreds for threefry, which dominates step time for dropout-trained
+    encoders (BERT) at scale."""
+    n = int(np.prod(mask_shape, dtype=np.int64))
+    # fold the jax PRNG key into a 32-bit salt (host-side when eager; a
+    # traced constant under jit, same lifetime as the old bernoulli path)
+    salt = jax.random.randint(seed_key, (), 0, 2 ** 31 - 1).astype(jnp.uint32)
+    idx = jax.lax.iota(jnp.uint32, n) * jnp.uint32(0x9E3779B1)
+    h = idx ^ (salt * jnp.uint32(0x85EBCA77))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    thresh = jnp.uint32(min(int(float(p) * 4294967296.0), 4294967295))
+    return (h >= thresh).reshape(mask_shape)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
@@ -35,7 +69,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         else:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
             mask_shape = tuple(v.shape[i] if i in axes else 1 for i in range(v.ndim))
-        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        keep = _hash_keep(key, mask_shape, p)
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
         return jnp.where(keep, v, jnp.zeros((), v.dtype))

@@ -53,6 +53,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
         if channel_last:
             perm = tuple(range(2, 2 + n)) + (1, 0)
             w = jnp.transpose(w, perm)
+        from .common import amp_compute_cast
+        v = amp_compute_cast(v, w)
         out = jax.lax.conv_general_dilated(
             v, w.astype(v.dtype), window_strides=stride, padding=pad,
             rhs_dilation=dilation, feature_group_count=groups,

@@ -89,9 +89,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
     out = apply_op(_f, *args)
 
-    # eager stat update (mirrors reference batch_norm_kernel running-stat path)
+    # eager stat update (mirrors reference batch_norm_kernel running-stat
+    # path). Tracers are jax.Array instances too — under jit the update must
+    # NOT run, or the buffers would be overwritten with leaked tracers (the
+    # functional Trainer path handles buffers explicitly as consts)
     if training and not use_global and isinstance(running_mean, Tensor) \
-            and isinstance(x._value, jax.Array):
+            and isinstance(x._value, jax.Array) \
+            and not isinstance(x._value, jax.core.Tracer):
         v = x._value.astype(jnp.float32)
         ax = ch_axis % v.ndim
         reduce_axes = tuple(i for i in range(v.ndim) if i != ax)

@@ -274,8 +274,10 @@ class LarsMomentum(Optimizer):
         p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
         g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
         denom = g_norm + wd * p_norm + self._epsilon
-        # reference kernel leaves lr unscaled when norms are zero
-        local_lr = jnp.where(denom > 0.0,
+        # reference kernel scales only when BOTH norms are nonzero, else
+        # plain lr — otherwise zero-init params (every Linear bias) would get
+        # local_lr = 0 and never train
+        local_lr = jnp.where((p_norm > 0.0) & (g_norm > 0.0),
                              lr * self._lars_coeff * p_norm / jnp.maximum(denom, 1e-30),
                              lr)
         v = self._momentum * slots["velocity"] + local_lr * (g32 + wd * p32)
