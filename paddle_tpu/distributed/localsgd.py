@@ -78,7 +78,8 @@ class LocalSGDTrainer:
             # for the model and restore it on the way out
             params = jax.tree_util.tree_map(lambda v: v[0], params)
             opt_state = jax.tree_util.tree_map(lambda v: v[0], opt_state)
-            loss_v, grads = jax.value_and_grad(compute_loss)(params, consts, batch)
+            (loss_v, buf_updates), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params, consts, batch)
             new_params, new_state = optimizer.apply_gradients_pytree(
                 params, grads, opt_state, lr)
             new_params = jax.lax.cond(
@@ -87,8 +88,13 @@ class LocalSGDTrainer:
                     lambda v: jax.lax.pmean(v, axis), t),
                 lambda t: t,
                 new_params)
+            # buffer stats (BN running mean/var) are consts: average the
+            # per-rank updates so the replicated copy stays consistent
+            new_consts = {**consts, **jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axis), buf_updates)}
             unsq = lambda tree: jax.tree_util.tree_map(lambda v: v[None], tree)
-            return unsq(new_params), unsq(new_state), jax.lax.pmean(loss_v, axis)
+            return (unsq(new_params), unsq(new_state), new_consts,
+                    jax.lax.pmean(loss_v, axis))
 
         strip = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
 
@@ -98,7 +104,7 @@ class LocalSGDTrainer:
                 local_step, mesh=self.mesh,
                 in_specs=(strip(params), strip(opt_state), P(), P(),
                           jax.tree_util.tree_map(lambda _: P(axis), batch), P()),
-                out_specs=(strip(params), strip(opt_state), P()),
+                out_specs=(strip(params), strip(opt_state), P(), P()),
                 check_vma=False,
             )(params, opt_state, consts, lr, batch, do_sync)
 
@@ -120,7 +126,7 @@ class LocalSGDTrainer:
         batch = batch_to_arrays(batch)
         self._host_step += 1
         do_sync = (self._host_step % self.k_steps) == 0
-        self.params, self.opt_state, loss = self._step_fn(
+        self.params, self.opt_state, self.consts, loss = self._step_fn(
             self.params, self.opt_state, self.consts, lr, batch,
             jnp.asarray(do_sync))
         sched = self.optimizer._lr_scheduler
@@ -135,6 +141,7 @@ class LocalSGDTrainer:
         return loss
 
     def sync_to_model(self):
-        """Average the per-rank stacks and write back into the Layer tree."""
+        """Average the per-rank stacks and write back into the Layer tree
+        (consts carry the pmean'd BN running stats)."""
         avg = {k: jnp.mean(v, axis=0) for k, v in self.params.items()}
-        load_state_pytree(self.model, avg)
+        load_state_pytree(self.model, {**self.consts, **avg})

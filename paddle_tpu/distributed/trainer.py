@@ -22,13 +22,28 @@ __all__ = ["Trainer", "shard_batch", "make_compute_loss", "batch_to_arrays"]
 
 
 def make_compute_loss(model, loss_fn):
-    """Pure (params, consts, batch) -> fp32 scalar loss via functional_call.
-    Shared by Trainer and LocalSGDTrainer so loss/dtype handling can't drift."""
+    """Pure (params, consts, batch) -> (fp32 loss, buffer_updates) via
+    functional_call. Shared by Trainer and LocalSGDTrainer so loss/dtype
+    handling can't drift.
+
+    buffer_updates is {name: traced_value} for buffers whose ops attempted a
+    state write during the trace (BatchNorm running stats): the caller folds
+    them back into its consts so stats keep accumulating under jit."""
+    from ..nn.layer_base import collect_buffer_updates
+
     def compute_loss(p, consts, batch):
-        with functional_call(model, {**p, **consts}):
-            loss = loss_fn(model, batch)
+        with collect_buffer_updates() as sink:
+            with functional_call(model, {**p, **consts}):
+                loss = loss_fn(model, batch)
+        updates = {}
+        if sink:
+            by_id = {id(b): name for name, b in model.named_buffers()}
+            for tid, (_, val) in sink.items():
+                name = by_id.get(tid)
+                if name is not None:
+                    updates[name] = val
         lv = loss._value if isinstance(loss, Tensor) else loss
-        return lv.astype(jnp.float32)
+        return lv.astype(jnp.float32), updates
     return compute_loss
 
 
@@ -91,7 +106,8 @@ class Trainer:
 
         def step(params, opt_state, consts, lr, batch):
             if accum <= 1:
-                loss_v, grads = jax.value_and_grad(compute_loss)(params, consts, batch)
+                (loss_v, buf_updates), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True)(params, consts, batch)
             else:
                 # gradient merge (reference DistributedStrategy.gradient_merge):
                 # microbatch scan accumulating mean grads before ONE update
@@ -101,26 +117,31 @@ class Trainer:
 
                 def body(carry, mb):
                     loss_acc, grad_acc = carry
-                    lv, g = jax.value_and_grad(compute_loss)(params, consts, mb)
+                    (lv, bu), g = jax.value_and_grad(
+                        compute_loss, has_aux=True)(params, consts, mb)
                     grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, g)
-                    return (loss_acc + lv, grad_acc), None
+                    return (loss_acc + lv, grad_acc), bu
 
                 zeros = jax.tree_util.tree_map(
                     lambda v: jnp.zeros(v.shape, jnp.float32), params)
-                (loss_sum, grad_sum), _ = jax.lax.scan(
+                (loss_sum, grad_sum), bus = jax.lax.scan(
                     body, (jnp.zeros((), jnp.float32), zeros), micro)
                 loss_v = loss_sum / accum
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grad_sum)
+                # per-microbatch stat updates all start from the same consts;
+                # carry the last microbatch's
+                buf_updates = jax.tree_util.tree_map(lambda v: v[-1], bus)
             new_params, new_state = optimizer.apply_gradients_pytree(
                 params, grads, opt_state, lr)
-            return new_params, new_state, loss_v
+            new_consts = {**consts, **buf_updates}
+            return new_params, new_state, new_consts, loss_v
 
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
     def step(self, batch, lr=None):
         lr = self.optimizer.get_lr() if lr is None else lr
         batch = batch_to_arrays(batch)
-        self.params, self.opt_state, loss = self._step_fn(
+        self.params, self.opt_state, self.consts, loss = self._step_fn(
             self.params, self.opt_state, self.consts, lr, batch)
         sched = self.optimizer._lr_scheduler
         if sched is not None:
@@ -129,8 +150,9 @@ class Trainer:
         return loss
 
     def sync_to_model(self):
-        """Copy trained params back into the Layer tree (for save/eval)."""
-        load_state_pytree(self.model, self.params)
+        """Copy trained params AND accumulated buffers (BN running stats)
+        back into the Layer tree (for save/eval)."""
+        load_state_pytree(self.model, {**self.consts, **self.params})
 
     def state(self):
         return {"params": self.params, "opt_state": self.opt_state,

@@ -42,15 +42,26 @@ class Model:
 
         def step(params, buffers, opt_state, lr, inputs, labels):
             def compute_loss(p):
-                with functional_call(net, {**p, **buffers}):
-                    out = net(*inputs)
+                from ..nn.layer_base import collect_buffer_updates
+                with collect_buffer_updates() as sink:
+                    with functional_call(net, {**p, **buffers}):
+                        out = net(*inputs)
+                # BN running stats recorded during the trace carry forward
+                updates = {}
+                if sink:
+                    by_id = {id(b): name for name, b in net.named_buffers()}
+                    for tid, (_, val) in sink.items():
+                        if tid in by_id:
+                            updates[by_id[tid]] = val
                 loss = loss_fn(out, *labels)
                 lv = loss._value if isinstance(loss, Tensor) else loss
-                return jnp.mean(lv), out._value if isinstance(out, Tensor) else out
+                return jnp.mean(lv), (out._value if isinstance(out, Tensor) else out,
+                                      updates)
 
-            (loss_v, out), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+            (loss_v, (out, updates)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
             new_params, new_state = opt.apply_gradients_pytree(params, grads, opt_state, lr)
-            return new_params, new_state, loss_v, out
+            return new_params, new_state, {**buffers, **updates}, loss_v, out
 
         return jax.jit(step, donate_argnums=(0, 2))
 
@@ -69,7 +80,7 @@ class Model:
         in_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in inputs]
         lab_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in labels]
         lr = self._optimizer.get_lr()
-        self._params, self._opt_state, loss_v, out = self._compiled_step(
+        self._params, self._opt_state, self._buffers, loss_v, out = self._compiled_step(
             self._params, self._buffers, self._opt_state, lr, in_vals, lab_vals)
         if self._optimizer._lr_scheduler is not None:
             self._optimizer._lr_scheduler.step()
@@ -83,7 +94,7 @@ class Model:
     def _sync_params_back(self):
         if self._compiled_step is not None:
             from ..nn.layer_base import load_state_pytree
-            load_state_pytree(self.network, self._params)
+            load_state_pytree(self.network, {**self._buffers, **self._params})
 
     def eval_batch(self, inputs, labels=None):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]

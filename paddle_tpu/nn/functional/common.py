@@ -39,23 +39,18 @@ def linear(x, weight, bias=None, name=None):
 
 
 def _hash_keep(seed_key, mask_shape, p):
-    """Counter-hash bernoulli(1-p) — same lowbias32 mixer as the flash
-    attention kernel's in-kernel dropout. ~8 int ops/element on the VPU vs
-    ~hundreds for threefry, which dominates step time for dropout-trained
-    encoders (BERT) at scale."""
+    """Counter-hash bernoulli(1-p) — the same lowbias32 mixer as the flash
+    attention kernel's in-kernel dropout (imported, so the two can't
+    desynchronize). ~8 int ops/element on the VPU vs ~hundreds for threefry,
+    which dominates step time for dropout-trained encoders (BERT) at scale."""
+    from ...ops.attention import _hash32, _rate_thresh
     n = int(np.prod(mask_shape, dtype=np.int64))
     # fold the jax PRNG key into a 32-bit salt (host-side when eager; a
     # traced constant under jit, same lifetime as the old bernoulli path)
     salt = jax.random.randint(seed_key, (), 0, 2 ** 31 - 1).astype(jnp.uint32)
     idx = jax.lax.iota(jnp.uint32, n) * jnp.uint32(0x9E3779B1)
-    h = idx ^ (salt * jnp.uint32(0x85EBCA77))
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x7FEB352D)
-    h = h ^ (h >> 15)
-    h = h * jnp.uint32(0x846CA68B)
-    h = h ^ (h >> 16)
-    thresh = jnp.uint32(min(int(float(p) * 4294967296.0), 4294967295))
-    return (h >= thresh).reshape(mask_shape)
+    h = _hash32(idx ^ (salt * jnp.uint32(0x85EBCA77)))
+    return (h >= _rate_thresh(p)).reshape(mask_shape)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):

@@ -120,6 +120,8 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
         if channel_last:
             perm = tuple(range(2, 2 + n)) + (1, 0)
             wf = jnp.transpose(wf, perm)
+        from .common import amp_compute_cast
+        v = amp_compute_cast(v, wf)
         out = jax.lax.conv_general_dilated(
             v, wf.astype(v.dtype), window_strides=(1,) * n, padding=tpads,
             lhs_dilation=stride, rhs_dilation=dilation, feature_group_count=groups,

@@ -89,22 +89,29 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
     out = apply_op(_f, *args)
 
-    # eager stat update (mirrors reference batch_norm_kernel running-stat
-    # path). Tracers are jax.Array instances too — under jit the update must
-    # NOT run, or the buffers would be overwritten with leaked tracers (the
-    # functional Trainer path handles buffers explicitly as consts)
+    # running-stat update (mirrors reference batch_norm_kernel). Eager: mutate
+    # the buffers in place. Under tracing, mutation would leak tracers into
+    # the buffers — instead the new values are RECORDED via the buffer-update
+    # sink, and the compiled-step owner (distributed.trainer.Trainer) carries
+    # them across steps; a bare jit with no sink skips the update.
     if training and not use_global and isinstance(running_mean, Tensor) \
-            and isinstance(x._value, jax.Array) \
-            and not isinstance(x._value, jax.core.Tracer):
+            and isinstance(x._value, jax.Array):
         v = x._value.astype(jnp.float32)
         ax = ch_axis % v.ndim
         reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
         batch_mean = jnp.mean(v, axis=reduce_axes)
         batch_var = jnp.var(v, axis=reduce_axes)
-        running_mean._value = (momentum * running_mean._value
-                               + (1 - momentum) * batch_mean.astype(running_mean.dtype))
-        running_var._value = (momentum * running_var._value
-                              + (1 - momentum) * batch_var.astype(running_var.dtype))
+        new_rm = (momentum * running_mean._value
+                  + (1 - momentum) * batch_mean.astype(running_mean.dtype))
+        new_rv = (momentum * running_var._value
+                  + (1 - momentum) * batch_var.astype(running_var.dtype))
+        if isinstance(x._value, jax.core.Tracer):
+            from ..layer_base import record_buffer_update
+            record_buffer_update(running_mean, new_rm)
+            record_buffer_update(running_var, new_rv)
+        else:
+            running_mean._value = new_rm
+            running_var._value = new_rv
     return out
 
 

@@ -8,6 +8,7 @@ object serves eager debugging and compiled GSPMD training.
 from __future__ import annotations
 
 import collections
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -328,6 +329,35 @@ def state_pytree(layer: Layer, trainable_only=False):
 
 def buffer_pytree(layer: Layer):
     return {name: b._value for name, b in layer.named_buffers()}
+
+
+_buffer_sink = threading.local()
+
+
+class collect_buffer_updates:
+    """Context that collects buffer writes attempted under tracing (e.g.
+    BatchNorm running stats): ops call `record_buffer_update(tensor, value)`
+    instead of mutating, and the compiled-step owner (Trainer) carries the
+    returned {id(tensor): (tensor, traced_value)} into its next-step consts."""
+
+    def __enter__(self):
+        self._prev = getattr(_buffer_sink, "sink", None)
+        _buffer_sink.sink = {}
+        return _buffer_sink.sink
+
+    def __exit__(self, *exc):
+        _buffer_sink.sink = self._prev
+        return False
+
+
+def record_buffer_update(tensor, value):
+    """Record a pending buffer update if a collect_buffer_updates context is
+    active. Returns True if recorded (the caller should skip eager mutation)."""
+    sink = getattr(_buffer_sink, "sink", None)
+    if sink is None:
+        return False
+    sink[id(tensor)] = (tensor, value)
+    return True
 
 
 def load_state_pytree(layer: Layer, values: dict):

@@ -1,5 +1,7 @@
 """paddle_tpu.vision — reference python/paddle/vision/__init__.py."""
 from . import datasets, models, transforms  # noqa: F401
 from . import ops  # noqa: F401
+from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
 
-__all__ = ["models", "transforms", "ops", "datasets"]
+__all__ = ["models", "transforms", "ops", "datasets",
+           "get_image_backend", "set_image_backend", "image_load"]

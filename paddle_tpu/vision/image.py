@@ -1,0 +1,51 @@
+"""Image IO backend — reference python/paddle/vision/image.py:90-140
+(get_image_backend / set_image_backend / image_load).
+
+Backends: 'pil' (reference default; cv2 is not in this image) and 'native'
+(paddle_tpu.runtime.image — off-GIL libjpeg decode, returns HWC uint8
+ndarray; falls back to PIL for non-JPEG formats)."""
+import os
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_backend = "pil"
+_VALID = ("pil", "native", "cv2")
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in _VALID:
+        raise ValueError(
+            f"Expected backend in {_VALID}, got {backend!r}")
+    if backend == "cv2":
+        raise ImportError("cv2 is not available in this environment; use "
+                          "'pil' or 'native'")
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend
+
+
+def image_load(path, backend=None):
+    """Load an image. 'pil' returns a PIL.Image (reference semantics);
+    'native' returns an HWC uint8 ndarray decoded off the GIL."""
+    backend = backend or _backend
+    if backend not in _VALID:
+        raise ValueError(f"Expected backend in {_VALID}, got {backend!r}")
+    if backend == "cv2":
+        raise ImportError("cv2 is not available in this environment; use "
+                          "'pil' or 'native'")
+    if backend == "native":
+        from ..runtime.image import decode_jpeg
+        ext = os.path.splitext(str(path))[1].lower()
+        if ext in (".jpg", ".jpeg"):
+            with open(path, "rb") as f:
+                return decode_jpeg(f.read())
+        # non-JPEG: PIL decode, same ndarray contract
+        import numpy as np
+        from PIL import Image
+        arr = np.asarray(Image.open(path))
+        return arr if arr.ndim == 3 else arr[:, :, None]
+    from PIL import Image
+    return Image.open(path)

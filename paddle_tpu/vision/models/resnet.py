@@ -20,11 +20,14 @@ class BasicBlock(nn.Layer):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         df = dict(data_format=data_format)
+        # custom norm_layer callables keep their pre-NHWC contract: the
+        # kwarg is only forwarded when the user opted out of NCHW
+        nl = (lambda c: norm_layer(c, **df)) if data_format != "NCHW" else norm_layer
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride, bias_attr=False, **df)
-        self.bn1 = norm_layer(planes, **df)
+        self.bn1 = nl(planes)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False, **df)
-        self.bn2 = norm_layer(planes, **df)
+        self.bn2 = nl(planes)
         self.downsample = downsample
         self.stride = stride
 
@@ -46,13 +49,14 @@ class BottleneckBlock(nn.Layer):
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
         df = dict(data_format=data_format)
+        nl = (lambda c: norm_layer(c, **df)) if data_format != "NCHW" else norm_layer
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
-        self.bn1 = norm_layer(width, **df)
+        self.bn1 = nl(width)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
                                groups=groups, dilation=dilation, bias_attr=False, **df)
-        self.bn2 = norm_layer(width, **df)
+        self.bn2 = nl(width)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False, **df)
-        self.bn3 = norm_layer(planes * self.expansion, **df)
+        self.bn3 = nl(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
 

@@ -110,6 +110,7 @@ def _spawn_fn(out_dir):
         f.write(str(float(total)))
 
 
+@pytest.mark.slow
 def test_spawn_two_workers(tmp_path):
     from paddle_tpu.distributed import spawn
 

@@ -23,6 +23,7 @@ def test_ring_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_grads_match():
     build_mesh(sp=8)
     rng = np.random.RandomState(1)
@@ -66,6 +67,7 @@ def test_ulysses_matches_reference():
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_gpt_ulysses_sp_mode():
     """GPT with sp_mode='ulysses' trains on an sp mesh and matches the
     ring-attention configuration's loss."""

@@ -14,7 +14,7 @@ slow = pytest.mark.slow
     (lambda: models.resnet50(num_classes=10), 64),
     pytest.param(lambda: models.vgg11(num_classes=10), 64, marks=slow),
     pytest.param(lambda: models.mobilenet_v1(num_classes=10), 64, marks=slow),
-    (lambda: models.mobilenet_v2(num_classes=10), 64),
+    pytest.param(lambda: models.mobilenet_v2(num_classes=10), 64, marks=slow),
     pytest.param(lambda: models.mobilenet_v3_small(num_classes=10), 64, marks=slow),
     pytest.param(lambda: models.squeezenet1_1(num_classes=10), 96, marks=slow),
     pytest.param(lambda: models.shufflenet_v2_x0_25(num_classes=10), 64, marks=slow),
