@@ -1,5 +1,7 @@
 """paddle_tpu.nn — reference python/paddle/nn/__init__.py."""
 from . import functional  # noqa: F401
+from . import layout  # noqa: F401
+from .layout import channels_last_enabled, set_channels_last  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
 from . import utils  # noqa: F401

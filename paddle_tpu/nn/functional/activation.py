@@ -2,6 +2,7 @@
 All map to jax.nn / lax primitives that XLA fuses into adjacent matmuls."""
 import jax
 import jax.numpy as jnp
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import Tensor, apply_op
 
@@ -124,12 +125,13 @@ def hardtanh(x, min=-1.0, max=1.0, name=None):
     return apply_op(lambda v: jnp.clip(v, min, max), x)
 
 
-def prelu(x, weight, data_format="NCHW", name=None):
+def prelu(x, weight, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v, w):
         if w.size == 1:
             wb = w.reshape(())
         else:
-            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
             shape = [1] * v.ndim
             shape[ch_axis] = w.size
             wb = w.reshape(shape)

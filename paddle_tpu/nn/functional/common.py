@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import Tensor, apply_op
 from ...framework.random import next_key
@@ -71,12 +72,14 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     return apply_op(_f, x)
 
 
-def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+def dropout2d(x, p=0.5, training=True, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     axes = [0, 1] if data_format == "NCHW" else [0, 3]
     return dropout(x, p, axis=axes, training=training)
 
 
-def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+def dropout3d(x, p=0.5, training=True, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     axes = [0, 1] if data_format == "NCDHW" else [0, 4]
     return dropout(x, p, axis=axes, training=training)
 
@@ -123,19 +126,22 @@ def _pad_nd(v, pad, mode, value, data_format):
     return jnp.pad(v, widths, mode=m)
 
 
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     if isinstance(pad, Tensor):
         pad = [int(p) for p in np.asarray(pad._value)]
     pad = [int(p) for p in pad]
     return apply_op(lambda v: _pad_nd(v, pad, mode, value, data_format), x)
 
 
-def zeropad2d(x, padding, data_format="NCHW", name=None):
+def zeropad2d(x, padding, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v):
         chan_last = not data_format.startswith("NC")
         spatial_axes = list(range(1, v.ndim - 1)) if chan_last else list(range(2, v.ndim))
@@ -179,7 +185,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",
-             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+             align_corners=False, align_mode=0, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
 
 

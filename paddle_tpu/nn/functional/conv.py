@@ -3,6 +3,7 @@ lax.conv_general_dilated drives the MXU directly; weight layout matches
 paddle ([out_c, in_c/groups, *kernel])."""
 import jax
 import jax.numpy as jnp
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import apply_op
 
@@ -71,18 +72,21 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCL", name=None):
+           data_format=None, name=None):
+    data_format = _resolve_df(data_format, 1)
     fmt = "NWC" if data_format in ("NLC",) else "NCW"
     return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW", name=None):
+           data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCDHW", name=None):
+           data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
 
 
@@ -142,19 +146,22 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+                     groups=1, dilation=1, output_size=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 1)
     fmt = "NWC" if data_format == "NLC" else "NCW"
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
                            dilation, groups, 1, fmt, output_size)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+                     groups=1, dilation=1, output_size=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
                            dilation, groups, 2, data_format, output_size)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+                     groups=1, dilation=1, output_size=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
                            dilation, groups, 3, data_format, output_size)

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import Tensor, apply_op
 
@@ -45,7 +46,8 @@ def gather_tree(ids, parents):
     return apply_op(_f, ids, parents)
 
 
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v):
         if data_format == "NHWC":
             v = jnp.moveaxis(v, -1, 1)

@@ -3,6 +3,7 @@ layer_norm/rms_norm have Pallas fused variants in paddle_tpu.ops; these jnp
 forms are the reference implementations XLA already fuses well."""
 import jax
 import jax.numpy as jnp
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import Tensor, apply_op
 
@@ -61,7 +62,8 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
-               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+               momentum=0.9, epsilon=1e-05, data_format=None, use_global_stats=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     use_global = (not training) if use_global_stats is None else use_global_stats
     ch_axis = 1 if data_format.startswith("NC") else -1
 
@@ -116,27 +118,32 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
-                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
+    chan_last = not data_format.startswith("NC")
+
     def _f(v, *rest):
-        spatial = tuple(range(2, v.ndim))
-        x32 = v.astype(jnp.float32)
+        vv = jnp.moveaxis(v, -1, 1) if chan_last else v
+        spatial = tuple(range(2, vv.ndim))
+        x32 = vv.astype(jnp.float32)
         mean = jnp.mean(x32, axis=spatial, keepdims=True)
         var = jnp.var(x32, axis=spatial, keepdims=True)
         out = ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
-        shape = [1] * v.ndim
-        shape[1] = v.shape[1]
+        shape = [1] * vv.ndim
+        shape[1] = vv.shape[1]
         i = 0
         if weight is not None:
             out = out * rest[i].reshape(shape).astype(v.dtype)
             i += 1
         if bias is not None:
             out = out + rest[i].reshape(shape).astype(v.dtype)
-        return out
+        return jnp.moveaxis(out, 1, -1) if chan_last else out
     args = (x,) + tuple(t for t in (weight, bias) if t is not None)
     return apply_op(_f, *args)
 
 
-def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v, *rest):
         n = v.shape[0]
         if data_format == "NHWC":
@@ -165,7 +172,8 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format
     return apply_op(_f, *args)
 
 
-def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v):
         ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
         sq = jnp.square(v)

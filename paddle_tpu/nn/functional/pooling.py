@@ -3,6 +3,7 @@ lax.reduce_window (fuses well on TPU)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import apply_op
 
@@ -151,7 +152,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
-               ceil_mode=False, data_format="NCHW", name=None):
+               ceil_mode=False, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     if return_mask:
         return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
                                    channel_last=data_format == "NHWC",
@@ -160,7 +162,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
-               ceil_mode=False, data_format="NCDHW", name=None):
+               ceil_mode=False, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     if return_mask:
         return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
                                    channel_last=data_format == "NDHWC",
@@ -168,14 +171,20 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
     return _pool(x, kernel_size, stride, padding, 3, "max", data_format == "NDHWC", ceil_mode)
 
 
-def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n):
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
+                channel_last=False):
     """Scatter pooled values back to their argmax positions — reference
-    python/paddle/nn/functional/pooling.py:max_unpool2d."""
+    python/paddle/nn/functional/pooling.py:max_unpool2d. The mask indices are
+    NC-first plane positions (what _max_pool_with_mask emits for either
+    layout), so channel-last inputs are transposed at the edges."""
     kernel = _tuple(kernel_size, n)
     stride = _tuple(stride if stride is not None else kernel_size, n)
     pads = [p[0] for p in _pads(padding, n)]
 
     def _f(v, idx):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
         in_spatial = v.shape[2:]
         if output_size is not None:
             osz = tuple(int(s) for s in output_size[-n:])
@@ -188,23 +197,30 @@ def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n):
         flat_idx = idx.reshape(nc, -1).astype(jnp.int32)
         out = jnp.zeros((nc, flat_out), dtype=v.dtype)
         out = out.at[jnp.arange(nc)[:, None], flat_idx].set(vals)
-        return out.reshape(v.shape[:2] + osz)
+        out = out.reshape(v.shape[:2] + osz)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
     return apply_op(_f, x, indices)
 
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCL", output_size=None, name=None):
-    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+                 data_format=None, output_size=None, name=None):
+    data_format = _resolve_df(data_format, 1)
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1,
+                       channel_last=data_format == "NLC")
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCHW", output_size=None, name=None):
-    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+                 data_format=None, output_size=None, name=None):
+    data_format = _resolve_df(data_format, 2)
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2,
+                       channel_last=data_format == "NHWC")
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCDHW", output_size=None, name=None):
-    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+                 data_format=None, output_size=None, name=None):
+    data_format = _resolve_df(data_format, 3)
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3,
+                       channel_last=data_format == "NDHWC")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -213,13 +229,15 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+               exclusive=True, divisor_override=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return _pool(x, kernel_size, stride, padding, 2, "avg", data_format == "NHWC",
                  ceil_mode, exclusive)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+               exclusive=True, divisor_override=None, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     return _pool(x, kernel_size, stride, padding, 3, "avg", data_format == "NDHWC",
                  ceil_mode, exclusive)
 
@@ -249,11 +267,13 @@ def adaptive_avg_pool1d(x, output_size, name=None):
     return _adaptive(x, output_size, 1, "avg")
 
 
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+def adaptive_avg_pool2d(x, output_size, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
 
 
-def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+def adaptive_avg_pool3d(x, output_size, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 3)
     return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
 
 

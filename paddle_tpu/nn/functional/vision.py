@@ -1,13 +1,15 @@
 """Vision functionals — reference python/paddle/nn/functional/vision.py."""
 import jax
 import jax.numpy as jnp
+from ..layout import resolve_data_format as _resolve_df
 
 from ...framework.core import apply_op
 
 __all__ = ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "affine_grid", "grid_sample"]
 
 
-def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+def pixel_shuffle(x, upscale_factor, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     r = upscale_factor
 
     def _f(v):
@@ -23,7 +25,8 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     return apply_op(_f, x)
 
 
-def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+def pixel_unshuffle(x, downscale_factor, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     r = downscale_factor
 
     def _f(v):
@@ -39,7 +42,8 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     return apply_op(_f, x)
 
 
-def channel_shuffle(x, groups, data_format="NCHW", name=None):
+def channel_shuffle(x, groups, data_format=None, name=None):
+    data_format = _resolve_df(data_format, 2)
     def _f(v):
         if data_format == "NCHW":
             n, c, h, w = v.shape

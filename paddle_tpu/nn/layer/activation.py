@@ -2,6 +2,7 @@
 from .. import functional as F
 from ..initializer import Constant
 from ..layer_base import Layer
+from ..layout import resolve_data_format as _resolve_df
 
 __all__ = [
     "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "Softmax2D", "LogSoftmax",
@@ -174,7 +175,8 @@ class Maxout(Layer):
 
 class PReLU(Layer):
     def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
-                 data_format="NCHW", name=None):
+                 data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.data_format = data_format
         self.weight = self.create_parameter(

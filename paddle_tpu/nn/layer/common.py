@@ -5,6 +5,7 @@ from ...framework.core import Parameter
 from .. import functional as F
 from ..initializer import Constant, Normal, Uniform, XavierUniform
 from ..layer_base import Layer, ParamAttr
+from ..layout import resolve_data_format as _resolve_df
 
 __all__ = [
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
@@ -79,7 +80,8 @@ class Dropout(Layer):
 
 
 class Dropout2D(Layer):
-    def __init__(self, p=0.5, data_format="NCHW", name=None):
+    def __init__(self, p=0.5, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.p = p
         self.data_format = data_format
@@ -89,7 +91,8 @@ class Dropout2D(Layer):
 
 
 class Dropout3D(Layer):
-    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+    def __init__(self, p=0.5, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 3)
         super().__init__()
         self.p = p
         self.data_format = data_format
@@ -120,7 +123,8 @@ class Flatten(Layer):
 
 class Upsample(Layer):
     def __init__(self, size=None, scale_factor=None, mode="nearest",
-                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
+                 align_corners=False, align_mode=0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.size = size
         self.scale_factor = scale_factor
@@ -135,17 +139,20 @@ class Upsample(Layer):
 
 
 class UpsamplingNearest2D(Upsample):
-    def __init__(self, size=None, scale_factor=None, data_format="NCHW", name=None):
+    def __init__(self, size=None, scale_factor=None, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(size, scale_factor, "nearest", False, 0, data_format)
 
 
 class UpsamplingBilinear2D(Upsample):
-    def __init__(self, size=None, scale_factor=None, data_format="NCHW", name=None):
+    def __init__(self, size=None, scale_factor=None, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(size, scale_factor, "bilinear", True, 0, data_format)
 
 
 class _PadND(Layer):
-    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL", name=None):
+    def __init__(self, padding, mode="constant", value=0.0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 1)
         super().__init__()
         self.padding = padding
         self.mode = mode
@@ -157,22 +164,26 @@ class _PadND(Layer):
 
 
 class Pad1D(_PadND):
-    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL", name=None):
+    def __init__(self, padding, mode="constant", value=0.0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 1)
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad2D(_PadND):
-    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def __init__(self, padding, mode="constant", value=0.0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad3D(_PadND):
-    def __init__(self, padding, mode="constant", value=0.0, data_format="NCDHW", name=None):
+    def __init__(self, padding, mode="constant", value=0.0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 3)
         super().__init__(padding, mode, value, data_format)
 
 
 class ZeroPad2D(Pad2D):
-    def __init__(self, padding, data_format="NCHW", name=None):
+    def __init__(self, padding, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(padding, "constant", 0.0, data_format)
 
 

@@ -4,6 +4,7 @@ import numpy as np
 from .. import functional as F
 from ..initializer import KaimingUniform, Uniform
 from ..layer_base import Layer
+from ..layout import resolve_data_format as _resolve_df
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose"]
 
@@ -53,7 +54,8 @@ class _ConvNd(Layer):
 class Conv1D(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
-                 bias_attr=None, data_format="NCL"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 1)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, padding_mode, weight_attr, bias_attr,
                          data_format, 1)
@@ -66,7 +68,8 @@ class Conv1D(_ConvNd):
 class Conv2D(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
-                 bias_attr=None, data_format="NCHW"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, padding_mode, weight_attr, bias_attr,
                          data_format, 2)
@@ -79,7 +82,8 @@ class Conv2D(_ConvNd):
 class Conv3D(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
-                 bias_attr=None, data_format="NCDHW"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 3)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, padding_mode, weight_attr, bias_attr,
                          data_format, 3)
@@ -92,7 +96,8 @@ class Conv3D(_ConvNd):
 class Conv1DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  output_padding=0, groups=1, dilation=1, weight_attr=None,
-                 bias_attr=None, data_format="NCL"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 1)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, "zeros", weight_attr, bias_attr,
                          data_format, 1, transposed=True, output_padding=output_padding)
@@ -106,7 +111,8 @@ class Conv1DTranspose(_ConvNd):
 class Conv2DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  output_padding=0, groups=1, dilation=1, weight_attr=None,
-                 bias_attr=None, data_format="NCHW"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, "zeros", weight_attr, bias_attr,
                          data_format, 2, transposed=True, output_padding=output_padding)
@@ -120,7 +126,8 @@ class Conv2DTranspose(_ConvNd):
 class Conv3DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  output_padding=0, groups=1, dilation=1, weight_attr=None,
-                 bias_attr=None, data_format="NCDHW"):
+                 bias_attr=None, data_format=None):
+        data_format = _resolve_df(data_format, 3)
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, "zeros", weight_attr, bias_attr,
                          data_format, 3, transposed=True, output_padding=output_padding)

@@ -5,6 +5,7 @@ from ...framework.core import Tensor
 from .. import functional as F
 from ..initializer import Constant
 from ..layer_base import Layer
+from ..layout import resolve_data_format as _resolve_df
 
 __all__ = [
     "LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
@@ -54,7 +55,8 @@ class RMSNorm(Layer):
 
 class _BatchNormBase(Layer):
     def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+                 bias_attr=None, data_format=None, use_global_stats=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self._num_features = num_features
         self._momentum = momentum
@@ -97,7 +99,8 @@ class BatchNorm(_BatchNormBase):
 
 class BatchNorm1D(_BatchNormBase):
     def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+                 bias_attr=None, data_format=None, use_global_stats=None, name=None):
+        data_format = _resolve_df(data_format, 1)
         super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
                          "NCHW" if data_format == "NCL" else "NHWC", use_global_stats)
 
@@ -108,7 +111,8 @@ class BatchNorm2D(_BatchNormBase):
 
 class BatchNorm3D(_BatchNormBase):
     def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+                 bias_attr=None, data_format=None, use_global_stats=None, name=None):
+        data_format = _resolve_df(data_format, 3)
         super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
                          "NCHW" if data_format == "NCDHW" else "NHWC", use_global_stats)
 
@@ -160,7 +164,8 @@ class SyncBatchNorm(_BatchNormBase):
 
 class GroupNorm(Layer):
     def __init__(self, num_groups, num_channels, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", name=None):
+                 bias_attr=None, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self._num_groups = num_groups
         self._epsilon = epsilon
@@ -177,9 +182,11 @@ class GroupNorm(Layer):
 
 class _InstanceNormBase(Layer):
     def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", name=None):
+                 bias_attr=None, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self._epsilon = epsilon
+        self._data_format = data_format
         if weight_attr is False:
             self.scale = None
         else:
@@ -189,7 +196,8 @@ class _InstanceNormBase(Layer):
             [num_features], attr=bias_attr, is_bias=True)
 
     def forward(self, input):
-        return F.instance_norm(input, weight=self.scale, bias=self.bias, eps=self._epsilon)
+        return F.instance_norm(input, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon, data_format=self._data_format)
 
 
 class InstanceNorm1D(_InstanceNormBase):
@@ -205,7 +213,8 @@ class InstanceNorm3D(_InstanceNormBase):
 
 
 class LocalResponseNorm(Layer):
-    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.args = (size, alpha, beta, k, data_format)
 

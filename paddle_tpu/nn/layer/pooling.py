@@ -19,6 +19,15 @@ class _Pool(Layer):
         self.stride = stride
         self.padding = padding
         self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+        self._snapshot_data_format()
+
+    def _snapshot_data_format(self):
+        # resolve the global layout at CONSTRUCTION, like every other layer
+        # (a model built under set_channels_last must not change behavior if
+        # the flag is flipped before forward)
+        if "data_format" not in self.kwargs and self._fn and self._fn[-2].isdigit():
+            from ..layout import resolve_data_format
+            self.kwargs["data_format"] = resolve_data_format(None, int(self._fn[-2]))
 
     def forward(self, x):
         return getattr(F, self._fn)(x, self.kernel_size, self.stride, self.padding, **self.kwargs)
@@ -58,6 +67,7 @@ class _AdaptivePool(Layer):
         super().__init__()
         self.output_size = output_size
         self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+        _Pool._snapshot_data_format(self)
 
     def forward(self, x):
         return getattr(F, self._fn)(x, self.output_size, **self.kwargs)

@@ -1,12 +1,14 @@
 """Vision layers — reference python/paddle/nn/layer/vision.py."""
 from .. import functional as F
 from ..layer_base import Layer
+from ..layout import resolve_data_format as _resolve_df
 
 __all__ = ["PixelShuffle", "PixelUnshuffle", "ChannelShuffle"]
 
 
 class PixelShuffle(Layer):
-    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+    def __init__(self, upscale_factor, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.upscale_factor = upscale_factor
         self.data_format = data_format
@@ -16,7 +18,8 @@ class PixelShuffle(Layer):
 
 
 class PixelUnshuffle(Layer):
-    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+    def __init__(self, downscale_factor, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.downscale_factor = downscale_factor
         self.data_format = data_format
@@ -26,7 +29,8 @@ class PixelUnshuffle(Layer):
 
 
 class ChannelShuffle(Layer):
-    def __init__(self, groups, data_format="NCHW", name=None):
+    def __init__(self, groups, data_format=None, name=None):
+        data_format = _resolve_df(data_format, 2)
         super().__init__()
         self.groups = groups
         self.data_format = data_format

@@ -1,0 +1,45 @@
+"""Global data-format (layout) switch.
+
+TPU's fast path wants channels on the 128-lane minor dim (channel-last):
+with NCHW tensors XLA materializes transposes around every conv, which can
+dominate a conv net's step time. Rather than plumbing `data_format` through
+every model constructor, `set_channels_last(True)` flips the DEFAULT layout
+of every conv/norm/pool layer and functional whose `data_format` the caller
+left unspecified — so any vision model runs channel-last end-to-end:
+
+    paddle.nn.set_channels_last(True)
+    model = paddle.vision.models.mobilenet_v2()   # NHWC throughout
+    out = model(images_nhwc)
+
+Explicit `data_format=...` arguments always win. The reference has no such
+switch (CUDA favors NCHW); this is a TPU-first extension.
+"""
+__all__ = ["set_channels_last", "channels_last_enabled", "resolve_data_format"]
+
+# PROCESS-global (layers snapshot their layout at construction, so a model
+# built in one thread behaves identically when driven from another)
+_state = {"flag": False}
+
+_CHANNEL_FIRST = {1: "NCL", 2: "NCHW", 3: "NCDHW"}
+_CHANNEL_LAST = {1: "NLC", 2: "NHWC", 3: "NDHWC"}
+
+
+def set_channels_last(flag=True):
+    """Make channel-last the default layout for layers/functionals that were
+    not given an explicit data_format. Returns the previous setting."""
+    prev = channels_last_enabled()
+    _state["flag"] = bool(flag)
+    return prev
+
+
+def channels_last_enabled():
+    return _state["flag"]
+
+
+def resolve_data_format(data_format, n_spatial):
+    """None -> the current default for n_spatial dims; explicit strings pass
+    through untouched."""
+    if data_format is not None:
+        return data_format
+    table = _CHANNEL_LAST if channels_last_enabled() else _CHANNEL_FIRST
+    return table[n_spatial]

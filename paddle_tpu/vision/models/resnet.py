@@ -5,6 +5,7 @@ NCHW forces XLA to materialize transposes around every conv, which dominates
 the step time; NHWC trains at full MXU utilisation. Weights are layout-
 independent ([O, I, kH, kW] either way), so checkpoints transfer."""
 from ... import nn
+from ...nn.layout import resolve_data_format
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
            "wide_resnet50_2", "wide_resnet101_2",
@@ -72,7 +73,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
-                 groups=1, data_format="NCHW"):
+                 groups=1, data_format=None):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -83,6 +84,7 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
+        data_format = resolve_data_format(data_format, 2)
         self.data_format = data_format
         df = dict(data_format=data_format)
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False, **df)

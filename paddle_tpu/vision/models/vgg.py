@@ -34,6 +34,10 @@ class VGG(nn.Layer):
         self.features = features
         self.num_classes = num_classes
         self.with_pool = with_pool
+        # built under nn.set_channels_last: activations are NHWC; remember so
+        # the classifier sees the same flattened (C, H, W) feature ORDER as an
+        # NCHW build — keeps fc weights checkpoint-compatible across layouts
+        self._channels_last = nn.channels_last_enabled()
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
         if num_classes > 0:
@@ -47,7 +51,9 @@ class VGG(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            from ...tensor.manipulation import flatten
+            from ...tensor.manipulation import flatten, transpose
+            if self._channels_last:
+                x = transpose(x, [0, 3, 1, 2])
             x = self.classifier(flatten(x, 1))
         return x
 

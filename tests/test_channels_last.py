@@ -1,0 +1,64 @@
+"""Global channels-last layout switch (nn.set_channels_last): any vision
+model built under it runs NHWC end-to-end and matches the NCHW build
+numerically (TPU-first extension; see paddle_tpu/nn/layout.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision import models
+
+
+@pytest.fixture
+def channels_last():
+    prev = nn.set_channels_last(True)
+    yield
+    nn.set_channels_last(prev)
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: models.mobilenet_v2(num_classes=7), 32),
+    (lambda: models.vgg11(num_classes=7), 32),
+    (lambda: models.resnet18(num_classes=7), 32),
+])
+def test_channels_last_matches_channels_first(ctor, size, channels_last):
+    paddle.seed(0)
+    m_last = ctor()                 # built under channels_last -> NHWC layers
+    nn.set_channels_last(False)     # layers SNAPSHOT their layout at build:
+    paddle.seed(0)                  # flipping the flag later must not matter
+    m_first = ctor()
+    m_first.set_state_dict(m_last.state_dict())
+    m_last.eval()
+    m_first.eval()
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, size, size, 3).astype("float32")
+    out_last = m_last(paddle.to_tensor(x))
+    out_first = m_first(paddle.to_tensor(np.transpose(x, (0, 3, 1, 2))))
+    np.testing.assert_allclose(out_last.numpy(), out_first.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_unpool_channels_last(channels_last):
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 8, 8, 3).astype("float32"))
+    out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+    rec = F.max_unpool2d(out, mask, 2, 2)
+    assert rec.shape == [2, 8, 8, 3]
+    # scattered values land at their argmax positions
+    nn.set_channels_last(False)
+    xc = paddle.to_tensor(np.transpose(x.numpy(), (0, 3, 1, 2)))
+    out_c, mask_c = F.max_pool2d(xc, 2, 2, return_mask=True)
+    rec_c = F.max_unpool2d(out_c, mask_c, 2, 2)
+    np.testing.assert_allclose(np.transpose(rec.numpy(), (0, 3, 1, 2)),
+                               rec_c.numpy(), atol=1e-6)
+
+
+def test_explicit_data_format_wins(channels_last):
+    conv = nn.Conv2D(3, 4, 3, data_format="NCHW")   # explicit beats global
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 8, 8).astype("float32"))
+    assert conv(x).shape == [1, 4, 6, 6]
+
+
+def test_flag_restored_between_tests():
+    assert not nn.channels_last_enabled()
