@@ -158,7 +158,8 @@ def run_bert_base(batch_size=32, seq_len=512, steps=10):
                                  accumulator_dtype="bfloat16")
 
     def loss_fn(m, batch):
-        mlm_logits, nsp_logits = m(paddle.to_tensor(batch["input_ids"]))
+        mlm_logits, nsp_logits = m(paddle.to_tensor(batch["input_ids"]),
+                                   attention_mask=paddle.to_tensor(batch["attention_mask"]))
         return crit(mlm_logits, nsp_logits,
                     paddle.to_tensor(batch["mlm_labels"]),
                     paddle.to_tensor(batch["nsp_labels"]))
@@ -167,8 +168,12 @@ def run_bert_base(batch_size=32, seq_len=512, steps=10):
     rng = np.random.RandomState(0)
     labels = rng.randint(0, cfg.vocab_size, (batch_size, seq_len))
     labels[rng.rand(batch_size, seq_len) > 0.15] = -100  # MLM masking rate
+    # ~12% padding per sequence: masked flash attention is the measured path
+    lengths = rng.randint(int(seq_len * 0.75), seq_len + 1, (batch_size,))
+    attn_mask = (np.arange(seq_len)[None, :] < lengths[:, None])
     batch = {"input_ids": rng.randint(0, cfg.vocab_size,
                                       (batch_size, seq_len)).astype("int32"),
+             "attention_mask": attn_mask.astype("int32"),  # [B, L]: model expands
              "mlm_labels": labels.astype("int32"),
              "nsp_labels": rng.randint(0, 2, (batch_size,)).astype("int64")}
     batch = _stage(batch)
@@ -178,6 +183,42 @@ def run_bert_base(batch_size=32, seq_len=512, steps=10):
     mfu = 6 * n_params * seqs_s * seq_len / chip_peak_flops()
     log(f"bert_base: {dt*1e3:.1f} ms/step, {seqs_s:.1f} seqs/s, MFU={mfu:.3f}")
     return seqs_s, mfu
+
+
+def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
+    """BASELINE.json config 5: GPT-MoE (top-2 routed experts), tokens/s/chip.
+    Single-chip: measures the dispatch/combine einsums + expert FFs; the ep
+    mesh path is validated by dryrun_multichip and tests/test_moe.py."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import GPTMoE, GPTPretrainingCriterion
+    from paddle_tpu.models.moe import gpt_moe_small
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    cfg = gpt_moe_small(max_seq_len=seq_len)
+    model = GPTMoE(cfg)
+    model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=2e-4,
+                                 accumulator_dtype="bfloat16")
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["input_ids"]))
+        return crit(logits, paddle.to_tensor(b["labels"])) + m.aux_loss()
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
+    batch = _stage({"input_ids": ids[:, :-1].astype("int32"),
+                    "labels": ids[:, 1:].astype("int32")})
+    dt = _measure(trainer, batch, steps, "gpt_moe")
+    tok_s = batch_size * seq_len / dt
+    log(f"gpt_moe: {dt*1e3:.1f} ms/step, {tok_s:.0f} tok/s")
+    return tok_s
 
 
 def main():
@@ -250,6 +291,13 @@ def main():
         except Exception as e:
             log(f"bert bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["bert_base_error"] = str(e)[:160]
+    if only in (None, "moe"):
+        try:
+            tok_s = run_gpt_moe()
+            extras["gpt_moe_tokens_per_sec_per_chip"] = round(tok_s, 1)
+        except Exception as e:
+            log(f"moe bench failed: {type(e).__name__}: {str(e)[:300]}")
+            extras["gpt_moe_error"] = str(e)[:160]
     if extras:
         result["extras"] = extras
     print(json.dumps(result))

@@ -12,7 +12,7 @@ from .gpt import (  # noqa: F401
     gpt_1p3b,
     gpt_tiny,
 )
-from .moe import GPTMoE, MoEConfig, MoEMLP, gpt_moe_tiny  # noqa: F401
+from .moe import GPTMoE, MoEConfig, MoEMLP, gpt_moe_small, gpt_moe_tiny  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForPretraining,

@@ -18,7 +18,7 @@ from ..nn.initializer import Constant, Normal
 from ..nn.layer_base import Layer
 from .gpt import GPT, GPTBlock, GPTConfig, GPTPretrainingCriterion
 
-__all__ = ["MoEConfig", "MoEMLP", "GPTMoE", "gpt_moe_tiny"]
+__all__ = ["MoEConfig", "MoEMLP", "GPTMoE", "gpt_moe_tiny", "gpt_moe_small"]
 
 
 @dataclasses.dataclass
@@ -179,5 +179,14 @@ def gpt_moe_tiny(**kw):
     base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
                 max_seq_len=64, dtype="float32", num_experts=4, top_k=2,
                 remat=False)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+def gpt_moe_small(**kw):
+    """~350M-class dense backbone with 8 experts every 2nd block (the
+    single-chip bench config; scale num_experts with the 'ep' degree)."""
+    base = dict(hidden_size=1024, num_layers=12, num_heads=16,
+                num_experts=8, top_k=2)
     base.update(kw)
     return MoEConfig(**base)

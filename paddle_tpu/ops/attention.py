@@ -202,7 +202,7 @@ def _fwd_kernel(*refs, scale, causal, block_k, seq_k, seq_q_real,
         v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
         if has_kvb:
-            s = s + kvb_ref[0, pl.dslice(i * block_k, block_k)][None, :]
+            s = s + kvb_ref[0, 0, pl.dslice(i * block_k, block_k)][None, :]
         if has_fb:
             s = s + fb_ref[0, 0, :, pl.dslice(i * block_k, block_k)]
         if causal:
@@ -271,7 +271,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_k, seq_q_real,
         v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         if has_kvb:
-            s = s + kvb_ref[0, pl.dslice(i * block_k, block_k)][None, :]
+            s = s + kvb_ref[0, 0, pl.dslice(i * block_k, block_k)][None, :]
         if has_fb:
             s = s + fb_ref[0, 0, :, pl.dslice(i * block_k, block_k)]
         if causal:
@@ -329,7 +329,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_q_real,
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [bq, bk]
         if has_kvb:
-            s = s + kvb_ref[0, pl.dslice(k_idx * bk, bk)][None, :]
+            s = s + kvb_ref[0, 0, pl.dslice(k_idx * bk, bk)][None, :]
         if has_fb:
             r0m = (i * block_q) % fb_rows
             s = s + fb_ref[0, 0, pl.dslice(r0m, block_q), :]
@@ -378,8 +378,10 @@ def _bias_specs(cfg, B, H, bq, Lk, fb_rows, kvb, fb, seed, for_dkv=False, bk=Non
     causal, scale, rate, has_kvb, kvb_b, has_fb, fb_b, fb_h = cfg
     specs, args = [], []
     if has_kvb:
+        # [Bm, 1, Lk]: the unit middle dim keeps the last-two block dims
+        # (1, Lk) equal to the array dims — TPU tiling requirement
         specs.append(pl.BlockSpec(
-            (1, Lk), lambda b, h, i, _kb=kvb_b: (b if _kb else 0, 0)))
+            (1, 1, Lk), lambda b, h, i, _kb=kvb_b: (b if _kb else 0, 0, 0)))
         args.append(kvb)
     if has_fb:
         n_rb = fb_rows // bq
@@ -416,6 +418,8 @@ def _fwd_lse_impl(q, k, v, kvb, fb, seed, cfg, interpret=None):
     bq = _block(Lq if seq_q_real is None else seq_q_real, _BLOCK_Q)
     bk = _block(Lk, _BLOCK_K)
     fb_rows = fb.shape[2] if has_fb else Lq_f
+    if has_kvb and kvb.ndim == 2:
+        kvb = kvb[:, None, :]
     grid = (B, H, Lq_f // bq)
     extra_specs, extra_args = _bias_specs(cfg, B, H, bq, Lk, fb_rows, kvb, fb, seed)
     out, lse = pl.pallas_call(
@@ -466,6 +470,8 @@ def _bwd_impl(q, k, v, lse, g, out, kvb, fb, seed, cfg, interpret=None):
     bq = _block(Lq if seq_q_real is None else seq_q_real, _BLOCK_Q)
     bk = _block(Lk, _BLOCK_K)
     fb_rows = fb.shape[2] if has_fb else Lq_f
+    if has_kvb and kvb.ndim == 2:
+        kvb = kvb[:, None, :]
     delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
                     axis=-1, keepdims=True)           # (B, H, Lq_f, 1)
 
