@@ -1,0 +1,122 @@
+"""ResNet training recipe — the paddle_tpu rendering of the reference's
+PaddleClas ResNet run: channel-last layout, bf16 on the MXU, multiprocess
+DataLoader with the native (off-GIL) JPEG pipeline, one compiled step.
+
+Usage (synthetic data):
+    python examples/train_resnet.py --steps 50
+With an image-folder dataset (class-per-subdir of JPEGs):
+    python examples/train_resnet.py --data /path/to/train --classes 1000
+"""
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+class FolderDataset:
+    """class-per-subdir JPEG folder -> (CHW float32, label) via the native
+    decode-resize-normalize pipeline (paddle_tpu.runtime.image)."""
+
+    MEAN, STD = [0.485, 0.456, 0.406], [0.229, 0.224, 0.225]
+
+    def __init__(self, root, size=224, channels_last=True):
+        from paddle_tpu.io import Dataset  # noqa: F401 (duck-typed)
+        self.samples = []
+        for ci, cls in enumerate(sorted(os.listdir(root))):
+            d = os.path.join(root, cls)
+            if not os.path.isdir(d):
+                continue
+            for f in os.listdir(d):
+                if f.lower().endswith((".jpg", ".jpeg")):
+                    self.samples.append((os.path.join(d, f), ci))
+        self.size = size
+        self.channels_last = channels_last
+
+    def __getitem__(self, i):
+        from paddle_tpu.runtime.image import decode_resize_normalize
+        path, label = self.samples[i]
+        with open(path, "rb") as f:
+            chw = decode_resize_normalize(f.read(), (self.size, self.size),
+                                          self.MEAN, self.STD)
+        if chw.shape[0] == 1:          # grayscale JPEGs -> 3 channels
+            chw = np.repeat(chw, 3, axis=0)
+        x = np.transpose(chw, (1, 2, 0)) if self.channels_last else chw
+        return x.astype(np.float32), np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class SyntheticDataset:
+    def __init__(self, n=4096, size=224, classes=1000, channels_last=True):
+        self.n, self.size, self.classes = n, size, classes
+        self.channels_last = channels_last
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        shape = (self.size, self.size, 3) if self.channels_last else (3, self.size, self.size)
+        return rng.randn(*shape).astype(np.float32), np.int64(i % self.classes)
+
+    def __len__(self):
+        return self.n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="image-folder root (JPEGs)")
+    ap.add_argument("--arch", default="resnet50")
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--workers", type=int, default=4)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import Trainer, build_mesh
+    from paddle_tpu.io import DataLoader
+
+    paddle.seed(0)
+    build_mesh()  # dp over all attached devices
+
+    # NHWC end-to-end: channels ride the TPU lane dim (docs/performance.md)
+    model = getattr(paddle.vision.models, args.arch)(
+        num_classes=args.classes, data_format="NHWC")
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.Momentum(
+        learning_rate=paddle.optimizer.lr.CosineAnnealingDecay(args.lr, args.steps),
+        momentum=0.9, weight_decay=1e-4)
+
+    def loss_fn(m, batch):
+        return paddle.nn.functional.cross_entropy(
+            m(paddle.to_tensor(batch["image"])), paddle.to_tensor(batch["label"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    ds = FolderDataset(args.data) if args.data else SyntheticDataset(classes=args.classes)
+    if len(ds) < args.batch:
+        raise SystemExit(f"dataset has {len(ds)} samples < --batch {args.batch}; "
+                         "lower --batch (drop_last would yield zero batches)")
+    loader = DataLoader(ds, batch_size=args.batch, shuffle=True, drop_last=True,
+                        num_workers=args.workers, persistent_workers=True)
+
+    step, t0 = 0, time.time()
+    while step < args.steps:
+        for image, label in loader:
+            loss = trainer.step({"image": image, "label": label})
+            step += 1
+            if step % 10 == 0:
+                dt = (time.time() - t0) / 10
+                print(f"step {step}: loss {float(loss):.4f}  "
+                      f"{args.batch / dt:.0f} imgs/s")
+                t0 = time.time()
+            if step >= args.steps:
+                break
+    trainer.sync_to_model()  # params + BN running stats back into the Layer
+    paddle.save(model.state_dict(), f"{args.arch}.pdparams")
+    print(f"saved {args.arch}.pdparams")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,9 @@ class BertModel(nn.Layer):
             attn_dropout=cfg.attention_dropout, act_dropout=0.0)
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        if cfg.dtype in ("bfloat16", "float16"):
+            self.astype(cfg.dtype)   # config-driven precision, like GPTConfig
+
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         if attention_mask is not None:
