@@ -14,38 +14,23 @@ import time
 import numpy as np
 
 
-class FolderDataset:
-    """class-per-subdir JPEG folder -> (CHW float32, label) via the native
-    decode-resize-normalize pipeline (paddle_tpu.runtime.image)."""
+def make_folder_dataset(root, size=224, channels_last=True):
+    """vision.datasets.DatasetFolder with the native (off-GIL) JPEG pipeline
+    as its loader: decode -> resize -> normalize in one C call per image."""
+    from paddle_tpu.runtime.image import decode_resize_normalize
+    from paddle_tpu.vision.datasets import DatasetFolder
 
-    MEAN, STD = [0.485, 0.456, 0.406], [0.229, 0.224, 0.225]
+    mean, std = [0.485, 0.456, 0.406], [0.229, 0.224, 0.225]
 
-    def __init__(self, root, size=224, channels_last=True):
-        from paddle_tpu.io import Dataset  # noqa: F401 (duck-typed)
-        self.samples = []
-        for ci, cls in enumerate(sorted(os.listdir(root))):
-            d = os.path.join(root, cls)
-            if not os.path.isdir(d):
-                continue
-            for f in os.listdir(d):
-                if f.lower().endswith((".jpg", ".jpeg")):
-                    self.samples.append((os.path.join(d, f), ci))
-        self.size = size
-        self.channels_last = channels_last
-
-    def __getitem__(self, i):
-        from paddle_tpu.runtime.image import decode_resize_normalize
-        path, label = self.samples[i]
+    def load(path):
         with open(path, "rb") as f:
-            chw = decode_resize_normalize(f.read(), (self.size, self.size),
-                                          self.MEAN, self.STD)
+            chw = decode_resize_normalize(f.read(), (size, size), mean, std)
         if chw.shape[0] == 1:          # grayscale JPEGs -> 3 channels
             chw = np.repeat(chw, 3, axis=0)
-        x = np.transpose(chw, (1, 2, 0)) if self.channels_last else chw
-        return x.astype(np.float32), np.int64(label)
+        x = np.transpose(chw, (1, 2, 0)) if channels_last else chw
+        return x.astype(np.float32)
 
-    def __len__(self):
-        return len(self.samples)
+    return DatasetFolder(root, loader=load, extensions=(".jpg", ".jpeg"))
 
 
 class SyntheticDataset:
@@ -94,7 +79,7 @@ def main():
             m(paddle.to_tensor(batch["image"])), paddle.to_tensor(batch["label"]))
 
     trainer = Trainer(model, opt, loss_fn)
-    ds = FolderDataset(args.data) if args.data else SyntheticDataset(classes=args.classes)
+    ds = make_folder_dataset(args.data) if args.data else SyntheticDataset(classes=args.classes)
     if len(ds) < args.batch:
         raise SystemExit(f"dataset has {len(ds)} samples < --batch {args.batch}; "
                          "lower --batch (drop_last would yield zero batches)")

@@ -70,7 +70,7 @@ class BertModel(nn.Layer):
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         if cfg.dtype in ("bfloat16", "float16"):
-            self.astype(cfg.dtype)   # config-driven precision, like GPTConfig
+            self.astype(cfg.dtype)   # config-driven PARAM cast
 
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
@@ -98,6 +98,8 @@ class BertForPretraining(nn.Layer):
         self.mlm_bias = self.create_parameter(
             [cfg.vocab_size], is_bias=True)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
+        if cfg.dtype in ("bfloat16", "float16"):
+            self.astype(cfg.dtype)   # heads follow the config dtype too
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
@@ -132,6 +134,8 @@ class BertForSequenceClassification(nn.Layer):
         self.bert = BertModel(cfg)
         self.dropout = nn.Dropout(dropout if dropout is not None else cfg.hidden_dropout)
         self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+        if cfg.dtype in ("bfloat16", "float16"):
+            self.astype(cfg.dtype)   # heads follow the config dtype too
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         _, pooled = self.bert(input_ids, token_type_ids, attention_mask)

@@ -12,8 +12,13 @@ Shapes:
   q               : (B, 1, H, D)    single decode step
 
 The compute path is jnp (XLA fuses the gather + masked softmax well on TPU
-for decode's tiny FLOP count — latency is HBM-bound on page reads); a Pallas
-kernel variant processes one (batch, head) per grid cell for long contexts.
+for decode's tiny FLOP count — latency is HBM-bound on page reads). The
+opt-in Pallas kernel (`use_kernel=True`) uses scalar-prefetch paging: the
+page pool stays in HBM and the prefetched page_table drives the BlockSpec
+index maps, so exactly one page of K/V is in VMEM per grid step regardless
+of pool size (semantics verified against the jnp path in interpret mode;
+note: some remote-compile toolchains are slow to build the
+PrefetchScalarGridSpec lowering — the jnp default avoids that).
 """
 import functools
 
@@ -97,38 +102,46 @@ def _paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens, scale):
     return out.astype(q.dtype)
 
 
-def _paged_kernel(q_ref, kp_ref, vp_ref, pt_ref, len_ref, o_ref, *,
-                  scale, page_size, max_pages):
-    """One (batch, head) per grid cell; loops pages with masking. All
-    intermediates are kept 2-D (Mosaic requires >=2-D vector shapes)."""
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, s_scr, acc_scr, *, scale, page_size, max_pages):
+    """Grid (B, H, max_pages): ONE page of K/V in VMEM per step — the page
+    pool stays in HBM and the scalar-prefetched page_table drives the
+    BlockSpec index maps, so pallas pipelines page fetches with compute
+    (no whole-pool VMEM blowup; the previous kernel mapped the entire pool
+    per grid cell and silently fell back for any realistic pool size).
+    Online-softmax state lives in VMEM scratch across the page steps."""
     from jax.experimental import pallas as pl
 
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    seq_len = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        s_scr[...] = jnp.zeros_like(s_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
     q = q_ref[0, 0, 0].astype(jnp.float32).reshape(1, -1) * scale  # (1, D)
-    d = q.shape[1]
-    seq_len = len_ref[0]
-    m = jnp.full((1, 1), -1e30, jnp.float32)
-    s = jnp.zeros((1, 1), jnp.float32)
-    acc = jnp.zeros((1, d), jnp.float32)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)                      # (P, D)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))   # (1, P)
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1)
+    logits = jnp.where(pos < seq_len, logits, -1e30)
 
-    def body(i, carry):
-        m, s, acc = carry
-        page = pt_ref[0, i]
-        k = kp_ref[pl.dslice(page, 1), :, 0, :][0].astype(jnp.float32)  # (P, D)
-        v = vp_ref[pl.dslice(page, 1), :, 0, :][0].astype(jnp.float32)
-        logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))    # (1, P)
-        pos = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        logits = jnp.where(pos < seq_len, logits, -1e30)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
-        p = jnp.exp(logits - m_new)
-        corr = jnp.exp(m - m_new)
-        s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + p @ v
-        return m_new, s_new, acc_new
+    m_prev, s_prev, acc_prev = m_scr[...], s_scr[...], acc_scr[...]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.exp(logits - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    m_scr[...] = m_new
+    s_scr[...] = s_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_prev * corr + p @ v
 
-    n_live = (seq_len + page_size - 1) // page_size
-    m, s, acc = jax.lax.fori_loop(0, n_live, body, (m, s, acc))
-    o_ref[0, 0, 0] = (acc / jnp.maximum(s, 1e-30))[0].astype(o_ref.dtype)
+    @pl.when(j == max_pages - 1)
+    def _emit():
+        out = acc_scr[...] / jnp.maximum(s_scr[...], 1e-30)
+        o_ref[0, 0, 0] = out[0].astype(o_ref.dtype)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
@@ -139,13 +152,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     if not use_kernel:
         return _paged_attention_ref(q, k_pages, v_pages, page_table,
                                     seq_lens, scale)
-    from jax.experimental import pallas as pl
-
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    b, _, h, d = q.shape
-    n_pages, page_size = k_pages.shape[:2]
-    max_pages = page_table.shape[1]
     try:
         return _paged_kernel_call(q, k_pages, v_pages, page_table, seq_lens,
                                   scale, interpret)
@@ -158,23 +166,36 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
 def _paged_kernel_call(q, k_pages, v_pages, page_table, seq_lens, scale,
                        interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, _, h, d = q.shape
     n_pages, page_size = k_pages.shape[:2]
     max_pages = page_table.shape[1]
+
+    def page_map(bi, hi, j, pt, lens):
+        return (jnp.maximum(pt[bi, j], 0), 0, hi, 0)  # -1 (unused) -> page 0, masked
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_table, seq_lens
+        grid=(b, h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, j, pt, lens: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, d), page_map),
+            pl.BlockSpec((1, page_size, 1, d), page_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, d), lambda bi, hi, j, pt, lens: (bi, 0, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, page_size=page_size,
                           max_pages=max_pages),
-        grid=(b, h),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, 0, j, 0)),
-            pl.BlockSpec((n_pages, page_size, 1, d), lambda i, j: (0, 0, j, 0)),
-            pl.BlockSpec((n_pages, page_size, 1, d), lambda i, j: (0, 0, j, 0)),
-            pl.BlockSpec((1, max_pages), lambda i, j: (i, 0)),
-            pl.BlockSpec((1,), lambda i, j: (i,)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, 0, j, 0)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
         interpret=interpret,
-    )(q, k_pages, v_pages, page_table.astype(jnp.int32),
-      seq_lens.astype(jnp.int32))
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)

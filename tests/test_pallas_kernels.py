@@ -203,3 +203,26 @@ def test_flash_attention_nondivisible_256():
     out = A._flash_fwd_impl(q, k, v, True, 0.125, interpret=True)
     ref = A.mha_reference(q, k, v, causal=True, scale=0.125)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_paged_attention_kernel_path(monkeypatch):
+    """The scalar-prefetch paged kernel (one page in VMEM per grid step) must
+    run — fallback is a test failure here — and match the jnp reference."""
+    from paddle_tpu.ops import paged_attention as P
+
+    def no_fallback(name, err):
+        raise AssertionError(f"kernel fell back: {err}")
+    monkeypatch.setattr(P, "kernel_fallback", no_fallback)
+
+    rng = np.random.RandomState(1)
+    B, H, D, page, n_pages = 2, 2, 128, 8, 12
+    k_pages = jnp.asarray(rng.randn(n_pages, page, H, D).astype(np.float32))
+    v_pages = jnp.asarray(rng.randn(n_pages, page, H, D).astype(np.float32))
+    # seq 0 uses pages [3, 5, 7] (len 20), seq 1 uses [2] (len 5)
+    table = jnp.asarray(np.array([[3, 5, 7], [2, -1, -1]], np.int32))
+    lens = jnp.asarray(np.array([20, 5], np.int32))
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+    out_k = P.paged_attention(q, k_pages, v_pages, table, lens, use_kernel=True)
+    out_r = P.paged_attention(q, k_pages, v_pages, table, lens, use_kernel=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
