@@ -60,6 +60,22 @@ class DistributedStrategy:
             ep=int(cfg.get("ep_degree", 1)),
         )
 
+    def pipeline_schedule(self):
+        """Schedule for distributed.pipeline.pipeline_apply, from
+        pipeline_configs (reference pipeline_configs schedule_mode /
+        accumulate_steps / virtual_pp_degree):
+        returns (schedule, n_microbatch, virtual)."""
+        cfg = self.pipeline_configs or {}
+        mode = str(cfg.get("schedule_mode", "1F1B")).lower()
+        virtual = int(cfg.get("virtual_pp_degree", 1))
+        if virtual > 1:
+            mode = "interleaved"
+        elif mode not in ("gpipe", "1f1b", "interleaved"):
+            mode = "1f1b"
+        if mode == "interleaved":
+            virtual = max(virtual, 2)
+        return mode, int(cfg.get("accumulate_steps", 4)), virtual
+
 
 class HybridCommunicateGroup:
     def __init__(self, strategy):

@@ -9,7 +9,7 @@ microbatch schedule is a lax.scan over ticks. shard_map is manual ONLY over
 'pp' (axis_names={'pp'}) so tensor/data parallel dims inside each stage stay
 GSPMD-managed — pp×tp×dp×sp compose.
 
-Two schedules:
+Three schedules:
 
 - "gpipe": forward scan, backward by XLA autodiff of the scan. Simple, but
   the autodiff saves EVERY tick's stage residuals (all internal
@@ -23,12 +23,34 @@ Two schedules:
   residuals) expressed as a single XLA program. Measured on GPTStacked
   pp=4×dp=2, 8 microbatches (examples/bench_pipeline.py): 1.56× faster
   and 5.7× less temp memory than "gpipe".
+- "interleaved": virtual pipeline stages (reference
+  fleet/meta_parallel/pipeline_parallel.py interleaved 1F1B scheduler +
+  Megatron-LM interleaving). Each device owns `virtual` non-contiguous
+  layer chunks; chunk c on device d is global virtual stage c*S+d, so one
+  microbatch visits every device V times. A tick does 1/V of a stage's
+  work, shrinking the pipeline-fill bubble from (S-1) stage-ticks to
+  ~(S-1) CHUNK-ticks — the bubble fraction drops by the virtual factor V.
+  The schedule itself is simulated on the host at trace time (greedy
+  earliest-ready, breadth-first priority) and baked into the compiled
+  program as static gather tables; activations hop on a forward ppermute
+  ring plus a wrap ring (last device → device 0) between chunks.
 """
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "interleaved_schedule_table"]
+
+
+def _make_varying(axis_name):
+    def _varying(z):
+        try:
+            return jax.lax.pcast(z, (axis_name,), to="varying")
+        except ValueError:  # already varying over axis_name
+            return z
+    return _varying
 
 
 def _make_fwd_scan(stage_fn, n_micro, n_stages, axis_name):
@@ -36,12 +58,7 @@ def _make_fwd_scan(stage_fn, n_micro, n_stages, axis_name):
     M, S = n_micro, n_stages
     T = M + S - 1
     perm = [(i, i + 1) for i in range(S - 1)]
-
-    def _varying(z):
-        try:
-            return jax.lax.pcast(z, (axis_name,), to="varying")
-        except ValueError:  # already varying over axis_name
-            return z
+    _varying = _make_varying(axis_name)
 
     def fwd_scan(params_local, xv):
         idx = jax.lax.axis_index(axis_name)
@@ -142,15 +159,156 @@ def _1f1b_local(stage_fn, n_micro, n_stages, axis_name):
     return run
 
 
+def interleaved_schedule_table(n_micro, n_stages, virtual):
+    """Greedy earliest-ready simulation of the interleaved schedule.
+
+    Work item (m, k): microbatch m at global virtual stage k = c*S + d
+    (chunk c of device d). Item input is ready one tick after the previous
+    virtual stage computed it; each device runs at most one chunk per tick;
+    ties broken breadth-first (lowest chunk, then lowest microbatch), which
+    keeps the wrap link busy and realizes the ~(S-1)-chunk-tick fill bubble.
+
+    Returns dict of numpy [T, S] tables:
+      work/mb/ch    — does device d compute at tick t, and which (m, c)
+      stv/stm/stc   — should device d STORE the value received at tick t,
+                      and into which buffer slot (m, c)
+      out           — is this tick's computed y a final-stage output
+    """
+    M, S, V = n_micro, n_stages, virtual
+    SV = S * V
+    avail = {(m, 0): 0 for m in range(M)}       # (m, k) -> ready tick
+    done = set()
+    compute = []                                # (t, d, m, c)
+    t = 0
+    while len(done) < M * SV:
+        for d in range(S):
+            ready = [(c, m)
+                     for c in range(V) for m in range(M)
+                     if (m, c * S + d) not in done
+                     and avail.get((m, c * S + d), None) is not None
+                     and avail[(m, c * S + d)] <= t]
+            if not ready:
+                continue
+            c, m = min(ready)
+            k = c * S + d
+            done.add((m, k))
+            compute.append((t, d, m, c))
+            if k + 1 < SV:
+                avail[(m, k + 1)] = t + 1
+        t += 1
+    T = t
+    tbl = {key: np.zeros((T, S), np.int32)
+           for key in ("work", "mb", "ch", "stv", "stm", "stc", "out")}
+    for (tc, d, m, c) in compute:
+        k = c * S + d
+        tbl["work"][tc, d] = 1
+        tbl["mb"][tc, d] = m
+        tbl["ch"][tc, d] = c
+        if k == SV - 1:
+            tbl["out"][tc, d] = 1
+        elif tc + 1 < T:
+            d2 = (k + 1) % S
+            tbl["stv"][tc + 1, d2] = 1
+            tbl["stm"][tc + 1, d2] = m
+            tbl["stc"][tc + 1, d2] = (k + 1) // S
+    return T, tbl
+
+
+def _interleaved_local(stage_fn, n_micro, n_stages, virtual, axis_name):
+    """Forward interleaved schedule (backward by XLA autodiff of the scan,
+    as with gpipe). params_local leaves are [V*cl, ...]: chunk c of THIS
+    device = rows [c*cl, (c+1)*cl) after the interleave permutation applied
+    in pipeline_apply."""
+    M, S, V = n_micro, n_stages, virtual
+    T, tbl = interleaved_schedule_table(M, S, V)
+    jt = {k: jnp.asarray(v) for k, v in tbl.items()}
+    # one full-ring hop per tick: d -> d+1, plus the S-1 -> 0 wrap that
+    # carries chunk c outputs into chunk c+1 on device 0
+    perm_ring = [(i, (i + 1) % S) for i in range(S)]
+    _varying = _make_varying(axis_name)
+
+    def local_fn(params_local, xv):
+        idx = jax.lax.axis_index(axis_name)
+        B = xv.shape[0]
+        mb = xv.reshape((M, B // M) + xv.shape[1:])
+        mb_shape = mb.shape[1:]
+        cl = jax.tree_util.tree_leaves(params_local)[0].shape[0] // V
+        buf0 = _varying(jnp.zeros((V, M) + mb_shape, xv.dtype))
+        out0 = _varying(jnp.zeros_like(mb))
+        ysend0 = _varying(jnp.zeros(mb_shape, xv.dtype))
+        zero_nd = (0,) * len(mb_shape)
+
+        def tick(carry, t):
+            buf, out_buf, ysend = carry
+            # 1) receive last tick's hop on the ring
+            recv = jax.lax.ppermute(ysend, axis_name, perm_ring)
+            stv, stm, stc = jt["stv"][t, idx], jt["stm"][t, idx], jt["stc"][t, idx]
+            cur = jax.lax.dynamic_slice(buf, (stc, stm) + zero_nd,
+                                        (1, 1) + mb_shape)[0, 0]
+            buf = jax.lax.dynamic_update_slice(
+                buf, jnp.where(stv == 1, recv, cur)[None, None],
+                (stc, stm) + zero_nd)
+            # 2) compute this tick's chunk (idle devices run on garbage;
+            #    consumers are gated by the tables so it never escapes)
+            w, m, c = jt["work"][t, idx], jt["mb"][t, idx], jt["ch"][t, idx]
+            x_direct = jax.lax.dynamic_index_in_dim(mb, m, 0, keepdims=False)
+            x_buf = jax.lax.dynamic_slice(buf, (c, m) + zero_nd,
+                                          (1, 1) + mb_shape)[0, 0]
+            x_in = jnp.where(jnp.logical_and(idx == 0, c == 0), x_direct, x_buf)
+            p_c = jax.tree_util.tree_map(
+                lambda v: jax.lax.dynamic_slice_in_dim(v, c * cl, cl, 0),
+                params_local)
+            y = stage_fn(p_c, x_in)
+            # 3) final-virtual-stage outputs land in the output buffer
+            out_cur = jax.lax.dynamic_index_in_dim(out_buf, m, 0, keepdims=False)
+            is_out = jnp.logical_and(w == 1, jt["out"][t, idx] == 1)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_out, y, out_cur), m, 0)
+            return (buf, out_buf, y), None
+
+        (_, out_buf, _), _ = jax.lax.scan(tick, (buf0, out0, ysend0),
+                                          jnp.arange(T))
+        # final virtual stage SV-1 lives on device S-1
+        out_buf = jnp.where(idx == S - 1, out_buf, jnp.zeros_like(out_buf))
+        out_buf = jax.lax.psum(out_buf, axis_name)
+        return out_buf.reshape(xv.shape[:1] + out_buf.shape[2:])
+
+    return local_fn
+
+
+def _interleave_perm(n_layers, n_stages, virtual):
+    """Permutation mapping contiguous [L] layers to the interleaved
+    device-major layout: device d holds (in order) the layers of virtual
+    stages d, S+d, 2S+d, … so a plain 'pp'-sharding of dim 0 gives each
+    device its V chunks contiguously."""
+    cl = n_layers // (n_stages * virtual)
+    perm = []
+    for d in range(n_stages):
+        for c in range(virtual):
+            v = c * n_stages + d
+            perm.extend(range(v * cl, (v + 1) * cl))
+    return np.asarray(perm, np.int32)
+
+
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatch, mesh=None,
-                   axis_name="pp", param_specs=None, schedule="gpipe"):
+                   axis_name="pp", param_specs=None, schedule="gpipe",
+                   virtual=2, pre_permuted=False):
     """Run layers stacked on leading dim through a pipeline schedule.
 
     stage_fn(local_params, x) -> y   applies this stage's layer slice
     stacked_params: pytree, leaves [L_total, ...], sharded over 'pp' on dim 0
     x: [B, ...] activations (replicated w.r.t. 'pp')
-    schedule: "gpipe" (autodiff backward) or "1f1b" (recompute backward
-              with 1F1B activation liveness)
+    schedule: "gpipe" (autodiff backward), "1f1b" (recompute backward
+              with 1F1B activation liveness), or "interleaved" (virtual
+              pipeline stages — `virtual` chunks per device)
+    virtual: chunks per device for schedule="interleaved"
+    pre_permuted: the caller already stores stacked_params in the
+              interleaved device-major layout (_interleave_perm), so the
+              compiled step does zero layer resharding. When False the
+              permutation happens here via jnp.take — correct, but it
+              costs an all-to-all of the whole layer stack every step;
+              long-lived models should permute their storage once instead
+              (see GPTStacked).
     """
     from .mesh import get_mesh
 
@@ -166,9 +324,21 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatch, mesh=None,
         local_fn = _1f1b_local(stage_fn, n_micro, n_stages, axis_name)
     elif schedule == "gpipe":
         local_fn = _gpipe_local(stage_fn, n_micro, n_stages, axis_name)
+    elif schedule == "interleaved":
+        L_total = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        if virtual <= 1 or L_total % (n_stages * virtual):
+            raise ValueError(
+                f"interleaved schedule needs layers ({L_total}) divisible by "
+                f"pp*virtual ({n_stages}*{virtual}) and virtual>1")
+        if not pre_permuted:
+            perm = jnp.asarray(_interleave_perm(L_total, n_stages, virtual))
+            stacked_params = jax.tree_util.tree_map(
+                lambda v: jnp.take(v, perm, axis=0), stacked_params)
+        local_fn = _interleaved_local(stage_fn, n_micro, n_stages, virtual,
+                                      axis_name)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
-                         "(want 'gpipe' or '1f1b')")
+                         "(want 'gpipe', '1f1b' or 'interleaved')")
 
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(

@@ -316,14 +316,22 @@ class GPTStacked(Layer):
     rendering of reference fleet meta_parallel/pipeline_parallel.py.
     Attention uses the jnp path (GSPMD-sharded); dropout is not applied
     inside stacked blocks.
+
+    With pp_schedule="interleaved" the layer stack is stored in virtual-
+    chunk schedule order (permuted once at construction, so the compiled
+    step never reshards it). Checkpoints saved from such a model are in
+    that order: load them only into a model built with the same pp degree
+    and pp_virtual, or convert rows via layer_storage_order(). Running the
+    model under a different mesh raises.
     """
 
     def __init__(self, cfg: GPTConfig, pp_microbatches: int = 4,
-                 pp_schedule: str = "1f1b"):
+                 pp_schedule: str = "1f1b", pp_virtual: int = 2):
         super().__init__()
         self.cfg = cfg
         self.pp_microbatches = pp_microbatches
         self.pp_schedule = pp_schedule
+        self.pp_virtual = pp_virtual
         h, f, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers
         init = Normal(0.0, cfg.init_std)
         out_init = Normal(0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers))
@@ -352,6 +360,35 @@ class GPTStacked(Layer):
         mk("fc1_b", [L, f], zero, ("pp", "tp"))
         mk("fc2_w", [L, f, h], out_init, ("pp", "tp", None))
         mk("fc2_b", [L, h], zero, ("pp", None))
+
+        # Interleaved schedule: store the layer stack in the device-major
+        # virtual-chunk order ONCE, so the compiled step never reshards the
+        # whole stack (a per-step all-to-all otherwise). state_dict() then
+        # holds layers in schedule order; `layer_storage_order()` gives the
+        # original-index-of-row mapping for checkpoint conversion.
+        self._pp_perm = None
+        self._pp_perm_stages = None
+        if pp_schedule == "interleaved":
+            from ..distributed.mesh import get_mesh
+            from ..distributed.pipeline import _interleave_perm
+            mesh = get_mesh(create_default=False)
+            S = mesh.shape.get("pp", 1) if mesh is not None else 1
+            if S > 1 and L % (S * pp_virtual) == 0:
+                perm = _interleave_perm(L, S, pp_virtual)
+                for k in self._BLOCK_KEYS:
+                    p = self._parameters[k]
+                    p._value = jnp.take(p._value, jnp.asarray(perm), axis=0)
+                self._pp_perm = perm
+                self._pp_perm_stages = S
+
+    def layer_storage_order(self):
+        """Row i of every stacked parameter holds the weights of ORIGINAL
+        layer `layer_storage_order()[i]` (identity unless the interleaved
+        schedule permuted storage at construction)."""
+        import numpy as np
+        if self._pp_perm is None:
+            return np.arange(self.cfg.num_layers)
+        return np.asarray(self._pp_perm)
 
     _BLOCK_KEYS = ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
                    "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
@@ -403,6 +440,17 @@ class GPTStacked(Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         x = x.astype(cfg.dtype)
         mesh = get_mesh(create_default=False)
+        if self._pp_perm is not None:
+            # storage is baked in schedule order for a specific pp degree;
+            # running under any other mesh would apply layers out of order
+            pp_now = mesh.shape.get("pp", 1) if mesh is not None else 1
+            if pp_now != self._pp_perm_stages:
+                raise RuntimeError(
+                    f"GPTStacked was built with interleaved layer storage "
+                    f"for pp={self._pp_perm_stages}, but the current mesh "
+                    f"has pp={pp_now}. Rebuild the model under the target "
+                    f"mesh (see layer_storage_order() for checkpoint "
+                    f"conversion).")
         stacked_names = list(self._BLOCK_KEYS)
         stacked_tensors = [self._parameters[k] for k in stacked_names]
         n_micro = self.pp_microbatches
@@ -411,7 +459,9 @@ class GPTStacked(Layer):
             stacked = dict(zip(stacked_names, pvals))
             if mesh is not None and mesh.shape.get("pp", 1) > 1:
                 return pipeline_apply(self._stage_fn, stacked, xv, n_micro,
-                                      mesh=mesh, schedule=self.pp_schedule)
+                                      mesh=mesh, schedule=self.pp_schedule,
+                                      virtual=self.pp_virtual,
+                                      pre_permuted=self._pp_perm is not None)
             return self._stage_fn(stacked, xv)
 
         x = apply_op(run, x, *stacked_tensors)

@@ -18,6 +18,23 @@ try:
 except Exception:
     pass
 
+# Persistent XLA compilation cache: the suite is compile-bound (one CPU,
+# hundreds of jitted programs), so re-runs pick up every executable from
+# disk instead of recompiling. Must be configured BEFORE the first backend
+# touch or it is silently ignored. Gitignored; safe to delete any time;
+# set PADDLE_TPU_NO_COMPILE_CACHE=1 to opt out (e.g. after a CPU change).
+# The loader's machine-feature E-logs only flag scheduling-preference
+# pseudo-features (prefer-no-scatter/gather), not ISA differences.
+_cache_dir = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
+if not os.environ.get("PADDLE_TPU_NO_COMPILE_CACHE"):
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(_cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
 assert jax.default_backend() == "cpu"
 
 import pytest  # noqa: E402

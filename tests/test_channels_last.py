@@ -17,8 +17,14 @@ def channels_last():
 
 
 @pytest.mark.parametrize("ctor,size", [
-    (lambda: models.mobilenet_v2(num_classes=7), 32),
-    (lambda: models.vgg11(num_classes=7), 32),
+    # depthwise (mobilenet) and VGG stacks exercise the same layout
+    # machinery through many more unique conv shapes -> compile-heavy, so
+    # they ride the slow lane; resnet18 covers conv/bn/pool/linear daily
+    # and test_depthwise_conv_channels_last covers depthwise cheaply.
+    pytest.param(lambda: models.mobilenet_v2(num_classes=7), 32,
+                 marks=pytest.mark.slow),
+    pytest.param(lambda: models.vgg11(num_classes=7), 32,
+                 marks=pytest.mark.slow),
     (lambda: models.resnet18(num_classes=7), 32),
 ])
 def test_channels_last_matches_channels_first(ctor, size, channels_last):
@@ -52,6 +58,26 @@ def test_unpool_channels_last(channels_last):
     rec_c = F.max_unpool2d(out_c, mask_c, 2, 2)
     np.testing.assert_allclose(np.transpose(rec.numpy(), (0, 3, 1, 2)),
                                rec_c.numpy(), atol=1e-6)
+
+
+def test_depthwise_conv_channels_last(channels_last):
+    """Depthwise conv (groups == channels, the mobilenet building block)
+    matches between layouts without compiling a whole mobilenet."""
+    paddle.seed(3)
+    conv_l = nn.Conv2D(8, 8, 3, groups=8, padding=1)
+    bn_l = nn.BatchNorm2D(8)
+    nn.set_channels_last(False)
+    paddle.seed(3)
+    conv_f = nn.Conv2D(8, 8, 3, groups=8, padding=1)
+    bn_f = nn.BatchNorm2D(8)
+    conv_f.set_state_dict(conv_l.state_dict())
+    bn_f.set_state_dict(bn_l.state_dict())
+    bn_l.eval(); bn_f.eval()
+    x = np.random.RandomState(0).randn(2, 12, 12, 8).astype("float32")
+    out_l = bn_l(conv_l(paddle.to_tensor(x)))
+    out_f = bn_f(conv_f(paddle.to_tensor(np.transpose(x, (0, 3, 1, 2)))))
+    np.testing.assert_allclose(np.transpose(out_l.numpy(), (0, 3, 1, 2)),
+                               out_f.numpy(), rtol=1e-4, atol=1e-4)
 
 
 def test_explicit_data_format_wins(channels_last):
