@@ -185,6 +185,43 @@ def run_bert_base(batch_size=32, seq_len=512, steps=10):
     return seqs_s, mfu
 
 
+def run_yolov3(batch_size=16, size=320, steps=10):
+    """BASELINE.json config 4: PP-OCR/detection family — YOLOv3-DarkNet53
+    train step, imgs/sec/chip."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.vision.models import yolov3_darknet53
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = yolov3_darknet53(num_classes=80, data_format="NHWC")
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    weight_decay=5e-4)
+
+    def loss_fn(m, b):
+        outs = m(paddle.to_tensor(b["image"]))
+        return m.loss(outs, paddle.to_tensor(b["gt_box"]),
+                      paddle.to_tensor(b["gt_label"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    nb = 8
+    batch = {"image": rng.randn(batch_size, size, size, 3).astype("float32"),
+             "gt_box": np.clip(rng.rand(batch_size, nb, 4) * 0.5 + 0.1, 0, 1)
+             .astype("float32"),
+             "gt_label": rng.randint(0, 80, (batch_size, nb)).astype("int32")}
+    batch = _stage(batch)
+    dt = _measure(trainer, batch, steps, "yolov3")
+    imgs_s = batch_size / dt
+    log(f"yolov3: {dt*1e3:.1f} ms/step, {imgs_s:.0f} imgs/s")
+    return imgs_s
+
+
 def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
     """BASELINE.json config 5: GPT-MoE (top-2 routed experts), tokens/s/chip.
     Single-chip: measures the dispatch/combine einsums + expert FFs; the ep
@@ -291,6 +328,13 @@ def main():
         except Exception as e:
             log(f"bert bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["bert_base_error"] = str(e)[:160]
+    if only in (None, "yolo"):
+        try:
+            imgs_s = run_yolov3()
+            extras["yolov3_imgs_per_sec_per_chip"] = round(imgs_s, 1)
+        except Exception as e:
+            log(f"yolov3 bench failed: {type(e).__name__}: {str(e)[:300]}")
+            extras["yolov3_error"] = str(e)[:160]
     if only in (None, "moe"):
         try:
             tok_s = run_gpt_moe()

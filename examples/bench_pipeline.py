@@ -51,7 +51,8 @@ def main():
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / 5
         lowered = trainer._step_fn.lower(
-            trainer.params, trainer.opt_state, trainer.consts, 1e-3,
+            trainer.params, trainer.opt_state, trainer.gt_state,
+            trainer.consts, 1e-3,
             {k: jnp.asarray(v) for k, v in batch.items()})
         ma = lowered.compile().memory_analysis()
         temp = getattr(ma, "temp_size_in_bytes", 0)

@@ -184,8 +184,8 @@ class Engine:
         import jax.numpy as jnp
         t = self._trainer
         b = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()}
-        lowered = t._step_fn.lower(t.params, t.opt_state, t.consts,
-                                   self.optimizer.get_lr(), b)
+        lowered = t._step_fn.lower(t.params, t.opt_state, t.gt_state,
+                                   t.consts, self.optimizer.get_lr(), b)
         return lowered.as_text()
 
     # -- loops ------------------------------------------------------------

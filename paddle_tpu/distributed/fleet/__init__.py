@@ -42,6 +42,8 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.dgc = False
+        self.dgc_configs = {}
+        self.fp16_allreduce = False
         self.lamb = False
         self.lars = False
         self.lars_configs = {}

@@ -77,12 +77,19 @@ class Trainer:
     """
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, donate=True,
-                 grad_accum_steps=1):
+                 grad_accum_steps=1, grad_transform=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or get_mesh()
         self.grad_accum_steps = grad_accum_steps
+        # grad_transform(grads, state) -> (grads, state): gradient
+        # compression/filtering between backward and the optimizer (DGC
+        # error-feedback sparsification, bf16 cast, custom clipping) —
+        # reference fleet meta_optimizers dgc/fp16_allreduce. State (e.g.
+        # DGC residuals) is carried inside the compiled step, donated like
+        # optimizer slots.
+        self.grad_transform = grad_transform
         self._plan = plan_shardings(model, self.mesh)
 
         trainable, consts = {}, {}
@@ -95,6 +102,11 @@ class Trainer:
         self.consts = consts
         # slots inherit param shardings: zeros_like under jit keeps sharding
         self.opt_state = jax.jit(optimizer.init_state_pytree)(self.params)
+        if self.grad_transform is not None and \
+                hasattr(self.grad_transform, "init_state"):
+            self.gt_state = jax.jit(self.grad_transform.init_state)(self.params)
+        else:
+            self.gt_state = None
         self._step_fn = self._build(donate)
         self._host_step = 0
 
@@ -104,7 +116,9 @@ class Trainer:
 
         compute_loss = make_compute_loss(model, loss_fn)
 
-        def step(params, opt_state, consts, lr, batch):
+        grad_transform = self.grad_transform
+
+        def step(params, opt_state, gt_state, consts, lr, batch):
             if accum <= 1:
                 (loss_v, buf_updates), grads = jax.value_and_grad(
                     compute_loss, has_aux=True)(params, consts, batch)
@@ -131,18 +145,21 @@ class Trainer:
                 # per-microbatch stat updates all start from the same consts;
                 # carry the last microbatch's
                 buf_updates = jax.tree_util.tree_map(lambda v: v[-1], bus)
+            if grad_transform is not None:
+                grads, gt_state = grad_transform(grads, gt_state)
             new_params, new_state = optimizer.apply_gradients_pytree(
                 params, grads, opt_state, lr)
             new_consts = {**consts, **buf_updates}
-            return new_params, new_state, new_consts, loss_v
+            return new_params, new_state, gt_state, new_consts, loss_v
 
-        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3) if donate else ())
 
     def step(self, batch, lr=None):
         lr = self.optimizer.get_lr() if lr is None else lr
         batch = batch_to_arrays(batch)
-        self.params, self.opt_state, self.consts, loss = self._step_fn(
-            self.params, self.opt_state, self.consts, lr, batch)
+        (self.params, self.opt_state, self.gt_state, self.consts,
+         loss) = self._step_fn(
+            self.params, self.opt_state, self.gt_state, self.consts, lr, batch)
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()

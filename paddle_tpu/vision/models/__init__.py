@@ -51,3 +51,15 @@ from .resnet import (  # noqa: F401
     wide_resnet101_2,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .detection import (  # noqa: F401
+    DarkNet53,
+    YOLOv3,
+    yolov3_darknet53,
+    yolov3_tiny,
+)
+from .ocr import (  # noqa: F401
+    CRNN,
+    DBNet,
+    crnn_mobilenet,
+    dbnet_mobilenet,
+)

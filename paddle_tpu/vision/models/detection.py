@@ -1,0 +1,217 @@
+"""YOLOv3-family detectors (BASELINE config "PP-YOLOE / detection").
+
+Reference scope: PaddleDetection's YOLOv3 (DarkNet53 backbone + FPN neck +
+per-scale heads) built on the yolo_loss / yolo_box / nms PHI ops that this
+repo re-implements in paddle_tpu/vision/ops.py. The model here is an
+original TPU-first build: Conv+BN+LeakyReLU blocks run NHWC by default
+(channels on the lane dim — see docs/performance.md), heads emit the
+NCHW [N, A*(5+C), H, W] tensors the yolo ops expect, and the whole
+train step jits into one XLA program.
+
+    model = yolov3_darknet53(num_classes=80)
+    losses = model.loss(model(imgs), gt_box, gt_label)     # train
+    boxes, scores = model.decode(model(imgs), img_size)    # eval
+"""
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.core import apply_op
+from ...nn.layout import resolve_data_format
+from ...tensor.manipulation import concat
+
+__all__ = ["YOLOv3", "yolov3_darknet53", "yolov3_tiny", "DarkNet53"]
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, data_format="NCHW"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                              bias_attr=False, data_format=data_format)
+        self.bn = nn.BatchNorm2D(cout, data_format=data_format)
+        self.act = nn.LeakyReLU(0.1)
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _Residual(nn.Layer):
+    def __init__(self, ch, data_format="NCHW"):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch // 2, k=1, data_format=data_format)
+        self.conv2 = ConvBNLayer(ch // 2, ch, k=3, data_format=data_format)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class DarkNet53(nn.Layer):
+    """DarkNet-53 backbone; returns C3, C4, C5 feature maps (stride 8/16/32).
+
+    stages: 1-2-8-8-4 residual blocks, downsample by stride-2 3x3 convs.
+    """
+
+    def __init__(self, data_format="NCHW", depths=(1, 2, 8, 8, 4), width=32):
+        super().__init__()
+        df = data_format
+        w = width
+        self.stem = ConvBNLayer(3, w, data_format=df)
+        stages = []
+        cin = w
+        for i, n in enumerate(depths):
+            cout = w * (2 ** (i + 1))
+            blocks = [ConvBNLayer(cin, cout, stride=2, data_format=df)]
+            blocks += [_Residual(cout, data_format=df) for _ in range(n)]
+            stages.append(nn.Sequential(*blocks))
+            cin = cout
+        self.stages = nn.LayerList(stages)
+        self.out_channels = [w * 8, w * 16, w * 32]
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for i, stage in enumerate(self.stages):
+            x = stage(x)
+            if i >= 2:
+                feats.append(x)
+        return feats        # [C3, C4, C5]
+
+
+class _YoloDetBlock(nn.Layer):
+    """5-conv FPN block: returns (route, tip)."""
+
+    def __init__(self, cin, ch, data_format="NCHW"):
+        super().__init__()
+        df = data_format
+        self.convs = nn.Sequential(
+            ConvBNLayer(cin, ch, k=1, data_format=df),
+            ConvBNLayer(ch, ch * 2, k=3, data_format=df),
+            ConvBNLayer(ch * 2, ch, k=1, data_format=df),
+            ConvBNLayer(ch, ch * 2, k=3, data_format=df),
+            ConvBNLayer(ch * 2, ch, k=1, data_format=df),
+        )
+        self.tip = ConvBNLayer(ch, ch * 2, k=3, data_format=df)
+
+    def forward(self, x):
+        route = self.convs(x)
+        return route, self.tip(route)
+
+
+_COCO_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+                 59, 119, 116, 90, 156, 198, 373, 326]
+_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]   # P5, P4, P3
+
+
+class YOLOv3(nn.Layer):
+    """YOLOv3 head over a 3-scale backbone.
+
+    forward(imgs) -> [p5, p4, p3] raw head outputs, each NCHW
+    [N, A*(5+C), H, W] regardless of compute layout (the yolo ops'
+    contract). loss() / decode() wrap vision.ops.yolo_loss / yolo_box+nms.
+    """
+
+    def __init__(self, backbone=None, num_classes=80,
+                 anchors=_COCO_ANCHORS, anchor_masks=_ANCHOR_MASKS,
+                 ignore_thresh=0.7, data_format=None):
+        super().__init__()
+        df = resolve_data_format(data_format, 2)
+        self.data_format = df
+        self.backbone = backbone or DarkNet53(data_format=df)
+        self.num_classes = num_classes
+        self.anchors = list(anchors)
+        self.anchor_masks = [list(m) for m in anchor_masks]
+        self.ignore_thresh = ignore_thresh
+        covered = {a for m in self.anchor_masks for a in m}
+        if covered != set(range(len(self.anchors) // 2)):
+            raise ValueError(
+                f"anchor_masks {self.anchor_masks} must cover every anchor "
+                f"0..{len(self.anchors) // 2 - 1}: ground-truth boxes whose "
+                "best-IoU anchor is unlisted would get no supervision")
+        chans = self.backbone.out_channels          # [C3, C4, C5]
+        n_scales = len(anchor_masks)
+        blocks, outs, routes = [], [], []
+        cin = chans[-1]
+        for i in range(n_scales):
+            ch = 512 // (2 ** i)
+            block = _YoloDetBlock(cin, ch, data_format=df)
+            na = len(anchor_masks[i])
+            out = nn.Conv2D(ch * 2, na * (5 + num_classes), 1,
+                            data_format=df)
+            blocks.append(block)
+            outs.append(out)
+            if i < n_scales - 1:
+                routes.append(ConvBNLayer(ch, ch // 2, k=1, data_format=df))
+                cin = ch // 2 + chans[-2 - i]
+        self.blocks = nn.LayerList(blocks)
+        self.outs = nn.LayerList(outs)
+        self.routes = nn.LayerList(routes)
+
+    def forward(self, x):
+        feats = self.backbone(x)          # [C3, C4, C5]
+        outputs = []
+        route = None
+        # deepest-first, only as many scales as the head defines
+        feats_rev = list(reversed(feats))[:len(self.blocks)]
+        for i, feat in enumerate(feats_rev):            # C5, C4, (C3)
+            if route is not None:
+                feat = concat([route, feat],
+                              axis=3 if self.data_format == "NHWC" else 1)
+            route, tip = self.blocks[i](feat)
+            head = self.outs[i](tip)
+            if self.data_format == "NHWC":
+                head = apply_op(lambda v: jnp.transpose(v, (0, 3, 1, 2)),
+                                head)
+            outputs.append(head)
+            if i < len(self.blocks) - 1:
+                route = self.routes[i](route)
+                route = nn.functional.interpolate(
+                    route, scale_factor=2, mode="nearest",
+                    data_format=self.data_format)
+        return outputs                    # [P5, P4, P3] NCHW
+
+    def loss(self, outputs, gt_box, gt_label, gt_score=None):
+        from ...vision.ops import yolo_loss
+        total = None
+        for i, out in enumerate(outputs):
+            l = yolo_loss(out, gt_box, gt_label, self.anchors,
+                          self.anchor_masks[i], self.num_classes,
+                          ignore_thresh=self.ignore_thresh,
+                          downsample_ratio=32 // (2 ** i),
+                          gt_score=gt_score)
+            l = l.mean()
+            total = l if total is None else total + l
+        return total
+
+    def decode(self, outputs, img_size, conf_thresh=0.01):
+        """Returns (boxes [N, M, 4], scores [N, M, C]) after per-scale
+        yolo_box decode + concat; run vision.ops.nms on each image's
+        boxes/scores for final detections (host-side, variable length)."""
+        from ...vision.ops import yolo_box
+        boxes, scores = [], []
+        for i, out in enumerate(outputs):
+            b, s = yolo_box(out, img_size, self._scale_anchors(i),
+                            self.num_classes, conf_thresh,
+                            downsample_ratio=32 // (2 ** i))
+            boxes.append(b)
+            scores.append(s)
+        return concat(boxes, axis=1), concat(scores, axis=1)
+
+    def _scale_anchors(self, i):
+        flat = []
+        for a in self.anchor_masks[i]:
+            flat += self.anchors[2 * a: 2 * a + 2]
+        return flat
+
+
+def yolov3_darknet53(num_classes=80, **kw):
+    return YOLOv3(num_classes=num_classes, **kw)
+
+
+def yolov3_tiny(num_classes=20, **kw):
+    """Small variant for tests / CPU smoke: thin darknet, 2 scales, the
+    6-anchor tiny set (every anchor reachable from one of the two masks)."""
+    df = resolve_data_format(kw.pop("data_format", None), 2)
+    backbone = DarkNet53(data_format=df, depths=(1, 1, 2, 2, 1), width=8)
+    tiny_anchors = [10, 14, 23, 27, 37, 58, 81, 82, 135, 169, 344, 319]
+    return YOLOv3(backbone=backbone, num_classes=num_classes,
+                  anchors=tiny_anchors,
+                  anchor_masks=[[3, 4, 5], [0, 1, 2]], data_format=df, **kw)

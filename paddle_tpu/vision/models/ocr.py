@@ -1,0 +1,182 @@
+"""PP-OCR-style text models (BASELINE config "PP-OCRv3 / OCR pipeline").
+
+Reference scope: PaddleOCR's recognition (CRNN: conv backbone -> sequence
+encoder -> CTC head) and detection (DB: FPN + binarization map) recipes,
+re-built TPU-first on this repo's layers: convs run NHWC-capable, the
+BiLSTM encoder is the lax.scan RNN stack, and CTC training uses the
+log-space forward algorithm in nn.functional.ctc_loss — everything jits
+into a single XLA program per step.
+
+    rec = CRNN(num_classes=97)                  # charset + blank
+    logits = rec(imgs)                          # [T, N, C] for CTC
+    loss = rec.loss(logits, labels, label_lengths)
+
+    det = DBNet()                               # text detection
+    prob = det(imgs)                            # [N, 1, H, W] shrink map
+    loss = det.loss(prob, gt_map, gt_mask)
+"""
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.core import apply_op
+from ...nn.layout import resolve_data_format
+
+__all__ = ["CRNN", "DBNet", "crnn_mobilenet", "dbnet_mobilenet"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, padding=None,
+                 data_format="NCHW"):
+        super().__init__()
+        if padding is None:
+            padding = k // 2 if isinstance(k, int) else 0
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              bias_attr=False, data_format=data_format)
+        self.bn = nn.BatchNorm2D(cout, data_format=data_format)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class CRNN(nn.Layer):
+    """Conv stack (height collapsed to 1) -> 2-layer BiLSTM -> CTC logits.
+
+    Input images [N, 3, 32, W] (or NHWC); output [W/4, N, num_classes]
+    in the [T, N, C] layout nn.functional.ctc_loss expects. Class 0 is
+    the CTC blank (PaddleOCR convention).
+    """
+
+    def __init__(self, num_classes=97, hidden_size=96, data_format=None):
+        super().__init__()
+        df = resolve_data_format(data_format, 2)
+        self.data_format = df
+        self.num_classes = num_classes
+        # height/width strides: H 32 -> 1, W -> W/4
+        self.body = nn.Sequential(
+            _ConvBN(3, 32, data_format=df),
+            nn.MaxPool2D(2, 2, data_format=df),              # 16 x W/2
+            _ConvBN(32, 64, data_format=df),
+            nn.MaxPool2D(2, 2, data_format=df),              # 8 x W/4
+            _ConvBN(64, 128, data_format=df),
+            _ConvBN(128, 128, data_format=df),
+            nn.MaxPool2D(kernel_size=(2, 1), stride=(2, 1),
+                         data_format=df),                    # 4 x W/4
+            _ConvBN(128, 256, data_format=df),
+            nn.MaxPool2D(kernel_size=(2, 1), stride=(2, 1),
+                         data_format=df),                    # 2 x W/4
+            # (2,1) kernel, no padding: collapses H 2->1, W untouched, so
+            # the sequence length is exactly W/4 as documented
+            _ConvBN(256, 256, k=(2, 1), stride=1, padding=0, data_format=df),
+        )
+        self.rnn = nn.LSTM(256, hidden_size, num_layers=2,
+                           direction="bidirect", time_major=True)
+        self.head = nn.Linear(2 * hidden_size, num_classes)
+
+    def forward(self, x):
+        feat = self.body(x)
+        if self.data_format == "NHWC":
+            # [N, 1, W', C] -> [W', N, C]
+            seq = apply_op(lambda v: jnp.transpose(v[:, 0], (1, 0, 2)), feat)
+        else:
+            # [N, C, 1, W'] -> [W', N, C]
+            seq = apply_op(lambda v: jnp.transpose(v[:, :, 0], (2, 0, 1)),
+                           feat)
+        out, _ = self.rnn(seq)
+        return self.head(out)                   # [T, N, num_classes]
+
+    def loss(self, logits, labels, label_lengths):
+        """CTC loss; input lengths are the full T (no horizontal padding
+        convention in the synthetic pipeline)."""
+        from ...tensor.creation import full
+        T, N = logits.shape[0], logits.shape[1]
+        input_lengths = full([N], T, dtype="int32")
+        return nn.functional.ctc_loss(logits, labels, input_lengths,
+                                      label_lengths, blank=0)
+
+    def decode_greedy(self, logits):
+        """Collapse-repeats-then-drop-blanks greedy CTC decode. Returns
+        [N, T] int32 with -1 padding (host-side trim to strings)."""
+        def _f(lp):
+            ids = jnp.argmax(lp, axis=-1).T                  # [N, T]
+            prev = jnp.pad(ids[:, :-1], ((0, 0), (1, 0)),
+                           constant_values=-1)
+            keep = (ids != prev) & (ids != 0)
+            order = jnp.argsort(~keep, axis=1, stable=True)  # keepers first
+            gathered = jnp.take_along_axis(ids, order, axis=1)
+            kept = jnp.take_along_axis(keep, order, axis=1)
+            return jnp.where(kept, gathered, -1).astype(jnp.int32)
+        return apply_op(_f, logits)
+
+
+class DBNet(nn.Layer):
+    """Differentiable-Binarization-style text detector (PaddleOCR det).
+
+    Light FPN over a 4-stage conv backbone; emits a shrink-probability map
+    at 1/4 resolution upsampled to input size. loss() is the DB recipe's
+    BCE on the probability map under a supervision mask (the threshold/
+    binarization branches collapse into the single map here — the
+    inference contract, box extraction from the prob map, is host-side).
+    """
+
+    def __init__(self, width=24, data_format=None):
+        super().__init__()
+        df = resolve_data_format(data_format, 2)
+        self.data_format = df
+        w = width
+        self.stem = _ConvBN(3, w, data_format=df)
+        self.stages = nn.LayerList([
+            nn.Sequential(_ConvBN(w, w * 2, stride=2, data_format=df),
+                          _ConvBN(w * 2, w * 2, data_format=df)),
+            nn.Sequential(_ConvBN(w * 2, w * 4, stride=2, data_format=df),
+                          _ConvBN(w * 4, w * 4, data_format=df)),
+            nn.Sequential(_ConvBN(w * 4, w * 8, stride=2, data_format=df),
+                          _ConvBN(w * 8, w * 8, data_format=df)),
+            nn.Sequential(_ConvBN(w * 8, w * 8, stride=2, data_format=df),
+                          _ConvBN(w * 8, w * 8, data_format=df)),
+        ])
+        self.laterals = nn.LayerList([
+            _ConvBN(c, w * 4, k=1, data_format=df)
+            for c in (w * 2, w * 4, w * 8, w * 8)])
+        self.out = nn.Conv2D(w * 4, 1, 3, padding=1, data_format=df)
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        # top-down sum with 2x upsampling
+        acc = self.laterals[-1](feats[-1])
+        for i in range(len(feats) - 2, -1, -1):
+            acc = nn.functional.interpolate(
+                acc, scale_factor=2, mode="nearest",
+                data_format=self.data_format)
+            acc = acc + self.laterals[i](feats[i])
+        prob = self.out(acc)                       # 1/2 input resolution
+        prob = nn.functional.interpolate(
+            prob, scale_factor=2, mode="bilinear",
+            data_format=self.data_format)
+        if self.data_format == "NHWC":
+            prob = apply_op(lambda v: jnp.transpose(v, (0, 3, 1, 2)), prob)
+        return nn.functional.sigmoid(prob)         # [N, 1, H, W]
+
+    def loss(self, prob, gt_map, mask=None, eps=1e-6):
+        """Masked balanced BCE on the shrink map (DB loss's L_s term)."""
+        def _f(p, g, m):
+            p = jnp.clip(p, eps, 1 - eps)
+            bce = -(g * jnp.log(p) + (1 - g) * jnp.log(1 - p))
+            if m is None:
+                return jnp.mean(bce)
+            return jnp.sum(bce * m) / jnp.maximum(jnp.sum(m), 1.0)
+        if mask is None:
+            return apply_op(lambda p, g: _f(p, g, None), prob, gt_map)
+        return apply_op(_f, prob, gt_map, mask)
+
+
+def crnn_mobilenet(num_classes=97, **kw):
+    return CRNN(num_classes=num_classes, **kw)
+
+
+def dbnet_mobilenet(**kw):
+    return DBNet(**kw)

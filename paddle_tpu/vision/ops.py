@@ -213,8 +213,12 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
         tx = gx * w - gi
         ty = gy * h - gj
-        tw = jnp.log(jnp.maximum(gw_pix, 1e-9) / jnp.maximum(an[:, 0][a_idx], 1e-9))
-        th = jnp.log(jnp.maximum(gh_pix, 1e-9) / jnp.maximum(an[:, 1][a_idx], 1e-9))
+        # jnp.take: a_idx may be a tracer (jitted training step) and numpy
+        # fancy-indexing would force a concrete conversion
+        an_w = jnp.take(jnp.asarray(an[:, 0]), a_idx)
+        an_h = jnp.take(jnp.asarray(an[:, 1]), a_idx)
+        tw = jnp.log(jnp.maximum(gw_pix, 1e-9) / jnp.maximum(an_w, 1e-9))
+        th = jnp.log(jnp.maximum(gh_pix, 1e-9) / jnp.maximum(an_h, 1e-9))
         box_scale = 2.0 - gw * gh
         score_w = gscore if gscore is not None else jnp.ones_like(gx)
         bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))

@@ -1,0 +1,77 @@
+"""Gradient compression transforms (reference fleet meta_optimizers
+dgc_optimizer / fp16_allreduce_optimizer) through Trainer(grad_transform=)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import DGCCompressor, bf16_compress, build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+
+
+def _setup(seed=0):
+    paddle.seed(seed)
+    build_mesh(dp=1)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.Tanh(), paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    rng = np.random.RandomState(seed)
+    batch = {"x": rng.randn(8, 16).astype("float32"),
+             "y": rng.randint(0, 4, (8,)).astype("int64")}
+
+    def loss_fn(m, b):
+        return paddle.nn.functional.cross_entropy(
+            m(paddle.to_tensor(b["x"])), paddle.to_tensor(b["y"]))
+
+    return model, opt, loss_fn, batch
+
+
+def test_dgc_trains_and_keeps_residual_state():
+    model, opt, loss_fn, batch = _setup()
+    dgc = DGCCompressor(sparsity=0.9, momentum=0.9)
+    trainer = Trainer(model, opt, loss_fn, grad_transform=dgc)
+    losses = [float(trainer.step(batch)) for _ in range(25)]
+    assert losses[-1] < losses[0], losses
+    # residual state exists and is nonzero (error feedback is live)
+    v_norm = sum(float(abs(v).sum()) for v in
+                 __import__("jax").tree_util.tree_leaves(trainer.gt_state["v"]))
+    assert v_norm > 0
+
+
+def test_dgc_sends_only_topk_mass():
+    import jax
+    import jax.numpy as jnp
+    dgc = DGCCompressor(sparsity=0.75, momentum=0.0)
+    grads = {"w": jnp.asarray(np.arange(1, 17, dtype=np.float32).reshape(4, 4))}
+    state = dgc.init_state(grads)
+    send, state = dgc(grads, state)
+    nz = int((send["w"] != 0).sum())
+    assert nz == 4                       # 25% of 16
+    # dropped mass accumulated in v, drains next step
+    assert float(jnp.abs(state["v"]["w"]).sum()) > 0
+    send2, _ = dgc(jax.tree_util.tree_map(jnp.zeros_like, grads), state)
+    assert float(jnp.abs(send2["w"]).sum()) > 0
+
+
+def test_bf16_compress_close_to_fp32():
+    model, opt, loss_fn, batch = _setup(1)
+    t_plain = Trainer(model, opt, loss_fn)
+    ref = [float(t_plain.step(batch)) for _ in range(5)]
+
+    model2, opt2, loss_fn2, _ = _setup(1)
+    t_bf16 = Trainer(model2, opt2, loss_fn2, grad_transform=bf16_compress)
+    got = [float(t_bf16.step(batch)) for _ in range(5)]
+    np.testing.assert_allclose(got, ref, rtol=2e-2)
+
+
+def test_strategy_builds_transform():
+    from paddle_tpu.distributed.compression import from_strategy
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    s = DistributedStrategy()
+    assert from_strategy(s) is None
+    s.dgc = True
+    s.dgc_configs = {"sparsity": 0.5}
+    t = from_strategy(s)
+    assert isinstance(t, DGCCompressor) and t.sparsity == 0.5
+    s.dgc = False
+    s.fp16_allreduce = True
+    assert from_strategy(s) is bf16_compress
