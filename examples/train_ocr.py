@@ -1,0 +1,88 @@
+"""PP-OCR-style pipeline recipe: CRNN recognition (CTC) + DBNet detection.
+
+Synthetic-data rendering of the PaddleOCR rec_crnn / det_db training
+loops. The recognizer reads 32xW crops and emits one CTC distribution per
+W/4 column; the detector emits a shrink-probability map. Both train as one
+jitted step each.
+
+    python examples/train_ocr.py --task rec --steps 50
+    python examples/train_ocr.py --task det --steps 50
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.vision.models import CRNN, DBNet
+
+CHARSET = "0123456789abcdefghijklmnopqrstuvwxyz"   # + blank at id 0
+
+
+def rec_batches(batch_size, width=96, max_len=8, seed=0):
+    rng = np.random.RandomState(seed)
+    n_cls = len(CHARSET) + 1
+    while True:
+        lens = rng.randint(2, max_len + 1, batch_size)
+        labels = rng.randint(1, n_cls, (batch_size, max_len))
+        labels *= (np.arange(max_len)[None, :] < lens[:, None])
+        yield {"image": rng.randn(batch_size, 3, 32, width).astype("float32"),
+               "label": labels.astype("int32"),
+               "length": lens.astype("int32")}
+
+
+def det_batches(batch_size, size=128, seed=0):
+    rng = np.random.RandomState(seed)
+    while True:
+        gt = np.zeros((batch_size, 1, size, size), np.float32)
+        for i in range(batch_size):
+            x0, y0 = rng.randint(0, size // 2, 2)
+            w, h = rng.randint(size // 8, size // 2, 2)
+            gt[i, 0, y0:y0 + h, x0:x0 + w] = 1.0
+        yield {"image": rng.randn(batch_size, 3, size, size).astype("float32"),
+               "gt": gt}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", choices=["rec", "det"], default="rec")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    if args.task == "rec":
+        model = CRNN(num_classes=len(CHARSET) + 1)
+        batches = rec_batches(args.batch_size)
+
+        def loss_fn(m, b):
+            logits = m(paddle.to_tensor(b["image"]))
+            return m.loss(logits, paddle.to_tensor(b["label"]),
+                          paddle.to_tensor(b["length"]))
+    else:
+        model = DBNet()
+        batches = det_batches(args.batch_size)
+
+        def loss_fn(m, b):
+            prob = m(paddle.to_tensor(b["image"]))
+            return m.loss(prob, paddle.to_tensor(b["gt"]))
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    trainer = Trainer(model, opt, loss_fn)
+    for step in range(1, args.steps + 1):
+        loss = trainer.step(next(batches))
+        if step % 10 == 0 or step == 1:
+            print(f"step {step}: loss={float(loss):.4f}")
+    if args.task == "rec":
+        trainer.sync_to_model()
+        ids = model.decode_greedy(model(paddle.to_tensor(
+            next(batches)["image"])))
+        first = [CHARSET[i - 1] for i in np.asarray(ids.numpy())[0] if i > 0]
+        print("sample decode:", "".join(first) or "<empty>")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""YOLOv3 detection training recipe (BASELINE config: PP-YOLOE/detection).
+
+Synthetic-data variant of the PaddleDetection yolov3_darknet53_270e_coco
+recipe: NHWC layout for the MXU, bf16 compute, one jitted step (fwd +
+3-scale yolo_loss + momentum update). Swap `synthetic_batches` for a
+DataLoader over your dataset; boxes are [cx, cy, w, h] normalized, labels
+int32, both padded to `max_boxes` per image (pad with w=h=0).
+
+Measured single v5e chip, 320x320, bs16: ~504 imgs/s.
+
+    python examples/train_yolov3.py --steps 100 --batch-size 16
+"""
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.vision.models import yolov3_darknet53
+
+
+def synthetic_batches(batch_size, size, max_boxes=8, seed=0):
+    rng = np.random.RandomState(seed)
+    while True:
+        n_real = rng.randint(1, max_boxes + 1, batch_size)
+        wh = rng.uniform(0.05, 0.5, (batch_size, max_boxes, 2))
+        cxy = rng.uniform(0.2, 0.8, (batch_size, max_boxes, 2))
+        mask = np.arange(max_boxes)[None, :] < n_real[:, None]
+        boxes = np.concatenate([cxy, wh * mask[..., None]], -1)
+        yield {
+            "image": rng.randn(batch_size, size, size, 3).astype("float32"),
+            "gt_box": boxes.astype("float32"),
+            "gt_label": rng.randint(0, 80, (batch_size, max_boxes)).astype("int32"),
+        }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--size", type=int, default=320)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = yolov3_darknet53(num_classes=80, data_format="NHWC")
+    model.bfloat16()
+    model.train()
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(args.lr, args.steps)
+    opt = paddle.optimizer.Momentum(learning_rate=sched, momentum=0.9,
+                                    weight_decay=5e-4)
+
+    def loss_fn(m, b):
+        outs = m(paddle.to_tensor(b["image"]))
+        return m.loss(outs, paddle.to_tensor(b["gt_box"]),
+                      paddle.to_tensor(b["gt_label"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    it = synthetic_batches(args.batch_size, args.size)
+    t0 = time.time()
+    for step in range(1, args.steps + 1):
+        loss = trainer.step(next(it))
+        if step % 10 == 0 or step == 1:
+            dt = (time.time() - t0) / step
+            print(f"step {step}: loss={float(loss):.3f} "
+                  f"{args.batch_size / dt:.0f} imgs/s")
+
+
+if __name__ == "__main__":
+    main()

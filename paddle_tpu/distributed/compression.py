@@ -34,10 +34,12 @@ class DGCCompressor:
                  v = v + u              (error accumulation)
                  send = top-k(|v|)      (k = (1-sparsity) fraction)
                  v -= send              (error feedback)
+                 u = where(sent, 0, u)  (momentum factor masking)
     The optimizer sees `send`; everything else stays in v and drains over
-    later steps, so no gradient mass is lost (DGC paper / reference
-    dgc_optimizer semantics, minus the NCCL sparse-allreduce plumbing that
-    GSPMD makes unnecessary).
+    later steps, so no gradient mass is lost. Momentum factor masking
+    clears u at sent coordinates (DGC paper §3.2 / reference dgc op) so a
+    frequently-sent coordinate's velocity doesn't compound into an
+    over-weighted update.
     """
 
     def __init__(self, sparsity=0.99, momentum=0.9, min_k=1):
@@ -68,10 +70,13 @@ class DGCCompressor:
             k = max(self.min_k, int(n * (1.0 - self.sparsity)))
             if k >= n:
                 send = v
+                sent = jnp.ones_like(v, jnp.bool_)
             else:
                 thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
-                send = jnp.where(jnp.abs(v) >= thresh, v, 0.0)
+                sent = jnp.abs(v) >= thresh
+                send = jnp.where(sent, v, 0.0)
             v = v - send
+            u = jnp.where(sent, 0.0, u)     # momentum factor masking
             return send.astype(g.dtype), u, v
 
         outs = jax.tree_util.tree_map(leaf, grads, state["u"], state["v"])

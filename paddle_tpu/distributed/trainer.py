@@ -172,12 +172,21 @@ class Trainer:
         load_state_pytree(self.model, {**self.consts, **self.params})
 
     def state(self):
-        return {"params": self.params, "opt_state": self.opt_state,
-                "step": self._host_step}
+        """Host-side snapshot (numpy leaves). Device buffers are donated
+        into the next step(), so a live-array snapshot would be invalidated
+        the moment training continues."""
+        s = {"params": self.params, "opt_state": self.opt_state,
+             "step": self._host_step}
+        if self.gt_state is not None:   # grad-transform residuals (DGC u/v)
+            s["gt_state"] = self.gt_state
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_get(v) if hasattr(v, "dtype") else v, s)
 
     def load_state(self, state):
         self.params = jax.tree_util.tree_map(lambda t, v: jax.device_put(v, t.sharding)
                                              if hasattr(t, "sharding") else v,
                                              self.params, state["params"])
         self.opt_state = state["opt_state"]
+        if "gt_state" in state:
+            self.gt_state = state["gt_state"]
         self._host_step = int(state.get("step", 0))

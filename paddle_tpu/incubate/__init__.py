@@ -1,6 +1,6 @@
 """paddle_tpu.incubate — reference python/paddle/incubate (fused ops, MoE,
 checkpointing, ASP, segment/graph ops, LookAhead/ModelAverage)."""
-from . import asp, autograd, checkpoint, graph, nn, operators, optimizer, tensor  # noqa: F401
+from . import asp, autograd, autotune, checkpoint, graph, nn, operators, optimizer, tensor  # noqa: F401
 from .graph import graph_khop_sampler, graph_reindex, graph_sample_neighbors  # noqa: F401
 from .operators import (  # noqa: F401
     graph_send_recv,
@@ -17,6 +17,3 @@ __all__ = ["nn", "checkpoint", "autotune", "asp", "autograd", "operators", "opti
            "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage"]
 
 
-def autotune(config=None):
-    """XLA autotunes its own tilings; accepted for API parity."""
-    return None

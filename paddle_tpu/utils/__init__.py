@@ -97,3 +97,5 @@ from .checkpoint_convert import (  # noqa: F401,E402
     convert_checkpoint,
     load_reference_state_dict,
 )
+
+from . import dlpack  # noqa: F401,E402

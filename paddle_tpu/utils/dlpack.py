@@ -1,0 +1,33 @@
+"""paddle_tpu.utils.dlpack — reference python/paddle/utils/dlpack.py
+(to_dlpack/from_dlpack over the fluid core capsule API).
+
+Modern DLPack rides the `__dlpack__` protocol rather than bare PyCapsules:
+`to_dlpack` returns a zero-copy exporter object any consumer
+(torch.from_dlpack, np.from_dlpack, jax) accepts, and `from_dlpack`
+accepts any such exporter (torch/numpy/cupy tensors included).
+"""
+from ..framework.core import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack exporter (zero-copy view of the device buffer).
+
+    The returned object implements __dlpack__/__dlpack_device__; pass it
+    straight to torch.from_dlpack / numpy.from_dlpack / from_dlpack."""
+    import jax.numpy as jnp
+
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(ext):
+    """DLPack exporter (torch/numpy/cupy/jax array) -> Tensor, zero-copy
+    when the producer lives on a compatible device."""
+    import jax
+
+    if not hasattr(ext, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack expects an object implementing the DLPack protocol "
+            "(__dlpack__); pass the tensor itself, not a raw capsule")
+    return Tensor(jax.dlpack.from_dlpack(ext))

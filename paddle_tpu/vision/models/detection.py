@@ -131,7 +131,9 @@ class YOLOv3(nn.Layer):
         blocks, outs, routes = [], [], []
         cin = chans[-1]
         for i in range(n_scales):
-            ch = 512 // (2 ** i)
+            # head width follows the backbone (512/256/128 for DarkNet53,
+            # proportionally thinner for small backbones)
+            ch = max(chans[-1] // 2 // (2 ** i), 16)
             block = _YoloDetBlock(cin, ch, data_format=df)
             na = len(anchor_masks[i])
             out = nn.Conv2D(ch * 2, na * (5 + num_classes), 1,

@@ -75,3 +75,21 @@ def test_strategy_builds_transform():
     s.dgc = False
     s.fp16_allreduce = True
     assert from_strategy(s) is bf16_compress
+
+
+def test_dgc_state_survives_checkpoint_resume():
+    import jax
+    model, opt, loss_fn, batch = _setup(3)
+    dgc = DGCCompressor(sparsity=0.9)
+    t1 = Trainer(model, opt, loss_fn, grad_transform=dgc)
+    for _ in range(3):
+        t1.step(batch)
+    snap = t1.state()
+    assert "gt_state" in snap
+    ref = [float(t1.step(batch)) for _ in range(3)]
+
+    model2, opt2, loss_fn2, _ = _setup(3)
+    t2 = Trainer(model2, opt2, loss_fn2, grad_transform=DGCCompressor(sparsity=0.9))
+    t2.load_state(snap)
+    got = [float(t2.step(batch)) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
