@@ -39,6 +39,12 @@ from .parallel import (  # noqa: F401
     init_parallel_env,
 )
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
+from .planner_cost import (  # noqa: F401
+    ClusterSpec,
+    ModelStats,
+    gpt_stats,
+    search_mesh,
+)
 from .compression import DGCCompressor, bf16_compress  # noqa: F401
 from .localsgd import LocalSGDTrainer  # noqa: F401
 from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401

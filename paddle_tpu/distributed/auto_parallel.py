@@ -24,7 +24,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..framework.core import Tensor, apply_op
 from .mesh import build_mesh, get_mesh, set_mesh
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Planner", "Engine"]
+from .planner_cost import (  # noqa: F401
+    ClusterSpec,
+    ModelStats,
+    gpt_stats,
+    search_mesh,
+)
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Planner", "Engine",
+           "ClusterSpec", "ModelStats", "gpt_stats", "search_mesh"]
 
 
 class ProcessMesh:
@@ -95,6 +103,13 @@ class Planner:
 
     def __init__(self, strategy=None):
         self.strategy = strategy
+
+    def search(self, stats, cluster=None, **kw):
+        """Cost-model mesh search (reference planner/parallel_tuner):
+        given ModelStats (e.g. gpt_stats(...)) and a ClusterSpec, rank
+        dp/fsdp/tp/pp factorizations by roofline-estimated step time.
+        See planner_cost.search_mesh."""
+        return search_mesh(stats, cluster, **kw)
 
     def collect_axes(self, model):
         axes = []

@@ -10,7 +10,10 @@ workload shape and installs the fastest; `set_config({"kernel":
 shapes. Measured on GPT-1.3B bs4/seq1024: (512, 512) beats the (256, 256)
 default by ~4% step time on v5e.
 """
+import sys
 import time
+import types
+import warnings
 
 __all__ = ["set_config", "tune_flash_attention", "get_tuned_blocks"]
 
@@ -97,3 +100,18 @@ def tune_flash_attention(batch, seq_len, num_heads, head_dim,
         A._BLOCK_Q, A._BLOCK_K = best
         _state["tuned"][(batch, seq_len, num_heads, head_dim)] = best
     return timings
+
+
+class _CallableModule(types.ModuleType):
+    """Back-compat: earlier releases exposed incubate.autotune as a bare
+    function; calling the module forwards to set_config with a warning."""
+
+    def __call__(self, config=None):
+        warnings.warn(
+            "calling paddle_tpu.incubate.autotune(config) is deprecated; "
+            "use incubate.autotune.set_config(config)",
+            DeprecationWarning, stacklevel=2)
+        return set_config(config)
+
+
+sys.modules[__name__].__class__ = _CallableModule

@@ -50,7 +50,9 @@ class GPTConfig:
     ffn_hidden: int = 0              # 0 → 4*hidden
     max_seq_len: int = 1024
     dropout: float = 0.0
-    sp_mode: str = "ring"            # 'ring' | 'ulysses' sequence parallelism
+    sp_mode: str = "ring"            # 'ring' | 'zigzag' | 'ulysses' seq par
+    #   'zigzag': load-balanced causal ring (2x less attention compute at
+    #   large sp; see ops/ring_attention.py)
     dtype: str = "bfloat16"          # compute/param dtype
     remat: bool = True               # jax.checkpoint each block
     remat_policy: str = "full"       # 'full' (recompute all) | 'dots' (save
@@ -62,9 +64,9 @@ class GPTConfig:
     def __post_init__(self):
         if self.ffn_hidden == 0:
             self.ffn_hidden = 4 * self.hidden_size
-        if self.sp_mode not in ("ring", "ulysses"):
-            raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got "
-                             f"{self.sp_mode!r}")
+        if self.sp_mode not in ("ring", "zigzag", "ulysses"):
+            raise ValueError(f"sp_mode must be 'ring', 'zigzag' or "
+                             f"'ulysses', got {self.sp_mode!r}")
 
     @property
     def head_dim(self):
@@ -127,8 +129,12 @@ class GPTBlock(Layer):
                             qv, kv, vv, mesh=mesh, causal=True), q, k, v)
                 else:
                     from ..ops.ring_attention import ring_attention
+                    layout = ("zigzag" if cfg.sp_mode == "zigzag"
+                              else "contiguous")
                     attn = apply_op(
-                        lambda qv, kv, vv: ring_attention(qv, kv, vv, mesh=mesh, causal=True),
+                        lambda qv, kv, vv: ring_attention(
+                            qv, kv, vv, mesh=mesh, causal=True,
+                            layout=layout),
                         q, k, v)
             else:
                 attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,

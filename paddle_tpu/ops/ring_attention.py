@@ -5,6 +5,21 @@ sparse_attention CUDA paths) with the TPU-native ring algorithm: K/V shards
 rotate around the ICI ring via ppermute while each device accumulates its
 queries' online-softmax partials — memory O(L/sp), comms overlap with compute.
 
+Two causal work layouts:
+
+- contiguous (ring_attention_local): shard d holds tokens
+  [d·L/S, (d+1)·L/S). Every ring step computes the full Lq×Lk block and
+  masks — correct and simple, but ~half the computed blocks are fully
+  masked.
+- zigzag (zigzag_ring_attention_local): the sequence is split into 2S
+  half-chunks and shard d holds chunks (d, 2S-1-d). Step 0 is plain local
+  causal attention; every later step needs exactly TWO unmasked
+  half-blocks per device (one always qc1×kc0; the other qc0×kc0 when the
+  visiting shard is earlier, qc1×kc1 when later) — uniform load, no
+  fully-masked matmuls, ~2× less attention compute at large sp. Same
+  exact online-softmax math, so results match contiguous bit-for-bit up
+  to float reassociation.
+
 Used inside shard_map with q/k/v sharded on the sequence dim:
     out = shard_map(partial(ring_attention_local, axis_name="sp", causal=True),
                     mesh, in_specs=P(dp, "sp", None, None), ...)(q, k, v)
@@ -16,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ring_attention_local", "ring_attention"]
+__all__ = ["ring_attention_local", "ring_attention",
+           "zigzag_ring_attention_local"]
 
 
 def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None):
@@ -64,10 +80,145 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _online_update(m, l, acc, s, vh):
+    """One online-softmax block update. s: [B,H,Lq,Lk] UNMASKED scores."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    return m_new, l * corr + jnp.sum(p, -1, keepdims=True), \
+        acc * corr + p @ vh
+
+
+def zigzag_ring_attention_local(q, k, v, axis_name="sp", scale=None):
+    """Causal ring attention with the zigzag layout, INSIDE shard_map.
+
+    q,k,v: [B, 2*Lh, H, D] — this shard's two half-chunks, ALREADY in
+    zigzag order: rows [:Lh] are global chunk d, rows [Lh:] are global
+    chunk 2S-1-d. Output is in the same zigzag order.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # [B,H,2Lh,D]
+    B, H, L2, D = qh.shape
+    Lh = L2 // 2
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # ---- step 0: local causal attention over this shard's own tokens ----
+    row = jax.lax.broadcasted_iota(jnp.int32, (L2, L2), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (L2, L2), 1)
+    half_of = lambda i: i // Lh                      # 0 -> chunk d, 1 -> 2S-1-d
+    pos = lambda i: jnp.where(half_of(i) == 0, d * Lh + i % Lh,
+                              (2 * sp - 1 - d) * Lh + i % Lh)
+    local_mask = pos(row) >= pos(col)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s0 = jnp.where(local_mask, qh @ jnp.swapaxes(kh, -1, -2), -1e30)
+    m = jnp.max(s0, axis=-1, keepdims=True)
+    p0 = jnp.where(local_mask, jnp.exp(s0 - m), 0.0)
+    l = jnp.sum(p0, -1, keepdims=True)
+    acc = p0 @ vh
+
+    m0, m1 = m[..., :Lh, :], m[..., Lh:, :]
+    l0, l1 = l[..., :Lh, :], l[..., Lh:, :]
+    a0, a1 = acc[..., :Lh, :], acc[..., Lh:, :]
+    q0, q1 = qh[..., :Lh, :], qh[..., Lh:, :]
+
+    def body(t, carry):
+        k_cur, v_cur, m0, l0, a0, m1, l1, a1 = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (d - t) % sp                   # owner of the visiting shard
+        kh = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)
+        vh = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
+        kc0, vc0 = kh[..., :Lh, :], vh[..., :Lh, :]   # chunk src
+        kc1, vc1 = kh[..., Lh:, :], vh[..., Lh:, :]   # chunk 2S-1-src
+        # block X (always needed, fully unmasked): qc1 attends chunk src
+        m1, l1, a1 = _online_update(m1, l1, a1, q1 @ jnp.swapaxes(kc0, -1, -2),
+                                    vc0)
+        # block Y: earlier shard -> qc0 x kc0; later shard -> qc1 x kc1.
+        # Gather the target accumulator first so the online update (the
+        # expensive p@v matmul + exps) runs ONCE, then scatter back.
+        early = src < d
+        q_sel = jnp.where(early, q0, q1)
+        k_sel = jnp.where(early, kc0, kc1)
+        v_sel = jnp.where(early, vc0, vc1)
+        m_sel = jnp.where(early, m0, m1)
+        l_sel = jnp.where(early, l0, l1)
+        a_sel = jnp.where(early, a0, a1)
+        s = q_sel @ jnp.swapaxes(k_sel, -1, -2)
+        m_new, l_new, a_new = _online_update(m_sel, l_sel, a_sel, s, v_sel)
+        m0 = jnp.where(early, m_new, m0)
+        l0 = jnp.where(early, l_new, l0)
+        a0 = jnp.where(early, a_new, a0)
+        m1 = jnp.where(early, m1, m_new)
+        l1 = jnp.where(early, l1, l_new)
+        a1 = jnp.where(early, a1, a_new)
+        return k_cur, v_cur, m0, l0, a0, m1, l1, a1
+
+    _, _, m0, l0, a0, m1, l1, a1 = jax.lax.fori_loop(
+        1, sp, body, (k, v, m0, l0, a0, m1, l1, a1))
+    out = jnp.concatenate([a0 / jnp.maximum(l0, 1e-30),
+                           a1 / jnp.maximum(l1, 1e-30)], axis=2)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _zigzag_perms(sp):
+    """ppermute tables moving contiguous layout <-> zigzag layout.
+
+    Contiguous shard e holds half-chunks (2e, 2e+1). Zigzag shard d wants
+    (d, 2S-1-d). Each half-chunk c has contiguous owner c//2 and zigzag
+    owner (c if c < S else 2S-1-c); one ppermute per half moves them."""
+    owner_z = lambda c: c if c < sp else 2 * sp - 1 - c
+    to_z_first = [(e, owner_z(2 * e)) for e in range(sp)]
+    to_z_second = [(e, owner_z(2 * e + 1)) for e in range(sp)]
+    return to_z_first, to_z_second
+
+
+def _contig_to_zigzag(x, axis_name, sp):
+    """[B, 2Lh, ...] contiguous shard -> zigzag shard, inside shard_map."""
+    d = jax.lax.axis_index(axis_name)
+    Lh = x.shape[1] // 2
+    first, second = _zigzag_perms(sp)
+    got_a = jax.lax.ppermute(x[:, :Lh], axis_name, first)
+    got_b = jax.lax.ppermute(x[:, Lh:], axis_name, second)
+    # zigzag shard d receives chunk d (goes to slot 0) and chunk 2S-1-d
+    # (slot 1); chunk d arrives via `first` iff d even... both arrivals are
+    # disjoint: exactly one of (got_a, got_b) is chunk d, the other 2S-1-d.
+    # chunk d has contiguous owner d//2 sending its half (d%2==0 ? first :
+    # second); build the slot choice from that parity.
+    a_is_low = (d % 2) == 0          # `first` perm carries even chunks
+    low = jnp.where(a_is_low, got_a, got_b)
+    high = jnp.where(a_is_low, got_b, got_a)
+    return jnp.concatenate([low, high], axis=1)
+
+
+def _zigzag_to_contig(x, axis_name, sp):
+    d = jax.lax.axis_index(axis_name)
+    Lh = x.shape[1] // 2
+    first, second = _zigzag_perms(sp)
+    inv_first = [(b, a) for a, b in first]
+    inv_second = [(b, a) for a, b in second]
+    # zigzag shard d holds chunk d (slot 0) and 2S-1-d (slot 1); route
+    # each back to its contiguous owner/half with the inverse perms.
+    send_first = jnp.where((d % 2) == 0, x[:, :Lh], x[:, Lh:])
+    send_second = jnp.where((d % 2) == 0, x[:, Lh:], x[:, :Lh])
+    got_a = jax.lax.ppermute(send_first, axis_name, inv_first)
+    got_b = jax.lax.ppermute(send_second, axis_name, inv_second)
+    return jnp.concatenate([got_a, got_b], axis=1)
+
+
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
-                   batch_axes=("dp", "fsdp"), scale=None):
+                   batch_axes=("dp", "fsdp"), scale=None, layout="contiguous"):
     """shard_map wrapper: q,k,v are GLOBAL [B, L, H, D] arrays (or already
-    sharded); the sequence dim is split over `axis_name`."""
+    sharded); the sequence dim is split over `axis_name`.
+
+    layout="zigzag" (causal only): re-shards contiguous shards into the
+    load-balanced zigzag layout (2 ppermutes of half-shards each way),
+    runs zigzag_ring_attention_local, and restores contiguous order —
+    ~2x less attention compute at large sp for O(L·D) extra comms.
+    """
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
@@ -75,7 +226,17 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
 
     mesh = mesh or get_mesh()
     spec = P(batch_axes, axis_name, None, None)
-    fn = functools.partial(ring_attention_local, axis_name=axis_name,
-                           causal=causal, scale=scale)
+    sp = mesh.shape.get(axis_name, 1)
+    if layout == "zigzag" and causal and sp > 1:
+        def fn(qv, kv, vv):
+            qz = _contig_to_zigzag(qv, axis_name, sp)
+            kz = _contig_to_zigzag(kv, axis_name, sp)
+            vz = _contig_to_zigzag(vv, axis_name, sp)
+            oz = zigzag_ring_attention_local(qz, kz, vz,
+                                             axis_name=axis_name, scale=scale)
+            return _zigzag_to_contig(oz, axis_name, sp)
+    else:
+        fn = functools.partial(ring_attention_local, axis_name=axis_name,
+                               causal=causal, scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)

@@ -83,7 +83,7 @@ def test_inference_predictor():
     x = np.random.rand(2, 8).astype("float32")
     (out,) = pred.run([x])
     np.testing.assert_allclose(out.numpy(), x @ m.weight.numpy() + m.bias.numpy(),
-                               rtol=1e-5)
+                               rtol=1e-4)
 
 
 def test_grad_accum_matches_full_batch():
