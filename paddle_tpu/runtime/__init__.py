@@ -6,55 +6,32 @@ pool + prefetch ring, all off-GIL).
 """
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
 
 __all__ = ["NativeTokenLoader", "PyTokenLoader", "TokenLoader", "native_available"]
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SO_PATH = os.path.join(_HERE, "lib", "libptl_loader.so")
-_SRC = os.path.join(_HERE, "cxx", "data_loader.cpp")
-_lock = threading.Lock()
-_lib = None
-_build_err = None
+from ._build import load_native  # noqa: E402
 
 
-def _build():
-    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO_PATH]
-    subprocess.run(cmd, check=True, capture_output=True)
+def _register(lib):
+    lib.ptl_open.restype = ctypes.c_void_p
+    lib.ptl_open.argtypes = [ctypes.c_char_p]
+    lib.ptl_num_tokens.restype = ctypes.c_int64
+    lib.ptl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.ptl_start.restype = ctypes.c_int
+    lib.ptl_start.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                              ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                              ctypes.c_uint64]
+    lib.ptl_next.restype = ctypes.c_int
+    lib.ptl_next.argtypes = [ctypes.c_void_p,
+                             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+    lib.ptl_stop.argtypes = [ctypes.c_void_p]
+    lib.ptl_close.argtypes = [ctypes.c_void_p]
 
 
 def _get_lib():
-    global _lib, _build_err
-    with _lock:
-        if _lib is not None or _build_err is not None:
-            return _lib
-        try:
-            if not os.path.exists(_SO_PATH) or \
-                    os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC):
-                _build()
-            lib = ctypes.CDLL(_SO_PATH)
-            lib.ptl_open.restype = ctypes.c_void_p
-            lib.ptl_open.argtypes = [ctypes.c_char_p]
-            lib.ptl_num_tokens.restype = ctypes.c_int64
-            lib.ptl_num_tokens.argtypes = [ctypes.c_void_p]
-            lib.ptl_start.restype = ctypes.c_int
-            lib.ptl_start.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                      ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-                                      ctypes.c_uint64]
-            lib.ptl_next.restype = ctypes.c_int
-            lib.ptl_next.argtypes = [ctypes.c_void_p,
-                                     np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
-            lib.ptl_stop.argtypes = [ctypes.c_void_p]
-            lib.ptl_close.argtypes = [ctypes.c_void_p]
-            _lib = lib
-        except Exception as e:  # toolchain missing → python fallback
-            _build_err = e
-        return _lib
+    return load_native("libptl_loader.so", "data_loader.cpp", _register)
 
 
 def native_available():
@@ -140,3 +117,11 @@ def TokenLoader(path, batch_size, seq_len, **kw):
     if native_available():
         return NativeTokenLoader(path, batch_size, seq_len, **kw)
     return PyTokenLoader(path, batch_size, seq_len, **kw)
+
+
+from .tokenizer import (  # noqa: E402,F401
+    WordPieceTokenizer,
+    native_tokenizer_available,
+)
+
+__all__ += ["WordPieceTokenizer", "native_tokenizer_available"]

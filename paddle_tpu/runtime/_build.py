@@ -1,0 +1,51 @@
+"""Shared lazy g++ build/load for the native runtime components.
+
+One implementation of the lock / stale-check / compile / dlopen pattern so
+data_loader, image_ops and tokenizer can't drift: a component calls
+`load_native("libx.so", "x.cpp", register)` and gets the CDLL (cached) or
+None if the toolchain/compile fails — callers always keep a pure-Python
+fallback.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_DIR = os.path.join(_HERE, "lib")
+_CXX_DIR = os.path.join(_HERE, "cxx")
+_lock = threading.Lock()
+_cache = {}          # so_name -> (lib or None)
+
+
+def load_native(so_name, src_name, register, extra_flags=()):
+    """Build (if stale) + dlopen a native component; returns the CDLL or
+    None. `register(lib)` sets restype/argtypes once after loading.
+
+    A prebuilt .so with no source alongside (e.g. a wheel that ships
+    binaries only) is loaded as-is — the staleness check only runs when
+    the source exists."""
+    with _lock:
+        if so_name in _cache:
+            return _cache[so_name]
+        so_path = os.path.join(_LIB_DIR, so_name)
+        src_path = os.path.join(_CXX_DIR, src_name)
+        lib = None
+        try:
+            needs_build = not os.path.exists(so_path) or (
+                os.path.exists(src_path)
+                and os.path.getmtime(so_path) < os.path.getmtime(src_path))
+            if needs_build:
+                os.makedirs(_LIB_DIR, exist_ok=True)
+                # libraries (-ljpeg etc.) must FOLLOW the source for the
+                # linker to resolve its undefined symbols
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", src_path, "-o", so_path, *extra_flags],
+                    check=True, capture_output=True)
+            lib = ctypes.CDLL(so_path)
+            register(lib)
+        except Exception:
+            lib = None
+        _cache[so_name] = lib
+        return lib

@@ -1,0 +1,161 @@
+// Native WordPiece tokenizer — batch encoder with an off-GIL thread pool.
+//
+// Reference counterpart: the reference framework tokenizes in Python
+// (PaddleNLP tokenizers) and hides the cost behind multiprocess DataLoader
+// workers; here the hot path (greedy longest-match WordPiece over a vocab
+// hash map) is C++ so one process saturates text preprocessing without
+// worker processes. Semantics: BERT WordPiece — whitespace pre-split,
+// per-word greedy longest prefix match, continuation pieces prefixed
+// "##", unknown words -> [UNK]. All matching is on raw UTF-8 bytes; the
+// Python fallback (runtime/tokenizer.py) implements the identical
+// byte-level algorithm so outputs are bit-identical either way.
+//
+// C ABI (ctypes):
+//   ptk_create(vocab_blob, blob_len) -> handle
+//       vocab_blob: '\n'-joined UTF-8 tokens; token id == line index.
+//   ptk_encode_batch(handle, text_blob, offsets, n_texts,
+//                    out_ids, out_lens, max_len, n_threads,
+//                    unk_id, cls_id, sep_id) -> 0/err
+//       text_blob: concatenated UTF-8 texts, offsets[i]..offsets[i+1].
+//       out_ids: int32 [n_texts, max_len] (padded with 0);
+//       emits [CLS] ... [SEP] when cls_id/sep_id >= 0.
+//   ptk_free(handle)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::string storage;                       // owns the vocab bytes
+  std::unordered_map<std::string_view, int32_t> vocab;
+  size_t max_token_bytes = 1;
+};
+
+bool is_space(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+void encode_one(const Tokenizer& tk, std::string_view text, int32_t* out,
+                int32_t* out_len, int64_t max_len, int32_t unk_id,
+                int32_t cls_id, int32_t sep_id) {
+  int64_t n = 0;
+  if (cls_id >= 0 && n < max_len) out[n++] = cls_id;
+  size_t i = 0;
+  const size_t N = text.size();
+  while (i < N && n < max_len) {
+    while (i < N && is_space(text[i])) ++i;
+    if (i >= N) break;
+    size_t j = i;
+    while (j < N && !is_space(text[j])) ++j;
+    std::string_view word = text.substr(i, j - i);
+    i = j;
+    // greedy longest-match over the word's bytes
+    size_t pos = 0;
+    bool bad = false;
+    std::vector<int32_t> pieces;
+    std::string cont;                        // "##" + piece scratch
+    while (pos < word.size()) {
+      size_t take = std::min(word.size() - pos, tk.max_token_bytes);
+      int32_t id = -1;
+      size_t used = 0;
+      for (; take > 0; --take) {
+        std::string_view cand = word.substr(pos, take);
+        if (pos == 0) {
+          auto it = tk.vocab.find(cand);
+          if (it != tk.vocab.end()) { id = it->second; used = take; break; }
+        } else {
+          cont.assign("##");
+          cont.append(cand.data(), cand.size());
+          auto it = tk.vocab.find(std::string_view(cont));
+          if (it != tk.vocab.end()) { id = it->second; used = take; break; }
+        }
+      }
+      if (id < 0) { bad = true; break; }
+      pieces.push_back(id);
+      pos += used;
+    }
+    if (bad) {
+      if (n < max_len) out[n++] = unk_id;
+    } else {
+      for (int32_t id : pieces) {
+        if (n >= max_len) break;
+        out[n++] = id;
+      }
+    }
+  }
+  if (sep_id >= 0) {
+    if (n < max_len) out[n++] = sep_id;
+    else out[max_len - 1] = sep_id;
+  }
+  *out_len = static_cast<int32_t>(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptk_create(const char* vocab_blob, int64_t blob_len) {
+  auto* tk = new Tokenizer();
+  tk->storage.assign(vocab_blob, static_cast<size_t>(blob_len));
+  size_t start = 0;
+  int32_t id = 0;
+  const std::string& s = tk->storage;
+  for (size_t i = 0; i <= s.size(); ++i) {
+    if (i == s.size() || s[i] == '\n') {
+      if (i > start) {
+        std::string_view tok(&s[start], i - start);
+        tk->vocab.emplace(tok, id);
+        size_t body = tok.size();
+        if (tok.size() > 2 && tok[0] == '#' && tok[1] == '#') body -= 2;
+        if (body > tk->max_token_bytes) tk->max_token_bytes = body;
+      }
+      ++id;
+      start = i + 1;
+    }
+  }
+  return tk;
+}
+
+int ptk_encode_batch(void* handle, const char* text_blob,
+                     const int64_t* offsets, int64_t n_texts,
+                     int32_t* out_ids, int32_t* out_lens, int64_t max_len,
+                     int n_threads, int32_t unk_id, int32_t cls_id,
+                     int32_t sep_id) {
+  auto* tk = static_cast<Tokenizer*>(handle);
+  if (!tk || n_texts < 0 || max_len <= 0) return 1;
+  std::memset(out_ids, 0, sizeof(int32_t) * n_texts * max_len);
+  int nt = n_threads > 0 ? n_threads : 1;
+  if (nt > n_texts) nt = static_cast<int>(n_texts > 0 ? n_texts : 1);
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      std::string_view text(text_blob + offsets[r],
+                            static_cast<size_t>(offsets[r + 1] - offsets[r]));
+      encode_one(*tk, text, out_ids + r * max_len, out_lens + r, max_len,
+                 unk_id, cls_id, sep_id);
+    }
+  };
+  if (nt <= 1) {
+    work(0, n_texts);
+  } else {
+    std::vector<std::thread> threads;
+    int64_t chunk = (n_texts + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = std::min<int64_t>(lo + chunk, n_texts);
+      if (lo >= hi) break;
+      threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return 0;
+}
+
+void ptk_free(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+}  // extern "C"

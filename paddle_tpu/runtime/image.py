@@ -7,66 +7,43 @@ one C call per image, so thread-pool DataLoader workers scale past the GIL
 even without process workers. Pure-Python (PIL/numpy) fallback throughout.
 """
 import ctypes
-import os
-import subprocess
-import threading
 
 import numpy as np
 
+from ._build import load_native
+
 __all__ = ["native_available", "decode_jpeg", "resize_bilinear",
            "normalize_chw", "decode_resize_normalize"]
-
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SO_PATH = os.path.join(_HERE, "lib", "libpti_image.so")
-_SRC = os.path.join(_HERE, "cxx", "image_ops.cpp")
-_lock = threading.Lock()
-_lib = None
-_build_err = None
 
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
-def _build():
-    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _SO_PATH, "-ljpeg"]
-    subprocess.run(cmd, check=True, capture_output=True)
+def _register(lib):
+    lib.pti_jpeg_info.restype = ctypes.c_int
+    lib.pti_jpeg_info.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.pti_decode_jpeg.restype = ctypes.c_int
+    lib.pti_decode_jpeg.argtypes = [ctypes.c_char_p, ctypes.c_int64, _u8p]
+    lib.pti_resize_bilinear.restype = None
+    lib.pti_resize_bilinear.argtypes = [
+        _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _u8p, ctypes.c_int, ctypes.c_int]
+    lib.pti_normalize_chw.restype = None
+    lib.pti_normalize_chw.argtypes = [
+        _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _f32p, _f32p, ctypes.c_float, _f32p]
+    lib.pti_pipeline.restype = ctypes.c_int
+    lib.pti_pipeline.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        _f32p, _f32p, ctypes.c_float, _f32p]
 
 
 def _get_lib():
-    global _lib, _build_err
-    with _lock:
-        if _lib is not None or _build_err is not None:
-            return _lib
-        try:
-            if not os.path.exists(_SO_PATH) or \
-                    os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC):
-                _build()
-            lib = ctypes.CDLL(_SO_PATH)
-            lib.pti_jpeg_info.restype = ctypes.c_int
-            lib.pti_jpeg_info.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-                ctypes.POINTER(ctypes.c_int)]
-            lib.pti_decode_jpeg.restype = ctypes.c_int
-            lib.pti_decode_jpeg.argtypes = [ctypes.c_char_p, ctypes.c_int64, _u8p]
-            lib.pti_resize_bilinear.restype = None
-            lib.pti_resize_bilinear.argtypes = [
-                _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                _u8p, ctypes.c_int, ctypes.c_int]
-            lib.pti_normalize_chw.restype = None
-            lib.pti_normalize_chw.argtypes = [
-                _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                _f32p, _f32p, ctypes.c_float, _f32p]
-            lib.pti_pipeline.restype = ctypes.c_int
-            lib.pti_pipeline.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-                _f32p, _f32p, ctypes.c_float, _f32p]
-            _lib = lib
-        except Exception as e:  # toolchain/libjpeg missing → python fallback
-            _build_err = e
-        return _lib
+    return load_native("libpti_image.so", "image_ops.cpp", _register,
+                       extra_flags=("-ljpeg",))
 
 
 def native_available():

@@ -1,0 +1,183 @@
+"""WordPiece tokenizer: native C++ batch encoder with a bit-identical
+Python fallback.
+
+Reference counterpart: PaddleNLP's BertTokenizer feeding the reference
+BERT/ERNIE recipes (Python, hidden behind multiprocess DataLoader
+workers). Here the greedy longest-match runs in C++ with an off-GIL
+thread pool (runtime/cxx/tokenizer.cpp), so text preprocessing keeps up
+with the device without worker processes; `use_native=False` (or a
+failed toolchain) falls back to the same byte-level algorithm in Python.
+
+    tok = WordPieceTokenizer(vocab)          # list of tokens or a file path
+    ids, lens = tok.encode_batch(["a test"], max_len=16)
+
+Matching is on raw UTF-8 bytes (continuation pieces prefixed '##',
+unknown words -> unk token), so native and Python agree byte-for-byte.
+"""
+import ctypes
+import os
+import re
+
+import numpy as np
+
+from ._build import load_native
+
+__all__ = ["WordPieceTokenizer", "native_tokenizer_available"]
+
+
+def _register(lib):
+    lib.ptk_create.restype = ctypes.c_void_p
+    lib.ptk_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptk_encode_batch.restype = ctypes.c_int
+    lib.ptk_encode_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32]
+    lib.ptk_free.restype = None
+    lib.ptk_free.argtypes = [ctypes.c_void_p]
+
+
+def _get_lib():
+    return load_native("libptk_tokenizer.so", "tokenizer.cpp", _register)
+
+
+def native_tokenizer_available():
+    return _get_lib() is not None
+
+
+class WordPieceTokenizer:
+    def __init__(self, vocab, unk_token="[UNK]", cls_token="[CLS]",
+                 sep_token="[SEP]", add_special_tokens=True,
+                 lowercase=False, use_native=True):
+        if isinstance(vocab, str):
+            with open(vocab, "r", encoding="utf-8") as f:
+                vocab = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        self.tokens = list(vocab)
+        bad = [t for t in self.tokens if "\n" in t or not t]
+        if bad:
+            raise ValueError(
+                f"vocab tokens must be non-empty and newline-free "
+                f"(the native blob is line-delimited): {bad[:3]!r}")
+        # first occurrence wins on duplicates — same rule as the C++ map
+        self.vocab = {}
+        for i, t in enumerate(self.tokens):
+            self.vocab.setdefault(t, i)
+        self.lowercase = lowercase
+        self.unk_id = self.vocab.get(unk_token, 0)
+        self.cls_id = self.vocab.get(cls_token, -1) if add_special_tokens else -1
+        self.sep_id = self.vocab.get(sep_token, -1) if add_special_tokens else -1
+        self._bvocab = {}
+        for i, t in enumerate(self.tokens):      # first-wins, like C++
+            self._bvocab.setdefault(t.encode("utf-8"), i)
+        self._max_body = max(
+            (len(t.encode("utf-8")) - (2 if t.startswith("##") else 0)
+             for t in self.tokens), default=1)
+        self._handle = None
+        if use_native and native_tokenizer_available():
+            blob = "\n".join(self.tokens).encode("utf-8")
+            self._handle = _get_lib().ptk_create(blob, len(blob))
+
+    @property
+    def vocab_size(self):
+        return len(self.tokens)
+
+    def __len__(self):
+        return len(self.tokens)
+
+    # -- encoding ---------------------------------------------------------
+
+    def encode_batch(self, texts, max_len=128, n_threads=0):
+        """-> (ids int32 [N, max_len] zero-padded, lens int32 [N])."""
+        if self.lowercase:
+            texts = [t.lower() for t in texts]
+        if self._handle is not None:
+            return self._encode_native(texts, max_len, n_threads)
+        return self._encode_py(texts, max_len)
+
+    def encode(self, text, max_len=128):
+        ids, lens = self.encode_batch([text], max_len)
+        return ids[0, :lens[0]].tolist()
+
+    def decode(self, ids):
+        out = []
+        for i in ids:
+            if 0 <= int(i) < len(self.tokens):
+                t = self.tokens[int(i)]
+                if t.startswith("##") and out:
+                    out[-1] += t[2:]
+                elif t not in ("[CLS]", "[SEP]", "[PAD]"):
+                    out.append(t)
+        return " ".join(out)
+
+    def _encode_native(self, texts, max_len, n_threads):
+        lib = _get_lib()
+        blobs = [t.encode("utf-8") for t in texts]
+        offsets = np.zeros(len(blobs) + 1, np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        blob = b"".join(blobs)
+        n = len(texts)
+        ids = np.zeros((n, max_len), np.int32)
+        lens = np.zeros(n, np.int32)
+        nt = n_threads or min(8, os.cpu_count() or 1)
+        rc = lib.ptk_encode_batch(
+            self._handle, blob, offsets.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)), n,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            max_len, nt, self.unk_id, self.cls_id, self.sep_id)
+        if rc != 0:
+            raise RuntimeError(f"native tokenizer failed (rc={rc})")
+        return ids, lens
+
+    def _encode_py(self, texts, max_len):
+        n = len(texts)
+        ids = np.zeros((n, max_len), np.int32)
+        lens = np.zeros(n, np.int32)
+        for r, text in enumerate(texts):
+            row = []
+            if self.cls_id >= 0:
+                row.append(self.cls_id)
+            # same whitespace set as the C++ is_space (space/tab/nl/cr)
+            for word in re.split(rb"[ \t\n\r]+", text.encode("utf-8")):
+                if not word:
+                    continue
+                pieces, pos, bad = [], 0, False
+                while pos < len(word):
+                    take = min(len(word) - pos, self._max_body)
+                    pid = -1
+                    while take > 0:
+                        cand = word[pos:pos + take]
+                        key = cand if pos == 0 else b"##" + cand
+                        if key in self._bvocab:
+                            pid = self._bvocab[key]
+                            break
+                        take -= 1
+                    if pid < 0:
+                        bad = True
+                        break
+                    pieces.append(pid)
+                    pos += take
+                row.extend([self.unk_id] if bad else pieces)
+                if len(row) >= max_len:
+                    break
+            row = row[:max_len]
+            if self.sep_id >= 0:
+                if len(row) < max_len:
+                    row.append(self.sep_id)
+                else:
+                    row[-1] = self.sep_id
+            ids[r, :len(row)] = row
+            lens[r] = len(row)
+        return ids, lens
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None:
+            lib = _get_lib()
+            if lib is not None:
+                try:
+                    lib.ptk_free(self._handle)
+                except Exception:
+                    pass

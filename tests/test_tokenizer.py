@@ -1,0 +1,75 @@
+"""Native C++ WordPiece tokenizer vs the bit-identical Python fallback."""
+import numpy as np
+import pytest
+
+from paddle_tpu.runtime.tokenizer import (
+    WordPieceTokenizer,
+    native_tokenizer_available,
+)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##s", "##ed", "over", "lazy", "dog", "un",
+         "##believ", "##able", "##ly", "a", "b", "##c"]
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog",
+    "unbelievable",
+    "unbelievably  lazy\tfox",
+    "zzz the fox",                       # unknown word -> [UNK]
+    "",
+    "a" * 50,                            # long repeated char
+]
+
+
+def _both():
+    py = WordPieceTokenizer(VOCAB, use_native=False)
+    nat = WordPieceTokenizer(VOCAB, use_native=True)
+    return py, nat
+
+
+def test_python_semantics():
+    py = WordPieceTokenizer(VOCAB, use_native=False)
+    ids = py.encode("the quick fox jumps", max_len=16)
+    toks = [VOCAB[i] for i in ids]
+    assert toks == ["[CLS]", "the", "quick", "fox", "jump", "##s", "[SEP]"]
+    assert py.decode(ids) == "the quick fox jumps"
+    # unknown word
+    ids2 = py.encode("xyzzy fox", max_len=8)
+    assert VOCAB[ids2[1]] == "[UNK]"
+
+
+@pytest.mark.skipif(not native_tokenizer_available(),
+                    reason="no C++ toolchain")
+def test_native_matches_python_bitwise():
+    py, nat = _both()
+    assert nat._handle is not None
+    for max_len in (4, 16, 64):
+        ids_p, lens_p = py.encode_batch(TEXTS, max_len=max_len)
+        ids_n, lens_n = nat.encode_batch(TEXTS, max_len=max_len, n_threads=4)
+        np.testing.assert_array_equal(ids_n, ids_p)
+        np.testing.assert_array_equal(lens_n, lens_p)
+
+
+@pytest.mark.skipif(not native_tokenizer_available(),
+                    reason="no C++ toolchain")
+def test_native_large_batch_threads():
+    py, nat = _both()
+    texts = [f"the quick brown fox number {i} jumps unbelievably" 
+             for i in range(257)]
+    ids_p, lens_p = py.encode_batch(texts, max_len=32)
+    ids_n, lens_n = nat.encode_batch(texts, max_len=32, n_threads=8)
+    np.testing.assert_array_equal(ids_n, ids_p)
+    np.testing.assert_array_equal(lens_n, lens_p)
+
+
+def test_truncation_and_specials():
+    py = WordPieceTokenizer(VOCAB, use_native=False)
+    ids, lens = py.encode_batch(["the quick brown fox jumped over"],
+                                max_len=5)
+    assert lens[0] == 5
+    assert ids[0, 0] == VOCAB.index("[CLS]")
+    assert ids[0, -1] == VOCAB.index("[SEP]")   # sep forced at the end
+    plain = WordPieceTokenizer(VOCAB, add_special_tokens=False,
+                               use_native=False)
+    ids2 = plain.encode("the fox", max_len=8)
+    assert [VOCAB[i] for i in ids2] == ["the", "fox"]
