@@ -13,7 +13,7 @@ from .nn import Linear
 from .nn.layer_base import Layer
 
 __all__ = ["quantize_weight", "dequantize_weight", "QuantizedLinear",
-           "quantize_model"]
+           "quantize_model", "QuantizedLinearA8W8", "PTQ"]
 
 
 def quantize_weight(w, axis=0):
@@ -61,3 +61,98 @@ def quantize_model(model, min_out_features=64):
         else:
             quantize_model(sub, min_out_features)
     return model
+
+
+# ---------------------------------------------------------------------------
+# Post-training static quantization (A8W8) — reference paddle slim PTQ
+# (fluid/contrib/slim post_training_quantization.py: abs-max activation
+# calibration + per-channel weights). On TPU the int8·int8→int32 matmul
+# runs on the MXU via dot_general(preferred_element_type=int32).
+# ---------------------------------------------------------------------------
+
+
+class QuantizedLinearA8W8(Layer):
+    """Linear with int8 weights AND int8 activations (static scale from
+    calibration): y = (q_x · q_w) · (s_x · s_w) + b."""
+
+    def __init__(self, linear: Linear, act_scale):
+        super().__init__()
+        q, scale = quantize_weight(linear.weight, axis=0)
+        self.register_buffer("weight_q", Tensor(q))
+        self.register_buffer("weight_scale", Tensor(scale))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(act_scale, jnp.float32)))
+        self.bias = linear.bias
+        self._out_features = linear._out_features
+        self._in_features = linear._in_features
+
+    def forward(self, x):
+        def _f(v, q, sw, sx, *rest):
+            qx = jnp.clip(jnp.round(v.astype(jnp.float32) / sx),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qx, q, (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            # sw is [1, out] (keepdims): flatten so a 1-D input keeps
+            # Linear's [out] output rank instead of broadcasting to [1, out]
+            out = acc.astype(jnp.float32) * (sw.reshape(-1) * sx)
+            if rest:
+                out = out + rest[0].astype(jnp.float32)
+            return out.astype(v.dtype)
+        args = (x, self.weight_q, self.weight_scale, self.act_scale) + \
+            ((self.bias,) if self.bias is not None else ())
+        return apply_op(_f, *args)
+
+
+class PTQ:
+    """Post-training static quantization driver.
+
+        ptq = PTQ(model)                 # hooks every Linear
+        for batch in calib: model(batch) # observe activation abs-max
+        model = ptq.convert()            # Linears -> int8 A8W8
+
+    Calibration records the running abs-max of each Linear's INPUT; convert
+    swaps in QuantizedLinearA8W8 with that static scale and removes hooks.
+    """
+
+    def __init__(self, model, min_out_features=16):
+        self.model = model
+        self.min_out = min_out_features
+        self._amax = {}
+        self._handles = []
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, Linear) and \
+                    sub._out_features >= min_out_features:
+                self._hook(name, sub)
+
+    def _hook(self, name, layer):
+        def pre(lyr, inputs):
+            x = inputs[0]
+            v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            try:
+                amax = float(jnp.max(jnp.abs(v.astype(jnp.float32))))
+            except Exception:        # traced (jitted calibration): skip
+                return None
+            prev = self._amax.get(name, 0.0)
+            self._amax[name] = max(prev, amax)
+            return None
+        self._handles.append(layer.register_forward_pre_hook(pre))
+
+    def convert(self):
+        for h in self._handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+
+        def swap(layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                full = f"{prefix}{name}"
+                if isinstance(sub, Linear) and full in self._amax \
+                        and self._amax[full] > 0:
+                    scale = max(self._amax[full] / 127.0, 1e-8)
+                    layer._sub_layers[name] = QuantizedLinearA8W8(sub, scale)
+                else:
+                    swap(sub, f"{full}.")
+        swap(self.model)
+        return self.model

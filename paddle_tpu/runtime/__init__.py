@@ -46,7 +46,10 @@ class NativeTokenLoader:
                  prefetch_depth=4, seed=0):
         lib = _get_lib()
         if lib is None:
-            raise RuntimeError(f"native loader unavailable: {_build_err}")
+            from ._build import build_error
+            raise RuntimeError(
+                f"native loader unavailable: "
+                f"{build_error('libptl_loader.so')}")
         self._lib = lib
         self._h = lib.ptl_open(os.fsencode(path))
         if not self._h:

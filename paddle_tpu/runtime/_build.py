@@ -16,6 +16,13 @@ _LIB_DIR = os.path.join(_HERE, "lib")
 _CXX_DIR = os.path.join(_HERE, "cxx")
 _lock = threading.Lock()
 _cache = {}          # so_name -> (lib or None)
+_errors = {}         # so_name -> exception from a failed build/load
+
+
+def build_error(so_name):
+    """The exception that made load_native return None for this
+    component, or None (for error messages / debugging)."""
+    return _errors.get(so_name)
 
 
 def load_native(so_name, src_name, register, extra_flags=()):
@@ -45,7 +52,14 @@ def load_native(so_name, src_name, register, extra_flags=()):
                     check=True, capture_output=True)
             lib = ctypes.CDLL(so_path)
             register(lib)
-        except Exception:
+        except Exception as e:
+            # keep the cause (incl. captured g++ stderr) for diagnostics;
+            # consumers fall back to Python but can surface build_error()
+            if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                e = RuntimeError(
+                    f"{' '.join(e.cmd)} failed:\n"
+                    + e.stderr.decode(errors='replace')[-2000:])
+            _errors[so_name] = e
             lib = None
         _cache[so_name] = lib
         return lib

@@ -66,9 +66,15 @@ class WordPieceTokenizer:
         for i, t in enumerate(self.tokens):
             self.vocab.setdefault(t, i)
         self.lowercase = lowercase
-        self.unk_id = self.vocab.get(unk_token, 0)
+        if unk_token not in self.vocab:
+            raise ValueError(
+                f"unk_token {unk_token!r} is not in the vocab — out-of-vocab "
+                "words would silently map to id 0; add it or pass the "
+                "correct unk_token=")
+        self.unk_id = self.vocab[unk_token]
         self.cls_id = self.vocab.get(cls_token, -1) if add_special_tokens else -1
         self.sep_id = self.vocab.get(sep_token, -1) if add_special_tokens else -1
+        self._special_ids = {self.cls_id, self.sep_id} - {-1}
         self._bvocab = {}
         for i, t in enumerate(self.tokens):      # first-wins, like C++
             self._bvocab.setdefault(t.encode("utf-8"), i)
@@ -76,9 +82,11 @@ class WordPieceTokenizer:
             (len(t.encode("utf-8")) - (2 if t.startswith("##") else 0)
              for t in self.tokens), default=1)
         self._handle = None
-        if use_native and native_tokenizer_available():
+        self._lib = None           # kept on self: __del__ must not re-enter
+        if use_native and native_tokenizer_available():    # the build lock
+            self._lib = _get_lib()
             blob = "\n".join(self.tokens).encode("utf-8")
-            self._handle = _get_lib().ptk_create(blob, len(blob))
+            self._handle = self._lib.ptk_create(blob, len(blob))
 
     @property
     def vocab_size(self):
@@ -104,12 +112,14 @@ class WordPieceTokenizer:
     def decode(self, ids):
         out = []
         for i in ids:
-            if 0 <= int(i) < len(self.tokens):
-                t = self.tokens[int(i)]
-                if t.startswith("##") and out:
-                    out[-1] += t[2:]
-                elif t not in ("[CLS]", "[SEP]", "[PAD]"):
-                    out.append(t)
+            i = int(i)
+            if not 0 <= i < len(self.tokens) or i in self._special_ids:
+                continue
+            t = self.tokens[i]
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            elif t != "[PAD]":      # id-0 padding convention
+                out.append(t)
         return " ".join(out)
 
     def _encode_native(self, texts, max_len, n_threads):
@@ -174,10 +184,9 @@ class WordPieceTokenizer:
         return ids, lens
 
     def __del__(self):
-        if getattr(self, "_handle", None) is not None:
-            lib = _get_lib()
-            if lib is not None:
-                try:
-                    lib.ptk_free(self._handle)
-                except Exception:
-                    pass
+        if getattr(self, "_handle", None) is not None and \
+                getattr(self, "_lib", None) is not None:
+            try:
+                self._lib.ptk_free(self._handle)
+            except Exception:
+                pass

@@ -1,0 +1,60 @@
+"""Post-training static quantization (A8W8) — calibration + int8 matmul."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import PTQ, QuantizedLinearA8W8
+
+
+def test_ptq_calibrate_convert_accuracy():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 8))
+    model.eval()
+    rng = np.random.RandomState(0)
+    calib = [rng.randn(4, 16).astype("float32") for _ in range(4)]
+    x_test = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+    ref = model(x_test).numpy()
+
+    ptq = PTQ(model, min_out_features=1)
+    for b in calib:
+        model(paddle.to_tensor(b))
+    assert ptq._amax and all(v > 0 for v in ptq._amax.values())
+    model = ptq.convert()
+    kinds = [type(m).__name__ for _, m in model.named_sublayers()]
+    assert kinds.count("QuantizedLinearA8W8") == 2
+    got = model(x_test).numpy()
+    # int8 PTQ keeps outputs close on well-scaled data
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.08, err
+
+
+def test_a8w8_kernel_matches_manual():
+    paddle.seed(1)
+    lin = nn.Linear(8, 32)
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype("float32")
+    act_scale = np.abs(x).max() / 127.0
+    q = QuantizedLinearA8W8(lin, act_scale)
+    got = q(paddle.to_tensor(x)).numpy()
+
+    import jax.numpy as jnp
+    w = lin.weight.numpy()
+    amax = np.abs(w).max(axis=0, keepdims=True)
+    sw = np.maximum(amax / 127.0, 1e-8)
+    qw = np.clip(np.round(w / sw), -127, 127).astype(np.int8)
+    qx = np.clip(np.round(x / act_scale), -127, 127).astype(np.int8)
+    want = (qx.astype(np.int32) @ qw.astype(np.int32)).astype(np.float32) \
+        * (sw * act_scale) + lin.bias.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_hooks_removed_after_convert():
+    paddle.seed(2)
+    model = nn.Sequential(nn.Linear(8, 32))
+    ptq = PTQ(model)
+    model(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    before = dict(ptq._amax)
+    ptq.convert()
+    # further forwards must not touch the collector
+    model(paddle.to_tensor(np.full((2, 8), 100.0, np.float32)))
+    assert ptq._amax == before
