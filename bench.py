@@ -258,8 +258,31 @@ def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
     return tok_s
 
 
+def _device_watchdog(timeout_s=240):
+    """Probe jax backend init in a subprocess: a dead TPU tunnel HANGS
+    jax.devices() forever, which would leave the driver with no JSON at
+    all. Returns None if healthy, else an error string."""
+    import subprocess
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+        if p.returncode != 0:
+            return f"device init failed: {(p.stderr or '')[-200:]}"
+        return None
+    except subprocess.TimeoutExpired:
+        return f"device init hung >{timeout_s}s (TPU tunnel down?)"
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
+    err = _device_watchdog()
+    if err is not None:
+        log(f"bench aborted: {err}")
+        print(json.dumps({"metric": "gpt_train_tokens_per_sec_per_chip",
+                          "value": 0.0, "unit": "tokens/s/chip",
+                          "vs_baseline": 0.0, "error": err}))
+        return
     # each group: variants of the same headline config — run all that fit,
     # keep the fastest; fall to the next (smaller) group only if none ran
     groups = [

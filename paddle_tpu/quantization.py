@@ -139,11 +139,17 @@ class PTQ:
         self._handles.append(layer.register_forward_pre_hook(pre))
 
     def convert(self):
+        import warnings
         for h in self._handles:
             try:
                 h.remove()
             except Exception:
                 pass
+        if self._handles and not any(v > 0 for v in self._amax.values()):
+            warnings.warn(
+                "PTQ.convert(): calibration observed no activations (were "
+                "the calibration forwards run eagerly, not under jit?); "
+                "returning the model UNQUANTIZED", RuntimeWarning)
 
         def swap(layer, prefix=""):
             for name, sub in list(layer._sub_layers.items()):

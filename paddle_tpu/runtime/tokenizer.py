@@ -74,7 +74,11 @@ class WordPieceTokenizer:
         self.unk_id = self.vocab[unk_token]
         self.cls_id = self.vocab.get(cls_token, -1) if add_special_tokens else -1
         self.sep_id = self.vocab.get(sep_token, -1) if add_special_tokens else -1
-        self._special_ids = {self.cls_id, self.sep_id} - {-1}
+        # decode() strips the cls/sep tokens wherever they appear in the
+        # vocab, even when THIS tokenizer doesn't emit them
+        # (add_special_tokens=False) — ids may come from another encoder
+        self._special_ids = {self.vocab.get(cls_token, -1),
+                             self.vocab.get(sep_token, -1)} - {-1}
         self._bvocab = {}
         for i, t in enumerate(self.tokens):      # first-wins, like C++
             self._bvocab.setdefault(t.encode("utf-8"), i)

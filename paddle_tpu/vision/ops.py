@@ -7,7 +7,8 @@ from ..framework.core import Tensor, apply_op
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
            "deform_conv2d", "DeformConv2D", "psroi_pool", "read_file", "decode_jpeg",
-           "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+           "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign",
+           "RoIPool", "ConvNormActivation"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
@@ -439,12 +440,168 @@ def decode_jpeg(x, mode="unchanged", name=None):
     return Tensor(jnp.asarray(arr))
 
 
-def distribute_fpn_proposals(*args, **kwargs):
-    raise NotImplementedError("FPN ops land with the detection suite")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale — reference
+    python/paddle/vision/ops.py:distribute_fpn_proposals + phi
+    distribute_fpn_proposals kernel.
+
+    target_level = clip(floor(refer_level + log2(sqrt(area)/refer_scale)),
+    min_level, max_level). Proposal routing is a host-side postprocessing
+    stage (variable-size outputs), so this runs in numpy: returns
+    (multi_rois [per level], restore_ind[, rois_num_per_level]).
+
+    rois_num: per-image roi counts ([B] array/Tensor, or True for a
+    single-image batch); when given, each level's rois stay grouped
+    image-major and rois_num_per_level entries are [B] counts, matching
+    the reference's batched contract.
+    """
+    rois = np.asarray(fpn_rois.numpy() if hasattr(fpn_rois, "numpy")
+                      else fpn_rois, np.float32)
+    if rois_num is None or rois_num is True:
+        per_image = np.asarray([len(rois)], np.int64)
+    else:
+        per_image = np.asarray(
+            rois_num.numpy() if hasattr(rois_num, "numpy") else rois_num,
+            np.int64).reshape(-1)
+    img_of = np.repeat(np.arange(len(per_image)), per_image)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, per_level_counts, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        # image-major within each level so per-image counts slice cleanly
+        idx = np.nonzero(lvl == L)[0]
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
+        order.append(idx)
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        per_level_counts.append(np.bincount(
+            img_of[idx], minlength=len(per_image)).astype(np.int32))
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    # restore_ind[i] = position of original roi i in the concatenated
+    # per-level output (reference RestoreIndex semantics)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1).astype(np.int32)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, [
+            Tensor(jnp.asarray(c)) for c in per_level_counts]
+    return multi_rois, restore_ind
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError("RPN ops land with the detection suite")
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation — reference
+    python/paddle/vision/ops.py:generate_proposals + phi
+    generate_proposals_v2 kernel.
+
+    Per image: decode anchor deltas, clip to the image, drop boxes smaller
+    than min_size, keep pre_nms_top_n by score, NMS, keep post_nms_top_n.
+    Returns (rpn_rois, rpn_roi_probs[, rpn_rois_num]) like the reference.
+    The variable-length NMS/stacking stage is host-side like the
+    reference's CPU kernel.
+    """
+    def to_np(x):
+        return np.asarray(x.numpy() if hasattr(x, "numpy") else x, np.float32)
+
+    sc = to_np(scores)                       # [N, A, H, W]
+    dl = to_np(bbox_deltas)                  # [N, 4A, H, W]
+    im = to_np(img_size)                     # [N, 2] (h, w)
+    an = to_np(anchors).reshape(-1, 4)       # [H*W*A, 4]
+    var = to_np(variances).reshape(-1, 4)
+    N, A = sc.shape[0], sc.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    # [N, A, H, W] -> [N, H*W*A]; deltas -> [N, H*W*A, 4] (phi layout)
+    sc = sc.transpose(0, 2, 3, 1).reshape(N, -1)
+    dl = dl.reshape(N, A, 4, dl.shape[2], dl.shape[3]) \
+        .transpose(0, 3, 4, 1, 2).reshape(N, -1, 4)
+
+    aw = an[:, 2] - an[:, 0] + off
+    ah = an[:, 3] - an[:, 1] + off
+    acx = an[:, 0] + aw * 0.5
+    acy = an[:, 1] + ah * 0.5
+
+    max_delta = float(np.log(1000.0 / 16.0))   # phi kernel's bbox clip
+    min_size = max(float(min_size), 1.0)       # phi floors min_size to 1
+    all_rois, all_probs, all_num = [], [], []
+    for i in range(N):
+        dx, dy, dw, dh = (dl[i, :, 0] * var[:, 0], dl[i, :, 1] * var[:, 1],
+                          dl[i, :, 2] * var[:, 2], dl[i, :, 3] * var[:, 3])
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        w = np.exp(np.minimum(dw, max_delta)) * aw
+        h = np.exp(np.minimum(dh, max_delta)) * ah
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=1)
+        H, W = im[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H - off)
+        keep_w = boxes[:, 2] - boxes[:, 0] + off
+        keep_h = boxes[:, 3] - boxes[:, 1] + off
+        valid = (keep_w >= min_size) & (keep_h >= min_size)
+        idx = np.nonzero(valid)[0]
+        s = sc[i, idx]
+        if pre_nms_top_n > 0 and len(idx) > pre_nms_top_n:
+            top = np.argsort(-s)[:pre_nms_top_n]
+            idx, s = idx[top], s[top]
+        b = boxes[idx]
+        keep = nms(Tensor(jnp.asarray(b)), iou_threshold=nms_thresh,
+                   scores=Tensor(jnp.asarray(s)))
+        keep = np.asarray(keep.numpy() if hasattr(keep, "numpy") else keep)
+        if post_nms_top_n > 0:
+            keep = keep[:post_nms_top_n]
+        all_rois.append(b[keep])
+        all_probs.append(s[keep])
+        all_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, axis=0)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(
+        (np.concatenate(all_probs, axis=0) if all_probs
+         else np.zeros((0,), np.float32)).reshape(-1, 1)))
+    nums = Tensor(jnp.asarray(np.asarray(all_num, np.int32)))
+    if return_rois_num:
+        return rois, probs, nums
+    return rois, probs
+
+
+from ..nn import Sequential as _Sequential  # noqa: E402
+
+
+class ConvNormActivation(_Sequential):
+    """Conv2D + norm + activation block — reference
+    python/paddle/vision/ops.py:ConvNormActivation. A Sequential subclass
+    (like the reference) so isinstance checks and subclassing behave; TPU
+    layout flows through Conv2D's data_format default."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=None,
+                 activation_layer=None, dilation=1, bias=None):
+        from .. import nn
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is None:
+            norm_layer = nn.BatchNorm2D
+        if activation_layer is None:
+            activation_layer = nn.ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+        self.out_channels = out_channels
 
 
 

@@ -102,3 +102,66 @@ def test_yolov3_channels_last_matches_channels_first():
     out_first = m_first(paddle.to_tensor(np.transpose(x, (0, 3, 1, 2))))
     for a, b in zip(out_last, out_first):   # heads are NCHW in both cases
         np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_distribute_fpn_proposals():
+    from paddle_tpu.vision.ops import distribute_fpn_proposals
+    rois = np.array([[0, 0, 16, 16],      # small -> low level
+                     [0, 0, 112, 112],    # ~refer scale
+                     [0, 0, 500, 500]],   # large -> high level
+                    np.float32)
+    multi, restore, nums = distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224, rois_num=True)
+    assert len(multi) == 4
+    sizes = [int(n.numpy()[0]) for n in nums]
+    assert sum(sizes) == 3
+    # restore index maps originals back to their concat position
+    concat = np.concatenate([m.numpy() for m in multi if m.numpy().size],
+                            axis=0)
+    r = restore.numpy().reshape(-1)
+    np.testing.assert_allclose(concat[r], rois)
+    # the small roi lands strictly below the large one's level
+    lvl_of = {tuple(row): i for i, m in enumerate(multi)
+              for row in m.numpy().tolist()}
+    assert lvl_of[tuple(rois[0].tolist())] < lvl_of[tuple(rois[2].tolist())]
+
+
+def test_generate_proposals_shapes_and_order():
+    from paddle_tpu.vision.ops import generate_proposals
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype("float32")
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype("float32")
+    # anchors per (H, W, A)
+    base = np.array([[0, 0, 15, 15], [0, 0, 31, 31], [0, 0, 7, 7]], np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            anchors[y, x] = base + np.array([x * 8, y * 8, x * 8, y * 8],
+                                            np.float32)
+    variances = np.ones((H, W, A, 4), np.float32)
+    rois, probs, num = generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32, 32]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(variances),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.5,
+        return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] == int(num.numpy()[0]) <= 5
+    assert probs.numpy().shape == (r.shape[0], 1)
+    # probs are sorted descending (NMS visits by score)
+    pv = probs.numpy().reshape(-1)
+    assert (np.diff(pv) <= 1e-6).all()
+    # proposals are clipped to the image
+    assert (r >= 0).all() and (r[:, 2] <= 32).all() and (r[:, 3] <= 32).all()
+
+
+def test_conv_norm_activation_block():
+    from paddle_tpu.vision.ops import ConvNormActivation
+    blk = ConvNormActivation(3, 8, kernel_size=3)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 8, 8)
+                         .astype("float32"))
+    out = blk(x)
+    assert out.shape == [1, 8, 8, 8]
+    assert (out.numpy() >= 0).all()       # ReLU applied
