@@ -63,3 +63,4 @@ from .ocr import (  # noqa: F401
     crnn_mobilenet,
     dbnet_mobilenet,
 )
+from .ssd import SSD, make_prior_boxes, ssd_lite  # noqa: F401

@@ -1,0 +1,233 @@
+"""SSD single-shot detector — TPU-first detection training.
+
+Reference scope: PaddleCV's SSD recipe (prior boxes + MultiBoxLoss over
+fluid prior_box/multiclass_nms ops). Unlike proposal-based detectors, SSD
+is ALL static shapes — priors are fixed at build time, ground truth is
+matched to priors with dense IoU, and hard negative mining is a top-k —
+so the entire training step (forward + match + loss + backward + update)
+compiles into one XLA program with no host round-trips.
+
+    model = ssd_lite(num_classes=20, image_size=128)
+    loc, conf = model(imgs)
+    loss = model.loss(loc, conf, gt_box, gt_label)   # fully jittable
+    boxes, scores = model.decode(loc, conf)          # for host-side NMS
+"""
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.core import apply_op
+from ...nn.layout import resolve_data_format
+
+__all__ = ["SSD", "ssd_lite", "make_prior_boxes"]
+
+
+def make_prior_boxes(feat_sizes, min_ratio=0.15, max_ratio=0.9,
+                     aspect_ratios=(2.0,)):
+    """Static prior boxes (cx, cy, w, h normalized) — reference fluid
+    prior_box op. One scale per feature map (linear min→max), plus the
+    geometric-mean extra scale and the given aspect ratios."""
+    n_maps = len(feat_sizes)
+    scales = [min_ratio + (max_ratio - min_ratio) * i / max(n_maps - 1, 1)
+              for i in range(n_maps)]
+    scales.append(min(1.0, scales[-1] * (scales[-1] / max(scales[-2], 1e-6))
+                      if n_maps > 1 else 1.0))
+    priors = []
+    for m, fs in enumerate(feat_sizes):
+        s = scales[m]
+        s_next = scales[m + 1]
+        whs = [(s, s), (math.sqrt(s * s_next),) * 2]
+        for ar in aspect_ratios:
+            whs.append((s * math.sqrt(ar), s / math.sqrt(ar)))
+            whs.append((s / math.sqrt(ar), s * math.sqrt(ar)))
+        for y in range(fs):
+            for x in range(fs):
+                cx, cy = (x + 0.5) / fs, (y + 0.5) / fs
+                for w, h in whs:
+                    priors.append((cx, cy, w, h))
+    return np.clip(np.asarray(priors, np.float32), 0.0, 1.0)
+
+
+def _priors_per_cell(aspect_ratios):
+    return 2 + 2 * len(aspect_ratios)
+
+
+class SSD(nn.Layer):
+    """Backbone stages -> per-scale (loc, conf) heads over static priors.
+
+    gt_box: [N, B, cx cy w h] normalized (w=h=0 pads); gt_label: [N, B]
+    class ids (0..C-1; the conf head's class 0 is background, so targets
+    are shifted by +1 internally, mirroring the reference MultiBoxLoss).
+    """
+
+    def __init__(self, num_classes=20, image_size=128, width=32,
+                 aspect_ratios=(2.0,), variances=(0.1, 0.1, 0.2, 0.2),
+                 neg_pos_ratio=3.0, data_format=None):
+        super().__init__()
+        df = resolve_data_format(data_format, 2)
+        self.data_format = df
+        self.num_classes = num_classes
+        self.variances = variances
+        self.neg_pos_ratio = neg_pos_ratio
+        w = width
+        act = nn.ReLU
+
+        def block(cin, cout, stride):
+            return nn.Sequential(
+                nn.Conv2D(cin, cout, 3, stride=stride, padding=1,
+                          bias_attr=False, data_format=df),
+                nn.BatchNorm2D(cout, data_format=df), act())
+
+        # 4 detection scales: /8, /16, /32, /64
+        self.stem = nn.Sequential(block(3, w, 2), block(w, w * 2, 2))
+        self.stages = nn.LayerList([
+            nn.Sequential(block(w * 2, w * 4, 2), block(w * 4, w * 4, 1)),
+            nn.Sequential(block(w * 4, w * 8, 2), block(w * 8, w * 8, 1)),
+            nn.Sequential(block(w * 8, w * 8, 2), block(w * 8, w * 8, 1)),
+            nn.Sequential(block(w * 8, w * 8, 2), block(w * 8, w * 8, 1)),
+        ])
+        chans = [w * 4, w * 8, w * 8, w * 8]
+        A = _priors_per_cell(aspect_ratios)
+        self.loc_heads = nn.LayerList([
+            nn.Conv2D(c, A * 4, 3, padding=1, data_format=df) for c in chans])
+        self.conf_heads = nn.LayerList([
+            nn.Conv2D(c, A * (num_classes + 1), 3, padding=1, data_format=df)
+            for c in chans])
+        # every stride-2 conv (k=3, p=1) yields ceil(in/2); walk the six
+        # downsamples so priors match the head maps for ANY image size
+        size = image_size
+        feat_sizes = []
+        for i in range(6):
+            size = (size + 1) // 2
+            if i >= 2:                       # /8, /16, /32, /64 scales
+                feat_sizes.append(size)
+        self._priors = make_prior_boxes(feat_sizes,
+                                        aspect_ratios=aspect_ratios)
+
+    @property
+    def priors(self):
+        return self._priors                    # [P, 4] numpy (static)
+
+    def forward(self, x):
+        x = self.stem(x)
+        locs, confs = [], []
+        C1 = self.num_classes + 1
+        for stage, lh, ch in zip(self.stages, self.loc_heads,
+                                 self.conf_heads):
+            x = stage(x)
+            loc = lh(x)
+            conf = ch(x)
+            if self.data_format == "NCHW":
+                loc = apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 1)), loc)
+                conf = apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 1)),
+                                conf)
+            locs.append(apply_op(
+                lambda v: v.reshape(v.shape[0], -1, 4), loc))
+            confs.append(apply_op(
+                lambda v, c=C1: v.reshape(v.shape[0], -1, c), conf))
+        from ...tensor.manipulation import concat
+        return concat(locs, axis=1), concat(confs, axis=1)  # [N,P,4],[N,P,C+1]
+
+    # -- training ---------------------------------------------------------
+
+    def loss(self, loc_pred, conf_pred, gt_box, gt_label):
+        """Dense IoU matching + smooth-L1 loc + CE conf with 3:1 hard
+        negative mining — the reference MultiBoxLoss, as pure jnp."""
+        pri = jnp.asarray(self._priors)
+        var = jnp.asarray(self.variances, jnp.float32)
+        npr = self.neg_pos_ratio
+
+        def _f(loc, conf, gbox, glabel):
+            N, P, _ = loc.shape
+            B = gbox.shape[1]
+            valid = (gbox[..., 2] > 0) & (gbox[..., 3] > 0)     # [N, B]
+            # corners
+            p1 = pri[:, :2] - pri[:, 2:] / 2
+            p2 = pri[:, :2] + pri[:, 2:] / 2
+            g1 = gbox[..., :2] - gbox[..., 2:] / 2
+            g2 = gbox[..., :2] + gbox[..., 2:] / 2
+            ix = jnp.maximum(0.0, jnp.minimum(p2[None, None, :, 0], g2[..., None, 0])
+                             - jnp.maximum(p1[None, None, :, 0], g1[..., None, 0]))
+            iy = jnp.maximum(0.0, jnp.minimum(p2[None, None, :, 1], g2[..., None, 1])
+                             - jnp.maximum(p1[None, None, :, 1], g1[..., None, 1]))
+            inter = ix * iy                                     # [N, B, P]
+            area_p = (pri[:, 2] * pri[:, 3])[None, None, :]
+            area_g = (gbox[..., 2] * gbox[..., 3])[..., None]
+            iou = jnp.where(valid[..., None],
+                            inter / jnp.maximum(area_p + area_g - inter, 1e-9),
+                            0.0)
+            best_gt = jnp.argmax(iou, axis=1)                   # [N, P]
+            best_iou = jnp.max(iou, axis=1)
+            # every gt claims its best prior (bipartite step); padded gt
+            # rows scatter out of range (dropped) so they can never
+            # clobber a real object's claim at prior 0
+            best_prior = jnp.argmax(iou, axis=2)                # [N, B]
+            safe_bp = jnp.where(valid, best_prior, P)
+            claimed = jax.vmap(
+                lambda bp: jnp.zeros((P,), bool).at[bp].set(True,
+                                                            mode="drop")
+            )(safe_bp)
+            forced_gt = jax.vmap(
+                lambda bp: jnp.full((P,), -1, jnp.int32)
+                .at[bp].set(jnp.arange(B, dtype=jnp.int32), mode="drop")
+            )(safe_bp)
+            gt_idx = jnp.where(forced_gt >= 0, forced_gt,
+                               best_gt.astype(jnp.int32))
+            positive = claimed | (best_iou >= 0.5)
+            # gather matched gt
+            take = jax.vmap(lambda arr, idx: arr[idx])
+            mbox = take(gbox, gt_idx)                           # [N, P, 4]
+            mlab = take(glabel.astype(jnp.int32), gt_idx)
+            # encode loc targets (center-size with variances)
+            t_xy = (mbox[..., :2] - pri[None, :, :2]) / \
+                (pri[None, :, 2:] * var[:2])
+            t_wh = jnp.log(jnp.maximum(mbox[..., 2:], 1e-6)
+                           / pri[None, :, 2:]) / var[2:]
+            t = jnp.concatenate([t_xy, t_wh], axis=-1)
+            d = loc.astype(jnp.float32) - t
+            smooth = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                               jnp.abs(d) - 0.5).sum(-1)
+            n_pos = jnp.maximum(positive.sum(axis=1), 1)
+            loss_loc = (smooth * positive).sum(axis=1)
+            # conf: target 0 = background, gt classes shifted +1
+            target = jnp.where(positive, mlab + 1, 0)
+            logp = jax.nn.log_softmax(conf.astype(jnp.float32), axis=-1)
+            ce = -jnp.take_along_axis(logp, target[..., None],
+                                      axis=-1)[..., 0]          # [N, P]
+            # hard negative mining: top (npr * n_pos) background losses
+            neg_ce = jnp.where(positive, -jnp.inf, ce)
+            order = jnp.argsort(-neg_ce, axis=1)
+            rank = jnp.argsort(order, axis=1)
+            n_neg = jnp.minimum((npr * n_pos).astype(jnp.int32),
+                                P - n_pos.astype(jnp.int32))
+            negative = rank < n_neg[:, None]
+            loss_conf = (ce * (positive | negative)).sum(axis=1)
+            return jnp.mean((loss_loc + loss_conf) / n_pos)
+
+        return apply_op(_f, loc_pred, conf_pred, gt_box, gt_label)
+
+    # -- inference --------------------------------------------------------
+
+    def decode(self, loc_pred, conf_pred):
+        """Decode priors + offsets -> (boxes [N,P,4] xyxy normalized,
+        scores [N,P,C]); feed per-image slices to vision.ops.nms."""
+        pri = jnp.asarray(self._priors)
+        var = jnp.asarray(self.variances, jnp.float32)
+
+        def _f(loc, conf):
+            loc = loc.astype(jnp.float32)
+            cxy = pri[None, :, :2] + loc[..., :2] * var[:2] * pri[None, :, 2:]
+            wh = pri[None, :, 2:] * jnp.exp(loc[..., 2:] * var[2:])
+            boxes = jnp.concatenate([cxy - wh / 2, cxy + wh / 2], axis=-1)
+            scores = jax.nn.softmax(conf.astype(jnp.float32), axis=-1)[..., 1:]
+            return jnp.clip(boxes, 0.0, 1.0), scores
+
+        return apply_op(_f, loc_pred, conf_pred)   # one dispatch, two outs
+
+
+def ssd_lite(num_classes=20, image_size=128, **kw):
+    return SSD(num_classes=num_classes, image_size=image_size, **kw)

@@ -1,0 +1,71 @@
+"""SSD detector: static-shape (fully jittable) detection training."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.vision.models import make_prior_boxes, ssd_lite
+
+
+def test_priors_static_and_normalized():
+    pri = make_prior_boxes([8, 4, 2, 1])
+    assert pri.shape[1] == 4
+    assert (pri >= 0).all() and (pri <= 1).all()
+    # count: sum over maps of fs^2 * (2 + 2*1 aspect)
+    assert pri.shape[0] == sum(f * f * 4 for f in (8, 4, 2, 1))
+
+
+def test_ssd_train_step_fully_jitted_decreases_loss():
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = ssd_lite(num_classes=3, image_size=64, width=8)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(1)
+    batch = {
+        "image": rng.randn(2, 3, 64, 64).astype("float32"),
+        "gt_box": np.tile(np.array([[[0.5, 0.5, 0.4, 0.4],
+                                     [0.25, 0.25, 0.2, 0.3],
+                                     [0, 0, 0, 0]]], np.float32), (2, 1, 1)),
+        "gt_label": np.tile(np.array([[0, 2, 0]], np.int32), (2, 1)),
+    }
+
+    def loss_fn(m, b):
+        loc, conf = m(paddle.to_tensor(b["image"]))
+        return m.loss(loc, conf, paddle.to_tensor(b["gt_box"]),
+                      paddle.to_tensor(b["gt_label"]))
+
+    trainer = Trainer(model, opt, loss_fn)   # ONE compiled XLA program
+    losses = [float(trainer.step(batch)) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_ssd_decode_inverts_encoding():
+    """A loc prediction that exactly encodes a gt box must decode to it."""
+    paddle.seed(2)
+    model = ssd_lite(num_classes=2, image_size=64, width=8)
+    pri = model.priors
+    var = np.asarray(model.variances, np.float32)
+    gt = np.array([0.5, 0.5, 0.25, 0.4], np.float32)        # cx cy w h
+    # encode gt against every prior
+    t_xy = (gt[:2] - pri[:, :2]) / (pri[:, 2:] * var[:2])
+    t_wh = np.log(gt[2:] / pri[:, 2:]) / var[2:]
+    loc = np.concatenate([t_xy, t_wh], axis=1)[None].astype("float32")
+    conf = np.zeros((1, pri.shape[0], 3), np.float32)
+    boxes, scores = model.decode(paddle.to_tensor(loc),
+                                 paddle.to_tensor(conf))
+    want = np.array([gt[0] - gt[2] / 2, gt[1] - gt[3] / 2,
+                     gt[0] + gt[2] / 2, gt[1] + gt[3] / 2], np.float32)
+    np.testing.assert_allclose(boxes.numpy()[0], np.tile(want, (pri.shape[0], 1)),
+                               atol=1e-5)
+    assert scores.shape == [1, pri.shape[0], 2]
+
+
+def test_ssd_non_multiple_image_size():
+    """Prior count matches head outputs for sizes not divisible by 64."""
+    paddle.seed(3)
+    model = ssd_lite(num_classes=2, image_size=96, width=8)
+    x = paddle.to_tensor(np.zeros((1, 3, 96, 96), np.float32))
+    loc, conf = model(x)
+    assert loc.shape[1] == model.priors.shape[0]
