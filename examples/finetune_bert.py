@@ -22,6 +22,28 @@ def synthetic_batches(vocab, batch, seq, classes, seed=0):
                "labels": rng.randint(0, classes, (batch,)).astype("int64")}
 
 
+def text_batches(texts, labels, vocab_file, batch, seq):
+    """Real-text variant: the native C++ WordPiece tokenizer
+    (paddle_tpu.runtime.WordPieceTokenizer, off-GIL batch encode with a
+    bit-identical Python fallback) feeds the same model.
+
+        tok ids come out [batch, seq] zero-padded with [CLS]/[SEP] added;
+        attention_mask derives from the returned lengths.
+    """
+    from paddle_tpu.runtime import WordPieceTokenizer
+
+    tok = WordPieceTokenizer(vocab_file, lowercase=True)
+    n = len(texts)
+    i = 0
+    while True:
+        sel = [(i + j) % n for j in range(batch)]
+        i = (i + batch) % n
+        ids, lens = tok.encode_batch([texts[s] for s in sel], max_len=seq)
+        mask = (np.arange(seq)[None, :] < lens[:, None]).astype("int32")
+        yield {"input_ids": ids, "attention_mask": mask,
+               "labels": np.asarray([labels[s] for s in sel], np.int64)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="bert_base", choices=["bert_tiny", "bert_base", "bert_large"])
@@ -31,6 +53,11 @@ def main():
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--lr", type=float, default=2e-5)
     ap.add_argument("--from-ckpt", default=None, help=".pdparams to warm-start")
+    ap.add_argument("--vocab-file", default=None,
+                    help="WordPiece vocab (one token/line): tokenize real "
+                         "text from --text-file instead of synthetic ids")
+    ap.add_argument("--text-file", default=None,
+                    help="TSV of '<label>\\t<text>' lines for --vocab-file")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -58,7 +85,16 @@ def main():
             logits, paddle.to_tensor(batch["labels"]))
 
     trainer = Trainer(model, opt, loss_fn)
-    data = synthetic_batches(cfg.vocab_size, args.batch, args.seq, args.classes)
+    if args.vocab_file and args.text_file:
+        rows = [l.rstrip("\n").split("\t", 1)
+                for l in open(args.text_file) if l.strip()]
+        labels = [int(r[0]) for r in rows]
+        texts = [r[1] for r in rows]
+        data = text_batches(texts, labels, args.vocab_file,
+                            args.batch, args.seq)
+    else:
+        data = synthetic_batches(cfg.vocab_size, args.batch, args.seq,
+                                 args.classes)
     t0 = time.time()
     for step, batch in zip(range(1, args.steps + 1), data):
         loss = trainer.step(batch)

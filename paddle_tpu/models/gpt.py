@@ -414,8 +414,9 @@ class GPTStacked(Layer):
         y = ln(xv, p["ln1_w"], p["ln1_b"])
         qkv = y @ p["qkv_w"].astype(y.dtype) + p["qkv_b"].astype(y.dtype)
         qkv = qkv.reshape(B, L, 3, cfg.num_heads, cfg.head_dim)
-        from ..ops.attention import mha_reference
-        attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True)
+        from ..ops.attention import flash_raw_or_reference
+        attn = flash_raw_or_reference(qkv[:, :, 0], qkv[:, :, 1],
+                                      qkv[:, :, 2], causal=True)
         attn = attn.reshape(B, L, cfg.hidden_size)
         xv = xv + attn @ p["proj_w"].astype(y.dtype) + p["proj_b"].astype(y.dtype)
         y = ln(xv, p["ln2_w"], p["ln2_b"])

@@ -623,6 +623,23 @@ def _flash(q, k, v, causal, scale):
     return _flash_core(_plain_cfg(causal, scale), q, k, v, d, d, d)
 
 
+def flash_raw_or_reference(q, k, v, causal=True, scale=None):
+    """Raw-array dispatch for code already inside jit/shard_map (stacked
+    GPT blocks, pipeline stages): the Pallas kernel when the backend and
+    tiling allow, else the jnp reference — same numerics. Unlike the
+    public flash_attention it does NOT pad: non-128-multiple sequence
+    lengths would only fail at XLA compile (beyond the trace-time
+    except), so they take the reference path instead."""
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    if flash_attention_available(q, None, 0.0) \
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
+        try:
+            return _flash(q, k, v, causal, scale)
+        except Exception as e:
+            kernel_fallback("flash_raw", e)
+    return mha_reference(q, k, v, causal=causal, scale=scale)
+
+
 # -- back-compat impl wrappers (tests drive these in interpret mode) --------
 
 
