@@ -85,11 +85,24 @@ def main():
             logits, paddle.to_tensor(batch["labels"]))
 
     trainer = Trainer(model, opt, loss_fn)
-    if args.vocab_file and args.text_file:
-        rows = [l.rstrip("\n").split("\t", 1)
-                for l in open(args.text_file) if l.strip()]
+    if bool(args.vocab_file) != bool(args.text_file):
+        ap.error("--vocab-file and --text-file must be given together")
+    if args.vocab_file:
+        rows = []
+        for ln, l in enumerate(open(args.text_file), 1):
+            if not l.strip():
+                continue
+            parts = l.rstrip("\n").split("\t", 1)
+            if len(parts) != 2:
+                ap.error(f"{args.text_file}:{ln}: expected '<label>\\t<text>'")
+            rows.append(parts)
         labels = [int(r[0]) for r in rows]
         texts = [r[1] for r in rows]
+        from paddle_tpu.runtime import WordPieceTokenizer
+        n_vocab = WordPieceTokenizer(args.vocab_file).vocab_size
+        if n_vocab > cfg.vocab_size:
+            ap.error(f"vocab file has {n_vocab} tokens > model embedding "
+                     f"table {cfg.vocab_size}; ids would gather garbage")
         data = text_batches(texts, labels, args.vocab_file,
                             args.batch, args.seq)
     else:

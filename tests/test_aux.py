@@ -125,3 +125,30 @@ def test_hub_local(tmp_path):
     assert "tiny" in hub.list(str(tmp_path), source="local")
     m = hub.load(str(tmp_path), "tiny", source="local", n=3)
     assert m(paddle.rand([1, 3])).shape == [1, 3]
+
+
+def test_elastic_heartbeat_and_resume(tmp_path):
+    """ElasticManager: heartbeat file writes atomically; resume_step reads
+    the latest checkpoint; SIGTERM flips should_exit."""
+    import json
+    import os
+    import signal
+
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        em = ElasticManager(str(tmp_path), interval_s=0)
+        em.heartbeat(step=7, extra={"loss": 1.5})
+        hb = json.load(open(em.heartbeat_path))
+        assert hb["step"] == 7 and hb["loss"] == 1.5
+        # a second beat overwrites atomically
+        em.heartbeat(step=8)
+        assert json.load(open(em.heartbeat_path))["step"] == 8
+        assert not em.should_exit
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert em.should_exit
+        # no checkpoints yet -> nothing to resume from
+        assert em.resume_step() in (None, 0)
+    finally:     # don't leave the flag-setting handler on the pytest process
+        signal.signal(signal.SIGTERM, prev)

@@ -138,15 +138,18 @@ def test_zigzag_layout_roundtrip():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
 
 
-def test_gpt_zigzag_sp_equals_single_device():
-    """GPT with sp_mode='zigzag' trains identically to dp=1."""
+@pytest.mark.parametrize("axes", [{"sp": 4}, {"sp": 2, "tp": 2}],
+                         ids=["sp4", "sp2xtp2"])
+def test_gpt_zigzag_sp_equals_single_device(axes):
+    """GPT with sp_mode='zigzag' trains identically to dp=1, alone and
+    composed with tensor parallelism."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed.trainer import Trainer
     from paddle_tpu.models import GPT, GPTConfig, GPTPretrainingCriterion
 
     def cfg():
         return GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
-                         num_heads=2, max_seq_len=32, dtype="float32",
+                         num_heads=4, max_seq_len=32, dtype="float32",
                          remat=False, sp_mode="zigzag")
 
     crit = GPTPretrainingCriterion()
@@ -160,14 +163,13 @@ def test_gpt_zigzag_sp_equals_single_device():
     batch = {"input_ids": ids[:, :-1].astype("int32"),
              "labels": ids[:, 1:].astype("int32")}
     losses = {}
-    for axes in ({"dp": 1}, {"sp": 4}):
-        import paddle_tpu as paddle
+    for mesh_axes in ({"dp": 1}, axes):
         paddle.seed(9)
-        build_mesh(**axes)
+        build_mesh(**mesh_axes)
         model = GPT(cfg())
         opt = paddle.optimizer.SGD(learning_rate=0.1,
                                    parameters=model.parameters())
         t = Trainer(model, opt, loss_fn)
-        losses[tuple(axes)] = [float(t.step(batch)) for _ in range(3)]
+        losses[tuple(mesh_axes)] = [float(t.step(batch)) for _ in range(3)]
     vals = list(losses.values())
     np.testing.assert_allclose(vals[0], vals[1], rtol=2e-4)
