@@ -22,7 +22,7 @@ def synthetic_batches(vocab, batch, seq, classes, seed=0):
                "labels": rng.randint(0, classes, (batch,)).astype("int64")}
 
 
-def text_batches(texts, labels, vocab_file, batch, seq):
+def text_batches(texts, labels, tok, batch, seq):
     """Real-text variant: the native C++ WordPiece tokenizer
     (paddle_tpu.runtime.WordPieceTokenizer, off-GIL batch encode with a
     bit-identical Python fallback) feeds the same model.
@@ -30,9 +30,6 @@ def text_batches(texts, labels, vocab_file, batch, seq):
         tok ids come out [batch, seq] zero-padded with [CLS]/[SEP] added;
         attention_mask derives from the returned lengths.
     """
-    from paddle_tpu.runtime import WordPieceTokenizer
-
-    tok = WordPieceTokenizer(vocab_file, lowercase=True)
     n = len(texts)
     i = 0
     while True:
@@ -64,9 +61,36 @@ def main():
     from paddle_tpu.distributed import Trainer, build_mesh
     from paddle_tpu.models import bert
 
+    cfg = getattr(bert, args.config)(dtype="bfloat16")
+
+    # validate the data flags BEFORE spending time/memory on the model
+    if bool(args.vocab_file) != bool(args.text_file):
+        ap.error("--vocab-file and --text-file must be given together")
+    tok = None
+    if args.vocab_file:
+        from paddle_tpu.runtime import WordPieceTokenizer
+        tok = WordPieceTokenizer(args.vocab_file, lowercase=True)
+        if tok.vocab_size > cfg.vocab_size:
+            ap.error(f"vocab file has {tok.vocab_size} tokens > model "
+                     f"embedding table {cfg.vocab_size}; ids would gather "
+                     "garbage")
+        labels, texts = [], []
+        for ln, l in enumerate(open(args.text_file), 1):
+            if not l.strip():
+                continue
+            parts = l.rstrip("\n").split("\t", 1)
+            if len(parts) != 2 or not parts[0].strip().lstrip("-").isdigit():
+                ap.error(f"{args.text_file}:{ln}: expected "
+                         f"'<int label>\\t<text>'")
+            labels.append(int(parts[0]))
+            texts.append(parts[1])
+        data = text_batches(texts, labels, tok, args.batch, args.seq)
+    else:
+        data = synthetic_batches(cfg.vocab_size, args.batch, args.seq,
+                                 args.classes)
+
     paddle.seed(0)
     build_mesh()
-    cfg = getattr(bert, args.config)(dtype="bfloat16")
     model = bert.BertForSequenceClassification(cfg, num_classes=args.classes)
     model.bfloat16()
     if args.from_ckpt:
@@ -85,29 +109,6 @@ def main():
             logits, paddle.to_tensor(batch["labels"]))
 
     trainer = Trainer(model, opt, loss_fn)
-    if bool(args.vocab_file) != bool(args.text_file):
-        ap.error("--vocab-file and --text-file must be given together")
-    if args.vocab_file:
-        rows = []
-        for ln, l in enumerate(open(args.text_file), 1):
-            if not l.strip():
-                continue
-            parts = l.rstrip("\n").split("\t", 1)
-            if len(parts) != 2:
-                ap.error(f"{args.text_file}:{ln}: expected '<label>\\t<text>'")
-            rows.append(parts)
-        labels = [int(r[0]) for r in rows]
-        texts = [r[1] for r in rows]
-        from paddle_tpu.runtime import WordPieceTokenizer
-        n_vocab = WordPieceTokenizer(args.vocab_file).vocab_size
-        if n_vocab > cfg.vocab_size:
-            ap.error(f"vocab file has {n_vocab} tokens > model embedding "
-                     f"table {cfg.vocab_size}; ids would gather garbage")
-        data = text_batches(texts, labels, args.vocab_file,
-                            args.batch, args.seq)
-    else:
-        data = synthetic_batches(cfg.vocab_size, args.batch, args.seq,
-                                 args.classes)
     t0 = time.time()
     for step, batch in zip(range(1, args.steps + 1), data):
         loss = trainer.step(batch)

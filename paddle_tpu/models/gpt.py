@@ -288,25 +288,31 @@ class GPTPretrainingCriterion(Layer):
         return F.cross_entropy(flat, flat_labels, ignore_index=-100, reduction="mean")
 
 
+def _preset(kw, **defaults):
+    """Config factory body: caller kwargs override the preset's fields."""
+    defaults.update(kw)
+    return GPTConfig(**defaults)
+
+
 def gpt_tiny(**kw):
-    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
-                     max_seq_len=256, **kw)
+    return _preset(kw, vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, max_seq_len=256)
 
 
 def gpt_125m(**kw):
-    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+    return _preset(kw, hidden_size=768, num_layers=12, num_heads=12)
 
 
 def gpt_350m(**kw):
-    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+    return _preset(kw, hidden_size=1024, num_layers=24, num_heads=16)
 
 
 def gpt_760m(**kw):
-    return GPTConfig(hidden_size=1536, num_layers=24, num_heads=16, **kw)
+    return _preset(kw, hidden_size=1536, num_layers=24, num_heads=16)
 
 
 def gpt_1p3b(**kw):
-    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+    return _preset(kw, hidden_size=2048, num_layers=24, num_heads=16)
 
 
 # ---------------------------------------------------------------------------

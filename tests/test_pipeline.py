@@ -93,10 +93,12 @@ def test_gpt_stacked_pp_equals_pp1(schedule):
     np.testing.assert_allclose(vals[0], vals[1], rtol=1e-3)
 
 
-def test_gpt_stacked_trains():
+@pytest.mark.parametrize("schedule", [
+    "1f1b", pytest.param("interleaved", marks=pytest.mark.slow)])
+def test_gpt_stacked_trains(schedule):
     paddle.seed(0)
     build_mesh(pp=2, dp=2, tp=2)
-    model = GPTStacked(_cfg(), pp_microbatches=2)
+    model = GPTStacked(_cfg(), pp_microbatches=2, pp_schedule=schedule)
     opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
     trainer = Trainer(model, opt, _loss_fn)
     batch = _batch()
