@@ -79,13 +79,16 @@ class DGCCompressor:
             u = jnp.where(sent, 0.0, u)     # momentum factor masking
             return send.astype(g.dtype), u, v
 
-        outs = jax.tree_util.tree_map(leaf, grads, state["u"], state["v"])
-        sends = jax.tree_util.tree_map(lambda t: t[0], outs,
-                                       is_leaf=lambda x: isinstance(x, tuple))
-        new_u = jax.tree_util.tree_map(lambda t: t[1], outs,
-                                       is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree_util.tree_map(lambda t: t[2], outs,
-                                       is_leaf=lambda x: isinstance(x, tuple))
+        # flatten by the grads treedef so tuples used as structure nodes in
+        # the params pytree are never mistaken for per-leaf results
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_u = treedef.flatten_up_to(state["u"])
+        leaves_v = treedef.flatten_up_to(state["v"])
+        outs = [leaf(g, u, v)
+                for g, u, v in zip(leaves_g, leaves_u, leaves_v)]
+        sends = treedef.unflatten([o[0] for o in outs])
+        new_u = treedef.unflatten([o[1] for o in outs])
+        new_v = treedef.unflatten([o[2] for o in outs])
         return sends, {"u": new_u, "v": new_v}
 
 

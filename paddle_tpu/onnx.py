@@ -78,6 +78,19 @@ def _np_dtype_code(arr):
     return _DTYPES[np.dtype(arr.dtype)]
 
 
+def _dtype_code(dtype):
+    """TensorProto code for a dtype object (bf16-aware; raises on unknown
+    so graph I/O never gets silently mislabeled as FLOAT)."""
+    import jax.numpy as jnp
+    if dtype == jnp.bfloat16:
+        return _BFLOAT16
+    try:
+        return _DTYPES[np.dtype(dtype)]
+    except (KeyError, TypeError):
+        raise NotImplementedError(
+            f"onnx.export: no TensorProto dtype mapping for {dtype!r}")
+
+
 def _tensor_proto(name, arr):
     """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
     import jax.numpy as jnp
@@ -706,13 +719,12 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
             "onnx.export: unsupported primitives in traced graph: "
             + ", ".join(sorted(ex.unsupported)))
 
-    inputs = [_value_info(n, e.shape, _DTYPES.get(np.dtype(e.dtype), 1))
+    inputs = [_value_info(n, e.shape, _dtype_code(e.dtype))
               for n, e in zip(in_names, examples)]
     outputs = []
     outvals = closed.out_avals
     for n, av in zip(out_names, outvals):
-        code = _DTYPES.get(np.dtype(av.dtype), 1)
-        outputs.append(_value_info(n, av.shape, code))
+        outputs.append(_value_info(n, av.shape, _dtype_code(av.dtype)))
     graph = _graph(ex.nodes, "paddle_tpu_graph", ex.initializers,
                    inputs, outputs)
     data = _model(graph, opset=opset_version)

@@ -228,6 +228,12 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     spec = P(batch_axes, axis_name, None, None)
     sp = mesh.shape.get(axis_name, 1)
     if layout == "zigzag" and causal and sp > 1:
+        L = q.shape[1]
+        if L % (2 * sp) != 0:
+            raise ValueError(
+                f"ring_attention(layout='zigzag') needs the sequence length "
+                f"divisible by 2*sp = {2 * sp} (two half-chunks per shard); "
+                f"got L={L} over sp={sp}")
         def fn(qv, kv, vv):
             qz = _contig_to_zigzag(qv, axis_name, sp)
             kz = _contig_to_zigzag(kv, axis_name, sp)

@@ -7,6 +7,7 @@ None if the toolchain/compile fails — callers always keep a pure-Python
 fallback.
 """
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -30,18 +31,29 @@ def load_native(so_name, src_name, register, extra_flags=()):
     None. `register(lib)` sets restype/argtypes once after loading.
 
     A prebuilt .so with no source alongside (e.g. a wheel that ships
-    binaries only) is loaded as-is — the staleness check only runs when
-    the source exists."""
+    binaries only) is loaded as-is.  When the source IS present, staleness
+    is decided by a recorded sha256 of the source (a `.srchash` stamp next
+    to the .so) — mtimes are unreliable after a fresh git checkout, and a
+    hash also rejects a foreign binary that happens to be newer."""
     with _lock:
         if so_name in _cache:
             return _cache[so_name]
         so_path = os.path.join(_LIB_DIR, so_name)
         src_path = os.path.join(_CXX_DIR, src_name)
+        stamp_path = so_path + ".srchash"
         lib = None
         try:
-            needs_build = not os.path.exists(so_path) or (
-                os.path.exists(src_path)
-                and os.path.getmtime(so_path) < os.path.getmtime(src_path))
+            if os.path.exists(src_path):
+                with open(src_path, "rb") as f:
+                    src_hash = hashlib.sha256(f.read()).hexdigest()
+                stamp = None
+                if os.path.exists(stamp_path):
+                    with open(stamp_path) as f:
+                        stamp = f.read().strip()
+                needs_build = not os.path.exists(so_path) or stamp != src_hash
+            else:
+                src_hash = None
+                needs_build = not os.path.exists(so_path)
             if needs_build:
                 os.makedirs(_LIB_DIR, exist_ok=True)
                 # libraries (-ljpeg etc.) must FOLLOW the source for the
@@ -50,6 +62,9 @@ def load_native(so_name, src_name, register, extra_flags=()):
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                      "-pthread", src_path, "-o", so_path, *extra_flags],
                     check=True, capture_output=True)
+                if src_hash is not None:
+                    with open(stamp_path, "w") as f:
+                        f.write(src_hash)
             lib = ctypes.CDLL(so_path)
             register(lib)
         except Exception as e:
