@@ -41,6 +41,27 @@ def _measure(trainer, batch, steps, label):
     return (time.time() - t0) / steps
 
 
+def _fwd_flops(trainer, batch):
+    """Executed FLOPs of ONE forward pass (XLA cost analysis of the traced
+    loss computation): the roofline denominator for configs like detection
+    or routed-MoE where a 6N params heuristic misstates the compute. Train
+    step ≈ 3x forward (fwd + ~2x bwd)."""
+    import jax
+
+    from paddle_tpu.distributed.trainer import batch_to_arrays, make_compute_loss
+    try:
+        cl = make_compute_loss(trainer.model, trainer.loss_fn)
+        lowered = jax.jit(cl).lower(trainer.params, trainer.consts,
+                                    batch_to_arrays(batch))
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception as e:
+        log(f"fwd flops analysis failed: {type(e).__name__}: {str(e)[:200]}")
+        return 0.0
+
+
 def chip_peak_flops():
     """bf16 peak FLOP/s for the attached chip."""
     import jax
@@ -216,10 +237,14 @@ def run_yolov3(batch_size=16, size=320, steps=10):
              .astype("float32"),
              "gt_label": rng.randint(0, 80, (batch_size, nb)).astype("int32")}
     batch = _stage(batch)
+    fwd = _fwd_flops(trainer, batch)
     dt = _measure(trainer, batch, steps, "yolov3")
     imgs_s = batch_size / dt
-    log(f"yolov3: {dt*1e3:.1f} ms/step, {imgs_s:.0f} imgs/s")
-    return imgs_s
+    # roofline: measured fwd FLOPs x3 for train (bwd ~2x fwd)
+    mfu = 3 * fwd / batch_size * imgs_s / chip_peak_flops() if fwd else 0.0
+    log(f"yolov3: {dt*1e3:.1f} ms/step, {imgs_s:.0f} imgs/s, MFU={mfu:.3f} "
+        f"(fwd {fwd/batch_size/1e9:.1f} GFLOP/img)")
+    return imgs_s, mfu
 
 
 def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
@@ -254,24 +279,38 @@ def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
                     "labels": ids[:, 1:].astype("int32")})
     dt = _measure(trainer, batch, steps, "gpt_moe")
     tok_s = batch_size * seq_len / dt
-    log(f"gpt_moe: {dt*1e3:.1f} ms/step, {tok_s:.0f} tok/s")
-    return tok_s
+    # roofline on ACTIVATED params (top_k of E experts): 6N_active per token
+    n_active = cfg.num_active_params()
+    mfu = 6 * n_active * tok_s / chip_peak_flops()
+    log(f"gpt_moe: {dt*1e3:.1f} ms/step, {tok_s:.0f} tok/s, MFU={mfu:.3f} "
+        f"({n_active/1e6:.0f}M active / {cfg.num_params()/1e6:.0f}M total)")
+    return tok_s, mfu
 
 
-def _device_watchdog(timeout_s=240):
+def _device_watchdog(timeout_s=150, attempts=4, backoff_s=45):
     """Probe jax backend init in a subprocess: a dead TPU tunnel HANGS
     jax.devices() forever, which would leave the driver with no JSON at
-    all. Returns None if healthy, else an error string."""
+    all. Tunnel flaps are transient, so retry with backoff before giving
+    up (~11 min worst case). Returns None if healthy, else an error
+    string."""
     import subprocess
+    import time as _time
     code = "import jax; d = jax.devices(); print(d[0].platform)"
-    try:
-        p = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=timeout_s)
-        if p.returncode != 0:
-            return f"device init failed: {(p.stderr or '')[-200:]}"
-        return None
-    except subprocess.TimeoutExpired:
-        return f"device init hung >{timeout_s}s (TPU tunnel down?)"
+    err = None
+    for i in range(attempts):
+        if i:
+            log(f"device probe retry {i + 1}/{attempts} in {backoff_s}s: {err}")
+            _time.sleep(backoff_s)
+        try:
+            p = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+            if p.returncode == 0:
+                return None
+            err = f"device init failed: {(p.stderr or '')[-200:]}"
+        except subprocess.TimeoutExpired:
+            err = f"device init hung >{timeout_s}s (TPU tunnel down?)"
+    return f"{err} [after {attempts} attempts]"
 
 
 def main():
@@ -353,15 +392,17 @@ def main():
             extras["bert_base_error"] = str(e)[:160]
     if only in (None, "yolo"):
         try:
-            imgs_s = run_yolov3()
+            imgs_s, mfu = run_yolov3()
             extras["yolov3_imgs_per_sec_per_chip"] = round(imgs_s, 1)
+            extras["yolov3_mfu"] = round(mfu, 4)
         except Exception as e:
             log(f"yolov3 bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["yolov3_error"] = str(e)[:160]
     if only in (None, "moe"):
         try:
-            tok_s = run_gpt_moe()
+            tok_s, mfu = run_gpt_moe()
             extras["gpt_moe_tokens_per_sec_per_chip"] = round(tok_s, 1)
+            extras["gpt_moe_mfu"] = round(mfu, 4)
         except Exception as e:
             log(f"moe bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["gpt_moe_error"] = str(e)[:160]

@@ -29,6 +29,32 @@ class MoEConfig(GPTConfig):
     aux_loss_weight: float = 0.01
     moe_every: int = 2  # every Nth block gets an MoE MLP
 
+    def _n_moe_blocks(self):
+        return sum(1 for i in range(self.num_layers)
+                   if i % self.moe_every == self.moe_every - 1)
+
+    def _expert_mlp_params(self):
+        # one expert's FF: w1 [h,f] + b1 [f] + w2 [f,h] + b2 [h]
+        h, f = self.hidden_size, self.ffn_hidden
+        return 2 * h * f + f + h
+
+    def num_params(self):
+        # dense equivalent + per-MoE-block gate and the (E-1) extra experts
+        # replacing that block's dense MLP
+        extra = self._n_moe_blocks() * (
+            self.hidden_size * self.num_experts
+            + (self.num_experts - 1) * self._expert_mlp_params())
+        return super().num_params() + extra
+
+    def num_active_params(self):
+        """Per-token ACTIVATED parameters (backbone + gate + top_k of the E
+        experts in each MoE block): the N in the 6N FLOPs/token roofline —
+        routed-expert FLOPs scale with top_k, not num_experts."""
+        extra = self._n_moe_blocks() * (
+            self.hidden_size * self.num_experts
+            + (self.top_k - 1) * self._expert_mlp_params())
+        return super().num_params() + extra
+
 
 def _moe_dispatch(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor):
     """x: [T, H] tokens. Returns (y [T, H], aux_loss scalar).
