@@ -508,7 +508,20 @@ def _flatten(t):
 def _unflatten(aux, children):
     cls, sg = aux
     obj = Tensor.__new__(cls)
-    Tensor.__init__(obj, children[0], stop_gradient=sg)
+    v = children[0]
+    if _is_jax_value(v):
+        Tensor.__init__(obj, v, stop_gradient=sg)
+    else:
+        # abstract leaf (ShapeDtypeStruct under eval_shape, aval in
+        # tree_map diagnostics, ...): carry it through unnormalized so
+        # Tensor pytrees survive shape-only tree rebuilds
+        obj._value = v
+        obj.stop_gradient = sg
+        obj.grad = None
+        obj._producer = None
+        obj.name = None
+        obj.persistable = False
+        obj.partition_spec = None
     return obj
 
 

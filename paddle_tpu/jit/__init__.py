@@ -1,8 +1,11 @@
 """paddle_tpu.jit — reference python/paddle/jit (dy2static to_static, save/load).
 
-TPU-native: to_static wraps a Layer/function in jax.jit over its functional
-form. jit.save exports StableHLO text + weights; jit.load restores a callable
-(same artifact role as the reference's saved inference Program).
+TPU-native: to_static first routes tensor-dependent Python control flow
+onto lax.cond/while_loop/scan via the dy2static AST transform (see
+jit/dy2static/), then wraps the Layer/function in jax.jit over its
+functional form. jit.save exports StableHLO text + weights; jit.load
+restores a callable (same artifact role as the reference's saved
+inference Program).
 """
 import os
 import pickle
@@ -14,12 +17,15 @@ import numpy as np
 from ..framework.core import Tensor
 from ..nn.layer_base import Layer, functional_call, state_pytree
 from ..static.input_spec import InputSpec
+from .dy2static import conversion_error, convert_to_static
 
-__all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer"]
+__all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer",
+           "dy2static"]
 
 
 class _StaticFunction:
-    """jax.jit-compiled wrapper around a Layer or python function."""
+    """dy2static-converted, jax.jit-compiled wrapper around a Layer or
+    python function (reference dygraph_to_static.StaticFunction)."""
 
     def __init__(self, fn_or_layer, input_spec=None, donate_params=False):
         self._target = fn_or_layer
@@ -27,21 +33,56 @@ class _StaticFunction:
         self._is_layer = isinstance(fn_or_layer, Layer)
         if self._is_layer:
             layer = fn_or_layer
+            # convert whatever Layer.__call__ would dispatch to: an
+            # instance-assigned forward wins over the class method
+            inst_fwd = layer.__dict__.get("forward")
+            if inst_fwd is not None and hasattr(inst_fwd, "__func__"):
+                conv = convert_to_static(inst_fwd.__func__)
+                bound = lambda *a, **k: conv(layer, *a, **k)  # noqa: E731
+            elif inst_fwd is not None:
+                bound = convert_to_static(inst_fwd)
+            else:
+                conv = convert_to_static(type(layer).forward)
+                bound = lambda *a, **k: conv(layer, *a, **k)  # noqa: E731
+
+            def call_converted(*args, **kwargs):
+                # route through Layer.__call__ (forward pre/post hooks run)
+                # with the converted forward shadowing via the instance dict
+                had = "forward" in layer.__dict__
+                old = layer.__dict__.get("forward")
+                object.__setattr__(layer, "forward", bound)
+                try:
+                    return layer(*args, **kwargs)
+                finally:
+                    if had:
+                        object.__setattr__(layer, "forward", old)
+                    else:
+                        del layer.__dict__["forward"]
+
+            self._dygraph = call_converted
 
             def pure(params, buffers, *args, **kwargs):
                 merged = {**params, **buffers}
                 with functional_call(layer, merged):
-                    out = layer(*args, **kwargs)
+                    out = call_converted(*args, **kwargs)
                 return out
             self._jitted = jax.jit(pure)
         else:
-            fn = fn_or_layer
+            fn = convert_to_static(
+                getattr(fn_or_layer, "__func__", fn_or_layer))
+            if hasattr(fn_or_layer, "__self__"):   # bound method
+                bound_self = fn_or_layer.__self__
+                conv = fn
 
-            def pure(*args, **kwargs):
-                return fn(*args, **kwargs)
-            self._jitted = jax.jit(pure)
+                def fn(*args, **kwargs):
+                    return conv(bound_self, *args, **kwargs)
+            self._dygraph = fn
+            self._jitted = jax.jit(fn)
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.enable_to_static:
+            # dygraph fallback (still control-flow converted, not jitted)
+            return self._dygraph(*args, **kwargs)
         if self._is_layer:
             layer = self._target
             params = state_pytree(layer)
@@ -143,7 +184,11 @@ def set_verbosity(level=0, also_to_stdout=False):
 
 
 class ProgramTranslator:
-    """Reference dy2static ProgramTranslator singleton façade."""
+    """Reference dy2static ProgramTranslator singleton (reference
+    dygraph_to_static/program_translator.py): the entry point for the AST
+    control-flow conversion.  `get_func` returns the converted (but
+    unjitted) function; `enable(False)` makes every _StaticFunction run
+    its converted dygraph path instead of the compiled one."""
     _instance = None
     enable_to_static = True
 
@@ -155,6 +200,16 @@ class ProgramTranslator:
 
     def enable(self, enable_to_static=True):
         ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+    def get_func(self, dygraph_func):
+        """The dy2static-converted function (control flow routed through
+        lax primitives), without jit."""
+        return convert_to_static(
+            getattr(dygraph_func, "__func__", dygraph_func))
+
+    @staticmethod
+    def conversion_error(fn):
+        return conversion_error(getattr(fn, "__func__", fn))
 
 
 class TracedLayer:

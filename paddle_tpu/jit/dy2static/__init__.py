@@ -1,0 +1,30 @@
+"""dy2static: dynamic-graph Python → static (traceable) conversion.
+
+Reference python/paddle/fluid/dygraph/dygraph_to_static/: an AST rewrite
+(transformer.py) routes `if`/`while`/`for`/`and`/`or`/`not` through
+dual-path runtime converters (convert_ops.py) that keep Python semantics
+for concrete values and lower to lax.cond / lax.while_loop / lax.scan for
+traced ones — so `jit.to_static` compiles models with data-dependent
+control flow instead of failing in the tracer.
+"""
+from .convert_ops import (
+    UNDEF,
+    convert_and,
+    convert_for,
+    convert_ifelse,
+    convert_ifelse_ret,
+    convert_len,
+    convert_not,
+    convert_or,
+    convert_range,
+    convert_while_loop,
+    to_bool,
+)
+from .transformer import conversion_error, convert_to_static
+
+__all__ = [
+    "convert_to_static", "conversion_error", "convert_ifelse",
+    "convert_ifelse_ret", "convert_while_loop", "convert_for",
+    "convert_and", "convert_or", "convert_not", "convert_range",
+    "convert_len", "to_bool", "UNDEF",
+]

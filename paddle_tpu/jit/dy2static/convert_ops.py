@@ -1,0 +1,484 @@
+"""Runtime converters for dy2static control flow.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py
+(convert_ifelse / convert_while_loop / convert_logical_*), which lower
+Python control flow to fluid cond/while ops.  TPU-native: the same
+dual-path converters dispatch on the *runtime* type of the condition — a
+concrete Python/array value keeps exact Python semantics (short-circuit,
+early exit, unrolling), while a traced value lowers to `lax.cond` /
+`lax.while_loop` / `lax.scan`, which is what XLA needs for data-dependent
+control flow inside one compiled program.
+
+These are the call targets the AST transformer (transformer.py) rewrites
+`if` / `while` / `for` / `and` / `or` / `not` into; user code never calls
+them directly.  Tensor is a registered pytree, so loop carries and branch
+outputs flow through lax primitives with their wrappers intact.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = [
+    "UNDEF", "arg", "convert_ifelse", "convert_ifelse_ret",
+    "convert_while_loop", "convert_for", "convert_and", "convert_or",
+    "convert_not", "convert_range", "convert_len", "to_bool",
+]
+
+
+class _Undefined:
+    """Placeholder for a name with no binding at the conversion point (a
+    variable first assigned inside the converted block) — reference
+    variable_trans_func.create_undefined_variable.  Loud on accidental
+    use."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "dy2static: variable used before assignment (it is only set on "
+            "one path of converted control flow)")
+
+
+UNDEF = _Undefined()
+
+
+def arg(thunk):
+    """Evaluate `lambda: name` from generated code; unbound names become
+    UNDEF instead of raising, so variables first assigned inside the block
+    can still be threaded through the functionalized call."""
+    try:
+        return thunk()
+    except NameError:       # includes UnboundLocalError and free-var errors
+        return UNDEF
+
+
+def _raw(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_raw(v), jax.core.Tracer)
+
+
+def to_bool(pred, ctx="condition"):
+    """Truthiness for the dual path: a Python bool when the value is
+    concrete, a scalar bool tracer when traced."""
+    p = _raw(pred)
+    if isinstance(p, jax.core.Tracer):
+        if getattr(p, "size", 1) != 1:
+            raise ValueError(
+                f"dy2static: {ctx} is an array of {p.size} elements; a "
+                "branch/loop condition must be a single boolean (reduce "
+                "with .any()/.all() first)")
+        return jnp.reshape(p, ()).astype(bool)
+    if isinstance(p, (jax.Array, np.ndarray)):
+        if p.size != 1:
+            raise ValueError(
+                f"dy2static: {ctx} is an array of {p.size} elements; a "
+                "branch/loop condition must be a single boolean (reduce "
+                "with .any()/.all() first)")
+        return bool(p.reshape(())) if isinstance(p, np.ndarray) else bool(p)
+    return bool(p)
+
+
+def _is_dyn(v):
+    """Can this value ride through a lax primitive as an operand?"""
+    if v is UNDEF:
+        return False
+    return isinstance(v, (Tensor, jax.Array, jax.core.Tracer, np.ndarray,
+                          bool, int, float, complex, np.generic))
+
+
+def _split(vals):
+    mask = tuple(_is_dyn(v) for v in vals)
+    dyn = [v for v, m in zip(vals, mask) if m]
+    stat = [v for v, m in zip(vals, mask) if not m]
+    return dyn, stat, mask
+
+
+def _merge(dyn, stat, mask):
+    out, i, j = [], 0, 0
+    for m in mask:
+        if m:
+            out.append(dyn[i])
+            i += 1
+        else:
+            out.append(stat[j])
+            j += 1
+    return tuple(out)
+
+
+def _check_same_static(name, a, b):
+    same = a is b
+    if not same:
+        try:
+            same = bool(a == b)
+        except Exception:
+            same = False
+    if not same:
+        raise TypeError(
+            f"dy2static: non-tensor variable {name!r} takes different "
+            f"values on the branches of tensor-dependent control flow "
+            f"({a!r} vs {b!r}); only tensor/numeric values can depend on a "
+            "traced condition")
+
+
+def _dyn_names(names, mask):
+    return [n for n, m in zip(names, mask) if m] or list(names)
+
+
+# --------------------------------------------------------------------------
+# if / else
+# --------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, operands, names=()):
+    """`if`-statement converter.  `operands` holds the current values of
+    every name either branch assigns; both fns take and return that full
+    tuple (the transformer generates them that way)."""
+    p = to_bool(pred, "`if` condition")
+    if not isinstance(p, jax.core.Tracer):
+        return (true_fn if p else false_fn)(*operands)
+
+    dyn, stat, mask = _split(operands)
+    stash = {}
+
+    def run(fn, tag):
+        def inner(dyn_in):
+            outs = fn(*_merge(list(dyn_in), stat, mask))
+            nd, ns, nm = _split(outs)
+            stash[tag] = (ns, nm)
+            return tuple(nd)
+        return inner
+
+    # pre-check with eval_shape for readable errors (lax.cond's structure
+    # errors don't mention the user's variable names)
+    dyn_in = tuple(dyn)
+    try:
+        t_out = jax.eval_shape(run(true_fn, "t"), dyn_in)
+        f_out = jax.eval_shape(run(false_fn, "f"), dyn_in)
+    except TypeError as e:
+        raise TypeError(
+            f"dy2static: a branch of a tensor-dependent `if` assigning "
+            f"{list(names)} produced a non-traceable value: {e}") from None
+    if stash["t"][1] != stash["f"][1]:
+        raise TypeError(
+            f"dy2static: the branches of a tensor-dependent `if` disagree "
+            f"on which of {list(names)} are tensors; a variable set in "
+            "only one branch must already have a tensor value before the "
+            "`if`")
+    _check_branch_match(t_out, f_out, names)
+    for n, a, b in zip([nm for nm, m in zip(names, stash["t"][1]) if not m],
+                       stash["t"][0], stash["f"][0]):
+        _check_same_static(n, a, b)
+
+    outs = jax.lax.cond(p, run(true_fn, "t"), run(false_fn, "f"), dyn_in)
+    ns, nm = stash["t"]
+    return _merge(list(outs), ns, nm)
+
+
+def _check_branch_match(t_out, f_out, names):
+    t_flat, t_tree = jax.tree_util.tree_flatten(t_out)
+    f_flat, f_tree = jax.tree_util.tree_flatten(f_out)
+    if t_tree != f_tree or len(t_flat) != len(f_flat):
+        raise TypeError(
+            f"dy2static: the branches of a tensor-dependent `if` produce "
+            f"different structures for {list(names)} ({t_tree} vs {f_tree})")
+    for i, (a, b) in enumerate(zip(t_flat, f_flat)):
+        nm = names[i] if i < len(names) else f"value {i}"
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            raise TypeError(
+                f"dy2static: {nm!r} is {tuple(a.shape)}/{a.dtype} on the "
+                f"true branch but {tuple(b.shape)}/{b.dtype} on the false "
+                "branch; both sides of a tensor-dependent `if` must "
+                "produce matching tensors")
+
+
+def convert_ifelse_ret(pred, true_fn, false_fn):
+    """Both-branches-return form: the converted statement returns the
+    chosen branch's return value directly."""
+    p = to_bool(pred, "`if` condition")
+    if not isinstance(p, jax.core.Tracer):
+        return (true_fn if p else false_fn)()
+    t_out = jax.eval_shape(lambda: true_fn())
+    f_out = jax.eval_shape(lambda: false_fn())
+    _check_branch_match(t_out, f_out, ("return value",))
+    return jax.lax.cond(p, lambda _: true_fn(), lambda _: false_fn(), 0)
+
+
+# --------------------------------------------------------------------------
+# while / for
+# --------------------------------------------------------------------------
+
+def _stable_dtypes(body_flat, init_flat, names):
+    """Fixed-point the carry dtypes (e.g. `x = x / 2` promotes an int
+    carry to float): promote the initial carry until one body application
+    is dtype-stable, with shape changes reported by name."""
+    dtypes = [jnp.result_type(x) for x in init_flat]
+    shapes = [jnp.shape(x) for x in init_flat]
+    for _ in range(4):
+        avals = tuple(jax.ShapeDtypeStruct(s, d)
+                      for s, d in zip(shapes, dtypes))
+        out = jax.eval_shape(body_flat, avals)
+        for i, o in enumerate(out):
+            if tuple(o.shape) != tuple(shapes[i]):
+                nm = names[i] if i < len(names) else f"carry {i}"
+                raise TypeError(
+                    f"dy2static: loop variable {nm!r} changes shape "
+                    f"{tuple(shapes[i])} -> {tuple(o.shape)} across "
+                    "iterations; tensor loops need shape-stable carries "
+                    "(pad or restructure the loop)")
+        new = [jnp.promote_types(d, o.dtype) for d, o in zip(dtypes, out)]
+        if new == dtypes:
+            return dtypes
+        dtypes = new
+    return dtypes
+
+
+def _check_no_undef(names, operands, kind):
+    for n, v in zip(names, operands):
+        if v is UNDEF:
+            raise TypeError(
+                f"dy2static: loop variable {n!r} is carried by a "
+                f"tensor-dependent `{kind}` loop but has no value before "
+                "it; initialize it before the loop")
+
+
+def convert_while_loop(cond_fn, body_fn, operands, names=()):
+    """`while` converter: operands are every name the loop carries (read
+    by the condition, loop-carried in the body, or read after the loop)."""
+    test = to_bool(cond_fn(*operands), "`while` condition")
+    if not isinstance(test, jax.core.Tracer):
+        vals = operands
+        while test:
+            vals = body_fn(*vals)
+            test = to_bool(cond_fn(*vals), "`while` condition")
+            if isinstance(test, jax.core.Tracer):
+                # the condition became traced mid-flight (first iteration
+                # produced a tracer): continue on the traced path
+                return _traced_while(cond_fn, body_fn, vals, names)
+        return vals
+    return _traced_while(cond_fn, body_fn, operands, names)
+
+
+def _traced_while(cond_fn, body_fn, operands, names):
+    _check_no_undef(names, operands, "while")
+    dyn, stat, mask = _split(operands)
+    dyn_flat, dyn_tree = jax.tree_util.tree_flatten(tuple(dyn))
+    static_names = [n for n, m in zip(names, mask) if not m]
+
+    def cond(flat):
+        vals = _merge(list(jax.tree_util.tree_unflatten(dyn_tree, flat)),
+                      stat, mask)
+        return to_bool(cond_fn(*vals), "`while` condition")
+
+    def body_raw(flat):
+        vals = _merge(list(jax.tree_util.tree_unflatten(dyn_tree, flat)),
+                      stat, mask)
+        outs = body_fn(*vals)
+        nd, ns, nm = _split(outs)
+        if nm != mask:
+            raise TypeError(
+                f"dy2static: the `while` body changed which of "
+                f"{list(names)} are tensors; loop variables must stay "
+                "tensor/numeric")
+        for n, a, b in zip(static_names, stat, ns):
+            _check_same_static(n, a, b)
+        new_flat, new_tree = jax.tree_util.tree_flatten(tuple(nd))
+        if new_tree != dyn_tree:
+            raise TypeError(
+                f"dy2static: the `while` body changed the structure of "
+                f"loop variables {list(names)}")
+        return new_flat
+
+    leaf_names = _dyn_names(names, mask)
+    init_flat = [jnp.asarray(_plain(x)) for x in dyn_flat]
+    dtypes = _stable_dtypes(body_raw, init_flat, leaf_names)
+    init = tuple(x.astype(d) for x, d in zip(init_flat, dtypes))
+
+    def body(flat):
+        return tuple(jnp.asarray(_plain(v)).astype(d)
+                     for v, d in zip(body_raw(list(flat)), dtypes))
+
+    out_flat = jax.lax.while_loop(cond, body, init)
+    return _merge(list(jax.tree_util.tree_unflatten(dyn_tree,
+                                                    list(out_flat))),
+                  stat, mask)
+
+
+def _plain(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def convert_for(iterable, body_fn, operands, names=(), target_arity=1):
+    """`for` converter.  A Tensor/traced iterable scans over its leading
+    axis with `lax.scan`; any other iterable keeps the Python loop (which
+    unrolls under jit — the natural XLA behavior for static trip
+    counts)."""
+    if isinstance(iterable, _TracedRange):
+        return _traced_range_for(iterable, body_fn, operands, names,
+                                 target_arity)
+    it = _raw(iterable)
+    if not isinstance(it, jax.core.Tracer):
+        vals = operands
+        for x in iterable:
+            if target_arity == 1:
+                vals = body_fn(x, *vals)
+            else:
+                vals = body_fn(*tuple(x), *vals)
+        return vals
+
+    _check_no_undef(names, operands, "for")
+    dyn, stat, mask = _split(operands)
+    dyn_flat, dyn_tree = jax.tree_util.tree_flatten(tuple(dyn))
+    static_names = [n for n, m in zip(names, mask) if not m]
+    wrap = Tensor if isinstance(iterable, Tensor) else (lambda x: x)
+
+    def step_raw(flat, x):
+        vals = _merge(list(jax.tree_util.tree_unflatten(dyn_tree, flat)),
+                      stat, mask)
+        if target_arity == 1:
+            xs = (wrap(x),)
+        else:
+            xs = tuple(wrap(x[i]) for i in range(target_arity))
+        outs = body_fn(*xs, *vals)
+        nd, ns, nm = _split(outs)
+        if nm != mask:
+            raise TypeError(
+                f"dy2static: the `for` body changed which of "
+                f"{list(names)} are tensors; loop variables must stay "
+                "tensor/numeric")
+        for n, a, b in zip(static_names, stat, ns):
+            _check_same_static(n, a, b)
+        new_flat, new_tree = jax.tree_util.tree_flatten(tuple(nd))
+        if new_tree != dyn_tree:
+            raise TypeError(
+                f"dy2static: the `for` body changed the structure of loop "
+                f"variables {list(names)}")
+        return new_flat
+
+    leaf_names = _dyn_names(names, mask)
+    init_flat = [jnp.asarray(_plain(x)) for x in dyn_flat]
+    x0 = it[0] if it.shape[0] else it  # aval probe only
+    dtypes = _stable_dtypes(lambda flat: step_raw(list(flat), x0),
+                            init_flat, leaf_names)
+    init = tuple(x.astype(d) for x, d in zip(init_flat, dtypes))
+
+    def step(flat, x):
+        out = step_raw(list(flat), x)
+        return tuple(jnp.asarray(_plain(v)).astype(d)
+                     for v, d in zip(out, dtypes)), None
+
+    carry, _ = jax.lax.scan(step, init, it)
+    return _merge(list(jax.tree_util.tree_unflatten(dyn_tree, list(carry))),
+                  stat, mask)
+
+
+class _TracedRange:
+    """range() with a traced bound: no concrete length exists, so the
+    `for` lowers to lax.while_loop over the index instead of a scan."""
+
+    def __init__(self, start, stop, step):
+        self.start, self.stop, self.step = start, stop, step
+
+
+def _traced_range_for(rng, body_fn, operands, names, target_arity):
+    """`for i in range(<traced bound>)`: lax.while_loop carrying
+    (index, *loop_vars)."""
+    if target_arity != 1:
+        raise TypeError("dy2static: cannot unpack a range() loop target")
+    _check_no_undef(names, operands, "for")
+
+    def cond_fn(i, *vals):
+        step = rng.step
+        fwd = jnp.logical_and(jnp.asarray(step > 0), i < rng.stop)
+        bwd = jnp.logical_and(jnp.asarray(step < 0), i > rng.stop)
+        return jnp.logical_or(fwd, bwd)
+
+    def step_fn(i, *vals):
+        outs = body_fn(i, *vals)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return (i + rng.step,) + outs
+
+    out = _traced_while(cond_fn, step_fn,
+                        (jnp.asarray(rng.start),) + tuple(operands),
+                        ("<range index>",) + tuple(names))
+    return out[1:]
+
+
+def convert_range(*args):
+    """`range(...)` in a converted `for` header: Python range for concrete
+    bounds, a while-loop marker for tensor bounds."""
+    vals = [_raw(a) for a in args]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        vals = [jnp.reshape(v, ()) if isinstance(v, jax.core.Tracer)
+                else int(v) for v in vals]
+        if len(vals) == 1:
+            return _TracedRange(0, vals[0], 1)
+        if len(vals) == 2:
+            return _TracedRange(vals[0], vals[1], 1)
+        return _TracedRange(*vals[:3])
+    return range(*[int(v) for v in vals])
+
+
+def convert_len(x):
+    v = _raw(x)
+    if isinstance(v, (jax.Array, jax.core.Tracer, np.ndarray)):
+        if v.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return v.shape[0]
+    return len(x)
+
+
+# --------------------------------------------------------------------------
+# boolean operators
+# --------------------------------------------------------------------------
+
+def convert_and(*thunks):
+    """Short-circuit `and` chain.  Python semantics for concrete values;
+    a traced operand switches to elementwise logical_and (the graph
+    meaning — reference convert_logical_and)."""
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if not _is_traced(val):
+            if not val:
+                return val
+            val = t()
+        else:
+            val = _logical(jnp.logical_and, val, t())
+    return val
+
+
+def convert_or(*thunks):
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if not _is_traced(val):
+            if val:
+                return val
+            val = t()
+        else:
+            val = _logical(jnp.logical_or, val, t())
+    return val
+
+
+def _logical(op, a, b):
+    out = op(jnp.asarray(_raw(a)).astype(bool),
+             jnp.asarray(_raw(b)).astype(bool))
+    return Tensor(out) if isinstance(a, Tensor) or isinstance(b, Tensor) \
+        else out
+
+
+def convert_not(x):
+    if _is_traced(x):
+        out = jnp.logical_not(jnp.asarray(_raw(x)).astype(bool))
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return not x

@@ -1,0 +1,590 @@
+"""AST transformation for dy2static (reference program_translator.py +
+ifelse_transformer.py / loop_transformer.py / logical_transformer.py).
+
+`convert_to_static(fn)` rewrites a function's source so Python control
+flow that *might* depend on tensors is routed through the dual-path
+runtime converters in convert_ops:
+
+    if t.sum() > 0: x = x + 1        →  functionalized branch fns + _jst.convert_ifelse
+    while norm(x) > eps: x = f(x)    →  cond/body fns + _jst.convert_while_loop
+    for row in tensor: acc += row    →  body fn + _jst.convert_for (lax.scan)
+    a and b / not a                  →  _jst.convert_and / _jst.convert_not
+
+Concrete (non-traced) conditions keep exact Python semantics, so the
+transform is safe to apply universally; traced conditions lower to
+lax.cond / lax.while_loop / lax.scan.
+
+Deliberately NOT functionalized (left as plain Python, which still works
+for concrete conditions and raises jax's tracer error for traced ones):
+blocks containing `break`/`continue` bound to an enclosing loop, early
+returns that don't cover both branches, `global`/`nonlocal`, loop-`else`.
+"""
+import ast
+import functools
+import inspect
+import linecache
+import textwrap
+import types
+import weakref
+
+from . import convert_ops as _jst_mod
+
+_TEMPLATES = {}    # fn.__code__ -> (module_code, fdef_name, kept_decorators)
+_CONVERTED = weakref.WeakKeyDictionary()   # fn -> converted fn (per closure)
+_FAILED = {}       # fn.__code__ -> reason string (for diagnostics)
+
+
+# --------------------------------------------------------------------------
+# name analysis
+# --------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda, ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+
+
+def _walk_same_scope(node, into_loops=True):
+    """Yield nodes in the same variable scope (don't descend into nested
+    function/class/comprehension scopes — including when the root itself
+    opens one)."""
+    if isinstance(node, _SCOPE_NODES):
+        return
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE_NODES):
+            continue
+        if not into_loops and isinstance(n, (ast.While, ast.For)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _stores(stmts):
+    """Ordered simple-Name assignment targets in these statements (same
+    scope): Assign/AugAssign/AnnAssign/NamedExpr/For-target/With-as."""
+    seen, out = set(), []
+
+    def add(name):
+        if name not in seen:
+            seen.add(name)
+            out.append(name)
+
+    def targets_of(t):
+        if isinstance(t, ast.Name):
+            add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+
+    for stmt in stmts:
+        for n in [stmt] + list(_walk_same_scope(stmt)):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    targets_of(t)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets_of(n.target)
+            elif isinstance(n, ast.NamedExpr):
+                targets_of(n.target)
+            elif isinstance(n, ast.For):
+                targets_of(n.target)
+            elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+                targets_of(n.optional_vars)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                add(n.name)
+    # generated helpers are scoped to the statement that consumes them and
+    # must never count as user variables to thread through conversions
+    return [n for n in out if not n.startswith("_pt_") and n != "_jst"]
+
+
+def _reads(node):
+    """All Name loads under `node`, INCLUDING nested scopes (a nested def
+    reads its free variables when called — conservative is correct
+    here)."""
+    out = set()
+    nodes = [node] if isinstance(node, ast.AST) else list(node)
+    for root in nodes:
+        for n in ast.walk(root):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+    return out
+
+
+def _use_before_def(stmts, candidates):
+    """Which of `candidates` are read before they are (re)assigned when
+    executing `stmts` linearly — i.e. loop-carried names.  Compound
+    statements are approximated: their reads count first, then their
+    stores."""
+    carried, defined = set(), set()
+    for stmt in stmts:
+        for name in _reads(stmt):
+            if name in candidates and name not in defined:
+                carried.add(name)
+        for name in _stores([stmt]):
+            defined.add(name)
+    return carried
+
+
+def _contains(node, kinds, stop=()):
+    """Does `node` contain any statement of `kinds` in the same
+    scope/binding region (not descending into `stop` node types)?"""
+    if isinstance(node, _SCOPE_NODES) or (stop and isinstance(node, stop)):
+        return False
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, kinds):
+            return True
+        if isinstance(n, _SCOPE_NODES) or isinstance(n, stop):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _has_return(stmts):
+    return any(_contains_self(s, ast.Return) for s in stmts)
+
+
+def _contains_self(node, kinds):
+    if isinstance(node, kinds):
+        return True
+    return _contains(node, kinds if isinstance(kinds, tuple) else (kinds,))
+
+
+def _has_loop_jump(stmts):
+    """break/continue bound to an ENCLOSING loop (not one inside)."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.While, ast.For)):
+            continue  # binds its own break/continue
+        if isinstance(s, _SCOPE_NODES):
+            continue
+        if _contains(s, (ast.Break, ast.Continue), stop=(ast.While, ast.For)):
+            return True
+    return False
+
+
+def _has_scope_escape(stmts):
+    for s in stmts:
+        if _contains_self(s, (ast.Global, ast.Nonlocal, ast.Delete)):
+            return True
+    return False
+
+
+def _ends_in_return(stmts):
+    """Every execution path through `stmts` ends in `return`?  (tail
+    return, or an if whose both branches end in return)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return _ends_in_return(last.body) and _ends_in_return(last.orelse)
+    return False
+
+
+def _fold_early_returns(stmts, is_func_tail):
+    """Rewrite `if c: ...return` followed by REST into `if c: ... else:
+    REST` (semantics-identical since the body always returns), so the
+    both-branches-return functionalization can lower early-return guards —
+    the most common data-dependent `if` shape.  Only statement lists whose
+    fall-through means "function returns None" may have an implicit
+    `return None` appended."""
+    stmts = list(stmts)
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.If):
+            rest = stmts[i + 1:]
+            st.body[:] = _fold_early_returns(st.body,
+                                             is_func_tail and not rest)
+            st.orelse[:] = _fold_early_returns(st.orelse,
+                                               is_func_tail and not rest)
+            if (not st.orelse and _ends_in_return(st.body)
+                    and not _has_loop_jump(st.body)):
+                if rest:
+                    st.orelse = _fold_early_returns(rest, is_func_tail)
+                    if is_func_tail and not _ends_in_return(st.orelse):
+                        st.orelse.append(
+                            ast.Return(value=ast.Constant(value=None)))
+                    del stmts[i + 1:]
+                    return stmts
+                if is_func_tail:
+                    st.orelse = [ast.Return(value=ast.Constant(value=None))]
+        elif isinstance(st, (ast.While, ast.For, ast.With)):
+            st.body[:] = _fold_early_returns(st.body, False)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            st.body[:] = _fold_early_returns(st.body, True)
+    return stmts
+
+
+def _compute_tail_reads(fdef):
+    """For every While/For node: the names read after the loop finishes,
+    including re-reads by the next iteration of any ENCLOSING loop."""
+    out = {}
+
+    def walk(stmts, after):
+        acc = set(after)
+        for st in reversed(stmts):
+            if isinstance(st, (ast.While, ast.For)):
+                out[id(st)] = acc | _reads(st)
+                walk(st.body, out[id(st)])
+                walk(st.orelse, acc)
+            elif isinstance(st, ast.If):
+                walk(st.body, acc)
+                walk(st.orelse, acc)
+            elif isinstance(st, ast.With):
+                walk(st.body, acc)
+            elif isinstance(st, ast.Try):
+                for part in (st.body, st.orelse, st.finalbody):
+                    walk(part, acc)
+                for h in st.handlers:
+                    walk(h.body, acc)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(st.body, acc)
+            acc |= _reads(st)
+        return acc
+
+    walk(fdef.body, set())
+    return out
+
+
+# --------------------------------------------------------------------------
+# AST building helpers
+# --------------------------------------------------------------------------
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst(attr):
+    return ast.Attribute(value=_name("_jst"), attr=attr, ctx=ast.Load())
+
+
+def _call(func, args=(), kwargs=()):
+    return ast.Call(func=func, args=list(args),
+                    keywords=[ast.keyword(arg=k, value=v)
+                              for k, v in kwargs])
+
+
+def _const_tuple(names):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+def _arg_thunk(name):
+    """_jst.arg(lambda: name)"""
+    lam = ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=_name(name))
+    return _call(_jst("arg"), [lam])
+
+
+def _make_fn(name, params, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p, annotation=None) for p in params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body, decorator_list=[], returns=None)
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(elts=[_name(n) for n in names],
+                                      ctx=ast.Load()))
+
+
+def _assign_tuple(names, value):
+    return ast.Assign(
+        targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                           ctx=ast.Store())],
+        value=value)
+
+
+# --------------------------------------------------------------------------
+# the transformer
+# --------------------------------------------------------------------------
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, tail_reads, self_name=None, has_class_cell=False):
+        self._tail_reads = tail_reads
+        self._self_name = self_name
+        self._has_class_cell = has_class_cell
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- zero-arg super() --------------------------------------------------
+    def visit_Call(self, node):
+        """`super()` relies on the compiler-injected __class__ cell, which
+        a recompiled def outside its class body doesn't get: make the
+        arguments explicit (`super(__class__, self)`) so __class__ rides
+        the normal free-variable path."""
+        self.generic_visit(node)
+        if (self._has_class_cell and self._self_name
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "super"
+                and not node.args and not node.keywords):
+            node.args = [_name("__class__"), _name(self._self_name)]
+        return node
+
+    # -- boolean operators -------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = "convert_and" if isinstance(node.op, ast.And) else "convert_or"
+        thunks = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=v) for v in node.values]
+        return _call(_jst(conv), thunks)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call(_jst("convert_not"), [node.operand])
+        return node
+
+    # -- if ----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body_ret = _has_return(node.body)
+        orelse_ret = _has_return(node.orelse)
+        if body_ret or orelse_ret:
+            # only the both-tails-return shape is functionalized; other
+            # early-return shapes stay Python (fine for concrete preds)
+            if (node.orelse and _ends_in_return(node.body)
+                    and _ends_in_return(node.orelse)
+                    and not _has_loop_jump(node.body)
+                    and not _has_loop_jump(node.orelse)
+                    and not _has_scope_escape(node.body + node.orelse)):
+                uid = self._uid()
+                tname, fname = f"_pt_ret_true_{uid}", f"_pt_ret_false_{uid}"
+                t_fn = _make_fn(tname, [], node.body)
+                f_fn = _make_fn(fname, [], node.orelse)
+                ret = ast.Return(value=_call(
+                    _jst("convert_ifelse_ret"),
+                    [node.test, _name(tname), _name(fname)]))
+                return [t_fn, f_fn, ret]
+            return node
+        if (_has_loop_jump(node.body) or _has_loop_jump(node.orelse)
+                or _has_scope_escape(node.body + node.orelse)):
+            return node
+        mod = _stores(node.body + node.orelse)
+        if not mod:
+            return node   # side-effect-only if: nothing to functionalize
+        uid = self._uid()
+        tname, fname = f"_pt_true_{uid}", f"_pt_false_{uid}"
+        t_fn = _make_fn(tname, mod, node.body + [_ret_tuple(mod)])
+        f_fn = _make_fn(fname, mod,
+                        (node.orelse or [ast.Pass()]) + [_ret_tuple(mod)])
+        call = _call(_jst("convert_ifelse"),
+                     [node.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[_arg_thunk(n) for n in mod],
+                                ctx=ast.Load()),
+                      _const_tuple(mod)])
+        return [t_fn, f_fn, _assign_tuple(mod, call)]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node):
+        tail = self._tail_reads.get(id(node), set())
+        self.generic_visit(node)
+        if (node.orelse or _has_loop_jump(node.body)
+                or _has_return(node.body)
+                or _has_scope_escape(node.body)):
+            return node
+        stored = _stores(node.body)
+        if not stored:
+            return node
+        carried = _use_before_def(node.body, set(stored))
+        test_reads = _reads(node.test)
+        loop_vars = [n for n in stored
+                     if n in carried or n in test_reads or n in tail]
+        if not loop_vars:
+            return node
+        uid = self._uid()
+        cname, bname = f"_pt_while_cond_{uid}", f"_pt_while_body_{uid}"
+        c_fn = _make_fn(cname, loop_vars, [ast.Return(value=node.test)])
+        b_fn = _make_fn(bname, loop_vars, node.body + [_ret_tuple(loop_vars)])
+        call = _call(_jst("convert_while_loop"),
+                     [_name(cname), _name(bname),
+                      ast.Tuple(elts=[_arg_thunk(n) for n in loop_vars],
+                                ctx=ast.Load()),
+                      _const_tuple(loop_vars)])
+        return [c_fn, b_fn, _assign_tuple(loop_vars, call)]
+
+    # -- for ---------------------------------------------------------------
+    def visit_For(self, node):
+        tail = self._tail_reads.get(id(node), set())
+        self.generic_visit(node)
+        if (node.orelse or _has_loop_jump(node.body)
+                or _has_return(node.body)
+                or _has_scope_escape(node.body)):
+            return node
+        # target must be a simple name or flat tuple of names
+        if isinstance(node.target, ast.Name):
+            tnames = [node.target.id]
+        elif isinstance(node.target, ast.Tuple) and all(
+                isinstance(e, ast.Name) for e in node.target.elts):
+            tnames = [e.id for e in node.target.elts]
+        else:
+            return node
+        stored = [n for n in _stores(node.body) if n not in tnames]
+        carried = _use_before_def(node.body, set(stored))
+        loop_vars = [n for n in stored if n in carried or n in tail]
+        if not loop_vars:
+            return node
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range"):
+            it = _call(_jst("convert_range"), it.args)
+        uid = self._uid()
+        bname = f"_pt_for_body_{uid}"
+        b_fn = _make_fn(bname, tnames + loop_vars,
+                        node.body + [_ret_tuple(loop_vars)])
+        call = _call(_jst("convert_for"),
+                     [it, _name(bname),
+                      ast.Tuple(elts=[_arg_thunk(n) for n in loop_vars],
+                                ctx=ast.Load()),
+                      _const_tuple(loop_vars)],
+                     kwargs=[("target_arity",
+                              ast.Constant(value=len(tnames)))])
+        return [b_fn, _assign_tuple(loop_vars, call)]
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+def convert_to_static(fn, verbose=False):
+    """Rewrite `fn`'s tensor-dependent control flow onto lax primitives.
+    Falls back to `fn` unchanged when the source is unavailable or the
+    transform fails (trace-only to_static still works for straight-line
+    code).
+
+    The transformed TEMPLATE is cached per code object, but each distinct
+    function (closure) gets its own converted function bound to its OWN
+    closure cells — factory-made functions stay independent and see later
+    cell mutations."""
+    key = getattr(fn, "__code__", None)
+    if key is None:
+        return fn
+    try:
+        hit = _CONVERTED.get(fn)
+    except TypeError:       # unhashable callable
+        hit = None
+    if hit is not None:
+        return hit
+    if key in _FAILED:
+        return fn
+    try:
+        new_fn = _convert(fn)
+    except Exception as e:  # pragma: no cover - diagnostics path
+        _FAILED[key] = f"{type(e).__name__}: {e}"
+        if verbose:
+            import traceback
+            traceback.print_exc()
+        return fn
+    try:
+        _CONVERTED[fn] = new_fn
+    except TypeError:
+        pass
+    return new_fn
+
+
+def conversion_error(fn):
+    """Why convert_to_static fell back for this function (or None)."""
+    return _FAILED.get(getattr(fn, "__code__", None))
+
+
+_TO_STATIC_DECOS = ("to_static", "not_to_static")
+
+
+def _build_template(fn):
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise TypeError(f"not a function def: {type(fdef).__name__}")
+    # strip only the decorator that triggered conversion; semantic
+    # decorators (@no_grad(), ...) must keep wrapping the converted fn
+    kept = []
+    for d in fdef.decorator_list:
+        text = ast.unparse(d)
+        if not any(text == t or text.endswith("." + t)
+                   or text.startswith(t + "(") or ("." + t + "(") in text
+                   for t in _TO_STATIC_DECOS):
+            kept.append(d)
+    fdef.decorator_list = kept
+    fdef.body[:] = _fold_early_returns(fdef.body, True)
+    tail_reads = _compute_tail_reads(fdef)
+    self_name = fdef.args.args[0].arg if fdef.args.args else None
+    has_class_cell = "__class__" in fn.__code__.co_freevars
+    _CtrlFlowTransformer(tail_reads, self_name, has_class_cell).visit(fdef)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        factory = _make_fn("__dy2st_factory", list(freevars),
+                           [fdef, ast.Return(value=_name(fdef.name))])
+        module = ast.Module(body=[factory], type_ignores=[])
+    else:
+        module = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(module)
+
+    filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
+    code = compile(module, filename, "exec")
+    # make the generated source inspectable in tracebacks
+    try:
+        gen_src = ast.unparse(module)
+        linecache.cache[filename] = (len(gen_src), None,
+                                     [l + "\n" for l in gen_src.split("\n")],
+                                     filename)
+    except Exception:
+        pass
+    return code, fdef.name, bool(kept)
+
+
+def _convert(fn):
+    key = fn.__code__
+    if key not in _TEMPLATES:
+        _TEMPLATES[key] = _build_template(fn)
+    code, name, has_decorators = _TEMPLATES[key]
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _jst_mod
+    exec(code, glb)
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # build once with placeholder cells, then rebind the ORIGINAL
+        # cells so the converted function shares this closure's live state
+        inner = glb["__dy2st_factory"](*([None] * len(freevars)))
+        cellmap = dict(zip(freevars, fn.__closure__))
+        if (has_decorators
+                or any(n not in cellmap
+                       for n in inner.__code__.co_freevars)):
+            # a kept decorator wraps the inner fn (its code isn't ours to
+            # rebind): fall back to snapshotting the cell contents
+            new_fn = glb["__dy2st_factory"](
+                *[c.cell_contents for c in fn.__closure__])
+        else:
+            new_fn = types.FunctionType(
+                inner.__code__, glb, fn.__name__, fn.__defaults__,
+                tuple(cellmap[n] for n in inner.__code__.co_freevars))
+    else:
+        new_fn = glb[name]
+    try:
+        new_fn.__defaults__ = fn.__defaults__
+        new_fn.__kwdefaults__ = fn.__kwdefaults__
+    except (AttributeError, TypeError):
+        pass   # decorated wrapper without writable defaults
+    functools.update_wrapper(new_fn, fn, updated=())
+    new_fn.__dy2static__ = True
+    return new_fn
