@@ -1,5 +1,7 @@
 """Extension functionals — reference python/paddle/nn/functional/extension.py
 + transformer attention entry points (fused path in paddle_tpu.ops)."""
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -90,7 +92,57 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     return apply_op(_f, *args)
 
 
-def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
-    raise NotImplementedError(
-        "block-sparse attention lands with the Pallas kernel set; use "
-        "scaled_dot_product_attention (flash) instead")
+@functools.lru_cache(maxsize=16)
+def _cached_block_layout(off_bytes, off_shape, col_bytes, col_shape, L):
+    """Sparsity patterns are static across steps: the O(L^2) host-side
+    block-alignment detection runs once per distinct CSR, not per call."""
+    from ...ops import block_sparse_attention as _bsa
+    off = np.frombuffer(off_bytes, np.int32).reshape(off_shape)
+    cols = np.frombuffer(col_bytes, np.int32).reshape(col_shape)
+    return _bsa.csr_to_block_layout(off, cols, L)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR-sparsified softmax(QK^T/sqrt(d))V — reference
+    python/paddle/nn/functional/sparse_attention.py:20 (CUDA
+    sparse_attention_op).  q/k/v: [B, H, L, D]; offset [B, H, L+1];
+    columns [B, H, nnz]; masks use 0 = masked.
+
+    TPU-native: when the CSR pattern is concrete, mask-free and exactly
+    block-aligned, it runs the blocked-CSR Pallas kernel
+    (ops/block_sparse_attention.py) whose compute scales with nonzero
+    blocks; otherwise a dense-masked XLA path with identical semantics."""
+    from ...ops import block_sparse_attention as _bsa
+
+    L = query.shape[-2]
+    raw = lambda t: t._value if isinstance(t, Tensor) else t
+    layout = None
+    if key_padding_mask is None and attn_mask is None:
+        try:
+            off = np.asarray(raw(sparse_csr_offset)).astype(np.int32)
+            cols = np.asarray(raw(sparse_csr_columns)).astype(np.int32)
+            layout = _cached_block_layout(off.tobytes(), off.shape,
+                                          cols.tobytes(), cols.shape, L)
+        except Exception:   # traced CSR (inside jit) → dense fallback
+            layout = None
+    if layout is not None:
+        bs, bcols, bcounts = layout
+
+        def _kern(q, k, v):
+            return _bsa.block_sparse_attention(q, k, v, bcols, bcounts, bs)
+        return apply_op(_kern, query, key, value)
+
+    def _dense(q, k, v, off, cols, *masks):
+        mask = _bsa.csr_element_mask(off, cols, L)
+        kpm = masks[0] if key_padding_mask is not None else None
+        am = masks[-1] if attn_mask is not None else None
+        return _bsa.dense_mask_sparse_attention(q, k, v, mask, kpm, am)
+
+    args = (query, key, value, sparse_csr_offset, sparse_csr_columns)
+    if key_padding_mask is not None:
+        args += (key_padding_mask,)
+    if attn_mask is not None:
+        args += (attn_mask,)
+    return apply_op(_dense, *args)
