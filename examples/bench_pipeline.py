@@ -37,7 +37,7 @@ def main():
              "labels": ids[:, 1:].astype("int32")}
 
     results = {}
-    for sched in ("gpipe", "1f1b"):
+    for sched in ("gpipe", "1f1b", "interleaved", "interleaved_1f1b"):
         paddle.seed(0)
         build_mesh(pp=4, dp=2)
         model = GPTStacked(cfg, pp_microbatches=8, pp_schedule=sched)
@@ -62,6 +62,9 @@ def main():
 
     g, f = results["gpipe"], results["1f1b"]
     print(f"1f1b speedup: {g[0] / f[0]:.2f}x, temp reduction: {g[1] / f[1]:.1f}x")
+    i, i1 = results["interleaved"], results["interleaved_1f1b"]
+    print(f"interleaved_1f1b vs interleaved (autodiff): "
+          f"{i[0] / i1[0]:.2f}x faster, {i[1] / i1[1]:.1f}x less temp")
 
 
 if __name__ == "__main__":

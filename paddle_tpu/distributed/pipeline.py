@@ -159,7 +159,7 @@ def _1f1b_local(stage_fn, n_micro, n_stages, axis_name):
     return run
 
 
-def interleaved_schedule_table(n_micro, n_stages, virtual):
+def _simulate_interleaved(n_micro, n_stages, virtual):
     """Greedy earliest-ready simulation of the interleaved schedule.
 
     Work item (m, k): microbatch m at global virtual stage k = c*S + d
@@ -168,11 +168,7 @@ def interleaved_schedule_table(n_micro, n_stages, virtual):
     ties broken breadth-first (lowest chunk, then lowest microbatch), which
     keeps the wrap link busy and realizes the ~(S-1)-chunk-tick fill bubble.
 
-    Returns dict of numpy [T, S] tables:
-      work/mb/ch    — does device d compute at tick t, and which (m, c)
-      stv/stm/stc   — should device d STORE the value received at tick t,
-                      and into which buffer slot (m, c)
-      out           — is this tick's computed y a final-stage output
+    Returns (T, compute) with compute = [(t, d, m, c), ...].
     """
     M, S, V = n_micro, n_stages, virtual
     SV = S * V
@@ -196,7 +192,19 @@ def interleaved_schedule_table(n_micro, n_stages, virtual):
             if k + 1 < SV:
                 avail[(m, k + 1)] = t + 1
         t += 1
-    T = t
+    return t, compute
+
+
+def interleaved_schedule_table(n_micro, n_stages, virtual):
+    """Forward tables, dict of numpy [T, S]:
+      work/mb/ch    — does device d compute at tick t, and which (m, c)
+      stv/stm/stc   — should device d STORE the value received at tick t,
+                      and into which buffer slot (m, c)
+      out           — is this tick's computed y a final-stage output
+    """
+    M, S, V = n_micro, n_stages, virtual
+    SV = S * V
+    T, compute = _simulate_interleaved(M, S, V)
     tbl = {key: np.zeros((T, S), np.int32)
            for key in ("work", "mb", "ch", "stv", "stm", "stc", "out")}
     for (tc, d, m, c) in compute:
@@ -214,11 +222,42 @@ def interleaved_schedule_table(n_micro, n_stages, virtual):
     return T, tbl
 
 
-def _interleaved_local(stage_fn, n_micro, n_stages, virtual, axis_name):
-    """Forward interleaved schedule (backward by XLA autodiff of the scan,
-    as with gpipe). params_local leaves are [V*cl, ...]: chunk c of THIS
-    device = rows [c*cl, (c+1)*cl) after the interleave permutation applied
-    in pipeline_apply."""
+def interleaved_backward_tables(n_micro, n_stages, virtual):
+    """Mirror tables for the 1F1B recompute backward: device d re-runs the
+    VJP of exactly the items it computed forward, at mirrored ticks
+    r = T-1-t.  The consumer of item (m,k)'s output is item (m,k+1) on
+    device (k+1)%S at forward tick t2 > t; its input-cotangent dx hops the
+    REVERSE ring at backward tick r2 = T-1-t2 and is stored by d one tick
+    later (r2+1 <= r, so it is always buffered before use).
+    """
+    M, S, V = n_micro, n_stages, virtual
+    SV = S * V
+    T, compute = _simulate_interleaved(M, S, V)
+    item_tick = {(m, c * S + d): t for (t, d, m, c) in compute}
+    tbl = {key: np.zeros((T, S), np.int32)
+           for key in ("work", "mb", "ch", "stv", "stm", "stc", "out")}
+    for (tc, d, m, c) in compute:
+        k = c * S + d
+        r = T - 1 - tc
+        tbl["work"][r, d] = 1
+        tbl["mb"][r, d] = m
+        tbl["ch"][r, d] = c
+        if k == SV - 1:
+            tbl["out"][r, d] = 1        # dy comes straight from g[m]
+        else:
+            r2 = T - 1 - item_tick[(m, k + 1)]
+            tbl["stv"][r2 + 1, d] = 1
+            tbl["stm"][r2 + 1, d] = m
+            tbl["stc"][r2 + 1, d] = c
+    return T, tbl
+
+
+def _make_interleaved_fwd(stage_fn, n_micro, n_stages, virtual, axis_name):
+    """Shared interleaved forward scan. Returns (out, per-tick chunk
+    inputs xs [T, ...]) — xs is the only residual the 1F1B backward
+    needs. params_local leaves are [V*cl, ...]: chunk c of THIS device =
+    rows [c*cl, (c+1)*cl) after the interleave permutation applied in
+    pipeline_apply."""
     M, S, V = n_micro, n_stages, virtual
     T, tbl = interleaved_schedule_table(M, S, V)
     jt = {k: jnp.asarray(v) for k, v in tbl.items()}
@@ -227,7 +266,7 @@ def _interleaved_local(stage_fn, n_micro, n_stages, virtual, axis_name):
     perm_ring = [(i, (i + 1) % S) for i in range(S)]
     _varying = _make_varying(axis_name)
 
-    def local_fn(params_local, xv):
+    def fwd_scan(params_local, xv):
         idx = jax.lax.axis_index(axis_name)
         B = xv.shape[0]
         mb = xv.reshape((M, B // M) + xv.shape[1:])
@@ -264,16 +303,108 @@ def _interleaved_local(stage_fn, n_micro, n_stages, virtual, axis_name):
             is_out = jnp.logical_and(w == 1, jt["out"][t, idx] == 1)
             out_buf = jax.lax.dynamic_update_index_in_dim(
                 out_buf, jnp.where(is_out, y, out_cur), m, 0)
-            return (buf, out_buf, y), None
+            return (buf, out_buf, y), x_in
 
-        (_, out_buf, _), _ = jax.lax.scan(tick, (buf0, out0, ysend0),
-                                          jnp.arange(T))
+        (_, out_buf, _), xs = jax.lax.scan(tick, (buf0, out0, ysend0),
+                                           jnp.arange(T))
         # final virtual stage SV-1 lives on device S-1
         out_buf = jnp.where(idx == S - 1, out_buf, jnp.zeros_like(out_buf))
         out_buf = jax.lax.psum(out_buf, axis_name)
-        return out_buf.reshape(xv.shape[:1] + out_buf.shape[2:])
+        return out_buf.reshape(xv.shape[:1] + out_buf.shape[2:]), xs
 
-    return local_fn
+    return fwd_scan, _varying
+
+
+def _interleaved_local(stage_fn, n_micro, n_stages, virtual, axis_name):
+    """Interleaved forward, backward by XLA autodiff of the scan (GPipe
+    liveness: the autodiff saves every tick's internal stage residuals)."""
+    fwd_scan, _ = _make_interleaved_fwd(stage_fn, n_micro, n_stages,
+                                        virtual, axis_name)
+    return lambda params_local, xv: fwd_scan(params_local, xv)[0]
+
+
+def _interleaved_1f1b_local(stage_fn, n_micro, n_stages, virtual, axis_name):
+    """Interleaved schedule WITH the 1F1B recompute backward (reference
+    fleet/meta_parallel/pipeline_parallel.py:171 — interleaved 1F1B):
+    forward saves only each tick's chunk input; the backward replays the
+    mirrored schedule, recomputing each chunk forward and applying its
+    VJP, with input-cotangents hopping the reverse ring and buffering in
+    a [V, M] grad buffer until their producer's backward tick."""
+    M, S, V = n_micro, n_stages, virtual
+    SV = S * V
+    T, btbl = interleaved_backward_tables(M, S, V)
+    jb = {k: jnp.asarray(v) for k, v in btbl.items()}
+    rev_ring = [((i + 1) % S, i) for i in range(S)]
+    fwd_scan, _varying = _make_interleaved_fwd(stage_fn, M, S, V, axis_name)
+
+    @jax.custom_vjp
+    def run(params_local, xv):
+        return fwd_scan(params_local, xv)[0]
+
+    def run_fwd(params_local, xv):
+        out, xs = fwd_scan(params_local, xv)
+        return out, (params_local, xs)
+
+    def run_bwd(res, g):
+        params_local, xs = res
+        idx = jax.lax.axis_index(axis_name)
+        mb_shape = xs.shape[1:]
+        cl = jax.tree_util.tree_leaves(params_local)[0].shape[0] // V
+        gmb = g.reshape((M,) + mb_shape[:1] + g.shape[1:]).astype(xs.dtype)
+        zero_nd = (0,) * len(mb_shape)
+        dbuf0 = _varying(jnp.zeros((V, M) + mb_shape, xs.dtype))
+        dmb0 = _varying(jnp.zeros((M,) + mb_shape, xs.dtype))
+        dsend0 = _varying(jnp.zeros(mb_shape, xs.dtype))
+        dparams0 = jax.tree_util.tree_map(
+            lambda v: _varying(jnp.zeros_like(v)), params_local)
+
+        def btick(carry, r):
+            dbuf, dmb, dparams, dsend = carry
+            # 1) receive the reverse-ring hop, store per mirror tables
+            drecv = jax.lax.ppermute(dsend, axis_name, rev_ring)
+            stv, stm, stc = jb["stv"][r, idx], jb["stm"][r, idx], jb["stc"][r, idx]
+            cur = jax.lax.dynamic_slice(dbuf, (stc, stm) + zero_nd,
+                                        (1, 1) + mb_shape)[0, 0]
+            dbuf = jax.lax.dynamic_update_slice(
+                dbuf, jnp.where(stv == 1, drecv, cur)[None, None],
+                (stc, stm) + zero_nd)
+            # 2) backward-compute this tick's mirrored item
+            w, m, c = jb["work"][r, idx], jb["mb"][r, idx], jb["ch"][r, idx]
+            is_out = jb["out"][r, idx]
+            g_t = jax.lax.dynamic_index_in_dim(gmb, m, 0, keepdims=False)
+            d_buf = jax.lax.dynamic_slice(dbuf, (c, m) + zero_nd,
+                                          (1, 1) + mb_shape)[0, 0]
+            dy = jnp.where(is_out == 1, g_t, d_buf)
+            dy = jnp.where(w == 1, dy, jnp.zeros_like(dy))
+            t = T - 1 - r
+            x_in = jax.lax.dynamic_index_in_dim(xs, t, 0, keepdims=False)
+            p_c = jax.tree_util.tree_map(
+                lambda v: jax.lax.dynamic_slice_in_dim(v, c * cl, cl, 0),
+                params_local)
+            _, vjp_fn = jax.vjp(stage_fn, p_c, x_in)
+            dp_t, dx_t = vjp_fn(dy)
+            dparams = jax.tree_util.tree_map(
+                lambda acc, dpc: jax.lax.dynamic_update_slice_in_dim(
+                    acc,
+                    jax.lax.dynamic_slice_in_dim(acc, c * cl, cl, 0) + dpc,
+                    c * cl, 0),
+                dparams, dp_t)
+            # 3) global-first-stage items feed the input cotangent
+            is_first = jnp.logical_and(jnp.logical_and(idx == 0, c == 0),
+                                       w == 1)
+            cur_dmb = jax.lax.dynamic_index_in_dim(dmb, m, 0, keepdims=False)
+            dmb = jax.lax.dynamic_update_index_in_dim(
+                dmb, jnp.where(is_first, dx_t, cur_dmb), m, 0)
+            return (dbuf, dmb, dparams, dx_t), None
+
+        (_, dmb, dparams, _), _ = jax.lax.scan(
+            btick, (dbuf0, dmb0, dparams0, dsend0), jnp.arange(T))
+        dxv = dmb.reshape((M * mb_shape[0],) + mb_shape[1:])
+        dxv = jnp.where(idx == 0, dxv, jnp.zeros_like(dxv))
+        return dparams, jax.lax.psum(dxv, axis_name)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run
 
 
 def _interleave_perm(n_layers, n_stages, virtual):
@@ -324,7 +455,7 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatch, mesh=None,
         local_fn = _1f1b_local(stage_fn, n_micro, n_stages, axis_name)
     elif schedule == "gpipe":
         local_fn = _gpipe_local(stage_fn, n_micro, n_stages, axis_name)
-    elif schedule == "interleaved":
+    elif schedule in ("interleaved", "interleaved_1f1b"):
         L_total = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
         if virtual <= 1 or L_total % (n_stages * virtual):
             raise ValueError(
@@ -334,11 +465,13 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatch, mesh=None,
             perm = jnp.asarray(_interleave_perm(L_total, n_stages, virtual))
             stacked_params = jax.tree_util.tree_map(
                 lambda v: jnp.take(v, perm, axis=0), stacked_params)
-        local_fn = _interleaved_local(stage_fn, n_micro, n_stages, virtual,
-                                      axis_name)
+        make = (_interleaved_1f1b_local if schedule == "interleaved_1f1b"
+                else _interleaved_local)
+        local_fn = make(stage_fn, n_micro, n_stages, virtual, axis_name)
     else:
-        raise ValueError(f"unknown pipeline schedule {schedule!r} "
-                         "(want 'gpipe', '1f1b' or 'interleaved')")
+        raise ValueError(f"unknown pipeline schedule {schedule!r} (want "
+                         "'gpipe', '1f1b', 'interleaved' or "
+                         "'interleaved_1f1b')")
 
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(

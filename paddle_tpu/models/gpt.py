@@ -380,7 +380,7 @@ class GPTStacked(Layer):
         # original-index-of-row mapping for checkpoint conversion.
         self._pp_perm = None
         self._pp_perm_stages = None
-        if pp_schedule == "interleaved":
+        if pp_schedule.startswith("interleaved"):
             from ..distributed.mesh import get_mesh
             from ..distributed.pipeline import _interleave_perm
             mesh = get_mesh(create_default=False)

@@ -75,13 +75,14 @@ def _loss_fn(model, batch):
 
 @pytest.mark.parametrize("schedule", [
     "1f1b", pytest.param("gpipe", marks=pytest.mark.slow),
-    pytest.param("interleaved", marks=pytest.mark.slow)])
+    pytest.param("interleaved", marks=pytest.mark.slow),
+    "interleaved_1f1b"])
 def test_gpt_stacked_pp_equals_pp1(schedule):
     batch = _batch()
     losses = {}
     # pp x tp combined is covered by test_gpt_stacked_trains; comparing
     # dp1 vs pp4 here keeps one Trainer compile off the default suite
-    pp = 2 if schedule == "interleaved" else 4  # 4 layers = pp2 x virtual2
+    pp = 2 if schedule.startswith("interleaved") else 4  # 4 layers = pp2 x v2
     for axes in ({"dp": 1}, {"pp": pp}):
         paddle.seed(11)
         build_mesh(**axes)
@@ -106,8 +107,13 @@ def test_gpt_stacked_trains(schedule):
     assert losses[-1] < losses[0]
 
 
-def test_pipeline_interleaved_matches_sequential():
-    build_mesh(pp=2)
+@pytest.mark.parametrize("schedule,pp", [
+    ("interleaved", 2),
+    ("interleaved_1f1b", 2),
+    ("interleaved_1f1b", 4),     # pp4 x V2: the composed-schedule shape
+])
+def test_pipeline_interleaved_matches_sequential(schedule, pp):
+    build_mesh(pp=pp)
     L_total, B, H, V = 8, 4, 16, 2
     rng = np.random.RandomState(2)
     w = jnp.asarray(rng.randn(L_total, H, H) * 0.1, jnp.float32)
@@ -121,7 +127,7 @@ def test_pipeline_interleaved_matches_sequential():
     x = jnp.asarray(rng.randn(B, H), jnp.float32)
     seq = stage_fn(w, x)
     piped = pipeline_apply(stage_fn, w, x, n_microbatch=4,
-                           schedule="interleaved", virtual=V)
+                           schedule=schedule, virtual=V)
     np.testing.assert_allclose(np.asarray(piped), np.asarray(seq), atol=1e-5)
 
     def loss_seq(w):
@@ -129,7 +135,7 @@ def test_pipeline_interleaved_matches_sequential():
 
     def loss_pipe(w):
         return jnp.sum(pipeline_apply(stage_fn, w, x, n_microbatch=4,
-                                      schedule="interleaved", virtual=V) ** 2)
+                                      schedule=schedule, virtual=V) ** 2)
 
     g1 = jax.grad(loss_seq)(w)
     g2 = jax.grad(loss_pipe)(w)
