@@ -287,6 +287,66 @@ def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
     return tok_s, mfu
 
 
+def run_decode(batch=8, prompt_len=128, gen=128, quant=None):
+    """Serving decode throughput: continuous-batching greedy decode over
+    the paged-KV Pallas kernel (GPT-1.3B bf16, falls back to 350M/125M if
+    the chip can't hold it). Reported as generated tokens/sec/chip."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.models import GPT, gpt_125m, gpt_350m, gpt_1p3b
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    rng = np.random.RandomState(0)
+    last_err = None
+    for mk in (gpt_1p3b, gpt_350m, gpt_125m):
+        try:
+            cfg = mk(max_seq_len=max(512, prompt_len + gen))
+            model = GPT(cfg)
+            model.bfloat16()
+            model.eval()
+            page_size = 32
+            pages_per_seq = (prompt_len + gen + page_size - 1) // page_size
+            dec = PagedGPTDecoder(
+                model, num_pages=batch * pages_per_seq + 2,
+                page_size=page_size, max_batch=batch, quant=quant,
+                use_kernel=True)
+
+            def run_batch():
+                eng = ContinuousBatchingEngine(dec, max_new_tokens=gen)
+                for _ in range(batch):
+                    eng.submit(rng.randint(
+                        0, cfg.vocab_size, prompt_len).astype(np.int32))
+                return eng.run()
+
+            t0 = time.time()
+            run_batch()              # compile prefill bucket + decode step
+            log(f"decode[{mk.__name__}] compile+first batch: "
+                f"{time.time()-t0:.1f}s")
+            t0 = time.time()
+            outs = run_batch()
+            dt = time.time() - t0
+            n_tok = sum(len(v) for v in outs.values())
+            tok_s = n_tok / dt
+            log(f"decode[{mk.__name__}{'/' + quant if quant else ''}]: "
+                f"{n_tok} tokens in {dt:.2f}s = {tok_s:.0f} tok/s "
+                f"(batch={batch}, prompt={prompt_len}, gen={gen})")
+            return tok_s, mk.__name__
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {str(e)[:200]}"
+            log(f"decode {mk.__name__} failed: {last_err}")
+            # the failed attempt's weights/pages must be freed BEFORE the
+            # smaller model allocates, or the fallback OOMs too
+            model = dec = run_batch = cfg = None
+            del e
+            import gc
+            gc.collect()
+    raise RuntimeError(last_err or "decode bench failed")
+
+
 def _device_watchdog(timeout_s=150, attempts=4, backoff_s=45):
     """Probe jax backend init in a subprocess: a dead TPU tunnel HANGS
     jax.devices() forever, which would leave the driver with no JSON at
@@ -406,6 +466,14 @@ def main():
         except Exception as e:
             log(f"moe bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["gpt_moe_error"] = str(e)[:160]
+    if only in (None, "decode"):
+        try:
+            tok_s, which = run_decode()
+            extras["decode_tokens_per_sec_per_chip"] = round(tok_s, 1)
+            extras["decode_model"] = which
+        except Exception as e:
+            log(f"decode bench failed: {type(e).__name__}: {str(e)[:300]}")
+            extras["decode_error"] = str(e)[:160]
     if extras:
         result["extras"] = extras
     print(json.dumps(result))

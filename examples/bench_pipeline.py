@@ -7,8 +7,10 @@ Measures wall-clock per training step and compiled temp (activation) memory
 for GPTStacked at pp=4 x dp=2, 8 microbatches. Representative result
 (this machine, 2026-07):
 
-    gpipe: 25.3 s/step, temp=304.5 MB
-    1f1b : 16.2 s/step, temp= 53.5 MB   -> 1.56x faster, 5.7x less temp
+    gpipe            : 16.7 s/step, temp=304.5 MB
+    1f1b             :  9.6 s/step, temp= 53.5 MB  -> 1.75x faster, 5.7x less
+    interleaved      :  8.3 s/step, temp=313.6 MB  (autodiff backward)
+    interleaved_1f1b :  7.0 s/step, temp= 38.0 MB  -> 1.19x faster, 8.3x less
 """
 import time
 

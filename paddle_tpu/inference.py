@@ -1,6 +1,11 @@
 """paddle_tpu.inference — reference python/paddle/inference (Predictor over a
-saved inference program). TPU-native: a Predictor wraps a jit-compiled
-functional model loaded via paddle_tpu.jit artifacts + weights."""
+saved inference program, paddle/fluid/inference/api/paddle_inference_api.h).
+
+TPU-native: the saved program is a jax.export artifact (serialized
+StableHLO with calling convention); Predictor deserializes and executes it
+directly — no Python Layer rebuild.  Config.set_model(layer) remains the
+eager path for models constructed in-process.
+"""
 import numpy as np
 
 import jax
@@ -21,7 +26,8 @@ class Config:
         self._model = layer
         return self
 
-    # GPU/IR knobs kept for API parity (XLA handles all of it)
+    # Device/IR knobs kept for API parity: XLA always runs its optimizing
+    # pipeline (there is no unoptimized execution mode to switch to)
     def enable_use_gpu(self, *a, **k):
         pass
 
@@ -37,13 +43,26 @@ class Config:
 
 class Predictor:
     def __init__(self, config: Config):
+        self._translated = None
         if config._model is None and config.prog_file:
             from . import jit as pjit
-            loaded = pjit.load(config.prog_file.replace(".pdmodel", ""))
-            raise NotImplementedError(
-                "rebuild the python Layer and use Config.set_model(layer) with "
-                "weights from jit.load — direct program execution needs a "
-                "StableHLO runtime binding (planned)")
+            path = config.prog_file
+            for suffix in (".pdmodel", ".jaxprog"):
+                if path.endswith(suffix):
+                    path = path[: -len(suffix)]
+            loaded = pjit.load(path)
+            if not loaded.runnable:
+                why = getattr(loaded, "_load_error", None)
+                why = (f"its program failed to deserialize ({why})"
+                       if why else "it was saved without input_spec "
+                       "(no executable program)")
+                raise RuntimeError(
+                    f"{config.prog_file!r} holds weights but {why}; "
+                    "re-save with jit.save(layer, path, input_spec=[...]) "
+                    "or use Config.set_model(layer)")
+            self._translated = loaded
+            self.model = loaded
+            return
         self.model = config._model
         self.model.eval()
         params = state_pytree(self.model)
@@ -57,9 +76,14 @@ class Predictor:
         self._fn = jax.jit(pure)
 
     def run(self, inputs):
-        arrs = [i._value if isinstance(i, Tensor) else np.asarray(i) for i in inputs]
+        arrs = [i._value if isinstance(i, Tensor) else np.asarray(i)
+                for i in inputs]
+        if self._translated is not None:
+            out = self._translated(*arrs)
+            return list(out) if isinstance(out, (list, tuple)) else [out]
         out = self._fn(self._params, *arrs)
-        return [Tensor(out)] if not isinstance(out, (list, tuple)) else [Tensor(o) for o in out]
+        return [Tensor(out)] if not isinstance(out, (list, tuple)) \
+            else [Tensor(o) for o in out]
 
 
 def create_predictor(config: Config):

@@ -113,8 +113,33 @@ def _example_from_spec(spec):
     return jnp.zeros(shape, jnp.dtype(spec.dtype or "float32"))
 
 
+def _symbolic_args(specs):
+    """InputSpec list -> ShapeDtypeStruct args where every None/-1 dim is
+    a distinct export symbol, so the saved program accepts ANY size there
+    (paddle's dynamic-batch convention) instead of specializing to 1."""
+    from jax import export as jax_export
+    scope = jax_export.SymbolicScope()
+    args, n = [], 0
+    for spec in specs:
+        dims = []
+        for s in spec.shape:
+            if s is None or s < 0:
+                dims.append(jax_export.symbolic_shape(f"d{n}",
+                                                      scope=scope)[0])
+                n += 1
+            else:
+                dims.append(int(s))
+        args.append(jax.ShapeDtypeStruct(tuple(dims),
+                                         jnp.dtype(spec.dtype or "float32")))
+    return args, n
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Exports {path}.pdiparams (weights pickle) + {path}.stablehlo.mlir."""
+    """Exports {path}.pdiparams (weights pickle) + {path}.pdmodel (meta)
+    + {path}.stablehlo.mlir (inspectable IR) + {path}.jaxprog (executable
+    jax.export artifact: the serialized program runs WITHOUT the Python
+    Layer — reference jit.save inference-program role,
+    paddle/fluid/inference/api/paddle_inference_api.h)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if isinstance(layer, _StaticFunction):
         layer = layer._target
@@ -130,6 +155,8 @@ def save(layer, path, input_spec=None, **configs):
             params = state_pytree(layer)
             from ..nn.layer_base import buffer_pytree
             bufs = buffer_pytree(layer)
+            meta["param_names"] = sorted(params)
+            meta["buffer_names"] = sorted(bufs)
 
             def pure(params, buffers, *args):
                 with functional_call(layer, {**params, **buffers}):
@@ -139,6 +166,23 @@ def save(layer, path, input_spec=None, **configs):
             lowered = jax.jit(pure).lower(params, bufs, *examples)
             with open(path + ".stablehlo.mlir", "w") as f:
                 f.write(lowered.as_text())
+            from jax import export as jax_export
+            sym_args, n_sym = _symbolic_args(specs)
+            try:
+                exp = jax_export.export(jax.jit(pure))(params, bufs,
+                                                       *sym_args)
+            except Exception as sym_err:
+                if n_sym:
+                    # an op in the model doesn't support shape polymorphism:
+                    # fall back to a static program at the example shapes
+                    meta["symbolic_export_error"] = str(sym_err)[:500]
+                    meta["static_shapes"] = True
+                    exp = jax_export.export(jax.jit(pure))(params, bufs,
+                                                           *examples)
+                else:
+                    raise
+            with open(path + ".jaxprog", "wb") as f:
+                f.write(exp.serialize())
         except Exception as e:  # export is best-effort; weights always saved
             meta["export_error"] = str(e)
     with open(path + ".pdmodel", "wb") as f:
@@ -146,18 +190,52 @@ def save(layer, path, input_spec=None, **configs):
 
 
 class TranslatedLayer(Layer):
-    """Loaded inference artifact (reference fluid/dygraph/io.py:TranslatedLayer)."""
+    """Loaded inference artifact (reference fluid/dygraph/io.py:
+    TranslatedLayer).  When the .jaxprog executable program is present,
+    forward() RUNS it — no Python Layer rebuild needed (the saved weights
+    feed the program's parameter arguments)."""
 
-    def __init__(self, state, meta):
+    def __init__(self, state, meta, program=None, load_error=None):
         super().__init__()
         self._state = {k: jnp.asarray(v) for k, v in state.items()}
         self._meta = meta
+        self._program = program
+        self._load_error = load_error
+        self._runner = None
+
+    @property
+    def runnable(self):
+        return self._program is not None
+
+    def _build_runner(self):
+        pnames = self._meta.get("param_names")
+        bnames = self._meta.get("buffer_names", [])
+        if pnames is None:
+            pnames = sorted(self._state)
+        params = {n: self._state[n] for n in pnames}
+        bufs = {n: self._state[n] for n in bnames}
+        program = self._program
+        call = jax.jit(lambda p, b, *args: program.call(p, b, *args))
+        self._runner = lambda *args: call(params, bufs, *args)
 
     def forward(self, *args):
-        raise NotImplementedError(
-            "TranslatedLayer holds weights + exported StableHLO; rebuild the "
-            "python Layer and set_state_dict(layer.state_dict()) to run, or "
-            "execute the .stablehlo.mlir with any StableHLO runtime")
+        if self._program is None:
+            if self._load_error is not None:
+                raise RuntimeError(
+                    "the saved program could not be deserialized "
+                    f"({self._load_error}); re-export the artifact with "
+                    "the current jax version")
+            raise NotImplementedError(
+                "this artifact was saved without input_spec (no executable "
+                "program): rebuild the python Layer and set_state_dict"
+                "(layer.state_dict()), or re-save with input_spec")
+        if self._runner is None:
+            self._build_runner()
+        out = self._runner(*[a._value if isinstance(a, Tensor)
+                             else jnp.asarray(a) for a in args])
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor(o) for o in out)
+        return Tensor(out)
 
     def state_dict(self, *a, **k):
         return {k: Tensor(v) for k, v in self._state.items()}
@@ -170,7 +248,16 @@ def load(path, **configs):
     if os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
-    return TranslatedLayer(state, meta)
+    program, load_error = None, None
+    if os.path.exists(path + ".jaxprog"):
+        try:
+            from jax import export as jax_export
+            with open(path + ".jaxprog", "rb") as f:
+                program = jax_export.deserialize(f.read())
+        except Exception as e:
+            program = None
+            load_error = f"{type(e).__name__}: {str(e)[:300]}"
+    return TranslatedLayer(state, meta, program, load_error)
 
 
 def set_code_level(level=100, also_to_stdout=False):

@@ -1,0 +1,364 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Reference role: the fluid inference API's batched decode serving path
+(paddle/fluid/inference/api/paddle_inference_api.h + PaddleNLP FasterGPT
+decoding).  TPU-native design:
+
+- ONE compiled decode step for a fixed slot count: [max_batch] tokens in,
+  [max_batch] greedy tokens out.  Slots hold independent sequences at
+  different lengths; position/page state rides in arrays, so admission
+  and retirement never recompile.
+- KV lives in paged pools [L, P, page_size, H, D] (ops/paged_attention).
+  Decode attention gathers each slot's pages (optionally via the
+  scalar-prefetch Pallas kernel); page allocation is host-side.
+- Prefill is a second compiled program per prompt-length bucket
+  (powers of two) writing the prompt's K/V straight into the pages.
+- quant="a8w8": per-(layer, out-channel) int8 weights with dynamic
+  per-row int8 activations — matmuls run int8xint8->int32 on the MXU
+  (same recipe as quantization.QuantizedLinearA8W8).
+
+The engine applies to GPT-family models (uniform pre-LN blocks); weights
+are extracted once into stacked per-layer arrays and the model object is
+no longer needed — pair with jit.load-style artifacts for serving.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor
+
+__all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine"]
+
+
+def _ln(x, w, b):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * w + b).astype(x.dtype)
+
+
+def _quantize_w(w):
+    """Per-out-channel symmetric int8: w [in, out] -> (int8 w, scale [out])."""
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _mm(x, w, b, quant):
+    """x [..., in] @ w -> [..., out].  Float path, or dynamic-A8 x W8
+    int8 MXU matmul with per-row activation scales."""
+    if not quant:
+        return (x @ w.astype(x.dtype) + b.astype(x.dtype)).astype(x.dtype)
+    qw, sw = w
+    sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
+    sx = jnp.maximum(sx, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, qw, (((xq.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * sw + b).astype(x.dtype)
+
+
+class PagedGPTDecoder:
+    """Stacked-weight GPT decode executor over paged KV pools."""
+
+    def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
+                 max_pages_per_seq=None, quant=None, use_kernel=False,
+                 dtype=None):
+        cfg = model.cfg
+        self.cfg = cfg
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_batch = max_batch
+        self.max_pages = max_pages_per_seq or \
+            (cfg.max_seq_len + page_size - 1) // page_size
+        self.quant = quant
+        self.use_kernel = use_kernel
+        assert quant in (None, "a8w8"), quant
+        dtype = dtype or jnp.dtype(cfg.dtype)
+
+        state = {k: np.asarray(v._value)
+                 for k, v in model.state_dict().items()}
+        L = cfg.num_layers
+
+        def stack(fmt):
+            return jnp.asarray(
+                np.stack([state[fmt.format(i)] for i in range(L)]))
+
+        w = {
+            "ln1_w": stack("blocks.{}.ln1.weight"),
+            "ln1_b": stack("blocks.{}.ln1.bias"),
+            "qkv_w": stack("blocks.{}.qkv.weight"),
+            "qkv_b": stack("blocks.{}.qkv.bias"),
+            "proj_w": stack("blocks.{}.proj.weight"),
+            "proj_b": stack("blocks.{}.proj.bias"),
+            "ln2_w": stack("blocks.{}.ln2.weight"),
+            "ln2_b": stack("blocks.{}.ln2.bias"),
+            "fc1_w": stack("blocks.{}.fc1.weight"),
+            "fc1_b": stack("blocks.{}.fc1.bias"),
+            "fc2_w": stack("blocks.{}.fc2.weight"),
+            "fc2_b": stack("blocks.{}.fc2.bias"),
+        }
+        if quant == "a8w8":
+            for k in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+                qs = jax.vmap(_quantize_w)(w[k])
+                w[k] = qs
+        self.weights = w
+        self.wte = jnp.asarray(state["wte.weight"])
+        self.wpe = jnp.asarray(state["wpe.weight"])
+        self.ln_f_w = jnp.asarray(state["ln_f.weight"])
+        self.ln_f_b = jnp.asarray(state["ln_f.bias"])
+        self.lm_head = jnp.asarray(
+            state.get("lm_head.weight", state["wte.weight"].T))
+
+        H, D = cfg.num_heads, cfg.head_dim
+        self.k_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
+        self.v_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
+
+        self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._prefills = {}   # padded length -> jitted prefill
+
+    # -- compiled programs -------------------------------------------------
+
+    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table):
+        """tokens [S], lens [S] (tokens already counted, i.e. position of
+        the incoming token), table [S, max_pages] -> (next [S], logits
+        [S, V], k_pages, v_pages)."""
+        cfg, ps = self.cfg, self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        S = tokens.shape[0]
+        x = (self.wte[tokens] +
+             self.wpe[jnp.clip(lens, 0, cfg.max_seq_len - 1)]
+             ).astype(self.k_pages.dtype)                      # [S, h]
+        pids = jnp.take_along_axis(table, (lens // ps)[:, None],
+                                   axis=1)[:, 0]                # [S]
+        offs = lens % ps
+        quant = bool(self.quant)
+
+        def layer(x, wkv):
+            wl, kp, vp = wkv
+            y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+            qkv = _mm(y, wl["qkv_w"], wl["qkv_b"], quant)       # [S, 3h]
+            qkv = qkv.reshape(S, 3, H, D)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
+            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            from .ops.paged_attention import paged_attention
+            attn = paged_attention(q[:, None], kp, vp, table, lens + 1,
+                                   use_kernel=self.use_kernel)  # [S,1,H,D]
+            x = x + _mm(attn.reshape(S, H * D), wl["proj_w"], wl["proj_b"],
+                        quant)
+            y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+            h = jax.nn.gelu(_mm(y, wl["fc1_w"], wl["fc1_b"], quant),
+                            approximate=True)
+            x = x + _mm(h, wl["fc2_w"], wl["fc2_b"], quant)
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer, x, (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, logits, k_pages, v_pages
+
+    def _prefill_fn(self, Lp):
+        """Per-bucket compiled prefill: one sequence, padded to Lp.
+        Returns (last-token logits argmax, per-layer K/V) and writes the
+        prompt KV into the given pages."""
+        cfg, ps = self.cfg, self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        n_pg = Lp // ps
+        quant = bool(self.quant)
+
+        def run(weights, k_pages, v_pages, ids, true_len, page_ids):
+            x = (self.wte[ids] + self.wpe[jnp.arange(Lp)]
+                 ).astype(k_pages.dtype)                        # [Lp, h]
+
+            def layer(x, wkv):
+                wl, kp, vp = wkv
+                y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+                qkv = _mm(y, wl["qkv_w"], wl["qkv_b"], quant)
+                qkv = qkv.reshape(Lp, 3, H, D)
+                q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+                s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                               k.astype(jnp.float32)) / math.sqrt(D)
+                row = jax.lax.broadcasted_iota(jnp.int32, (Lp, Lp), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (Lp, Lp), 1)
+                s = jnp.where((row >= col) & (col < true_len), s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+                x = x + _mm(attn.reshape(Lp, H * D).astype(x.dtype),
+                            wl["proj_w"], wl["proj_b"], quant)
+                y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+                h = jax.nn.gelu(_mm(y, wl["fc1_w"], wl["fc1_b"], quant),
+                                approximate=True)
+                x = x + _mm(h, wl["fc2_w"], wl["fc2_b"], quant)
+                # page writes: static page count, dynamic page ids
+                kpg = k.reshape(n_pg, ps, H, D).astype(kp.dtype)
+                vpg = v.reshape(n_pg, ps, H, D).astype(vp.dtype)
+                kp = kp.at[page_ids].set(kpg)
+                vp = vp.at[page_ids].set(vpg)
+                return x, (kp, vp)
+
+            x, (k_pages, v_pages) = jax.lax.scan(
+                layer, x, (weights, k_pages, v_pages))
+            x = _ln(x, self.ln_f_w, self.ln_f_b)
+            last = jnp.take(x, true_len - 1, axis=0)
+            logits = last.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+            return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    # -- host-side API -----------------------------------------------------
+
+    def prefill(self, ids, page_ids):
+        """Run one prompt through the model, writing KV into `page_ids`;
+        returns the greedy next token (int)."""
+        ids = np.asarray(ids, np.int32)
+        true_len = len(ids)
+        Lp = max(self.page_size,
+                 self.page_size * (2 ** math.ceil(
+                     math.log2(max(1, (true_len + self.page_size - 1)
+                                   // self.page_size)))))
+        if Lp not in self._prefills:
+            self._prefills[Lp] = self._prefill_fn(Lp)
+        pad = np.zeros(Lp, np.int32)
+        pad[:true_len] = ids
+        # page_ids covers prompt+generation; prefill only fills the
+        # prompt's pages (decode writes the rest as it goes)
+        pg = np.zeros(Lp // self.page_size, np.int32)
+        k = min(len(page_ids), len(pg))
+        pg[:k] = page_ids[:k]
+        # unused padded pages write into page 0's slot of a scratch page:
+        # route them to a reserved scratch page to avoid clobbering
+        if len(page_ids) < len(pg):
+            pg[len(page_ids):] = self.num_pages - 1   # scratch page
+        nxt, self.k_pages, self.v_pages = self._prefills[Lp](
+            self.weights, self.k_pages, self.v_pages, jnp.asarray(pad),
+            jnp.asarray(true_len, jnp.int32), jnp.asarray(pg))
+        return int(nxt)
+
+    def decode(self, tokens, lens, table):
+        """One greedy step for all slots."""
+        nxt, logits, self.k_pages, self.v_pages = self._decode(
+            self.weights, self.k_pages, self.v_pages,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(table, jnp.int32))
+        return nxt
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching: requests are admitted into free
+    slots as soon as capacity allows (iteration-level scheduling), decode
+    runs one compiled step for ALL active slots, finished sequences free
+    their pages immediately."""
+
+    def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
+                 max_new_tokens=64):
+        self.d = decoder
+        self.eos = eos_token_id
+        self.max_new = max_new_tokens
+        # page 0..num_pages-2 allocatable; last page reserved as scratch
+        self._free = list(range(decoder.num_pages - 2, -1, -1))
+        S = decoder.max_batch
+        self._slot_req = [None] * S          # request id per slot
+        self._slot_pages = [[] for _ in range(S)]
+        self._lens = np.zeros(S, np.int64)
+        self._tokens = np.zeros(S, np.int64)
+        self._queue = []                     # (req_id, ids)
+        self._outputs = {}                   # req_id -> [generated ids]
+        self._next_id = 0
+        self.steps = 0
+
+    def submit(self, prompt_ids):
+        rid = self._next_id
+        self._next_id += 1
+        ids = [int(t) for t in np.asarray(
+            prompt_ids._value if isinstance(prompt_ids, Tensor)
+            else prompt_ids).reshape(-1)]
+        total = len(ids) + self.max_new
+        need = self._pages_for(total)
+        if need > min(self.d.max_pages, self.d.num_pages - 1):
+            raise ValueError(
+                f"request needs {need} pages (prompt {len(ids)} + "
+                f"max_new {self.max_new} tokens) but the pool allows "
+                f"{min(self.d.max_pages, self.d.num_pages - 1)}")
+        if total > self.d.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {len(ids)} + max_new {self.max_new} tokens "
+                f"exceeds the model's max_seq_len "
+                f"{self.d.cfg.max_seq_len} (positions past it have no "
+                "embedding)")
+        self._queue.append((rid, ids))
+        return rid
+
+    def _pages_for(self, n_tokens):
+        return (n_tokens + self.d.page_size - 1) // self.d.page_size
+
+    def _admit(self):
+        for slot in range(self.d.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            rid, ids = self._queue[0]
+            need = self._pages_for(len(ids) + self.max_new)
+            if need > len(self._free) or need > self.d.max_pages:
+                break                        # head-of-line: wait for pages
+            self._queue.pop(0)
+            pages = [self._free.pop() for _ in range(need)]
+            self._slot_req[slot] = rid
+            self._slot_pages[slot] = pages
+            first = self.d.prefill(ids, pages)
+            self._outputs[rid] = [first]
+            if (self.eos is not None and first == self.eos) \
+                    or self.max_new <= 1:
+                # finished at prefill: never occupy a decode slot
+                self._free.extend(pages)
+                self._slot_req[slot] = None
+                self._slot_pages[slot] = []
+                continue
+            self._lens[slot] = len(ids)
+            self._tokens[slot] = first
+
+    def _retire(self, slot):
+        self._free.extend(self._slot_pages[slot])
+        self._slot_req[slot] = None
+        self._slot_pages[slot] = []
+        self._lens[slot] = 0
+        self._tokens[slot] = 0
+
+    def step(self):
+        """Admit + one decode tick. Returns number of active slots."""
+        self._admit()
+        active = [s for s in range(self.d.max_batch)
+                  if self._slot_req[s] is not None]
+        if not active:
+            return 0
+        # inactive slots must never write into allocatable pages: route
+        # their (masked, discarded) KV writes to the reserved scratch page
+        table = np.full((self.d.max_batch, self.d.max_pages),
+                        self.d.num_pages - 1, np.int32)
+        for s in active:
+            pg = self._slot_pages[s]
+            table[s, :len(pg)] = pg
+        nxt = np.asarray(self.d.decode(self._tokens, self._lens, table))
+        self.steps += 1
+        for s in active:
+            rid = self._slot_req[s]
+            tok = int(nxt[s])
+            self._outputs[rid].append(tok)
+            self._lens[s] += 1
+            self._tokens[s] = tok
+            done = (self.eos is not None and tok == self.eos) or \
+                len(self._outputs[rid]) >= self.max_new
+            if done:
+                self._retire(s)
+        return len(active)
+
+    def run(self):
+        """Drain the queue; returns {request_id: generated token list}."""
+        while self._queue or any(r is not None for r in self._slot_req):
+            self.step()
+        return dict(self._outputs)
