@@ -1,13 +1,22 @@
-"""Elastic training hooks — reference python/paddle/distributed/elastic.
+"""Elastic training — reference python/paddle/distributed/elastic +
+fleet/elastic/manager.py (etcd registration, fault watch, restart).
 
-JAX's single-controller model restarts whole processes rather than patching
-collectives mid-flight; elasticity = checkpoint-resume. This module provides
-the watch/trigger surface: a heartbeat file + resume helper that pairs with
-incubate.checkpoint.CheckpointManager.
+TPU-native rendering: JAX's single-controller collectives can't be patched
+mid-flight, so elasticity = whole-group restart + checkpoint-resume.
+- ElasticManager: in-job surface — heartbeat file (the etcd-lease
+  replacement), SIGTERM-aware should_exit, resume_step from the latest
+  orbax checkpoint.
+- launch_elastic: the supervisor — runs the worker group via
+  distributed.launch, watches exits AND heartbeat staleness, and restarts
+  the whole group (bounded by max_restarts); the restarted job resumes
+  from the checkpoint.  Multi-host production delegates the restart to
+  k8s/systemd; this is the single-host supervisor and the test harness.
 """
 import json
 import os
 import signal
+import subprocess
+import sys
 import time
 
 __all__ = ["ElasticManager", "enable_elastic", "launch_elastic"]
@@ -57,7 +66,70 @@ def enable_elastic(args=None, distribute_mode=None):
     return None
 
 
-def launch_elastic(*a, **k):
-    raise NotImplementedError(
-        "run under an external supervisor (k8s/systemd restart) + "
-        "ElasticManager heartbeat/resume")
+def launch_elastic(training_script, script_args=(), nproc_per_node=1,
+                   cpu_devices_per_rank=0, max_restarts=3,
+                   heartbeat_path=None, heartbeat_timeout_s=None,
+                   log_dir=None, job_id="elastic", env=None, poll_s=0.3,
+                   verbose=True):
+    """Supervise an elastic training job: launch the worker group, restart
+    it on worker death (any nonzero exit, incl. SIGKILL) or heartbeat
+    staleness, up to `max_restarts` times.  The training script is
+    expected to resume via ElasticManager.resume_step /
+    CheckpointManager.restore_latest.
+
+    Returns the number of restarts performed on success; raises
+    RuntimeError when the group still fails after max_restarts.
+    """
+    restarts = 0
+    while True:
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", str(nproc_per_node),
+               "--job_id", f"{job_id}.r{restarts}"]
+        if cpu_devices_per_rank:
+            cmd += ["--cpu_devices_per_rank", str(cpu_devices_per_rank)]
+        if log_dir:
+            cmd += ["--log_dir", log_dir]
+        cmd += [training_script, *script_args]
+        # a dead incarnation's heartbeat must not count for (or against)
+        # the new one
+        if heartbeat_path and os.path.exists(heartbeat_path):
+            try:
+                os.remove(heartbeat_path)
+            except OSError:
+                pass
+        started = time.time()
+        proc = subprocess.Popen(cmd, env=env)
+        reason = None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                if rc != 0:
+                    reason = f"worker group exited rc={rc}"
+                break
+            if heartbeat_timeout_s and heartbeat_path:
+                # clock starts at launch: a worker that hangs BEFORE its
+                # first beat is detected too
+                last = started
+                if os.path.exists(heartbeat_path):
+                    last = max(last, os.path.getmtime(heartbeat_path))
+                age = time.time() - last
+                if age > heartbeat_timeout_s:
+                    reason = f"heartbeat stale for {age:.0f}s"
+                    proc.send_signal(signal.SIGINT)  # launch forwards it
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    break
+            time.sleep(poll_s)
+        if reason is None:
+            return restarts
+        restarts += 1
+        if restarts > max_restarts:
+            raise RuntimeError(
+                f"elastic job failed after {max_restarts} restarts "
+                f"(last: {reason})")
+        if verbose:
+            print(f"[elastic] {reason}; restart {restarts}/{max_restarts}",
+                  file=sys.stderr, flush=True)
