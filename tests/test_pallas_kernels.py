@@ -136,6 +136,36 @@ def test_fused_dropout_residual_layer_norm_eval():
     np.testing.assert_allclose(np.asarray(h_k), np.asarray(h_r), atol=1e-6)
 
 
+def test_fused_dropout_residual_layer_norm_training_path():
+    """The TRAINING (dropout) path of the kernel runs in interpret mode
+    (mask bits drawn on the host there — the TPU prng primitives have no
+    CPU lowering) and its threshold/scale/LN arithmetic matches a golden
+    computed from the same bits."""
+    from paddle_tpu.ops.fused_ops import fused_dropout_residual_layer_norm
+    rng = np.random.RandomState(1)
+    n, h, p, seed = 256, 128, 0.3, 5
+    x = jnp.asarray(rng.randn(n, h).astype(np.float32))
+    r = jnp.asarray(rng.randn(n, h).astype(np.float32))
+    w = jnp.asarray(rng.randn(h).astype(np.float32))
+    b = jnp.asarray(rng.randn(h).astype(np.float32))
+    out_k, h_k = fused_dropout_residual_layer_norm(
+        x, r, w, b, p=p, seed=seed, training=True, interpret=True)
+
+    # golden from the identical host bits + the kernel's threshold rule
+    bits = np.asarray(jax.random.bits(jax.random.PRNGKey(seed), (n, h),
+                                      jnp.uint32))
+    keep = bits <= np.uint32((1.0 - p) * (2 ** 32 - 1))
+    xd = np.where(keep, np.asarray(x) / (1.0 - p), 0.0)
+    hh = xd + np.asarray(r)
+    mu = hh.mean(-1, keepdims=True)
+    var = ((hh - mu) ** 2).mean(-1, keepdims=True)
+    golden = (hh - mu) / np.sqrt(var + 1e-5) * np.asarray(w) + np.asarray(b)
+    # dropout actually dropped something, and kept most of the rest
+    assert 0.6 < keep.mean() < 0.8
+    np.testing.assert_allclose(np.asarray(h_k), hh, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_k), golden, atol=1e-4)
+
+
 def test_paged_attention_matches_dense():
     """ops/paged_attention.py — paged gather+softmax == dense attention over
     the sequence's actual history, jnp and kernel paths."""
