@@ -163,9 +163,6 @@ def save(layer, path, input_spec=None, **configs):
                     out = layer(*args)
                 return out._value if isinstance(out, Tensor) else out
             examples = [_example_from_spec(s) for s in specs]
-            lowered = jax.jit(pure).lower(params, bufs, *examples)
-            with open(path + ".stablehlo.mlir", "w") as f:
-                f.write(lowered.as_text())
             from jax import export as jax_export
             sym_args, n_sym = _symbolic_args(specs)
             try:
@@ -183,6 +180,10 @@ def save(layer, path, input_spec=None, **configs):
                     raise
             with open(path + ".jaxprog", "wb") as f:
                 f.write(exp.serialize())
+            # inspectable IR straight from the exported artifact (a
+            # separate .lower() would trace the model a second time)
+            with open(path + ".stablehlo.mlir", "w") as f:
+                f.write(str(exp.mlir_module()))
         except Exception as e:  # export is best-effort; weights always saved
             meta["export_error"] = str(e)
     with open(path + ".pdmodel", "wb") as f:

@@ -41,11 +41,12 @@ def _ln(x, w, b):
 
 
 def _quantize_w(w):
-    """Per-out-channel symmetric int8: w [in, out] -> (int8 w, scale [out])."""
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
-    scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return q.astype(jnp.int8), scale.astype(jnp.float32)
+    """Per-out-channel symmetric int8 via the shared quantization recipe
+    (quantization.quantize_weight) — one implementation so serving a8w8
+    can't drift from QuantizedLinearA8W8/PTQ."""
+    from .quantization import quantize_weight
+    q, scale = quantize_weight(w, axis=0)
+    return q, scale.reshape(-1)
 
 
 def _mm(x, w, b, quant):
