@@ -186,6 +186,14 @@ def save(layer, path, input_spec=None, **configs):
                 f.write(str(exp.mlir_module()))
         except Exception as e:  # export is best-effort; weights always saved
             meta["export_error"] = str(e)
+            try:
+                # the executable program failed, but the inspectable IR
+                # may still lower — keep the .stablehlo.mlir promise
+                lowered = jax.jit(pure).lower(params, bufs, *examples)
+                with open(path + ".stablehlo.mlir", "w") as f:
+                    f.write(lowered.as_text())
+            except Exception:
+                pass
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
 

@@ -10,6 +10,7 @@ control flow instead of failing in the tracer.
 from .convert_ops import (
     UNDEF,
     convert_and,
+    convert_call,
     convert_for,
     convert_ifelse,
     convert_ifelse_ret,
@@ -26,5 +27,5 @@ __all__ = [
     "convert_to_static", "conversion_error", "convert_ifelse",
     "convert_ifelse_ret", "convert_while_loop", "convert_for",
     "convert_and", "convert_or", "convert_not", "convert_range",
-    "convert_len", "to_bool", "UNDEF",
+    "convert_len", "convert_call", "to_bool", "UNDEF",
 ]

@@ -23,8 +23,47 @@ from ...framework.core import Tensor
 __all__ = [
     "UNDEF", "arg", "convert_ifelse", "convert_ifelse_ret",
     "convert_while_loop", "convert_for", "convert_and", "convert_or",
-    "convert_not", "convert_range", "convert_len", "to_bool",
+    "convert_not", "convert_range", "convert_len", "convert_call",
+    "to_bool",
 ]
+
+# modules whose functions are never AST-converted when called from
+# converted code (library code is already trace-compatible; reference
+# convert_call_func.py BUILTIN/paddle skip list)
+_NO_CONVERT_PREFIXES = (
+    "jax", "numpy", "paddle_tpu", "builtins", "math", "functools",
+    "itertools", "operator", "collections", "typing", "np", "torch",
+)
+
+
+def convert_call(fn):
+    """Recursive conversion entry (reference
+    dygraph_to_static/convert_call_func.py): a user-defined function
+    called from converted code gets its own control-flow conversion;
+    library/builtin callables pass through untouched.  Conversion is
+    cached per function; failures fall back to the original callable."""
+    from .transformer import convert_to_static
+
+    target = fn
+    bound_self = None
+    if isinstance(fn, staticmethod):
+        target = fn.__func__
+    elif hasattr(fn, "__func__") and hasattr(fn, "__self__"):
+        bound_self = fn.__self__                # bound method
+        target = fn.__func__
+    if not isinstance(target, type(convert_call)):
+        return fn                               # class, Layer instance, ...
+    if getattr(target, "__dy2static__", False):
+        return fn
+    mod = getattr(target, "__module__", "") or ""
+    if mod.split(".")[0] in _NO_CONVERT_PREFIXES:
+        return fn
+    conv = convert_to_static(target)
+    if conv is target:
+        return fn
+    if bound_self is not None:
+        return conv.__get__(bound_self)
+    return conv
 
 
 class _Undefined:

@@ -31,7 +31,23 @@ from . import convert_ops as _jst_mod
 
 _TEMPLATES = {}    # fn.__code__ -> (module_code, fdef_name, kept_decorators)
 _CONVERTED = weakref.WeakKeyDictionary()   # fn -> converted fn (per closure)
+_BY_CODE = {}      # (code, id(globals)) -> converted fn (closure-free only)
 _FAILED = {}       # fn.__code__ -> reason string (for diagnostics)
+
+
+class _LiveGlobals(dict):
+    """exec-globals that READ through to the original module namespace
+    live (a snapshot would hide later rebinds of module attributes from
+    converted code) while keeping definitions (the transformed function,
+    _jst) out of the user's module.  Works because CPython's LOAD_GLOBAL
+    takes the generic-mapping path for dict subclasses."""
+
+    def __init__(self, base, extra):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
 
 
 # --------------------------------------------------------------------------
@@ -323,18 +339,35 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         self._n += 1
         return self._n
 
-    # -- zero-arg super() --------------------------------------------------
+    # -- calls -------------------------------------------------------------
+
+    _SKIP_CALL_NAMES = frozenset({
+        "range", "len", "super", "print", "isinstance", "issubclass",
+        "getattr", "setattr", "hasattr", "type", "locals", "globals",
+        "vars", "id", "repr",
+    })
+
     def visit_Call(self, node):
-        """`super()` relies on the compiler-injected __class__ cell, which
-        a recompiled def outside its class body doesn't get: make the
-        arguments explicit (`super(__class__, self)`) so __class__ rides
-        the normal free-variable path."""
+        """Two rewrites.  (1) `super()` relies on the compiler-injected
+        __class__ cell, which a recompiled def outside its class body
+        doesn't get: make the arguments explicit (`super(__class__,
+        self)`).  (2) every other call goes through _jst.convert_call so
+        user-defined helpers get their own control-flow conversion
+        (reference convert_call_func.py); library callables pass through
+        untouched at runtime."""
         self.generic_visit(node)
-        if (self._has_class_cell and self._self_name
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "super"
-                and not node.args and not node.keywords):
-            node.args = [_name("__class__"), _name(self._self_name)]
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "super" \
+                and not node.args and not node.keywords:
+            if self._has_class_cell and self._self_name:
+                node.args = [_name("__class__"), _name(self._self_name)]
+            return node
+        if isinstance(func, ast.Name) and func.id in self._SKIP_CALL_NAMES:
+            return node
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "_jst":
+            return node
+        node.func = _call(_jst("convert_call"), [func])
         return node
 
     # -- boolean operators -------------------------------------------------
@@ -478,10 +511,20 @@ def convert_to_static(fn, verbose=False):
     key = getattr(fn, "__code__", None)
     if key is None:
         return fn
+    import inspect as _inspect
+    if key.co_flags & (_inspect.CO_GENERATOR | _inspect.CO_COROUTINE
+                       | _inspect.CO_ASYNC_GENERATOR):
+        # functionalizing a body that yields would change generator
+        # semantics (yields move into branch helpers): never convert
+        return fn
+    if key.co_filename.startswith("<dy2static"):
+        return fn           # already-generated code
     try:
         hit = _CONVERTED.get(fn)
     except TypeError:       # unhashable callable
         hit = None
+    if hit is None and not fn.__closure__:
+        hit = _BY_CODE.get((key, id(fn.__globals__)))
     if hit is not None:
         return hit
     if key in _FAILED:
@@ -498,6 +541,10 @@ def convert_to_static(fn, verbose=False):
         _CONVERTED[fn] = new_fn
     except TypeError:
         pass
+    if not fn.__closure__:
+        # per-code cache so per-call function objects (nested defs) don't
+        # reconvert every invocation; keyed on the live globals identity
+        _BY_CODE[(key, id(fn.__globals__))] = new_fn
     return new_fn
 
 
@@ -515,11 +562,20 @@ def _build_template(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise TypeError(f"not a function def: {type(fdef).__name__}")
-    # strip only the decorator that triggered conversion; semantic
-    # decorators (@no_grad(), ...) must keep wrapping the converted fn
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Global):
+            # a converted fn executes with _LiveGlobals: `global` writes
+            # would land there instead of the user's module
+            raise TypeError("uses `global` writes; left unconverted")
+    # strip the decorator that triggered conversion, plus binding
+    # decorators (static/classmethod: the descriptor behavior lives on
+    # the class attribute — convert_call always receives the plain
+    # function); semantic decorators (@no_grad(), ...) keep wrapping
     kept = []
     for d in fdef.decorator_list:
         text = ast.unparse(d)
+        if text in ("staticmethod", "classmethod"):
+            continue
         if not any(text == t or text.endswith("." + t)
                    or text.startswith(t + "(") or ("." + t + "(") in text
                    for t in _TO_STATIC_DECOS):
@@ -558,8 +614,7 @@ def _convert(fn):
     if key not in _TEMPLATES:
         _TEMPLATES[key] = _build_template(fn)
     code, name, has_decorators = _TEMPLATES[key]
-    glb = dict(fn.__globals__)
-    glb["_jst"] = _jst_mod
+    glb = _LiveGlobals(fn.__globals__, {"_jst": _jst_mod})
     exec(code, glb)
     freevars = fn.__code__.co_freevars
     if freevars:

@@ -2,6 +2,7 @@
 lax.cond / lax.while_loop / lax.scan (reference
 python/paddle/fluid/dygraph/dygraph_to_static/ — program_translator.py,
 ifelse_transformer.py, loop_transformer.py, convert_operators.py)."""
+import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -351,3 +352,117 @@ def test_program_translator_toggle():
 def test_conversion_fallback_is_graceful():
     # builtins have no source: convert_to_static must return them unchanged
     assert convert_to_static(len) is len
+
+
+# --------------------------------------------------------------------------
+# recursive conversion of called functions (convert_call)
+# --------------------------------------------------------------------------
+
+def _helper_gate(x):
+    """Module-level helper with tensor control flow, called from a
+    converted function — must be converted transitively."""
+    if x.sum() > 0:
+        return x * 2.0
+    return x * -3.0
+
+
+def test_called_helper_converted_transitively():
+    def f(x):
+        y = _helper_gate(x)          # helper has its own tensor `if`
+        return y + 1.0
+
+    g = _check_converted(f)
+    x = jnp.array([1.0, 2.0])
+    np.testing.assert_allclose(jax.jit(g)(x), x * 2.0 + 1.0)
+    np.testing.assert_allclose(jax.jit(g)(-x), x * 3.0 + 1.0)
+
+
+def test_called_method_converted_transitively():
+    class Gate:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            if x.mean() > 0:
+                return x * self.k
+            return x / self.k
+
+    def f(obj, x):
+        return obj.apply(x) + _helper_gate(x)
+
+    g = _check_converted(f)
+    gate = Gate(4.0)
+    x = jnp.array([2.0])
+    np.testing.assert_allclose(jax.jit(g, static_argnums=0)(gate, x),
+                               2.0 * 4.0 + 2.0 * 2.0)
+    np.testing.assert_allclose(jax.jit(g, static_argnums=0)(gate, -x),
+                               -2.0 / 4.0 + -2.0 * -3.0)
+
+
+_THRESHOLD = 0.0
+
+
+def test_converted_code_sees_live_module_globals(monkeypatch):
+    """Converted functions read module globals LIVE (monkeypatch and
+    config rebinds must be visible, as in unconverted Python)."""
+    def f(x):
+        if x.sum() > _THRESHOLD:
+            return x * 2.0
+        return x
+
+    g = _check_converted(f)
+    x = jnp.array([1.0])
+    np.testing.assert_allclose(g(x), x * 2.0)
+    monkeypatch.setattr(sys.modules[__name__], "_THRESHOLD", 100.0)
+    np.testing.assert_allclose(g(x), x)
+
+
+def test_generators_never_converted():
+    def gen(t):
+        acc = t * 0.0
+        if t.sum() > 0:
+            acc = t * 2.0
+        yield acc
+        yield acc + 1.0
+
+    assert convert_to_static(gen) is gen
+    t = jnp.array([1.0])
+    vals = list(gen(t))
+    assert len(vals) == 2
+
+    def f(x):
+        return sum(gen(x))           # called from converted code
+
+    g = _check_converted(f)
+    np.testing.assert_allclose(g(t), 2.0 * t + (2.0 * t + 1.0))
+
+
+def test_staticmethod_call_from_converted_code():
+    class C:
+        @staticmethod
+        def scale(x):
+            if x.sum() > 0:
+                return x * 5.0
+            return x
+
+    def f(x):
+        return C.scale(x) + C.__dict__["scale"](x)
+
+    g = _check_converted(f)
+    x = jnp.array([1.0])
+    np.testing.assert_allclose(jax.jit(g)(x), 10.0 * x)
+
+
+def test_library_calls_pass_through():
+    """jnp/paddle/builtin calls must not be touched by convert_call."""
+    def f(x):
+        h = jnp.tanh(x)
+        if h.sum() > 0:
+            return jnp.concatenate([h, h])
+        return jnp.concatenate([h, -h])
+
+    g = _check_converted(f)
+    x = jnp.array([1.0])
+    th = jnp.tanh(x)
+    np.testing.assert_allclose(jax.jit(g)(x), jnp.concatenate([th, th]))
+    np.testing.assert_allclose(jax.jit(g)(-x), jnp.concatenate([-th, th]))
