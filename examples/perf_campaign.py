@@ -1,0 +1,194 @@
+"""TPU perf campaign: structured sweeps for the BENCH configs that sit
+below the 0.35-MFU north star (ResNet-50, BERT-base), plus GPT
+confirmation.  Run ON THE CHIP; each trial prints one JSON line and
+appends to perf_campaign_results.jsonl so partial runs still record.
+
+    python examples/perf_campaign.py resnet   # bs + BN-dtype sweep
+    python examples/perf_campaign.py bert     # bs + dropout + tile sweep
+    python examples/perf_campaign.py gpt      # remat/bs confirmation
+    python examples/perf_campaign.py hlo      # fusion audit (transpose/f32 counts)
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def record(trial):
+    line = json.dumps(trial)
+    print(line, flush=True)
+    with open("perf_campaign_results.jsonl", "a") as f:
+        f.write(line + "\n")
+
+
+def _resnet_trial(batch_size, steps=10):
+    import bench
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = paddle.vision.models.resnet50(num_classes=1000,
+                                          data_format="NHWC")
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    weight_decay=1e-4)
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["image"]))
+        return paddle.nn.functional.cross_entropy(
+            logits, paddle.to_tensor(b["label"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    batch = bench._stage({
+        "image": rng.randn(batch_size, 224, 224, 3).astype("float32"),
+        "label": rng.randint(0, 1000, (batch_size,)).astype("int64")})
+    dt = bench._measure(trainer, batch, steps, f"resnet_bs{batch_size}")
+    imgs_s = batch_size / dt
+    mfu = 3 * 8.2e9 * imgs_s / bench.chip_peak_flops()
+    return {"config": "resnet50", "bs": batch_size,
+            "imgs_s": round(imgs_s, 1), "mfu": round(mfu, 4)}, trainer, batch
+
+
+def run_resnet():
+    for bs in (128, 256, 512):
+        try:
+            trial, _, _ = _resnet_trial(bs)
+            record(trial)
+        except Exception as e:
+            record({"config": "resnet50", "bs": bs,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            import gc
+            gc.collect()
+
+
+def run_hlo_audit():
+    """Compile the ResNet step and count fusion red flags in optimized
+    HLO: f32 convolutions, transposes, copies (docs/performance.md
+    profiling rules)."""
+    import jax.numpy as jnp
+    trial, trainer, batch = _resnet_trial(128, steps=1)
+    lowered = trainer._step_fn.lower(
+        trainer.params, trainer.opt_state, trainer.gt_state, trainer.consts,
+        0.1, {k: jnp.asarray(v) for k, v in batch.items()})
+    txt = lowered.compile().as_text()
+    counts = {
+        "conv_f32": sum(1 for l in txt.splitlines()
+                        if "convolution" in l and "f32[" in l.split("=")[0]),
+        "conv_total": txt.count(" convolution("),
+        "transpose": txt.count(" transpose("),
+        "copy": txt.count(" copy("),
+        "all_reduce": txt.count("all-reduce"),
+        "custom_call": txt.count("custom-call"),
+    }
+    record({"config": "resnet50_hlo_audit", **counts})
+    log("lines:", len(txt.splitlines()))
+
+
+def _bert_trial(batch_size, seq_len, dropout, steps=10):
+    import bench
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models.bert import (BertForPretraining,
+                                        BertPretrainingCriterion, bert_base)
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    cfg = bert_base(dtype="bfloat16")
+    if not dropout:
+        cfg.hidden_dropout = 0.0
+        cfg.attention_dropout = 0.0
+    model = BertForPretraining(cfg)
+    model.bfloat16()
+    model.train()
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 accumulator_dtype="bfloat16")
+
+    def loss_fn(m, b):
+        mlm, nsp = m(paddle.to_tensor(b["input_ids"]),
+                     attention_mask=paddle.to_tensor(b["attention_mask"]))
+        return crit(mlm, nsp, paddle.to_tensor(b["mlm_labels"]),
+                    paddle.to_tensor(b["nsp_labels"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, cfg.vocab_size, (batch_size, seq_len))
+    labels[rng.rand(batch_size, seq_len) > 0.15] = -100
+    lengths = rng.randint(int(seq_len * 0.75), seq_len + 1, (batch_size,))
+    attn = (np.arange(seq_len)[None, :] < lengths[:, None])
+    batch = bench._stage({
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (batch_size, seq_len)).astype("int32"),
+        "attention_mask": attn.astype("int32"),
+        "mlm_labels": labels.astype("int32"),
+        "nsp_labels": rng.randint(0, 2, (batch_size,)).astype("int64")})
+    dt = bench._measure(trainer, batch, steps,
+                        f"bert_bs{batch_size}_drop{dropout}")
+    seqs_s = batch_size / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    mfu = 6 * n_params * seqs_s * seq_len / bench.chip_peak_flops()
+    return {"config": "bert_base", "bs": batch_size, "seq": seq_len,
+            "dropout": dropout, "seqs_s": round(seqs_s, 2),
+            "mfu": round(mfu, 4)}
+
+
+def run_bert():
+    for bs, dropout in ((32, True), (32, False), (64, True), (64, False),
+                        (128, True)):
+        try:
+            record(_bert_trial(bs, 512, dropout))
+        except Exception as e:
+            record({"config": "bert_base", "bs": bs, "dropout": dropout,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            import gc
+            gc.collect()
+
+
+def run_flash_tune():
+    """On-device flash tile autotune at BERT's shape (seq 512, masked)."""
+    from paddle_tpu.incubate.autotune import tune_flash_attention
+    best = tune_flash_attention(batch=32, seq_len=512, num_heads=12,
+                                head_dim=64, causal=False)
+    record({"config": "flash_tune_bert", "best": str(best)})
+
+
+def run_gpt():
+    import bench
+    for name, bs, rp in (("gpt_1p3b", 4, "dots"), ("gpt_1p3b", 6, "dots"),
+                         ("gpt_1p3b", 8, "full")):
+        try:
+            tok_s, mfu, _ = bench.run_config(name, bs, 1024, remat_policy=rp)
+            record({"config": name, "bs": bs, "remat": rp,
+                    "tok_s": round(tok_s, 1), "mfu": round(mfu, 4)})
+        except Exception as e:
+            record({"config": name, "bs": bs, "remat": rp,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            import gc
+            gc.collect()
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("resnet", "all"):
+        run_resnet()
+    if which in ("hlo",):
+        run_hlo_audit()
+    if which in ("bert", "all"):
+        run_bert()
+    if which in ("tune",):
+        run_flash_tune()
+    if which in ("gpt", "all"):
+        run_gpt()
+
+
+if __name__ == "__main__":
+    main()

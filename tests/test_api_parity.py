@@ -43,6 +43,62 @@ def test_reference_top_level_modules_present():
     assert [len(b) for b in batched()] == [3, 3, 1]
 
 
+_SUBMODULES = {
+    "nn/__init__.py": "nn",
+    "nn/functional/__init__.py": "nn.functional",
+    "linalg.py": "linalg",
+    "fft.py": "fft",
+    "signal.py": "signal",
+    "distributed/__init__.py": "distributed",
+    "optimizer/__init__.py": "optimizer",
+    "vision/__init__.py": "vision",
+    "vision/ops.py": "vision.ops",
+    "metric/__init__.py": "metric",
+    "distribution/__init__.py": "distribution",
+    "io/__init__.py": "io",
+    "amp/__init__.py": "amp",
+    "autograd/__init__.py": "autograd",
+    "incubate/__init__.py": "incubate",
+    "static/__init__.py": "static",
+    "jit/__init__.py": "jit",
+    "text/__init__.py": "text",
+    "sparse/__init__.py": "sparse",
+    "utils/__init__.py": "utils",
+}
+
+
+def _module_all(relpath):
+    p = os.path.join(os.path.dirname(_REF_INIT), relpath)
+    with open(p) as f:
+        tree = ast.parse(f.read())
+    names = []
+    for node in ast.walk(tree):
+        tgts = (node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AugAssign) else [])
+        for t in tgts:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                v = node.value
+                if isinstance(v, (ast.List, ast.Tuple)):
+                    try:
+                        names += [ast.literal_eval(e) for e in v.elts]
+                    except ValueError:
+                        pass
+    return names
+
+
+@pytest.mark.skipif(not os.path.exists(_REF_INIT),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("relpath", sorted(_SUBMODULES))
+def test_reference_submodule_names_present(relpath):
+    names = _module_all(relpath)
+    assert names, f"no __all__ parsed from {relpath}"
+    mod = paddle
+    for part in _SUBMODULES[relpath].split("."):
+        mod = getattr(mod, part)
+    missing = [n for n in names if not hasattr(mod, n)]
+    assert not missing, f"{relpath}: missing {missing}"
+
+
 def test_kron():
     a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
     b = paddle.to_tensor([[0.0, 1.0], [1.0, 0.0]])
