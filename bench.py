@@ -302,7 +302,13 @@ def run_decode(batch=8, prompt_len=128, gen=128, quant=None):
     build_mesh(dp=1)
     rng = np.random.RandomState(0)
     last_err = None
-    for mk in (gpt_1p3b, gpt_350m, gpt_125m):
+    import os
+    models = (gpt_1p3b, gpt_350m, gpt_125m)
+    if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
+        from paddle_tpu.models import gpt_tiny
+        models = (gpt_tiny,)
+        batch, prompt_len, gen = 2, 16, 8
+    for mk in models:
         try:
             cfg = mk(max_seq_len=max(512, prompt_len + gen))
             model = GPT(cfg)
