@@ -32,11 +32,161 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["ring_attention_local", "ring_attention",
-           "zigzag_ring_attention_local"]
+           "ring_flash_attention_local", "zigzag_ring_attention_local"]
 
 
-def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None):
-    """Runs INSIDE shard_map. q,k,v: [B, L_local, H, D] (this shard)."""
+def ring_flash_attention_local(q, k, v, axis_name="sp", causal=True,
+                               scale=None):
+    """Flash-kernel ring attention INSIDE shard_map (the long-context
+    path): each ring step runs the Pallas flash kernel on the resident
+    K/V shard and merges (out, lse) partials by log-sum-exp, so nothing
+    of size Lq×Lk is ever materialized — per-device memory stays
+    O(L/sp · D). Fully-masked causal steps are skipped via lax.cond.
+
+    The custom VJP is the ring form of the flash backward: gradients for
+    a (q-shard, kv-shard) block pair computed with the GLOBAL lse are
+    exact partials of the global softmax, so dk/dv accumulators simply
+    rotate with their K/V shards and arrive home after the full cycle.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if q.shape[2] != k.shape[2]:
+        # GQA head-folding inside the per-step impls would break the lse
+        # merge bookkeeping; the dense path handles it
+        return ring_attention_local(q, k, v, axis_name, causal, scale,
+                                    use_flash=False)
+    out, _ = _ring_flash(q, k, v, axis_name, causal, float(scale))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    return _ring_flash_fwd_compute(q, k, v, axis_name, causal, scale)
+
+
+def _ring_flash_fwd_compute(q, k, v, axis_name, causal, scale):
+    from .attention import _flash_fwd_lse_impl
+
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # step 0: this shard's own block — causal square
+    out0, lse0 = _flash_fwd_lse_impl(q, k, v, causal, scale)
+    acc0 = jnp.swapaxes(out0, 1, 2).astype(jnp.float32)   # [B,H,Lq,D]
+    L0 = lse0                                             # [B,H,Lq,1] f32
+
+    def body(step, carry):
+        k_cur, v_cur, acc, L_run = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (my_idx - step) % sp
+
+        def merge(args):
+            acc, L_run = args
+            out_i, lse_i = _flash_fwd_lse_impl(q, k_cur, v_cur, False, scale)
+            oh = jnp.swapaxes(out_i, 1, 2).astype(jnp.float32)
+            L_new = jnp.logaddexp(L_run, lse_i)
+            acc = acc * jnp.exp(L_run - L_new) + oh * jnp.exp(lse_i - L_new)
+            return acc, L_new
+
+        if causal:
+            # skip blocks where every kv position is in the future
+            acc, L_run = jax.lax.cond(src < my_idx, merge, lambda a: a,
+                                      (acc, L_run))
+        else:
+            acc, L_run = merge((acc, L_run))
+        return k_cur, v_cur, acc, L_run
+
+    _, _, acc, L_tot = jax.lax.fori_loop(1, sp, body, (k, v, acc0, L0))
+    out = jnp.swapaxes(acc, 1, 2).astype(q.dtype)         # [B,Lq,H,D]
+    return out, L_tot
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd_compute(q, k, v, axis_name, causal, scale)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, cts):
+    from .attention import _flash_bwd_impl
+
+    q, k, v, out, lse = res
+    g = cts[0].astype(q.dtype)   # lse cotangent is zero in ring use
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # step 0: own causal block
+    dq0, dk0, dv0 = _flash_bwd_impl(q, k, v, out, lse, g, causal, scale)
+    dq0 = dq0.astype(jnp.float32)
+
+    def body(step, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        src = (my_idx - step) % sp
+
+        def compute(args):
+            dk_cur, dv_cur, dq = args
+            # global lse makes this block's grads exact global partials
+            dq_i, dk_i, dv_i = _flash_bwd_impl(q, k_cur, v_cur, out, lse,
+                                               g, False, scale)
+            return (dk_cur + dk_i.astype(dk_cur.dtype),
+                    dv_cur + dv_i.astype(dv_cur.dtype),
+                    dq + dq_i.astype(jnp.float32))
+
+        if causal:
+            dk_cur, dv_cur, dq = jax.lax.cond(src < my_idx, compute,
+                                              lambda a: a,
+                                              (dk_cur, dv_cur, dq))
+        else:
+            dk_cur, dv_cur, dq = compute((dk_cur, dv_cur, dq))
+        return k_cur, v_cur, dk_cur, dv_cur, dq
+
+    dk0 = dk0.astype(jnp.float32)
+    dv0 = dv0.astype(jnp.float32)
+    # after the remaining sp-1 rotations everything is one hop short of
+    # home; one final ppermute completes the cycle
+    k_f, v_f, dk, dv, dq = jax.lax.fori_loop(
+        1, sp, body, (k, v, dk0, dv0, dq0))
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _flash_ring_ok(q, k):
+    """Default-on gate for the flash ring path: the kernel's head-dim
+    tiling (ops/attention.py flash_attention_available), no GQA fold, and
+    128-aligned local sequence (the non-public impls don't pad)."""
+    B, Lq, Hq, D = q.shape
+    return (D in (64, 128, 256) and Hq == k.shape[2]
+            and Lq % 128 == 0 and k.shape[1] % 128 == 0)
+
+
+def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None,
+                         use_flash=None):
+    """Runs INSIDE shard_map. q,k,v: [B, L_local, H, D] (this shard).
+
+    use_flash: route each ring step through the Pallas flash kernel with
+    lse-merged partials (O(L/sp) memory — the long-context path). Default:
+    on for the TPU backend when the kernel supports the shape
+    (_flash_ring_ok); the dense jnp path remains for CPU tests, GQA, and
+    unaligned shapes."""
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and _flash_ring_ok(q, k)
+    if use_flash:
+        return ring_flash_attention_local(q, k, v, axis_name, causal, scale)
+    return _ring_dense_local(q, k, v, axis_name, causal, scale)
+
+
+def _ring_dense_local(q, k, v, axis_name="sp", causal=True, scale=None):
+    """Dense per-step scores (materializes Lq x Lk per ring step)."""
     sp = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
@@ -210,7 +360,8 @@ def _zigzag_to_contig(x, axis_name, sp):
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
-                   batch_axes=("dp", "fsdp"), scale=None, layout="contiguous"):
+                   batch_axes=("dp", "fsdp"), scale=None,
+                   layout="contiguous", use_flash=None):
     """shard_map wrapper: q,k,v are GLOBAL [B, L, H, D] arrays (or already
     sharded); the sequence dim is split over `axis_name`.
 
@@ -218,6 +369,10 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     load-balanced zigzag layout (2 ppermutes of half-shards each way),
     runs zigzag_ring_attention_local, and restores contiguous order —
     ~2x less attention compute at large sp for O(L·D) extra comms.
+
+    use_flash (contiguous layout only; zigzag is dense): per-ring-step
+    Pallas flash blocks with lse-merged partials — O(L/sp) attention
+    memory. None = auto (TPU + supported shape); see ring_attention_local.
     """
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
@@ -241,8 +396,19 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
             oz = zigzag_ring_attention_local(qz, kz, vz,
                                              axis_name=axis_name, scale=scale)
             return _zigzag_to_contig(oz, axis_name, sp)
+        check_vma = True
     else:
+        if use_flash is None:
+            l_loc = q.shape[1] // max(sp, 1)
+            use_flash = (jax.default_backend() == "tpu" and sp > 1
+                         and q.shape[-1] in (64, 128, 256)
+                         and q.shape[2] == k.shape[2]
+                         and l_loc % 128 == 0)
         fn = functools.partial(ring_attention_local, axis_name=axis_name,
-                               causal=causal, scale=scale)
+                               causal=causal, scale=scale,
+                               use_flash=use_flash)
+        # the vma checker can't see through pallas_call's out_shape (same
+        # caveat as ulysses.py); keep it active for the dense paths
+        check_vma = not use_flash
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+                     out_specs=spec, check_vma=check_vma)(q, k, v)
