@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["ring_attention_local", "ring_attention",
-           "ring_flash_attention_local", "zigzag_ring_attention_local"]
+           "ring_flash_attention_local", "zigzag_ring_attention_local",
+           "zigzag_ring_flash_attention_local"]
 
 
 def ring_flash_attention_local(q, k, v, axis_name="sp", causal=True,
@@ -85,10 +86,7 @@ def _ring_flash_fwd_compute(q, k, v, axis_name, causal, scale):
         def merge(args):
             acc, L_run = args
             out_i, lse_i = _flash_fwd_lse_impl(q, k_cur, v_cur, False, scale)
-            oh = jnp.swapaxes(out_i, 1, 2).astype(jnp.float32)
-            L_new = jnp.logaddexp(L_run, lse_i)
-            acc = acc * jnp.exp(L_run - L_new) + oh * jnp.exp(lse_i - L_new)
-            return acc, L_new
+            return _lse_merge(acc, L_run, out_i, lse_i)
 
         if causal:
             # skip blocks where every kv position is in the future
@@ -160,13 +158,197 @@ def _ring_flash_bwd(axis_name, causal, scale, res, cts):
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-def _flash_ring_ok(q, k):
-    """Default-on gate for the flash ring path: the kernel's head-dim
+def _flash_ring_ok(q_shape, kv_heads, block_len):
+    """Default-on gate for the flash ring paths: the kernel's head-dim
     tiling (ops/attention.py flash_attention_available), no GQA fold, and
-    128-aligned local sequence (the non-public impls don't pad)."""
-    B, Lq, Hq, D = q.shape
-    return (D in (64, 128, 256) and Hq == k.shape[2]
-            and Lq % 128 == 0 and k.shape[1] % 128 == 0)
+    128-aligned per-step block length (the non-public impls don't pad).
+    q_shape: [..., H, D] of the LOCAL q; block_len: rows per flash call
+    (L_local for contiguous, L_local/2 for zigzag)."""
+    H, D = q_shape[-2], q_shape[-1]
+    return (D in (64, 128, 256) and H == kv_heads
+            and block_len > 0 and block_len % 128 == 0)
+
+
+def _lse_merge(acc, L_run, out_i, lse_i):
+    """Merge a flash partial (normalized out_i [B,L,H,D], lse_i
+    [B,H,L,1]) into the running (acc [B,H,L,D] f32, L_run) pair."""
+    oh = jnp.swapaxes(out_i, 1, 2).astype(jnp.float32)
+    L_new = jnp.logaddexp(L_run, lse_i)
+    acc = acc * jnp.exp(L_run - L_new) + oh * jnp.exp(lse_i - L_new)
+    return acc, L_new
+
+
+def zigzag_ring_flash_attention_local(q, k, v, axis_name="sp", scale=None):
+    """Flash-kernel zigzag ring (causal): the load-balanced layout AND
+    O(L/sp) attention memory.  Every ring step runs exactly two Lh x Lh
+    flash blocks per device (block X: q-half-1 x visiting chunk-0, always
+    unmasked; block Y: the early/late where-selected half pair), partials
+    merged by lse per query half.  Same custom-VJP scheme as the
+    contiguous flash ring: block grads against the global per-half lse
+    are exact partials, dk/dv rotate home with their shards."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if q.shape[2] != k.shape[2]:
+        # GQA head-folding breaks the per-half lse bookkeeping; dense path
+        return _zigzag_dense_local(q, k, v, axis_name, scale)
+    out, _ = _zz_ring_flash(q, k, v, axis_name, float(scale))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zz_ring_flash(q, k, v, axis_name, scale):
+    return _zz_ring_flash_fwd_compute(q, k, v, axis_name, scale)
+
+
+def _zz_ring_flash_fwd_compute(q, k, v, axis_name, scale):
+    from .attention import _flash_fwd_lse_impl
+
+    sp = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    Lh = q.shape[1] // 2
+    q0, q1 = q[:, :Lh], q[:, Lh:]
+
+    def halves(t):
+        return t[:, :Lh], t[:, Lh:]
+
+    k0, k1 = halves(k)
+    v0, v1 = halves(v)
+
+    # step 0: local causal in zigzag order = three flash blocks
+    o, lse = _flash_fwd_lse_impl(q0, k0, v0, True, scale)     # q0 x c_d
+    acc0 = jnp.swapaxes(o, 1, 2).astype(jnp.float32)
+    L0 = lse
+    o, lse = _flash_fwd_lse_impl(q1, k0, v0, False, scale)    # q1 x c_d
+    acc1 = jnp.swapaxes(o, 1, 2).astype(jnp.float32)
+    L1 = lse
+    o, lse = _flash_fwd_lse_impl(q1, k1, v1, True, scale)     # q1 x c_{2S-1-d}
+    acc1, L1 = _lse_merge(acc1, L1, o, lse)
+
+    def body(t, carry):
+        k_cur, v_cur, acc0, L0, acc1, L1 = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (d - t) % sp
+        kc0, kc1 = halves(k_cur)
+        vc0, vc1 = halves(v_cur)
+        # block X: q1 x visiting chunk src — always fully unmasked
+        o, lse = _flash_fwd_lse_impl(q1, kc0, vc0, False, scale)
+        acc1, L1 = _lse_merge(acc1, L1, o, lse)
+        # block Y: early shard -> q0 x kc0, later -> q1 x kc1; select so
+        # the flash kernel runs once
+        early = src < d
+        q_sel = jnp.where(early, q0, q1)
+        k_sel = jnp.where(early, kc0, kc1)
+        v_sel = jnp.where(early, vc0, vc1)
+        a_sel = jnp.where(early, acc0, acc1)
+        L_sel = jnp.where(early, L0, L1)
+        o, lse = _flash_fwd_lse_impl(q_sel, k_sel, v_sel, False, scale)
+        a_new, L_new = _lse_merge(a_sel, L_sel, o, lse)
+        acc0 = jnp.where(early, a_new, acc0)
+        L0 = jnp.where(early, L_new, L0)
+        acc1 = jnp.where(early, acc1, a_new)
+        L1 = jnp.where(early, L1, L_new)
+        return k_cur, v_cur, acc0, L0, acc1, L1
+
+    _, _, acc0, L0, acc1, L1 = jax.lax.fori_loop(
+        1, sp, body, (k, v, acc0, L0, acc1, L1))
+    out = jnp.concatenate([jnp.swapaxes(acc0, 1, 2),
+                           jnp.swapaxes(acc1, 1, 2)], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([L0, L1], axis=2)                   # [B,H,2Lh,1]
+    return out, lse
+
+
+def _zz_ring_flash_fwd(q, k, v, axis_name, scale):
+    out, lse = _zz_ring_flash_fwd_compute(q, k, v, axis_name, scale)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _zz_ring_flash_bwd(axis_name, scale, res, cts):
+    from .attention import _flash_bwd_impl
+
+    q, k, v, out, lse = res
+    g = cts[0].astype(q.dtype)
+    sp = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    Lh = q.shape[1] // 2
+
+    def halves(t, axis=1):
+        if axis == 1:
+            return t[:, :Lh], t[:, Lh:]
+        return t[:, :, :Lh], t[:, :, Lh:]
+
+    q0, q1 = halves(q)
+    k0, k1 = halves(k)
+    v0, v1 = halves(v)
+    out0, out1 = halves(out)
+    g0, g1 = halves(g)
+    lse0, lse1 = halves(lse, axis=2)
+
+    # step 0: the three local blocks
+    dq_a, dk_a, dv_a = _flash_bwd_impl(q0, k0, v0, out0, lse0, g0, True,
+                                       scale)
+    dq_b, dk_b, dv_b = _flash_bwd_impl(q1, k0, v0, out1, lse1, g1, False,
+                                       scale)
+    dq_c, dk_c, dv_c = _flash_bwd_impl(q1, k1, v1, out1, lse1, g1, True,
+                                       scale)
+    dq0 = dq_a.astype(jnp.float32)
+    dq1 = (dq_b + dq_c).astype(jnp.float32)
+    dk_own = jnp.concatenate([(dk_a + dk_b), dk_c], axis=1) \
+        .astype(jnp.float32)
+    dv_own = jnp.concatenate([(dv_a + dv_b), dv_c], axis=1) \
+        .astype(jnp.float32)
+
+    def body(t, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq0, dq1 = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        src = (d - t) % sp
+        kc0, kc1 = halves(k_cur)
+        vc0, vc1 = halves(v_cur)
+        # block X: q1 x chunk src (full)
+        dq_i, dk_i, dv_i = _flash_bwd_impl(q1, kc0, vc0, out1, lse1, g1,
+                                           False, scale)
+        dq1 = dq1 + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur.at[:, :Lh].add(dk_i.astype(jnp.float32))
+        dv_cur = dv_cur.at[:, :Lh].add(dv_i.astype(jnp.float32))
+        # block Y (selected half pair)
+        early = src < d
+        q_sel = jnp.where(early, q0, q1)
+        k_sel = jnp.where(early, kc0, kc1)
+        v_sel = jnp.where(early, vc0, vc1)
+        o_sel = jnp.where(early, out0, out1)
+        l_sel = jnp.where(early, lse0, lse1)
+        g_sel = jnp.where(early, g0, g1)
+        dq_i, dk_i, dv_i = _flash_bwd_impl(q_sel, k_sel, v_sel, o_sel,
+                                           l_sel, g_sel, False, scale)
+        dq_i = dq_i.astype(jnp.float32)
+        dk_i = dk_i.astype(jnp.float32)
+        dv_i = dv_i.astype(jnp.float32)
+        zero = jnp.zeros_like(dk_i)
+        dq0 = dq0 + jnp.where(early, dq_i, 0.0)
+        dq1 = dq1 + jnp.where(early, 0.0, dq_i)
+        dk_cur = dk_cur + jnp.concatenate(
+            [jnp.where(early, dk_i, zero), jnp.where(early, zero, dk_i)],
+            axis=1)
+        dv_cur = dv_cur + jnp.concatenate(
+            [jnp.where(early, dv_i, zero), jnp.where(early, zero, dv_i)],
+            axis=1)
+        return k_cur, v_cur, dk_cur, dv_cur, dq0, dq1
+
+    _, _, dk, dv, dq0, dq1 = jax.lax.fori_loop(
+        1, sp, body, (k, v, dk_own, dv_own, dq0, dq1))
+    # complete the rotation cycle so accumulators land on their owners
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    dq = jnp.concatenate([dq0, dq1], axis=1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_zz_ring_flash.defvjp(_zz_ring_flash_fwd, _zz_ring_flash_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None,
@@ -179,7 +361,8 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None,
     (_flash_ring_ok); the dense jnp path remains for CPU tests, GQA, and
     unaligned shapes."""
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu" and _flash_ring_ok(q, k)
+        use_flash = (jax.default_backend() == "tpu"
+                     and _flash_ring_ok(q.shape, k.shape[2], q.shape[1]))
     if use_flash:
         return ring_flash_attention_local(q, k, v, axis_name, causal, scale)
     return _ring_dense_local(q, k, v, axis_name, causal, scale)
@@ -239,13 +422,29 @@ def _online_update(m, l, acc, s, vh):
         acc * corr + p @ vh
 
 
-def zigzag_ring_attention_local(q, k, v, axis_name="sp", scale=None):
+def zigzag_ring_attention_local(q, k, v, axis_name="sp", scale=None,
+                                use_flash=None):
     """Causal ring attention with the zigzag layout, INSIDE shard_map.
 
     q,k,v: [B, 2*Lh, H, D] — this shard's two half-chunks, ALREADY in
     zigzag order: rows [:Lh] are global chunk d, rows [Lh:] are global
     chunk 2S-1-d. Output is in the same zigzag order.
-    """
+
+    use_flash routes the per-step half-blocks through the Pallas flash
+    kernel with lse merging (zigzag_ring_flash_attention_local): balanced
+    load AND O(L/sp) memory. Default: on for TPU when the half-chunk
+    shape fits the kernel (head-dim tiling, 128-aligned Lh, no GQA)."""
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu"
+                     and _flash_ring_ok(q.shape, k.shape[2],
+                                        q.shape[1] // 2))
+    if use_flash:
+        return zigzag_ring_flash_attention_local(q, k, v, axis_name, scale)
+    return _zigzag_dense_local(q, k, v, axis_name, scale)
+
+
+def _zigzag_dense_local(q, k, v, axis_name="sp", scale=None):
+    """Dense zigzag step blocks (materializes Lh x Lh scores per block)."""
     sp = jax.lax.axis_size(axis_name)
     d = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
@@ -370,9 +569,9 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     runs zigzag_ring_attention_local, and restores contiguous order —
     ~2x less attention compute at large sp for O(L·D) extra comms.
 
-    use_flash (contiguous layout only; zigzag is dense): per-ring-step
-    Pallas flash blocks with lse-merged partials — O(L/sp) attention
-    memory. None = auto (TPU + supported shape); see ring_attention_local.
+    use_flash (both layouts): per-ring-step Pallas flash blocks with
+    lse-merged partials — O(L/sp) attention memory. None = auto (TPU +
+    supported shape; zigzag additionally needs 128-aligned half-chunks).
     """
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
@@ -389,21 +588,25 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
                 f"ring_attention(layout='zigzag') needs the sequence length "
                 f"divisible by 2*sp = {2 * sp} (two half-chunks per shard); "
                 f"got L={L} over sp={sp}")
-        def fn(qv, kv, vv):
+        if use_flash is None:
+            use_flash = (jax.default_backend() == "tpu"
+                         and _flash_ring_ok(q.shape, k.shape[2],
+                                            q.shape[1] // max(2 * sp, 1)))
+
+        def fn(qv, kv, vv, _uf=use_flash):
             qz = _contig_to_zigzag(qv, axis_name, sp)
             kz = _contig_to_zigzag(kv, axis_name, sp)
             vz = _contig_to_zigzag(vv, axis_name, sp)
             oz = zigzag_ring_attention_local(qz, kz, vz,
-                                             axis_name=axis_name, scale=scale)
+                                             axis_name=axis_name,
+                                             scale=scale, use_flash=_uf)
             return _zigzag_to_contig(oz, axis_name, sp)
-        check_vma = True
+        check_vma = not use_flash
     else:
         if use_flash is None:
-            l_loc = q.shape[1] // max(sp, 1)
             use_flash = (jax.default_backend() == "tpu" and sp > 1
-                         and q.shape[-1] in (64, 128, 256)
-                         and q.shape[2] == k.shape[2]
-                         and l_loc % 128 == 0)
+                         and _flash_ring_ok(q.shape, k.shape[2],
+                                            q.shape[1] // max(sp, 1)))
         fn = functools.partial(ring_attention_local, axis_name=axis_name,
                                causal=causal, scale=scale,
                                use_flash=use_flash)
