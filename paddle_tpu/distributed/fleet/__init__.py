@@ -10,6 +10,17 @@ import jax
 from ...framework.core import Tensor
 from ..mesh import build_mesh, get_mesh, mesh_axis_size
 from ..sharding_utils import plan_shardings, shard_params
+from .base import (  # noqa: F401
+    CommunicateTopology,
+    DataGenerator,
+    Fleet,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -23,6 +34,9 @@ __all__ = [
     "get_hybrid_communicate_group", "worker_index", "worker_num", "is_first_worker",
     "HybridCommunicateGroup", "ColumnParallelLinear", "RowParallelLinear",
     "VocabParallelEmbedding", "ParallelCrossEntropy", "get_rng_state_tracker",
+    "Fleet", "UtilBase", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+    "CommunicateTopology", "DataGenerator", "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator",
 ]
 
 

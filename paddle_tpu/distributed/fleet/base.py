@@ -1,0 +1,326 @@
+"""Fleet base infrastructure — reference
+python/paddle/distributed/fleet/base/{topology,role_maker,util_factory}.py
+and fleet/data_generator/data_generator.py.
+
+TPU-native notes: role information comes from the launcher's env
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, set by
+paddle_tpu.distributed.launch) instead of gloo/etcd; there is no
+parameter-server mode, so every role is WORKER and the data generators
+exist for their text-protocol (they are host-side utilities usable for
+any slot-style ingestion).
+"""
+import collections
+import os
+import sys
+from functools import reduce
+from itertools import product
+
+__all__ = ["CommunicateTopology", "Role", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UtilBase", "DataGenerator",
+           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+           "Fleet"]
+
+
+class CommunicateTopology:
+    """Rank <-> hybrid-coordinate bookkeeping (reference
+    fleet/base/topology.py:52)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate",
+                                                 self._parallel_names)
+        self._world_size = reduce(lambda x, y: x * y, self._dims)
+        ranges = [range(d) for d in self._dims]
+        all_coord = [self.coordinate(*x) for x in product(*ranges)]
+        self._coord2rank = dict(zip(all_coord, range(len(all_coord))))
+        self._rank2coord = dict(zip(self._coord2rank.values(),
+                                    self._coord2rank.keys()))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        assert len(args) == len(self._dims), args
+        key = self.coordinate(**args)
+        return self._coord2rank[key]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """Rank groups that communicate along `axis_name` (all other
+        coordinates fixed)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [self._parallel_names[i]
+                 for i in range(len(self._dims)) if i != axis]
+        groups = {}
+        for coord, rank in self._coord2rank.items():
+            key = tuple(getattr(coord, n) for n in other)
+            groups.setdefault(key, []).append(rank)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = coord._asdict()
+        tf.update(kwargs)
+        return self.get_rank(**tf)
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Role info from the launcher env (reference role_maker.py; gloo and
+    the parameter-server paths don't exist here — everyone is a WORKER)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def _worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_first_worker = _is_first_worker
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, current_id=0,
+                 role=Role.WORKER, worker_endpoints=None, server_endpoints=None,
+                 **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._current_id = current_id
+        self._user_role = role
+        self._worker_endpoints = worker_endpoints or []
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def _role(self):
+        return self._user_role
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+
+
+class UtilBase:
+    """Host-side helpers (reference fleet/utils/fleet_util.py surface)."""
+
+    def get_file_shard(self, files):
+        """This worker's slice of a file list (contiguous split with the
+        remainder spread over the first workers)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        i = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        blocks = len(files) // n
+        remain = len(files) % n
+        begin = blocks * i + min(i, remain)
+        end = begin + blocks + (1 if i < remain else 0)
+        return files[begin:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == rank_id:
+            print(message, flush=True)
+
+    def all_reduce(self, input, mode="sum"):
+        """Cross-process reduction of host values; single-controller JAX
+        jobs reduce over jax processes when initialized, else identity."""
+        import numpy as np
+        import jax
+        arr = np.asarray(input)
+        if jax.process_count() <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(arr)
+        if mode == "sum":
+            return out.sum(axis=0)
+        if mode == "max":
+            return out.max(axis=0)
+        if mode == "min":
+            return out.min(axis=0)
+        raise ValueError(f"unsupported mode {mode!r}")
+
+    def barrier(self, comm_world="worker"):
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+
+
+class DataGenerator:
+    """Slot-format streaming data generator (reference
+    fleet/data_generator): subclass and override generate_sample(line);
+    run_from_stdin() turns stdin lines into the MultiSlotDataFeed text
+    protocol on stdout."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "override generate_sample(line) -> callable yielding "
+            "[(slot_name, [values...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def run_from_stdin(self):
+        self._run(sys.stdin, sys.stdout)
+
+    def run_from_memory(self, lines):
+        """Same pipeline over in-memory lines; returns the encoded
+        strings (testable without process plumbing)."""
+        out = []
+
+        class _Sink:
+            def write(self, s):
+                out.append(s)
+        self._run(lines, _Sink())
+        return out
+
+    def _run(self, line_iter, sink):
+        batch = []
+        for line in line_iter:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        sink.write(self._gen_str(s))
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                sink.write(self._gen_str(s))
+
+
+def _check_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample() must be list or tuple, e.g. "
+            "[('words', [1926, 8, 17]), ('label', [1])]")
+    return line
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        parts = []
+        for name, elements in _check_slots(line):
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        parts = []
+        for name, elements in _check_slots(line):
+            if not elements:
+                raise ValueError(f"slot {name!r} has no values")
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class Fleet:
+    """The Fleet object API (reference fleet/base/fleet_base.py:Fleet);
+    the module-level paddle.distributed.fleet functions are the singleton
+    form of this class."""
+
+    def __init__(self):
+        self._util = UtilBase()
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        from . import init as _init
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return _init(role_maker=role_maker, is_collective=is_collective,
+                     strategy=strategy)
+
+    @property
+    def util(self):
+        return self._util
+
+    def worker_index(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_index()
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def worker_num(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_num()
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        self._util.barrier()
+
+    def distributed_model(self, model):
+        from . import distributed_model as _dm
+        return _dm(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from . import distributed_optimizer as _do
+        return _do(optimizer, strategy)
+
+    def stop_worker(self):
+        pass

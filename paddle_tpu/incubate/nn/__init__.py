@@ -8,7 +8,7 @@ from ...framework.core import Tensor
 from ...nn import functional as F
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedTransformerEncoderLayer",
-           "functional"]
+           "FusedMultiTransformer", "functional"]
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -91,6 +91,223 @@ class FusedTransformerEncoderLayer(nn.Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Whole-stack fused transformer (reference
+    python/paddle/incubate/nn/layer/fused_transformer.py:627 — the
+    multi-layer inference/decode block behind FasterGPT).  TPU-native:
+    per-layer weights live STACKED on a leading [num_layers] axis and the
+    forward is one lax.scan over layers — flash attention for the
+    self-attention, XLA-fused FFN — so the whole stack compiles into a
+    single fused program.  Supports decode `caches` ((k, v) buffers per
+    the stacked layout) with `time_step` positioning.
+
+    Per-layer *_attrs are honored (list = per layer, single = shared);
+    note the TPU-native weight layout: qkv [h, 3h], linear [h, h],
+    ffn1 [h, f], ffn2 [f, h] — transpose reference [3, heads, dim, h]
+    checkpoints accordingly when assigning."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, ring_id=-1,
+                 name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0 and dim_feedforward > 0
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.num_layers = num_layers
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        from ...nn.initializer import Constant, XavierUniform
+        L, h, f = num_layers, embed_dim, dim_feedforward
+        one, zero = Constant(1.0), Constant(0.0)
+        xav = XavierUniform()
+
+        def mk(shape, attrs, default_init):
+            """Stacked [L, *shape] parameter honoring the reference's
+            per-layer attrs convention: a list/tuple gives layer i its
+            own initializer; a single attr applies to every layer."""
+            if attrs is None:
+                return self.create_parameter(
+                    [L] + shape, default_initializer=default_init)
+            from ...nn.layer_base import ParamAttr
+            if isinstance(attrs, (list, tuple)):
+                if len(attrs) != L:
+                    raise ValueError(
+                        f"expected {L} per-layer attrs, got {len(attrs)}")
+                per = [ParamAttr._to_attr(a) for a in attrs]
+            else:
+                per = [ParamAttr._to_attr(attrs)] * L
+            slices = [(a.initializer or default_init)(shape, "float32")
+                      for a in per]
+            stacked = jnp.stack([jnp.asarray(s) for s in slices])
+            from ...nn.initializer import Assign
+            return self.create_parameter(
+                [L] + shape, default_initializer=Assign(stacked))
+
+        self.ln_scale = mk([h], ln_scale_attrs, one)
+        self.ln_bias = mk([h], ln_bias_attrs, zero)
+        self.qkv_weight = mk([h, 3 * h], qkv_weight_attrs, xav)
+        self.qkv_bias = mk([3 * h], qkv_bias_attrs, zero)
+        self.linear_weight = mk([h, h], linear_weight_attrs, xav)
+        self.linear_bias = mk([h], linear_bias_attrs, zero)
+        self.ffn_ln_scale = mk([h], ffn_ln_scale_attrs, one)
+        self.ffn_ln_bias = mk([h], ffn_ln_bias_attrs, zero)
+        self.ffn1_weight = mk([h, f], ffn1_weight_attrs, xav)
+        self.ffn1_bias = mk([f], ffn1_bias_attrs, zero)
+        self.ffn2_weight = mk([f, h], ffn2_weight_attrs, xav)
+        self.ffn2_bias = mk([h], ffn2_bias_attrs, zero)
+
+    def gen_cache(self, batch_size, max_len):
+        """Stacked decode KV buffers: (k, v) each
+        [num_layers, B, max_len, num_heads, head_dim]."""
+        shape = (self.num_layers, batch_size, max_len, self.num_heads,
+                 self.head_dim)
+        z = jnp.zeros(shape, jnp.dtype(self.qkv_weight.dtype))
+        return Tensor(z), Tensor(z)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        import numpy as np
+
+        import jax
+
+        from ...framework.core import apply_op
+
+        eps = self.epsilon
+        H, D = self.num_heads, self.head_dim
+        pre = self.normalize_before
+        act = self.activation
+        have_mask = attn_mask is not None
+        have_cache = caches is not None
+        step = None
+        if time_step is not None:
+            step = time_step._value if isinstance(time_step, Tensor) \
+                else jnp.asarray(time_step)
+
+        rate = float(self.dropout_rate) if self.training else 0.0
+        # per-call seed (same convention/limitation as the flash kernel's
+        # _next_seed: varies per eager call, a trace-time constant under jit)
+        from ...ops.attention import _next_seed
+        seed = jnp.uint32(_next_seed() if rate else 0)
+
+        def ln(x, w, b):
+            x32 = x.astype(jnp.float32)
+            mu = x32.mean(-1, keepdims=True)
+            var = x32.var(-1, keepdims=True)
+            return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b) \
+                .astype(x.dtype)
+
+        def drop(t, salt):
+            if not rate:
+                return t
+            # deterministic counter-hash RNG (the repo's cheap dropout —
+            # see ops/attention.py): ~8 int ops/elem, no key plumbing
+            from ...ops.attention import _hash32, _rate_thresh
+            ids = jax.lax.iota(jnp.uint32, t.size).reshape(t.shape)
+            keep = _hash32(ids ^ jnp.uint32(salt) ^ seed) \
+                >= _rate_thresh(rate)
+            return jnp.where(keep, t / (1.0 - rate), 0).astype(t.dtype)
+
+        def run(xv, *rest):
+            i = 0
+            mask = rest[0] if have_mask else None
+            i += 1 if have_mask else 0
+            kc = rest[i] if have_cache else None
+            vc = rest[i + 1] if have_cache else None
+            i += 2 if have_cache else 0
+            (ln_w, ln_b, qkv_w, qkv_b, lin_w, lin_b, fln_w, fln_b,
+             f1_w, f1_b, f2_w, f2_b) = rest[i:]
+            B, Lq = xv.shape[0], xv.shape[1]
+
+            def layer(x, wl):
+                (li, ln_w, ln_b, qkv_w, qkv_b, lin_w, lin_b, fln_w, fln_b,
+                 f1_w, f1_b, f2_w, f2_b, kci, vci) = wl
+                salt0 = li * jnp.uint32(3)
+                res = x
+                y = ln(x, ln_w, ln_b) if pre else x
+                qkv = (y @ qkv_w.astype(y.dtype)
+                       + qkv_b.astype(y.dtype)).reshape(B, Lq, 3, H, D)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                if have_cache:
+                    # decode: append at time_step, attend over the prefix
+                    kci = jax.lax.dynamic_update_slice(
+                        kci, k.astype(kci.dtype), (0, step, 0, 0))
+                    vci = jax.lax.dynamic_update_slice(
+                        vci, v.astype(vci.dtype), (0, step, 0, 0))
+                    Lmax = kci.shape[1]
+                    scale = 1.0 / float(np.sqrt(D))
+                    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+                    kh = jnp.swapaxes(kci, 1, 2).astype(jnp.float32)
+                    vh = jnp.swapaxes(vci, 1, 2).astype(jnp.float32)
+                    s = qh @ jnp.swapaxes(kh, -1, -2)
+                    qpos = step + jax.lax.broadcasted_iota(
+                        jnp.int32, (Lq, Lmax), 0)
+                    kpos = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lmax), 1)
+                    s = jnp.where(kpos <= qpos, s, -1e30)
+                    if mask is not None:
+                        m = mask
+                        while m.ndim < 4:
+                            m = m[None]
+                        if m.dtype == jnp.bool_:
+                            s = jnp.where(m, s, -1e30)
+                        else:
+                            s = s + m.astype(s.dtype)
+                    p = jax.nn.softmax(s, axis=-1)
+                    attn = jnp.swapaxes(p @ vh, 1, 2).astype(x.dtype)
+                else:
+                    from ...ops.attention import mha_reference
+                    attn = mha_reference(q, k, v, causal=mask is None,
+                                         attn_mask=mask)
+                attn = attn.reshape(B, Lq, H * D)
+                o = attn @ lin_w.astype(attn.dtype) + lin_b.astype(attn.dtype)
+                x = res + drop(o, salt0)
+                if not pre:
+                    x = ln(x, ln_w, ln_b)
+                res = x
+                y = ln(x, fln_w, fln_b) if pre else x
+                hdn = y @ f1_w.astype(y.dtype) + f1_b.astype(y.dtype)
+                hdn = drop(getattr(jax.nn, act)(hdn), salt0 + jnp.uint32(1))
+                y = hdn @ f2_w.astype(hdn.dtype) + f2_b.astype(hdn.dtype)
+                x = res + drop(y, salt0 + jnp.uint32(2))
+                if not pre:
+                    x = ln(x, fln_w, fln_b)
+                return x, (kci, vci)
+
+            L = ln_w.shape[0]
+            kc_xs = kc if have_cache else jnp.zeros((L, 0))
+            vc_xs = vc if have_cache else jnp.zeros((L, 0))
+            xs = (jnp.arange(L, dtype=jnp.uint32), ln_w, ln_b, qkv_w,
+                  qkv_b, lin_w, lin_b, fln_w, fln_b,
+                  f1_w, f1_b, f2_w, f2_b, kc_xs, vc_xs)
+            out, (nk, nv) = jax.lax.scan(layer, xv, xs)
+            return out, nk, nv
+
+        params = (self.ln_scale, self.ln_bias, self.qkv_weight,
+                  self.qkv_bias, self.linear_weight, self.linear_bias,
+                  self.ffn_ln_scale, self.ffn_ln_bias, self.ffn1_weight,
+                  self.ffn1_bias, self.ffn2_weight, self.ffn2_bias)
+        args = (src,)
+        if have_mask:
+            args += (attn_mask,)
+        if have_cache:
+            args += tuple(caches)
+        out, nk, nv = apply_op(run, *args, *params)
+        if have_cache:
+            return out, (nk, nv)
+        return out
 
 
 class functional:

@@ -16,7 +16,8 @@ from ...framework.random import next_key
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+    "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
+    "set_global_initializer",
 ]
 
 
@@ -180,6 +181,26 @@ class Dirac(Initializer):
             for i in range(min(per_group, in_c)):
                 idx = (g * per_group + i, i, *centers)
                 arr[idx] = 1.0
+        return jnp.asarray(arr, _dt(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference
+    python/paddle/nn/initializer/Bilinear): each [kh, kw] slice is the
+    bilinear interpolation stencil, identical across channels."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv "
+                             f"weight, got shape {shape}")
+        arr = np.zeros(tuple(shape), np.float32)
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        stencil = (1 - np.abs(yy / fh - ch)) * (1 - np.abs(xx / fw - cw))
+        arr[:, :] = stencil
         return jnp.asarray(arr, _dt(dtype))
 
 

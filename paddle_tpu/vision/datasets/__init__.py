@@ -10,7 +10,8 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder", "FakeImageDataset", "flowers", "voc2012"]
+           "ImageFolder", "FakeImageDataset", "Flowers", "VOC2012",
+           "flowers", "voc2012"]
 
 
 class FakeImageDataset(Dataset):
@@ -198,9 +199,116 @@ class ImageFolder(Dataset):
         return len(self.samples)
 
 
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py). This
+    environment has no network, so the archive paths are REQUIRED:
+    data_file (102flowers.tgz), label_file (imagelabels.mat), setid_file
+    (setid.mat). Streaming: images are read from the tar on demand."""
+
+    _MODE_FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        mode = mode.lower()
+        assert mode in self._MODE_FLAG, mode
+        if not (data_file and label_file and setid_file):
+            raise ValueError(
+                "zero-egress environment: pass local data_file "
+                "(102flowers.tgz), label_file (imagelabels.mat) and "
+                "setid_file (setid.mat) — downloads are disabled")
+        import tarfile
+
+        import scipy.io as scio
+        self.transform = transform
+        # extract ONCE (as the reference does): random access into a gzip
+        # tar re-decompresses from the start per read, and an open tar
+        # handle can't be shared across forked DataLoader workers
+        self.data_path = data_file + ".extracted/"
+        if not os.path.isdir(os.path.join(self.data_path, "jpg")):
+            os.makedirs(self.data_path, exist_ok=True)
+            with tarfile.open(data_file) as t:
+                t.extractall(self.data_path)
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._MODE_FLAG[mode]][0]
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]], "int64")
+        image = _load_image(
+            os.path.join(self.data_path, "jpg/image_%05d.jpg" % index))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference vision/datasets/voc2012.py).
+    Requires the local VOCtrainval tar (zero-egress); yields
+    (image, segmentation-label) arrays streamed from the archive."""
+
+    _SETS = {"train": "train.txt", "valid": "val.txt",
+             "trainval": "trainval.txt"}
+    _VOC = "VOCdevkit/VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        assert mode in self._SETS, mode
+        if not data_file:
+            raise ValueError(
+                "zero-egress environment: pass the local "
+                "VOCtrainval_11-May-2012.tar as data_file — downloads "
+                "are disabled")
+        self.transform = transform
+        self._data_file = data_file
+        self._tars = {}          # pid -> handle (fork-safe: one per worker)
+        tar = self._get_tar()
+        listing = f"{self._VOC}/ImageSets/Segmentation/{self._SETS[mode]}"
+        names = tar.extractfile(listing).read().decode().split()
+        self.data = [f"{self._VOC}/JPEGImages/{n}.jpg" for n in names]
+        self.labels = [f"{self._VOC}/SegmentationClass/{n}.png"
+                       for n in names]
+
+    def _get_tar(self):
+        """A forked DataLoader worker must not share the parent's tar
+        file descriptor (concurrent seeks corrupt reads): one handle per
+        process."""
+        import tarfile
+        pid = os.getpid()
+        if pid not in self._tars:
+            self._tars.clear()
+            self._tars[pid] = tarfile.open(self._data_file)
+        return self._tars[pid]
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tars"] = {}
+        return state
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        tar = self._get_tar()
+        img = np.asarray(Image.open(_io.BytesIO(
+            tar.extractfile(self.data[idx]).read())).convert("RGB"))
+        lab = np.asarray(Image.open(_io.BytesIO(
+            tar.extractfile(self.labels[idx]).read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self.data)
+
+
 def flowers(*a, **k):
-    raise NotImplementedError("zero-egress: use DatasetFolder on a local copy")
+    """Legacy reader-style entry (reference paddle.dataset.flowers)."""
+    return Flowers(*a, **k)
 
 
 def voc2012(*a, **k):
-    raise NotImplementedError("zero-egress: use DatasetFolder on a local copy")
+    return VOC2012(*a, **k)

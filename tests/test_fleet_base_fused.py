@@ -1,0 +1,219 @@
+"""Fleet base infra (topology/rolemaker/util/data generators),
+FusedMultiTransformer, Bilinear initializer, Flowers/VOC2012 datasets —
+reference fleet/base/*, incubate/nn/layer/fused_transformer.py:627,
+nn/initializer/Bilinear, vision/datasets/{flowers,voc2012}.py."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+
+
+def test_communicate_topology_roundtrip():
+    topo = fleet.CommunicateTopology(["data", "pipe", "model"], [2, 2, 2])
+    assert topo.world_size() == 8
+    for r in range(8):
+        c = topo.get_coord(r)
+        assert topo.get_rank(data=c.data, pipe=c.pipe, model=c.model) == r
+    assert topo.get_dim("pipe") == 2
+    # comm groups along 'model': 4 groups of 2 ranks, disjoint, covering all
+    groups = topo.get_comm_list("model")
+    assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+    assert sorted(sum(groups, [])) == list(range(8))
+    assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+
+
+def test_role_maker_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    rm = fleet.PaddleCloudRoleMaker()
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    assert not rm.is_first_worker() and rm._is_worker()
+    u = fleet.UtilBase()
+    files = [f"part-{i}" for i in range(10)]
+    shard = u.get_file_shard(files)
+    # 10 files over 4 workers: sizes 3,3,2,2; worker 2 gets part-6, part-7
+    assert shard == ["part-6", "part-7"]
+    all_shards = []
+    for i in range(4):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", str(i))
+        all_shards += u.get_file_shard(files)
+    assert all_shards == files
+
+
+def test_multislot_data_generators():
+    class G(fleet.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                w = line.split()
+                yield [("words", w[:-1]), ("label", [w[-1]])]
+            return gen
+
+    g = G()
+    g.set_batch(2)
+    out = g.run_from_memory(["1926 08 17 1", "4 5 0"])
+    assert out == ["3 1926 08 17 1 1\n", "2 4 5 1 0\n"]
+
+    class GN(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("ids", [1, 2, 3]), ("label", [1])]
+            return gen
+
+    out = GN().run_from_memory(["x"])
+    assert out == ["3 1 2 3 1 1\n"]
+
+
+def test_fleet_class_surface():
+    f = fleet.Fleet()
+    assert f.is_worker() and not f.is_server()
+    assert isinstance(f.util, fleet.UtilBase)
+    assert f.worker_num() >= 1
+
+
+def test_bilinear_initializer_upsamples():
+    from paddle_tpu.nn.initializer import Bilinear
+    w = np.asarray(Bilinear()([1, 1, 4, 4], "float32"))
+    # symmetric stencil, peak at center block
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], atol=1e-6)
+    assert w[0, 0, 1, 1] == w.max()
+    # conv-transpose with this kernel interpolates a constant exactly
+    ct = paddle.nn.Conv2DTranspose(
+        1, 1, 4, stride=2, padding=1,
+        weight_attr=paddle.ParamAttr(initializer=Bilinear()),
+        bias_attr=False)
+    x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"))
+    y = np.asarray(ct(x)._value)
+    assert y.shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(y[0, 0, 2:-2, 2:-2], 1.0, atol=1e-5)
+
+
+def test_fused_multi_transformer_decode_matches_full():
+    """Cache-incremental decode reproduces the full-sequence forward —
+    the layer's two execution paths agree (reference
+    FusedMultiTransformer semantics)."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    paddle.seed(0)
+    L, B, T, h = 2, 2, 6, 32
+    m = FusedMultiTransformer(embed_dim=h, num_heads=4, dim_feedforward=64,
+                              num_layers=L, normalize_before=True)
+    m.eval()
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, h).astype("float32") * 0.3
+
+    full = np.asarray(m(paddle.to_tensor(x))._value)
+    assert full.shape == (B, T, h)
+
+    caches = m.gen_cache(B, T)
+    outs = []
+    for t in range(T):
+        o, caches = m(paddle.to_tensor(x[:, t:t + 1]), caches=caches,
+                      time_step=t)
+        outs.append(np.asarray(o._value))
+    inc = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(inc, full, atol=2e-5)
+
+
+def test_fused_multi_transformer_attrs_honored():
+    """Per-layer ParamAttr initializers must take effect (reference
+    FasterGPT weight-loading path)."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.nn.initializer import Assign
+    h = 8
+    ws = [np.full((h, 3 * h), 0.1 * (i + 1), "float32") for i in range(2)]
+    m = FusedMultiTransformer(
+        embed_dim=h, num_heads=2, dim_feedforward=16, num_layers=2,
+        qkv_weight_attrs=[paddle.ParamAttr(initializer=Assign(w))
+                          for w in ws])
+    got = np.asarray(m.qkv_weight.numpy())
+    np.testing.assert_allclose(got, np.stack(ws))
+
+
+def test_fleet_role_maker_delegation():
+    f = fleet.Fleet()
+    f.init(fleet.UserDefinedRoleMaker(current_id=3,
+                                      worker_endpoints=["a"] * 4))
+    assert f.worker_index() == 3 and f.worker_num() == 4
+
+
+def test_fused_multi_transformer_post_ln_and_mask():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    paddle.seed(1)
+    m = FusedMultiTransformer(embed_dim=16, num_heads=2, dim_feedforward=32,
+                              num_layers=2, normalize_before=False)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 5, 16).astype("float32"))
+    mask = paddle.to_tensor(np.tril(np.ones((5, 5), "float32")))
+    out = m(x, attn_mask=mask)
+    assert list(out.shape) == [1, 5, 16]
+    assert np.all(np.isfinite(np.asarray(out._value)))
+
+
+@pytest.fixture()
+def flowers_archives(tmp_path):
+    import scipy.io as scio
+    from PIL import Image
+    jpg_dir = tmp_path / "jpg"
+    jpg_dir.mkdir()
+    rng = np.random.RandomState(0)
+    n = 6
+    for i in range(1, n + 1):
+        Image.fromarray(rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)) \
+            .save(str(jpg_dir / f"image_{i:05d}.jpg"))
+    tgz = tmp_path / "102flowers.tgz"
+    with tarfile.open(str(tgz), "w:gz") as t:
+        for i in range(1, n + 1):
+            t.add(str(jpg_dir / f"image_{i:05d}.jpg"),
+                  arcname=f"jpg/image_{i:05d}.jpg")
+    labels = tmp_path / "imagelabels.mat"
+    scio.savemat(str(labels),
+                 {"labels": np.arange(1, n + 1).reshape(1, -1)})
+    setid = tmp_path / "setid.mat"
+    scio.savemat(str(setid), {"trnid": np.array([[1, 2, 3, 4]]),
+                              "valid": np.array([[5]]),
+                              "tstid": np.array([[6]])})
+    return str(tgz), str(labels), str(setid)
+
+
+def test_flowers_dataset(flowers_archives):
+    from paddle_tpu.vision.datasets import Flowers
+    tgz, labels, setid = flowers_archives
+    ds = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                 mode="train")
+    assert len(ds) == 4
+    img, lab = ds[0]
+    assert img.shape == (16, 16, 3) and lab.tolist() == [1]
+    assert len(Flowers(data_file=tgz, label_file=labels,
+                       setid_file=setid, mode="test")) == 1
+    with pytest.raises(ValueError, match="zero-egress"):
+        Flowers(mode="train")
+
+
+def test_voc2012_dataset(tmp_path):
+    from PIL import Image
+    from paddle_tpu.vision.datasets import VOC2012
+    rng = np.random.RandomState(0)
+    base = "VOCdevkit/VOC2012"
+    root = tmp_path / "voc"
+    for sub in ("JPEGImages", "SegmentationClass",
+                "ImageSets/Segmentation"):
+        (root / base / sub).mkdir(parents=True)
+    names = ["2007_000032", "2007_000033"]
+    for n in names:
+        Image.fromarray(rng.randint(0, 255, (12, 12, 3), dtype=np.uint8)) \
+            .save(str(root / base / "JPEGImages" / f"{n}.jpg"))
+        Image.fromarray(rng.randint(0, 20, (12, 12), dtype=np.uint8)) \
+            .save(str(root / base / "SegmentationClass" / f"{n}.png"))
+    (root / base / "ImageSets/Segmentation/train.txt") \
+        .write_text("\n".join(names))
+    tar = tmp_path / "voctrainval.tar"
+    with tarfile.open(str(tar), "w") as t:
+        t.add(str(root / "VOCdevkit"), arcname="VOCdevkit")
+    ds = VOC2012(data_file=str(tar), mode="train")
+    assert len(ds) == 2
+    img, lab = ds[0]
+    assert img.shape == (12, 12, 3) and lab.shape == (12, 12)
