@@ -49,6 +49,8 @@ from .compression import DGCCompressor, bf16_compress  # noqa: F401
 from .localsgd import LocalSGDTrainer  # noqa: F401
 from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401
 from .trainer import Trainer  # noqa: F401
+from . import sharding  # noqa: F401  (group_sharded_parallel API)
+from . import utils  # noqa: F401  (Cluster/Pod/Trainer launch plumbing)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "DataParallel",

@@ -28,6 +28,8 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
+from . import utils  # noqa: F401  (LocalFS/HDFSClient/recompute)
+from .utils import DistributedInfer, HDFSClient, LocalFS, recompute  # noqa: F401
 
 __all__ = [
     "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",

@@ -1,0 +1,152 @@
+"""Fleet utils — reference python/paddle/distributed/fleet/utils/
+(fs.py LocalFS/HDFSClient, recompute, DistributedInfer)."""
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "recompute", "DistributedInfer"]
+
+
+class LocalFS:
+    """Local filesystem client (reference fleet/utils/fs.py:LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, f))
+             else files).append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src_path):
+            raise FileNotFoundError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                # POSIX rename would clobber silently; honor the guard
+                raise FileExistsError(dst_path)
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """`hadoop fs` subprocess client (reference fleet/utils/fs.py:
+    HDFSClient) — requires a hadoop binary on PATH."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop")
+                      if hadoop_home else "hadoop", "fs"]
+        for k, v in (configs or {}).items():
+            self._base += [f"-D{k}={v}"]
+
+    def _run(self, *args):
+        try:
+            out = subprocess.run(self._base + list(args),
+                                 capture_output=True, text=True, check=True)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop binary on PATH (or "
+                "hadoop_home); none found in this environment") from e
+        return out.stdout
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except subprocess.CalledProcessError:
+            return False
+
+    def ls_dir(self, fs_path):
+        try:
+            lines = self._run("-ls", fs_path).splitlines()
+        except subprocess.CalledProcessError:
+            return [], []        # missing path: match LocalFS.ls_dir
+        dirs, files = [], []
+        for ln in lines:
+            parts = ln.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if ln.startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+
+def recompute(function, *args, **kwargs):
+    """Activation recomputation (reference fleet/utils/recompute):
+    TPU-native it IS jax.checkpoint — the backward re-runs `function`
+    instead of storing its internals. Non-tensor kwargs pass through to
+    `function` (they are static w.r.t. the checkpoint)."""
+    import jax
+
+    from ...framework.core import Tensor, apply_op
+    kwargs.pop("preserve_rng_state", True)
+
+    def fn(*raw):
+        out = function(*[Tensor(r) for r in raw], **kwargs)
+        return out._value if isinstance(out, Tensor) else out
+
+    return apply_op(jax.checkpoint(fn), *args)
+
+
+class DistributedInfer:
+    """Thin parity shim (reference fleet/utils/ps_util.DistributedInfer is
+    parameter-server specific; collective mode just runs the model)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return None

@@ -1,0 +1,246 @@
+"""Launcher plumbing — reference python/paddle/distributed/utils.py
+(Cluster/Pod/Trainer topology records + local trainer process control,
+used by user launch scripts)."""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["get_host_name_ip", "Trainer", "TrainerProc", "get_cluster",
+           "start_local_trainers", "watch_local_trainers",
+           "find_free_ports", "JobServer", "Cluster", "Pod", "Hdfs",
+           "add_arguments", "terminate_local_procs", "get_logger",
+           "pull_worker_log", "global_scatter", "global_gather"]
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return None, None
+
+
+def find_free_ports(num):
+    ports = set()
+    socks = []
+    try:
+        while len(ports) < num:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return list(ports)
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return bool(self.hdfs_ugi and self.hdfs_name and self.hdfs_path)
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint})"
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+
+    def __str__(self):
+        return f"Pod(rank={self.rank}, addr={self.addr}, " \
+               f"trainers={len(self.trainers)})"
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def world_device_ids(self):
+        return [t.gpus for p in self.pods for t in p.trainers]
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, devices_per_proc):
+    """Build a Cluster record: one pod per node, one trainer per device
+    group (reference get_cluster)."""
+    cluster = Cluster(hdfs=None)
+    rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        eps = trainer_endpoints[node_rank]
+        for i, dev in enumerate(devices_per_proc):
+            t = Trainer()
+            t.gpus = dev if isinstance(dev, list) else [dev]
+            t.endpoint = eps[i]
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    return cluster
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.rank = None
+        self.cmd = None
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None):
+    """Spawn this pod's trainer processes with the PADDLE_* env the
+    runtime expects (init_parallel_env reads them)."""
+    procs = []
+    world = cluster.trainers_nranks()
+    endpoints = ",".join(cluster.trainers_endpoints())
+    for t in pod.trainers:
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update(
+            PADDLE_TRAINER_ID=str(t.rank),
+            PADDLE_TRAINERS_NUM=str(world),
+            PADDLE_MASTER=cluster.trainers_endpoints()[0],
+            PADDLE_CURRENT_ENDPOINT=t.endpoint or "",
+            PADDLE_TRAINER_ENDPOINTS=endpoints,
+        )
+        out = None
+        tp = TrainerProc()
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            tp.log_fn = open(os.path.join(log_dir,
+                                          f"workerlog.{t.rank}"), "w")
+            out = tp.log_fn
+        cmd = [sys.executable, "-u", training_script,
+               *training_script_args]
+        tp.proc = subprocess.Popen(cmd, env=env, stdout=out,
+                                   stderr=subprocess.STDOUT if out else None)
+        tp.rank = t.rank
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll trainer processes; returns the still-alive list, terminates
+    the group on any failure (reference watch_local_trainers)."""
+    alive = []
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            terminate_local_procs(procs)
+            raise RuntimeError(
+                f"trainer rank {tp.rank} failed with exit code {ret}")
+    return alive
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc is not None and tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 10
+    for tp in procs:
+        if tp.proc is None:
+            continue
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            tp.proc.kill()
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def get_logger(log_level=20, name="root"):
+    import logging
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(levelname)s %(asctime)s %(filename)s:%(lineno)d] %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def pull_worker_log(tp):
+    if tp.log_fn:
+        with open(tp.log_fn.name) as f:
+            sys.stdout.write(f.read())
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """Reference arg-helper used by launch scripts."""
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: %(default)s.", **kwargs)
+
+
+def _uniform_tokens_per_peer(count, what):
+    import numpy as np
+    c = np.asarray(count)
+    if c.ndim != 1 or not (c == c[0]).all():
+        raise NotImplementedError(
+            f"{what}: ragged per-expert counts need dynamic shapes, which "
+            "XLA does not compile; use the capacity-bounded dense dispatch "
+            "(paddle_tpu.models.moe) — the TPU-native MoE exchange")
+    return int(c[0])
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """MoE raw token exchange (reference distributed/utils.global_scatter
+    over NCCL alltoall). TPU-native MoE routes through capacity-bounded
+    dense dispatch (models/moe.py) so shapes stay static; this wrapper
+    supports the shape-static subset — uniform counts per peer — via
+    all_to_all over the 'ep' axis."""
+    from .collective import alltoall
+    _uniform_tokens_per_peer(local_count, "global_scatter")
+    return alltoall(x, group=group)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (same static-shape contract)."""
+    from .collective import alltoall
+    _uniform_tokens_per_peer(global_count, "global_gather")
+    return alltoall(x, group=group)

@@ -196,6 +196,8 @@ class FusedMultiTransformer(nn.Layer):
         if time_step is not None:
             step = time_step._value if isinstance(time_step, Tensor) \
                 else jnp.asarray(time_step)
+        elif have_cache:
+            step = jnp.asarray(0)     # prefill: write the cache from pos 0
 
         rate = float(self.dropout_rate) if self.training else 0.0
         # per-call seed (same convention/limitation as the flash kernel's

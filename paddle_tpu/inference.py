@@ -13,7 +13,85 @@ import jax
 from .framework.core import Tensor
 from .nn.layer_base import Layer, buffer_pytree, functional_call, state_pytree
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "DataType",
+           "PlaceType", "PrecisionType", "PredictorPool", "get_version",
+           "get_trt_compile_version", "get_trt_runtime_version",
+           "get_num_bytes_of_data_type", "convert_to_mixed_precision"]
+
+
+class DataType:
+    """reference paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    TPU = 4           # the device this framework actually targets
+    CUSTOM = 5
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    return _DTYPE_BYTES[dtype]
+
+
+def get_version():
+    import jax
+    return f"paddle_tpu inference (jax {jax.__version__}, XLA runtime)"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)    # no TensorRT on TPU — XLA is the compiler
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(*a, **k):
+    raise NotImplementedError(
+        "use paddle_tpu.amp.auto_cast / model.bfloat16(): on TPU mixed "
+        "precision is a trace-time dtype decision, not a program rewrite")
+
+
+class PredictorPool:
+    """reference paddle_infer.PredictorPool: N predictor handles sharing
+    ONE compiled program and params (XLA executables are thread-safe —
+    building N independent Predictors would compile and host the same
+    model N times)."""
+
+    def __init__(self, config, size=1):
+        import copy
+        base = Predictor(config)
+        self._predictors = [base]
+        for _ in range(size - 1):
+            self._predictors.append(copy.copy(base))  # shares _fn/_params
+
+    def retrive(self, idx):            # reference spelling
+        return self._predictors[idx]
+
+    retrieve = retrive
 
 
 class Config:

@@ -225,9 +225,16 @@ class Flowers(Dataset):
         # handle can't be shared across forked DataLoader workers
         self.data_path = data_file + ".extracted/"
         if not os.path.isdir(os.path.join(self.data_path, "jpg")):
-            os.makedirs(self.data_path, exist_ok=True)
+            # extract to a temp sibling and rename atomically so a crashed
+            # or concurrent extraction never masquerades as a complete one
+            tmp = data_file + f".extracting.{os.getpid()}/"
             with tarfile.open(data_file) as t:
-                t.extractall(self.data_path)
+                t.extractall(tmp, filter="data")
+            try:
+                os.rename(tmp, self.data_path.rstrip("/"))
+            except OSError:      # another worker won the race
+                import shutil
+                shutil.rmtree(tmp, ignore_errors=True)
         self.labels = scio.loadmat(label_file)["labels"][0]
         self.indexes = scio.loadmat(setid_file)[self._MODE_FLAG[mode]][0]
 

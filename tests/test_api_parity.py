@@ -44,6 +44,10 @@ def test_reference_top_level_modules_present():
 
 
 _SUBMODULES = {
+    "distributed/sharding/__init__.py": "distributed.sharding",
+    "distributed/utils.py": "distributed.utils",
+    "distributed/fleet/utils/__init__.py": "distributed.fleet.utils",
+    "inference/__init__.py": "inference",
     "nn/__init__.py": "nn",
     "nn/functional/__init__.py": "nn.functional",
     "linalg.py": "linalg",
