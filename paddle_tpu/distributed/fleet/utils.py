@@ -124,11 +124,21 @@ def recompute(function, *args, **kwargs):
     """Activation recomputation (reference fleet/utils/recompute):
     TPU-native it IS jax.checkpoint — the backward re-runs `function`
     instead of storing its internals. Non-tensor kwargs pass through to
-    `function` (they are static w.r.t. the checkpoint)."""
+    `function` (they are static w.r.t. the checkpoint).
+
+    Eager (untraced) calls run `function` directly: rematerialization is
+    a compiled-program memory tradeoff, and the direct call keeps the
+    eager tape recording the block's PARAMETER ops (a checkpoint wrapper
+    would orphan closure-captured params from Tensor.backward())."""
     import jax
 
     from ...framework.core import Tensor, apply_op
     kwargs.pop("preserve_rng_state", True)
+
+    traced = any(isinstance(a._value if isinstance(a, Tensor) else a,
+                            jax.core.Tracer) for a in args)
+    if not traced:
+        return function(*args, **kwargs)
 
     def fn(*raw):
         out = function(*[Tensor(r) for r in raw], **kwargs)

@@ -217,7 +217,15 @@ def add_arguments(argname, type, default, help, argparser, **kwargs):
 
 def _uniform_tokens_per_peer(count, what):
     import numpy as np
-    c = np.asarray(count)
+    try:
+        c = np.asarray(count.numpy() if hasattr(count, "numpy") else count)
+    except Exception:
+        # traced counts (inside jit): uniformity can't be verified and
+        # ragged exchange can't compile — same guidance either way
+        raise NotImplementedError(
+            f"{what}: per-expert counts are traced; XLA needs static "
+            "shapes — use the capacity-bounded dense dispatch "
+            "(paddle_tpu.models.moe), the TPU-native MoE exchange")
     if c.ndim != 1 or not (c == c[0]).all():
         raise NotImplementedError(
             f"{what}: ragged per-expert counts need dynamic shapes, which "

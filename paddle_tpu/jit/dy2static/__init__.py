@@ -17,6 +17,7 @@ from .convert_ops import (
     convert_len,
     convert_not,
     convert_or,
+    convert_print,
     convert_range,
     convert_while_loop,
     to_bool,
@@ -27,5 +28,5 @@ __all__ = [
     "convert_to_static", "conversion_error", "convert_ifelse",
     "convert_ifelse_ret", "convert_while_loop", "convert_for",
     "convert_and", "convert_or", "convert_not", "convert_range",
-    "convert_len", "convert_call", "to_bool", "UNDEF",
+    "convert_len", "convert_call", "convert_print", "to_bool", "UNDEF",
 ]

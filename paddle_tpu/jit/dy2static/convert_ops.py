@@ -521,3 +521,19 @@ def convert_not(x):
         out = jnp.logical_not(jnp.asarray(_raw(x)).astype(bool))
         return Tensor(out) if isinstance(x, Tensor) else out
     return not x
+
+
+def convert_print(*args, **kwargs):
+    """print() in converted code (reference PrintTransformer → Print op):
+    traced values print at RUNTIME via jax.debug.print instead of
+    dumping tracer reprs at trace time. sep/end are honored; `file`
+    cannot be routed through the runtime host callback and is ignored
+    on the traced path."""
+    if not any(_is_traced(a) for a in args):
+        return print(*args, **kwargs)
+    sep = kwargs.get("sep", " ")
+    end = kwargs.get("end", "\n")
+    fmt = sep.join("{}" for _ in args)
+    if end != "\n":                 # debug.print terminates with newline
+        fmt += end
+    jax.debug.print(fmt, *[_raw(a) if _is_traced(a) else a for a in args])

@@ -342,10 +342,10 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
     # -- calls -------------------------------------------------------------
 
     _SKIP_CALL_NAMES = frozenset({
-        "range", "len", "super", "print", "isinstance", "issubclass",
+        "range", "len", "super", "isinstance", "issubclass",
         "getattr", "setattr", "hasattr", "type", "locals", "globals",
         "vars", "id", "repr",
-    })
+    })  # print is handled by its own convert_print rewrite
 
     def visit_Call(self, node):
         """Two rewrites.  (1) `super()` relies on the compiler-injected
@@ -361,6 +361,9 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 and not node.args and not node.keywords:
             if self._has_class_cell and self._self_name:
                 node.args = [_name("__class__"), _name(self._self_name)]
+            return node
+        if isinstance(func, ast.Name) and func.id == "print":
+            node.func = _jst("convert_print")
             return node
         if isinstance(func, ast.Name) and func.id in self._SKIP_CALL_NAMES:
             return node

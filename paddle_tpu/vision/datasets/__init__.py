@@ -230,11 +230,19 @@ class Flowers(Dataset):
             tmp = data_file + f".extracting.{os.getpid()}/"
             with tarfile.open(data_file) as t:
                 t.extractall(tmp, filter="data")
+            import shutil
+            target = self.data_path.rstrip("/")
             try:
-                os.rename(tmp, self.data_path.rstrip("/"))
-            except OSError:      # another worker won the race
-                import shutil
-                shutil.rmtree(tmp, ignore_errors=True)
+                os.rename(tmp, target)
+            except OSError:
+                if os.path.isdir(os.path.join(target, "jpg")):
+                    # a concurrent worker finished first
+                    shutil.rmtree(tmp, ignore_errors=True)
+                else:
+                    # stale partial dir from an interrupted extraction:
+                    # replace it with the fresh complete one
+                    shutil.rmtree(target, ignore_errors=True)
+                    os.rename(tmp, target)
         self.labels = scio.loadmat(label_file)["labels"][0]
         self.indexes = scio.loadmat(setid_file)[self._MODE_FLAG[mode]][0]
 

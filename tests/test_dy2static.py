@@ -466,3 +466,23 @@ def test_library_calls_pass_through():
     th = jnp.tanh(x)
     np.testing.assert_allclose(jax.jit(g)(x), jnp.concatenate([th, th]))
     np.testing.assert_allclose(jax.jit(g)(-x), jnp.concatenate([-th, th]))
+
+
+def test_print_of_traced_values(capfd):
+    """print() in converted code emits runtime values (jax.debug.print),
+    not tracer reprs — reference PrintTransformer."""
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x
+        print("y:", y)
+        return y
+
+    g = _check_converted(f)
+    out = jax.jit(g)(jnp.array([1.0, 2.0]))
+    jax.effects_barrier()
+    captured = capfd.readouterr().out
+    assert "2." in captured and "4." in captured, captured
+    assert "Traced" not in captured
+    np.testing.assert_allclose(out, [2.0, 4.0])
