@@ -531,9 +531,10 @@ def convert_print(*args, **kwargs):
     on the traced path."""
     if not any(_is_traced(a) for a in args):
         return print(*args, **kwargs)
-    sep = kwargs.get("sep", " ")
+    esc = lambda s: str(s).replace("{", "{{").replace("}", "}}")
+    sep = esc(kwargs.get("sep", " "))
     end = kwargs.get("end", "\n")
     fmt = sep.join("{}" for _ in args)
     if end != "\n":                 # debug.print terminates with newline
-        fmt += end
+        fmt += esc(end)
     jax.debug.print(fmt, *[_raw(a) if _is_traced(a) else a for a in args])

@@ -49,6 +49,31 @@ def _quantize_w(w):
     return q, scale.reshape(-1)
 
 
+def _sample_tokens(logits, sampling, keys):
+    """Per-slot next-token choice: greedy, or seeded temperature/top-k/
+    top-p sampling (keys: [S, 2] per-slot PRNG keys; sampling is the
+    static (temperature, top_k, top_p) config)."""
+    if sampling is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature, top_k, top_p = sampling
+    logits = logits / max(temperature, 1e-6)
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p and top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+
+    def one(key, row):
+        return jax.random.categorical(key, row)
+
+    return jax.vmap(one)(keys, logits).astype(jnp.int32)
+
+
 def _mm(x, w, b, quant):
     """x [..., in] @ w -> [..., out].  Float path, or dynamic-A8 x W8
     int8 MXU matmul with per-row activation scales."""
@@ -68,7 +93,7 @@ class PagedGPTDecoder:
 
     def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
                  max_pages_per_seq=None, quant=None, use_kernel=False,
-                 dtype=None):
+                 dtype=None, temperature=0.0, top_k=0, top_p=1.0, seed=0):
         cfg = model.cfg
         self.cfg = cfg
         self.page_size = page_size
@@ -79,6 +104,11 @@ class PagedGPTDecoder:
         self.quant = quant
         self.use_kernel = use_kernel
         assert quant in (None, "a8w8"), quant
+        # temperature 0 = greedy (reference decode convention)
+        self.sampling = None if not temperature else \
+            (float(temperature), int(top_k), float(top_p))
+        self.seed = int(seed)
+        self._draws = 0
         dtype = dtype or jnp.dtype(cfg.dtype)
 
         state = {k: np.asarray(v._value)
@@ -124,10 +154,12 @@ class PagedGPTDecoder:
 
     # -- compiled programs -------------------------------------------------
 
-    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table):
+    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table,
+                     draw):
         """tokens [S], lens [S] (tokens already counted, i.e. position of
-        the incoming token), table [S, max_pages] -> (next [S], logits
-        [S, V], k_pages, v_pages)."""
+        the incoming token), table [S, max_pages], draw (sampling round
+        counter for per-slot keys) -> (next [S], logits [S, V], k_pages,
+        v_pages)."""
         cfg, ps = self.cfg, self.page_size
         H, D = cfg.num_heads, cfg.head_dim
         S = tokens.shape[0]
@@ -162,7 +194,12 @@ class PagedGPTDecoder:
             layer, x, (weights, k_pages, v_pages))
         x = _ln(x, self.ln_f_w, self.ln_f_b)
         logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = None
+        if self.sampling is not None:
+            base = jax.random.fold_in(jax.random.PRNGKey(self.seed), draw)
+            keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+                jnp.arange(S))
+        nxt = _sample_tokens(logits, self.sampling, keys)
         return nxt, logits, k_pages, v_pages
 
     def _prefill_fn(self, Lp):
@@ -174,7 +211,7 @@ class PagedGPTDecoder:
         n_pg = Lp // ps
         quant = bool(self.quant)
 
-        def run(weights, k_pages, v_pages, ids, true_len, page_ids):
+        def run(weights, k_pages, v_pages, ids, true_len, page_ids, draw):
             x = (self.wte[ids] + self.wpe[jnp.arange(Lp)]
                  ).astype(k_pages.dtype)                        # [Lp, h]
 
@@ -209,7 +246,12 @@ class PagedGPTDecoder:
             x = _ln(x, self.ln_f_w, self.ln_f_b)
             last = jnp.take(x, true_len - 1, axis=0)
             logits = last.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
-            return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+            keys = None
+            if self.sampling is not None:
+                keys = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed), draw)[None]
+            nxt = _sample_tokens(logits[None], self.sampling, keys)[0]
+            return nxt, k_pages, v_pages
 
         return jax.jit(run, donate_argnums=(1, 2))
 
@@ -217,7 +259,8 @@ class PagedGPTDecoder:
 
     def prefill(self, ids, page_ids):
         """Run one prompt through the model, writing KV into `page_ids`;
-        returns the greedy next token (int)."""
+        returns the next token (greedy, or sampled per the decoder's
+        temperature/top_k/top_p config)."""
         ids = np.asarray(ids, np.int32)
         true_len = len(ids)
         Lp = max(self.page_size,
@@ -237,17 +280,22 @@ class PagedGPTDecoder:
         # route them to a reserved scratch page to avoid clobbering
         if len(page_ids) < len(pg):
             pg[len(page_ids):] = self.num_pages - 1   # scratch page
+        self._draws += 1
         nxt, self.k_pages, self.v_pages = self._prefills[Lp](
             self.weights, self.k_pages, self.v_pages, jnp.asarray(pad),
-            jnp.asarray(true_len, jnp.int32), jnp.asarray(pg))
+            jnp.asarray(true_len, jnp.int32), jnp.asarray(pg),
+            jnp.asarray(self._draws, jnp.int32))
         return int(nxt)
 
     def decode(self, tokens, lens, table):
-        """One greedy step for all slots."""
+        """One decode step for all slots (greedy, or the configured
+        sampling with deterministic per-(seed, round, slot) keys)."""
+        self._draws += 1
         nxt, logits, self.k_pages, self.v_pages = self._decode(
             self.weights, self.k_pages, self.v_pages,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
-            jnp.asarray(table, jnp.int32))
+            jnp.asarray(table, jnp.int32),
+            jnp.asarray(self._draws, jnp.int32))
         return nxt
 
 

@@ -240,9 +240,17 @@ class Flowers(Dataset):
                     shutil.rmtree(tmp, ignore_errors=True)
                 else:
                     # stale partial dir from an interrupted extraction:
-                    # replace it with the fresh complete one
+                    # replace it with the fresh complete one (another
+                    # worker may be doing the same — whoever loses the
+                    # rename race defers to the winner's install)
                     shutil.rmtree(target, ignore_errors=True)
-                    os.rename(tmp, target)
+                    try:
+                        os.rename(tmp, target)
+                    except OSError:
+                        if os.path.isdir(os.path.join(target, "jpg")):
+                            shutil.rmtree(tmp, ignore_errors=True)
+                        else:
+                            raise
         self.labels = scio.loadmat(label_file)["labels"][0]
         self.indexes = scio.loadmat(setid_file)[self._MODE_FLAG[mode]][0]
 
