@@ -532,8 +532,11 @@ def convert_print(*args, **kwargs):
     if not any(_is_traced(a) for a in args):
         return print(*args, **kwargs)
     esc = lambda s: str(s).replace("{", "{{").replace("}", "}}")
-    sep = esc(kwargs.get("sep", " "))
-    end = kwargs.get("end", "\n")
+    # print(sep=None/end=None) means the defaults, not the string 'None'
+    sep = kwargs.get("sep")
+    sep = esc(" " if sep is None else sep)
+    end = kwargs.get("end")
+    end = "\n" if end is None else end
     fmt = sep.join("{}" for _ in args)
     if end != "\n":                 # debug.print terminates with newline
         fmt += esc(end)

@@ -14,11 +14,11 @@ from ..nn.layer_base import buffer_pytree, functional_call, state_pytree
 __all__ = ["generate"]
 
 
-def _sample(logits, key, temperature, top_k, top_p):
-    logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+def mask_logits(logits, temperature, top_k, top_p):
+    """Temperature/top-k/nucleus filtering — the ONE implementation of
+    the sampling mask (generate() and serving.py both use it, so they
+    can't drift)."""
+    logits = logits.astype(jnp.float32) / temperature
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -1e30, logits)
@@ -29,7 +29,14 @@ def _sample(logits, key, temperature, top_k, top_p):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
+
+
+def _sample(logits, key, temperature, top_k, top_p):
+    if temperature == 0.0:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    return jax.random.categorical(
+        key, mask_logits(logits, temperature, top_k, top_p), axis=-1)
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,

@@ -5,9 +5,10 @@ Reference role: the fluid inference API's batched decode serving path
 decoding).  TPU-native design:
 
 - ONE compiled decode step for a fixed slot count: [max_batch] tokens in,
-  [max_batch] greedy tokens out.  Slots hold independent sequences at
-  different lengths; position/page state rides in arrays, so admission
-  and retirement never recompile.
+  [max_batch] next tokens out (greedy, or seeded temperature/top-k/top-p
+  sampling).  Slots hold independent sequences at different lengths;
+  position/page state rides in arrays, so admission and retirement never
+  recompile.
 - KV lives in paged pools [L, P, page_size, H, D] (ops/paged_attention).
   Decode attention gathers each slot's pages (optionally via the
   scalar-prefetch Pallas kernel); page allocation is host-side.
@@ -51,27 +52,15 @@ def _quantize_w(w):
 
 def _sample_tokens(logits, sampling, keys):
     """Per-slot next-token choice: greedy, or seeded temperature/top-k/
-    top-p sampling (keys: [S, 2] per-slot PRNG keys; sampling is the
-    static (temperature, top_k, top_p) config)."""
+    top-p sampling (keys: [S] per-slot PRNG keys — slot-stable draws no
+    matter how the batch is composed; the mask itself is shared with
+    generate() via models.generation.mask_logits)."""
     if sampling is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    from .models.generation import mask_logits
     temperature, top_k, top_p = sampling
-    logits = logits / max(temperature, 1e-6)
-    if top_k:
-        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p and top_p < 1.0:
-        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_l, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -1e30, logits)
-
-    def one(key, row):
-        return jax.random.categorical(key, row)
-
-    return jax.vmap(one)(keys, logits).astype(jnp.int32)
+    masked = mask_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
 
 
 def _mm(x, w, b, quant):

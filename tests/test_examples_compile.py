@@ -1,0 +1,21 @@
+"""Every example stays syntactically valid and importable-name-clean
+(the cheap rot check; heavier example flows run in their own benches)."""
+import ast
+import glob
+import os
+
+import pytest
+
+EXAMPLES = sorted(glob.glob(os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples", "*.py")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p)
+                                                for p in EXAMPLES])
+def test_example_parses(path):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    # every example must be runnable as a script
+    assert any(isinstance(n, ast.If) and ast.unparse(n.test).startswith(
+        "__name__") for n in tree.body), f"{path}: no __main__ guard"
