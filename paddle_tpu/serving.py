@@ -63,6 +63,23 @@ def _sample_tokens(logits, sampling, keys):
     return jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
 
 
+def _mm_heads(x, w, b, quant):
+    """x [S, h] @ head-major qkv weight [h, 3, H, D] -> [S, 3, H, D]."""
+    if not quant:
+        return (jnp.einsum("sh,htnd->stnd", x, w.astype(x.dtype))
+                + b.astype(x.dtype))
+    qw, sw = w                     # [h,3,H,D] int8, [3,H,D] f32
+    sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                 keepdims=True) / 127.0
+    sx = jnp.maximum(sx, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127,
+                  127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, qw, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx[:, :, None, None] * sw
+            + b).astype(x.dtype)
+
+
 def _mm(x, w, b, quant):
     """x [..., in] @ w -> [..., out].  Float path, or dynamic-A8 x W8
     int8 MXU matmul with per-row activation scales."""
@@ -82,7 +99,8 @@ class PagedGPTDecoder:
 
     def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
                  max_pages_per_seq=None, quant=None, use_kernel=False,
-                 dtype=None, temperature=0.0, top_k=0, top_p=1.0, seed=0):
+                 dtype=None, temperature=0.0, top_k=0, top_p=1.0, seed=0,
+                 mesh=None):
         cfg = model.cfg
         self.cfg = cfg
         self.page_size = page_size
@@ -108,11 +126,19 @@ class PagedGPTDecoder:
             return jnp.asarray(
                 np.stack([state[fmt.format(i)] for i in range(L)]))
 
+        H, D = cfg.num_heads, cfg.head_dim
         w = {
             "ln1_w": stack("blocks.{}.ln1.weight"),
             "ln1_b": stack("blocks.{}.ln1.bias"),
-            "qkv_w": stack("blocks.{}.qkv.weight"),
-            "qkv_b": stack("blocks.{}.qkv.bias"),
+            # head-major qkv layout [L, h, 3, H, D]: under tp the shard
+            # axis is the HEAD dim, which propagates cleanly through the
+            # per-head attention and the head-sharded KV pages (a flat
+            # [h, 3h] out-dim shard mixes q/k/v columns and costs an
+            # all-gather per layer)
+            "qkv_w": stack("blocks.{}.qkv.weight").reshape(
+                cfg.num_layers, cfg.hidden_size, 3, H, D),
+            "qkv_b": stack("blocks.{}.qkv.bias").reshape(
+                cfg.num_layers, 3, H, D),
             "proj_w": stack("blocks.{}.proj.weight"),
             "proj_b": stack("blocks.{}.proj.bias"),
             "ln2_w": stack("blocks.{}.ln2.weight"),
@@ -124,8 +150,12 @@ class PagedGPTDecoder:
         }
         if quant == "a8w8":
             for k in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
-                qs = jax.vmap(_quantize_w)(w[k])
-                w[k] = qs
+                v = w[k]
+                shp = v.shape
+                if v.ndim > 3:          # qkv head-major: flatten to 2-D
+                    v = v.reshape(shp[0], shp[1], -1)
+                q, s = jax.vmap(_quantize_w)(v)
+                w[k] = (q.reshape(shp), s.reshape((shp[0],) + shp[2:]))
         self.weights = w
         self.wte = jnp.asarray(state["wte.weight"])
         self.wpe = jnp.asarray(state["wpe.weight"])
@@ -138,8 +168,68 @@ class PagedGPTDecoder:
         self.k_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
         self.v_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
 
+        # tensor-parallel serving: shard the 3h/ffn/head dims of the
+        # stacked weights and the HEAD dim of the KV pages over 'tp';
+        # GSPMD inserts the all-reduces after proj/ffn2 — the Megatron
+        # decode layout, no code changes in the step function
+        self.mesh = mesh
+        if mesh is None:
+            from .distributed.mesh import get_mesh
+            m = get_mesh(create_default=False)
+            if m is not None and m.shape.get("tp", 1) > 1:
+                self.mesh = m
+        if self.mesh is not None:
+            self._shard_for_tp()
+
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
         self._prefills = {}   # padded length -> jitted prefill
+
+    def _shard_for_tp(self):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        tp = mesh.shape.get("tp", 1)
+        if self.cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads {self.cfg.num_heads} must divide over "
+                f"tp={tp} for tensor-parallel serving")
+        if self.cfg.ffn_hidden % tp:
+            raise ValueError(
+                f"ffn_hidden {self.cfg.ffn_hidden} must divide over "
+                f"tp={tp} for tensor-parallel serving")
+
+        def put(v, *spec):
+            return jax.device_put(v, NamedSharding(mesh, P(*spec)))
+
+        w = self.weights
+
+        def put_w(key, *spec):
+            if isinstance(w[key], tuple):      # a8w8 (q, per-out scale)
+                q, s = w[key]
+                w[key] = (put(q, *spec), put(s, spec[0], *spec[2:]))
+            else:
+                w[key] = put(w[key], *spec)
+
+        # column-parallel qkv (HEAD axis — aligns with the per-head
+        # attention and the head-sharded pages, no reshard) and fc1;
+        # row-parallel proj/fc2; biases follow their out dims
+        put_w("qkv_w", None, None, None, "tp", None)
+        w["qkv_b"] = put(w["qkv_b"], None, None, "tp", None)
+        put_w("proj_w", None, "tp", None)
+        put_w("fc1_w", None, None, "tp")
+        w["fc1_b"] = put(w["fc1_b"], None, "tp")
+        put_w("fc2_w", None, "tp", None)
+        self.wte = put(self.wte, None, None)
+        if self.lm_head.shape[-1] % tp == 0:
+            self.lm_head = put(self.lm_head, None, "tp")
+        else:
+            # odd vocab (e.g. 50257): keep the head replicated rather
+            # than fail — logits are [S, V] and small at decode batch
+            self.lm_head = put(self.lm_head, None, None)
+        # KV pages: heads sharded — each tp shard holds its heads' pages
+        self.k_pages = put(self.k_pages, None, None, None, "tp", None)
+        self.v_pages = put(self.v_pages, None, None, None, "tp", None)
 
     # -- compiled programs -------------------------------------------------
 
@@ -163,8 +253,7 @@ class PagedGPTDecoder:
         def layer(x, wkv):
             wl, kp, vp = wkv
             y = _ln(x, wl["ln1_w"], wl["ln1_b"])
-            qkv = _mm(y, wl["qkv_w"], wl["qkv_b"], quant)       # [S, 3h]
-            qkv = qkv.reshape(S, 3, H, D)
+            qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)  # [S,3,H,D]
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
             kp = kp.at[pids, offs].set(k.astype(kp.dtype))
             vp = vp.at[pids, offs].set(v.astype(vp.dtype))
@@ -207,8 +296,7 @@ class PagedGPTDecoder:
             def layer(x, wkv):
                 wl, kp, vp = wkv
                 y = _ln(x, wl["ln1_w"], wl["ln1_b"])
-                qkv = _mm(y, wl["qkv_w"], wl["qkv_b"], quant)
-                qkv = qkv.reshape(Lp, 3, H, D)
+                qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)
                 q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
                 s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
                                k.astype(jnp.float32)) / math.sqrt(D)
