@@ -480,6 +480,14 @@ def main():
         except Exception as e:
             log(f"decode bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["decode_error"] = str(e)[:160]
+        try:
+            tok_s, which = run_decode(quant="a8w8")
+            extras["decode_a8w8_tokens_per_sec_per_chip"] = round(tok_s, 1)
+            extras["decode_a8w8_model"] = which
+        except Exception as e:
+            log(f"a8w8 decode bench failed: "
+                f"{type(e).__name__}: {str(e)[:300]}")
+            extras["decode_a8w8_error"] = str(e)[:160]
     if extras:
         result["extras"] = extras
     print(json.dumps(result))

@@ -31,7 +31,8 @@ import numpy as np
 
 from .framework.core import Tensor
 
-__all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine"]
+__all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
+           "SpeculativeEngine"]
 
 
 def _ln(x, w, b):
@@ -182,6 +183,7 @@ class PagedGPTDecoder:
             self._shard_for_tp()
 
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._verify = None   # jitted lazily (speculative decoding only)
         self._prefills = {}   # padded length -> jitted prefill
 
     def _shard_for_tp(self):
@@ -279,6 +281,81 @@ class PagedGPTDecoder:
                 jnp.arange(S))
         nxt = _sample_tokens(logits, self.sampling, keys)
         return nxt, logits, k_pages, v_pages
+
+    def _verify_step(self, weights, k_pages, v_pages, tokens, lens, table):
+        """Speculative verify: tokens [S, W] (last accepted token + the
+        draft proposals) are consumed in ONE forward — KV written at
+        positions lens..lens+W-1, causal attention against the paged
+        prefix — returning the target's greedy choice after every
+        position ([S, W] argmaxes). Rejected positions need no cleanup:
+        lens is the source of truth and stale entries are overwritten."""
+        cfg, ps = self.cfg, self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        S, W = tokens.shape
+        pos = lens[:, None] + jnp.arange(W)[None, :]            # [S, W]
+        x = (self.wte[tokens] +
+             self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+             ).astype(self.k_pages.dtype)                       # [S, W, h]
+        MP = table.shape[1]
+        # margin guard: window positions past the table's capacity (the
+        # engine admits with a +k margin, so only pathological callers
+        # get here) write to the reserved scratch page, never to a
+        # clamped REAL page of the sequence
+        in_range = pos < MP * ps
+        pids = jnp.take_along_axis(table, jnp.minimum(pos // ps, MP - 1),
+                                   axis=1)                      # [S, W]
+        pids = jnp.where(in_range, pids, self.num_pages - 1)
+        offs = pos % ps
+        quant = bool(self.quant)
+
+        def layer(x, wkv):
+            wl, kp, vp = wkv
+            y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+            xf = y.reshape(S * W, -1)
+            qkv = _mm_heads(xf, wl["qkv_w"], wl["qkv_b"], quant)
+            qkv = qkv.reshape(S, W, 3, H, D)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
+            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            # gather each slot's pages and attend with per-row causality
+            kg = kp[table].reshape(S, MP * ps, H, D)            # [S, T, H, D]
+            vg = vp[table].reshape(S, MP * ps, H, D)
+            scale = 1.0 / float(np.sqrt(D))
+            s = jnp.einsum("swhd,sthd->shwt", q.astype(jnp.float32),
+                           kg.astype(jnp.float32)) * scale
+            kpos = jnp.arange(MP * ps)[None, None, None, :]
+            s = jnp.where(kpos <= pos[:, None, :, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("shwt,sthd->swhd", p,
+                              vg.astype(jnp.float32)).astype(x.dtype)
+            o = _mm(attn.reshape(S * W, H * D), wl["proj_w"],
+                    wl["proj_b"], quant).reshape(S, W, -1)
+            x = x + o
+            y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+            yf = y.reshape(S * W, -1)
+            h = jax.nn.gelu(_mm(yf, wl["fc1_w"], wl["fc1_b"], quant),
+                            approximate=True)
+            x = x + _mm(h, wl["fc2_w"], wl["fc2_b"],
+                        quant).reshape(S, W, -1)
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer, x, (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                k_pages, v_pages)
+
+    def verify(self, tokens, lens, table):
+        """Batched speculative verify (see _verify_step)."""
+        if self._verify is None:
+            self._verify = jax.jit(self._verify_step,
+                                   donate_argnums=(1, 2))
+        out, self.k_pages, self.v_pages = self._verify(
+            self.weights, self.k_pages, self.v_pages,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(table, jnp.int32))
+        return np.asarray(out)
 
     def _prefill_fn(self, Lp):
         """Per-bucket compiled prefill: one sequence, padded to Lp.
@@ -455,6 +532,17 @@ class ContinuousBatchingEngine:
         self._lens[slot] = 0
         self._tokens[slot] = 0
 
+    def _table(self, pages_per_slot, decoder):
+        """Page table with inactive/unused entries routed to the reserved
+        scratch page (their masked, discarded KV writes must never land
+        in allocatable pages)."""
+        t = np.full((decoder.max_batch, decoder.max_pages),
+                    decoder.num_pages - 1, np.int32)
+        for s, pg in enumerate(pages_per_slot):
+            if pg:
+                t[s, :len(pg)] = pg
+        return t
+
     def step(self):
         """Admit + one decode tick. Returns number of active slots."""
         self._admit()
@@ -462,13 +550,7 @@ class ContinuousBatchingEngine:
                   if self._slot_req[s] is not None]
         if not active:
             return 0
-        # inactive slots must never write into allocatable pages: route
-        # their (masked, discarded) KV writes to the reserved scratch page
-        table = np.full((self.d.max_batch, self.d.max_pages),
-                        self.d.num_pages - 1, np.int32)
-        for s in active:
-            pg = self._slot_pages[s]
-            table[s, :len(pg)] = pg
+        table = self._table(self._slot_pages, self.d)
         nxt = np.asarray(self.d.decode(self._tokens, self._lens, table))
         self.steps += 1
         for s in active:
@@ -488,3 +570,142 @@ class ContinuousBatchingEngine:
         while self._queue or any(r is not None for r in self._slot_req):
             self.step()
         return dict(self._outputs)
+
+
+class SpeculativeEngine(ContinuousBatchingEngine):
+    """Greedy speculative decoding over the paged engine: a small DRAFT
+    model proposes k tokens with k cheap decode ticks; the TARGET model
+    scores all of them in ONE verify forward and the longest matching
+    prefix is accepted (+ the target's own token at the first mismatch) —
+    output is EXACTLY the target's greedy decode, in up to k-times fewer
+    target forwards. Paged KV makes rollback free: `lens` is the source
+    of truth, rejected positions are simply overwritten.
+
+    Acceptance is capped at k-1 drafts so the draft cache (which holds
+    proposals d1..d_{k-1}) never falls behind; when all k drafts match,
+    the capped path still emits exactly d1..dk.
+    """
+
+    def __init__(self, decoder, draft_decoder, eos_token_id=None,
+                 max_new_tokens=64, k=4):
+        if decoder.sampling is not None or draft_decoder.sampling is not None:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only for now (sampled "
+                "acceptance needs rejection sampling)")
+        if draft_decoder.max_batch != decoder.max_batch or \
+                draft_decoder.page_size != decoder.page_size:
+            raise ValueError("draft/target max_batch and page_size must match")
+        super().__init__(decoder, eos_token_id, max_new_tokens)
+        self.draft = draft_decoder
+        self.k = int(k)
+        self._draft_free = list(range(draft_decoder.num_pages - 2, -1, -1))
+        self._draft_pages = [[] for _ in range(decoder.max_batch)]
+        self._dlens = np.zeros(decoder.max_batch, np.int64)
+        self.target_calls = 0
+
+    def submit(self, prompt_ids):
+        """Same as the base, with a +k margin: a verify window can write
+        up to k positions past the final accepted length."""
+        ids = np.asarray(prompt_ids._value if isinstance(prompt_ids, Tensor)
+                         else prompt_ids).reshape(-1)
+        total = len(ids) + self.max_new + self.k
+        need = self._pages_for(total)
+        limit = min(self.d.max_pages, self.draft.max_pages,
+                    self.d.num_pages - 1, self.draft.num_pages - 1)
+        if need > limit:
+            raise ValueError(
+                f"request needs {need} pages (prompt {len(ids)} + max_new "
+                f"{self.max_new} + speculation margin {self.k}) but the "
+                f"pools allow {limit}")
+        if total > min(self.d.cfg.max_seq_len, self.draft.cfg.max_seq_len):
+            raise ValueError(
+                f"prompt {len(ids)} + max_new {self.max_new} + margin "
+                f"{self.k} exceeds max_seq_len "
+                f"{min(self.d.cfg.max_seq_len, self.draft.cfg.max_seq_len)}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, [int(t) for t in ids]))
+        return rid
+
+    def _admit(self):
+        for slot in range(self.d.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            rid, ids = self._queue[0]
+            # +k margin: a verify window may write up to k positions past
+            # the final accepted length
+            need = self._pages_for(len(ids) + self.max_new + self.k)
+            if need > len(self._free) or need > len(self._draft_free) \
+                    or need > self.d.max_pages \
+                    or need > self.draft.max_pages:
+                break
+            self._queue.pop(0)
+            pages = [self._free.pop() for _ in range(need)]
+            dpages = [self._draft_free.pop() for _ in range(need)]
+            self._slot_req[slot] = rid
+            self._slot_pages[slot] = pages
+            self._draft_pages[slot] = dpages
+            first = self.d.prefill(ids, pages)
+            self.draft.prefill(ids, dpages)     # draft's guess discarded
+            self._outputs[rid] = [first]
+            if (self.eos is not None and first == self.eos) \
+                    or self.max_new <= 1:
+                self._retire(slot)
+                continue
+            self._lens[slot] = len(ids)
+            self._dlens[slot] = len(ids)
+            self._tokens[slot] = first
+
+    def _retire(self, slot):
+        self._draft_free.extend(self._draft_pages[slot])
+        self._draft_pages[slot] = []
+        self._dlens[slot] = 0
+        super()._retire(slot)
+
+    def step(self):
+        self._admit()
+        active = [s for s in range(self.d.max_batch)
+                  if self._slot_req[s] is not None]
+        if not active:
+            return 0
+        k = self.k
+        ttable = self._table(self._slot_pages, self.d)
+        dtable = self._table(self._draft_pages, self.draft)
+
+        # draft proposes k tokens (k cheap ticks over all slots)
+        proposals = np.zeros((self.d.max_batch, k), np.int64)
+        d_in = self._tokens.copy()
+        dlens = self._dlens.copy()
+        for j in range(k):
+            nxt = np.asarray(self.draft.decode(d_in, dlens, dtable))
+            proposals[:, j] = nxt
+            dlens = dlens + 1
+            d_in = nxt.astype(np.int64)
+
+        # target verifies [cur, d1..dk] in one forward
+        window = np.concatenate(
+            [self._tokens[:, None], proposals[:, :k]], axis=1)  # [S, k+1]
+        tgt = self.d.verify(window, self._lens, ttable)         # [S, k+1]
+        self.target_calls += 1
+        self.steps += 1
+
+        for s in active:
+            rid = self._slot_req[s]
+            a = 0
+            while a < k - 1 and proposals[s, a] == tgt[s, a]:
+                a += 1
+            emitted = [int(t) for t in proposals[s, :a]] + [int(tgt[s, a])]
+            L = int(self._lens[s])
+            self._lens[s] = L + a + 1
+            self._dlens[s] = L + a + 1
+            self._tokens[s] = emitted[-1]
+            done = False
+            for t in emitted:
+                self._outputs[rid].append(t)
+                if (self.eos is not None and t == self.eos) or \
+                        len(self._outputs[rid]) >= self.max_new:
+                    done = True      # tokens speculated past the stop
+                    break            # point are simply never appended
+            if done:
+                self._retire(s)
+        return len(active)

@@ -152,6 +152,54 @@ def test_tensor_parallel_serving_matches_single_device(tiny_model):
         set_mesh(prev)
 
 
+def test_speculative_equals_target_greedy(tiny_model):
+    """Speculative decoding is exact: outputs equal the target's plain
+    greedy decode, with FEWER target forwards (the whole point). The
+    'draft' here is the same tiny model, so every proposal is accepted
+    and each verify round emits k tokens."""
+    from paddle_tpu.serving import SpeculativeEngine
+    prompt = [3, 141, 59, 26, 535]
+    n_new = 12
+
+    golden = _golden_greedy(tiny_model, prompt, n_new)
+
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    draft = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                            max_batch=2)
+    eng = SpeculativeEngine(dec, draft, max_new_tokens=n_new, k=4)
+    rid = eng.submit(np.asarray(prompt, np.int32))
+    outs = eng.run()
+    assert outs[rid] == golden
+    # perfect-draft case: ceil((n_new-1)/k) verify rounds, not n_new-1
+    assert eng.target_calls <= (n_new - 1 + 3) // 4 + 1, eng.target_calls
+
+
+def test_speculative_with_weak_draft(tiny_model):
+    """A DIFFERENT (weaker) draft model must not change the output — only
+    the speedup. Also exercises mixed accept/reject rounds and multiple
+    slots."""
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import SpeculativeEngine
+    paddle.seed(123)     # different weights: drafts will often miss
+    weak = GPT(gpt_tiny(max_seq_len=128, dtype="float32", remat=False))
+    weak.eval()
+    prompts = [[3, 141, 59], [897, 11, 4, 18, 200, 7]]
+    n_new = 10
+
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    draft = PagedGPTDecoder(weak, num_pages=32, page_size=16, max_batch=2)
+    eng = SpeculativeEngine(dec, draft, max_new_tokens=n_new, k=3)
+    rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+    outs = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert outs[rid] == _golden_greedy(tiny_model, p, n_new), p
+    # pages fully reclaimed on both pools
+    assert len(eng._free) == dec.num_pages - 1
+    assert len(eng._draft_free) == draft.num_pages - 1
+
+
 def test_paged_kernel_path_matches_jnp(tiny_model):
     """use_kernel=True exercises the scalar-prefetch Pallas paged kernel
     (interpret mode on CPU) end-to-end through the engine."""
