@@ -51,6 +51,31 @@ def _quantize_w(w):
     return q, scale.reshape(-1)
 
 
+def _spec_accept(p_rows, q_rows, drafts, rng):
+    """Rejection-sampling acceptance for ONE slot (Leviathan et al.):
+    p_rows [n+1, V] target probs — row j is the target's conditional
+    AFTER the tokens preceding draft j (row 0 judges drafts[0]),
+    q_rows [n, V] draft probs, drafts [n] proposed tokens.  Accept draft
+    j with prob min(1, p_j(d)/q_j(d)); on rejection emit a sample from
+    norm(max(p_j - q_j, 0)); if every draft is accepted emit a fresh
+    sample from the last target row.  The emitted tokens are distributed
+    EXACTLY as target-only sampling (unit-tested by Monte Carlo).
+    Returns (n_accepted, final_token)."""
+    n = len(drafts)
+    for j in range(n):
+        d = int(drafts[j])
+        q = q_rows[j, d]
+        p = p_rows[j, d]
+        if q <= 0.0 or rng.random() >= min(1.0, p / q):
+            resid = np.maximum(p_rows[j] - q_rows[j], 0.0)
+            tot = resid.sum()
+            if tot <= 1e-12:       # p==q everywhere: any target sample
+                resid, tot = p_rows[j], p_rows[j].sum()
+            return j, int(rng.choice(len(resid), p=resid / tot))
+    row = p_rows[n]
+    return n, int(rng.choice(len(row), p=row / row.sum()))
+
+
 def _sample_tokens(logits, sampling, keys):
     """Per-slot next-token choice: greedy, or seeded temperature/top-k/
     top-p sampling (keys: [S] per-slot PRNG keys — slot-stable draws no
@@ -184,7 +209,22 @@ class PagedGPTDecoder:
 
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
         self._verify = None   # jitted lazily (speculative decoding only)
+        self._probs = None    # jitted lazily (sampled speculation)
         self._prefills = {}   # padded length -> jitted prefill
+
+    def _probs_of(self, logits):
+        """softmax over the decoder's sampling mask (the distribution its
+        sampled tokens are actually drawn from)."""
+        if self._probs is None:
+            from .models.generation import mask_logits
+            if self.sampling:
+                t, tk, tp = self.sampling
+                self._probs = jax.jit(lambda lg: jax.nn.softmax(
+                    mask_logits(lg, t, tk, tp), axis=-1))
+            else:
+                self._probs = jax.jit(
+                    lambda lg: jax.nn.softmax(lg, axis=-1))
+        return np.asarray(self._probs(logits))
 
     def _shard_for_tp(self):
         from jax.sharding import NamedSharding
@@ -343,18 +383,20 @@ class PagedGPTDecoder:
             layer, x, (weights, k_pages, v_pages))
         x = _ln(x, self.ln_f_w, self.ln_f_b)
         logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
-        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32), logits,
                 k_pages, v_pages)
 
-    def verify(self, tokens, lens, table):
+    def verify(self, tokens, lens, table, return_probs=False):
         """Batched speculative verify (see _verify_step)."""
         if self._verify is None:
             self._verify = jax.jit(self._verify_step,
                                    donate_argnums=(1, 2))
-        out, self.k_pages, self.v_pages = self._verify(
+        out, logits, self.k_pages, self.v_pages = self._verify(
             self.weights, self.k_pages, self.v_pages,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
             jnp.asarray(table, jnp.int32))
+        if return_probs:
+            return np.asarray(out), self._probs_of(logits)
         return np.asarray(out)
 
     def _prefill_fn(self, Lp):
@@ -441,15 +483,19 @@ class PagedGPTDecoder:
             jnp.asarray(self._draws, jnp.int32))
         return int(nxt)
 
-    def decode(self, tokens, lens, table):
+    def decode(self, tokens, lens, table, return_probs=False):
         """One decode step for all slots (greedy, or the configured
-        sampling with deterministic per-(seed, round, slot) keys)."""
+        sampling with deterministic per-(seed, round, slot) keys).
+        return_probs additionally yields the [S, V] distribution the
+        token was drawn from (speculative acceptance needs it)."""
         self._draws += 1
         nxt, logits, self.k_pages, self.v_pages = self._decode(
             self.weights, self.k_pages, self.v_pages,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
             jnp.asarray(table, jnp.int32),
             jnp.asarray(self._draws, jnp.int32))
+        if return_probs:
+            return nxt, self._probs_of(logits)
         return nxt
 
 
@@ -573,13 +619,16 @@ class ContinuousBatchingEngine:
 
 
 class SpeculativeEngine(ContinuousBatchingEngine):
-    """Greedy speculative decoding over the paged engine: a small DRAFT
-    model proposes k tokens with k cheap decode ticks; the TARGET model
-    scores all of them in ONE verify forward and the longest matching
-    prefix is accepted (+ the target's own token at the first mismatch) —
-    output is EXACTLY the target's greedy decode, in up to k-times fewer
-    target forwards. Paged KV makes rollback free: `lens` is the source
-    of truth, rejected positions are simply overwritten.
+    """Speculative decoding over the paged engine: a small DRAFT model
+    proposes k tokens with k cheap decode ticks; the TARGET model scores
+    all of them in ONE verify forward. Greedy configs accept the longest
+    matching prefix (+ the target's token at the first mismatch) —
+    output is EXACTLY the target's greedy decode; sampled configs (same
+    temperature/top-k/top-p on both decoders) use rejection-sampling
+    acceptance (_spec_accept), so emitted tokens are distributed exactly
+    as target-only sampling. Either way: up to k-times fewer target
+    forwards. Paged KV makes rollback free: `lens` is the source of
+    truth, rejected positions are simply overwritten.
 
     Acceptance is capped at k-1 drafts so the draft cache (which holds
     proposals d1..d_{k-1}) never falls behind; when all k drafts match,
@@ -588,10 +637,12 @@ class SpeculativeEngine(ContinuousBatchingEngine):
 
     def __init__(self, decoder, draft_decoder, eos_token_id=None,
                  max_new_tokens=64, k=4):
-        if decoder.sampling is not None or draft_decoder.sampling is not None:
-            raise NotImplementedError(
-                "speculative decoding is greedy-only for now (sampled "
-                "acceptance needs rejection sampling)")
+        if decoder.sampling != draft_decoder.sampling:
+            raise ValueError(
+                "speculative decoding needs the SAME sampling config on "
+                "target and draft (acceptance compares their masked "
+                f"distributions): {decoder.sampling} vs "
+                f"{draft_decoder.sampling}")
         if draft_decoder.max_batch != decoder.max_batch or \
                 draft_decoder.page_size != decoder.page_size:
             raise ValueError("draft/target max_batch and page_size must match")
@@ -672,12 +723,26 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         ttable = self._table(self._slot_pages, self.d)
         dtable = self._table(self._draft_pages, self.draft)
 
+        sampled = self.d.sampling is not None
+
         # draft proposes k tokens (k cheap ticks over all slots)
         proposals = np.zeros((self.d.max_batch, k), np.int64)
+        qrows = None
         d_in = self._tokens.copy()
         dlens = self._dlens.copy()
         for j in range(k):
-            nxt = np.asarray(self.draft.decode(d_in, dlens, dtable))
+            if sampled and j < k - 1:
+                # the k-th draft's distribution is never judged
+                # (acceptance is capped at k-1): skip its transfer
+                nxt, qp = self.draft.decode(d_in, dlens, dtable,
+                                            return_probs=True)
+                if qrows is None:
+                    qrows = np.zeros((self.d.max_batch, k - 1,
+                                      qp.shape[-1]))
+                qrows[:, j] = qp
+                nxt = np.asarray(nxt)
+            else:
+                nxt = np.asarray(self.draft.decode(d_in, dlens, dtable))
             proposals[:, j] = nxt
             dlens = dlens + 1
             d_in = nxt.astype(np.int64)
@@ -685,16 +750,31 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         # target verifies [cur, d1..dk] in one forward
         window = np.concatenate(
             [self._tokens[:, None], proposals[:, :k]], axis=1)  # [S, k+1]
-        tgt = self.d.verify(window, self._lens, ttable)         # [S, k+1]
+        if sampled:
+            tgt, prows = self.d.verify(window, self._lens, ttable,
+                                       return_probs=True)
+        else:
+            tgt = self.d.verify(window, self._lens, ttable)     # [S, k+1]
         self.target_calls += 1
         self.steps += 1
 
         for s in active:
             rid = self._slot_req[s]
-            a = 0
-            while a < k - 1 and proposals[s, a] == tgt[s, a]:
-                a += 1
-            emitted = [int(t) for t in proposals[s, :a]] + [int(tgt[s, a])]
+            if sampled:
+                rng = np.random.default_rng(
+                    (self.d.seed * 1000003 + self.target_calls) * 4093 + s)
+                a, tok = _spec_accept(
+                    prows[s, :k],
+                    qrows[s] if qrows is not None else
+                    np.zeros((0, prows.shape[-1])),
+                    proposals[s, :k - 1], rng)
+                emitted = [int(t) for t in proposals[s, :a]] + [tok]
+            else:
+                a = 0
+                while a < k - 1 and proposals[s, a] == tgt[s, a]:
+                    a += 1
+                emitted = [int(t) for t in proposals[s, :a]] + \
+                    [int(tgt[s, a])]
             L = int(self._lens[s])
             self._lens[s] = L + a + 1
             self._dlens[s] = L + a + 1

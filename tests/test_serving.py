@@ -200,6 +200,58 @@ def test_speculative_with_weak_draft(tiny_model):
     assert len(eng._draft_free) == draft.num_pages - 1
 
 
+def test_spec_accept_is_unbiased():
+    """The rejection-sampling acceptance emits tokens distributed EXACTLY
+    as the target distribution, whatever the draft proposes (Monte Carlo
+    over the pure host function)."""
+    from paddle_tpu.serving import _spec_accept
+    p = np.array([[0.5, 0.3, 0.2], [0.1, 0.6, 0.3]])
+    q = np.array([[0.2, 0.5, 0.3]])
+    rng = np.random.default_rng(0)
+    first = np.zeros(3)
+    n_trials = 20000
+    for _ in range(n_trials):
+        d = rng.choice(3, p=q[0])            # draft proposes from q
+        a, tok = _spec_accept(p, q, np.array([d]), rng)
+        first[d if a == 1 else tok] += 1     # first emitted token
+    freq = first / n_trials
+    np.testing.assert_allclose(freq, p[0], atol=0.02)
+
+
+def test_sampled_speculative_deterministic(tiny_model):
+    """Sampled speculation: reproducible per seed, near-greedy
+    temperature reproduces the greedy golden exactly."""
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import SpeculativeEngine
+    paddle.seed(55)
+    weak = GPT(gpt_tiny(max_seq_len=128, dtype="float32", remat=False))
+    weak.eval()
+    prompt = [3, 141, 59, 26]
+    n_new = 10
+
+    def run(temperature, seed):
+        dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                              max_batch=1, temperature=temperature,
+                              seed=seed)
+        draft = PagedGPTDecoder(weak, num_pages=32, page_size=16,
+                                max_batch=1, temperature=temperature,
+                                seed=seed + 1)
+        eng = SpeculativeEngine(dec, draft, max_new_tokens=n_new, k=3)
+        rid = eng.submit(np.asarray(prompt, np.int32))
+        return eng.run()[rid]
+
+    assert run(0.9, 3) == run(0.9, 3), "same seed must reproduce"
+    # temperature -> 0 limit: sampling collapses to greedy
+    assert run(1e-4, 0) == _golden_greedy(tiny_model, prompt, n_new)
+    # mismatched sampling configs rejected
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=1, temperature=0.9)
+    draft = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                            max_batch=1)
+    with pytest.raises(ValueError, match="SAME sampling"):
+        SpeculativeEngine(dec, draft)
+
+
 def test_paged_kernel_path_matches_jnp(tiny_model):
     """use_kernel=True exercises the scalar-prefetch Pallas paged kernel
     (interpret mode on CPU) end-to-end through the engine."""
