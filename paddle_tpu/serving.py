@@ -417,13 +417,15 @@ class PagedGPTDecoder:
                 y = _ln(x, wl["ln1_w"], wl["ln1_b"])
                 qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)
                 q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-                s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                               k.astype(jnp.float32)) / math.sqrt(D)
-                row = jax.lax.broadcasted_iota(jnp.int32, (Lp, Lp), 0)
-                col = jax.lax.broadcasted_iota(jnp.int32, (Lp, Lp), 1)
-                s = jnp.where((row >= col) & (col < true_len), s, -1e30)
-                p = jax.nn.softmax(s, axis=-1)
-                attn = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+                # Pallas flash kernel when backend/tiling allow, jnp
+                # reference otherwise (one shared gate + fallback).
+                # Padded-key masking is unnecessary: causal rows < true_len
+                # never see cols >= true_len, padded rows' garbage stays
+                # row-local, and only row true_len-1 feeds the logits.
+                from .ops.attention import flash_raw_or_reference
+                attn = flash_raw_or_reference(
+                    q[None], k[None], v[None], causal=True,
+                    scale=1.0 / math.sqrt(D))[0]
                 x = x + _mm(attn.reshape(Lp, H * D).astype(x.dtype),
                             wl["proj_w"], wl["proj_b"], quant)
                 y = _ln(x, wl["ln2_w"], wl["ln2_b"])
