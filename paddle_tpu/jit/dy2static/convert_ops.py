@@ -164,11 +164,18 @@ def _check_same_static(name, a, b):
         except Exception:
             same = False
     if not same:
+        hint = ""
+        if isinstance(a, list) or isinstance(b, list):
+            hint = (" — to COLLECT results in a tensor-dependent loop, "
+                    "preallocate a tensor and write into it "
+                    "(out = paddle.zeros([n, ...]); out[i] = ...), which "
+                    "lowers to a scan with stacked outputs; a growing "
+                    "Python list has no static shape for XLA")
         raise TypeError(
             f"dy2static: non-tensor variable {name!r} takes different "
             f"values on the branches of tensor-dependent control flow "
-            f"({a!r} vs {b!r}); only tensor/numeric values can depend on a "
-            "traced condition")
+            f"({a!r} vs {b!r}); only tensor/numeric values can depend on "
+            f"a traced condition{hint}")
 
 
 def _dyn_names(names, mask):

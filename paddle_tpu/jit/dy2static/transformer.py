@@ -76,9 +76,14 @@ def _walk_same_scope(node, into_loops=True):
         stack.extend(ast.iter_child_nodes(n))
 
 
-def _stores(stmts):
+def _stores(stmts, local_names=None):
     """Ordered simple-Name assignment targets in these statements (same
-    scope): Assign/AugAssign/AnnAssign/NamedExpr/For-target/With-as."""
+    scope): Assign/AugAssign/AnnAssign/NamedExpr/For-target/With-as.
+    A subscript store (`out[i] = v`) counts as a store of its base —
+    Tensor __setitem__ is a functional update that must be threaded
+    through lax control flow — but ONLY when the base is a local of the
+    enclosing function (`local_names`); subscript writes to globals or
+    closure objects are genuine side effects and must stay untouched."""
     seen, out = set(), []
 
     def add(name):
@@ -94,6 +99,9 @@ def _stores(stmts):
                 targets_of(e)
         elif isinstance(t, ast.Starred):
             targets_of(t.value)
+        elif isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+            if local_names is None or t.value.id in local_names:
+                add(t.value.id)
 
     for stmt in stmts:
         for n in [stmt] + list(_walk_same_scope(stmt)):
@@ -129,7 +137,7 @@ def _reads(node):
     return out
 
 
-def _use_before_def(stmts, candidates):
+def _use_before_def(stmts, candidates, local_names=None):
     """Which of `candidates` are read before they are (re)assigned when
     executing `stmts` linearly — i.e. loop-carried names.  Compound
     statements are approximated: their reads count first, then their
@@ -139,7 +147,7 @@ def _use_before_def(stmts, candidates):
         for name in _reads(stmt):
             if name in candidates and name not in defined:
                 carried.add(name)
-        for name in _stores([stmt]):
+        for name in _stores([stmt], local_names):
             defined.add(name)
     return carried
 
@@ -329,10 +337,12 @@ def _assign_tuple(names, value):
 # --------------------------------------------------------------------------
 
 class _CtrlFlowTransformer(ast.NodeTransformer):
-    def __init__(self, tail_reads, self_name=None, has_class_cell=False):
+    def __init__(self, tail_reads, self_name=None, has_class_cell=False,
+                 local_names=None):
         self._tail_reads = tail_reads
         self._self_name = self_name
         self._has_class_cell = has_class_cell
+        self._locals = local_names
         self._n = 0
 
     def _uid(self):
@@ -415,7 +425,7 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         if (_has_loop_jump(node.body) or _has_loop_jump(node.orelse)
                 or _has_scope_escape(node.body + node.orelse)):
             return node
-        mod = _stores(node.body + node.orelse)
+        mod = _stores(node.body + node.orelse, self._locals)
         if not mod:
             return node   # side-effect-only if: nothing to functionalize
         uid = self._uid()
@@ -438,10 +448,10 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 or _has_return(node.body)
                 or _has_scope_escape(node.body)):
             return node
-        stored = _stores(node.body)
+        stored = _stores(node.body, self._locals)
         if not stored:
             return node
-        carried = _use_before_def(node.body, set(stored))
+        carried = _use_before_def(node.body, set(stored), self._locals)
         test_reads = _reads(node.test)
         loop_vars = [n for n in stored
                      if n in carried or n in test_reads or n in tail]
@@ -474,8 +484,9 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             tnames = [e.id for e in node.target.elts]
         else:
             return node
-        stored = [n for n in _stores(node.body) if n not in tnames]
-        carried = _use_before_def(node.body, set(stored))
+        stored = [n for n in _stores(node.body, self._locals)
+                  if n not in tnames]
+        carried = _use_before_def(node.body, set(stored), self._locals)
         loop_vars = [n for n in stored if n in carried or n in tail]
         if not loop_vars:
             return node
@@ -588,7 +599,18 @@ def _build_template(fn):
     tail_reads = _compute_tail_reads(fdef)
     self_name = fdef.args.args[0].arg if fdef.args.args else None
     has_class_cell = "__class__" in fn.__code__.co_freevars
-    _CtrlFlowTransformer(tail_reads, self_name, has_class_cell).visit(fdef)
+    a = fdef.args
+    params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    # function locals = params + plain Name stores (subscript bases
+    # excluded here: a name is local only if actually BOUND in scope)
+    local_names = frozenset(params) | frozenset(
+        _stores(fdef.body, frozenset()))
+    _CtrlFlowTransformer(tail_reads, self_name, has_class_cell,
+                         local_names).visit(fdef)
 
     freevars = fn.__code__.co_freevars
     if freevars:

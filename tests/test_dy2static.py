@@ -399,6 +399,50 @@ def test_called_method_converted_transitively():
                                -2.0 / 4.0 + -2.0 * -3.0)
 
 
+def test_preallocated_writes_in_tensor_loop():
+    """`out[i] = ...` inside a converted tensor loop: the subscript base
+    is threaded as a loop variable so the functional updates ride the
+    scan carry (the ported-code idiom for collecting loop results —
+    reference list/tensor_array transformers)."""
+    def f(xs):
+        out = paddle.zeros([3, 2])
+        i = 0
+        for row in xs:
+            out[i] = row * 2.0
+            i = i + 1
+        return out
+
+    g = _check_converted(f)
+    xs_np = np.arange(6.0).reshape(3, 2).astype("float32")
+    eager = g(paddle.to_tensor(xs_np))
+    np.testing.assert_allclose(np.asarray(eager._value), xs_np * 2.0)
+    jitted = jax.jit(lambda v: g(paddle.to_tensor(v))._value)(xs_np)
+    np.testing.assert_allclose(np.asarray(jitted), xs_np * 2.0)
+
+
+def test_subscript_write_in_tensor_if():
+    def f(x):
+        out = paddle.zeros([2, 2])
+        if x.sum() > 0:
+            out[0] = x * 10.0
+        else:
+            out[1] = x
+        return out
+
+    g = _check_converted(f)
+
+    def run(v):
+        return g(paddle.to_tensor(v))._value
+
+    x = np.array([1.0, 2.0], "float32")
+    got = np.asarray(jax.jit(run)(x))
+    np.testing.assert_allclose(got[0], x * 10.0)
+    np.testing.assert_allclose(got[1], 0.0)
+    got = np.asarray(jax.jit(run)(-x))
+    np.testing.assert_allclose(got[1], -x)
+    np.testing.assert_allclose(got[0], 0.0)
+
+
 _THRESHOLD = 0.0
 
 
