@@ -399,24 +399,25 @@ class PagedGPTDecoder:
             return np.asarray(out), self._probs_of(logits)
         return np.asarray(out)
 
-    def _prefill_fn(self, Lp):
-        """Per-bucket compiled prefill: one sequence, padded to Lp.
-        Returns (last-token logits argmax, per-layer K/V) and writes the
-        prompt KV into the given pages."""
+    def _prefill_fn(self, Lp, n):
+        """Per-(length-bucket, batch-bucket) compiled prefill: n padded
+        sequences at once. Writes prompt KV into each sequence's pages
+        and returns the n first tokens."""
         cfg, ps = self.cfg, self.page_size
         H, D = cfg.num_heads, cfg.head_dim
         n_pg = Lp // ps
         quant = bool(self.quant)
 
         def run(weights, k_pages, v_pages, ids, true_len, page_ids, draw):
-            x = (self.wte[ids] + self.wpe[jnp.arange(Lp)]
-                 ).astype(k_pages.dtype)                        # [Lp, h]
+            x = (self.wte[ids] + self.wpe[jnp.arange(Lp)][None]
+                 ).astype(k_pages.dtype)                     # [n, Lp, h]
 
             def layer(x, wkv):
                 wl, kp, vp = wkv
                 y = _ln(x, wl["ln1_w"], wl["ln1_b"])
-                qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)
-                q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+                qkv = _mm_heads(y.reshape(n * Lp, -1), wl["qkv_w"],
+                                wl["qkv_b"], quant).reshape(n, Lp, 3, H, D)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
                 # Pallas flash kernel when backend/tiling allow, jnp
                 # reference otherwise (one shared gate + fallback).
                 # Padded-key masking is unnecessary: causal rows < true_len
@@ -424,17 +425,20 @@ class PagedGPTDecoder:
                 # row-local, and only row true_len-1 feeds the logits.
                 from .ops.attention import flash_raw_or_reference
                 attn = flash_raw_or_reference(
-                    q[None], k[None], v[None], causal=True,
-                    scale=1.0 / math.sqrt(D))[0]
-                x = x + _mm(attn.reshape(Lp, H * D).astype(x.dtype),
-                            wl["proj_w"], wl["proj_b"], quant)
+                    q, k, v, causal=True, scale=1.0 / math.sqrt(D))
+                x = x + _mm(attn.reshape(n * Lp, H * D).astype(x.dtype),
+                            wl["proj_w"], wl["proj_b"],
+                            quant).reshape(n, Lp, -1)
                 y = _ln(x, wl["ln2_w"], wl["ln2_b"])
-                h = jax.nn.gelu(_mm(y, wl["fc1_w"], wl["fc1_b"], quant),
-                                approximate=True)
-                x = x + _mm(h, wl["fc2_w"], wl["fc2_b"], quant)
-                # page writes: static page count, dynamic page ids
-                kpg = k.reshape(n_pg, ps, H, D).astype(kp.dtype)
-                vpg = v.reshape(n_pg, ps, H, D).astype(vp.dtype)
+                h = jax.nn.gelu(
+                    _mm(y.reshape(n * Lp, -1), wl["fc1_w"], wl["fc1_b"],
+                        quant), approximate=True)
+                x = x + _mm(h, wl["fc2_w"], wl["fc2_b"],
+                            quant).reshape(n, Lp, -1)
+                # page writes: static page count, dynamic page ids; the
+                # requests' page sets are disjoint (scratch excepted)
+                kpg = k.reshape(n, n_pg, ps, H, D).astype(kp.dtype)
+                vpg = v.reshape(n, n_pg, ps, H, D).astype(vp.dtype)
                 kp = kp.at[page_ids].set(kpg)
                 vp = vp.at[page_ids].set(vpg)
                 return x, (kp, vp)
@@ -442,14 +446,19 @@ class PagedGPTDecoder:
             x, (k_pages, v_pages) = jax.lax.scan(
                 layer, x, (weights, k_pages, v_pages))
             x = _ln(x, self.ln_f_w, self.ln_f_b)
-            last = jnp.take(x, true_len - 1, axis=0)
-            logits = last.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+            last = jnp.take_along_axis(
+                x, (true_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                                # [n, h]
+            logits = last.astype(jnp.float32) @ \
+                self.lm_head.astype(jnp.float32)
             keys = None
             if self.sampling is not None:
-                keys = jax.random.fold_in(
-                    jax.random.PRNGKey(self.seed), draw)[None]
-            nxt = _sample_tokens(logits[None], self.sampling, keys)[0]
-            return nxt, k_pages, v_pages
+                base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                          draw)
+                keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+                    jnp.arange(n))
+            return _sample_tokens(logits, self.sampling, keys), \
+                k_pages, v_pages
 
         return jax.jit(run, donate_argnums=(1, 2))
 
@@ -459,31 +468,48 @@ class PagedGPTDecoder:
         """Run one prompt through the model, writing KV into `page_ids`;
         returns the next token (greedy, or sampled per the decoder's
         temperature/top_k/top_p config)."""
-        ids = np.asarray(ids, np.int32)
-        true_len = len(ids)
-        Lp = max(self.page_size,
-                 self.page_size * (2 ** math.ceil(
-                     math.log2(max(1, (true_len + self.page_size - 1)
-                                   // self.page_size)))))
-        if Lp not in self._prefills:
-            self._prefills[Lp] = self._prefill_fn(Lp)
-        pad = np.zeros(Lp, np.int32)
-        pad[:true_len] = ids
-        # page_ids covers prompt+generation; prefill only fills the
-        # prompt's pages (decode writes the rest as it goes)
-        pg = np.zeros(Lp // self.page_size, np.int32)
-        k = min(len(page_ids), len(pg))
-        pg[:k] = page_ids[:k]
-        # unused padded pages write into page 0's slot of a scratch page:
-        # route them to a reserved scratch page to avoid clobbering
-        if len(page_ids) < len(pg):
-            pg[len(page_ids):] = self.num_pages - 1   # scratch page
-        self._draws += 1
-        nxt, self.k_pages, self.v_pages = self._prefills[Lp](
-            self.weights, self.k_pages, self.v_pages, jnp.asarray(pad),
-            jnp.asarray(true_len, jnp.int32), jnp.asarray(pg),
-            jnp.asarray(self._draws, jnp.int32))
-        return int(nxt)
+        return self.prefill_batch([(ids, page_ids)])[0]
+
+    def prefill_batch(self, requests):
+        """Prefill several prompts, batching same-length-bucket groups
+        into single forwards. requests: [(ids, page_ids), ...]; returns
+        the first generated token per request (in order)."""
+        ps = self.page_size
+        results = [None] * len(requests)
+        groups = {}
+        for i, (ids, page_ids) in enumerate(requests):
+            ids = np.asarray(ids, np.int32)
+            Lp = max(ps, ps * (2 ** math.ceil(
+                math.log2(max(1, (len(ids) + ps - 1) // ps)))))
+            groups.setdefault(Lp, []).append((i, ids, page_ids))
+        for Lp, group in groups.items():
+            n_pg = Lp // ps
+            while group:
+                # batch-bucket to powers of two (bounded compile count)
+                nb = 1
+                while nb * 2 <= len(group) and nb * 2 <= self.max_batch:
+                    nb *= 2
+                chunk, group = group[:nb], group[nb:]
+                pad = np.zeros((nb, Lp), np.int32)
+                tl = np.ones(nb, np.int32)
+                pg = np.full((nb, n_pg), self.num_pages - 1, np.int32)
+                for r, (i, ids, page_ids) in enumerate(chunk):
+                    pad[r, :len(ids)] = ids
+                    tl[r] = len(ids)
+                    k = min(len(page_ids), n_pg)
+                    pg[r, :k] = page_ids[:k]   # rest stays on scratch
+                key = (Lp, nb)
+                if key not in self._prefills:
+                    self._prefills[key] = self._prefill_fn(Lp, nb)
+                self._draws += 1
+                nxt, self.k_pages, self.v_pages = self._prefills[key](
+                    self.weights, self.k_pages, self.v_pages,
+                    jnp.asarray(pad), jnp.asarray(tl), jnp.asarray(pg),
+                    jnp.asarray(self._draws, jnp.int32))
+                nxt = np.asarray(nxt)
+                for r, (i, _, _) in enumerate(chunk):
+                    results[i] = int(nxt[r])
+        return results
 
     def decode(self, tokens, lens, table, return_probs=False):
         """One decode step for all slots (greedy, or the configured
@@ -550,6 +576,29 @@ class ContinuousBatchingEngine:
         return (n_tokens + self.d.page_size - 1) // self.d.page_size
 
     def _admit(self):
+        # gather every admittable request first: same-length-bucket
+        # prompts then prefill as ONE batched forward (iteration-level
+        # batching applies to prefill too, not just decode). Pages freed
+        # by EOS-at-prefill become available from the NEXT step's pass.
+        admitted = self._gather_admissions()
+        if not admitted:
+            return
+        firsts = self.d.prefill_batch(
+            [(ids, pages) for _, _, ids, pages in admitted])
+        self._extra_prefill(admitted)
+        for (slot, rid, ids, pages), first in zip(admitted, firsts):
+            self._outputs[rid] = [first]
+            if (self.eos is not None and first == self.eos) \
+                    or self.max_new <= 1:
+                # finished at prefill: never occupy a decode slot
+                self._retire(slot)
+                continue
+            self._lens[slot] = len(ids)
+            self._tokens[slot] = first
+            self._after_admit(slot, len(ids))
+
+    def _gather_admissions(self):
+        admitted = []
         for slot in range(self.d.max_batch):
             if self._slot_req[slot] is not None or not self._queue:
                 continue
@@ -561,17 +610,14 @@ class ContinuousBatchingEngine:
             pages = [self._free.pop() for _ in range(need)]
             self._slot_req[slot] = rid
             self._slot_pages[slot] = pages
-            first = self.d.prefill(ids, pages)
-            self._outputs[rid] = [first]
-            if (self.eos is not None and first == self.eos) \
-                    or self.max_new <= 1:
-                # finished at prefill: never occupy a decode slot
-                self._free.extend(pages)
-                self._slot_req[slot] = None
-                self._slot_pages[slot] = []
-                continue
-            self._lens[slot] = len(ids)
-            self._tokens[slot] = first
+            admitted.append((slot, rid, ids, pages))
+        return admitted
+
+    def _extra_prefill(self, admitted):
+        pass                                 # SpeculativeEngine: draft
+
+    def _after_admit(self, slot, prompt_len):
+        pass                                 # SpeculativeEngine: _dlens
 
     def _retire(self, slot):
         self._free.extend(self._slot_pages[slot])
@@ -680,7 +726,8 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self._queue.append((rid, [int(t) for t in ids]))
         return rid
 
-    def _admit(self):
+    def _gather_admissions(self):
+        admitted = []
         for slot in range(self.d.max_batch):
             if self._slot_req[slot] is not None or not self._queue:
                 continue
@@ -698,16 +745,16 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             self._slot_req[slot] = rid
             self._slot_pages[slot] = pages
             self._draft_pages[slot] = dpages
-            first = self.d.prefill(ids, pages)
-            self.draft.prefill(ids, dpages)     # draft's guess discarded
-            self._outputs[rid] = [first]
-            if (self.eos is not None and first == self.eos) \
-                    or self.max_new <= 1:
-                self._retire(slot)
-                continue
-            self._lens[slot] = len(ids)
-            self._dlens[slot] = len(ids)
-            self._tokens[slot] = first
+            admitted.append((slot, rid, ids, pages))
+        return admitted
+
+    def _extra_prefill(self, admitted):
+        self.draft.prefill_batch(           # draft's guesses discarded
+            [(ids, self._draft_pages[slot])
+             for slot, _, ids, _ in admitted])
+
+    def _after_admit(self, slot, prompt_len):
+        self._dlens[slot] = prompt_len
 
     def _retire(self, slot):
         self._draft_free.extend(self._draft_pages[slot])

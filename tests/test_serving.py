@@ -53,6 +53,23 @@ def test_continuous_batching_more_requests_than_slots(tiny_model):
     assert eng.steps < 3 * 6
 
 
+def test_prefill_batches_same_bucket_admissions(tiny_model):
+    """Two same-length-bucket prompts admitted together run as ONE
+    batched prefill forward (draw counter advances once), with outputs
+    identical to isolated decodes."""
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    eng = ContinuousBatchingEngine(dec, max_new_tokens=5)
+    prompts = [[3, 141, 59], [897, 11, 4, 18]]     # both bucket Lp=16
+    rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+    draws_before = dec._draws
+    eng.step()                                     # admission happens here
+    assert dec._draws == draws_before + 2          # 1 prefill + 1 decode
+    outs = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert outs[rid] == _golden_greedy(tiny_model, p, 5), p
+
+
 def test_eos_at_prefill_finishes_immediately(tiny_model):
     """A prompt whose first greedy token is EOS must not burn decode
     ticks or hold a slot."""
