@@ -1,0 +1,22 @@
+#!/bin/bash
+# Watch for the axon TPU tunnel to come back; the moment a device answers,
+# fire the perf campaign (resnet + bert + gpt + hlo) and bench.py so a
+# returning chip converts to recorded numbers within minutes, not hours.
+# Probe is a subprocess with a hard timeout (a down tunnel HANGS device
+# init forever rather than erroring).
+cd /root/repo
+PROBE='import jax; assert jax.devices()[0].platform != "cpu"; print("TPU-OK")'
+while true; do
+  if timeout 120 python -c "$PROBE" 2>/dev/null | grep -q TPU-OK; then
+    echo "$(date -u +%FT%TZ) tunnel UP — launching perf campaign" >> tunnel_watch.log
+    for cfg in hlo resnet bert gpt; do
+      timeout 3000 python examples/perf_campaign.py "$cfg" \
+        >> tunnel_watch.log 2>&1
+    done
+    timeout 3000 python bench.py >> tunnel_watch.log 2>&1
+    echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
+    break
+  fi
+  echo "$(date -u +%FT%TZ) tunnel still down" >> tunnel_watch.log
+  sleep 900
+done

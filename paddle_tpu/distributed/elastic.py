@@ -110,8 +110,10 @@ def launch_elastic(training_script, script_args=(), nproc_per_node=1,
                 # clock starts at launch: a worker that hangs BEFORE its
                 # first beat is detected too
                 last = started
-                if os.path.exists(heartbeat_path):
+                try:
                     last = max(last, os.path.getmtime(heartbeat_path))
+                except OSError:
+                    pass  # beat file not written yet (or deleted mid-check)
                 age = time.time() - last
                 if age > heartbeat_timeout_s:
                     reason = f"heartbeat stale for {age:.0f}s"

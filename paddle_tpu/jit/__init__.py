@@ -45,19 +45,13 @@ class _StaticFunction:
                 conv = convert_to_static(type(layer).forward)
                 bound = lambda *a, **k: conv(layer, *a, **k)  # noqa: E731
 
-            def call_converted(*args, **kwargs):
-                # route through Layer.__call__ (forward pre/post hooks run)
-                # with the converted forward shadowing via the instance dict
-                had = "forward" in layer.__dict__
-                old = layer.__dict__.get("forward")
-                object.__setattr__(layer, "forward", bound)
-                try:
-                    return layer(*args, **kwargs)
-                finally:
-                    if had:
-                        object.__setattr__(layer, "forward", old)
-                    else:
-                        del layer.__dict__["forward"]
+            def call_converted(*inputs, **kwargs):
+                # hook-wrapped dispatch of the CONVERTED forward (no
+                # instance-dict swap: swapping layer.forward is not
+                # reentrancy/thread safe). A subclass overriding
+                # __call__ itself is bypassed here — hook semantics
+                # live in Layer._dispatch, the shared path.
+                return layer._dispatch(bound, *inputs, **kwargs)
 
             self._dygraph = call_converted
 

@@ -31,7 +31,7 @@ from . import convert_ops as _jst_mod
 
 _TEMPLATES = {}    # fn.__code__ -> (module_code, fdef_name, kept_decorators)
 _CONVERTED = weakref.WeakKeyDictionary()   # fn -> converted fn (per closure)
-_BY_CODE = {}      # (code, id(globals)) -> converted fn (closure-free only)
+_BY_CODE_KEY = "__dy2static_by_code__"  # per-module cache slot: code -> fn
 _FAILED = {}       # fn.__code__ -> reason string (for diagnostics)
 
 
@@ -538,7 +538,7 @@ def convert_to_static(fn, verbose=False):
     except TypeError:       # unhashable callable
         hit = None
     if hit is None and not fn.__closure__:
-        hit = _BY_CODE.get((key, id(fn.__globals__)))
+        hit = fn.__globals__.get(_BY_CODE_KEY, {}).get(key)
     if hit is not None:
         return hit
     if key in _FAILED:
@@ -557,8 +557,10 @@ def convert_to_static(fn, verbose=False):
         pass
     if not fn.__closure__:
         # per-code cache so per-call function objects (nested defs) don't
-        # reconvert every invocation; keyed on the live globals identity
-        _BY_CODE[(key, id(fn.__globals__))] = new_fn
+        # reconvert every invocation; stored IN the globals dict so the
+        # cache's lifetime is the module's (an id(globals) key could be
+        # served stale after id reuse)
+        fn.__globals__.setdefault(_BY_CODE_KEY, {})[key] = new_fn
     return new_fn
 
 

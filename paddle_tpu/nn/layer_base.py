@@ -105,11 +105,16 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        return self._dispatch(self.forward, *inputs, **kwargs)
+
+    def _dispatch(self, forward, *inputs, **kwargs):
+        """Hook-wrapped forward dispatch — the single source of hook
+        semantics (jit.to_static routes its converted forward here)."""
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        outputs = forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             out = hook(self, inputs, outputs)
             if out is not None:

@@ -535,6 +535,10 @@ class ContinuousBatchingEngine:
 
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
                  max_new_tokens=64):
+        if max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (the prefill forward always "
+                f"produces one token), got {max_new_tokens}")
         self.d = decoder
         self.eos = eos_token_id
         self.max_new = max_new_tokens

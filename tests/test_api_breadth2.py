@@ -26,9 +26,11 @@ def test_max_pool_mask_and_unpool_match_torch():
     tun = torch.nn.functional.max_unpool2d(to, tm, 2, 2)
     assert np.allclose(un.numpy(), tun.numpy())
     # layer forms
-    o2, m2 = nn.MaxPool2D(2, 2, return_mask=True)(paddle.to_tensor(x)) \
-        if False else (out, mask)
+    o2, m2 = nn.MaxPool2D(2, 2, return_mask=True)(paddle.to_tensor(x))
+    assert np.allclose(o2.numpy(), out.numpy())
+    assert np.array_equal(m2.numpy(), mask.numpy())
     y = nn.MaxUnPool2D(2, 2)(o2, m2)
+    assert np.allclose(y.numpy(), tun.numpy())
     assert y.shape == [2, 3, 8, 10]
 
 
