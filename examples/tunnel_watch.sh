@@ -4,7 +4,7 @@
 # returning chip converts to recorded numbers within minutes, not hours.
 # Probe is a subprocess with a hard timeout (a down tunnel HANGS device
 # init forever rather than erroring).
-cd /root/repo
+cd "$(dirname "$0")/.."
 PROBE='import jax; assert jax.devices()[0].platform != "cpu"; print("TPU-OK")'
 while true; do
   if timeout 120 python -c "$PROBE" 2>/dev/null | grep -q TPU-OK; then

@@ -135,7 +135,9 @@ def save(layer, path, input_spec=None, **configs):
     Layer — reference jit.save inference-program role,
     paddle/fluid/inference/api/paddle_inference_api.h)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    converted = None
     if isinstance(layer, _StaticFunction):
+        converted = layer._dygraph     # control-flow-converted forward
         layer = layer._target
     state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:
@@ -152,9 +154,14 @@ def save(layer, path, input_spec=None, **configs):
             meta["param_names"] = sorted(params)
             meta["buffer_names"] = sorted(bufs)
 
+            if converted is None and isinstance(layer, Layer):
+                # convert so tensor-dependent control flow exports via lax
+                converted = _StaticFunction(layer)._dygraph
+            fwd_call = converted if converted is not None else layer
+
             def pure(params, buffers, *args):
                 with functional_call(layer, {**params, **buffers}):
-                    out = layer(*args)
+                    out = fwd_call(*args)
                 return out._value if isinstance(out, Tensor) else out
             examples = [_example_from_spec(s) for s in specs]
             from jax import export as jax_export

@@ -22,10 +22,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     n_axes = len(ns)
 
     if n_axes == 1 and weight is not None and bias is not None:
-        # fused Pallas path (falls back internally on odd shapes)
-        from ...ops.layer_norm import fused_layer_norm
-        return apply_op(lambda v, w, b: fused_layer_norm(v, w, b, epsilon),
-                        x, weight, bias)
+        # fused Pallas path (falls back internally on odd shapes),
+        # dispatched through the public custom-op registration
+        from ...ops.layer_norm import fused_layer_norm_op
+        return fused_layer_norm_op(x, weight, bias, eps=epsilon)
 
     def _f(v, *rest):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
@@ -47,8 +47,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     if weight is not None:
-        from ...ops.layer_norm import fused_rms_norm
-        return apply_op(lambda v, w: fused_rms_norm(v, w, epsilon), x, weight)
+        from ...ops.layer_norm import fused_rms_norm_op
+        return fused_rms_norm_op(x, weight, eps=epsilon)
 
     def _f(v, *rest):
         x32 = v.astype(jnp.float32)

@@ -20,6 +20,30 @@ _cache = {}          # so_name -> (lib or None)
 _errors = {}         # so_name -> exception from a failed build/load
 
 
+def compile_so(sources, so_path, extra_flags=(), verbose=False):
+    """Compile C++ sources into `so_path` atomically: g++ writes to a
+    tmp path, then os.replace() publishes — a concurrent reader never
+    dlopens a half-written library (shared by runtime components and
+    utils.cpp_extension so the build flow can't drift)."""
+    tmp = f"{so_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           # libraries (-ljpeg etc.) must FOLLOW the sources for the
+           # linker to resolve their undefined symbols
+           *sources, "-o", tmp, *extra_flags]
+    if verbose:
+        print("[paddle_tpu build]", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so_path)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"{' '.join(cmd)} failed:\n"
+            + e.stderr.decode(errors="replace")[-2000:]) from None
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def build_error(so_name):
     """The exception that made load_native return None for this
     component, or None (for error messages / debugging)."""
@@ -56,12 +80,7 @@ def load_native(so_name, src_name, register, extra_flags=()):
                 needs_build = not os.path.exists(so_path)
             if needs_build:
                 os.makedirs(_LIB_DIR, exist_ok=True)
-                # libraries (-ljpeg etc.) must FOLLOW the source for the
-                # linker to resolve its undefined symbols
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", src_path, "-o", so_path, *extra_flags],
-                    check=True, capture_output=True)
+                compile_so([src_path], so_path, extra_flags)
                 if src_hash is not None:
                     with open(stamp_path, "w") as f:
                         f.write(src_hash)

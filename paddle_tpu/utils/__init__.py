@@ -99,3 +99,5 @@ from .checkpoint_convert import (  # noqa: F401,E402
 )
 
 from . import dlpack  # noqa: F401,E402
+
+from . import cpp_extension  # noqa: F401,E402

@@ -1,0 +1,296 @@
+"""paddle_tpu.utils.cpp_extension — the custom-op extension API.
+
+Reference counterpart: python/paddle/utils/cpp_extension/cpp_extension.py
+(`setup()` at :51, `load()` at :736) where users JIT-compile a C++/CUDA
+kernel and get a paddle op with autograd wired in.
+
+TPU-first split of that capability:
+
+* **Device compute** belongs in Pallas/JAX, not C++: `register_op` turns a
+  user-written JAX/Pallas kernel (plus optional custom VJP) into a
+  paddle-style op — Tensor in/out, recorded on the eager autograd tape,
+  differentiable under functional `paddle.grad`/`jax.grad`, traceable
+  under `jit.to_static`, serializable through `jit.save` (jax.export
+  inlines custom_vjp calls) and the ONNX exporter (paddle_tpu/onnx.py
+  inlines custom_vjp_call subjaxprs).
+* **Host-side native code** (IO, decode, tokenize — anything outside the
+  XLA graph) keeps the C++ path: `load()` JIT-compiles C++ sources with
+  g++ (hash-gated rebuilds, like paddle_tpu/runtime/_build.py) and binds
+  the exported functions via ctypes.
+
+In-tree proof: ops/layer_norm.py registers its fused Pallas LayerNorm /
+RMSNorm through this exact public path.
+"""
+import ctypes
+import hashlib
+import inspect
+import os
+import types
+
+import jax
+
+from ..framework.core import apply_op
+
+__all__ = [
+    "register_op", "get_op", "custom_ops",
+    "load", "setup", "CppExtension", "CUDAExtension", "BuildExtension",
+    "get_build_directory",
+]
+
+_REGISTRY = {}
+
+# namespace module holding every registered op (reference `load()` returns
+# a module of ops; registered ops live here under their given name)
+custom_ops = types.ModuleType(
+    "paddle_tpu.utils.custom_ops",
+    "Registered custom ops (populated by register_op)")
+
+
+class CustomOp:
+    """A registered op: `op(...)` is the paddle-level call (Tensor in/out,
+    tape-recorded); `op.raw(...)` is the jax-level kernel (arrays in/out,
+    differentiable via jax.grad) for use inside already-jitted code."""
+
+    def __init__(self, name, fn, vjp, fwd, static_argnames, doc):
+        self.name = name
+        self._fn = fn
+        self._vjp = vjp
+        self._fwd = fwd
+        self._static = tuple(static_argnames)
+        self._kernels = {}          # statics tuple -> jax callable
+        sig = inspect.signature(fn)
+        for p in sig.parameters.values():
+            if p.kind not in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                raise ValueError(
+                    f"register_op({name!r}): kernel parameters must be "
+                    f"positional (got {p.kind.description} {p.name!r}); "
+                    "wrap *args/**kwargs kernels in an explicit signature")
+        self._sig = sig
+        self._param_names = list(sig.parameters)
+        missing = set(self._static) - set(self._param_names)
+        if missing:
+            raise ValueError(
+                f"register_op({name!r}): static_argnames {sorted(missing)} "
+                f"not in kernel signature {self._param_names}")
+        self.__doc__ = doc or fn.__doc__
+        self.__name__ = name
+
+    def _split(self, args, kwargs):
+        ba = self._sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+        statics, arrays = [], []
+        for k in self._param_names:
+            v = ba.arguments[k]
+            (statics if k in self._static else arrays).append((k, v))
+        try:
+            key = tuple(statics)
+            hash(key)
+        except TypeError:
+            raise TypeError(
+                f"custom op {self.name!r}: static argument values must be "
+                f"hashable, got {statics}") from None
+        return key, [v for _, v in arrays]
+
+    def _kernel_for(self, statics_key):
+        k = self._kernels.get(statics_key)
+        if k is not None:
+            return k
+        statics = dict(statics_key)
+        array_names = [n for n in self._param_names if n not in self._static]
+        fn, user_fwd, user_vjp = self._fn, self._fwd, self._vjp
+
+        def call_fn(*arrays):
+            return fn(**dict(zip(array_names, arrays)), **statics)
+
+        if user_vjp is None:
+            kernel = call_fn
+        else:
+            kernel = jax.custom_vjp(call_fn)
+
+            def k_fwd(*arrays):
+                if user_fwd is not None:
+                    return user_fwd(*arrays, **statics)
+                return call_fn(*arrays), arrays
+
+            def k_bwd(res, g):
+                grads = user_vjp(res, g, **statics)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                if len(grads) != len(array_names):
+                    raise ValueError(
+                        f"custom op {self.name!r}: vjp returned "
+                        f"{len(grads)} gradients for {len(array_names)} "
+                        f"tensor inputs {array_names}")
+                return tuple(grads)
+
+            kernel.defvjp(k_fwd, k_bwd)
+        kernel.__name__ = self.name  # eager-profiler op label
+        kernel.__qualname__ = self.name
+        self._kernels[statics_key] = kernel
+        return kernel
+
+    def raw(self, *args, **kwargs):
+        """jax-level call: raw arrays in, raw array(s) out (no Tensor
+        wrapping, no tape) — compose inside other kernels/jitted fns."""
+        key, arrays = self._split(args, kwargs)
+        return self._kernel_for(key)(*arrays)
+
+    def __call__(self, *args, **kwargs):
+        key, arrays = self._split(args, kwargs)
+        return apply_op(self._kernel_for(key), *arrays)
+
+
+def register_op(name, fn, vjp=None, fwd=None, static_argnames=(),
+                doc=None, override=False):
+    """Register a JAX/Pallas kernel as a paddle-style custom op.
+
+    Args:
+        name: op name; the op becomes `custom_ops.<name>` and is
+            retrievable via `get_op(name)`.
+        fn: the kernel — a pure function of arrays (Pallas `pallas_call`
+            wrappers, plain jnp code, anything jax-traceable). Parameters
+            named in `static_argnames` are compile-time configuration
+            (hashable); all others are tensor inputs.
+        vjp: optional backward rule `vjp(residuals, out_grad, **statics)
+            -> tuple of input gradients` (one per tensor input). Without
+            it the op is differentiated by jax's autodiff through `fn`.
+        fwd: optional forward-for-grad `fwd(*arrays, **statics) -> (out,
+            residuals)`; defaults to `(fn(...), arrays)`.
+        static_argnames: kernel parameters treated as static config
+            (a distinct jax kernel is cached per combination).
+        override: allow re-registering an existing name.
+
+    Returns the CustomOp. Eager calls record on the autograd tape (so
+    `.backward()` flows); `op.raw` is the unwrapped jax-level callable.
+    """
+    if not override and name in _REGISTRY:
+        raise ValueError(
+            f"custom op {name!r} already registered "
+            "(pass override=True to replace)")
+    op = CustomOp(name, fn, vjp, fwd, static_argnames, doc)
+    _REGISTRY[name] = op
+    setattr(custom_ops, name, op)
+    return op
+
+
+def get_op(name):
+    """Look up a registered custom op by name."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no custom op {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# C++ host-side extensions (JIT-compiled, ctypes-bound)
+# ---------------------------------------------------------------------------
+
+def get_build_directory():
+    """Where JIT-compiled extension .so files land (reference
+    cpp_extension.get_build_directory; env PADDLE_TPU_EXTENSION_DIR)."""
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """A C++ source bundle for setup()/load() (reference CppExtension)."""
+
+    def __init__(self, sources, extra_compile_args=(), extra_link_args=(),
+                 name=None, **kw):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args)
+        self.extra_link_args = list(extra_link_args)
+
+
+def CUDAExtension(sources, **kw):
+    """CUDA sources have no TPU meaning: device kernels are Pallas
+    (`register_op`). Accepted and compiled as plain C++ host code so
+    reference build scripts degrade gracefully — .cu files are rejected."""
+    cu = [s for s in sources if s.endswith((".cu", ".cuh"))]
+    if cu:
+        raise ValueError(
+            f"CUDAExtension: {cu} are CUDA kernels; on TPU write the "
+            "device kernel in Pallas and register it with register_op()")
+    return CppExtension(sources, **kw)
+
+
+class BuildExtension:
+    """No-op stand-in for the reference's setuptools build_ext subclass
+    (compilation here is direct g++, no setuptools pipeline)."""
+
+    @classmethod
+    def with_options(cls, **kw):
+        return cls
+
+
+class _ExtensionModule(types.ModuleType):
+    """What load() returns: declared functions as attributes + `.lib`."""
+
+    def __init__(self, name, lib, so_path):
+        super().__init__(name, f"JIT-compiled extension ({so_path})")
+        self.lib = lib
+        self.so_path = so_path
+
+
+def _compile(name, sources, extra_flags, build_dir, verbose=False):
+    # staleness is content-addressed: the source/flag hash is IN the .so
+    # name, so a rebuilt source compiles to a fresh path; the atomic
+    # write itself is runtime/_build.py's shared compile_so
+    for s in sources:
+        if not os.path.exists(s):
+            raise FileNotFoundError(f"extension source not found: {s}")
+    h = hashlib.sha256()
+    for s in sorted(sources):
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_flags).encode())
+    so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:16]}.so")
+    if not os.path.exists(so_path):
+        from ..runtime._build import compile_so
+        compile_so(sources, so_path, extra_flags, verbose)
+    return so_path
+
+
+def load(name, sources, functions=None, extra_cxx_flags=(),
+         extra_ldflags=(), build_directory=None, verbose=False, **kw):
+    """JIT-compile C++ sources and return a module of bound functions
+    (reference cpp_extension.load at :736).
+
+    `functions` maps an exported (extern "C") symbol to its ctypes
+    signature: {"fname": (restype, [argtypes...])}. Unlisted symbols stay
+    reachable through `module.lib`. Host-side only — the returned
+    functions run on CPU outside the XLA graph; device compute goes
+    through register_op."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = _compile(name, list(sources),
+                       [*extra_cxx_flags, *extra_ldflags], build_dir,
+                       verbose)
+    lib = ctypes.CDLL(so_path)
+    mod = _ExtensionModule(name, lib, so_path)
+    for fname, (restype, argtypes) in (functions or {}).items():
+        cfunc = getattr(lib, fname)
+        cfunc.restype = restype
+        cfunc.argtypes = list(argtypes)
+        setattr(mod, fname, cfunc)
+    return mod
+
+
+def setup(name=None, ext_modules=(), verbose=False, **kw):
+    """Ahead-of-time build of CppExtension bundles into the build
+    directory (reference cpp_extension.setup at :51 — the pip-install
+    packaging half is setuptools' job; this performs the compile step and
+    returns the built .so paths)."""
+    paths = []
+    for ext in ext_modules:
+        ext_name = ext.name or name or "extension"
+        paths.append(_compile(
+            ext_name, ext.sources,
+            [*ext.extra_compile_args, *ext.extra_link_args],
+            get_build_directory(), verbose))
+    return paths
