@@ -9,6 +9,7 @@ pretraining at >=35% MFU on v5e). Falls back to smaller GPT configs if the
 1.3B Adam state can't fit the chip.
 """
 import json
+import os
 import sys
 import time
 
@@ -247,10 +248,11 @@ def run_yolov3(batch_size=16, size=320, steps=10):
     return imgs_s, mfu
 
 
-def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
+def run_gpt_moe(batch_size=8, seq_len=1024, steps=10, gate=None):
     """BASELINE.json config 5: GPT-MoE (top-2 routed experts), tokens/s/chip.
     Single-chip: measures the dispatch/combine einsums + expert FFs; the ep
-    mesh path is validated by dryrun_multichip and tests/test_moe.py."""
+    mesh path is validated by dryrun_multichip and tests/test_moe.py.
+    Gate family selectable via arg or PADDLE_TPU_MOE_GATE=topk|switch|gshard."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -261,7 +263,8 @@ def run_gpt_moe(batch_size=8, seq_len=1024, steps=10):
 
     paddle.seed(0)
     build_mesh(dp=1)
-    cfg = gpt_moe_small(max_seq_len=seq_len)
+    gate = gate or os.environ.get("PADDLE_TPU_MOE_GATE", "topk")
+    cfg = gpt_moe_small(max_seq_len=seq_len, gate=gate)
     model = GPTMoE(cfg)
     model.bfloat16()
     crit = GPTPretrainingCriterion()

@@ -20,6 +20,9 @@ from .sharding_utils import feasible_spec, plan_shardings
 
 __all__ = ["Trainer", "shard_batch", "make_compute_loss", "batch_to_arrays"]
 
+# consts key carrying the step counter that salts in-step RNG draws
+_RNG_STEP = "__rng_step__"
+
 
 def make_compute_loss(model, loss_fn):
     """Pure (params, consts, batch) -> (fp32 loss, buffer_updates) via
@@ -98,6 +101,10 @@ class Trainer:
             (consts if p.stop_gradient else trainable)[name] = v
         for name, b in model.named_buffers():
             consts[name] = jax.device_put(b._value, self._plan[name])
+        # per-step RNG salt rides consts so stochastic layers (dropout,
+        # noisy MoE gates) draw FRESH randomness every compiled step
+        # (framework.random.traced_salt); load_state_pytree ignores it
+        consts[_RNG_STEP] = jnp.zeros((), jnp.uint32)
         self.params = trainable
         self.consts = consts
         # slots inherit param shardings: zeros_like under jit keeps sharding
@@ -119,6 +126,11 @@ class Trainer:
         grad_transform = self.grad_transform
 
         def step(params, opt_state, gt_state, consts, lr, batch):
+            from ..framework.random import traced_salt
+            with traced_salt(consts.get(_RNG_STEP)):
+                return _inner(params, opt_state, gt_state, consts, lr, batch)
+
+        def _inner(params, opt_state, gt_state, consts, lr, batch):
             if accum <= 1:
                 (loss_v, buf_updates), grads = jax.value_and_grad(
                     compute_loss, has_aux=True)(params, consts, batch)
@@ -150,6 +162,8 @@ class Trainer:
             new_params, new_state = optimizer.apply_gradients_pytree(
                 params, grads, opt_state, lr)
             new_consts = {**consts, **buf_updates}
+            if _RNG_STEP in consts:
+                new_consts[_RNG_STEP] = consts[_RNG_STEP] + 1
             return new_params, new_state, gt_state, new_consts, loss_v
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3) if donate else ())

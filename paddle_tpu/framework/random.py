@@ -6,6 +6,7 @@ global generator per device; the TPU-native equivalent is a root
 single `seed(n)` reproduces an entire run. Inside jitted code users pass keys
 explicitly (idiomatic JAX); eager creation ops draw from this state.
 """
+import contextlib
 import threading
 
 import jax
@@ -17,7 +18,27 @@ def _ensure():
     if not hasattr(_state, "key"):
         _state.key = jax.random.PRNGKey(0)
         _state.counter = 0
+        _state.traced_salt = None
     return _state
+
+
+@contextlib.contextmanager
+def traced_salt(value):
+    """Fold a TRACED value (e.g. the training-step counter) into every
+    next_key() drawn inside the context. Without this, keys drawn while
+    tracing a jitted train step are baked in as compile-time constants —
+    the same dropout/gate-noise draw would repeat every step. The salt is
+    a step argument, so randomness is fresh per step with no retrace."""
+    if value is None:
+        yield
+        return
+    s = _ensure()
+    old = s.traced_salt
+    s.traced_salt = value
+    try:
+        yield
+    finally:
+        s.traced_salt = old
 
 
 def seed(value: int):
@@ -31,7 +52,10 @@ def next_key():
     """Fresh PRNG key for one eager random op (deterministic given seed())."""
     s = _ensure()
     s.counter += 1
-    return jax.random.fold_in(s.key, s.counter)
+    k = jax.random.fold_in(s.key, s.counter)
+    if getattr(s, "traced_salt", None) is not None:
+        k = jax.random.fold_in(k, s.traced_salt)
+    return k
 
 
 def get_rng_state():

@@ -1,6 +1,6 @@
 """paddle_tpu.incubate — reference python/paddle/incubate (fused ops, MoE,
 checkpointing, ASP, segment/graph ops, LookAhead/ModelAverage)."""
-from . import asp, autograd, autotune, checkpoint, graph, nn, operators, optimizer, tensor  # noqa: F401
+from . import asp, autograd, autotune, checkpoint, graph, moe, nn, operators, optimizer, tensor  # noqa: F401
 from .graph import graph_khop_sampler, graph_reindex, graph_sample_neighbors  # noqa: F401
 from .operators import (  # noqa: F401
     graph_send_recv,

@@ -1,0 +1,12 @@
+"""paddle_tpu.incubate.moe — reference
+python/paddle/incubate/distributed/models/moe (MoELayer, gate classes,
+grad clip). Flat namespace here; the implementations live in
+models/moe.py (dispatch), models/moe_gate.py (gate policies) and
+nn/clip.py (MoE-aware global-norm clip)."""
+from ..models.moe import GPTMoE, MoEConfig, MoEMLP  # noqa: F401
+from ..models.moe_gate import (  # noqa: F401
+    GShardGate, NaiveTopKGate, SwitchGate, make_gate)
+from ..nn.clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+
+__all__ = ["MoEConfig", "MoEMLP", "GPTMoE", "NaiveTopKGate", "SwitchGate",
+           "GShardGate", "make_gate", "ClipGradForMOEByGlobalNorm"]

@@ -28,6 +28,27 @@ class MoEConfig(GPTConfig):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     moe_every: int = 2  # every Nth block gets an MoE MLP
+    gate: str = "topk"  # "topk" | "switch" | "gshard" (moe_gate.py)
+    switch_eps: float = 0.1       # SwitchGate training jitter
+    random_routing: bool = True   # GShard random 2nd-expert drop
+
+    def __post_init__(self):
+        super().__post_init__()
+        # "switch" is top-1, "gshard" top-2 by definition; keep top_k (the
+        # FLOPs/capacity accounting input) consistent with the policy
+        if isinstance(self.gate, str):
+            if self.gate == "switch":
+                self.top_k = 1
+            elif self.gate == "gshard":
+                self.top_k = 2
+            elif self.gate != "topk":
+                raise ValueError(
+                    f"unknown MoE gate {self.gate!r}: "
+                    "'topk', 'switch' or 'gshard'")
+        else:
+            # a policy instance defines its own k; keep the config's
+            # FLOPs/capacity accounting in sync with actual routing
+            self.top_k = int(self.gate.top_k)
 
     def _n_moe_blocks(self):
         return sum(1 for i in range(self.num_layers)
@@ -56,40 +77,58 @@ class MoEConfig(GPTConfig):
         return super().num_params() + extra
 
 
-def _moe_dispatch(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor):
+def _moe_dispatch(x, gate_w, w1, b1, w2, b2, gate_policy, capacity_factor,
+                  key=None, train=False):
     """x: [T, H] tokens. Returns (y [T, H], aux_loss scalar).
     Pure function — runs under jit/GSPMD; the E dim of w1/w2 is 'ep'-sharded.
+    Routing policy (top-k count, selection noise, per-round random drops)
+    comes from `gate_policy` (models/moe_gate.py).
     """
     T, H = x.shape
     E = w1.shape[0]
+    top_k = gate_policy.top_k
     C = max(1, int(capacity_factor * T * top_k / E))
+    if key is None:
+        key = jax.random.key(0)
+    sel_key, route_key = jax.random.split(jax.random.fold_in(key, T))
 
     logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
+    # selection may see jittered logits (SwitchGate); combine weights and
+    # the aux loss always use the clean probabilities
+    sel_probs = jax.nn.softmax(
+        gate_policy.select_logits(logits, sel_key, train), axis=-1)
 
     # top-k selection, one expert at a time (k small)
     combine = jnp.zeros((T, E, C), jnp.float32)
     dispatch = jnp.zeros((T, E, C), bool)
-    remaining = probs
+    remaining = sel_probs
     # track per-expert slot usage across the k rounds
     base_count = jnp.zeros((E,), jnp.int32)
     aux_me = jnp.mean(probs, axis=0)  # mean gate prob per expert
     frac_tokens = jnp.zeros((E,), jnp.float32)
-    for _ in range(top_k):
+    for k in range(top_k):
         expert = jnp.argmax(remaining, axis=-1)              # [T]
-        gate = jnp.take_along_axis(remaining, expert[:, None], axis=1)[:, 0]
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+        # combine weight comes from the CLEAN probs at the chosen expert
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+        extra = gate_policy.keep_round(
+            k, gate, jax.random.fold_in(route_key, k), train)
+        if extra is not None:
+            # e.g. GShard random 2nd-expert drop: the token leaves the
+            # round entirely (consumes no capacity slot)
+            onehot = onehot * extra[:, None].astype(jnp.int32)
+        frac_tokens = frac_tokens + jnp.mean(onehot.astype(jnp.float32), axis=0)
         # position of each token within its expert's queue this round
         pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot + base_count[None, :]
         pos = jnp.sum(pos_in_expert * onehot, axis=1)        # [T]
-        keep = pos < C
-        frac_tokens = frac_tokens + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        keep = (pos < C) & (jnp.sum(onehot, axis=1) > 0)
         slot = jax.nn.one_hot(jnp.where(keep, pos, C), C + 1, dtype=jnp.float32)[:, :C]
         contrib = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
         combine = combine + gate[:, None, None] * contrib
         dispatch = dispatch | (contrib > 0)
         base_count = base_count + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
-        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
 
     # renormalize combine weights over selected experts
     denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
@@ -124,16 +163,24 @@ class MoEMLP(Layer):
         self.w2.partition_spec = ("ep", "tp", None)
         self.b2 = self.create_parameter([E, h], default_initializer=Constant(0.0))
         self.b2.partition_spec = ("ep", None)
+        from .moe_gate import make_gate
+        self.gate_policy = make_gate(cfg.gate, cfg)
         self.last_aux_loss = None
 
     def forward(self, x):
         cfg = self.cfg
         B, L, H = x.shape[0], x.shape[1], x.shape[2]
+        from ..framework.random import next_key
         from ..tensor.manipulation import reshape
         flat = reshape(x, [B * L, H])
+        policy, train = self.gate_policy, self.training
+        # stochastic gates (switch jitter, gshard random routing) draw
+        # from the framework's seeded key stream, like dropout does
+        key = next_key() if train else None
         out = apply_op(
             lambda xv, gw, w1, b1, w2, b2: _moe_dispatch(
-                xv, gw, w1, b1, w2, b2, cfg.top_k, cfg.capacity_factor),
+                xv, gw, w1, b1, w2, b2, policy, cfg.capacity_factor,
+                key=key, train=train),
             flat, self.gate_w, self.w1, self.b1, self.w2, self.b2)
         y, aux = out
         self.last_aux_loss = aux

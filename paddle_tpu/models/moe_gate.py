@@ -1,0 +1,94 @@
+"""MoE gate family — reference
+python/paddle/incubate/distributed/models/moe/gate/{naive,switch,gshard}_gate.py.
+
+TPU-native framing: a gate is a pure ROUTING POLICY over the router
+logits — it owns no parameters (the router projection lives in MoEMLP)
+and is expressed as jit-traceable transforms so the whole dispatch stays
+one XLA program:
+
+* `NaiveTopKGate`  — plain top-k (k rounds of argmax), uniform keep.
+* `SwitchGate`     — top-1; during training the raw scores get additive
+  uniform noise in [1-eps, 1+eps] (reference switch_gate.py:49-52).
+* `GShardGate`     — top-2; the SECOND expert is kept with probability
+  min(1, 2*g2) per token (reference gshard_gate.py random_routing +
+  distributed/models/moe/utils.py:_random_routing — drop when
+  2*g2 < u).
+
+The k-round selection/capacity loop itself lives in models/moe.py
+(`_moe_dispatch`); gates plug in via two hooks:
+  select_logits(logits, key, train)  -> logits used for argmax selection
+  keep_round(k, gate_val, key, train) -> per-token keep mask or None
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NaiveTopKGate", "SwitchGate", "GShardGate", "make_gate"]
+
+
+class NaiveTopKGate:
+    """Plain top-k routing (reference naive_gate.py)."""
+
+    name = "topk"
+
+    def __init__(self, top_k=2):
+        self.top_k = int(top_k)
+
+    def select_logits(self, logits, key, train):
+        return logits
+
+    def keep_round(self, k, gate_val, key, train):
+        return None
+
+
+class SwitchGate(NaiveTopKGate):
+    """Top-1 routing with training-time jitter (reference
+    switch_gate.py: `noise = rand*2*eps + 1 - eps; score += noise`)."""
+
+    name = "switch"
+
+    def __init__(self, switch_eps=0.1):
+        super().__init__(top_k=1)
+        self.switch_eps = float(switch_eps)
+
+    def select_logits(self, logits, key, train):
+        if not train:
+            return logits
+        noise = jax.random.uniform(
+            key, logits.shape, jnp.float32,
+            1.0 - self.switch_eps, 1.0 + self.switch_eps)
+        return logits + noise
+
+
+class GShardGate(NaiveTopKGate):
+    """Top-2 with random second-expert routing (reference
+    gshard_gate.py): token i's 2nd expert is dropped when
+    2 * g2_i < uniform_i — i.e. kept with probability min(1, 2*g2)."""
+
+    name = "gshard"
+
+    def __init__(self, random_routing=True):
+        super().__init__(top_k=2)
+        self.random_routing = random_routing
+
+    def keep_round(self, k, gate_val, key, train):
+        # training-time regularizer only: inference stays deterministic
+        if k == 0 or not self.random_routing or not train:
+            return None
+        u = jax.random.uniform(key, gate_val.shape, jnp.float32)
+        return (2.0 * gate_val) >= u
+
+
+def make_gate(gate, cfg):
+    """Gate factory: `gate` is a policy instance or one of
+    "topk" | "switch" | "gshard" (config knobs taken from MoEConfig)."""
+    if not isinstance(gate, str):
+        return gate
+    if gate == "topk":
+        return NaiveTopKGate(top_k=cfg.top_k)
+    if gate == "switch":
+        return SwitchGate(switch_eps=cfg.switch_eps)
+    if gate == "gshard":
+        return GShardGate(random_routing=cfg.random_routing)
+    raise ValueError(
+        f"unknown MoE gate {gate!r}: expected 'topk', 'switch', 'gshard' "
+        "or a gate policy instance")

@@ -5,7 +5,8 @@ from .layout import channels_last_enabled, set_channels_last  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
 from . import utils  # noqa: F401
-from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue, ClipGradForMOEByGlobalNorm)
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .utils import spectral_norm  # noqa: F401
 from .layer import *  # noqa: F401,F403

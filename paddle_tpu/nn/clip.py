@@ -6,7 +6,8 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 
-__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "ClipGradForMOEByGlobalNorm"]
 
 
 class ClipGradBase:
@@ -82,6 +83,85 @@ class ClipGradByGlobalNorm(ClipGradBase):
         leaves = jax.tree_util.tree_leaves(grads)
         sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
         global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def _leaf_name(key_path):
+    """Pytree key path -> plain dotted name ("moe.w1", not "['moe.w1']"),
+    so name predicates see the same strings as state_dict keys."""
+    parts = []
+    for k in key_path:
+        parts.append(str(getattr(k, "key", getattr(k, "name",
+                                                   getattr(k, "idx", k)))))
+    return ".".join(parts)
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """MoE-aware global-norm clip — reference
+    python/paddle/incubate/distributed/models/moe/grad_clip.py
+    (ClipGradForMOEByGlobalNorm): expert and non-expert gradients form ONE
+    combined global norm, with the expert contribution summed across the
+    expert-parallel group.
+
+    TPU-native: under GSPMD the stacked expert tensors are logically
+    global, so summing their squared norms IS the cross-group reduction —
+    no explicit collective needed. Inside a shard_map body (manual
+    collectives, each rank holding its expert slice) the expert
+    contribution is psum'd over `moe_axis` to reproduce the reference's
+    moe-group all_reduce.
+
+    `is_expert_param_func(param_or_name) -> bool` selects expert params:
+    it receives the param in the eager path and the pytree leaf NAME in
+    clip_pytree.
+    """
+
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_axis="ep", group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert = is_expert_param_func or (lambda p: False)
+        self.moe_axis = moe_axis
+
+    def _moe_psum(self, sq_moe):
+        from ..distributed.mesh import current_axis_context, in_shard_map
+        if in_shard_map() and self.moe_axis in (current_axis_context() or ()):
+            return jax.lax.psum(sq_moe, self.moe_axis)
+        return sq_moe
+
+    def _dygraph_clip(self, params_grads):
+        sq_normal = jnp.zeros((), jnp.float32)
+        sq_moe = jnp.zeros((), jnp.float32)
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            if self.is_expert(p):
+                sq_moe = sq_moe + s
+            else:
+                sq_normal = sq_normal + s
+        global_norm = jnp.sqrt(sq_normal + self._moe_psum(sq_moe))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value.astype(jnp.float32)
+                                   * scale).astype(g.dtype))))
+        return out
+
+    def clip_pytree(self, grads):
+        pairs = jax.tree_util.tree_flatten_with_path(grads)[0]
+        sq_normal = jnp.zeros((), jnp.float32)
+        sq_moe = jnp.zeros((), jnp.float32)
+        for kp, g in pairs:
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if self.is_expert(_leaf_name(kp)):
+                sq_moe = sq_moe + s
+            else:
+                sq_normal = sq_normal + s
+        global_norm = jnp.sqrt(sq_normal + self._moe_psum(sq_moe))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
         return jax.tree_util.tree_map(
             lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)

@@ -39,9 +39,28 @@ __all__ = [
 
 _REGISTRY = {}
 
+
+def _ensure_intree():
+    """In-tree kernels register as an import side effect of their op
+    modules; make the documented names reliable even before the first
+    layer_norm call."""
+    from ..ops import layer_norm  # noqa: F401
+
+
+class _CustomOpsModule(types.ModuleType):
+    def __getattr__(self, name):
+        _ensure_intree()
+        try:
+            return _REGISTRY[name]
+        except KeyError:
+            raise AttributeError(
+                f"no custom op {name!r}; registered: {sorted(_REGISTRY)}"
+            ) from None
+
+
 # namespace module holding every registered op (reference `load()` returns
 # a module of ops; registered ops live here under their given name)
-custom_ops = types.ModuleType(
+custom_ops = _CustomOpsModule(
     "paddle_tpu.utils.custom_ops",
     "Registered custom ops (populated by register_op)")
 
@@ -67,6 +86,8 @@ class CustomOp:
                     "wrap *args/**kwargs kernels in an explicit signature")
         self._sig = sig
         self._param_names = list(sig.parameters)
+        self._defaults = {p.name: p.default for p in sig.parameters.values()
+                          if p.default is not inspect.Parameter.empty}
         missing = set(self._static) - set(self._param_names)
         if missing:
             raise ValueError(
@@ -76,20 +97,38 @@ class CustomOp:
         self.__name__ = name
 
     def _split(self, args, kwargs):
-        ba = self._sig.bind(*args, **kwargs)
-        ba.apply_defaults()
-        statics, arrays = [], []
-        for k in self._param_names:
-            v = ba.arguments[k]
-            (statics if k in self._static else arrays).append((k, v))
+        # hand-rolled Signature.bind — this sits on hot eager paths
+        # (nn.functional.layer_norm runs through here every call)
+        names = self._param_names
+        if len(args) > len(names):
+            raise TypeError(
+                f"custom op {self.name!r} takes {len(names)} arguments "
+                f"({len(args)} given)")
+        vals = dict(self._defaults)
+        vals.update(zip(names, args))
+        n_pos = len(args)
+        for k, v in kwargs.items():
+            if k not in self._sig.parameters:
+                raise TypeError(
+                    f"custom op {self.name!r} got unexpected keyword "
+                    f"argument {k!r}")
+            if k in names[:n_pos]:
+                raise TypeError(
+                    f"custom op {self.name!r} got multiple values for {k!r}")
+            vals[k] = v
+        if len(vals) != len(names):
+            missing = [n for n in names if n not in vals]
+            raise TypeError(
+                f"custom op {self.name!r} missing arguments: {missing}")
+        statics = tuple((k, vals[k]) for k in names if k in self._static)
+        arrays = [vals[k] for k in names if k not in self._static]
         try:
-            key = tuple(statics)
-            hash(key)
+            hash(statics)
         except TypeError:
             raise TypeError(
                 f"custom op {self.name!r}: static argument values must be "
                 f"hashable, got {statics}") from None
-        return key, [v for _, v in arrays]
+        return statics, arrays
 
     def _kernel_for(self, statics_key):
         k = self._kernels.get(statics_key)
@@ -175,6 +214,8 @@ def register_op(name, fn, vjp=None, fwd=None, static_argnames=(),
 
 def get_op(name):
     """Look up a registered custom op by name."""
+    if name not in _REGISTRY:
+        _ensure_intree()
     try:
         return _REGISTRY[name]
     except KeyError:

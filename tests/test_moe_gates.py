@@ -1,0 +1,223 @@
+"""MoE gate family — reference
+python/paddle/incubate/distributed/models/moe/gate/{switch,gshard}_gate.py
+and moe/grad_clip.py (ClipGradForMOEByGlobalNorm)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.models import GPTPretrainingCriterion
+from paddle_tpu.models.moe import GPTMoE, MoEMLP, _moe_dispatch, gpt_moe_tiny
+from paddle_tpu.models.moe_gate import (
+    GShardGate, NaiveTopKGate, SwitchGate, make_gate)
+
+
+def _dispatch(policy, T=64, H=32, E=4, seed=0, train=False, key=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(T, H).astype("float32"))
+    gate_w = jnp.asarray(rng.randn(H, E).astype("float32"))
+    w1 = jnp.asarray(rng.randn(E, H, 2 * H).astype("float32") * 0.05)
+    b1 = jnp.zeros((E, 2 * H), jnp.float32)
+    w2 = jnp.asarray(rng.randn(E, 2 * H, H).astype("float32") * 0.05)
+    b2 = jnp.zeros((E, H), jnp.float32)
+    return _moe_dispatch(x, gate_w, w1, b1, w2, b2, policy, 1.25,
+                         key=jax.random.key(key), train=train)
+
+
+def test_gate_factory_and_config_topk():
+    cfg = gpt_moe_tiny(gate="switch")
+    assert cfg.top_k == 1                  # switch is top-1 by definition
+    cfg = gpt_moe_tiny(gate="gshard")
+    assert cfg.top_k == 2
+    assert isinstance(make_gate("switch", cfg), SwitchGate)
+    assert isinstance(make_gate("gshard", cfg), GShardGate)
+    assert isinstance(make_gate("topk", cfg), NaiveTopKGate)
+    g = GShardGate(random_routing=False)
+    assert make_gate(g, cfg) is g          # instances pass through
+    with pytest.raises(ValueError, match="unknown MoE gate"):
+        gpt_moe_tiny(gate="nope")
+
+
+def test_switch_gate_routes_top1():
+    """Each token lands on at most ONE expert slot under switch."""
+    y, aux = _dispatch(SwitchGate(), train=False)
+    assert y.shape == (64, 32)
+    assert float(aux) > 0
+    # eval: no jitter -> deterministic
+    y2, _ = _dispatch(SwitchGate(), train=False, key=7)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-6)
+    # training jitter changes routing for some key
+    yt, _ = _dispatch(SwitchGate(switch_eps=5.0), train=True, key=1)
+    assert not np.allclose(np.asarray(y), np.asarray(yt))
+
+
+def test_switch_matches_naive_top1_at_eval():
+    """Without jitter, switch IS top-1 routing."""
+    y_sw, aux_sw = _dispatch(SwitchGate(), train=False)
+    y_n1, aux_n1 = _dispatch(NaiveTopKGate(top_k=1), train=False)
+    np.testing.assert_allclose(np.asarray(y_sw), np.asarray(y_n1), rtol=1e-6)
+    np.testing.assert_allclose(float(aux_sw), float(aux_n1), rtol=1e-6)
+
+
+def test_gshard_random_routing_drops_second_expert():
+    """Random routing keeps the 2nd expert with prob min(1, 2*g2): vs the
+    no-routing baseline, some tokens lose their 2nd-expert contribution,
+    and with random_routing=False the dispatch equals plain top-2."""
+    y_plain, _ = _dispatch(GShardGate(random_routing=False), train=True)
+    y_top2, _ = _dispatch(NaiveTopKGate(top_k=2), train=True)
+    np.testing.assert_allclose(np.asarray(y_plain), np.asarray(y_top2),
+                               rtol=1e-6)
+    y_rand, _ = _dispatch(GShardGate(random_routing=True), train=True)
+    assert not np.allclose(np.asarray(y_plain), np.asarray(y_rand))
+    # eval: no random drops
+    y_ev, _ = _dispatch(GShardGate(random_routing=True), train=False)
+    y_ev2, _ = _dispatch(GShardGate(random_routing=False), train=False)
+    np.testing.assert_allclose(np.asarray(y_ev), np.asarray(y_ev2), rtol=1e-6)
+
+
+def test_gshard_keep_probability_monte_carlo():
+    """keep_round implements P(keep) = min(1, 2*g2)."""
+    g = GShardGate()
+    gate_val = jnp.full((20000,), 0.3, jnp.float32)
+    keep = g.keep_round(1, gate_val, jax.random.key(0), train=True)
+    assert abs(float(jnp.mean(keep)) - 0.6) < 0.02
+    assert g.keep_round(0, gate_val, jax.random.key(0), train=True) is None
+    assert g.keep_round(1, gate_val, jax.random.key(0), train=False) is None
+
+
+@pytest.mark.parametrize("gate", ["switch", "gshard"])
+def test_gpt_moe_trains_with_gate(gate):
+    paddle.seed(0)
+    build_mesh(ep=4, dp=2)
+    model = GPTMoE(gpt_moe_tiny(gate=gate))
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["input_ids"]))
+        return crit(logits, paddle.to_tensor(b["labels"])) + m.aux_loss()
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (4, 17))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+    losses = [float(trainer.step(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_mlp_capacity_drop_counts():
+    """With a tiny capacity factor most tokens are dropped (output ~0 for
+    dropped tokens), proving capacity bounding is live for every gate."""
+    for policy in (NaiveTopKGate(2), SwitchGate(), GShardGate()):
+        y, _ = _dispatch(policy, T=64, E=4)
+        ys, _ = _moe_dispatch(
+            jnp.ones((64, 32), jnp.float32),
+            jnp.asarray(np.random.RandomState(0).randn(32, 4), jnp.float32),
+            jnp.ones((4, 32, 64), jnp.float32), jnp.zeros((4, 64)),
+            jnp.ones((4, 64, 32), jnp.float32), jnp.zeros((4, 32)),
+            policy, 0.05, key=jax.random.key(0))
+        # identical tokens all route to one expert; capacity 0.05 keeps
+        # only a few slots -> most rows come back zero
+        zero_rows = int(jnp.sum(jnp.all(ys == 0, axis=-1)))
+        assert zero_rows > 32, zero_rows
+
+
+def test_clip_grad_for_moe_by_global_norm():
+    from paddle_tpu.nn import ClipGradForMOEByGlobalNorm
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+    paddle.seed(3)
+    build_mesh(dp=1)
+    moe = MoEMLP(gpt_moe_tiny())
+    x = paddle.rand([2, 8, moe.cfg.hidden_size])
+    (moe(x).sum() + moe.last_aux_loss).backward()
+    pg = [(p, p.grad) for p in moe.parameters()]
+
+    is_expert = lambda p: any(  # noqa: E731
+        p is w for w in (moe.w1, moe.b1, moe.w2, moe.b2))
+    clip = ClipGradForMOEByGlobalNorm(0.01, is_expert_param_func=is_expert)
+    out = clip(pg)
+    # single-mesh GSPMD: combined norm == plain global norm -> same scaling
+    ref = ClipGradByGlobalNorm(0.01)(pg)
+    for (_, g1), (_, g2) in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(g1._value),
+                                   np.asarray(g2._value), rtol=1e-5)
+    total = np.sqrt(sum(float(jnp.sum(jnp.square(
+        g._value.astype(jnp.float32)))) for _, g in out))
+    assert total <= 0.0101
+
+    # pytree form with name-based expert selection
+    grads = {"moe.w1": jnp.ones((4, 8)), "dense.w": jnp.ones((3, 3))}
+    clip2 = ClipGradForMOEByGlobalNorm(
+        1.0, is_expert_param_func=lambda name: "moe" in name)
+    clipped = clip2.clip_pytree(grads)
+    n = np.sqrt(sum(float(jnp.sum(jnp.square(v)))
+                    for v in clipped.values()))
+    assert n <= 1.0001
+
+
+def test_gate_noise_fresh_per_jitted_step():
+    """Keys drawn inside a jitted train step are salted with the traced
+    step counter (framework.random.traced_salt): the same compiled fn
+    yields DIFFERENT jitter at different steps, same jitter at the same
+    step."""
+    from paddle_tpu.framework.random import next_key, traced_salt
+
+    @jax.jit
+    def draw(step):
+        with traced_salt(step):
+            paddle.seed(0)
+            return jax.random.normal(next_key(), (8,))
+
+    a = np.asarray(draw(jnp.uint32(0)))
+    b = np.asarray(draw(jnp.uint32(1)))
+    c = np.asarray(draw(jnp.uint32(0)))
+    assert not np.allclose(a, b)
+    np.testing.assert_allclose(a, c)
+
+    # end to end: two Trainer steps of a switch-gate model produce
+    # different routing noise (consts carry the incrementing salt)
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = GPTMoE(gpt_moe_tiny(gate="switch", switch_eps=5.0))
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=0.0,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["input_ids"]))
+        return crit(logits, paddle.to_tensor(b["labels"])) + m.aux_loss()
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (4, 17))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+    # lr=0: params frozen, so loss differences come only from gate noise
+    l1 = float(trainer.step(batch))
+    l2 = float(trainer.step(batch))
+    assert l1 != l2, "gate jitter repeated across steps"
+
+
+def test_moe_config_syncs_top_k_from_gate_instance():
+    cfg = gpt_moe_tiny(gate=SwitchGate())
+    assert cfg.top_k == 1
+
+
+def test_leaf_name_for_clip_predicates():
+    from paddle_tpu.nn.clip import _leaf_name
+    pairs = jax.tree_util.tree_flatten_with_path(
+        {"moe.w1": jnp.zeros(2), "outer": {"b": jnp.zeros(2)}})[0]
+    names = sorted(_leaf_name(kp) for kp, _ in pairs)
+    assert names == ["moe.w1", "outer.b"]
+
+
+def test_incubate_moe_namespace():
+    import paddle_tpu.incubate as incubate
+    assert incubate.moe.SwitchGate is SwitchGate
+    assert incubate.moe.ClipGradForMOEByGlobalNorm is \
+        paddle.nn.ClipGradForMOEByGlobalNorm
