@@ -47,6 +47,7 @@ from .planner_cost import (  # noqa: F401
 )
 from .compression import DGCCompressor, bf16_compress  # noqa: F401
 from .localsgd import LocalSGDTrainer  # noqa: F401
+from .sharded_embedding import ShardedEmbedding  # noqa: F401
 from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from . import sharding  # noqa: F401  (group_sharded_parallel API)

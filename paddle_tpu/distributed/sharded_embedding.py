@@ -1,0 +1,102 @@
+"""Mesh-sharded embedding tables — the TPU answer to reference PS mode.
+
+Reference counterpart: the parameter-server training stack for
+recsys-scale sparse embeddings — python/paddle/distributed/ps/the_one_ps.py
+(1,439 L: sparse tables on PS nodes, workers pull rows / push sparse
+grads) and paddle.static.nn.sparse_embedding.
+
+TPU-first mapping (no parameter servers exist here):
+
+    PS concept                      → TPU-native equivalent
+    ------------------------------------------------------------------
+    sparse table sharded over       → ONE logical [V, D] array with its
+    PS instances (by row hash)        vocab dim sharded over mesh axes
+                                      (GSPMD row sharding)
+    worker "pull" of touched rows   → jnp.take on the sharded table:
+                                      XLA lowers the gather to an
+                                      all-to-all/all-gather over ICI
+    "push" of sparse row grads      → VJP of take = scatter-add, which
+                                      GSPMD keeps row-sharded: each
+                                      device only materializes and
+                                      updates ITS rows' optimizer state
+    distributed lookup table        → total HBM across the mesh; each
+    capacity ≫ single host            device holds V/n rows
+
+Inside a shard_map body (manual-collective contexts: pipeline stages,
+custom kernels) the same layer switches to the explicit recipe: local
+slice lookup, out-of-range rows masked to zero, psum over the shard
+axis — byte-identical to what GSPMD emits for the annotated gather.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+from ..nn.layer.common import Embedding
+from .mesh import current_axis_context, in_shard_map, mesh_axis_size
+
+__all__ = ["ShardedEmbedding"]
+
+
+class ShardedEmbedding(Embedding):
+    """nn.Embedding with the vocab (row) dim sharded over `shard_axes`.
+
+    Drop-in replacement: same call signature and numerics as the dense
+    layer (parity-tested), but the [V, D] table carries a row partition
+    spec so plan_shardings/GSPMD place V/n rows per device — tables
+    larger than one device's HBM train normally. Gradients stay
+    row-sharded through the take-VJP scatter, so optimizer state for a
+    row lives only where the row does (the PS "sparse push" economics).
+
+    Args:
+        shard_axes: mesh axis name (or tuple of names) to shard rows
+            over. Defaults to ("dp", "tp") — recsys tables want the
+            biggest product of axes available; axes that don't divide V
+            are dropped by feasible_spec at plan time.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=True, weight_attr=None, shard_axes=("dp", "tp"),
+                 name=None):
+        super().__init__(num_embeddings, embedding_dim,
+                         padding_idx=padding_idx, sparse=sparse,
+                         weight_attr=weight_attr, name=name)
+        if isinstance(shard_axes, str):
+            shard_axes = (shard_axes,)
+        # the REQUESTED axes: feasibility against the actual mesh is
+        # resolved at plan time (param_partition_spec -> feasible_spec),
+        # so building the layer before build_mesh() is safe
+        self.shard_axes = tuple(shard_axes)
+        self.weight.partition_spec = (self.shard_axes, None)
+
+    def forward(self, x):
+        axes = [a for a in self.shard_axes
+                if a in (current_axis_context() or ())]
+        if in_shard_map() and axes:
+            # manual-collective path: the table arg is the LOCAL row
+            # slice; mask foreign rows and psum the partial lookups
+            pad = self._padding_idx
+
+            def _local(ids, w_local):
+                n = 1
+                idx = jnp.zeros((), jnp.int32)
+                for a in axes:
+                    idx = idx * mesh_axis_size(a) + jax.lax.axis_index(a)
+                    n *= mesh_axis_size(a)
+                rows = w_local.shape[0]
+                offset = idx * rows
+                local = ids - offset
+                ok = (local >= 0) & (local < rows)
+                if pad is not None:
+                    ok = ok & (ids != pad)
+                safe = jnp.clip(local, 0, rows - 1)
+                out = jnp.take(w_local, safe, axis=0) \
+                    * ok[..., None].astype(w_local.dtype)
+                return jax.lax.psum(out, tuple(axes))
+            return apply_op(_local, x, self.weight)
+        # GSPMD path: annotated row sharding makes XLA insert the
+        # gather collectives; numerics identical to dense Embedding
+        return super().forward(x)
+
+    def extra_repr(self):
+        return (f"{self._num_embeddings}, {self._embedding_dim}, "
+                f"shard_axes={self.shard_axes}")

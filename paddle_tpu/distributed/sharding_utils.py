@@ -16,12 +16,19 @@ from .mesh import get_mesh
 __all__ = ["param_partition_spec", "plan_shardings", "shard_params", "constraint"]
 
 
-def param_partition_spec(p, fsdp_size=1, min_fsdp_numel=2 ** 16):
-    """Decide the PartitionSpec for one parameter value."""
+def param_partition_spec(p, fsdp_size=1, min_fsdp_numel=2 ** 16, mesh=None):
+    """Decide the PartitionSpec for one parameter value.
+
+    With `mesh` given, requested axes that don't evenly divide their dim
+    are dropped here at PLAN time (feasible_spec policy) — layers may
+    annotate partition_spec before any mesh exists (e.g. ShardedEmbedding
+    built before build_mesh) and still get a legal sharding."""
     spec = getattr(p, "partition_spec", None)
     shape = tuple(p.shape if hasattr(p, "shape") else np.shape(p))
     if spec is not None:
         spec = tuple(spec)
+        if mesh is not None:
+            spec = tuple(feasible_spec(shape, spec, mesh))
     else:
         spec = (None,) * len(shape)
     if fsdp_size > 1 and int(np.prod(shape)) >= min_fsdp_numel:
@@ -40,7 +47,9 @@ def plan_shardings(layer, mesh=None, fsdp_axis="fsdp"):
     fsdp_size = mesh.shape.get(fsdp_axis, 1)
     plan = {}
     for name, p in layer.named_parameters():
-        plan[name] = NamedSharding(mesh, param_partition_spec(p, fsdp_size))
+        plan[name] = NamedSharding(mesh,
+                                   param_partition_spec(p, fsdp_size,
+                                                        mesh=mesh))
     for name, b in layer.named_buffers():
         plan[name] = NamedSharding(mesh, PartitionSpec())
     return plan

@@ -107,11 +107,15 @@ def _moe_dispatch(x, gate_w, w1, b1, w2, b2, gate_policy, capacity_factor,
     base_count = jnp.zeros((E,), jnp.int32)
     aux_me = jnp.mean(probs, axis=0)  # mean gate prob per expert
     frac_tokens = jnp.zeros((E,), jnp.float32)
+    sel_gate_sum = jnp.zeros((T,), jnp.float32)
     for k in range(top_k):
         expert = jnp.argmax(remaining, axis=-1)              # [T]
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
         # combine weight comes from the CLEAN probs at the chosen expert
         gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+        # renorm denominator counts every SELECTED expert (g1+g2), before
+        # random drops/capacity — post-drop sums would degenerate to 1
+        sel_gate_sum = sel_gate_sum + gate
         remaining = remaining * (1.0 - onehot.astype(jnp.float32))
         extra = gate_policy.keep_round(
             k, gate, jax.random.fold_in(route_key, k), train)
@@ -130,9 +134,13 @@ def _moe_dispatch(x, gate_w, w1, b1, w2, b2, gate_policy, capacity_factor,
         dispatch = dispatch | (contrib > 0)
         base_count = base_count + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
 
-    # renormalize combine weights over selected experts
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = combine / jnp.maximum(denom, 1e-9)
+    if getattr(gate_policy, "normalize_combine", top_k > 1):
+        # renormalize combine weights over the selected experts (GShard
+        # g1/(g1+g2) convention). Top-1 gates must NOT renormalize: the
+        # weight would become a constant 1 and the router would get zero
+        # task-loss gradient — Switch scales output by the raw prob.
+        combine = combine / jnp.maximum(
+            sel_gate_sum[:, None, None], 1e-9)
 
     aux = E * jnp.sum(aux_me * frac_tokens / top_k)
 

@@ -33,6 +33,13 @@ class NaiveTopKGate:
     def __init__(self, top_k=2):
         self.top_k = int(top_k)
 
+    @property
+    def normalize_combine(self):
+        """Renormalize combine weights over the selected experts. False
+        for top-1: the renormalized weight degenerates to 1, killing the
+        router's task-loss gradient (Switch scales by the raw prob)."""
+        return self.top_k > 1
+
     def select_logits(self, logits, key, train):
         return logits
 

@@ -63,14 +63,26 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
         self.clip_norm = float(clip_norm)
 
-    def _dygraph_clip(self, params_grads):
+    # squared-norm accumulation is the ONLY thing subclasses change
+    # (ClipGradForMOEByGlobalNorm splits expert/dense and psums)
+    def _sq_eager(self, params_grads):
         sq = 0.0
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 continue
             sq = sq + jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+        return sq
+
+    def _sq_pytree(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+    def _scale(self, sq):
         global_norm = jnp.sqrt(sq)
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+
+    def _dygraph_clip(self, params_grads):
+        scale = self._scale(self._sq_eager(params_grads))
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
@@ -80,10 +92,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return out
 
     def clip_pytree(self, grads):
-        leaves = jax.tree_util.tree_leaves(grads)
-        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
-        global_norm = jnp.sqrt(sq)
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        scale = self._scale(self._sq_pytree(grads))
         return jax.tree_util.tree_map(
             lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
@@ -129,39 +138,25 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
             return jax.lax.psum(sq_moe, self.moe_axis)
         return sq_moe
 
-    def _dygraph_clip(self, params_grads):
+    def _combine(self, tagged_sqs):
         sq_normal = jnp.zeros((), jnp.float32)
         sq_moe = jnp.zeros((), jnp.float32)
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                continue
-            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
-            if self.is_expert(p):
+        for is_moe, s in tagged_sqs:
+            if is_moe:
                 sq_moe = sq_moe + s
             else:
                 sq_normal = sq_normal + s
-        global_norm = jnp.sqrt(sq_normal + self._moe_psum(sq_moe))
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            out.append((p, Tensor((g._value.astype(jnp.float32)
-                                   * scale).astype(g.dtype))))
-        return out
+        return sq_normal + self._moe_psum(sq_moe)
 
-    def clip_pytree(self, grads):
-        pairs = jax.tree_util.tree_flatten_with_path(grads)[0]
-        sq_normal = jnp.zeros((), jnp.float32)
-        sq_moe = jnp.zeros((), jnp.float32)
-        for kp, g in pairs:
-            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-            if self.is_expert(_leaf_name(kp)):
-                sq_moe = sq_moe + s
-            else:
-                sq_normal = sq_normal + s
-        global_norm = jnp.sqrt(sq_normal + self._moe_psum(sq_moe))
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
-        return jax.tree_util.tree_map(
-            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+    def _sq_eager(self, params_grads):
+        return self._combine(
+            (self.is_expert(p),
+             jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            for p, g in params_grads
+            if g is not None and getattr(p, "need_clip", True))
+
+    def _sq_pytree(self, grads):
+        return self._combine(
+            (self.is_expert(_leaf_name(kp)),
+             jnp.sum(jnp.square(g.astype(jnp.float32))))
+            for kp, g in jax.tree_util.tree_flatten_with_path(grads)[0])

@@ -160,6 +160,28 @@ def test_clip_grad_for_moe_by_global_norm():
     assert n <= 1.0001
 
 
+def test_router_gets_task_gradient_for_top1():
+    """Top-1 combine weights must NOT be renormalized (they'd collapse to
+    1 and the router would only learn from the aux loss): gate_w must
+    receive nonzero gradient through the OUTPUT path for every gate."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 16).astype("float32"))
+    w1 = jnp.asarray(rng.randn(4, 16, 32).astype("float32") * 0.1)
+    b1 = jnp.zeros((4, 32), jnp.float32)
+    w2 = jnp.asarray(rng.randn(4, 32, 16).astype("float32") * 0.1)
+    b2 = jnp.zeros((4, 16), jnp.float32)
+
+    for policy in (SwitchGate(), NaiveTopKGate(1), NaiveTopKGate(2),
+                   GShardGate()):
+        def out_only(gw):
+            y, _aux = _moe_dispatch(x, gw, w1, b1, w2, b2, policy, 2.0,
+                                    key=jax.random.key(0), train=False)
+            return jnp.sum(y ** 2)      # task path only, no aux term
+        g = jax.grad(out_only)(
+            jnp.asarray(rng.randn(16, 4).astype("float32")))
+        assert float(jnp.max(jnp.abs(g))) > 0, policy.name
+
+
 def test_gate_noise_fresh_per_jitted_step():
     """Keys drawn inside a jitted train step are salted with the traced
     step counter (framework.random.traced_salt): the same compiled fn
