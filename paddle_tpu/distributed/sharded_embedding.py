@@ -77,11 +77,9 @@ class ShardedEmbedding(Embedding):
             pad = self._padding_idx
 
             def _local(ids, w_local):
-                n = 1
                 idx = jnp.zeros((), jnp.int32)
                 for a in axes:
                     idx = idx * mesh_axis_size(a) + jax.lax.axis_index(a)
-                    n *= mesh_axis_size(a)
                 rows = w_local.shape[0]
                 offset = idx * rows
                 local = ids - offset

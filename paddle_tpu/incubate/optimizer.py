@@ -9,7 +9,11 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+from . import functional_optimizer as functional  # noqa: F401
+from .functional_optimizer import minimize_bfgs, minimize_lbfgs  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "functional", "minimize_bfgs",
+           "minimize_lbfgs"]
 
 
 class LookAhead(Optimizer):

@@ -1,0 +1,74 @@
+"""minimize_bfgs / minimize_lbfgs — reference
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.optimizer import minimize_bfgs, minimize_lbfgs
+
+
+def rosen(x):
+    v = x._value
+    return jnp.sum(100.0 * (v[1:] - v[:-1] ** 2) ** 2 + (1 - v[:-1]) ** 2)
+
+
+def quadratic(x):
+    v = x._value
+    a = jnp.asarray([1.0, 10.0, 100.0], jnp.float32)
+    return jnp.sum(a * (v - 2.0) ** 2)
+
+
+@pytest.mark.parametrize("fn,extra", [(minimize_bfgs, {}),
+                                      (minimize_lbfgs, {"history_size": 8})])
+def test_rosenbrock_reaches_optimum(fn, extra):
+    """Matches scipy's BFGS answer (x*=1, f*=0) on the banana function."""
+    x0 = paddle.to_tensor(np.zeros(6, np.float32))
+    out = fn(rosen, x0, max_iters=200, **extra)
+    pos, fval = np.asarray(out[2]._value), float(out[3])
+    assert fval < 1e-6, fval
+    np.testing.assert_allclose(pos, np.ones(6), atol=1e-2)
+    assert int(out[1]) > 0              # func-call counter advanced
+
+
+@pytest.mark.parametrize("fn,extra", [(minimize_bfgs, {}),
+                                      (minimize_lbfgs, {"history_size": 4})])
+def test_quadratic_converges_flag(fn, extra):
+    """On a benign quadratic the inf-norm grad tolerance is reachable in
+    fp32 and is_converge reports it."""
+    x0 = paddle.to_tensor(np.zeros(3, np.float32))
+    out = fn(quadratic, x0, max_iters=100, tolerance_grad=1e-3)
+    assert bool(out[0]), "did not report convergence"
+    np.testing.assert_allclose(np.asarray(out[2]._value), 2 * np.ones(3),
+                               atol=1e-3)
+
+
+def test_bfgs_returns_inverse_hessian_estimate():
+    """BFGS's 6th output approximates the true inverse Hessian: for
+    f = sum(a*(x-b)^2), H^-1 = diag(1/(2a))."""
+    x0 = paddle.to_tensor(np.zeros(3, np.float32))
+    out = minimize_bfgs(quadratic, x0, max_iters=100)
+    H = np.asarray(out[5]._value)
+    assert H.shape == (3, 3)
+    np.testing.assert_allclose(np.diag(H), [0.5, 0.05, 0.005], rtol=0.3)
+
+
+def test_initial_inverse_hessian_and_custom_start():
+    x0 = paddle.to_tensor(np.array([3.0, -1.0, 0.5], np.float32))
+    out = minimize_bfgs(quadratic, x0, max_iters=100,
+                        initial_inverse_hessian_estimate=paddle.to_tensor(
+                            np.eye(3, dtype=np.float32) * 0.1))
+    np.testing.assert_allclose(np.asarray(out[2]._value), 2 * np.ones(3),
+                               atol=1e-3)
+
+
+def test_lbfgs_tiny_history_still_converges():
+    x0 = paddle.to_tensor(np.zeros(4, np.float32))
+    out = minimize_lbfgs(rosen, x0, history_size=2, max_iters=300)
+    assert float(out[3]) < 1e-4
+
+
+def test_unsupported_line_search_raises():
+    with pytest.raises(NotImplementedError):
+        minimize_bfgs(rosen, paddle.to_tensor(np.zeros(2, np.float32)),
+                      line_search_fn="hager_zhang")
