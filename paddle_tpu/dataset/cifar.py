@@ -1,29 +1,76 @@
-"""reference python/paddle/dataset/cifar.py reader API (synthetic)."""
+"""CIFAR readers — reference python/paddle/dataset/cifar.py.
+
+Parses the REAL cifar-python archive format (a tar/tar.gz of pickled
+batch dicts: `data` [N, 3072] uint8 rows, `labels`/`fine_labels`) when
+pointed at a local file via `data_file=`; zero-egress means no download,
+so without a path the readers fall back to labeled synthetic samples with
+the same shapes/ranges. Samples match the reference contract: float32
+pixels in [0, 1] (3072-vector), int label.
+"""
+import pickle
+import tarfile
+
 import numpy as np
 
-__all__ = ["train10", "test10", "train100", "test100"]
+__all__ = ["train10", "test10", "train100", "test100", "reader_creator"]
 
 
-def _reader(n, classes, seed):
+def reader_creator(data_file, sub_name, cycle=False):
+    """Yield (pixels [3072] float32 in [0,1], int label) from every
+    archive member whose name contains `sub_name` (reference
+    cifar.py:reader_creator — 'data_batch', 'test_batch', 'train',
+    'test')."""
+
+    def read_batch(batch):
+        data = batch[b"data"]
+        labels = batch.get(b"labels", batch.get(b"fine_labels"))
+        assert labels is not None, "batch has neither labels nor fine_labels"
+        for sample, label in zip(data, labels):
+            yield sample.astype("float32") / 255.0, int(label)
+
+    def reader():
+        while True:
+            with tarfile.open(data_file, mode="r") as f:
+                names = sorted(n for n in f.getnames() if sub_name in n)
+                if not names:
+                    raise ValueError(
+                        f"no member matching {sub_name!r} in {data_file}")
+                for name in names:
+                    batch = pickle.loads(f.extractfile(name).read(),
+                                         encoding="bytes")
+                    yield from read_batch(batch)
+            if not cycle:
+                break
+    return reader
+
+
+def _synthetic(n, classes, seed):
     def read():
         rng = np.random.RandomState(seed)
         for _ in range(n):
-            img = rng.rand(3072).astype("float32")
-            yield img, int(rng.randint(0, classes))
+            yield rng.rand(3072).astype("float32"), int(rng.randint(0, classes))
     return read
 
 
-def train10(n=1024):
-    return _reader(n, 10, 0)
+def train10(n=1024, data_file=None, cycle=False):
+    if data_file:
+        return reader_creator(data_file, "data_batch", cycle)
+    return _synthetic(n, 10, 0)
 
 
-def test10(n=256):
-    return _reader(n, 10, 1)
+def test10(n=256, data_file=None, cycle=False):
+    if data_file:
+        return reader_creator(data_file, "test_batch", cycle)
+    return _synthetic(n, 10, 1)
 
 
-def train100(n=1024):
-    return _reader(n, 100, 2)
+def train100(n=1024, data_file=None, cycle=False):
+    if data_file:
+        return reader_creator(data_file, "train", cycle)
+    return _synthetic(n, 100, 2)
 
 
-def test100(n=256):
-    return _reader(n, 100, 3)
+def test100(n=256, data_file=None, cycle=False):
+    if data_file:
+        return reader_creator(data_file, "test", cycle)
+    return _synthetic(n, 100, 3)

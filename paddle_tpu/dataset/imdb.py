@@ -1,28 +1,97 @@
-"""reference python/paddle/dataset/imdb.py reader API (synthetic)."""
+"""IMDB sentiment readers — reference python/paddle/dataset/imdb.py.
+
+Parses the REAL aclImdb archive layout (tar/tar.gz with
+aclImdb/{train,test}/{pos,neg}/*.txt review files) when given a local
+`data_file=`; builds the frequency-sorted word dict from the training
+corpus like the reference (tokenize: lowercase, strip punctuation,
+whitespace split). Synthetic fallback otherwise (zero egress). Samples:
+(word-id list, label) with label 0=negative, 1=positive.
+"""
+import re
+import string
+import tarfile
+
 import numpy as np
 
-__all__ = ["train", "test", "word_dict"]
+__all__ = ["train", "test", "word_dict", "build_dict", "tokenize"]
 
-_VOCAB = 5149  # reference imdb vocab size ballpark
+_VOCAB = 5149  # synthetic fallback vocab size (reference ballpark)
 
 
-def word_dict():
+def tokenize(text):
+    """Reference imdb.py tokenize: drop punctuation, lowercase, split."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", errors="replace")
+    return text.translate(
+        str.maketrans("", "", string.punctuation)).lower().split()
+
+
+def _corpus(data_file, pattern):
+    rx = re.compile(pattern)
+    with tarfile.open(data_file, mode="r") as f:
+        for name in sorted(f.getnames()):
+            if rx.match(name):
+                yield tokenize(f.extractfile(name).read())
+
+
+def build_dict(data_file, pattern=r"aclImdb/train/(pos|neg)/.*\.txt$",
+               cutoff=0):
+    """Frequency-sorted {word: id} from the training corpus (reference
+    imdb.py:build_dict); id len(dict) is reserved for OOV ('<unk>')."""
+    freq = {}
+    for words in _corpus(data_file, pattern):
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    items = sorted(((c, w) for w, c in freq.items() if c > cutoff),
+                   key=lambda cw: (-cw[0], cw[1]))
+    word_idx = {w: i for i, (_, w) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)   # reference reserves the last id
+    return word_idx
+
+
+def _real_reader(data_file, word_idx, split):
+    unk = word_idx.get("<unk>", len(word_idx))
+    neg = re.compile(rf"aclImdb/{split}/neg/.*\.txt$")
+    pos = re.compile(rf"aclImdb/{split}/pos/.*\.txt$")
+
+    def read():
+        # ONE tar traversal for both classes (gzip tars re-scan slowly)
+        with tarfile.open(data_file, mode="r") as f:
+            for name in sorted(f.getnames()):
+                label = 1 if pos.match(name) else (0 if neg.match(name)
+                                                   else None)
+                if label is None:
+                    continue
+                words = tokenize(f.extractfile(name).read())
+                yield [word_idx.get(w, unk) for w in words], label
+    return read
+
+
+def word_dict(data_file=None):
+    if data_file:
+        return build_dict(data_file)
     return {f"w{i}": i for i in range(_VOCAB)}
 
 
-def _reader(n, seed):
+def _synthetic(n, seed):
     def read():
         rng = np.random.RandomState(seed)
         for _ in range(n):
             length = int(rng.randint(8, 64))
-            ids = rng.randint(0, _VOCAB, (length,)).tolist()
-            yield ids, int(rng.randint(0, 2))
+            yield rng.randint(0, _VOCAB, (length,)).tolist(), \
+                int(rng.randint(0, 2))
     return read
 
 
-def train(word_idx=None, n=512):
-    return _reader(n, 0)
+def train(word_idx=None, n=512, data_file=None):
+    if data_file:
+        return _real_reader(data_file, word_idx or build_dict(data_file),
+                            "train")
+    return _synthetic(n, 0)
 
 
-def test(word_idx=None, n=128):
-    return _reader(n, 1)
+def test(word_idx=None, n=128, data_file=None):
+    if data_file:
+        return _real_reader(data_file, word_idx or build_dict(data_file),
+                            "test")
+    return _synthetic(n, 1)

@@ -1,21 +1,59 @@
-"""reference python/paddle/dataset/mnist.py reader API (synthetic)."""
+"""MNIST readers — reference python/paddle/dataset/mnist.py.
+
+Parses the REAL gzipped IDX format (big-endian: images magic 2051 with
+[n, rows, cols], labels magic 2049) when given local `image_path`/
+`label_path`; synthetic fallback otherwise (zero egress). Samples match
+the reference contract: float32 pixels normalized to [-1, 1]
+(784-vector), int label.
+"""
+import gzip
+import struct
+
 import numpy as np
 
-__all__ = ["train", "test"]
+__all__ = ["train", "test", "reader_creator"]
 
 
-def _reader(n, seed):
+def reader_creator(image_path, label_path):
+    def reader():
+        with gzip.GzipFile(image_path, "rb") as f:
+            img_buf = f.read()
+        with gzip.GzipFile(label_path, "rb") as f:
+            lab_buf = f.read()
+        magic_img, n_img, rows, cols = struct.unpack_from(">IIII", img_buf, 0)
+        magic_lab, n_lab = struct.unpack_from(">II", lab_buf, 0)
+        if magic_img != 2051 or magic_lab != 2049:
+            raise ValueError(
+                f"not IDX files: image magic {magic_img} (want 2051), "
+                f"label magic {magic_lab} (want 2049)")
+        if n_img != n_lab:
+            raise ValueError(f"{n_img} images vs {n_lab} labels")
+        images = np.frombuffer(img_buf, np.uint8, n_img * rows * cols,
+                               struct.calcsize(">IIII"))
+        images = images.reshape(n_img, rows * cols).astype("float32")
+        images = images / 255.0 * 2.0 - 1.0       # reference [-1, 1] range
+        labels = np.frombuffer(lab_buf, np.uint8, n_lab,
+                               struct.calcsize(">II"))
+        for i in range(n_img):
+            yield images[i], int(labels[i])
+    return reader
+
+
+def _synthetic(n, seed):
     def read():
         rng = np.random.RandomState(seed)
         for _ in range(n):
-            img = rng.rand(784).astype("float32") * 2 - 1
-            yield img, int(rng.randint(0, 10))
+            yield rng.rand(784).astype("float32") * 2 - 1, int(rng.randint(0, 10))
     return read
 
 
-def train(n=1024):
-    return _reader(n, 0)
+def train(n=1024, image_path=None, label_path=None):
+    if image_path and label_path:
+        return reader_creator(image_path, label_path)
+    return _synthetic(n, 0)
 
 
-def test(n=256):
-    return _reader(n, 1)
+def test(n=256, image_path=None, label_path=None):
+    if image_path and label_path:
+        return reader_creator(image_path, label_path)
+    return _synthetic(n, 1)

@@ -1,5 +1,12 @@
-"""reference python/paddle/dataset/uci_housing.py reader API (synthetic
-13-feature regression with a fixed linear ground truth + noise)."""
+"""UCI housing readers — reference python/paddle/dataset/uci_housing.py.
+
+Parses the REAL housing.data format (whitespace-separated table, 13
+features + MEDV target per row) from a local `data_file=`, with the
+reference's feature normalization: (x - mean) scaled by the max-min
+range, computed over the whole table, then an 80/20 train/test split
+(reference uci_housing.py: load_data ratio=0.8). Synthetic linear
+regression fallback otherwise (zero egress).
+"""
 import numpy as np
 
 __all__ = ["train", "test", "feature_names"]
@@ -10,7 +17,31 @@ feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
 _W = np.linspace(-1.0, 1.0, 13).astype("float32")
 
 
-def _reader(n, seed):
+def _load(data_file):
+    data = np.loadtxt(data_file).astype("float32")
+    if data.ndim == 1:
+        data = data.reshape(-1, 14)
+    if data.shape[1] != 14:
+        raise ValueError(
+            f"housing.data rows must have 14 columns, got {data.shape[1]}")
+    feats = data[:, :13]
+    # reference normalization: (x - mean) / (max - min) per feature
+    span = feats.max(0) - feats.min(0)
+    feats = (feats - feats.mean(0)) / np.where(span == 0, 1.0, span)
+    return feats, data[:, 13:14]
+
+
+def _real_reader(data_file, is_train, ratio=0.8):
+    def read():
+        feats, target = _load(data_file)
+        split = int(len(feats) * ratio)
+        sl = slice(0, split) if is_train else slice(split, None)
+        for x, y in zip(feats[sl], target[sl]):
+            yield x, y
+    return read
+
+
+def _synthetic(n, seed):
     def read():
         rng = np.random.RandomState(seed)
         for _ in range(n):
@@ -20,9 +51,13 @@ def _reader(n, seed):
     return read
 
 
-def train(n=404):
-    return _reader(n, 0)
+def train(n=404, data_file=None):
+    if data_file:
+        return _real_reader(data_file, True)
+    return _synthetic(n, 0)
 
 
-def test(n=102):
-    return _reader(n, 1)
+def test(n=102, data_file=None):
+    if data_file:
+        return _real_reader(data_file, False)
+    return _synthetic(n, 1)

@@ -122,13 +122,15 @@ def _strong_wolfe(f_and_grad, x, d, f0, g0, alpha0, max_iters,
     lo, hi, f_lo, dg_lo, it2, calls, done, a_s, f_s, g_s = \
         jax.lax.while_loop(zoom_cond, zoom_body, zoom_init)
     # if nothing satisfied strong Wolfe, re-evaluate at the best point so
-    # (f, g) are consistent with a_s
-    f_fb, g_fb, _ = phi(a_s)
-    take_fb = ~done
-    return (a_s,
-            jnp.where(take_fb, f_fb, f_s),
-            jnp.where(take_fb, g_fb, g_s),
-            calls + 1)
+    # (f, g) are consistent with a_s (g_s can be stale when the zoom
+    # exhausts its budget); skipped entirely on the success path
+    def fallback(_):
+        f_fb, g_fb, _dg = phi(a_s)
+        return f_fb, g_fb, calls + 1
+
+    f_s, g_s, calls = jax.lax.cond(
+        done, lambda _: (f_s, g_s, calls), fallback, None)
+    return a_s, f_s, g_s, calls
 
 
 def _prep(objective_func, initial_position, dtype):

@@ -31,9 +31,25 @@ class _LocalCorpus(Dataset):
 
 
 class Imdb(_LocalCorpus):
+    """IMDB sentiment (reference text/datasets/imdb.py). A real aclImdb
+    tarball given as data_file is parsed by dataset/imdb.py (tokenize +
+    frequency word dict); .npz and synthetic fallbacks otherwise."""
+
     def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
         if download and data_file is None:
             raise NotImplementedError("zero-egress: pass local data_file")
+        import tarfile
+        if data_file and os.path.exists(data_file) \
+                and tarfile.is_tarfile(data_file):
+            from ..dataset import imdb as imdb_reader
+            self.word_idx = imdb_reader.build_dict(data_file, cutoff=cutoff)
+            reader = (imdb_reader.train if mode == "train"
+                      else imdb_reader.test)(word_idx=self.word_idx,
+                                             data_file=data_file)
+            pairs = list(reader())
+            self.data = [np.asarray(ids, "int64") for ids, _ in pairs]
+            self.labels = np.asarray([lab for _, lab in pairs], "int64")
+            return
         super().__init__(data_file, mode)
 
 
