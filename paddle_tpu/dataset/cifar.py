@@ -30,15 +30,19 @@ def reader_creator(data_file, sub_name, cycle=False):
 
     def reader():
         while True:
+            matched = 0
+            # archive order: gzip tars re-inflate from 0 on backward seeks
             with tarfile.open(data_file, mode="r") as f:
-                names = sorted(n for n in f.getnames() if sub_name in n)
-                if not names:
-                    raise ValueError(
-                        f"no member matching {sub_name!r} in {data_file}")
-                for name in names:
-                    batch = pickle.loads(f.extractfile(name).read(),
+                for member in f:
+                    if sub_name not in member.name:
+                        continue
+                    matched += 1
+                    batch = pickle.loads(f.extractfile(member).read(),
                                          encoding="bytes")
                     yield from read_batch(batch)
+            if not matched:
+                raise ValueError(
+                    f"no member matching {sub_name!r} in {data_file}")
             if not cycle:
                 break
     return reader

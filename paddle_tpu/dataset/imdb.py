@@ -27,11 +27,13 @@ def tokenize(text):
 
 
 def _corpus(data_file, pattern):
+    # ARCHIVE order, not sorted: a gzip tar can only stream forward, and
+    # out-of-order extractfile() seeks re-inflate from byte 0 each time
     rx = re.compile(pattern)
     with tarfile.open(data_file, mode="r") as f:
-        for name in sorted(f.getnames()):
-            if rx.match(name):
-                yield tokenize(f.extractfile(name).read())
+        for member in f:
+            if rx.match(member.name):
+                yield tokenize(f.extractfile(member).read())
 
 
 def build_dict(data_file, pattern=r"aclImdb/train/(pos|neg)/.*\.txt$",
@@ -55,14 +57,16 @@ def _real_reader(data_file, word_idx, split):
     pos = re.compile(rf"aclImdb/{split}/pos/.*\.txt$")
 
     def read():
-        # ONE tar traversal for both classes (gzip tars re-scan slowly)
+        # ONE forward tar traversal for both classes, in archive order
+        # (gzip tars re-inflate from 0 on any backward seek)
         with tarfile.open(data_file, mode="r") as f:
-            for name in sorted(f.getnames()):
+            for member in f:
+                name = member.name
                 label = 1 if pos.match(name) else (0 if neg.match(name)
                                                    else None)
                 if label is None:
                     continue
-                words = tokenize(f.extractfile(name).read())
+                words = tokenize(f.extractfile(member).read())
                 yield [word_idx.get(w, unk) for w in words], label
     return read
 

@@ -101,7 +101,12 @@ class _QuantizedWrapper(Layer):
         super().__init__()
         self._inner = layer
         if weight_quantize_type == "channel_wise_abs_max":
-            self._fake_quant_weight = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits)
+            # per-OUTPUT-channel grid: out channels live on the LAST axis
+            # of both Linear [in, out] and conv [..., in, out] weights —
+            # must match quantize_weight(axis=0)'s per-out export grid
+            self._fake_quant_weight = FakeQuantChannelWiseAbsMax(
+                quant_bits=weight_bits,
+                quant_axis=layer.weight._value.ndim - 1)
         else:
             self._fake_quant_weight = FakeQuantAbsMax(quant_bits=weight_bits, quant_on_weight=True)
         self._fake_quant_input = FakeQuantMovingAverageAbsMax(

@@ -13,7 +13,7 @@ from .nn import Linear
 from .nn.layer_base import Layer
 
 __all__ = ["quantize_weight", "dequantize_weight", "QuantizedLinear",
-           "quantize_model", "QuantizedLinearA8W8", "PTQ"]
+           "quantize_model", "QuantizedLinearA8W8", "PTQ", "QAT"]
 
 
 def quantize_weight(w, axis=0):
@@ -53,13 +53,27 @@ class QuantizedLinear(Layer):
         return apply_op(_f, *args)
 
 
+def _swap_sublayers(layer, visit, prefix=""):
+    """Shared sublayer-swap traversal (quantize_model, PTQ.convert,
+    QAT.quantize/convert all walk the same way). `visit(full_name, sub)`
+    returns a replacement layer, False to skip recursing into `sub`, or
+    None to recurse."""
+    for name, sub in list(layer._sub_layers.items()):
+        full = f"{prefix}{name}"
+        r = visit(full, sub)
+        if r is False:
+            continue
+        if r is not None:
+            layer._sub_layers[name] = r
+        else:
+            _swap_sublayers(sub, visit, f"{full}.")
+
+
 def quantize_model(model, min_out_features=64):
     """Replace every Linear (≥ min_out_features) with QuantizedLinear."""
-    for name, sub in list(model._sub_layers.items()):
-        if isinstance(sub, Linear) and sub._out_features >= min_out_features:
-            model._sub_layers[name] = QuantizedLinear(sub)
-        else:
-            quantize_model(sub, min_out_features)
+    _swap_sublayers(model, lambda full, sub: QuantizedLinear(sub)
+                    if isinstance(sub, Linear)
+                    and sub._out_features >= min_out_features else None)
     return model
 
 
@@ -151,14 +165,97 @@ class PTQ:
                 "the calibration forwards run eagerly, not under jit?); "
                 "returning the model UNQUANTIZED", RuntimeWarning)
 
-        def swap(layer, prefix=""):
-            for name, sub in list(layer._sub_layers.items()):
-                full = f"{prefix}{name}"
-                if isinstance(sub, Linear) and full in self._amax \
-                        and self._amax[full] > 0:
-                    scale = max(self._amax[full] / 127.0, 1e-8)
-                    layer._sub_layers[name] = QuantizedLinearA8W8(sub, scale)
-                else:
-                    swap(sub, f"{full}.")
-        swap(self.model)
+        def visit(full, sub):
+            if isinstance(sub, Linear) and self._amax.get(full, 0) > 0:
+                return QuantizedLinearA8W8(
+                    sub, max(self._amax[full] / 127.0, 1e-8))
+            return None
+        _swap_sublayers(self.model, visit)
         return self.model
+
+
+# ---------------------------------------------------------------------------
+# Quantization-aware training — reference
+# python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+# (QuantizationTransformPass inserts fake-quant ops into the graph) and
+# imperative/qat.py (ImperativeQuantAware). TPU-native: fake-quant
+# LAYERS (nn/quant) wrap each Linear so the straight-through estimator
+# trains THROUGH the int8 grid inside the normal jit-compiled step —
+# no separate graph pass; XLA still sees dense fp matmuls during
+# training, and convert() exports the learned scales to real int8.
+# ---------------------------------------------------------------------------
+
+
+class QAT:
+    """Quantization-aware training driver.
+
+        qat = QAT()                 # weight_bits=8, activation_bits=8
+        qat.quantize(model)         # Linears -> fake-quant wrappers
+        ... train as usual ...      # STE learns int8-friendly weights
+        qat.convert(model)          # wrappers -> int8 A8W8 execution
+
+    quantize() wraps every Linear (>= min_out_features) in
+    nn.quant.QuantizedLinear: the weight is fake-quantized per forward
+    (abs-max) and the input through a trained moving-average abs-max
+    observer. convert() swaps each wrapper for QuantizedLinearA8W8,
+    carrying the OBSERVED activation scale (EMA buffer / 127) and the
+    trained weights — so the deployed int8 model computes with the same
+    grid the training loop optimized against.
+    """
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 min_out_features=16,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        if weight_bits != 8 or activation_bits != 8:
+            # the int8 execution path (QuantizedLinearA8W8) is the only
+            # deployment grid; exporting a differently-trained grid would
+            # silently break the trained==deployed guarantee
+            raise NotImplementedError(
+                "QAT export currently targets int8 only: weight_bits and "
+                f"activation_bits must be 8, got {weight_bits}/"
+                f"{activation_bits}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.min_out = min_out_features
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+
+    def quantize(self, model):
+        from .nn.quant import QuantizedLinear as FakeQuantLinear
+
+        def visit(full, sub):
+            if isinstance(sub, FakeQuantLinear):
+                return False            # idempotent: never double-wrap
+            if isinstance(sub, Linear) and \
+                    sub._out_features >= self.min_out:
+                return FakeQuantLinear(
+                    sub, weight_bits=self.weight_bits,
+                    activation_bits=self.activation_bits,
+                    moving_rate=self.moving_rate,
+                    weight_quantize_type=self.weight_quantize_type,
+                    activation_quantize_type=self.activation_quantize_type)
+            return None
+        _swap_sublayers(model, visit)
+        return model
+
+    def convert(self, model):
+        import warnings
+
+        from .nn.quant import QuantizedLinear as FakeQuantLinear
+
+        def visit(full, sub):
+            if not isinstance(sub, FakeQuantLinear):
+                return None
+            obs = sub._fake_quant_input
+            if int(obs.seen._value) == 0:
+                warnings.warn(
+                    f"QAT.convert(): {full} never observed an activation "
+                    "(no train-mode forward ran); exporting with the "
+                    "uninitialized scale 1.0 will saturate inputs |x|>1",
+                    RuntimeWarning)
+            act_scale = max(float(obs.scale._value) / 127.0, 1e-8)
+            return QuantizedLinearA8W8(sub._inner, act_scale)
+        _swap_sublayers(model, visit)
+        return model

@@ -1,0 +1,163 @@
+"""End-to-end quantization-aware training — reference
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass) / imperative qat: train with fake-quant,
+export int8, compare against PTQ on the same model."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import QuantizedLinear as FakeQuantLinear
+from paddle_tpu.quantization import PTQ, QAT, QuantizedLinearA8W8
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 16).astype("float32")
+    w = rng.randn(16, 4).astype("float32")
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, 4), axis=1).astype("int64")
+    return x, y
+
+
+class MLP(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(16, 32)
+        self.act = paddle.nn.ReLU()
+        self.fc2 = paddle.nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _train(model, x, y, steps, lr=0.05):
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    for _ in range(steps):
+        loss = paddle.nn.functional.cross_entropy(
+            model(paddle.to_tensor(x)), paddle.to_tensor(y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss)
+
+
+def _acc(model, x, y):
+    model.eval()
+    logits = model(paddle.to_tensor(x)).numpy()
+    return float((np.argmax(logits, -1) == y).mean())
+
+
+def test_qat_end_to_end_vs_ptq():
+    x, y = _data()
+    paddle.seed(0)
+    base = MLP()
+    _train(base, x, y, 60)
+    fp_acc = _acc(base, x, y)
+    state = {k: v.numpy().copy() for k, v in base.state_dict().items()}
+
+    # --- PTQ branch: calibrate + convert -----------------------------
+    paddle.seed(0)
+    ptq_model = MLP()
+    ptq_model.set_state_dict({k: paddle.to_tensor(v)
+                              for k, v in state.items()})
+    ptq = PTQ(ptq_model)
+    ptq_model.eval()
+    ptq_model(paddle.to_tensor(x))        # calibration pass
+    ptq_model = ptq.convert()
+    assert isinstance(ptq_model.fc1, QuantizedLinearA8W8)
+    ptq_acc = _acc(ptq_model, x, y)
+
+    # --- QAT branch: wrap, fine-tune THROUGH fake quant, convert -----
+    paddle.seed(0)
+    qat_model = MLP()
+    qat_model.set_state_dict({k: paddle.to_tensor(v)
+                              for k, v in state.items()})
+    qat = QAT(min_out_features=4)
+    qat.quantize(qat_model)
+    assert isinstance(qat_model.fc1, FakeQuantLinear)
+    w_before = qat_model.fc1._inner.weight.numpy().copy()
+    qat_model.train()
+    _train(qat_model, x, y, 30, lr=0.01)
+    w_after = qat_model.fc1._inner.weight.numpy()
+    # the straight-through estimator actually updates the fp weights
+    assert not np.allclose(w_before, w_after)
+
+    qat_model.eval()
+    fake_logits = qat_model(paddle.to_tensor(x)).numpy()
+    qat.convert(qat_model)
+    assert isinstance(qat_model.fc1, QuantizedLinearA8W8)
+    assert isinstance(qat_model.fc2, QuantizedLinearA8W8)
+    int8_logits = qat_model(paddle.to_tensor(x)).numpy()
+    # exported int8 model computes on the same grid training optimized:
+    # logits track the fake-quant forward closely
+    err = np.abs(int8_logits - fake_logits).mean()
+    span = np.abs(fake_logits).mean()
+    assert err < 0.1 * span, (err, span)
+
+    qat_acc = _acc(qat_model, x, y)
+    # int8 QAT holds accuracy: no worse than PTQ (it trained against the
+    # quantization grid) and close to the fp32 model
+    assert qat_acc >= ptq_acc - 0.02, (qat_acc, ptq_acc)
+    assert qat_acc >= fp_acc - 0.05, (qat_acc, fp_acc)
+
+
+def test_qat_observer_learns_activation_scale():
+    """The moving-average observer's EMA buffer converges toward the
+    activation abs-max during training and is carried into convert()."""
+    x, y = _data(128, seed=3)
+    paddle.seed(1)
+    m = MLP()
+    qat = QAT(min_out_features=4, moving_rate=0.5)
+    qat.quantize(m)
+    m.train()
+    _train(m, x, y, 10, lr=0.01)
+    observed = float(m.fc1._fake_quant_input.scale._value)
+    true_amax = float(np.abs(x).max())
+    assert 0.2 * true_amax < observed < 2.0 * true_amax
+    qat.convert(m)
+    np.testing.assert_allclose(float(m.fc1.act_scale._value),
+                               max(observed / 127.0, 1e-8), rtol=1e-6)
+
+
+def test_qat_channel_wise_trains_on_export_grid():
+    """channel_wise fake-quant must use the per-OUTPUT-channel axis so
+    the training grid equals the exported int8 grid."""
+    x, y = _data(128, seed=5)
+    paddle.seed(2)
+    m = MLP()
+    qat = QAT(min_out_features=4,
+              weight_quantize_type="channel_wise_abs_max")
+    qat.quantize(m)
+    assert m.fc1._fake_quant_weight._quant_axis == 1   # [in, out] -> out
+    m.train()
+    _train(m, x, y, 15, lr=0.01)
+    m.eval()
+    fake = m(paddle.to_tensor(x)).numpy()
+    qat.convert(m)
+    int8 = m(paddle.to_tensor(x)).numpy()
+    err = np.abs(int8 - fake).mean()
+    assert err < 0.1 * np.abs(fake).mean(), err
+
+
+def test_qat_is_idempotent_and_guards_bits():
+    paddle.seed(0)
+    m = MLP()
+    qat = QAT(min_out_features=4)
+    qat.quantize(m)
+    inner = m.fc1._inner
+    qat.quantize(m)                     # second call must not re-wrap
+    assert m.fc1._inner is inner
+    with pytest.raises(NotImplementedError, match="int8 only"):
+        QAT(activation_bits=4)
+    # convert before any training forward warns about the dead observer
+    with pytest.warns(RuntimeWarning, match="never observed"):
+        qat.convert(m)
+
+
+def test_qat_respects_min_out_features():
+    paddle.seed(0)
+    m = MLP()
+    QAT(min_out_features=10).quantize(m)
+    assert isinstance(m.fc1, FakeQuantLinear)     # out=32 wrapped
+    assert isinstance(m.fc2, paddle.nn.Linear)    # out=4 skipped
+    assert not isinstance(m.fc2, FakeQuantLinear)
