@@ -663,10 +663,18 @@ class ContinuousBatchingEngine:
                 self._retire(s)
         return len(active)
 
-    def run(self):
-        """Drain the queue; returns {request_id: generated token list}."""
+    def run(self, step_times=None):
+        """Drain the queue; returns {request_id: generated token list}.
+        `step_times`, if given, receives each step's wall seconds (the
+        public hook benches use for per-token latency percentiles)."""
+        import time as _time
         while self._queue or any(r is not None for r in self._slot_req):
-            self.step()
+            if step_times is None:
+                self.step()
+            else:
+                t0 = _time.perf_counter()
+                self.step()
+                step_times.append(_time.perf_counter() - t0)
         return dict(self._outputs)
 
 

@@ -329,3 +329,22 @@ def test_predictor_clear_error_without_program(tmp_path):
     from paddle_tpu.inference import Config, create_predictor
     with pytest.raises(RuntimeError, match="input_spec"):
         create_predictor(Config(prog_file=path + ".pdmodel"))
+
+
+def test_decode_roofline_math():
+    """bench.decode_roofline_tok_s: explicit bytes-per-step model."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from paddle_tpu.models import gpt_tiny
+    cfg = gpt_tiny()
+    bw = bench.chip_hbm_bw()
+    batch, ctx = 4, 100
+    got = bench.decode_roofline_tok_s(cfg, batch, ctx)
+    w = cfg.num_params() * 2
+    kv = batch * cfg.num_layers * 2 * ctx * cfg.hidden_size * 2
+    assert abs(got - bw * batch / (w + kv)) < 1e-6
+    # int8 weights halve the weight traffic -> higher ceiling
+    assert bench.decode_roofline_tok_s(cfg, batch, ctx, quant="a8w8") > got
