@@ -19,7 +19,8 @@ import subprocess
 import sys
 import time
 
-__all__ = ["ElasticManager", "enable_elastic", "launch_elastic"]
+__all__ = ["ElasticManager", "enable_elastic", "launch_elastic",
+           "launch_elastic_node", "launch_elastic_multihost"]
 
 
 class ElasticManager:
@@ -135,3 +136,149 @@ def launch_elastic(training_script, script_args=(), nproc_per_node=1,
         if verbose:
             print(f"[elastic] {reason}; restart {restarts}/{max_restarts}",
                   file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Cross-host elastic (reference fleet/elastic/manager.py: per-host agents
+# registered in etcd watch for peer failure and restart the job together).
+# The shared-filesystem coord_dir stands in for etcd: it carries the job
+# EPOCH (bumped by whichever node watches its group die) and the jax
+# coordinator address per epoch. JAX collectives cannot heal around a lost
+# process, so any node failure means a whole-job restart on every node —
+# each node's supervisor notices the epoch moved, kills its local group,
+# and relaunches; workers resume from the shared checkpoint.
+# ---------------------------------------------------------------------------
+
+
+def _coordinator_addr(host=None):
+    """Routable coordinator address for THIS machine: peers on other
+    hosts must be able to reach it (loopback would only ever work in the
+    single-machine simulation)."""
+    import socket
+
+    from .launch import _free_port
+    if host is None:
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
+    return f"{host}:{_free_port()}"
+
+
+def _read_epoch(coord_dir):
+    try:
+        with open(os.path.join(coord_dir, "epoch")) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _bump_epoch(coord_dir, seen_epoch, reason):
+    """Advance the job epoch from the one we observed. Concurrent bumps
+    from the same epoch both write seen+1 — idempotent by construction."""
+    path = os.path.join(coord_dir, "epoch")
+    tmp = f"{path}.tmp.{os.getpid()}.{seen_epoch}"
+    with open(tmp, "w") as f:
+        f.write(str(seen_epoch + 1))
+    os.replace(tmp, path)
+    with open(os.path.join(coord_dir, f"reason.e{seen_epoch + 1}"), "w") as f:
+        f.write(reason)
+
+
+def launch_elastic_node(node_rank, nnodes, training_script, script_args=(),
+                        coord_dir=None, nproc_per_node=1,
+                        cpu_devices_per_rank=0, max_restarts=3,
+                        log_dir=None, job_id="elastic", env=None,
+                        poll_s=0.2, publish_timeout_s=600,
+                        coordinator_host=None):
+    """ONE host's supervisor in a cross-host elastic job; run one per
+    machine against a shared coord_dir (NFS/etcd-mount). Node 0 publishes
+    the jax coordinator address for each epoch; every node launches its
+    slice of the job via distributed.launch (--nnodes/--rank/--master),
+    watches for local group death (bump the epoch) and for the epoch
+    moving (a peer died: kill local group, relaunch)."""
+    if coord_dir is None:
+        raise ValueError("coord_dir (shared across nodes) is required")
+    os.makedirs(coord_dir, exist_ok=True)
+    restarts = 0
+    reason = None
+    while True:
+        epoch = _read_epoch(coord_dir)
+        addr_path = os.path.join(coord_dir, f"master.e{epoch}")
+        if node_rank == 0 and not os.path.exists(addr_path):
+            tmp = addr_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(_coordinator_addr(coordinator_host))
+            os.replace(tmp, addr_path)
+        deadline = time.time() + publish_timeout_s
+        while not os.path.exists(addr_path):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"node {node_rank}: coordinator address for epoch "
+                    f"{epoch} never published")
+            time.sleep(poll_s)
+        with open(addr_path) as f:
+            master = f.read().strip()
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", str(nnodes), "--rank", str(node_rank),
+               "--master", master,
+               "--nproc_per_node", str(nproc_per_node),
+               "--job_id", f"{job_id}.n{node_rank}.e{epoch}"]
+        if cpu_devices_per_rank:
+            cmd += ["--cpu_devices_per_rank", str(cpu_devices_per_rank)]
+        if log_dir:
+            cmd += ["--log_dir", log_dir]
+        cmd += [training_script, *script_args]
+        proc = subprocess.Popen(cmd, env=env)
+        while True:
+            rc = proc.poll()
+            cur = _read_epoch(coord_dir)
+            if cur != epoch:
+                # a peer's group died: whole-job restart
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                reason = f"peer bumped epoch {epoch}->{cur}"
+                break
+            if rc is not None:
+                if rc == 0:
+                    return restarts
+                reason = f"node {node_rank} group exited rc={rc}"
+                _bump_epoch(coord_dir, epoch, reason)
+                break
+            time.sleep(poll_s)
+        restarts += 1
+        if restarts > max_restarts:
+            raise RuntimeError(
+                f"elastic node {node_rank} failed after {max_restarts} "
+                f"restarts (last: {reason})")
+
+
+def launch_elastic_multihost(training_script, script_args=(), nnodes=2,
+                             **node_kw):
+    """In-process harness over launch_elastic_node: one supervisor THREAD
+    per simulated host (production runs one launch_elastic_node per
+    machine). Returns the max restart count across nodes."""
+    import threading
+    results = {}
+
+    def run(rank):
+        try:
+            results[rank] = launch_elastic_node(
+                rank, nnodes, training_script, script_args, **node_kw)
+        except BaseException as e:   # surface to the caller's thread
+            results[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True)
+               for r in range(nnodes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for v in results.values():
+        if isinstance(v, BaseException):
+            raise v
+    return max(results.values())

@@ -99,13 +99,15 @@ class Config:
         self.prog_file = prog_file
         self.params_file = params_file
         self._model = None
+        self._ir_optim = True
+        self._memory_optim = False
 
     def set_model(self, layer: Layer):
         self._model = layer
         return self
 
-    # Device/IR knobs kept for API parity: XLA always runs its optimizing
-    # pipeline (there is no unoptimized execution mode to switch to)
+    # Device knobs kept for API parity (this framework targets TPU; XLA
+    # owns device placement)
     def enable_use_gpu(self, *a, **k):
         pass
 
@@ -113,10 +115,24 @@ class Config:
         pass
 
     def switch_ir_optim(self, flag=True):
-        pass
+        """Reference: toggle the IR optimization passes. TPU mapping:
+        ir_optim ON = the whole forward is one jit-compiled XLA program
+        (fused, scheduled); OFF = eager op-by-op execution — genuinely
+        unoptimized, for debugging numerics op-at-a-time."""
+        self._ir_optim = bool(flag)
 
-    def enable_memory_optim(self):
-        pass
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        """Reference: reuse variable memory across ops. TPU mapping:
+        donate the INPUT buffers to the compiled program
+        (donate_argnums), letting XLA reuse their HBM for activations/
+        outputs instead of holding inputs live across the run."""
+        self._memory_optim = bool(x)
+
+    def memory_optim_enabled(self):
+        return self._memory_optim
 
 
 class Predictor:
@@ -146,12 +162,20 @@ class Predictor:
         params = state_pytree(self.model)
         params.update(buffer_pytree(self.model))
         self._params = params
+        self._jitted = config._ir_optim
 
-        def pure(params, *args):
+        def pure(params, args):
             with functional_call(self.model, params):
                 out = self.model(*[Tensor(a) for a in args])
             return out._value if isinstance(out, Tensor) else out
-        self._fn = jax.jit(pure)
+        self._donate_inputs = config._ir_optim and config._memory_optim
+        if config._ir_optim:
+            # memory_optim donates the (per-call) input pytree so XLA
+            # reuses its HBM for activations; params stay live across runs
+            self._fn = jax.jit(
+                pure, donate_argnums=(1,) if config._memory_optim else ())
+        else:
+            self._fn = pure          # eager: no XLA program, op-by-op
 
     def run(self, inputs):
         arrs = [i._value if isinstance(i, Tensor) else np.asarray(i)
@@ -159,7 +183,15 @@ class Predictor:
         if self._translated is not None:
             out = self._translated(*arrs)
             return list(out) if isinstance(out, (list, tuple)) else [out]
-        out = self._fn(self._params, *arrs)
+        if getattr(self, "_donate_inputs", False):
+            # donation destroys the buffer: a caller-owned jax array
+            # (paddle Tensor input) must be copied, or their tensor dies
+            args = tuple(jax.numpy.array(a, copy=True)
+                         if isinstance(a, jax.Array)
+                         else jax.numpy.asarray(a) for a in arrs)
+        else:
+            args = tuple(jax.numpy.asarray(a) for a in arrs)
+        out = self._fn(self._params, args)
         return [Tensor(out)] if not isinstance(out, (list, tuple)) \
             else [Tensor(o) for o in out]
 

@@ -102,6 +102,74 @@ def test_hang_detected_by_heartbeat_timeout(tmp_path):
     assert time.time() - t0 < 120
 
 
+def test_multihost_kill_restarts_both_groups(tmp_path):
+    """2-host-simulated elastic (reference fleet/elastic/manager.py
+    cross-host fault watch): TWO launch groups (--nnodes 2, one process
+    each) under TWO per-host supervisors sharing a coord_dir. SIGKILLing
+    host 0's worker must restart BOTH groups, and training resumes from
+    the shared checkpoint with an identical trajectory."""
+    from paddle_tpu.distributed.elastic import launch_elastic_multihost
+
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN_SCRIPT)
+    workdir = str(tmp_path)
+    total_steps = 7
+    log_path = tmp_path / "log.jsonl"
+    coord = tmp_path / "coord"
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    killed = {}
+
+    def assassin():
+        deadline = time.time() + 480
+        while time.time() < deadline:
+            if log_path.exists():
+                steps = [json.loads(l)
+                         for l in log_path.read_text().splitlines()]
+                done = [e["step"] for e in steps if "step" in e]
+                if done and max(done) >= 5 and not killed:
+                    pid = int((tmp_path / "pid.0").read_text())
+                    os.kill(pid, signal.SIGKILL)
+                    killed["pid"] = pid
+                    return
+            time.sleep(0.1)
+
+    t = threading.Thread(target=assassin, daemon=True)
+    t.start()
+    restarts = launch_elastic_multihost(
+        str(script), [workdir, str(total_steps)], nnodes=2,
+        coord_dir=str(coord), nproc_per_node=1, cpu_devices_per_rank=2,
+        max_restarts=2, env=env, log_dir=str(tmp_path / "logs"))
+    t.join(timeout=5)
+
+    assert killed, "the assassin never fired"
+    assert restarts == 1, restarts
+    # the epoch moved exactly once, with the dead group's rc recorded
+    assert (coord / "reason.e1").exists()
+    assert "rc=" in (coord / "reason.e1").read_text()
+    assert not (coord / "reason.e2").exists()
+
+    entries = [json.loads(l) for l in log_path.read_text().splitlines()]
+    resumed = [e["resumed_from"] for e in entries if "resumed_from" in e]
+    assert resumed == [4], resumed
+    first_seen, duplicates = {}, 0
+    for e in entries:
+        if "step" not in e:
+            continue
+        s, l = e["step"], e["loss"]
+        if s in first_seen:
+            duplicates += 1
+            np.testing.assert_allclose(l, first_seen[s], rtol=1e-5,
+                                       err_msg=f"step {s} diverged")
+        else:
+            first_seen[s] = l
+    assert duplicates >= 1
+    assert set(first_seen) == set(range(1, total_steps + 1))
+
+
 def test_kill_and_resume_two_process(tmp_path):
     from paddle_tpu.distributed.elastic import launch_elastic
 

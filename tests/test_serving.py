@@ -348,3 +348,38 @@ def test_decode_roofline_math():
     assert abs(got - bw * batch / (w + kv)) < 1e-6
     # int8 weights halve the weight traffic -> higher ceiling
     assert bench.decode_roofline_tok_s(cfg, batch, ctx, quant="a8w8") > got
+
+
+def test_inference_config_toggles_map_to_real_choices():
+    """switch_ir_optim(False) -> eager op-by-op execution (no XLA
+    program); enable_memory_optim -> input-buffer donation. Same
+    numerics either way."""
+    import numpy as np
+    from paddle_tpu.inference import Config, create_predictor
+    paddle.seed(0)
+    m = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU())
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+
+    cfg = Config(); cfg.set_model(m)
+    jit_pred = create_predictor(cfg)
+    assert jit_pred._jitted
+    out_jit = jit_pred.run([x])[0].numpy()
+
+    cfg2 = Config(); cfg2.set_model(m)
+    cfg2.switch_ir_optim(False)
+    assert cfg2.ir_optim() is False
+    eager_pred = create_predictor(cfg2)
+    assert not eager_pred._jitted
+    np.testing.assert_allclose(eager_pred.run([x])[0].numpy(), out_jit,
+                               rtol=1e-6)
+
+    cfg3 = Config(); cfg3.set_model(m)
+    cfg3.enable_memory_optim()
+    assert cfg3.memory_optim_enabled()
+    don_pred = create_predictor(cfg3)
+    np.testing.assert_allclose(don_pred.run([x])[0].numpy(), out_jit,
+                               rtol=1e-6)
+    # donation must not destroy a caller-owned Tensor across repeat runs
+    t = paddle.to_tensor(x)
+    don_pred.run([t]); don_pred.run([t])
+    np.testing.assert_allclose(t.numpy(), x)
