@@ -54,9 +54,97 @@ class Imdb(_LocalCorpus):
 
 
 class Imikolov(_LocalCorpus):
-    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+    """PTB language-model corpus (reference text/datasets/imikolov.py).
+    A real simple-examples tarball given as data_file is parsed: the word
+    dict builds from ptb.train.txt + ptb.valid.txt with per-line <s>/<e>
+    counts, freq > min_word_freq, sorted (-freq, word), <unk> last;
+    NGRAM mode yields window tuples, SEQ mode ((<s>+ids), (ids+<e>))."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
                  mode="train", min_word_freq=50, download=False):
-        super().__init__(data_file, mode, dim=window_size)
+        import tarfile
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        if data_file and os.path.exists(data_file):
+            if not data_file.endswith(".npz"):
+                if not tarfile.is_tarfile(data_file):
+                    raise ValueError(
+                        f"{data_file!r} exists but is not a PTB "
+                        "simple-examples tarball (nor a legacy .npz) — "
+                        "refusing to silently train on synthetic data")
+                # ONE TarFile for dict build + load: gzip tars re-inflate
+                # from byte 0 on every fresh open
+                with tarfile.open(data_file) as tf:
+                    names = set(tf.getnames())
+                    self.word_idx = self._build_dict(tf, names,
+                                                     min_word_freq)
+                    self._load(tf, names)
+                return
+        self._synth_init(data_file, mode, window_size)
+
+    def _synth_init(self, data_file, mode, window_size):
+        super(Imikolov, self).__init__(
+            data_file, mode,
+            dim=max(2, window_size if window_size > 0 else 5))
+        self.word_idx = {f"w{i}": i for i in range(5000)}
+        self.data = [tuple(row) for row in self.data]
+
+    @staticmethod
+    def _member(tf, names, name):
+        for cand in (name, name[2:] if name.startswith("./") else "./" + name):
+            if cand in names:
+                return tf.extractfile(cand)
+        raise KeyError(name)
+
+    def _build_dict(self, tf, names, cutoff):
+        freq = {}
+        for split in ("train", "valid"):
+            f = self._member(
+                tf, names, f"./simple-examples/data/ptb.{split}.txt")
+            for line in f:
+                for w in line.decode().strip().split():
+                    freq[w] = freq.get(w, 0) + 1
+                freq["<s>"] = freq.get("<s>", 0) + 1
+                freq["<e>"] = freq.get("<e>", 0) + 1
+        freq.pop("<unk>", None)        # reference reserves the last id
+        items = sorted(((c, w) for w, c in freq.items() if c > cutoff),
+                       key=lambda cw: (-cw[0], cw[1]))
+        word_idx = {w: i for i, (_, w) in enumerate(items)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, tf, names):
+        self.data = []
+        unk = self.word_idx["<unk>"]
+        f = self._member(
+            tf, names, f"./simple-examples/data/ptb.{self.mode}.txt")
+        for line in f:
+            words = line.decode().strip().split()
+            if self.data_type == "NGRAM":
+                assert self.window_size > -1, "Invalid gram length"
+                toks = ["<s>"] + words + ["<e>"]
+                if len(toks) >= self.window_size:
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+            elif self.data_type == "SEQ":
+                ids = [self.word_idx.get(w, unk) for w in words]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk)]
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue
+                self.data.append((src, trg))
+            else:
+                raise ValueError(f"unknown data_type {self.data_type}")
+
+    def __getitem__(self, idx):
+        # reference: every element of the sample tuple as an np array
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
 
 
 class Conll05st(_LocalCorpus):
@@ -89,7 +177,79 @@ class WMT16(_LocalCorpus):
 
 
 class Movielens(_LocalCorpus):
-    pass
+    """ml-1m recsys corpus (reference text/datasets/movielens.py). A real
+    ml-1m zip given as data_file is parsed: movies.dat / users.dat /
+    ratings.dat ('::'-separated, latin-1), sample =
+    (uid, gender, age_idx, job, mov_id, category_ids, title_word_ids,
+    [rating*2-5]) with a seeded random train/test split."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        import zipfile
+        if data_file and os.path.exists(data_file):
+            if not data_file.endswith(".npz"):
+                if not zipfile.is_zipfile(data_file):
+                    raise ValueError(
+                        f"{data_file!r} exists but is not an ml-1m zip "
+                        "(nor a legacy .npz) — refusing to silently "
+                        "train on synthetic data")
+                self._load_real(data_file, mode.lower(), test_ratio,
+                                rand_seed)
+                return
+        super().__init__(data_file, mode)
+        self.data = [tuple(row) for row in self.data]
+
+    def _load_real(self, data_file, mode, test_ratio, rand_seed):
+        import re as _re
+        import zipfile
+        title_rx = _re.compile(r"(.*)\s*\(\d{4}\)\s*$")
+        movies, title_words, cats = {}, set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, categories = \
+                        line.decode("latin-1").strip().split("::")
+                    cat_list = categories.split("|")
+                    cats.update(cat_list)
+                    m = title_rx.match(title)
+                    title = (m.group(1) if m else title).strip()
+                    movies[int(mid)] = (cat_list, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.categories_dict = {c: i for i, c in enumerate(sorted(cats))}
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            users = {}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job = \
+                        line.decode("latin-1").strip().split("::")[:4]
+                    users[int(uid)] = (0 if gender == "M" else 1,
+                                       self.AGES.index(int(age)), int(job))
+            rng = np.random.RandomState(rand_seed)
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    uid, mid, rating = \
+                        line.decode("latin-1").strip().split("::")[:3]
+                    if (rng.random_sample() < test_ratio) != (mode == "test"):
+                        continue
+                    uid, mid = int(uid), int(mid)
+                    cat_list, title = movies[mid]
+                    g, a, j = users[uid]
+                    self.data.append((
+                        uid, g, a, j, mid,
+                        [self.categories_dict[c] for c in cat_list],
+                        [self.movie_title_dict[w.lower()]
+                         for w in title.split()],
+                        [float(rating) * 2 - 5.0]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -150,3 +150,87 @@ def test_uci_housing_parses_table(tmp_path):
     np.testing.assert_allclose(x, expect[0], rtol=1e-4)
     np.testing.assert_allclose(y, table[0, 13:14].astype("float32"),
                                rtol=1e-5)
+
+
+def _make_ptb(path):
+    train = b"the cat sat on the mat\nthe dog sat\n" * 30
+    valid = b"the cat ran\n" * 10
+    test = b"a dog ran on the mat\n" * 5
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in (("./simple-examples/data/ptb.train.txt", train),
+                           ("./simple-examples/data/ptb.valid.txt", valid),
+                           ("./simple-examples/data/ptb.test.txt", test)):
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+
+
+def test_text_imikolov_parses_real_ptb(tmp_path):
+    from paddle_tpu.text import Imikolov
+    path = str(tmp_path / "simple-examples.tgz")
+    _make_ptb(path)
+    ds = Imikolov(data_file=path, data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=1)
+    # dict: freq-sorted with <s>/<e> counted per line, <unk> last
+    assert ds.word_idx["the"] == 0          # most frequent word
+    assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+    assert len(ds) > 0
+    sample = ds[0]
+    assert len(sample) == 3                 # window tuple
+    # first trigram of line 1: <s> the cat
+    expect = [ds.word_idx[w] for w in ("<s>", "the", "cat")]
+    assert [int(x) for x in sample] == expect
+
+    seq = Imikolov(data_file=path, data_type="SEQ", mode="test",
+                   min_word_freq=1)
+    src, trg = seq[0]
+    # SEQ: src = <s>+ids, trg = ids+<e>
+    assert int(src[0]) == ds.word_idx["<s>"]
+    assert int(trg[-1]) == ds.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+    # 'a' never reaches min freq in train+valid -> <unk>
+    assert int(src[1]) == ds.word_idx["<unk>"] or "a" in ds.word_idx
+
+
+def _make_ml1m(path):
+    import zipfile
+    movies = ("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+              "2::Jumanji (1995)::Adventure|Children's|Fantasy\n")
+    users = ("1::F::1::10::48067\n"
+             "2::M::56::16::70072\n")
+    ratings = "".join(f"{u}::{m}::{r}::97830110{i}\n"
+                      for i, (u, m, r) in enumerate(
+                          [(1, 1, 5), (1, 2, 3), (2, 1, 4), (2, 2, 1)] * 5))
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+
+
+def test_text_movielens_parses_real_zip(tmp_path):
+    from paddle_tpu.text import Movielens
+    path = str(tmp_path / "ml-1m.zip")
+    _make_ml1m(path)
+    tr = Movielens(data_file=path, mode="train", test_ratio=0.25)
+    te = Movielens(data_file=path, mode="test", test_ratio=0.25)
+    assert len(tr) + len(te) == 20
+    assert len(te) > 0
+    uid, gender, age, job, mid, cats, title, rating = tr[0]
+    assert int(gender) in (0, 1)
+    assert 0 <= int(age) < 7
+    assert -5.0 <= float(rating[0]) <= 5.0
+    assert all(0 <= int(c) < len(tr.categories_dict) for c in cats)
+    # title years are stripped: 'Toy Story (1995)' -> words toy, story
+    assert "toy" in tr.movie_title_dict and "(1995)" not in tr.movie_title_dict
+
+
+def test_text_corpora_reject_invalid_data_file(tmp_path):
+    """A present-but-corrupt archive must ERROR, not silently train on
+    synthetic data."""
+    from paddle_tpu.text import Imikolov, Movielens
+    bad = tmp_path / "corrupt.tgz"
+    bad.write_bytes(b"not an archive at all")
+    with pytest.raises(ValueError, match="not a PTB"):
+        Imikolov(data_file=str(bad), window_size=3)
+    with pytest.raises(ValueError, match="not an ml-1m"):
+        Movielens(data_file=str(bad))

@@ -172,8 +172,9 @@ def _analytic_entry(name, spec):
     if spec["kind"] == "gpt":
         tokens = spec["batch"] * spec["seq"]
         flops = 6 * spec["params"] * tokens
-        # bf16 params + grads + bf16 adam slots (m, v) read+write, plus
-        # remat'd activations ~ 2x forward activations at seq 1024
+        # optimizer-state traffic only: bf16 params + grads + bf16 adam
+        # m/v, read+write = 12 bytes/param. Activations are excluded by
+        # design — remat turns them into recompute, not HBM residency
         param_bytes = spec["params"] * 2 * (1 + 1 + 2 + 2)
         return {"flops_per_step": flops, "min_param_bytes": param_bytes}
     if spec["kind"] == "resnet":
