@@ -25,7 +25,7 @@ def record(trial):
         f.write(line + "\n")
 
 
-def _resnet_trial(batch_size, steps=10):
+def _resnet_trial(batch_size, steps=10, stem_s2d=False):
     import bench
     import paddle_tpu as paddle
     from paddle_tpu.distributed import build_mesh
@@ -34,7 +34,8 @@ def _resnet_trial(batch_size, steps=10):
     paddle.seed(0)
     build_mesh(dp=1)
     model = paddle.vision.models.resnet50(num_classes=1000,
-                                          data_format="NHWC")
+                                          data_format="NHWC",
+                                          stem_s2d=stem_s2d)
     model.bfloat16()
     model.train()
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -53,20 +54,23 @@ def _resnet_trial(batch_size, steps=10):
     dt = bench._measure(trainer, batch, steps, f"resnet_bs{batch_size}")
     imgs_s = batch_size / dt
     mfu = 3 * 8.2e9 * imgs_s / bench.chip_peak_flops()
-    return {"config": "resnet50", "bs": batch_size,
+    return {"config": "resnet50", "bs": batch_size, "stem_s2d": stem_s2d,
             "imgs_s": round(imgs_s, 1), "mfu": round(mfu, 4)}, trainer, batch
 
 
 def run_resnet():
+    # sweep batch AND the space-to-depth stem rewrite (exact-equivalent
+    # MXU-friendly 7x7/s2; ops/space_to_depth.py, CPU-parity tested)
     for bs in (128, 256, 512):
-        try:
-            trial, _, _ = _resnet_trial(bs)
-            record(trial)
-        except Exception as e:
-            record({"config": "resnet50", "bs": bs,
-                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
-            import gc
-            gc.collect()
+        for s2d in (False, True):
+            try:
+                trial, _, _ = _resnet_trial(bs, stem_s2d=s2d)
+                record(trial)
+            except Exception as e:
+                record({"config": "resnet50", "bs": bs, "stem_s2d": s2d,
+                        "error": f"{type(e).__name__}: {str(e)[:160]}"})
+                import gc
+                gc.collect()
 
 
 def run_hlo_audit():

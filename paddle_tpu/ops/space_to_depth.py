@@ -1,0 +1,45 @@
+"""Space-to-depth stem convolution (the MLPerf ResNet TPU trick).
+
+A 7x7/stride-2 conv on 3-channel input starves the MXU: C=3 occupies 3
+of 128 lanes. The exact-equivalent rewrite packs 2x2 spatial blocks into
+channels (NHWC [N,H,W,3] -> [N,H/2,W/2,12]) and runs a 4x4/stride-1
+conv with the correspondingly rearranged kernel — 4x the lane occupancy
+and no strided window. Derivation (1D): with the 7-tap kernel zero-
+padded to 8 taps on the left, out[i] = sum_m K[m] . y[i-2+m] over the
+paired signal y[j] = (x[2j], x[2j+1]), i.e. a 4-tap conv with
+asymmetric padding (2, 1). Bit-exact, checkpoint-compatible (consumes
+the ORIGINAL [O,3,7,7] weight).
+
+Reference counterpart: the stem conv lowering decisions in
+paddle/phi/kernels/gpu conv kernels are cuDNN's problem; on TPU the
+graph itself must present an MXU-friendly shape.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["space_to_depth_stem_conv"]
+
+
+def space_to_depth_stem_conv(x, w):
+    """Exact equivalent of conv2d(x, w, stride=2, padding=3) for NHWC x
+    [N,H,W,C] (H, W even) and OIHW w [O,C,7,7]."""
+    N, H, W, C = x.shape
+    O, Ci, kh, kw = w.shape
+    assert (kh, kw) == (7, 7) and Ci == C and H % 2 == 0 and W % 2 == 0, (
+        "space_to_depth_stem_conv handles the 7x7/s2 stem on even "
+        f"spatial dims, got w {w.shape} x {x.shape}")
+    # input: pack 2x2 blocks into channels, order (bu, bv, c)
+    y = x.reshape(N, H // 2, 2, W // 2, 2, C)
+    y = y.transpose(0, 1, 3, 2, 4, 5).reshape(N, H // 2, W // 2, 4 * C)
+    # kernel: zero-pad 7->8 leading on both spatial dims, then fold the
+    # 2x2 phase into the input-channel dim with the SAME (bu, bv, c) order
+    w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    wh = w8.transpose(2, 3, 1, 0)                    # [8, 8, C, O] HWIO
+    K = wh.reshape(4, 2, 4, 2, C, O).transpose(0, 2, 1, 3, 4, 5)
+    K = K.reshape(4, 4, 4 * C, O)
+    from ..nn.functional.common import amp_compute_cast
+    y = amp_compute_cast(y, K)          # same dtype rule as F.conv2d
+    return jax.lax.conv_general_dilated(
+        y, K.astype(y.dtype), window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))

@@ -53,7 +53,18 @@ class Imdb(_LocalCorpus):
         super().__init__(data_file, mode)
 
 
-class Imikolov(_LocalCorpus):
+class _TupleCorpus(Dataset):
+    """Samples are tuples whose every element maps to an np array
+    (reference text datasets' __getitem__ convention)."""
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(_TupleCorpus):
     """PTB language-model corpus (reference text/datasets/imikolov.py).
     A real simple-examples tarball given as data_file is parsed: the word
     dict builds from ptb.train.txt + ptb.valid.txt with per-line <s>/<e>
@@ -65,30 +76,40 @@ class Imikolov(_LocalCorpus):
         import tarfile
         self.data_type = data_type.upper()
         self.mode = mode.lower()
+        assert self.mode in ("train", "valid", "test"), \
+            f"mode should be 'train', 'valid' or 'test', got {mode!r}"
         self.window_size = window_size
         if data_file and os.path.exists(data_file):
-            if not data_file.endswith(".npz"):
-                if not tarfile.is_tarfile(data_file):
-                    raise ValueError(
-                        f"{data_file!r} exists but is not a PTB "
-                        "simple-examples tarball (nor a legacy .npz) — "
-                        "refusing to silently train on synthetic data")
-                # ONE TarFile for dict build + load: gzip tars re-inflate
-                # from byte 0 on every fresh open
-                with tarfile.open(data_file) as tf:
-                    names = set(tf.getnames())
-                    self.word_idx = self._build_dict(tf, names,
-                                                     min_word_freq)
-                    self._load(tf, names)
-                return
+            if not tarfile.is_tarfile(data_file):
+                raise ValueError(
+                    f"{data_file!r} exists but is not a PTB "
+                    "simple-examples tarball — refusing to silently "
+                    "train on synthetic data")
+            # ONE TarFile for dict build + load: gzip tars re-inflate
+            # from byte 0 on every fresh open
+            with tarfile.open(data_file) as tf:
+                names = set(tf.getnames())
+                self.word_idx = self._build_dict(tf, names, min_word_freq)
+                self._load(tf, names)
+            return
         self._synth_init(data_file, mode, window_size)
 
     def _synth_init(self, data_file, mode, window_size):
-        super(Imikolov, self).__init__(
-            data_file, mode,
-            dim=max(2, window_size if window_size > 0 else 5))
+        # synthetic stand-in yields the SAME sample shapes as the real
+        # path: window tuples for NGRAM, (src, trg) id lists for SEQ
+        rng = np.random.RandomState(0 if mode == "train" else 1)
         self.word_idx = {f"w{i}": i for i in range(5000)}
-        self.data = [tuple(row) for row in self.data]
+        self.word_idx.update({"<s>": 5000, "<e>": 5001, "<unk>": 5002})
+        self.data = []
+        for _ in range(200):
+            if self.data_type == "NGRAM":
+                w = max(2, window_size if window_size > 0 else 5)
+                self.data.append(tuple(rng.randint(0, 5000, w).tolist()))
+            else:
+                n = int(rng.randint(4, 20))
+                ids = rng.randint(0, 5000, n).tolist()
+                self.data.append(([self.word_idx["<s>"]] + ids,
+                                  ids + [self.word_idx["<e>"]]))
 
     @staticmethod
     def _member(tf, names, name):
@@ -139,13 +160,6 @@ class Imikolov(_LocalCorpus):
             else:
                 raise ValueError(f"unknown data_type {self.data_type}")
 
-    def __getitem__(self, idx):
-        # reference: every element of the sample tuple as an np array
-        return tuple(np.array(x) for x in self.data[idx])
-
-    def __len__(self):
-        return len(self.data)
-
 
 class Conll05st(_LocalCorpus):
     pass
@@ -176,7 +190,7 @@ class WMT16(_LocalCorpus):
     pass
 
 
-class Movielens(_LocalCorpus):
+class Movielens(_TupleCorpus):
     """ml-1m recsys corpus (reference text/datasets/movielens.py). A real
     ml-1m zip given as data_file is parsed: movies.dat / users.dat /
     ratings.dat ('::'-separated, latin-1), sample =
@@ -188,18 +202,28 @@ class Movielens(_LocalCorpus):
     def __init__(self, data_file=None, mode="train", test_ratio=0.1,
                  rand_seed=0, download=False):
         import zipfile
+        mode = mode.lower()
+        assert mode in ("train", "test"), \
+            f"mode should be 'train' or 'test', got {mode!r}"
         if data_file and os.path.exists(data_file):
-            if not data_file.endswith(".npz"):
-                if not zipfile.is_zipfile(data_file):
-                    raise ValueError(
-                        f"{data_file!r} exists but is not an ml-1m zip "
-                        "(nor a legacy .npz) — refusing to silently "
-                        "train on synthetic data")
-                self._load_real(data_file, mode.lower(), test_ratio,
-                                rand_seed)
-                return
-        super().__init__(data_file, mode)
-        self.data = [tuple(row) for row in self.data]
+            if not zipfile.is_zipfile(data_file):
+                raise ValueError(
+                    f"{data_file!r} exists but is not an ml-1m zip — "
+                    "refusing to silently train on synthetic data")
+            self._load_real(data_file, mode, test_ratio, rand_seed)
+            return
+        # synthetic stand-in with the SAME 8-field sample shape
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.categories_dict = {f"c{i}": i for i in range(18)}
+        self.movie_title_dict = {f"t{i}": i for i in range(500)}
+        self.data = [
+            (int(rng.randint(1, 6041)), int(rng.randint(0, 2)),
+             int(rng.randint(0, 7)), int(rng.randint(0, 21)),
+             int(rng.randint(1, 3953)),
+             rng.randint(0, 18, rng.randint(1, 4)).tolist(),
+             rng.randint(0, 500, rng.randint(1, 5)).tolist(),
+             [float(rng.randint(1, 6)) * 2 - 5.0])
+            for _ in range(200)]
 
     def _load_real(self, data_file, mode, test_ratio, rand_seed):
         import re as _re
@@ -244,12 +268,6 @@ class Movielens(_LocalCorpus):
                         [self.movie_title_dict[w.lower()]
                          for w in title.split()],
                         [float(rating) * 2 - 5.0]))
-
-    def __getitem__(self, idx):
-        return tuple(np.array(x) for x in self.data[idx])
-
-    def __len__(self):
-        return len(self.data)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

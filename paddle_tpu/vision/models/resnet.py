@@ -73,7 +73,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
-                 groups=1, data_format=None):
+                 groups=1, data_format=None, stem_s2d=False):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -87,6 +87,11 @@ class ResNet(nn.Layer):
         data_format = resolve_data_format(data_format, 2)
         self.data_format = data_format
         df = dict(data_format=data_format)
+        # TPU stem option: run the 7x7/s2 conv in its exact space-to-depth
+        # form (ops/space_to_depth.py) — C=3 starves MXU lanes; NHWC only
+        self.stem_s2d = bool(stem_s2d)
+        if self.stem_s2d and data_format != "NHWC":
+            raise ValueError("stem_s2d requires data_format='NHWC'")
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False, **df)
         self.bn1 = nn.BatchNorm2D(self.inplanes, **df)
         self.relu = nn.ReLU()
@@ -118,7 +123,13 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        if self.stem_s2d:
+            from ...framework.core import apply_op
+            from ...ops.space_to_depth import space_to_depth_stem_conv
+            x = apply_op(space_to_depth_stem_conv, x, self.conv1.weight)
+            x = self.relu(self.bn1(x))
+        else:
+            x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

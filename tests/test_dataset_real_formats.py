@@ -188,8 +188,9 @@ def test_text_imikolov_parses_real_ptb(tmp_path):
     assert int(src[0]) == ds.word_idx["<s>"]
     assert int(trg[-1]) == ds.word_idx["<e>"]
     np.testing.assert_array_equal(src[1:], trg[:-1])
-    # 'a' never reaches min freq in train+valid -> <unk>
-    assert int(src[1]) == ds.word_idx["<unk>"] or "a" in ds.word_idx
+    # 'a' appears only in ptb.test.txt, never in train+valid -> OOV
+    assert "a" not in ds.word_idx
+    assert int(src[1]) == ds.word_idx["<unk>"]
 
 
 def _make_ml1m(path):

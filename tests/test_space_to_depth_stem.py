@@ -1,0 +1,57 @@
+"""Space-to-depth stem conv (ops/space_to_depth.py): exact equivalence
+with the plain 7x7/s2 stem — the MLPerf ResNet TPU rewrite."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.ops.space_to_depth import space_to_depth_stem_conv
+
+
+def test_matches_plain_stem_conv_bitwise():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 40, 3).astype("float32"))
+    w = jnp.asarray(rng.randn(16, 3, 7, 7).astype("float32") * 0.1)
+    got = space_to_depth_stem_conv(x, w)
+    ref = jax.lax.conv_general_dilated(
+        x, jnp.transpose(w, (2, 3, 1, 0)), window_strides=(2, 2),
+        padding=((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert got.shape == ref.shape == (2, 16, 20, 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_stem_s2d_forward_and_grads_match():
+    """Same weights, flag on/off -> identical logits; grads flow to the
+    original conv1 weight through the rewritten path."""
+    paddle.seed(0)
+    build_mesh(dp=1)
+    m_plain = paddle.vision.models.resnet18(num_classes=5,
+                                            data_format="NHWC")
+    m_s2d = paddle.vision.models.resnet18(num_classes=5,
+                                          data_format="NHWC",
+                                          stem_s2d=True)
+    m_s2d.set_state_dict(m_plain.state_dict())
+    for m in (m_plain, m_s2d):
+        m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 64, 64, 3).astype("float32"))
+    np.testing.assert_allclose(m_s2d(x).numpy(), m_plain(x).numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+    m_s2d.train()
+    y = m_s2d(x)
+    y.sum().backward()
+    assert m_s2d.conv1.weight.grad is not None
+    assert float(jnp.max(jnp.abs(m_s2d.conv1.weight.grad._value))) > 0
+
+
+def test_s2d_requires_nhwc_and_even_dims():
+    import pytest
+    with pytest.raises(ValueError, match="NHWC"):
+        paddle.vision.models.resnet18(data_format="NCHW", stem_s2d=True)
+    with pytest.raises(AssertionError):
+        space_to_depth_stem_conv(jnp.zeros((1, 7, 8, 3)),
+                                 jnp.zeros((4, 3, 7, 7)))
