@@ -182,12 +182,88 @@ class UCIHousing(Dataset):
         return len(self.features)
 
 
-class WMT14(_LocalCorpus):
-    pass
+class WMT14(_TupleCorpus):
+    """WMT14 en-fr translation subset (reference text/datasets/wmt14.py).
+    A real wmt14.tgz given as data_file is parsed: `*src.dict` /
+    `*trg.dict` members (one word per line, first dict_size kept) and
+    tab-separated parallel lines in members ending '{mode}/{mode}'.
+    Samples: (src_ids with <s>/<e>, <s>+trg_ids, trg_ids+<e>); pairs
+    longer than 80 tokens dropped. UNK id is 2 (reference constant)."""
+
+    UNK_IDX = 2
+    START, END = "<s>", "<e>"
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        import tarfile
+        mode = mode.lower()
+        assert mode in ("train", "test", "gen"), \
+            f"mode should be 'train', 'test' or 'gen', got {mode!r}"
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            if not tarfile.is_tarfile(data_file):
+                raise ValueError(
+                    f"{data_file!r} exists but is not a wmt14 tarball — "
+                    "refusing to silently train on synthetic data")
+            assert dict_size > 0, "dict_size should be a positive number"
+            self._load_real(data_file, dict_size)
+            return
+        # synthetic stand-in, same 3-field sample shape
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.src_dict = {f"w{i}": i for i in range(3000)}
+        self.trg_dict = {self.START: 0, self.END: 1,
+                         **{f"v{i}": i + 3 for i in range(3000)}}
+        self.data = []
+        for _ in range(200):
+            ns, nt = int(rng.randint(3, 30)), int(rng.randint(3, 30))
+            src = rng.randint(3, 3000, ns).tolist()
+            trg = rng.randint(3, 3000, nt).tolist()
+            self.data.append((src, [0] + trg, trg + [1]))
+
+    def _load_real(self, data_file, dict_size):
+        import tarfile
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.decode().strip()] = i
+            return out
+
+        self.data = []
+        with tarfile.open(data_file, mode="r") as f:
+            members = f.getmembers()
+            src_d = [m for m in members if m.name.endswith("src.dict")]
+            trg_d = [m for m in members if m.name.endswith("trg.dict")]
+            assert len(src_d) == 1 and len(trg_d) == 1, \
+                "archive must hold exactly one src.dict and one trg.dict"
+            self.src_dict = to_dict(f.extractfile(src_d[0]), dict_size)
+            self.trg_dict = to_dict(f.extractfile(trg_d[0]), dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for m in members:
+                if not m.name.endswith(suffix):
+                    continue
+                for line in f.extractfile(m):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = [self.START] + parts[0].split() + [self.END]
+                    src = [self.src_dict.get(w, self.UNK_IDX)
+                           for w in src_words]
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.data.append(
+                        (src, [self.trg_dict[self.START]] + trg,
+                         trg + [self.trg_dict[self.END]]))
 
 
-class WMT16(_LocalCorpus):
-    pass
+class WMT16(WMT14):
+    """WMT16 en-de shares the WMT14 sample contract here (src_ids,
+    trg_ids, trg_ids_next); reference builds vocabularies from the raw
+    corpus — pass a wmt14-layout tarball or use the synthetic set."""
 
 
 class Movielens(_TupleCorpus):
