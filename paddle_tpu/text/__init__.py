@@ -161,8 +161,152 @@ class Imikolov(_TupleCorpus):
                 raise ValueError(f"unknown data_type {self.data_type}")
 
 
-class Conll05st(_LocalCorpus):
-    pass
+class Conll05st(_TupleCorpus):
+    """CoNLL-2005 SRL test set (reference text/datasets/conll05.py).
+    Real inputs: the conll05st-tests tarball (words.gz + props.gz under
+    conll05st-release/test.wsj/) plus word/verb/target dict files.
+    Props bracket tags expand to BIO; each (sentence, predicate) pair
+    yields the reference 9-field sample (word ids, five ctx-window id
+    columns, predicate ids, verb-region mark, BIO label ids). UNK id 0.
+    Label-dict tag order is SORTED here (the reference iterates a set —
+    nondeterministic); 'O' is last either way."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 emb_file=None, mode="train", download=False):
+        import tarfile
+        if data_file and os.path.exists(data_file):
+            if not (tarfile.is_tarfile(data_file) and word_dict_file
+                    and verb_dict_file and target_dict_file):
+                raise ValueError(
+                    "Conll05st needs the conll05st-tests tarball PLUS "
+                    "word/verb/target dict files (all local paths)")
+            self.word_dict = self._load_dict(word_dict_file)
+            self.predicate_dict = self._load_dict(verb_dict_file)
+            self.label_dict = self._load_label_dict(target_dict_file)
+            self.emb_file = emb_file
+            self._load_anno(data_file)
+            return
+        # synthetic stand-in with the same 9-field shape
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.word_dict = {f"w{i}": i for i in range(5000)}
+        self.predicate_dict = {f"v{i}": i for i in range(100)}
+        self.label_dict = {"B-A0": 0, "I-A0": 1, "B-V": 2, "I-V": 3, "O": 4}
+        self.data = []
+        for _ in range(100):
+            n = int(rng.randint(4, 20))
+            row = [rng.randint(0, 5000, n).tolist() for _ in range(6)]
+            row += [[int(rng.randint(0, 100))] * n,
+                    rng.randint(0, 2, n).tolist(),
+                    rng.randint(0, 5, n).tolist()]
+            self.data.append(tuple(row))
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        tags = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for tag in sorted(tags):
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    @staticmethod
+    def _expand_bio(lbl):
+        """Bracket tags ('(A0*', '*', '*)', '(V*)') -> BIO sequence."""
+        out, cur, inside = [], "O", False
+        for tok in lbl:
+            if tok == "*":
+                out.append("I-" + cur if inside else "O")
+            elif tok == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"Unexpected label: {tok}")
+        return out
+
+    def _load_anno(self, data_file):
+        import gzip
+        import tarfile
+        samples = []
+        with tarfile.open(data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentence, seg = [], []
+                for word, props in zip(words_file, props_file):
+                    word = word.decode().strip()
+                    cols = props.decode().strip().split()
+                    if cols:
+                        sentence.append(word)
+                        seg.append(cols)
+                        continue
+                    if seg:                      # end of sentence
+                        columns = list(zip(*seg))
+                        verbs = [v for v in columns[0] if v != "-"]
+                        for i, lbl in enumerate(columns[1:]):
+                            samples.append(
+                                (list(sentence), verbs[i],
+                                 self._expand_bio(lbl)))
+                    sentence, seg = [], []
+        self.data = [self._features(*s) for s in samples]
+
+    def _features(self, sentence, predicate, labels):
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name in ((-2, "n2"), (-1, "n1"), (0, "0"),
+                          (1, "p1"), (2, "p2")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = "bos" if off < 0 else "eos"
+        wd, UNK = self.word_dict, self.UNK_IDX
+        word_idx = [wd.get(w, UNK) for w in sentence]
+        row = [word_idx]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            row.append([wd.get(ctx[name], UNK)] * n)
+        row.append([self.predicate_dict.get(predicate)] * n)
+        row.append(mark)
+        row.append([self.label_dict.get(w) for w in labels])
+        return tuple(row)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        """Pretrained word embeddings (reference get_embedding):
+        whitespace-separated floats, one row per word-dict entry."""
+        if not getattr(self, "emb_file", None):
+            raise ValueError(
+                "no emb_file was provided to Conll05st(...)")
+        return np.loadtxt(self.emb_file, dtype="float32")
 
 
 class UCIHousing(Dataset):
@@ -241,9 +385,12 @@ class WMT14(_TupleCorpus):
             self.src_dict = to_dict(f.extractfile(src_d[0]), dict_size)
             self.trg_dict = to_dict(f.extractfile(trg_d[0]), dict_size)
             suffix = f"{self.mode}/{self.mode}"
-            for m in members:
-                if not m.name.endswith(suffix):
-                    continue
+            matched = [m for m in members if m.name.endswith(suffix)]
+            if not matched:
+                raise ValueError(
+                    f"no member ending {suffix!r} in {data_file!r} — "
+                    f"the archive has no {self.mode} split")
+            for m in matched:
                 for line in f.extractfile(m):
                     parts = line.decode().strip().split("\t")
                     if len(parts) != 2:
@@ -261,9 +408,25 @@ class WMT14(_TupleCorpus):
 
 
 class WMT16(WMT14):
-    """WMT16 en-de shares the WMT14 sample contract here (src_ids,
-    trg_ids, trg_ids_next); reference builds vocabularies from the raw
-    corpus — pass a wmt14-layout tarball or use the synthetic set."""
+    """WMT16 en-de (reference text/datasets/wmt16.py signature:
+    src_dict_size/trg_dict_size/lang, modes train/test/val). Shares the
+    WMT14 sample contract (src_ids, trg_ids, trg_ids_next); the parser
+    expects a wmt14-layout tarball (src.dict/trg.dict + parallel
+    '{mode}/{mode}' members) — the reference instead builds vocabularies
+    from the raw corpus, accepted divergence documented here."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        mode = mode.lower()
+        assert mode in ("train", "test", "val"), \
+            f"mode should be 'train', 'test' or 'val', got {mode!r}"
+        self.lang = lang
+        dict_size = max(int(src_dict_size), int(trg_dict_size))
+        wmt14_mode = mode if mode != "val" else "test"
+        super().__init__(data_file=data_file, mode=wmt14_mode,
+                         dict_size=dict_size if data_file else -1,
+                         download=download)
+        self.mode = mode
 
 
 class Movielens(_TupleCorpus):

@@ -262,9 +262,70 @@ def test_text_wmt14_parses_real_tarball(tmp_path):
     # OOV -> UNK_IDX=2
     ds2 = WMT14(data_file=path, mode="train", dict_size=3)
     assert int(ds2[0][0][1]) == 2
-    import pytest
     with pytest.raises(AssertionError, match="dict_size"):
         WMT14(data_file=path, mode="train")
+    # a tarball with no such split must error, not yield an empty set
+    with pytest.raises(ValueError, match="no member"):
+        WMT14(data_file=path, mode="gen", dict_size=5)
     # synthetic fallback keeps the 3-field contract
     s = WMT14(mode="test")
     assert len(s[0]) == 3
+    # WMT16 reference signature maps onto the same machinery
+    from paddle_tpu.text import WMT16
+    ds16 = WMT16(data_file=path, mode="train", src_dict_size=5,
+                 trg_dict_size=5)
+    assert len(ds16) == 2
+    # 'val' maps onto the wmt14 'test' split (absent here -> loud error)
+    with pytest.raises(ValueError, match="no test split"):
+        WMT16(data_file=path, mode="val", src_dict_size=5,
+              trg_dict_size=5)
+
+
+def test_text_conll05st_parses_real_props(tmp_path):
+    """SRL props bracket tags expand to BIO over the real archive layout
+    (conll05st-release/test.wsj words.gz + props.gz + dict files)."""
+    from paddle_tpu.text import Conll05st
+    words = "The\ncat\nchased\nthe\ndog\n\n"
+    # one predicate column + one args column (per-token rows)
+    props = ("-\t(A0*\n"
+             "-\t*)\n"
+             "chase\t(V*)\n"
+             "-\t(A1*\n"
+             "-\t*)\n"
+             "\n")
+    path = str(tmp_path / "conll05st-tests.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gzip.compress(words.encode())),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gzip.compress(props.encode()))):
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("The\ncat\nchased\nthe\ndog\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("chase\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+
+    ds = Conll05st(data_file=path, word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 1
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx, n2, n1, c0, p1, p2, pred, mark, label = sample
+    np.testing.assert_array_equal(word_idx, [0, 1, 2, 3, 4])
+    ld = ds.label_dict
+    np.testing.assert_array_equal(
+        label, [ld["B-A0"], ld["I-A0"], ld["B-V"], ld["B-A1"], ld["I-A1"]])
+    # ctx window around the verb (index 2): n2=The n1=cat 0=chased p1=the
+    assert int(n2[0]) == 0 and int(n1[0]) == 1
+    assert int(c0[0]) == 2 and int(p1[0]) == 3 and int(p2[0]) == 4
+    np.testing.assert_array_equal(mark, [1, 1, 1, 1, 1])
+    np.testing.assert_array_equal(pred, [0] * 5)
+    w, p, l = ds.get_dict()
+    assert w is ds.word_dict and "O" in l
+    # synthetic fallback keeps the 9-field contract
+    assert len(Conll05st()[0]) == 9
