@@ -68,6 +68,18 @@ _SUBMODULES = {
     "text/__init__.py": "text",
     "sparse/__init__.py": "sparse",
     "utils/__init__.py": "utils",
+    "nn/initializer/__init__.py": "nn.initializer",
+    "optimizer/lr.py": "optimizer.lr",
+    "vision/models/__init__.py": "vision.models",
+    "vision/transforms/__init__.py": "vision.transforms",
+    "vision/datasets/__init__.py": "vision.datasets",
+    "distribution/transform.py": "distribution.transform",
+    "distributed/fleet/__init__.py": "distributed.fleet",
+    "incubate/nn/__init__.py": "incubate.nn",
+    "device/__init__.py": "device",
+    "utils/cpp_extension/__init__.py": "utils.cpp_extension",
+    "profiler/__init__.py": "profiler",
+    "onnx/__init__.py": "onnx",
 }
 
 
