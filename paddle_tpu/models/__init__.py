@@ -26,7 +26,7 @@ from .bert import (  # noqa: F401
     bert_large,
     bert_tiny,
 )
-from .generation import generate  # noqa: F401
+from .generation import beam_search, generate  # noqa: F401
 from .transformer import (  # noqa: F401
     CrossEntropyCriterion,
     TransformerModel,

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..nn.layer_base import buffer_pytree, functional_call, state_pytree
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 def mask_logits(logits, temperature, top_k, top_p):
@@ -39,10 +39,38 @@ def _sample(logits, key, temperature, top_k, top_p):
         key, mask_logits(logits, temperature, top_k, top_p), axis=-1)
 
 
+def _make_prefill(model, B, max_len):
+    """ONE prefill recipe for greedy and beam paths (cache init + batched
+    forward + last-position logits)."""
+    def prefill(params, ids):
+        with functional_call(model, params):
+            cache = model.init_cache(B, max_len)
+            logits, cache = model(Tensor(ids), cache=cache, pos=0)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        return lv[:, -1], cache
+    return prefill
+
+
 def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-             top_p=1.0, eos_token_id=None, seed=0):
+             top_p=1.0, eos_token_id=None, seed=0, num_beams=1,
+             length_penalty=0.0):
     """Returns [B, L_in + max_new_tokens] token ids (greedy when
-    temperature=0). The full prefill+decode runs as two compiled programs."""
+    temperature=0). The full prefill+decode runs as two compiled programs.
+    num_beams>1 switches to beam search (PaddleNLP generation_utils
+    decode_strategy='beam_search' role): one lax.scan where each step
+    expands KxV candidates, keeps the top K, and REORDERS the KV cache
+    to follow the surviving beams; finished beams are frozen on EOS.
+    Final selection divides scores by len**length_penalty (0 = raw
+    log-prob, PaddleNLP's default)."""
+    if num_beams > 1:
+        assert temperature in (0.0, 1.0) and not top_k \
+            and top_p in (0, 1.0), \
+            "beam search explores by score, not sampling: leave " \
+            "temperature/top_k/top_p at defaults"
+        out, _scores = _beam_search(model, input_ids, max_new_tokens,
+                                    num_beams, eos_token_id,
+                                    length_penalty)
+        return out
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
     B, L_in = ids.shape
@@ -52,13 +80,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
     params = state_pytree(model)
     params.update(buffer_pytree(model))
     model.eval()
-
-    def prefill(params, ids):
-        with functional_call(model, params):
-            cache = model.init_cache(B, max_len)
-            logits, cache = model(Tensor(ids), cache=cache, pos=0)
-        lv = logits._value if isinstance(logits, Tensor) else logits
-        return lv[:, -1], cache
+    prefill = _make_prefill(model, B, max_len)
 
     def decode(params, cache, first_tok, key):
         def step(carry, _):
@@ -93,3 +115,97 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
         gen = jnp.where(prev_hit, eos_token_id, gen)
         out = jnp.concatenate([out[:, :L_in], gen], axis=1)
     return Tensor(out)
+
+
+def _beam_search(model, input_ids, max_new_tokens, num_beams,
+                 eos_token_id, length_penalty):
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    B, L_in = ids.shape
+    K = int(num_beams)
+    T = int(max_new_tokens)
+    max_len = L_in + T
+    assert max_len <= model.cfg.max_seq_len, "exceeds model max_seq_len"
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    pad = eos if eos_token_id is not None else 0
+
+    params = state_pytree(model)
+    params.update(buffer_pytree(model))
+    model.eval()
+    prefill = _make_prefill(model, B, max_len)
+
+    def run(params, ids):
+        last_logits, cache = prefill(params, ids)
+        logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), -1)
+        scores, first_toks = jax.lax.top_k(logp0, K)      # [B, K]
+        first_toks = first_toks.astype(jnp.int32)
+        # beams share the prefix: replicate every cache leaf to B*K rows
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, K, axis=0), cache)
+        toks = jnp.full((B, K, T), pad, jnp.int32)
+        toks = toks.at[:, :, 0].set(first_toks)
+        finished = (first_toks == eos)
+        V = logp0.shape[-1]
+        b_idx = jnp.arange(B)[:, None]
+
+        def step(carry, t):
+            cache, scores, cur, finished, toks = carry
+            with functional_call(model, params):
+                logits, cache = model(Tensor(cur.reshape(B * K, 1)),
+                                      cache=cache, pos=L_in + t)
+            lv = (logits._value if isinstance(logits, Tensor)
+                  else logits)[:, -1]
+            logp = jax.nn.log_softmax(lv.astype(jnp.float32), -1)
+            logp = logp.reshape(B, K, V)
+            # live beams expand over V; finished beams carry ONE frozen
+            # candidate (their pad continuation at unchanged score)
+            cand = jnp.where(finished[:, :, None], -jnp.inf,
+                             scores[:, :, None] + logp)
+            frozen = jnp.full((B, K, V), -jnp.inf)
+            frozen = frozen.at[:, :, pad].set(
+                jnp.where(finished, scores, -jnp.inf))
+            cand = jnp.maximum(cand, frozen).reshape(B, K * V)
+            scores, flat = jax.lax.top_k(cand, K)         # [B, K]
+            beam = (flat // V).astype(jnp.int32)
+            tok = (flat % V).astype(jnp.int32)
+            # the surviving beams' KV history must follow them
+            sel = (b_idx * K + beam).reshape(-1)          # [B*K]
+            cache = jax.tree_util.tree_map(
+                lambda x: jnp.take(x, sel, axis=0), cache)
+            toks = toks[b_idx, beam]                      # reorder history
+            finished = finished[b_idx, beam] | (tok == eos)
+            toks = toks.at[:, :, t + 1].set(tok)
+            return (cache, scores, tok, finished, toks), None
+
+        if T > 1:
+            (cache, scores, cur, finished, toks), _ = jax.lax.scan(
+                step, (cache, scores, first_toks, finished, toks),
+                jnp.arange(T - 1))
+        # length = tokens up to and including the first EOS (or T)
+        if eos >= 0:
+            hit = jnp.cumsum((toks == eos).astype(jnp.int32), -1) > 0
+            lengths = T - jnp.sum(hit, -1) + jnp.any(hit, -1)
+            # canonicalize: everything after the first EOS becomes pad
+            prev_hit = jnp.pad(hit[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+            toks = jnp.where(prev_hit, pad, toks)
+        else:
+            lengths = jnp.full((B, K), T)
+        norm = scores / jnp.maximum(lengths, 1).astype(
+            jnp.float32) ** length_penalty
+        best = jnp.argmax(norm, axis=1)                   # [B]
+        best_toks = toks[jnp.arange(B), best]             # [B, T]
+        best_score = norm[jnp.arange(B), best]
+        return jnp.concatenate([ids, best_toks], axis=1), best_score
+
+    out, scores = jax.jit(run)(params, ids)
+    return Tensor(out), Tensor(scores)
+
+
+def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
+                eos_token_id=None, length_penalty=0.0):
+    """Standalone beam-search entry. Returns (ids, scores) like the
+    reference generate() (PaddleNLP generation_utils returns the decoded
+    ids WITH their scores)."""
+    return _beam_search(model, input_ids, max_new_tokens, num_beams,
+                        eos_token_id, length_penalty)

@@ -1,0 +1,96 @@
+"""Beam-search generation (models/generation.py — PaddleNLP
+generation_utils decode_strategy='beam_search' role): one lax.scan with
+KV-cache reordering per step."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.models import GPT, generation, gpt_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(11)
+    build_mesh(dp=1)
+    cfg = gpt_tiny(max_seq_len=96, dtype="float32", remat=False)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _seq_logprob(model, ids, L_in):
+    """Log-probability the model assigns to the generated continuation."""
+    logits = model(paddle.to_tensor(np.asarray(ids)[None, :-1]))._value
+    logp = jnp.log(jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+                   / jnp.sum(jnp.exp(logits - jnp.max(logits, -1,
+                                                      keepdims=True)),
+                             -1, keepdims=True))
+    tgt = jnp.asarray(ids[1:])
+    tok_lp = jnp.take_along_axis(logp[0], tgt[:, None], 1)[:, 0]
+    return float(jnp.sum(tok_lp[L_in - 1:]))
+
+
+def test_beam1_greedy_equivalence(tiny):
+    prompt = np.asarray([[5, 77, 123, 9]], np.int32)
+    greedy = generation.generate(tiny, prompt, max_new_tokens=8,
+                                 temperature=0.0)
+    beam1, scores = generation.beam_search(tiny, prompt,
+                                           max_new_tokens=8, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(greedy._value),
+                                  np.asarray(beam1._value))
+    assert scores.shape == [1]
+
+
+def test_beam_improves_sequence_logprob(tiny):
+    prompt = np.asarray([[5, 77, 123, 9], [400, 2, 31, 8]], np.int32)
+    T = 10
+    greedy = np.asarray(generation.generate(
+        tiny, prompt, max_new_tokens=T, temperature=0.0)._value)
+    beam = np.asarray(generation.generate(
+        tiny, prompt, max_new_tokens=T, num_beams=4,
+        temperature=0.0)._value)
+    assert beam.shape == greedy.shape
+    for b in range(prompt.shape[0]):
+        lp_g = _seq_logprob(tiny, greedy[b], prompt.shape[1])
+        lp_b = _seq_logprob(tiny, beam[b], prompt.shape[1])
+        # pinned-seed regression: for THIS model/prompt beam finds a
+        # no-worse sequence. (Not a universal guarantee — beam can prune
+        # the greedy prefix mid-search; deterministic here.)
+        assert lp_b >= lp_g - 1e-4, (lp_b, lp_g)
+
+
+def test_beam_scores_match_model_logprob(tiny):
+    prompt = np.asarray([[5, 77, 123, 9]], np.int32)
+    out, scores = generation.beam_search(tiny, prompt, max_new_tokens=6,
+                                         num_beams=3)
+    lp = _seq_logprob(tiny, np.asarray(out._value)[0], prompt.shape[1])
+    np.testing.assert_allclose(float(scores._value[0]), lp,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_beam_eos_freezes_and_pads(tiny):
+    prompt = np.asarray([[5, 77, 123, 9]], np.int32)
+    # force an early finish: use the greedy 2nd token as EOS
+    greedy = np.asarray(generation.generate(
+        tiny, prompt, max_new_tokens=8, temperature=0.0)._value)
+    eos = int(greedy[0, prompt.shape[1] + 1])
+    out = np.asarray(generation.generate(
+        tiny, prompt, max_new_tokens=8, temperature=0.0, num_beams=3,
+        eos_token_id=eos)._value)
+    gen = out[0, prompt.shape[1]:]
+    if eos in gen.tolist():
+        i = gen.tolist().index(eos)
+        assert all(t == eos for t in gen[i:]), gen
+
+
+def test_beam_rejects_sampling_knobs(tiny):
+    for kw in ({"top_k": 5}, {"temperature": 0.0, "top_k": 50},
+               {"temperature": 0.7}, {"top_p": 0.5}):
+        with pytest.raises(AssertionError, match="beam search"):
+            generation.generate(tiny, np.asarray([[1, 2]], np.int32),
+                                num_beams=2, **kw)
+    # and the public models namespace exports it
+    from paddle_tpu.models import beam_search as bs
+    assert bs is generation.beam_search
