@@ -102,14 +102,16 @@ def decode_roofline_tok_s(cfg, batch, avg_ctx, quant=None, kv_bytes=2):
     length. tok/s_max = BW * batch / bytes_step. This is the honest
     denominator for decode (not MFU — the MXU idles).
 
-    a8w8 quantizes only the per-block linears (qkv/proj/fc1/fc2);
-    embeddings, position table, layernorms and the tied lm_head read at
-    bf16 width (per-channel scales are a few KB — ignored)."""
+    a8w8/w4a16 quantize only the per-block linears (qkv/proj/fc1/fc2)
+    at 1 and 0.5 bytes/param; embeddings, position table, layernorms and
+    the tied lm_head read at bf16 width (per-channel scales are a few KB
+    — ignored)."""
     n = cfg.num_params()
-    if quant == "a8w8":
+    if quant in ("a8w8", "w4a16"):
         h, f = cfg.hidden_size, cfg.ffn_hidden
         lin = cfg.num_layers * (4 * h * h + 2 * h * f)
-        w_bytes = lin * 1 + (n - lin) * 2
+        per = 1 if quant == "a8w8" else 0.5
+        w_bytes = lin * per + (n - lin) * 2
     else:
         w_bytes = n * 2
     kv = batch * cfg.num_layers * 2 * avg_ctx * cfg.hidden_size * kv_bytes
@@ -626,28 +628,20 @@ def main():
             log(f"moe bench failed: {type(e).__name__}: {str(e)[:300]}")
             extras["gpt_moe_error"] = str(e)[:160]
     if only in (None, "decode"):
-        try:
-            r = run_decode()
-            extras["decode_tokens_per_sec_per_chip"] = round(r["tok_s"], 1)
-            extras["decode_model"] = r["model"]
-            extras["decode_vs_hbm_roofline"] = r["vs_roofline"]
-            extras["decode_roofline_tok_s"] = r["roofline_tok_s"]
-            extras["decode_token_latency_ms"] = r["latency"]
-        except Exception as e:
-            log(f"decode bench failed: {type(e).__name__}: {str(e)[:300]}")
-            extras["decode_error"] = str(e)[:160]
-        try:
-            r = run_decode(quant="a8w8")
-            extras["decode_a8w8_tokens_per_sec_per_chip"] = \
-                round(r["tok_s"], 1)
-            extras["decode_a8w8_model"] = r["model"]
-            extras["decode_a8w8_vs_hbm_roofline"] = r["vs_roofline"]
-            extras["decode_a8w8_roofline_tok_s"] = r["roofline_tok_s"]
-            extras["decode_a8w8_token_latency_ms"] = r["latency"]
-        except Exception as e:
-            log(f"a8w8 decode bench failed: "
-                f"{type(e).__name__}: {str(e)[:300]}")
-            extras["decode_a8w8_error"] = str(e)[:160]
+        for q in (None, "a8w8", "w4a16"):
+            pfx = "decode" + (f"_{q}" if q else "")
+            try:
+                r = run_decode(quant=q)
+                extras[f"{pfx}_tokens_per_sec_per_chip"] = \
+                    round(r["tok_s"], 1)
+                extras[f"{pfx}_model"] = r["model"]
+                extras[f"{pfx}_vs_hbm_roofline"] = r["vs_roofline"]
+                extras[f"{pfx}_roofline_tok_s"] = r["roofline_tok_s"]
+                extras[f"{pfx}_token_latency_ms"] = r["latency"]
+            except Exception as e:
+                log(f"{pfx} bench failed: "
+                    f"{type(e).__name__}: {str(e)[:300]}")
+                extras[f"{pfx}_error"] = str(e)[:160]
         try:
             extras["speculative"] = run_speculative()
         except Exception as e:

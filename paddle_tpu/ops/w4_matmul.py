@@ -1,0 +1,95 @@
+"""Weight-only int4 matmul for decode (W4A16).
+
+Decode reads every weight byte each step; int4 halves that traffic vs
+int8 (a8w8) and quarters it vs bf16 — the HBM roofline moves up
+accordingly (bench.decode_roofline_tok_s). Storage: per-out-channel
+symmetric int4 (q in [-7, 7], scale = amax/7), two nibbles packed per
+int8 byte along the IN dim with a +8 offset (nibble value 1..15).
+
+The Pallas kernel unpacks nibbles in VMEM (VPU int ops) and feeds the
+MXU a bf16 tile — the dequantized weight never exists in HBM. The jnp
+reference path computes the identical math (used on CPU and as the
+fallback, and to verify the kernel bit-for-bit in interpret mode).
+
+Reference counterpart: weight-only quant epilogues in
+paddle/phi/kernels fused-matmul int8 paths — the int4 variant is the
+TPU-side extension of the same bandwidth story.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ._fallback import kernel_fallback
+
+__all__ = ["quantize_w4", "w4_matmul"]
+
+
+def quantize_w4(w):
+    """w [in, out] float -> (packed [ceil(in/2), out] int8 nibbles,
+    scale [out] f32). Odd `in` is zero-padded (nibble 8 == value 0).
+    Quantization itself is the shared recipe (quantization.quantize_weight
+    with bits=4); only the nibble packing lives here."""
+    from ..quantization import quantize_weight
+    w = jnp.asarray(w)
+    K, N = w.shape
+    q, scale = quantize_weight(w, axis=0, bits=4)
+    q = (q.astype(jnp.int32) + 8).astype(jnp.uint8)    # 1..15
+    if K % 2:
+        q = jnp.concatenate([q, jnp.full((1, N), 8, jnp.uint8)], axis=0)
+    lo, hi = q[0::2], q[1::2]                # even rows -> low nibble
+    return (lo | (hi << 4)).astype(jnp.int8), \
+        scale.reshape(-1).astype(jnp.float32)
+
+
+def _unpack_w4(packed, K):
+    """packed [K2, N] int8 -> dequant-ready int [K, N] in [-7, 7]."""
+    p = packed.astype(jnp.int32) & 0xFF      # int8 -> raw byte
+    lo = (p & 0xF) - 8
+    hi = ((p >> 4) & 0xF) - 8
+    K2, N = p.shape
+    return jnp.stack([lo, hi], axis=1).reshape(2 * K2, N)[:K]
+
+
+def _w4_ref(x, packed, scale, K):
+    w = _unpack_w4(packed, K).astype(jnp.float32) * scale
+    return (x.astype(jnp.float32) @ w).astype(x.dtype)
+
+
+def _w4_kernel(x_ref, p_ref, s_ref, o_ref, *, K):
+    x = x_ref[...].astype(jnp.float32)       # [S, K]
+    w = _unpack_w4(p_ref[...], K)            # [K, Nt] int
+    wf = w.astype(jnp.float32) * s_ref[...].astype(jnp.float32)
+    o_ref[...] = jnp.dot(
+        x, wf, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def w4_matmul(x, packed, scale, K, block_n=256):
+    """x [..., K] @ int4-packed weight -> [..., N]; dequant happens
+    per-tile in VMEM (Pallas), never in HBM. Falls back to the jnp
+    reference off-TPU or when the shape doesn't tile."""
+    from jax.experimental import pallas as pl
+
+    lead = x.shape[:-1]
+    xf = x.reshape(-1, K)
+    S = xf.shape[0]
+    K2, N = packed.shape
+    if N % block_n or K % 2 or S > 4096:
+        return _w4_ref(xf, packed, scale, K).reshape(*lead, N)
+    try:
+        out = pl.pallas_call(
+            functools.partial(_w4_kernel, K=K),
+            grid=(N // block_n,),
+            in_specs=[
+                pl.BlockSpec((S, K), lambda i: (0, 0)),
+                pl.BlockSpec((K2, block_n), lambda i: (0, i)),
+                pl.BlockSpec((block_n,), lambda i: (i,)),
+            ],
+            out_specs=pl.BlockSpec((S, block_n), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((S, N), x.dtype),
+            interpret=jax.default_backend() == "cpu",
+        )(xf, packed, scale)
+        return out.reshape(*lead, N)
+    except Exception as e:
+        kernel_fallback("w4_matmul", e)
+        return _w4_ref(xf, packed, scale, K).reshape(*lead, N)

@@ -16,12 +16,17 @@ __all__ = ["quantize_weight", "dequantize_weight", "QuantizedLinear",
            "quantize_model", "QuantizedLinearA8W8", "PTQ", "QAT"]
 
 
-def quantize_weight(w, axis=0):
-    """w: [in, out] float → (int8 w_q, float32 scale[out]) per-channel."""
+def quantize_weight(w, axis=0, bits=8):
+    """w: [in, out] float → (int8 w_q, float32 scale[out]) per-channel
+    symmetric; `bits` sets the grid (8 → ±127, 4 → ±7) — the ONE
+    quantization recipe (PTQ, QAT export, serving a8w8 and the int4
+    packer all come through here)."""
+    qmax = float(2 ** (bits - 1) - 1)
     wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
     amax = jnp.max(jnp.abs(wv.astype(jnp.float32)), axis=axis, keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
-    q = jnp.clip(jnp.round(wv.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(amax / qmax, 1e-8)
+    q = jnp.clip(jnp.round(wv.astype(jnp.float32) / scale),
+                 -qmax, qmax).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
 
 

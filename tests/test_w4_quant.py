@@ -1,0 +1,94 @@
+"""Weight-only int4 decode quantization (ops/w4_matmul.py + serving
+quant='w4a16')."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPT, gpt_tiny
+from paddle_tpu.ops.w4_matmul import _w4_ref, quantize_w4, w4_matmul
+from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+
+
+def test_pack_roundtrip_exact():
+    rng = np.random.RandomState(0)
+    w = rng.randn(10, 8).astype("float32")          # odd in-dim: padded
+    packed, scale = quantize_w4(w)
+    assert packed.shape == (5, 8) and packed.dtype == jnp.int8
+    from paddle_tpu.ops.w4_matmul import _unpack_w4
+    q = np.asarray(_unpack_w4(packed, 10))
+    assert q.min() >= -7 and q.max() <= 7
+    # dequantized weight within one int4 step of the original
+    deq = q.astype("float32") * np.asarray(scale)
+    assert np.max(np.abs(deq - w)) <= np.asarray(scale).max() * 0.5 + 1e-6
+
+
+def test_kernel_matches_reference():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 64).astype("float32"))
+    w = rng.randn(64, 256).astype("float32")
+    packed, scale = quantize_w4(w)
+    got = w4_matmul(x, packed, scale, 64, block_n=128)   # Pallas interpret
+    ref = _w4_ref(x, packed, scale, 64)                  # jnp path
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # and both track the fp matmul within int4 quantization error:
+    # per-weight err ~ scale/sqrt(12) = amax/(7*3.46) ~ 12% of sigma_w
+    # for N(0,1) weights, which is also the output's relative error
+    fp = np.asarray(x) @ w
+    rel = np.abs(np.asarray(got) - fp).mean() / np.abs(fp).mean()
+    assert rel < 0.2, rel
+
+
+def test_w4a16_decode_runs_and_tracks_fp():
+    paddle.seed(7)
+    from paddle_tpu.distributed import build_mesh
+    build_mesh(dp=1)
+    cfg = gpt_tiny(max_seq_len=128, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+
+    def run(quant):
+        dec = PagedGPTDecoder(model, num_pages=32, page_size=16,
+                              max_batch=1, quant=quant)
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=6)
+        rid = eng.submit(np.asarray([3, 141, 59], np.int32))
+        return eng.run()[rid]
+
+    toks = run("w4a16")
+    assert len(toks) == 6
+    assert all(0 <= t < cfg.vocab_size for t in toks)
+    # int4 is lossy but the tiny model's greedy path usually survives a
+    # few steps: at least the FIRST token matches fp decode
+    assert toks[0] == run(None)[0]
+
+
+def test_w4a16_composes_with_tensor_parallel():
+    """Packed qkv keeps the head-major rank so the tp sharding specs
+    apply to w4 exactly as to fp weights; tokens match single-device."""
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.mesh import get_mesh, set_mesh
+    paddle.seed(7)
+    prev = get_mesh(create_default=False)
+    try:
+        build_mesh(dp=1)
+        cfg = gpt_tiny(max_seq_len=128, dtype="float32", remat=False)
+        model = GPT(cfg)
+        model.eval()
+
+        def run(mesh):
+            dec = PagedGPTDecoder(model, num_pages=32, page_size=16,
+                                  max_batch=1, quant="w4a16", mesh=mesh)
+            eng = ContinuousBatchingEngine(dec, max_new_tokens=6)
+            rid = eng.submit(np.asarray([3, 141, 59], np.int32))
+            return eng.run()[rid], dec
+
+        single, _ = run(None)
+        mesh = build_mesh(tp=4, dp=2)
+        sharded, dec = run(mesh)
+        assert sharded == single
+        packed, scale = dec.weights["qkv_w"]
+        assert "tp" in str(packed.sharding.spec)
+        assert "tp" in str(scale.sharding.spec)
+    finally:
+        set_mesh(prev)
