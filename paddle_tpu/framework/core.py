@@ -439,7 +439,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (paddle.framework.Parameter / fluid ParamBase)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed",
+                 "need_clip", "is_sparse_grad")
 
     def __init__(self, value, dtype=None, name=None, trainable=True):
         super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)

@@ -55,6 +55,10 @@ class Embedding(Layer):
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 1.0))
+        # sparse=True marks SelectedRows-style gradients in the
+        # reference; here it opts the weight into Adam lazy_mode's
+        # frozen-zero-row semantics
+        self.weight.is_sparse_grad = bool(sparse)
         if self._padding_idx is not None:
             self.weight._value = self.weight._value.at[self._padding_idx].set(0.0)
 

@@ -112,10 +112,15 @@ class Optimizer:
                 gv = gv + self._wd * pv
             rule_slots = self._slots_to_f32({k: v for k, v in slots.items() if k != "master"})
             self._current_param_name = getattr(p, "name", None)
+            self._current_param_obj = p
+            self._last_lazy_mask = None
             new_p, new_slots = self._update_rule(pv, gv, rule_slots, p_lr, self._step_count)
             new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
-                new_p = new_p - p_lr * self._wd * pv
+                decay = p_lr * self._wd * pv
+                if getattr(self, "_last_lazy_mask", None) is not None:
+                    decay = decay * self._last_lazy_mask
+                new_p = new_p - decay
             if master is not None:
                 slots["master"] = new_p
             slots.update(new_slots)
@@ -170,10 +175,15 @@ class Optimizer:
             if self._wd and not self._decoupled_wd():
                 gv = gv + self._wd * pv
             self._current_param_name = name
+            self._current_param_obj = None
+            self._last_lazy_mask = None
             new_p, new_slots = self._update_rule(pv, gv, self._slots_to_f32(slots), lr, step)
             new_slots = self._slots_from_f32(new_slots)
             if self._wd and self._decoupled_wd():
-                new_p = new_p - lr * self._wd * pv
+                decay = lr * self._wd * pv
+                if getattr(self, "_last_lazy_mask", None) is not None:
+                    decay = decay * self._last_lazy_mask
+                new_p = new_p - decay
             out_slots = dict(new_slots)
             if master is not None:
                 out_slots["master"] = new_p
@@ -297,6 +307,32 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # reference lazy_mode updates only rows present in the sparse
+        # (SelectedRows) gradient — i.e. it only affects Embedding(
+        # sparse=True) weights; dense params behave normally. TPU
+        # gradients are dense scatters where untouched embedding rows
+        # are exact zeros, so the native rendering freezes zero rows
+        # (params, moments AND decoupled decay) of SPARSE-marKED params
+        # only. The eager path reads param.is_sparse_grad; the compiled
+        # path needs names (pass parameters= or set_lazy_params()).
+        self._lazy = bool(lazy_mode)
+        self._lazy_names = {
+            getattr(p, "name", None) for p in (parameters or [])
+            if getattr(p, "is_sparse_grad", False)} - {None}
+
+    def set_lazy_params(self, names):
+        """Names of sparse-embedding params for lazy_mode in the
+        functional/compiled path (state_pytree keys)."""
+        self._lazy_names = set(names)
+
+    def _lazy_applies(self):
+        if not self._lazy:
+            return False
+        name = self._current_param_name
+        if name in self._lazy_names:
+            return True
+        p = getattr(self, "_current_param_obj", None)
+        return bool(getattr(p, "is_sparse_grad", False))
 
     def _update_rule(self, p, g, slots, lr, step):
         b1, b2 = self._beta1, self._beta2
@@ -306,6 +342,14 @@ class Adam(Optimizer):
         mhat = m / (1 - b1 ** stepf)
         vhat = v / (1 - b2 ** stepf)
         new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._last_lazy_mask = None
+        if self._lazy_applies() and jnp.ndim(g) >= 2:
+            touched = jnp.any(g != 0, axis=tuple(range(1, jnp.ndim(g))))
+            mask = touched.reshape((-1,) + (1,) * (jnp.ndim(g) - 1))
+            new_p = jnp.where(mask, new_p, p)
+            m = jnp.where(mask, m, slots["moment1"])
+            v = jnp.where(mask, v, slots["moment2"])
+            self._last_lazy_mask = mask
         return new_p, {"moment1": m, "moment2": v}
 
 

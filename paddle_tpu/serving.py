@@ -187,27 +187,22 @@ class PagedGPTDecoder:
             "fc2_w": stack("blocks.{}.fc2.weight"),
             "fc2_b": stack("blocks.{}.fc2.bias"),
         }
-        if quant == "a8w8":
+        if quant:
+            if quant == "w4a16":
+                from .ops.w4_matmul import quantize_w4 as quantizer
+            else:
+                quantizer = _quantize_w
             for k in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
                 v = w[k]
                 shp = v.shape
                 if v.ndim > 3:          # qkv head-major: flatten to 2-D
                     v = v.reshape(shp[0], shp[1], -1)
-                q, s = jax.vmap(_quantize_w)(v)
-                w[k] = (q.reshape(shp), s.reshape((shp[0],) + shp[2:]))
-        elif quant == "w4a16":
-            from .ops.w4_matmul import quantize_w4
-            for k in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
-                v = w[k]
-                shp = v.shape
-                if v.ndim > 3:          # qkv head-major: flatten to 2-D
-                    v = v.reshape(shp[0], shp[1], -1)
-                packed, s = jax.vmap(quantize_w4)(v)
-                # restore the head-major rank (packed in-dim is h/2) so
-                # _shard_for_tp's specs apply to w4 exactly as to fp;
-                # the scan slices the tuple leaf-wise per layer
-                w[k] = (packed.reshape((shp[0], packed.shape[1])
-                                       + shp[2:]),
+                q, s = jax.vmap(quantizer)(v)
+                # restore the head-major rank (w4's packed in-dim is
+                # h/2) so _shard_for_tp's specs apply to both quant
+                # modes exactly as to fp; the scan slices tuples
+                # leaf-wise per layer
+                w[k] = (q.reshape((shp[0], q.shape[1]) + shp[2:]),
                         s.reshape((shp[0],) + shp[2:]))
         self.weights = w
         self.wte = jnp.asarray(state["wte.weight"])

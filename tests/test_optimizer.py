@@ -149,3 +149,93 @@ def test_multi_precision_master_weights():
     slots = opt._accumulators[id(p)]
     assert "master" in slots
     assert slots["master"].dtype == jnp.float32
+
+
+def test_adam_lazy_mode_freezes_untouched_rows():
+    """Reference Adam(lazy_mode=True) updates only rows present in the
+    sparse gradient; dense-scatter analog: exact-zero rows keep params
+    AND moments frozen, so stale momentum never moves untouched
+    embedding rows."""
+    import numpy as np
+
+    def one(lazy, sparse=True):
+        paddle.seed(0)
+        emb = paddle.nn.Embedding(8, 4, sparse=sparse)
+        opt = paddle.optimizer.Adam(learning_rate=0.5, lazy_mode=lazy,
+                                    parameters=emb.parameters())
+
+        def step(ids):
+            emb.weight.clear_grad()
+            out = emb(paddle.to_tensor(np.asarray([ids], np.int64)))
+            (out ** 2).sum().backward()
+            opt.step()
+        step([0, 1])        # build momentum on rows 0/1
+        before = emb.weight.numpy().copy()
+        step([2])           # rows 0/1 untouched this step
+        after = emb.weight.numpy()
+        return before, after
+
+    b, a = one(lazy=True)
+    np.testing.assert_array_equal(b[0], a[0])   # frozen under lazy
+    np.testing.assert_array_equal(b[1], a[1])
+    assert not np.allclose(b[2], a[2])          # touched row moved
+    b, a = one(lazy=False)
+    # stale momentum moves rows 0/1 without lazy mode
+    assert not np.allclose(b[0], a[0])
+    # lazy only affects sparse-marked embeddings (reference: dense
+    # gradients behave normally even under lazy_mode)
+    b, a = one(lazy=True, sparse=False)
+    assert not np.allclose(b[0], a[0])
+
+
+def test_adamw_lazy_mode_skips_decay_on_frozen_rows():
+    import numpy as np
+    paddle.seed(0)
+    emb = paddle.nn.Embedding(8, 4, sparse=True)
+    opt = paddle.optimizer.AdamW(learning_rate=0.5, weight_decay=0.5,
+                                 lazy_mode=True,
+                                 parameters=emb.parameters())
+
+    def step(ids):
+        emb.weight.clear_grad()
+        out = emb(paddle.to_tensor(np.asarray([ids], np.int64)))
+        (out ** 2).sum().backward()
+        opt.step()
+    step([0, 1])
+    before = emb.weight.numpy().copy()
+    step([2])
+    after = emb.weight.numpy()
+    # decoupled decay must NOT shrink frozen rows
+    np.testing.assert_array_equal(before[0], after[0])
+    np.testing.assert_array_equal(before[1], after[1])
+
+
+def test_adam_lazy_mode_compiled_path():
+    """set_lazy_params enables lazy semantics inside the jitted Trainer
+    step (the functional path has names, not Parameter objects)."""
+    import numpy as np
+
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    paddle.seed(0)
+    build_mesh(dp=1)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = paddle.nn.Embedding(8, 4, sparse=True)
+
+        def forward(self, ids):
+            return (self.emb(ids) ** 2).sum()
+
+    m = M()
+    opt = paddle.optimizer.Adam(learning_rate=0.5, lazy_mode=True)
+    opt.set_lazy_params(["emb.weight"])
+    tr = Trainer(m, opt, lambda mm, b: mm(paddle.to_tensor(b["ids"])))
+    tr.step({"ids": np.asarray([[0, 1]], np.int64)})
+    before = np.asarray(tr.params["emb.weight"]).copy()
+    tr.step({"ids": np.asarray([[2, 2]], np.int64)})
+    after = np.asarray(tr.params["emb.weight"])
+    np.testing.assert_array_equal(before[0], after[0])
+    np.testing.assert_array_equal(before[1], after[1])
+    assert not np.allclose(before[2], after[2])
