@@ -1,7 +1,7 @@
 """Text-in/text-out GPT serving demo: WordPiece tokenizer (native C++
 runtime) + continuous-batching paged-KV decode engine.
 
-Run:  JAX_PLATFORMS=cpu PYTHONPATH=. python examples/serve_gpt.py
+Run:  JAX_PLATFORMS=cpu PYTHONPATH=. python examples/serve_gpt.py [a8w8|w4a16]
 (The model is randomly initialized — outputs are gibberish tokens; the
 point is the full serving path: tokenize -> prefill -> batched sampled
 decode -> detokenize. Swap in converted weights via
@@ -26,6 +26,8 @@ def build_tokenizer():
 
 
 def main():
+    import sys
+    quant = sys.argv[1] if len(sys.argv) > 1 else None
     paddle.seed(0)
     build_mesh(dp=1)
     tok, vocab_size = build_tokenizer()
@@ -33,7 +35,7 @@ def main():
                          dtype="float32", remat=False))
     model.eval()
     dec = PagedGPTDecoder(model, num_pages=64, page_size=16, max_batch=4,
-                          temperature=0.8, top_p=0.95, seed=0)
+                          temperature=0.8, top_p=0.95, seed=0, quant=quant)
     eng = ContinuousBatchingEngine(dec, max_new_tokens=16)
 
     prompts = ["the quick brown fox", "tpu chips compile fast",
