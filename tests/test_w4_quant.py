@@ -92,3 +92,22 @@ def test_w4a16_composes_with_tensor_parallel():
         assert "tp" in str(scale.sharding.spec)
     finally:
         set_mesh(prev)
+
+
+def test_quantized_linear_w4_layer():
+    """quantize_model(weight_bits=4) swaps Linears for the int4 layer;
+    outputs track fp within int4 error and HBM weight bytes halve vs
+    int8 (packed buffer is [in/2, out])."""
+    from paddle_tpu.quantization import QuantizedLinearW4, quantize_model
+    paddle.seed(0)
+    m = paddle.nn.Sequential(paddle.nn.Linear(64, 128), paddle.nn.ReLU(),
+                             paddle.nn.Linear(128, 64))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 64).astype("float32"))
+    fp = m(x).numpy()
+    quantize_model(m, min_out_features=4, weight_bits=4)
+    assert isinstance(m[0], QuantizedLinearW4)
+    assert m[0].weight_q.shape == [32, 128]        # two nibbles per byte
+    got = m(x).numpy()
+    rel = np.abs(got - fp).mean() / (np.abs(fp).mean() + 1e-9)
+    assert rel < 0.3, rel
