@@ -17,6 +17,8 @@ from ..framework.device import (  # noqa: F401
 __all__ = ["set_device", "get_device", "device_count", "TPUPlace", "CPUPlace",
            "CustomPlace", "IPUPlace", "MLUPlace", "XPUPlace",
            "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "memory_allocated", "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved",
            "is_compiled_with_cinn", "is_compiled_with_ipu",
            "is_compiled_with_mlu", "is_compiled_with_npu",
            "is_compiled_with_rocm", "is_compiled_with_xpu",
@@ -72,8 +74,44 @@ def get_available_device():
     return [f"{d.platform}:{d.id}" for d in jax.devices()]
 
 
+def _memory_stats(device=None):
+    """PJRT per-device memory stats ({} when the backend exposes none —
+    CPU does; TPU reports bytes_in_use/peak_bytes_in_use/bytes_limit)."""
+    import jax
+    idx = 0
+    if isinstance(device, str) and ":" in device:
+        idx = int(device.split(":")[1])
+    elif isinstance(device, int):
+        idx = device
+    try:
+        return jax.local_devices()[idx].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    """Live device-memory bytes (reference
+    paddle.device.cuda.memory_allocated; PJRT bytes_in_use here)."""
+    return int(_memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    """Peak device-memory bytes (PJRT peak_bytes_in_use)."""
+    return int(_memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    """Total allocator pool (PJRT pool_bytes, else bytes_limit)."""
+    st = _memory_stats(device)
+    return int(st.get("pool_bytes", st.get("bytes_limit", 0)))
+
+
+max_memory_reserved = memory_reserved
+
+
 class cuda:
-    """Namespace parity for paddle.device.cuda on TPU builds."""
+    """Namespace parity for paddle.device.cuda on TPU builds (memory
+    queries answer for the actual accelerator via PJRT memory_stats)."""
 
     @staticmethod
     def device_count():
@@ -83,3 +121,8 @@ class cuda:
     def synchronize(device=None):
         import jax
         (jax.device_put(0) + 0).block_until_ready()
+
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(memory_reserved)

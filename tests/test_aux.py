@@ -152,3 +152,15 @@ def test_elastic_heartbeat_and_resume(tmp_path):
         assert em.resume_step() in (None, 0)
     finally:     # don't leave the flag-setting handler on the pytest process
         signal.signal(signal.SIGTERM, prev)
+
+
+def test_device_memory_queries():
+    """paddle.device.cuda memory parity surfaces answer from PJRT
+    memory_stats (CPU backend reports none -> zeros, no crash)."""
+    import paddle_tpu.device as device
+    for fn in (device.memory_allocated, device.max_memory_allocated,
+               device.memory_reserved, device.cuda.memory_allocated,
+               device.cuda.max_memory_allocated):
+        v = fn()
+        assert isinstance(v, int) and v >= 0
+    assert device.memory_allocated("tpu:0") >= 0   # device-string form
