@@ -76,15 +76,21 @@ def get_available_device():
 
 def _memory_stats(device=None):
     """PJRT per-device memory stats ({} when the backend exposes none —
-    CPU does; TPU reports bytes_in_use/peak_bytes_in_use/bytes_limit)."""
+    CPU does; TPU reports bytes_in_use/peak_bytes_in_use/bytes_limit).
+    Invalid device ids raise (reference paddle.device.cuda behavior) —
+    only a stats-less backend degrades to zeros."""
     import jax
     idx = 0
     if isinstance(device, str) and ":" in device:
         idx = int(device.split(":")[1])
     elif isinstance(device, int):
         idx = device
+    devices = jax.local_devices()
+    if not 0 <= idx < len(devices):
+        raise ValueError(
+            f"invalid device index {idx}: {len(devices)} local device(s)")
     try:
-        return jax.local_devices()[idx].memory_stats() or {}
+        return devices[idx].memory_stats() or {}
     except Exception:
         return {}
 
@@ -106,7 +112,14 @@ def memory_reserved(device=None):
     return int(st.get("pool_bytes", st.get("bytes_limit", 0)))
 
 
-max_memory_reserved = memory_reserved
+def max_memory_reserved(device=None):
+    """Peak allocator pool (PJRT peak_pool_bytes where exposed; pools
+    that never shrink fall back to the current/limit figures)."""
+    st = _memory_stats(device)
+    return int(st.get("peak_pool_bytes",
+                      st.get("peak_bytes_reserved",
+                             st.get("pool_bytes",
+                                    st.get("bytes_limit", 0)))))
 
 
 class cuda:
@@ -125,4 +138,4 @@ class cuda:
     memory_allocated = staticmethod(memory_allocated)
     max_memory_allocated = staticmethod(max_memory_allocated)
     memory_reserved = staticmethod(memory_reserved)
-    max_memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)

@@ -164,3 +164,7 @@ def test_device_memory_queries():
         v = fn()
         assert isinstance(v, int) and v >= 0
     assert device.memory_allocated("tpu:0") >= 0   # device-string form
+    assert device.max_memory_reserved() >= 0
+    import pytest
+    with pytest.raises(ValueError, match="invalid device"):
+        device.memory_allocated("tpu:99")
