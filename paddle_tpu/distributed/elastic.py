@@ -26,7 +26,10 @@ __all__ = ["ElasticManager", "enable_elastic", "launch_elastic",
 class ElasticManager:
     def __init__(self, checkpoint_dir, heartbeat_path=None, interval_s=30):
         self.checkpoint_dir = checkpoint_dir
-        self.heartbeat_path = heartbeat_path or os.path.join(checkpoint_dir, "heartbeat.json")
+        # per-node supervisors export their node's beat file path
+        self.heartbeat_path = heartbeat_path \
+            or os.environ.get("PADDLE_ELASTIC_HEARTBEAT") \
+            or os.path.join(checkpoint_dir, "heartbeat.json")
         self.interval_s = interval_s
         self._last_beat = 0.0
         self._should_exit = False
@@ -67,6 +70,38 @@ def enable_elastic(args=None, distribute_mode=None):
     return None
 
 
+def _clear_beat(heartbeat_path):
+    """A dead incarnation's heartbeat must not count for the new one."""
+    if heartbeat_path and os.path.exists(heartbeat_path):
+        try:
+            os.remove(heartbeat_path)
+        except OSError:
+            pass
+
+
+def _beat_age(heartbeat_path, started):
+    """Seconds since the last worker heartbeat (clock starts at launch,
+    so a worker that hangs BEFORE its first beat is detected too)."""
+    last = started
+    try:
+        last = max(last, os.path.getmtime(heartbeat_path))
+    except OSError:
+        pass  # beat file not written yet (or deleted mid-check)
+    return time.time() - last
+
+
+def _stop_group(proc):
+    """Stop a distributed.launch group: SIGINT (launch forwards it to
+    the workers — it has no SIGTERM handler, so SIGTERM would orphan
+    them), escalate to SIGKILL if the group won't die."""
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
 def launch_elastic(training_script, script_args=(), nproc_per_node=1,
                    cpu_devices_per_rank=0, max_restarts=3,
                    heartbeat_path=None, heartbeat_timeout_s=None,
@@ -91,13 +126,7 @@ def launch_elastic(training_script, script_args=(), nproc_per_node=1,
         if log_dir:
             cmd += ["--log_dir", log_dir]
         cmd += [training_script, *script_args]
-        # a dead incarnation's heartbeat must not count for (or against)
-        # the new one
-        if heartbeat_path and os.path.exists(heartbeat_path):
-            try:
-                os.remove(heartbeat_path)
-            except OSError:
-                pass
+        _clear_beat(heartbeat_path)
         started = time.time()
         proc = subprocess.Popen(cmd, env=env)
         reason = None
@@ -108,22 +137,10 @@ def launch_elastic(training_script, script_args=(), nproc_per_node=1,
                     reason = f"worker group exited rc={rc}"
                 break
             if heartbeat_timeout_s and heartbeat_path:
-                # clock starts at launch: a worker that hangs BEFORE its
-                # first beat is detected too
-                last = started
-                try:
-                    last = max(last, os.path.getmtime(heartbeat_path))
-                except OSError:
-                    pass  # beat file not written yet (or deleted mid-check)
-                age = time.time() - last
+                age = _beat_age(heartbeat_path, started)
                 if age > heartbeat_timeout_s:
                     reason = f"heartbeat stale for {age:.0f}s"
-                    proc.send_signal(signal.SIGINT)  # launch forwards it
-                    try:
-                        proc.wait(timeout=30)
-                    except subprocess.TimeoutExpired:
-                        proc.kill()
-                        proc.wait()
+                    _stop_group(proc)
                     break
             time.sleep(poll_s)
         if reason is None:
@@ -190,13 +207,16 @@ def launch_elastic_node(node_rank, nnodes, training_script, script_args=(),
                         cpu_devices_per_rank=0, max_restarts=3,
                         log_dir=None, job_id="elastic", env=None,
                         poll_s=0.2, publish_timeout_s=600,
-                        coordinator_host=None):
+                        coordinator_host=None, heartbeat_path=None,
+                        heartbeat_timeout_s=None):
     """ONE host's supervisor in a cross-host elastic job; run one per
     machine against a shared coord_dir (NFS/etcd-mount). Node 0 publishes
     the jax coordinator address for each epoch; every node launches its
     slice of the job via distributed.launch (--nnodes/--rank/--master),
-    watches for local group death (bump the epoch) and for the epoch
-    moving (a peer died: kill local group, relaunch)."""
+    watches for local group death OR a stale heartbeat file (bump the
+    epoch) and for the epoch moving (a peer died/hung: kill local group,
+    relaunch) — the reference manager's etcd-lease fault watch, file-
+    rendered. Workers beat via ElasticManager(heartbeat_path=...)."""
     if coord_dir is None:
         raise ValueError("coord_dir (shared across nodes) is required")
     os.makedirs(coord_dir, exist_ok=True)
@@ -229,18 +249,20 @@ def launch_elastic_node(node_rank, nnodes, training_script, script_args=(),
         if log_dir:
             cmd += ["--log_dir", log_dir]
         cmd += [training_script, *script_args]
-        proc = subprocess.Popen(cmd, env=env)
+        _clear_beat(heartbeat_path)
+        started = time.time()
+        run_env = dict(env) if env is not None else dict(os.environ)
+        if heartbeat_path:
+            # workers find THIS node's beat file via the env
+            # (ElasticManager defaults its path from it)
+            run_env["PADDLE_ELASTIC_HEARTBEAT"] = heartbeat_path
+        proc = subprocess.Popen(cmd, env=run_env)
         while True:
             rc = proc.poll()
             cur = _read_epoch(coord_dir)
             if cur != epoch:
-                # a peer's group died: whole-job restart
-                proc.send_signal(signal.SIGTERM)
-                try:
-                    proc.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    proc.wait()
+                # a peer's group died or hung: whole-job restart
+                _stop_group(proc)
                 reason = f"peer bumped epoch {epoch}->{cur}"
                 break
             if rc is not None:
@@ -249,6 +271,16 @@ def launch_elastic_node(node_rank, nnodes, training_script, script_args=(),
                 reason = f"node {node_rank} group exited rc={rc}"
                 _bump_epoch(coord_dir, epoch, reason)
                 break
+            if heartbeat_timeout_s and heartbeat_path:
+                age = _beat_age(heartbeat_path, started)
+                if age > heartbeat_timeout_s:
+                    # a WEDGED local group never exits: detect via the
+                    # workers' heartbeat file and restart the whole job
+                    _stop_group(proc)
+                    reason = (f"node {node_rank} heartbeat stale "
+                              f"for {age:.0f}s")
+                    _bump_epoch(coord_dir, epoch, reason)
+                    break
             time.sleep(poll_s)
         restarts += 1
         if restarts > max_restarts:
@@ -261,14 +293,21 @@ def launch_elastic_multihost(training_script, script_args=(), nnodes=2,
                              **node_kw):
     """In-process harness over launch_elastic_node: one supervisor THREAD
     per simulated host (production runs one launch_elastic_node per
-    machine). Returns the max restart count across nodes."""
+    machine, where each machine naturally has its own heartbeat file).
+    A shared heartbeat_path is made per-node here (suffix .n{rank}) —
+    one live node's beats must not mask a wedged peer. Returns the max
+    restart count across nodes."""
     import threading
     results = {}
+    beat = node_kw.pop("heartbeat_path", None)
 
     def run(rank):
+        kw = dict(node_kw)
+        if beat:
+            kw["heartbeat_path"] = f"{beat}.n{rank}"
         try:
             results[rank] = launch_elastic_node(
-                rank, nnodes, training_script, script_args, **node_kw)
+                rank, nnodes, training_script, script_args, **kw)
         except BaseException as e:   # surface to the caller's thread
             results[rank] = e
 

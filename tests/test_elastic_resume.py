@@ -233,3 +233,26 @@ def test_kill_and_resume_two_process(tmp_path):
     assert set(first_seen) == set(range(1, total_steps + 1))
     # the run completed after resume
     assert max(first_seen) == total_steps
+
+
+def test_multihost_heartbeat_detects_wedged_node(tmp_path):
+    """A node whose workers HANG (no exit, no beats) is detected by its
+    own supervisor's heartbeat watch; the epoch bump restarts the peer
+    too. With max_restarts=0 both supervisors raise."""
+    from paddle_tpu.distributed.elastic import launch_elastic_multihost
+
+    script = tmp_path / "hang.py"
+    script.write_text("import time\ntime.sleep(3600)\n")
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="heartbeat stale|failed"):
+        launch_elastic_multihost(
+            str(script), nnodes=2, coord_dir=str(tmp_path / "coord"),
+            nproc_per_node=1, max_restarts=0,
+            heartbeat_path=str(tmp_path / "beat.json"),
+            heartbeat_timeout_s=5, env={
+                **{k: v for k, v in os.environ.items()
+                   if k not in ("XLA_FLAGS", "JAX_PLATFORMS")},
+                "PYTHONPATH": REPO + os.pathsep +
+                os.environ.get("PYTHONPATH", "")})
+    assert time.time() - t0 < 120
+    assert (tmp_path / "coord" / "reason.e1").exists()
