@@ -5,6 +5,10 @@ each loader yields deterministic synthetic samples with the right shapes
 and dtypes (same contract the reference's readers expose). The modern path
 is paddle_tpu.vision.datasets / paddle_tpu.text with io.DataLoader.
 """
-from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+from . import (cifar, common, conll05, flowers, imdb,  # noqa: F401
+               imikolov, mnist, movielens, uci_housing, voc2012,
+               wmt14, wmt16)
 
-__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common"]
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common",
+           "conll05", "flowers", "imikolov", "movielens", "voc2012",
+           "wmt14", "wmt16"]

@@ -1,0 +1,31 @@
+"""reference python/paddle/dataset/conll05.py reader API — delegates to
+the real SRL parser in paddle_tpu.text.Conll05st."""
+from ..text import Conll05st as _Conll05st
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+
+_CACHE = {}
+
+
+def _ds(**kw):
+    key = tuple(sorted(kw.items()))
+    if key not in _CACHE:
+        _CACHE[key] = _Conll05st(**kw)
+    return _CACHE[key]
+
+
+def get_dict(**kw):
+    return _ds(**kw).get_dict()
+
+
+def get_embedding(**kw):
+    return _ds(**kw).get_embedding()
+
+
+def test(**kw):
+    def read():
+        ds = _ds(**kw)
+        for i in range(len(ds)):
+            yield ds[i]
+    return read

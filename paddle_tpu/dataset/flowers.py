@@ -1,0 +1,44 @@
+"""reference python/paddle/dataset/flowers.py reader API — delegates to
+vision.datasets.Flowers for real archives (102flowers.tgz +
+imagelabels.mat + setid.mat local paths); synthetic fallback otherwise."""
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+
+def _reader(mode, n, files, mapper=None, cycle=False):
+    def one_pass():
+        if files.get("data_file"):
+            from ..vision.datasets import Flowers
+            ds = Flowers(mode=mode, **files)
+            for i in range(len(ds)):
+                img, label = ds[i]
+                yield np.asarray(img), int(label)
+            return
+        rng = np.random.RandomState(
+            {"train": 0, "test": 1, "valid": 2}[mode])
+        for _ in range(n):
+            yield rng.rand(3 * 32 * 32).astype("float32"), \
+                int(rng.randint(0, 102))
+
+    def read():
+        while True:
+            for sample in one_pass():
+                yield mapper(sample) if mapper is not None else sample
+            if not cycle:
+                break
+    return read
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          n=256, **files):
+    return _reader("train", n, files, mapper, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+         n=64, **files):
+    return _reader("test", n, files, mapper, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, n=64, **files):
+    return _reader("valid", n, files, mapper)

@@ -1,0 +1,37 @@
+"""reference python/paddle/dataset/imikolov.py reader API — delegates to
+the real PTB parser in paddle_tpu.text.Imikolov (data_file= points at a
+local simple-examples tarball; synthetic fallback otherwise)."""
+from ..text import Imikolov as _Imikolov
+
+__all__ = ["build_dict", "train", "test"]
+
+N = 5  # reference default ngram order
+
+
+def build_dict(min_word_freq=50, data_file=None):
+    return _Imikolov(data_file=data_file, data_type="NGRAM",
+                     window_size=N, min_word_freq=min_word_freq).word_idx
+
+
+def _reader(word_idx, n, mode, data_file, min_word_freq):
+    def read():
+        ds = _Imikolov(data_file=data_file, data_type="NGRAM",
+                       window_size=n, mode=mode,
+                       min_word_freq=min_word_freq)
+        if word_idx is not None and len(word_idx) != len(ds.word_idx):
+            raise ValueError(
+                f"word_idx has {len(word_idx)} entries but the corpus "
+                f"dict (min_word_freq={min_word_freq}) has "
+                f"{len(ds.word_idx)} — build both with the same "
+                "min_word_freq/data_file")
+        for i in range(len(ds)):
+            yield tuple(int(x) for x in ds[i])
+    return read
+
+
+def train(word_idx=None, n=N, data_file=None, min_word_freq=50):
+    return _reader(word_idx, n, "train", data_file, min_word_freq)
+
+
+def test(word_idx=None, n=N, data_file=None, min_word_freq=50):
+    return _reader(word_idx, n, "test", data_file, min_word_freq)

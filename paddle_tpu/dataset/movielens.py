@@ -1,0 +1,56 @@
+"""reference python/paddle/dataset/movielens.py reader API — delegates
+to the real ml-1m parser in paddle_tpu.text.Movielens."""
+from ..text import Movielens as _Movielens
+
+__all__ = ["train", "test", "get_movie_title_dict", "movie_categories",
+           "max_movie_id", "max_user_id"]
+
+
+_CACHE = {}
+
+
+def _ds(mode="train", data_file=None):
+    key = (mode, data_file)
+    if key not in _CACHE:
+        _CACHE[key] = _Movielens(data_file=data_file, mode=mode)
+    return _CACHE[key]
+
+
+def _reader(mode, data_file):
+    def read():
+        ds = _ds(mode, data_file)
+        for i in range(len(ds)):
+            yield ds[i]
+    return read
+
+
+def train(data_file=None):
+    return _reader("train", data_file)
+
+
+def test(data_file=None):
+    return _reader("test", data_file)
+
+
+def get_movie_title_dict(data_file=None):
+    return _ds(data_file=data_file).movie_title_dict
+
+
+def movie_categories(data_file=None):
+    return _ds(data_file=data_file).categories_dict
+
+
+def max_movie_id(data_file=None):
+    # full movies.dat table where available (reference semantics: ids
+    # present only in the other split or unrated still count)
+    ds = _ds(data_file=data_file)
+    if getattr(ds, "max_movie_id_", None) is not None:
+        return ds.max_movie_id_
+    return max(int(row[4]) for row in ds.data)
+
+
+def max_user_id(data_file=None):
+    ds = _ds(data_file=data_file)
+    if getattr(ds, "max_user_id_", None) is not None:
+        return ds.max_user_id_
+    return max(int(row[0]) for row in ds.data)

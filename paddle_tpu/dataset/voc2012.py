@@ -1,0 +1,38 @@
+"""reference python/paddle/dataset/voc2012.py reader API — delegates to
+vision.datasets.VOC2012 for a real VOCtrainval archive; synthetic
+fallback otherwise. Reference split mapping: train()->'trainval',
+test()->'train', val()->'val' (dataset/voc2012.py:78-90)."""
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+_SPLIT = {"train": "trainval", "test": "train", "val": "valid"}
+
+
+def _reader(api_mode, n, data_file):
+    def read():
+        if data_file:
+            from ..vision.datasets import VOC2012
+            ds = VOC2012(data_file=data_file, mode=_SPLIT[api_mode])
+            for i in range(len(ds)):
+                img, label = ds[i]
+                yield np.asarray(img), np.asarray(label)
+            return
+        rng = np.random.RandomState(
+            {"train": 0, "test": 1, "val": 2}[api_mode])
+        for _ in range(n):
+            yield rng.rand(3, 32, 32).astype("float32"), \
+                rng.randint(0, 21, (32, 32)).astype("int64")
+    return read
+
+
+def train(data_file=None, n=64):
+    return _reader("train", n, data_file)
+
+
+def test(data_file=None, n=16):
+    return _reader("test", n, data_file)
+
+
+def val(data_file=None, n=16):
+    return _reader("val", n, data_file)

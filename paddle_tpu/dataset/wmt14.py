@@ -1,0 +1,36 @@
+"""reference python/paddle/dataset/wmt14.py reader API — delegates to
+the real parser in paddle_tpu.text.WMT14."""
+from ..text import WMT14 as _WMT14
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+
+def _reader(mode, dict_size, data_file):
+    def read():
+        ds = _WMT14(data_file=data_file, mode=mode,
+                    dict_size=dict_size if data_file else -1)
+        for i in range(len(ds)):
+            yield ds[i]
+    return read
+
+
+def train(dict_size=30000, data_file=None):
+    return _reader("train", dict_size, data_file)
+
+
+def test(dict_size=30000, data_file=None):
+    return _reader("test", dict_size, data_file)
+
+
+def gen(dict_size=30000, data_file=None):
+    return _reader("gen", dict_size, data_file)
+
+
+def get_dict(dict_size=30000, reverse=True, data_file=None):
+    ds = _WMT14(data_file=data_file, mode="train",
+                dict_size=dict_size if data_file else -1)
+    src, trg = ds.src_dict, ds.trg_dict
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -1,0 +1,54 @@
+"""reference python/paddle/dataset/wmt16.py reader API — delegates to
+paddle_tpu.text.WMT16 (wmt14-layout archives; see text/__init__.py)."""
+from ..text import WMT16 as _WMT16
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def _swap(src, trg, nxt):
+    """Reverse the language direction (reference src_lang='de'): the
+    stored sample is (src+<s>/<e> framing, <s>+trg, trg+<e>); the
+    swapped source is <s>+trg+<e> and the swapped target pair comes
+    from the inner src tokens."""
+    import numpy as np
+    new_src = np.concatenate([trg[:1], nxt])
+    inner = src[1:-1]
+    return new_src, np.concatenate([src[:1], inner]), \
+        np.concatenate([inner, src[-1:]])
+
+
+def _reader(mode, src_dict_size, trg_dict_size, src_lang, data_file):
+    def read():
+        ds = _WMT16(data_file=data_file, mode=mode,
+                    src_dict_size=src_dict_size if data_file else -1,
+                    trg_dict_size=trg_dict_size if data_file else -1)
+        for i in range(len(ds)):
+            sample = ds[i]
+            yield sample if src_lang == "en" else _swap(*sample)
+    return read
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en",
+          data_file=None):
+    return _reader("train", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en",
+         data_file=None):
+    return _reader("test", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def validation(src_dict_size=30000, trg_dict_size=30000, src_lang="en",
+               data_file=None):
+    return _reader("val", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def get_dict(lang, dict_size=30000, reverse=False, data_file=None):
+    ds = _WMT16(data_file=data_file, mode="train",
+                src_dict_size=dict_size if data_file else -1,
+                trg_dict_size=dict_size if data_file else -1)
+    d = ds.src_dict if lang == "en" else ds.trg_dict
+    return {v: k for k, v in d.items()} if reverse else d

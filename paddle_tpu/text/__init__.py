@@ -490,6 +490,8 @@ class Movielens(_TupleCorpus):
                         line.decode("latin-1").strip().split("::")[:4]
                     users[int(uid)] = (0 if gender == "M" else 1,
                                        self.AGES.index(int(age)), int(job))
+            self.max_user_id_ = max(users) if users else 0
+            self.max_movie_id_ = max(movies) if movies else 0
             rng = np.random.RandomState(rand_seed)
             self.data = []
             with z.open("ml-1m/ratings.dat") as f:

@@ -329,3 +329,37 @@ def test_text_conll05st_parses_real_props(tmp_path):
     assert w is ds.word_dict and "O" in l
     # synthetic fallback keeps the 9-field contract
     assert len(Conll05st()[0]) == 9
+
+
+def test_legacy_dataset_namespace_delegates():
+    """paddle.dataset.{imikolov,movielens,conll05,wmt14,wmt16,flowers,
+    voc2012} reader APIs delegate to the real parsers (synthetic here)."""
+    from paddle_tpu.dataset import (conll05, flowers, imikolov, movielens,
+                                    voc2012, wmt14, wmt16)
+    assert len(imikolov.build_dict()) > 0
+    sample = next(iter(imikolov.train(n=3)()))
+    assert len(sample) == 3 and all(isinstance(t, int) for t in sample)
+    s = next(iter(movielens.train()()))
+    assert len(s) == 8
+    assert movielens.max_user_id() > 0
+    assert len(next(iter(conll05.test()()))) == 9
+    w, p_, l = conll05.get_dict()
+    assert "O" in l
+    src, trg, nxt = next(iter(wmt14.train()()))
+    assert int(trg[0]) == 0 and int(nxt[-1]) == 1   # <s>/<e> framing
+    sd, td = wmt14.get_dict(reverse=True)
+    assert isinstance(next(iter(sd)), (int, np.integer))
+    assert len(next(iter(wmt16.validation()()))) == 3
+    img, lab = next(iter(flowers.train(n=2)()))
+    assert img.shape == (3072,)
+    # mapper + cycle honored
+    mapped = flowers.train(mapper=lambda s: ("X", s[1]), cycle=True, n=2)()
+    got = [next(mapped) for _ in range(5)]       # cycles past n=2
+    assert all(g[0] == "X" for g in got)
+    # wmt16 src_lang reverses direction consistently
+    f = next(iter(wmt16.train()()))
+    r = next(iter(wmt16.train(src_lang="de")()))
+    np.testing.assert_array_equal(r[0][1:-1], f[1][1:])   # src'=trg inner
+    np.testing.assert_array_equal(r[1][1:], f[0][1:-1])   # trg'=src inner
+    img, seg = next(iter(voc2012.val(n=2)()))
+    assert seg.shape == (32, 32)
