@@ -300,6 +300,11 @@ def launch_elastic_multihost(training_script, script_args=(), nnodes=2,
     import threading
     results = {}
     beat = node_kw.pop("heartbeat_path", None)
+    # same-machine simulation: loopback is the one address guaranteed to
+    # be locally bindable AND reachable (a container's hostname may
+    # resolve elsewhere); real per-machine deployments keep the
+    # routable-hostname default of launch_elastic_node
+    node_kw.setdefault("coordinator_host", "127.0.0.1")
 
     def run(rank):
         kw = dict(node_kw)
