@@ -102,16 +102,27 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     def _f(v, w, *rest):
         # paddle transpose-conv weight: [in_c, out_c/g, *k]
         # equivalent: conv with lhs_dilation=stride (fractional stride)
-        if isinstance(pad, str):
-            pads = [(0, 0)] * n if pad == "VALID" else None
-            if pads is None:
-                raise NotImplementedError("SAME padding for conv_transpose")
-        else:
-            pads = pad
         k = w.shape[2:]
         eff_k = [dilation[i] * (k[i] - 1) + 1 for i in range(n)]
-        tpads = [(eff_k[i] - 1 - pads[i][0], eff_k[i] - 1 - pads[i][1] + opad[i])
-                 for i in range(n)]
+        if isinstance(pad, str) and pad == "SAME":
+            # SAME transpose conv = gradient of a SAME forward conv:
+            # output spatial is exactly in*stride. Forward SAME pad
+            # total is max(eff_k - s, 0); transpose pads are the
+            # (eff_k-1 - fwd_pad) complements, with s - eff_k extra on
+            # the right when the kernel is narrower than the stride.
+            tpads = []
+            for i in range(n):
+                pt = max(eff_k[i] - stride[i], 0)
+                fl = pt // 2
+                fr = pt - fl
+                tpads.append((eff_k[i] - 1 - fl,
+                              eff_k[i] - 1 - fr
+                              + max(stride[i] - eff_k[i], 0) + opad[i]))
+        else:
+            pads = [(0, 0)] * n if isinstance(pad, str) else pad
+            tpads = [(eff_k[i] - 1 - pads[i][0],
+                      eff_k[i] - 1 - pads[i][1] + opad[i])
+                     for i in range(n)]
         # weight [I, O/g, *k] → flip spatial, swap to [O, I/g, *k]
         wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
         if groups > 1:

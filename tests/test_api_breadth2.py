@@ -370,3 +370,21 @@ def test_checkpoint_conversion(tmp_path):
     dst = str(tmp_path / "ours.pdparams")
     keys = paddle.utils.convert_checkpoint(fn, dst)
     assert len(keys) == 4
+
+
+def test_conv_transpose_same_padding():
+    """padding='SAME' transpose conv: output = in*stride exactly; equals
+    the symmetric explicit padding (eff_k - s)//2 when eff_k >= s."""
+    rng = np.random.RandomState(0)
+    for (k, s, p) in ((3, 1, 1), (4, 2, 1), (2, 2, 0)):
+        x = paddle.to_tensor(rng.randn(2, 3, 9, 9).astype("float32"))
+        w = paddle.to_tensor(rng.randn(3, 5, k, k).astype("float32"))
+        same = F.conv2d_transpose(x, w, stride=s, padding="SAME")
+        expl = F.conv2d_transpose(x, w, stride=s, padding=p)
+        assert list(same.shape) == [2, 5, 9 * s, 9 * s]
+        np.testing.assert_allclose(same.numpy(), expl.numpy(), rtol=1e-5)
+    # kernel narrower than stride: right output-padding keeps in*stride
+    x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype("float32"))
+    w = paddle.to_tensor(rng.randn(2, 4, 1, 1).astype("float32"))
+    assert list(F.conv2d_transpose(x, w, stride=3,
+                                   padding="SAME").shape) == [1, 4, 15, 15]
