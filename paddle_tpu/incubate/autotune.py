@@ -15,7 +15,8 @@ import time
 import types
 import warnings
 
-__all__ = ["set_config", "tune_flash_attention", "get_tuned_blocks"]
+__all__ = ["set_config", "tune_flash_attention", "tune_w4_matmul",
+           "get_tuned_blocks"]
 
 _state = {"kernel_enabled": False, "tuned": {}}
 
@@ -99,6 +100,39 @@ def tune_flash_attention(batch, seq_len, num_heads, head_dim,
         best = min(timings, key=timings.get)
         A._BLOCK_Q, A._BLOCK_K = best
         _state["tuned"][(batch, seq_len, num_heads, head_dim)] = best
+    return timings
+
+
+def tune_w4_matmul(S, K, N, candidates=(128, 256, 512), steps=5,
+                   dtype="bfloat16"):
+    """Time the int4 dequant-matmul per block_n on the attached device
+    (decode shapes: S = decode batch, K = in-dim, N = out-dim). Returns
+    {block_n: seconds}; pass the winner as w4_matmul(..., block_n=...).
+    On CPU the interpret path runs — tune on the device you serve on."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.w4_matmul import quantize_w4, w4_matmul
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(S, K), jnp.dtype(dtype))
+    packed, scale = quantize_w4(rng.randn(K, N).astype("float32"))
+    timings = {}
+    for bn in candidates:
+        if N % bn:
+            continue
+        try:
+            f = jax.jit(lambda xv, bn=bn: w4_matmul(xv, packed, scale,
+                                                    K, block_n=bn))
+            jax.block_until_ready(f(x))               # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = f(x)
+            jax.block_until_ready(out)
+            timings[bn] = (time.perf_counter() - t0) / steps
+        except Exception:
+            continue
     return timings
 
 
