@@ -327,6 +327,16 @@ def test_text_conll05st_parses_real_props(tmp_path):
     np.testing.assert_array_equal(pred, [0] * 5)
     w, p, l = ds.get_dict()
     assert w is ds.word_dict and "O" in l
+    # embeddings load from a whitespace float table
+    emb = tmp_path / "emb.txt"
+    np.savetxt(emb, np.arange(10, dtype=np.float32).reshape(5, 2))
+    ds_e = Conll05st(data_file=path, word_dict_file=str(wd),
+                     verb_dict_file=str(vd), target_dict_file=str(td),
+                     emb_file=str(emb))
+    assert ds_e.get_embedding().shape == (5, 2)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="emb_file"):
+        ds.get_embedding()
     # synthetic fallback keeps the 9-field contract
     assert len(Conll05st()[0]) == 9
 
