@@ -38,3 +38,11 @@ def test_autotune_config_and_tuning():
             assert (A._BLOCK_Q, A._BLOCK_K) == orig
     finally:
         A._BLOCK_Q, A._BLOCK_K = orig
+
+
+def test_tune_w4_matmul_sweeps_blocks():
+    from paddle_tpu.incubate.autotune import tune_w4_matmul
+    t = tune_w4_matmul(2, 64, 256, candidates=(64, 128, 999), steps=1)
+    # non-dividing candidate skipped; the rest timed
+    assert set(t) == {64, 128}
+    assert all(v > 0 for v in t.values())
