@@ -9,7 +9,10 @@ appends to perf_campaign_results.jsonl so partial runs still record.
     python examples/perf_campaign.py hlo      # fusion audit (transpose/f32 counts)
 """
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -165,6 +168,27 @@ def run_flash_tune():
     record({"config": "flash_tune_bert", "best": str(best)})
 
 
+def run_decode():
+    """On-chip serving numbers: decode tok/s vs HBM roofline for bf16 /
+    a8w8 / w4a16, plus the speculative wall-clock ceiling (both were
+    CPU-only until the tunnel returned)."""
+    import bench
+    for quant in (None, "a8w8", "w4a16"):
+        try:
+            r = bench.run_decode(quant=quant)
+            record({"config": "decode", "quant": quant or "bf16", **r})
+        except Exception as e:
+            record({"config": "decode", "quant": quant or "bf16",
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            import gc
+            gc.collect()
+    try:
+        record({"config": "speculative", **bench.run_speculative()})
+    except Exception as e:
+        record({"config": "speculative",
+                "error": f"{type(e).__name__}: {str(e)[:160]}"})
+
+
 def run_gpt():
     import bench
     for name, bs, rp in (("gpt_1p3b", 4, "dots"), ("gpt_1p3b", 6, "dots"),
@@ -192,6 +216,8 @@ def main():
         run_flash_tune()
     if which in ("gpt", "all"):
         run_gpt()
+    if which in ("decode", "all"):
+        run_decode()
 
 
 if __name__ == "__main__":

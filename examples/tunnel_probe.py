@@ -1,0 +1,70 @@
+"""Staged axon-tunnel health probe: answers WHERE the chip path stalls
+(device init, host->device bandwidth, compile, execute) with one timed
+line per stage, so a hung 1.3B campaign can be diagnosed in minutes.
+
+    python examples/tunnel_probe.py
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def stage(name):
+    print(f"[{time.strftime('%H:%M:%S')}] {name}...", flush=True)
+
+
+def done(name, t0, extra=""):
+    print(f"[{time.strftime('%H:%M:%S')}] {name}: {time.time()-t0:.1f}s"
+          f" {extra}", flush=True)
+
+
+def main():
+    stage("import jax + device init")
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    done("device init", t0, f"devices={devs}")
+
+    stage("tiny op (1-elem add)")
+    t0 = time.time()
+    x = jnp.ones(()) + 1
+    x.block_until_ready()
+    done("tiny op", t0)
+
+    for mb in (8, 64, 256):
+        stage(f"host->device transfer {mb}MB")
+        t0 = time.time()
+        arr = np.ones((mb, 1024, 1024 // 4), dtype=np.float32)
+        d = jax.device_put(arr)
+        d.block_until_ready()
+        dt = time.time() - t0
+        done(f"transfer {mb}MB", t0, f"= {mb / dt:.0f} MB/s")
+        del d, arr
+
+    stage("compile+run 4k x 4k bf16 matmul")
+    t0 = time.time()
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    f(a).block_until_ready()
+    done("matmul compile+run", t0)
+    t0 = time.time()
+    for _ in range(10):
+        a = f(a)
+    a.block_until_ready()
+    dt = (time.time() - t0) / 10
+    done("matmul steady", t0, f"= {2 * 4096**3 / dt / 1e12:.1f} TFLOP/s")
+
+    stage("on-device init of 1B bf16 params (no host transfer)")
+    t0 = time.time()
+    g = jax.jit(lambda k: [jax.random.normal(k, (4096, 4096), jnp.bfloat16)
+                           for _ in range(60)])
+    w = g(jax.random.PRNGKey(0))
+    jax.block_until_ready(w)
+    done("1B on-device init", t0)
+    print("PROBE OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,22 +1,44 @@
 #!/bin/bash
 # Watch for the axon TPU tunnel to come back; the moment a device answers,
-# fire the perf campaign (resnet + bert + gpt + hlo) and bench.py so a
-# returning chip converts to recorded numbers within minutes, not hours.
-# Probe is a subprocess with a hard timeout (a down tunnel HANGS device
-# init forever rather than erroring).
+# fire the perf campaign and bench.py so a returning chip converts to
+# recorded numbers within minutes, not hours.
+#
+# Lessons from the round-4 flap (tunnel answered jax.devices() at 01:01,
+# wedged on the first bulk transfer by 01:44):
+#   - the probe must exercise transfer + compile, not just device init,
+#     or a half-up tunnel fires the 1.3B campaign into a hang;
+#   - loop forever and skip stages that already recorded results, so a
+#     short tunnel window banks the small configs before the big ones;
+#   - smallest-first order (resnet 25M, bert 110M, gpt 1.3B).
 cd "$(dirname "$0")/.."
-PROBE='import jax; assert jax.devices()[0].platform != "cpu"; print("TPU-OK")'
+PROBE='
+import time, jax, jax.numpy as jnp, numpy as np
+t0=time.time(); d=jax.devices(); assert d[0].platform != "cpu", d
+x=(jnp.ones(())+1); x.block_until_ready()
+a=jax.device_put(np.ones((16,1024,256),np.float32)); a.block_until_ready()
+f=jax.jit(lambda a: a@a); b=f(jnp.ones((1024,1024),jnp.bfloat16))
+b.block_until_ready()
+print(f"TPU-OK {time.time()-t0:.1f}s")'
+
+have() { grep -q "\"config\": \"$1\"" perf_campaign_results.jsonl 2>/dev/null \
+         && ! grep "\"config\": \"$1\"" perf_campaign_results.jsonl | tail -1 | grep -q '"error"'; }
+
 while true; do
-  if timeout 120 python -c "$PROBE" 2>/dev/null | grep -q TPU-OK; then
+  if timeout 180 python -c "$PROBE" 2>/dev/null | grep -q TPU-OK; then
     echo "$(date -u +%FT%TZ) tunnel UP — launching perf campaign" >> tunnel_watch.log
-    for cfg in hlo resnet bert gpt; do
-      timeout 3000 python examples/perf_campaign.py "$cfg" \
-        >> tunnel_watch.log 2>&1
-    done
-    timeout 3000 python bench.py >> tunnel_watch.log 2>&1
-    echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
-    break
+    have resnet50   || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1
+    have bert_base  || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1
+    have resnet50_hlo_audit || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1
+    have gpt_1p3b   || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1
+    have decode     || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1
+    if have resnet50 && have bert_base && have gpt_1p3b; then
+      timeout 3000 python bench.py >> tunnel_watch.log 2>&1
+      echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
+      break
+    fi
+    echo "$(date -u +%FT%TZ) campaign incomplete — will retry" >> tunnel_watch.log
+  else
+    echo "$(date -u +%FT%TZ) tunnel still down" >> tunnel_watch.log
   fi
-  echo "$(date -u +%FT%TZ) tunnel still down" >> tunnel_watch.log
-  sleep 900
+  sleep 300
 done
