@@ -27,18 +27,97 @@ def _stage(batch):
     return {k: jnp.asarray(v) for k, v in batch.items()}
 
 
+# Best-so-far JSON line for the hard-exit watchdog: if the process must be
+# killed mid-wedge, the driver still gets the results banked up to that
+# point rather than nothing. main() updates this as configs complete.
+_PARTIAL = None
+
+
+def _publish_partial(d):
+    global _PARTIAL
+    _PARTIAL = d
+
+
+def _default_result():
+    return {"metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0}
+
+
+def _alarm(seconds, label):
+    """Mid-run hang guard, two layers. The init watchdog catches a tunnel
+    that is dead at startup, but a tunnel that wedges MID-RUN leaves device
+    syncs blocked forever (observed: gpt bs8 compiled, first step ran, then
+    the 10-step measure loop never returned).
+
+    Layer 1 — SIGALRM raising TimeoutError: works when the main thread is
+    executing Python bytecode (dispatch loops, host-side work).
+    Layer 2 — a backup watchdog THREAD at seconds+60: CPython only delivers
+    the signal-handler exception when bytecode next runs, and a wedged jax
+    sync is a C call that never returns, so the alarm alone can sail past a
+    real wedge. The thread prints the best-so-far JSON line (_PARTIAL) with
+    the error attached and hard-exits — the driver gets a parseable line
+    either way.
+
+    Nesting-safe: re-arms the enclosing guard's remaining time on exit.
+    Signal layer is skipped off the main thread (signal restriction); the
+    thread layer still applies."""
+    import contextlib
+    import json as _json
+    import signal
+    import threading
+
+    @contextlib.contextmanager
+    def guard():
+        def hard_exit():
+            import os
+            out = dict(_PARTIAL) if _PARTIAL else _default_result()
+            out["error"] = (f"{label} hard-wedged >{seconds + 60}s "
+                            "(device sync never returned)")
+            log(f"bench hard-exit: {out['error']}")
+            print(_json.dumps(out), flush=True)
+            os._exit(3)
+
+        backup = threading.Timer(seconds + 60, hard_exit)
+        backup.daemon = True
+        backup.start()
+        on_main = threading.current_thread() is threading.main_thread()
+        old_handler = prev_remaining = None
+        t0 = time.time()
+        if on_main:
+            def handler(signum, frame):
+                raise TimeoutError(
+                    f"{label} exceeded {seconds}s (TPU wedged mid-run?)")
+
+            old_handler = signal.signal(signal.SIGALRM, handler)
+            prev_remaining = signal.alarm(seconds)
+        try:
+            yield
+        finally:
+            backup.cancel()
+            if on_main:
+                signal.alarm(0)
+                signal.signal(signal.SIGALRM, old_handler)
+                if prev_remaining:  # restore the enclosing guard's budget
+                    signal.alarm(max(1, int(prev_remaining -
+                                            (time.time() - t0))))
+
+    return guard()
+
+
 def _measure(trainer, batch, steps, label):
     """Shared timing harness: compile+first step, one warm step, timed loop
     (async dispatch, single trailing sync). Returns seconds/step."""
     t0 = time.time()
-    loss = trainer.step(batch)
-    float(loss)
-    log(f"{label} compile+first step: {time.time()-t0:.1f}s, loss={float(loss):.3f}")
-    float(trainer.step(batch))  # warm
-    t0 = time.time()
-    for _ in range(steps):
+    with _alarm(600, f"{label} compile+first step"):
         loss = trainer.step(batch)
-    float(loss)  # sync
+        float(loss)
+    log(f"{label} compile+first step: {time.time()-t0:.1f}s, loss={float(loss):.3f}")
+    with _alarm(300, f"{label} measure loop"):
+        float(trainer.step(batch))  # warm
+        t0 = time.time()
+        for _ in range(steps):
+            loss = trainer.step(batch)
+        float(loss)  # sync
     return (time.time() - t0) / steps
 
 
@@ -401,6 +480,16 @@ def run_decode(batch=8, prompt_len=128, gen=128, quant=None):
             return {"tok_s": tok_s, "model": mk.__name__,
                     "vs_roofline": round(tok_s / ceil, 4),
                     "roofline_tok_s": round(ceil, 1), "latency": lat}
+        except TimeoutError:
+            # the _alarm wrapping this whole call fired: one-shot, so the
+            # fallback model would run unguarded — propagate instead. Null
+            # the HBM-pinning locals first: the raised traceback keeps this
+            # frame alive, and a still-referenced 1.3B model would OOM the
+            # caller's next quant variant.
+            model = dec = run_batch = cfg = None
+            import gc
+            gc.collect()
+            raise
         except Exception as e:
             last_err = f"{type(e).__name__}: {str(e)[:200]}"
             log(f"decode {mk.__name__} failed: {last_err}")
@@ -534,14 +623,46 @@ def _device_watchdog(timeout_s=150, attempts=4, backoff_s=45):
     return f"{err} [after {attempts} attempts]"
 
 
+
+def _record_failure(extras, key, label, e):
+    """Log + record a stage failure, then drop every reference to the
+    exception: its traceback pins the failed run's frames (trainer params,
+    KV pages) in HBM, which would OOM the next stage's allocation."""
+    msg = f"{type(e).__name__}: {str(e)[:300]}"
+    log(f"{label} bench failed: {msg}")
+    extras[key] = msg[:160]
+    # the caller's `except ... as e` binding still exists until its block
+    # exits, so `del e` here can't free anything — cut the traceback (and
+    # any chained exception's) off the object itself
+    e.__traceback__ = None
+    if e.__context__ is not None:
+        e.__context__.__traceback__ = None
+    del e
+    import gc
+    gc.collect()
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
+
+    def _on_term(signum, frame):
+        # external timeout (tunnel_watch runs bench under `timeout 3600`):
+        # per-stage alarm budgets can sum past it on a semi-wedged tunnel,
+        # so flush whatever is banked instead of dying JSON-less
+        out = dict(_PARTIAL) if _PARTIAL else _default_result()
+        out["error"] = "SIGTERM (external timeout) — partial results"
+        log(f"bench: {out['error']}")
+        print(json.dumps(out), flush=True)
+        os._exit(4)
+
+    import signal as _signal
+    import threading as _threading
+    if _threading.current_thread() is _threading.main_thread():
+        _signal.signal(_signal.SIGTERM, _on_term)
     err = _device_watchdog()
     if err is not None:
         log(f"bench aborted: {err}")
-        print(json.dumps({"metric": "gpt_train_tokens_per_sec_per_chip",
-                          "value": 0.0, "unit": "tokens/s/chip",
-                          "vs_baseline": 0.0, "error": err}))
+        print(json.dumps({**_default_result(), "error": err}))
         return
     # each group: variants of the same headline config — run all that fit,
     # keep the fastest; fall to the next (smaller) group only if none ran
@@ -560,8 +681,9 @@ def main():
         for group in groups:
             for cfg_name, bs, seq, rp in group:
                 try:
-                    tok_s, mfu, n_params = run_config(cfg_name, bs, seq,
-                                                      remat_policy=rp)
+                    with _alarm(1200, f"{cfg_name} bs{bs}/{rp}"):
+                        tok_s, mfu, n_params = run_config(cfg_name, bs, seq,
+                                                          remat_policy=rp)
                 except Exception as e:  # OOM or tunnel issues → try smaller
                     # keep only the STRING: holding the exception pins its
                     # traceback frames, which pin the failed Trainer's params
@@ -583,55 +705,58 @@ def main():
                         "batch": bs, "seq": seq, "remat": rp,
                     }
             if result is not None:
+                _publish_partial(result)
                 break
     if result is None:
         if only in (None, "gpt"):   # real failure of the headline config
-            result = {"metric": "gpt_train_tokens_per_sec_per_chip",
-                      "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+            result = _default_result()
             if last_err is not None:
                 result["error"] = last_err
         else:                       # gpt intentionally skipped via CLI filter
             result = {"metric": f"bench_only_{only}", "value": 0.0,
                       "unit": "see extras", "vs_baseline": 0.0}
     # secondary BASELINE.json configs ride along in the same JSON line
+    _publish_partial(result)
     extras = {}
+    result["extras"] = extras  # live reference: hard-exit sees each banked stage
     if only in (None, "resnet"):
         try:
-            imgs_s, mfu = run_resnet50()
+            with _alarm(900, "resnet50"):
+                imgs_s, mfu = run_resnet50()
             extras["resnet50_imgs_per_sec_per_chip"] = round(imgs_s, 1)
             extras["resnet50_mfu"] = round(mfu, 4)
         except Exception as e:
-            log(f"resnet50 bench failed: {type(e).__name__}: {str(e)[:300]}")
-            extras["resnet50_error"] = str(e)[:160]
+            _record_failure(extras, "resnet50_error", "resnet50", e)
     if only in (None, "bert"):
         try:
-            seqs_s, mfu = run_bert_base()
+            with _alarm(900, "bert_base"):
+                seqs_s, mfu = run_bert_base()
             extras["bert_base_seqs_per_sec_per_chip"] = round(seqs_s, 2)
             extras["bert_base_mfu"] = round(mfu, 4)
         except Exception as e:
-            log(f"bert bench failed: {type(e).__name__}: {str(e)[:300]}")
-            extras["bert_base_error"] = str(e)[:160]
+            _record_failure(extras, "bert_base_error", "bert", e)
     if only in (None, "yolo"):
         try:
-            imgs_s, mfu = run_yolov3()
+            with _alarm(900, "yolov3"):
+                imgs_s, mfu = run_yolov3()
             extras["yolov3_imgs_per_sec_per_chip"] = round(imgs_s, 1)
             extras["yolov3_mfu"] = round(mfu, 4)
         except Exception as e:
-            log(f"yolov3 bench failed: {type(e).__name__}: {str(e)[:300]}")
-            extras["yolov3_error"] = str(e)[:160]
+            _record_failure(extras, "yolov3_error", "yolov3", e)
     if only in (None, "moe"):
         try:
-            tok_s, mfu = run_gpt_moe()
+            with _alarm(900, "gpt_moe"):
+                tok_s, mfu = run_gpt_moe()
             extras["gpt_moe_tokens_per_sec_per_chip"] = round(tok_s, 1)
             extras["gpt_moe_mfu"] = round(mfu, 4)
         except Exception as e:
-            log(f"moe bench failed: {type(e).__name__}: {str(e)[:300]}")
-            extras["gpt_moe_error"] = str(e)[:160]
+            _record_failure(extras, "gpt_moe_error", "moe", e)
     if only in (None, "decode"):
         for q in (None, "a8w8", "w4a16"):
             pfx = "decode" + (f"_{q}" if q else "")
             try:
-                r = run_decode(quant=q)
+                with _alarm(900, pfx):
+                    r = run_decode(quant=q)
                 extras[f"{pfx}_tokens_per_sec_per_chip"] = \
                     round(r["tok_s"], 1)
                 extras[f"{pfx}_model"] = r["model"]
@@ -639,17 +764,14 @@ def main():
                 extras[f"{pfx}_roofline_tok_s"] = r["roofline_tok_s"]
                 extras[f"{pfx}_token_latency_ms"] = r["latency"]
             except Exception as e:
-                log(f"{pfx} bench failed: "
-                    f"{type(e).__name__}: {str(e)[:300]}")
-                extras[f"{pfx}_error"] = str(e)[:160]
+                _record_failure(extras, f"{pfx}_error", pfx, e)
         try:
-            extras["speculative"] = run_speculative()
+            with _alarm(900, "speculative"):
+                extras["speculative"] = run_speculative()
         except Exception as e:
-            log(f"speculative bench failed: "
-                f"{type(e).__name__}: {str(e)[:300]}")
-            extras["speculative_error"] = str(e)[:160]
-    if extras:
-        result["extras"] = extras
+            _record_failure(extras, "speculative_error", "speculative", e)
+    if not extras:
+        result.pop("extras", None)
     print(json.dumps(result))
 
 

@@ -64,16 +64,20 @@ def _resnet_trial(batch_size, steps=10, stem_s2d=False):
 def run_resnet():
     # sweep batch AND the space-to-depth stem rewrite (exact-equivalent
     # MXU-friendly 7x7/s2; ops/space_to_depth.py, CPU-parity tested)
+    ok = 0
     for bs in (128, 256, 512):
         for s2d in (False, True):
             try:
                 trial, _, _ = _resnet_trial(bs, stem_s2d=s2d)
                 record(trial)
+                ok += 1
             except Exception as e:
                 record({"config": "resnet50", "bs": bs, "stem_s2d": s2d,
                         "error": f"{type(e).__name__}: {str(e)[:160]}"})
                 import gc
                 gc.collect()
+    if ok:  # all-errored sweep stays unbanked so the watch retries it
+        record({"config": "resnet_stage_done"})
 
 
 def run_hlo_audit():
@@ -149,15 +153,19 @@ def _bert_trial(batch_size, seq_len, dropout, steps=10):
 
 
 def run_bert():
+    ok = 0
     for bs, dropout in ((32, True), (32, False), (64, True), (64, False),
                         (128, True)):
         try:
             record(_bert_trial(bs, 512, dropout))
+            ok += 1
         except Exception as e:
             record({"config": "bert_base", "bs": bs, "dropout": dropout,
                     "error": f"{type(e).__name__}: {str(e)[:160]}"})
             import gc
             gc.collect()
+    if ok:
+        record({"config": "bert_stage_done"})
 
 
 def run_flash_tune():
@@ -173,10 +181,12 @@ def run_decode():
     a8w8 / w4a16, plus the speculative wall-clock ceiling (both were
     CPU-only until the tunnel returned)."""
     import bench
+    ok = 0
     for quant in (None, "a8w8", "w4a16"):
         try:
             r = bench.run_decode(quant=quant)
             record({"config": "decode", "quant": quant or "bf16", **r})
+            ok += 1
         except Exception as e:
             record({"config": "decode", "quant": quant or "bf16",
                     "error": f"{type(e).__name__}: {str(e)[:160]}"})
@@ -184,24 +194,31 @@ def run_decode():
             gc.collect()
     try:
         record({"config": "speculative", **bench.run_speculative()})
+        ok += 1
     except Exception as e:
         record({"config": "speculative",
                 "error": f"{type(e).__name__}: {str(e)[:160]}"})
+    if ok:
+        record({"config": "decode_stage_done"})
 
 
 def run_gpt():
     import bench
+    ok = 0
     for name, bs, rp in (("gpt_1p3b", 4, "dots"), ("gpt_1p3b", 6, "dots"),
                          ("gpt_1p3b", 8, "full")):
         try:
             tok_s, mfu, _ = bench.run_config(name, bs, 1024, remat_policy=rp)
             record({"config": name, "bs": bs, "remat": rp,
                     "tok_s": round(tok_s, 1), "mfu": round(mfu, 4)})
+            ok += 1
         except Exception as e:
             record({"config": name, "bs": bs, "remat": rp,
                     "error": f"{type(e).__name__}: {str(e)[:160]}"})
             import gc
             gc.collect()
+    if ok:
+        record({"config": "gpt_stage_done"})
 
 
 def main():

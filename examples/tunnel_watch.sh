@@ -3,36 +3,35 @@
 # fire the perf campaign and bench.py so a returning chip converts to
 # recorded numbers within minutes, not hours.
 #
-# Lessons from the round-4 flap (tunnel answered jax.devices() at 01:01,
-# wedged on the first bulk transfer by 01:44):
+# Lessons from the round-4 flaps (tunnel answered jax.devices() at 01:01,
+# wedged on the first bulk transfer by 01:44; answered again at 03:16,
+# wedged mid-measure at 03:21):
 #   - the probe must exercise transfer + compile, not just device init,
-#     or a half-up tunnel fires the 1.3B campaign into a hang;
+#     or a half-up tunnel fires the 1.3B campaign into a hang — the probe
+#     IS examples/tunnel_probe.py --quick (one implementation, no drift);
 #   - loop forever and skip stages that already recorded results, so a
 #     short tunnel window banks the small configs before the big ones;
-#   - smallest-first order (resnet 25M, bert 110M, gpt 1.3B).
+#   - a stage is banked only when its "<stage>_stage_done" marker exists:
+#     per-trial errors inside a completed sweep don't force a redo, but a
+#     stage killed mid-run (timeout/wedge) has no marker and is retried;
+#   - smallest-first order (resnet 25M, bert 110M, gpt 1.3B);
+#   - bench.py itself carries mid-run SIGALRM + hard-exit watchdogs, so
+#     the final full run cannot hang the loop either.
 cd "$(dirname "$0")/.."
-PROBE='
-import time, jax, jax.numpy as jnp, numpy as np
-t0=time.time(); d=jax.devices(); assert d[0].platform != "cpu", d
-x=(jnp.ones(())+1); x.block_until_ready()
-a=jax.device_put(np.ones((16,1024,256),np.float32)); a.block_until_ready()
-f=jax.jit(lambda a: a@a); b=f(jnp.ones((1024,1024),jnp.bfloat16))
-b.block_until_ready()
-print(f"TPU-OK {time.time()-t0:.1f}s")'
 
-have() { grep -q "\"config\": \"$1\"" perf_campaign_results.jsonl 2>/dev/null \
-         && ! grep "\"config\": \"$1\"" perf_campaign_results.jsonl | tail -1 | grep -q '"error"'; }
+have() { grep -q "\"config\": \"$1_stage_done\"" perf_campaign_results.jsonl 2>/dev/null; }
 
 while true; do
-  if timeout 180 python -c "$PROBE" 2>/dev/null | grep -q TPU-OK; then
+  if timeout 180 python examples/tunnel_probe.py --quick 2>/dev/null | grep -q "PROBE OK"; then
     echo "$(date -u +%FT%TZ) tunnel UP — launching perf campaign" >> tunnel_watch.log
-    have resnet50   || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1
-    have bert_base  || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1
-    have resnet50_hlo_audit || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1
-    have gpt_1p3b   || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1
-    have decode     || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1
-    if have resnet50 && have bert_base && have gpt_1p3b; then
-      timeout 3000 python bench.py >> tunnel_watch.log 2>&1
+    have resnet || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1
+    have bert   || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1
+    grep -q '"config": "resnet50_hlo_audit"' perf_campaign_results.jsonl 2>/dev/null \
+                || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1
+    have gpt    || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1
+    have decode || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1
+    if have resnet && have bert && have gpt && have decode; then
+      timeout 3600 python bench.py >> tunnel_watch.log 2>&1
       echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
       break
     fi
