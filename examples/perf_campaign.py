@@ -164,6 +164,40 @@ def run_bert():
                     "error": f"{type(e).__name__}: {str(e)[:160]}"})
             import gc
             gc.collect()
+    # flash-tile tune at the encoder's shape (seq 512, unmasked-causal),
+    # then re-measure the strongest configs with the tuned tiles still
+    # installed — tuning is per-process, so it must happen HERE, not in a
+    # separate campaign stage
+    try:
+        from paddle_tpu.incubate.autotune import tune_flash_attention
+        timings = tune_flash_attention(batch=32, seq_len=512, num_heads=12,
+                                       head_dim=64, causal=False)
+        best = min(timings, key=timings.get) if timings else None
+        record({"config": "bert_flash_tune", "best": str(best),
+                "timings_ms": {str(k): round(v * 1e3, 2)
+                               for k, v in timings.items()}})
+    except Exception as e:
+        best = None
+        record({"config": "bert_flash_tune",
+                "error": f"{type(e).__name__}: {str(e)[:160]}"})
+        import gc
+        gc.collect()
+    if best:
+        # per-trial isolation like the main sweep: a failed tuned trial
+        # records as bert_base (not as a tuner error) and doesn't stop
+        # the other tuned batch size
+        for bs in (32, 64):
+            try:
+                trial = _bert_trial(bs, 512, True)
+                trial["tuned_tiles"] = str(best)
+                record(trial)
+                ok += 1
+            except Exception as e:
+                record({"config": "bert_base", "bs": bs, "dropout": True,
+                        "tuned_tiles": str(best),
+                        "error": f"{type(e).__name__}: {str(e)[:160]}"})
+                import gc
+                gc.collect()
     if ok:
         record({"config": "bert_stage_done"})
 
