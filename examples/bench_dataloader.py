@@ -13,8 +13,11 @@ cannot exceed 1x; on a multi-core host the worker rows scale with cores):
     pipeline w2 : native  367 imgs/s (1-core worker overhead; see
                   docs/performance.md)
 """
+
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import tempfile
 import time
 

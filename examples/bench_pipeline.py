@@ -12,6 +12,11 @@ for GPTStacked at pp=4 x dp=2, 8 microbatches. Representative result
     interleaved      :  8.3 s/step, temp=313.6 MB  (autodiff backward)
     interleaved_1f1b :  7.0 s/step, temp= 38.0 MB  -> 1.19x faster, 8.3x less
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np

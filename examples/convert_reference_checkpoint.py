@@ -20,7 +20,11 @@ tests/test_checkpoint_convert_e2e.py runs this flow on a full ResNet-50
 state dict (synthesized in the reference on-disk format — the CI
 environment has no network for a zoo download).
 """
+
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

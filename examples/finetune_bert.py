@@ -5,6 +5,11 @@ attention, AdamW + linear warmup, one compiled step).
 Usage (synthetic token data):
     python examples/finetune_bert.py --steps 50
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 import time
 

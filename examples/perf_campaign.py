@@ -8,7 +8,7 @@ appends to perf_campaign_results.jsonl so partial runs still record.
     python examples/perf_campaign.py gpt      # remat/bs confirmation
     python examples/perf_campaign.py hlo      # fusion audit (transpose/f32 counts)
 """
-import json
+
 import os
 import sys
 

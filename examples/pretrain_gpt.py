@@ -6,6 +6,11 @@ Usage (synthetic data):
 With a token file (flat int32 binary):
     python examples/pretrain_gpt.py --data tokens.bin --config gpt_1p3b
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 import time
 

@@ -7,6 +7,11 @@ point is the full serving path: tokenize -> prefill -> batched sampled
 decode -> detokenize. Swap in converted weights via
 utils.apply_reference_checkpoint for real outputs.)
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import paddle_tpu as paddle
@@ -26,7 +31,6 @@ def build_tokenizer():
 
 
 def main():
-    import sys
     quant = sys.argv[1] if len(sys.argv) > 1 else None
     paddle.seed(0)
     build_mesh(dp=1)

@@ -8,6 +8,11 @@ jitted step each.
     python examples/train_ocr.py --task rec --steps 50
     python examples/train_ocr.py --task det --steps 50
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 
 import numpy as np

@@ -7,8 +7,12 @@ Usage (synthetic data):
 With an image-folder dataset (class-per-subdir of JPEGs):
     python examples/train_resnet.py --data /path/to/train --classes 1000
 """
-import argparse
+
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import argparse
 import time
 
 import numpy as np

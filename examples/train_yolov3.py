@@ -10,6 +10,11 @@ Measured single v5e chip, 320x320, bs16: ~504 imgs/s.
 
     python examples/train_yolov3.py --steps 100 --batch-size 16
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 import time
 

@@ -4,7 +4,11 @@ line per stage, so a hung 1.3B campaign can be diagnosed in minutes.
 
     python examples/tunnel_probe.py
 """
+
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np

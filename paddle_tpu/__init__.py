@@ -7,6 +7,32 @@ tape; the performance path compiles whole train steps with jax.jit.
 """
 __version__ = "0.1.0"
 
+# Honor an explicit JAX_PLATFORMS=cpu request BEFORE any backend init: the
+# axon TPU-tunnel plugin (when present on this box) force-selects
+# jax_platforms="axon,cpu" at registration, so a user asking for CPU would
+# still block on the shared (and sometimes down) tunnel the moment
+# jax.devices() runs. Dropping the factory is what tests/conftest.py does;
+# doing it here makes `JAX_PLATFORMS=cpu python examples/...` work too.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    try:
+        import jax as _jax
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception as _e:  # private jax API moved — fail LOUD, not silent:
+        # swallowing this would reproduce the exact tunnel-block the guard
+        # exists to prevent, with zero diagnostic
+        import sys as _sys
+
+        print(f"paddle_tpu: could not honor JAX_PLATFORMS=cpu "
+              f"({type(_e).__name__}: {_e}); the axon TPU plugin may still "
+              f"grab the tunnel", file=_sys.stderr)
+        del _sys
+del _os
+
 from . import autograd, framework, tensor
 from .framework import (  # noqa: F401
     CPUPlace,
