@@ -197,7 +197,8 @@ def decode_roofline_tok_s(cfg, batch, avg_ctx, quant=None, kv_bytes=2):
     return chip_hbm_bw() * batch / (w_bytes + kv)
 
 
-def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full"):
+def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full",
+               grad_accum=1):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -211,7 +212,8 @@ def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full"):
     paddle.seed(0)
     build_mesh(dp=1)
     log(f"building {cfg_name}: {cfg.num_params()/1e6:.0f}M params, "
-        f"batch={batch_size} seq={seq_len}")
+        f"batch={batch_size} seq={seq_len}"
+        + (f" accum={grad_accum}" if grad_accum > 1 else ""))
     model = GPT(cfg)
     model.bfloat16()
     crit = GPTPretrainingCriterion()
@@ -224,7 +226,7 @@ def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full"):
         logits = m(paddle.to_tensor(batch["input_ids"]))
         return crit(logits, paddle.to_tensor(batch["labels"]))
 
-    trainer = Trainer(model, opt, loss_fn)
+    trainer = Trainer(model, opt, loss_fn, grad_accum_steps=grad_accum)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
     batch = {"input_ids": ids[:, :-1].astype("int32"),

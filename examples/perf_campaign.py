@@ -9,6 +9,7 @@ appends to perf_campaign_results.jsonl so partial runs still record.
     python examples/perf_campaign.py hlo      # fusion audit (transpose/f32 counts)
 """
 
+import json
 import os
 import sys
 
@@ -239,15 +240,21 @@ def run_decode():
 def run_gpt():
     import bench
     ok = 0
-    for name, bs, rp in (("gpt_1p3b", 4, "dots"), ("gpt_1p3b", 6, "dots"),
-                         ("gpt_1p3b", 8, "full")):
+    # bs7/dots probes the last step before the bs8/dots compile cliff;
+    # bs8/dots/accum2 gets effective batch 8 at microbatch-4 peak memory
+    # (gradient-merge scan), sidestepping that cliff entirely
+    for name, bs, rp, accum in (
+            ("gpt_1p3b", 4, "dots", 1), ("gpt_1p3b", 6, "dots", 1),
+            ("gpt_1p3b", 7, "dots", 1), ("gpt_1p3b", 8, "dots", 2),
+            ("gpt_1p3b", 8, "full", 1)):
         try:
-            tok_s, mfu, _ = bench.run_config(name, bs, 1024, remat_policy=rp)
-            record({"config": name, "bs": bs, "remat": rp,
+            tok_s, mfu, _ = bench.run_config(name, bs, 1024, remat_policy=rp,
+                                             grad_accum=accum)
+            record({"config": name, "bs": bs, "remat": rp, "accum": accum,
                     "tok_s": round(tok_s, 1), "mfu": round(mfu, 4)})
             ok += 1
         except Exception as e:
-            record({"config": name, "bs": bs, "remat": rp,
+            record({"config": name, "bs": bs, "remat": rp, "accum": accum,
                     "error": f"{type(e).__name__}: {str(e)[:160]}"})
             import gc
             gc.collect()
