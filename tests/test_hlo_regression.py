@@ -209,3 +209,66 @@ if __name__ == "__main__":
     with open(os.path.join(REPO, "perf_evidence.json"), "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
     print("wrote perf_evidence.json")
+
+
+def test_gpt_gradient_merge_graph_scans_microbatches():
+    """The accum=2 train step (campaign trial bs8/dots/accum2) must carry
+    ONE scanned microbatch body, not an unrolled double forward: the dot
+    count should stay near the accum=1 step's (body traced once inside
+    stablehlo.while), and a while/scan construct must be present. An
+    unrolled graph would double compile time and code size at 1.3B."""
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import GPT, GPTPretrainingCriterion, gpt_tiny
+    paddle.seed(0)
+    build_mesh(dp=1)
+    cfg = gpt_tiny(remat=True)
+    model = GPT(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4)
+
+    def loss_fn(m, b):
+        return crit(m(paddle.to_tensor(b["x"])), paddle.to_tensor(b["y"]))
+
+    trainer = Trainer(model, opt, loss_fn, grad_accum_steps=2)
+    ids = np.zeros((4, 33), np.int32)  # global batch 4 = 2 micro x 2
+    batch = {"x": jnp.asarray(ids[:, :-1]), "y": jnp.asarray(ids[:, 1:])}
+    lowered = trainer._step_fn.lower(
+        trainer.params, trainer.opt_state, trainer.gt_state, trainer.consts,
+        1e-4, batch)
+    txt = lowered.as_text()
+    assert "stablehlo.while" in txt, "gradient-merge scan was unrolled"
+    n_dots = len(re.findall(r"stablehlo\.dot_general", txt))
+    # one traced body (49, matching the accum=1 step) — unrolling would
+    # put ~98 here
+    assert n_dots <= 60, n_dots
+
+
+def test_resnet_s2d_stem_activation_transposes_bounded():
+    """The space-to-depth stem rewrite (campaign sweep lever) may add
+    exactly ONE activation transpose — the intrinsic 2x2 input pack
+    (dims [0,1,3,2,4,5] on a 6-d reshape, ~38MB bf16 at bs128: ~0.05ms
+    of HBM traffic vs the stem-conv MXU win). Weight-only transposes
+    (applied to %arg parameters) fold into XLA's free layout assignment.
+    Anything beyond that means the rewrite regressed into the
+    NHWC-defeating pattern the baseline test forbids."""
+    from paddle_tpu.vision.models import resnet50
+    paddle.seed(0)
+    build_mesh(dp=1)
+    for s2d, extra in ((False, 0), (True, 2)):
+        model = resnet50(num_classes=10, data_format="NHWC", stem_s2d=s2d)
+        model.bfloat16()
+        model.eval()
+        x = jnp.zeros((2, 64, 64, 3), jnp.bfloat16)
+        txt = _lower_forward(model, x)
+        n_conv = _count(txt, "convolution")
+        n_t = _count(txt, "transpose")
+        # baseline: one weight-layout transpose per conv, nothing else.
+        # s2d: the stem's [2,3,1,0] weight transpose is replaced by the
+        # input 2x2 pack (the one allowed activation transpose) plus TWO
+        # 6-d packs of the 7x7 stem kernel (9408 elements — noise), so
+        # the exact total is conv_count + 2.
+        assert n_conv == 53, (s2d, n_conv)
+        assert n_t == n_conv + extra, (s2d, n_t)
+        pack = [l for l in txt.splitlines()
+                if "dims = [0, 1, 3, 2, 4, 5]" in l]
+        assert len(pack) == (1 if s2d else 0), (s2d, pack)
