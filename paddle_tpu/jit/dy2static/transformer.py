@@ -247,7 +247,11 @@ def _fold_early_returns(stmts, is_func_tail):
 
 def _compute_tail_reads(fdef):
     """For every While/For node: the names read after the loop finishes,
-    including re-reads by the next iteration of any ENCLOSING loop."""
+    including re-reads by the next iteration of any ENCLOSING loop. For
+    every If node: the names read after the `if` completes (used to drop
+    branch-local dead variables from the lax.cond outputs — a loop
+    counter living only inside one branch must not force both branches
+    to agree on its tensor-ness)."""
     out = {}
 
     def walk(stmts, after):
@@ -258,12 +262,21 @@ def _compute_tail_reads(fdef):
                 walk(st.body, out[id(st)])
                 walk(st.orelse, acc)
             elif isinstance(st, ast.If):
+                out[id(st)] = set(acc)
                 walk(st.body, acc)
                 walk(st.orelse, acc)
             elif isinstance(st, ast.With):
                 walk(st.body, acc)
             elif isinstance(st, ast.Try):
-                for part in (st.body, st.orelse, st.finalbody):
+                # an exception can fire after ANY body statement, so a
+                # name read only in a handler (or finally) is still live
+                # throughout the body
+                h_reads = set()
+                for h in st.handlers:
+                    h_reads |= _reads(h.body)
+                h_reads |= _reads(st.finalbody)
+                walk(st.body, acc | h_reads)
+                for part in (st.orelse, st.finalbody):
                     walk(part, acc)
                 for h in st.handlers:
                     walk(h.body, acc)
@@ -272,7 +285,18 @@ def _compute_tail_reads(fdef):
             acc |= _reads(st)
         return acc
 
-    walk(fdef.body, set())
+    # a nested def/lambda's free-variable reads are live over the WHOLE
+    # function: its call position is unknowable, so seeding them into the
+    # initial tail set is the only safe placement (registering them at
+    # the def's source position would miss calls that happen earlier in
+    # the text but later in time)
+    nested = set()
+    for n in ast.walk(fdef):
+        if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)) and n is not fdef):
+            nested |= _reads(n.body)
+
+    walk(fdef.body, nested)
     return out
 
 
@@ -426,6 +450,21 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 or _has_scope_escape(node.body + node.orelse)):
             return node
         mod = _stores(node.body + node.orelse, self._locals)
+        tail = self._tail_reads.get(id(node))
+        if tail is not None:
+            # a name DEAD after the if (never read again — tail is
+            # conservative about enclosing-loop back-edges, handler reads
+            # and nested-def free variables) need not be a cond output:
+            # dropping it lets a branch-local helper (e.g. a while
+            # counter in one branch) exist without the other branch
+            # having to match its tensor-ness. A name a branch reads
+            # BEFORE (re)assigning must stay: `mod` doubles as the
+            # helper's parameter list, and dropping it would leave an
+            # unbound local inside the generated branch fn.
+            carried = (_use_before_def(node.body, set(mod), self._locals)
+                       | _use_before_def(node.orelse, set(mod),
+                                         self._locals))
+            mod = [n for n in mod if n in tail or n in carried]
         if not mod:
             return node   # side-effect-only if: nothing to functionalize
         uid = self._uid()

@@ -530,3 +530,109 @@ def test_print_of_traced_values(capfd):
     assert "2." in captured and "4." in captured, captured
     assert "Traced" not in captured
     np.testing.assert_allclose(out, [2.0, 4.0])
+
+
+def test_branch_local_variable_not_forced_into_cond_outputs():
+    """A name assigned only inside one branch and never read after the
+    `if` (e.g. a nested while's counter) must not become a lax.cond
+    output — before liveness filtering this raised 'branches disagree on
+    which of [i, x] are tensors'."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            i = paddle.zeros([], dtype="int32")
+            while i < 3:
+                x = x * 1.1
+                i = i + 1
+        return x
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        eager = f(x)
+        static = paddle.jit.to_static(f)(x)
+        np.testing.assert_allclose(np.asarray(eager._value),
+                                   np.asarray(static._value), rtol=1e-6)
+
+
+def test_dead_store_in_both_branches_dropped_from_cond():
+    """Names stored in BOTH branches but dead after the if are also
+    dropped — semantically invisible, smaller cond signature."""
+    def f(x):
+        scratch = 0.0
+        if paddle.sum(x) > 0:
+            scratch = paddle.sum(x)
+            y = x + 1
+        else:
+            scratch = paddle.mean(x)
+            y = x - 1
+        return y  # scratch is dead
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_dead_name_read_before_assign_in_branch_stays_bound():
+    """A dead-after-if name whose branch READS its prior value before
+    reassigning must stay a helper parameter (dropping it would leave an
+    unbound local in the generated branch fn)."""
+    def f(x):
+        acc = paddle.zeros([2])
+        if paddle.sum(x) > 0:
+            acc = acc + x
+            y = acc * 2
+        else:
+            y = x
+        return y  # acc dead after the if
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_closure_read_keeps_branch_assignment_live():
+    """A nested def's free-variable read counts as live over the whole
+    function — its call position is unknowable, so a branch-assigned
+    name it reads must remain a cond output."""
+    def f(x):
+        def g():
+            return scale * 2.0
+
+        if paddle.sum(x) > 0:
+            scale = paddle.sum(x)
+            y = x + 1
+        else:
+            scale = paddle.mean(x)
+            y = x - 1
+        return g() + y
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_handler_read_keeps_branch_assignment_live():
+    """A name whose only later read is inside an except handler is live
+    for the whole try body (the exception can fire after any statement)."""
+    def f(x):
+        msg = paddle.zeros([2])
+        try:
+            if paddle.sum(x) > 0:
+                msg = x + 1
+            else:
+                msg = x - 1
+            z = paddle.sum(x)
+        except ValueError:
+            return msg
+        return msg * 0 + z
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
