@@ -270,34 +270,59 @@ def _compute_tail_reads(fdef):
             elif isinstance(st, ast.Try):
                 # an exception can fire after ANY body statement, so a
                 # name read only in a handler (or finally) is still live
-                # throughout the body
-                h_reads = set()
+                # throughout the body; the else clause runs right after
+                # the body, so its reads are body-live too
+                fin_reads = _reads(st.finalbody)
+                h_reads = fin_reads.copy()
                 for h in st.handlers:
                     h_reads |= _reads(h.body)
-                h_reads |= _reads(st.finalbody)
-                walk(st.body, acc | h_reads)
-                for part in (st.orelse, st.finalbody):
-                    walk(part, acc)
+                walk(st.body, acc | h_reads | _reads(st.orelse))
+                walk(st.orelse, acc | fin_reads)
                 for h in st.handlers:
-                    walk(h.body, acc)
+                    walk(h.body, acc | fin_reads)
+                walk(st.finalbody, acc)
             elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 walk(st.body, acc)
             acc |= _reads(st)
         return acc
 
-    # a nested def/lambda's free-variable reads are live over the WHOLE
-    # function: its call position is unknowable, so seeding them into the
-    # initial tail set is the only safe placement (registering them at
-    # the def's source position would miss calls that happen earlier in
-    # the text but later in time)
+    # a nested def/lambda/genexp's FREE-variable reads are live over the
+    # WHOLE function: its call/consumption position is unknowable, so
+    # seeding them into the initial tail set is the only safe placement.
+    # Only free variables — seeding the nested scope's own params/locals
+    # would pin same-named outer branch-locals and defeat the filter.
     nested = set()
     for n in ast.walk(fdef):
-        if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                           ast.Lambda)) and n is not fdef):
-            nested |= _reads(n.body)
+        if n is fdef:
+            continue
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.GeneratorExp)):
+            nested |= _free_reads(n)
 
     walk(fdef.body, nested)
     return out
+
+
+def _free_reads(n):
+    """Name loads under a nested scope MINUS the names that scope binds
+    itself (params, its own assignments, comprehension targets)."""
+    if isinstance(n, ast.GeneratorExp):
+        bound = set()
+        for comp in n.generators:
+            for t in ast.walk(comp.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        return _reads(n) - bound
+    a = n.args
+    bound = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    if isinstance(n, ast.Lambda):
+        return _reads(n.body) - bound
+    bound |= set(_stores(n.body))
+    return _reads(n.body) - bound
 
 
 # --------------------------------------------------------------------------

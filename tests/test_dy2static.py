@@ -636,3 +636,68 @@ def test_handler_read_keeps_branch_assignment_live():
         np.testing.assert_allclose(
             np.asarray(f(x)._value),
             np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_try_else_read_keeps_branch_assignment_live():
+    """A name whose only later read sits in the try's `else:` clause is
+    live through the try body (the else runs right after it)."""
+    def f(x):
+        w = x
+        try:
+            if paddle.sum(x) > 0:
+                v = x + 1
+                w = x * 2
+            else:
+                v = x - 1
+                w = x * 3
+            z = paddle.sum(x)
+        except ValueError:
+            return w
+        else:
+            return v + z
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_generator_expression_read_is_live():
+    """A generator expression consumes lazily at an unknowable position,
+    so a branch-assigned name it reads must stay a cond output."""
+    def f(x):
+        gen = (scale * float(i) for i in [1, 2])
+        if paddle.sum(x) > 0:
+            scale = x + 1
+            y = x * 2
+        else:
+            scale = x - 1
+            y = x * 3
+        parts = list(gen)
+        return y + parts[0] + parts[1]
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_lambda_param_does_not_pin_branch_local():
+    """A lambda whose PARAMETER shares a name with a branch-local must
+    not pin that branch-local as live — only free variables count."""
+    def f(x):
+        g = lambda i: i * 2  # noqa: E731 — param named like the counter
+        if paddle.sum(x) > 0:
+            i = paddle.zeros([], dtype="int32")
+            while i < 3:
+                x = x * 1.1
+                i = i + 1
+        return g(x)
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
