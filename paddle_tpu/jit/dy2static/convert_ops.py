@@ -129,12 +129,32 @@ def to_bool(pred, ctx="condition"):
     return bool(p)
 
 
+_DYN_LEAVES = (jax.Array, jax.core.Tracer, np.ndarray,
+               bool, int, float, complex, np.generic)
+
+
 def _is_dyn(v):
-    """Can this value ride through a lax primitive as an operand?"""
+    """Can this value ride through a lax primitive as an operand?
+    Scalars/arrays/Tensors directly; containers (list/tuple/dict) ride
+    as pytrees when EVERY leaf is dynamic — Tensor is a registered
+    pytree node, so lax.cond/while_loop flatten and rebuild them (both
+    branches / every iteration must keep the same structure, enforced
+    by the structure checks downstream)."""
     if v is UNDEF:
         return False
-    return isinstance(v, (Tensor, jax.Array, jax.core.Tracer, np.ndarray,
-                          bool, int, float, complex, np.generic))
+    if isinstance(v, (Tensor,) + _DYN_LEAVES):
+        return True
+    if isinstance(v, (list, tuple, dict)):
+        leaves = jax.tree_util.tree_leaves(v)
+        # at least one leaf must be an actual device/traced array: a
+        # container of plain Python scalars (`shape = [2, 3]`) must stay
+        # STATIC, or shape-like lists assigned in both branches would
+        # come back as tracers and break paddle.zeros(shape)/reshape
+        return (bool(leaves)
+                and all(isinstance(l, _DYN_LEAVES) for l in leaves)
+                and any(isinstance(l, (jax.Array, jax.core.Tracer))
+                        for l in leaves))
+    return False
 
 
 def _split(vals):
@@ -178,8 +198,26 @@ def _check_same_static(name, a, b):
             f"a traced condition{hint}")
 
 
-def _dyn_names(names, mask):
-    return [n for n, m in zip(names, mask) if m] or list(names)
+def _dyn_names(names, mask, dyn_vals=None):
+    """Names of the dynamic operands, expanded per pytree LEAF when
+    `dyn_vals` is given: error paths (_check_branch_match,
+    _stable_dtypes) index by flattened-leaf position, and a container
+    operand contributes several leaves — without expansion they would
+    blame the wrong variable."""
+    out, it = [], iter(dyn_vals if dyn_vals is not None else ())
+    for n, m in zip(names, mask):
+        if not m:
+            continue
+        if dyn_vals is None:
+            out.append(n)
+            continue
+        v = next(it, None)
+        k = len(jax.tree_util.tree_leaves(v))
+        if k <= 1:
+            out.append(n)
+        else:
+            out.extend(f"{n} (leaf {j})" for j in range(k))
+    return out or list(names)
 
 
 # --------------------------------------------------------------------------
@@ -221,7 +259,8 @@ def convert_ifelse(pred, true_fn, false_fn, operands, names=()):
             f"on which of {list(names)} are tensors; a variable set in "
             "only one branch must already have a tensor value before the "
             "`if`")
-    _check_branch_match(t_out, f_out, names)
+    _check_branch_match(t_out, f_out,
+                        _dyn_names(names, stash["t"][1], list(t_out)))
     for n, a, b in zip([nm for nm, m in zip(names, stash["t"][1]) if not m],
                        stash["t"][0], stash["f"][0]):
         _check_same_static(n, a, b)
@@ -345,7 +384,7 @@ def _traced_while(cond_fn, body_fn, operands, names):
                 f"loop variables {list(names)}")
         return new_flat
 
-    leaf_names = _dyn_names(names, mask)
+    leaf_names = _dyn_names(names, mask, dyn)
     init_flat = [jnp.asarray(_plain(x)) for x in dyn_flat]
     dtypes = _stable_dtypes(body_raw, init_flat, leaf_names)
     init = tuple(x.astype(d) for x, d in zip(init_flat, dtypes))
@@ -411,7 +450,7 @@ def convert_for(iterable, body_fn, operands, names=(), target_arity=1):
                 f"variables {list(names)}")
         return new_flat
 
-    leaf_names = _dyn_names(names, mask)
+    leaf_names = _dyn_names(names, mask, dyn)
     init_flat = [jnp.asarray(_plain(x)) for x in dyn_flat]
     x0 = it[0] if it.shape[0] else it  # aval probe only
     dtypes = _stable_dtypes(lambda flat: step_raw(list(flat), x0),

@@ -305,7 +305,12 @@ def _compute_tail_reads(fdef):
 
 def _free_reads(n):
     """Name loads under a nested scope MINUS the names that scope binds
-    itself (params, its own assignments, comprehension targets)."""
+    itself (params, its own simple-Name assignments, comprehension
+    targets). Subtlety in both directions: `nonlocal`/`global` targets
+    are NOT local bindings (assigning them mutates the outer scope, so
+    their reads/writes stay free), and a subscript store like
+    `out[0] = v` binds nothing — `out` there is a Name LOAD, which the
+    shallow Store-only walk below naturally leaves in the free set."""
     if isinstance(n, ast.GeneratorExp):
         bound = set()
         for comp in n.generators:
@@ -321,7 +326,23 @@ def _free_reads(n):
         bound.add(a.kwarg.arg)
     if isinstance(n, ast.Lambda):
         return _reads(n.body) - bound
-    bound |= set(_stores(n.body))
+    own, escaped = set(), set()
+    stack = list(n.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_NODES):
+            # a deeper scope binds its own names — but its NAME (def g /
+            # class C) is bound HERE
+            name = getattr(node, "name", None)
+            if name:
+                own.add(name)
+            continue
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            escaped.update(node.names)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            own.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    bound |= own - escaped
     return _reads(n.body) - bound
 
 

@@ -701,3 +701,139 @@ def test_lambda_param_does_not_pin_branch_local():
         np.testing.assert_allclose(
             np.asarray(f(x)._value),
             np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_nonlocal_write_in_nested_def_keeps_name_live():
+    """`nonlocal` targets are outer-scope bindings: a nested def that
+    reads-and-writes a branch-assigned name via nonlocal must keep that
+    name a cond output."""
+    def f(x):
+        res = []
+
+        def bump():
+            nonlocal w
+            w = w + 1.0
+            res.append(w)
+
+        if paddle.sum(x) > 0:
+            w = paddle.sum(x)
+        else:
+            w = paddle.mean(x)
+        bump()
+        return res[0]
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_subscript_store_in_nested_def_keeps_name_live():
+    """`out[i] = v` inside a nested def binds nothing — `out` is a free
+    READ and the branch-assigned tensor it refers to must stay live.
+    (Container-valued branch outputs — `out = [t]` — are a separate,
+    pre-existing convert_ifelse limitation and not covered here.)"""
+    def f(x):
+        def fill():
+            out[0] = out[0] * 2.0
+
+        if paddle.sum(x) > 0:
+            out = x + 1
+        else:
+            out = x - 1
+        fill()
+        return out
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_container_branch_outputs_ride_as_pytrees():
+    """`out = [a, b]` / dict-of-tensors assigned per branch: containers
+    whose leaves are all tensors ride lax.cond as pytrees (Tensor is a
+    registered pytree node), so the common multi-output pattern works."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            out = [x + 1, x * 2]
+            d = {"s": paddle.sum(x)}
+        else:
+            out = [x - 1, x * 3]
+            d = {"s": paddle.mean(x)}
+        return out[0] + out[1] + d["s"]
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_container_carried_through_while():
+    """A tuple of tensors as a while-loop carry (same structure every
+    iteration) converts onto lax.while_loop."""
+    def f(x):
+        pair = (x, paddle.zeros([], dtype="float32"))
+        while pair[1] < 3:
+            pair = (pair[0] * 1.5, pair[1] + 1)
+        return pair[0]
+
+    x = paddle.to_tensor(np.asarray([1.0, -2.0], "float32"))
+    np.testing.assert_allclose(
+        np.asarray(f(x)._value),
+        np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_mismatched_container_structure_errors_readably():
+    """Branches disagreeing on container length must raise a TypeError
+    mentioning the variable, not a raw lax structure error."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            out = [x, x]
+        else:
+            out = [x]
+        return out[0]
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))
+    with pytest.raises(TypeError):
+        paddle.jit.to_static(f)(x)
+
+
+def test_static_shape_list_stays_static():
+    """A container of plain Python scalars (`shape = [2, 3]`) assigned in
+    both branches must stay STATIC — turning it into traced arrays would
+    break paddle.zeros(shape)/reshape under to_static."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            shape = [2, 3]
+            y = x + 1
+        else:
+            shape = [2, 3]
+            y = x - 1
+        return paddle.zeros(shape) + paddle.sum(y)
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign, 2 * sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_shape_unstable_container_carry_blames_right_leaf():
+    """Error paths index by flattened leaf: a container carry with an
+    unstable SECOND leaf must name that container, not a later var."""
+    def f(x):
+        pair = (x, paddle.zeros([1]))
+        z = paddle.zeros([])
+        while paddle.sum(pair[0]) > 1.0:
+            pair = (pair[0] / 2.0,
+                    paddle.concat([pair[1], pair[1]]))  # grows: unstable
+            z = z + 1
+        return z
+
+    x = paddle.to_tensor(np.asarray([8.0], "float32"))
+    with pytest.raises(TypeError, match="pair"):
+        paddle.jit.to_static(f)(x)
