@@ -403,22 +403,31 @@ def _plain(v):
     return v._value if isinstance(v, Tensor) else v
 
 
-def convert_for(iterable, body_fn, operands, names=(), target_arity=1):
+def convert_for(iterable, body_fn, operands, names=(), target_arity=1,
+                brk=None):
     """`for` converter.  A Tensor/traced iterable scans over its leading
     axis with `lax.scan`; any other iterable keeps the Python loop (which
     unrolls under jit — the natural XLA behavior for static trip
-    counts)."""
+    counts).  `brk` names the transformer's break guard flag: when its
+    carried value turns CONCRETELY true on the Python path, the loop
+    stops early — restoring real break semantics that the guard rewrite
+    alone would turn into no-op tail iterations."""
     if isinstance(iterable, _TracedRange):
         return _traced_range_for(iterable, body_fn, operands, names,
                                  target_arity)
     it = _raw(iterable)
     if not isinstance(it, jax.core.Tracer):
+        brk_i = names.index(brk) if brk in names else None
         vals = operands
         for x in iterable:
             if target_arity == 1:
                 vals = body_fn(x, *vals)
             else:
                 vals = body_fn(*tuple(x), *vals)
+            if brk_i is not None:
+                flag = _raw(vals[brk_i])
+                if not isinstance(flag, jax.core.Tracer) and bool(flag):
+                    break
         return vals
 
     _check_no_undef(names, operands, "for")

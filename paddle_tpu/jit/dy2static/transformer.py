@@ -14,10 +14,15 @@ Concrete (non-traced) conditions keep exact Python semantics, so the
 transform is safe to apply universally; traced conditions lower to
 lax.cond / lax.while_loop / lax.scan.
 
+`break`/`continue` in tensor loops are rewritten into guard flags
+(break -> carried stop flag ANDed into the loop condition; continue ->
+iteration-local skip flag guarding the rest of the body) and then ride
+the normal if/while functionalization.
+
 Deliberately NOT functionalized (left as plain Python, which still works
 for concrete conditions and raises jax's tracer error for traced ones):
-blocks containing `break`/`continue` bound to an enclosing loop, early
-returns that don't cover both branches, `global`/`nonlocal`, loop-`else`.
+jumps inside with/try blocks, early returns that don't cover both
+branches, `global`/`nonlocal`, loop-`else`.
 """
 import ast
 import functools
@@ -139,16 +144,31 @@ def _reads(node):
 
 def _use_before_def(stmts, candidates, local_names=None):
     """Which of `candidates` are read before they are (re)assigned when
-    executing `stmts` linearly — i.e. loop-carried names.  Compound
-    statements are approximated: their reads count first, then their
+    executing `stmts` linearly — i.e. loop-carried names.  `if`
+    statements are walked branch-by-branch (a name assigned before its
+    read INSIDE a branch is not use-before-def; only names defined in
+    BOTH branches count as definitely-defined afterwards); other
+    compound statements are approximated coarsely: reads first, then
     stores."""
-    carried, defined = set(), set()
-    for stmt in stmts:
-        for name in _reads(stmt):
-            if name in candidates and name not in defined:
-                carried.add(name)
-        for name in _stores([stmt], local_names):
-            defined.add(name)
+    carried = set()
+
+    def run(stmts, defined):
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                for name in _reads(stmt.test):
+                    if name in candidates and name not in defined:
+                        carried.add(name)
+                d_t = run(stmt.body, set(defined))
+                d_f = run(stmt.orelse, set(defined))
+                defined = defined | (d_t & d_f)
+            else:
+                for name in _reads(stmt):
+                    if name in candidates and name not in defined:
+                        carried.add(name)
+                defined = defined | set(_stores([stmt], local_names))
+        return defined
+
+    run(stmts, set())
     return carried
 
 
@@ -178,18 +198,81 @@ def _contains_self(node, kinds):
     return _contains(node, kinds if isinstance(kinds, tuple) else (kinds,))
 
 
-def _has_loop_jump(stmts):
+def _has_loop_jump(stmts, kinds=(ast.Break, ast.Continue)):
     """break/continue bound to an ENCLOSING loop (not one inside)."""
     for s in stmts:
-        if isinstance(s, (ast.Break, ast.Continue)):
+        if isinstance(s, kinds):
             return True
         if isinstance(s, (ast.While, ast.For)):
             continue  # binds its own break/continue
         if isinstance(s, _SCOPE_NODES):
             continue
-        if _contains(s, (ast.Break, ast.Continue), stop=(ast.While, ast.For)):
+        if _contains(s, kinds, stop=(ast.While, ast.For)):
             return True
     return False
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _rewrite_loop_jumps(stmts, brk, cont):
+    """Rewrite break/continue bound to THIS loop into guard-flag
+    assignments (reference break_continue_transformer.py plays the same
+    trick with fluid fill_constant flags):
+
+        break     ->  <brk> = True      (rest of the body guarded off)
+        continue  ->  <cont> = True     (rest of THIS iteration guarded)
+
+    Statements after an `if` that may set a flag are wrapped in
+    `if not (<brk> or <cont>): ...` — the injected ifs then ride the
+    normal if-functionalization, so a flag set under a TRACED condition
+    becomes a lax.cond output and everything downstream masks correctly.
+    Returns the rewritten statements, or None when a jump sits inside a
+    construct we don't restructure (with/try)."""
+    flags = [brk] + ([cont] if cont else [])
+
+    def guard(suffix):
+        test = _name(flags[0])
+        for n in flags[1:]:
+            test = ast.BoolOp(op=ast.Or(), values=[test, _name(n)])
+        return ast.If(test=ast.UnaryOp(op=ast.Not(), operand=test),
+                      body=suffix, orelse=[])
+
+    def rw(body):
+        out = []
+        for i, st in enumerate(body):
+            if isinstance(st, ast.Break):
+                out.append(_assign_const(brk, True))
+                return out                      # rest is dead code
+            if isinstance(st, ast.Continue):
+                out.append(_assign_const(cont, True))
+                return out
+            if isinstance(st, (ast.While, ast.For)) or \
+                    isinstance(st, _SCOPE_NODES):
+                out.append(st)                  # binds its own jumps
+                continue
+            if _contains(st, (ast.Break, ast.Continue),
+                         stop=(ast.While, ast.For)):
+                if not isinstance(st, ast.If):
+                    return None                 # jump inside with/try
+                t_body = rw(st.body)
+                f_body = rw(st.orelse) if st.orelse else []
+                if t_body is None or f_body is None:
+                    return None
+                out.append(ast.If(test=st.test, body=t_body,
+                                  orelse=f_body))
+                suffix = rw(body[i + 1:])
+                if suffix is None:
+                    return None
+                if suffix:
+                    out.append(guard(suffix))
+                return out
+            out.append(st)
+        return out
+
+    return rw(stmts)
 
 
 def _has_scope_escape(stmts):
@@ -245,6 +328,46 @@ def _fold_early_returns(stmts, is_func_tail):
     return stmts
 
 
+def _walk_tail(stmts, after, out, after_out):
+    """Backward liveness walk: record tail-read sets for every If
+    (`out[id]` = names read after the if) and loop (`out[id]` = after
+    the loop + the loop's own reads, for seeding its body;
+    `after_out[id]` = strictly after, which decides the loop's OWN
+    carried variables)."""
+    acc = set(after)
+    for st in reversed(stmts):
+        if isinstance(st, (ast.While, ast.For)):
+            after_out[id(st)] = set(acc)
+            out[id(st)] = acc | _reads(st)
+            _walk_tail(st.body, out[id(st)], out, after_out)
+            _walk_tail(st.orelse, acc, out, after_out)
+        elif isinstance(st, ast.If):
+            out[id(st)] = set(acc)
+            _walk_tail(st.body, acc, out, after_out)
+            _walk_tail(st.orelse, acc, out, after_out)
+        elif isinstance(st, ast.With):
+            _walk_tail(st.body, acc, out, after_out)
+        elif isinstance(st, ast.Try):
+            # an exception can fire after ANY body statement, so a
+            # name read only in a handler (or finally) is still live
+            # throughout the body; the else clause runs right after
+            # the body, so its reads are body-live too
+            fin_reads = _reads(st.finalbody)
+            h_reads = fin_reads.copy()
+            for h in st.handlers:
+                h_reads |= _reads(h.body)
+            _walk_tail(st.body, acc | h_reads | _reads(st.orelse),
+                       out, after_out)
+            _walk_tail(st.orelse, acc | fin_reads, out, after_out)
+            for h in st.handlers:
+                _walk_tail(h.body, acc | fin_reads, out, after_out)
+            _walk_tail(st.finalbody, acc, out, after_out)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _walk_tail(st.body, acc, out, after_out)
+        acc |= _reads(st)
+    return acc
+
+
 def _compute_tail_reads(fdef):
     """For every While/For node: the names read after the loop finishes,
     including re-reads by the next iteration of any ENCLOSING loop. For
@@ -253,38 +376,7 @@ def _compute_tail_reads(fdef):
     counter living only inside one branch must not force both branches
     to agree on its tensor-ness)."""
     out = {}
-
-    def walk(stmts, after):
-        acc = set(after)
-        for st in reversed(stmts):
-            if isinstance(st, (ast.While, ast.For)):
-                out[id(st)] = acc | _reads(st)
-                walk(st.body, out[id(st)])
-                walk(st.orelse, acc)
-            elif isinstance(st, ast.If):
-                out[id(st)] = set(acc)
-                walk(st.body, acc)
-                walk(st.orelse, acc)
-            elif isinstance(st, ast.With):
-                walk(st.body, acc)
-            elif isinstance(st, ast.Try):
-                # an exception can fire after ANY body statement, so a
-                # name read only in a handler (or finally) is still live
-                # throughout the body; the else clause runs right after
-                # the body, so its reads are body-live too
-                fin_reads = _reads(st.finalbody)
-                h_reads = fin_reads.copy()
-                for h in st.handlers:
-                    h_reads |= _reads(h.body)
-                walk(st.body, acc | h_reads | _reads(st.orelse))
-                walk(st.orelse, acc | fin_reads)
-                for h in st.handlers:
-                    walk(h.body, acc | fin_reads)
-                walk(st.finalbody, acc)
-            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                walk(st.body, acc)
-            acc |= _reads(st)
-        return acc
+    after_out = {}
 
     # a nested def/lambda/genexp's FREE-variable reads are live over the
     # WHOLE function: its call/consumption position is unknowable, so
@@ -299,8 +391,8 @@ def _compute_tail_reads(fdef):
                           ast.Lambda, ast.GeneratorExp)):
             nested |= _free_reads(n)
 
-    walk(fdef.body, nested)
-    return out
+    _walk_tail(fdef.body, nested, out, after_out)
+    return out, after_out
 
 
 def _free_reads(n):
@@ -408,8 +500,9 @@ def _assign_tuple(names, value):
 
 class _CtrlFlowTransformer(ast.NodeTransformer):
     def __init__(self, tail_reads, self_name=None, has_class_cell=False,
-                 local_names=None):
+                 local_names=None, after_reads=None):
         self._tail_reads = tail_reads
+        self._after_reads = after_reads or {}
         self._self_name = self_name
         self._has_class_cell = has_class_cell
         self._locals = local_names
@@ -525,23 +618,65 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                       _const_tuple(mod)])
         return [t_fn, f_fn, _assign_tuple(mod, call)]
 
+    def _rewrite_jumps(self, node):
+        """break/continue -> guard flags (see _rewrite_loop_jumps).
+        Mutates node.body on success and registers fresh tail-read
+        entries for the injected/cloned guard ifs — without them the
+        dead-variable filter is skipped and every iteration-local temp
+        would be forced into the loop carry with no pre-loop value.
+        Returns ([brk-init statement], brk_name) or ([], None)."""
+        if (node.orelse or not _has_loop_jump(node.body)
+                or _has_return(node.body)
+                or _has_scope_escape(node.body)):
+            return [], None
+        uid = self._uid()
+        brk = f"_brk_{uid}"
+        cont = (f"_cont_{uid}"
+                if _has_loop_jump(node.body, (ast.Continue,)) else None)
+        new_body = _rewrite_loop_jumps(node.body, brk, cont)
+        if new_body is None:
+            return [], None
+        if cont:
+            new_body = [_assign_const(cont, False)] + new_body
+        node.body = new_body
+        # seed = after-loop reads + names the NEXT iteration reads before
+        # defining (the genuinely carried set) + the flag (read by the
+        # loop test / wrap guard next iteration). Seeding with ALL body
+        # reads would pin defined-before-read iteration temps into every
+        # guard-if's cond outputs, forcing them into the carry with no
+        # pre-loop value.
+        seed = (self._after_reads.get(id(node), set())
+                | _use_before_def(node.body, _reads(node), self._locals)
+                | {brk})
+        _walk_tail(node.body, seed, self._tail_reads, self._after_reads)
+        return [_assign_const(brk, False)], brk
+
     # -- while -------------------------------------------------------------
     def visit_While(self, node):
-        tail = self._tail_reads.get(id(node), set())
+        tail = self._after_reads.get(id(node), set())
+        prelude, brk = self._rewrite_jumps(node)
+        if brk:
+            # `not brk` FIRST: python's break never re-evaluates the
+            # loop test after firing (it may be side-effecting or rely
+            # on state the final iteration invalidated, e.g. seq[i])
+            node.test = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                        node.test])
         self.generic_visit(node)
         if (node.orelse or _has_loop_jump(node.body)
                 or _has_return(node.body)
                 or _has_scope_escape(node.body)):
-            return node
+            return prelude + [node]
         stored = _stores(node.body, self._locals)
         if not stored:
-            return node
+            return prelude + [node]
         carried = _use_before_def(node.body, set(stored), self._locals)
         test_reads = _reads(node.test)
         loop_vars = [n for n in stored
                      if n in carried or n in test_reads or n in tail]
         if not loop_vars:
-            return node
+            return prelude + [node]
         uid = self._uid()
         cname, bname = f"_pt_while_cond_{uid}", f"_pt_while_body_{uid}"
         c_fn = _make_fn(cname, loop_vars, [ast.Return(value=node.test)])
@@ -551,30 +686,46 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_arg_thunk(n) for n in loop_vars],
                                 ctx=ast.Load()),
                       _const_tuple(loop_vars)])
-        return [c_fn, b_fn, _assign_tuple(loop_vars, call)]
+        return prelude + [c_fn, b_fn, _assign_tuple(loop_vars, call)]
 
     # -- for ---------------------------------------------------------------
     def visit_For(self, node):
-        tail = self._tail_reads.get(id(node), set())
-        self.generic_visit(node)
-        if (node.orelse or _has_loop_jump(node.body)
-                or _has_return(node.body)
-                or _has_scope_escape(node.body)):
-            return node
-        # target must be a simple name or flat tuple of names
+        tail = self._after_reads.get(id(node), set())
+        # target shape gates BOTH the conversion and the jump rewrite (a
+        # rewritten body with a dropped prelude would read an unbound
+        # flag)
         if isinstance(node.target, ast.Name):
             tnames = [node.target.id]
         elif isinstance(node.target, ast.Tuple) and all(
                 isinstance(e, ast.Name) for e in node.target.elts):
             tnames = [e.id for e in node.target.elts]
         else:
-            return node
+            tnames = None
+        prelude, brk = ([], None) if tnames is None \
+            else self._rewrite_jumps(node)
+        if brk:
+            # a scan can't exit early: once <brk> is set, every remaining
+            # iteration's whole body is guarded off (the concrete path
+            # early-stops inside convert_for via the brk kwarg)
+            wrap = ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                body=node.body, orelse=[])
+            self._tail_reads[id(wrap)] = (
+                self._after_reads.get(id(node), set())
+                | _use_before_def(node.body, _reads(node), self._locals)
+                | {brk})
+            node.body = [wrap]
+        self.generic_visit(node)
+        if (tnames is None or node.orelse or _has_loop_jump(node.body)
+                or _has_return(node.body)
+                or _has_scope_escape(node.body)):
+            return prelude + [node]
         stored = [n for n in _stores(node.body, self._locals)
                   if n not in tnames]
         carried = _use_before_def(node.body, set(stored), self._locals)
         loop_vars = [n for n in stored if n in carried or n in tail]
         if not loop_vars:
-            return node
+            return prelude + [node]
         it = node.iter
         if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range"):
@@ -583,14 +734,16 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         bname = f"_pt_for_body_{uid}"
         b_fn = _make_fn(bname, tnames + loop_vars,
                         node.body + [_ret_tuple(loop_vars)])
+        kwargs = [("target_arity", ast.Constant(value=len(tnames)))]
+        if brk:
+            kwargs.append(("brk", ast.Constant(value=brk)))
         call = _call(_jst("convert_for"),
                      [it, _name(bname),
                       ast.Tuple(elts=[_arg_thunk(n) for n in loop_vars],
                                 ctx=ast.Load()),
                       _const_tuple(loop_vars)],
-                     kwargs=[("target_arity",
-                              ast.Constant(value=len(tnames)))])
-        return [b_fn, _assign_tuple(loop_vars, call)]
+                     kwargs=kwargs)
+        return prelude + [b_fn, _assign_tuple(loop_vars, call)]
 
 
 # --------------------------------------------------------------------------
@@ -683,7 +836,7 @@ def _build_template(fn):
             kept.append(d)
     fdef.decorator_list = kept
     fdef.body[:] = _fold_early_returns(fdef.body, True)
-    tail_reads = _compute_tail_reads(fdef)
+    tail_reads, after_reads = _compute_tail_reads(fdef)
     self_name = fdef.args.args[0].arg if fdef.args.args else None
     has_class_cell = "__class__" in fn.__code__.co_freevars
     a = fdef.args
@@ -697,7 +850,7 @@ def _build_template(fn):
     local_names = frozenset(params) | frozenset(
         _stores(fdef.body, frozenset()))
     _CtrlFlowTransformer(tail_reads, self_name, has_class_cell,
-                         local_names).visit(fdef)
+                         local_names, after_reads).visit(fdef)
 
     freevars = fn.__code__.co_freevars
     if freevars:

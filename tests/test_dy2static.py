@@ -837,3 +837,187 @@ def test_shape_unstable_container_carry_blames_right_leaf():
     x = paddle.to_tensor(np.asarray([8.0], "float32"))
     with pytest.raises(TypeError, match="pair"):
         paddle.jit.to_static(f)(x)
+
+
+# --------------------------------------------------------------------------
+# break / continue in tensor-dependent loops (guard-flag rewrite)
+# --------------------------------------------------------------------------
+
+def test_break_in_tensor_while():
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        s = paddle.zeros([])
+        while i < 10:
+            s = s + paddle.sum(x) * 0.1
+            if s > 1.0:
+                break
+            i = i + 1
+        return s + i.astype("float32")
+
+    for scale in (1.0, 0.2, -1.0):
+        x = paddle.to_tensor(np.asarray([scale, 2 * scale], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_continue_in_tensor_while():
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        s = paddle.zeros([])
+        while i < 6:
+            i = i + 1
+            if paddle.sum(x) * i.astype("float32") < 2.0:
+                continue
+            s = s + 1.0
+        return s
+
+    for scale in (1.0, 0.3, -1.0):
+        x = paddle.to_tensor(np.asarray([scale, scale], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_break_and_continue_same_loop():
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        s = paddle.zeros([])
+        while i < 8:
+            i = i + 1
+            if i > 5:
+                break
+            if paddle.sum(x) < 0:
+                continue
+            s = s + i.astype("float32")
+        return s + i.astype("float32")
+
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.asarray([sign], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_break_in_for_masks_tail_iterations():
+    def f(x):
+        acc = paddle.zeros([])
+        for _ in range(6):
+            acc = acc + paddle.sum(x) * 0.2
+            if acc > 1.0:
+                break
+        return acc
+
+    for scale in (1.0, 0.1):
+        x = paddle.to_tensor(np.asarray([scale, scale], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_iteration_local_temp_not_carried():
+    """A temp assigned-then-read each iteration must not become a loop
+    carry demanding a pre-loop value (nested inner loop result pattern)."""
+    def f(x):
+        total = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 3:
+            j = paddle.zeros([], dtype="int32")
+            while j < 4:
+                j = j + 1
+                if j > 2:
+                    break
+            total = total + j.astype("float32")
+            i = i + 1
+        return total
+
+    x = paddle.to_tensor(np.asarray([1.0], "float32"))
+    np.testing.assert_allclose(
+        np.asarray(f(x)._value),
+        np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_break_with_unconvertible_for_target_keeps_python_semantics():
+    """A nested-tuple for target can't convert; a CONCRETE-condition
+    break must stay a real Python break (no guard flag, no unbound-name
+    crash). Traced-condition breaks in such loops keep raising the
+    standard tracer error, as before."""
+    def f(x):
+        acc = x * 0
+        total = 0.0
+        for a, (b, c) in [(1.0, (2.0, 3.0)), (4.0, (5.0, 6.0))]:
+            total = total + a + b + c
+            acc = acc + total
+            if total > 5.0:
+                break
+        return acc
+
+    x = paddle.to_tensor(np.asarray([0.0], "float32"))
+    np.testing.assert_allclose(
+        np.asarray(f(x)._value),
+        np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_temp_after_break_if_not_carried():
+    """An iteration-local temp AFTER the flag-if (inside the injected
+    guard) must not join the loop carry demanding a pre-loop value."""
+    def f(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 6:
+            i = i + 1
+            if paddle.sum(x) + s > 3.0:
+                break
+            t = s * 2.0 + 1.0
+            s = s + t
+        return s
+
+    for scale in (0.1, 5.0):
+        x = paddle.to_tensor(np.asarray([scale], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x)._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_break_does_not_reevaluate_loop_test():
+    """Python's break never re-evaluates the loop test; the guard
+    rewrite must check the flag FIRST or `seq[i]` would index out of
+    bounds after the final iteration."""
+    def f(x):
+        seq = [0.0, 0.0, 1.0]
+        i = 0
+        while seq[i] == 0.0:
+            i = i + 1
+            if i == len(seq):
+                break
+        return x + float(i)
+
+    x = paddle.to_tensor(np.asarray([0.0], "float32"))
+    np.testing.assert_allclose(
+        np.asarray(f(x)._value),
+        np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-6)
+
+
+def test_concrete_for_break_exits_early():
+    """On the concrete path a for-break must actually STOP iterating
+    (not run guarded no-op tail iterations)."""
+    seen = []
+
+    def f(x):
+        acc = paddle.zeros([])
+        for i in range(100):
+            seen.append(i)
+            acc = acc + paddle.sum(x)
+            if len(seen) >= 3:
+                break
+        return acc
+
+    x = paddle.to_tensor(np.asarray([1.0], "float32"))
+    eager = f(x)
+    n_eager = len(seen)
+    seen.clear()
+    static = paddle.jit.to_static(f)(x)
+    np.testing.assert_allclose(np.asarray(eager._value),
+                               np.asarray(static._value), rtol=1e-6)
+    assert n_eager == 3
+    assert len(seen) <= 4, f"tail iterations not skipped: {len(seen)}"
