@@ -335,6 +335,24 @@ class Tensor:
     def tolist(self):
         return np.asarray(self._value).tolist()
 
+    def set_value(self, value):
+        """In-place value assignment (reference
+        fluid/dygraph/varbase_patch_methods.py:132 set_value): the shape
+        must match; the new value is cast to this tensor's dtype (the
+        reference asserts dtype equality, but with x64 disabled a
+        silently-f64 numpy literal would then never be assignable).
+        Works on Parameters held by Layers — the Layer keeps this
+        object, only its buffer is replaced."""
+        v = value._value if isinstance(value, Tensor) else \
+            jnp.asarray(value)   # handles list/np/jax without a host hop
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value: shape mismatch — tensor is "
+                f"{tuple(self._value.shape)}, new value is "
+                f"{tuple(v.shape)}")
+        self._value = v.astype(self._value.dtype)
+        return self
+
     def astype(self, d):
         return apply_op(lambda x, _d=dtypes.dtype(d): x.astype(_d), self)
 

@@ -1,10 +1,32 @@
-"""paddle_tpu.hub — reference python/paddle/hub.py. Zero-egress environment:
-only `source="local"` works; github/gitee sources raise."""
+"""paddle_tpu.hub — reference python/paddle/hapi/hub.py. Zero-egress
+environment: only `source="local"` works; github/gitee sources raise
+(they would download archives). The local protocol is the reference's:
+a repo dir with hubconf.py whose public callables are the entrypoints,
+with an optional `dependencies = ["module", ...]` list checked for
+importability right after hubconf itself imports (a hubconf that
+imports a missing module at top level raises that ImportError
+directly)."""
+import importlib
 import importlib.util
 import os
 import sys
 
 __all__ = ["list", "help", "load"]
+
+
+def _check_dependencies(mod):
+    deps = getattr(mod, "dependencies", None)
+    if not deps:
+        return
+    missing = []
+    for d in deps:
+        try:
+            importlib.import_module(d)
+        except ImportError:
+            missing.append(d)
+    if missing:
+        raise RuntimeError(
+            f"hubconf.py declares missing dependencies: {missing}")
 
 
 def _load_hubconf(repo_dir):
@@ -15,10 +37,15 @@ def _load_hubconf(repo_dir):
     mod = importlib.util.module_from_spec(spec)
     sys.modules["hubconf"] = mod
     spec.loader.exec_module(mod)
+    _check_dependencies(mod)
     return mod
 
 
 def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            '"gitee" | "local".')
     if source != "local":
         raise NotImplementedError(
             "zero-egress environment: only source='local' is supported")
@@ -27,14 +54,22 @@ def _check_source(source):
 def list(repo_dir, source="github", force_reload=False):  # noqa: A001
     _check_source(source)
     mod = _load_hubconf(repo_dir)
-    return [n for n in dir(mod) if callable(getattr(mod, n)) and not n.startswith("_")]
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def _entrypoint(mod, model):
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn) or model.startswith("_"):
+        raise RuntimeError(f"hubconf.py has no entrypoint {model!r}")
+    return fn
 
 
 def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
     _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model).__doc__
+    return _entrypoint(_load_hubconf(repo_dir), model).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
     _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+    return _entrypoint(_load_hubconf(repo_dir), model)(**kwargs)

@@ -1,0 +1,77 @@
+"""paddle.hub with a local repo dir (reference python/paddle/hapi/hub.py
+local-source protocol: hubconf.py entrypoints + dependencies list)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+HUBCONF = '''
+dependencies = ["numpy", "paddle_tpu"]
+
+import paddle_tpu as paddle
+
+
+def tiny_mlp(hidden=8, classes=3, pretrained=False):
+    """A two-layer MLP entrypoint. `pretrained` zeroes the head bias so
+    loading effects are observable without downloads."""
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, hidden), paddle.nn.ReLU(),
+        paddle.nn.Linear(hidden, classes))
+    if pretrained:
+        net[2].bias.set_value(paddle.zeros([classes]))
+    return net
+
+
+def _private_helper():
+    return None
+'''
+
+
+@pytest.fixture
+def repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(HUBCONF)
+    return str(tmp_path)
+
+
+def test_list_shows_public_entrypoints(repo):
+    names = paddle.hub.list(repo, source="local")
+    assert "tiny_mlp" in names
+    assert "_private_helper" not in names
+
+
+def test_help_returns_docstring(repo):
+    doc = paddle.hub.help(repo, "tiny_mlp", source="local")
+    assert "two-layer MLP" in doc
+
+
+def test_load_builds_model_with_kwargs(repo):
+    net = paddle.hub.load(repo, "tiny_mlp", source="local",
+                          hidden=16, classes=5, pretrained=True)
+    out = net(paddle.to_tensor(np.zeros((2, 4), "float32")))
+    assert tuple(out.shape) == (2, 5)
+    np.testing.assert_allclose(net[2].bias.numpy(), np.zeros(5), atol=0)
+
+
+def test_missing_entrypoint_raises(repo):
+    with pytest.raises(RuntimeError, match="no entrypoint"):
+        paddle.hub.load(repo, "nope", source="local")
+
+
+def test_missing_dependency_raises(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['definitely_not_a_module_xyz']\n"
+        "def m():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="missing dependencies"):
+        paddle.hub.load(str(tmp_path), "m", source="local")
+
+
+def test_remote_sources_raise(repo):
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        paddle.hub.list("owner/repo", source="github")
+    with pytest.raises(ValueError, match="Unknown source"):
+        paddle.hub.list(repo, source="ftp")
+
+
+def test_non_callable_attribute_is_not_an_entrypoint(repo):
+    with pytest.raises(RuntimeError, match="no entrypoint"):
+        paddle.hub.load(repo, "dependencies", source="local")

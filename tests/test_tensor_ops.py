@@ -207,3 +207,23 @@ class TestRandom:
         assert _np(u).min() >= 2.0 and _np(u).max() <= 3.0
         p = paddle.randperm(10)
         np.testing.assert_array_equal(np.sort(_np(p)), np.arange(10))
+
+
+def test_set_value_in_place():
+    """Reference varbase set_value: same-shape in-place assignment, cast
+    to the tensor's dtype; shape mismatch raises; Layer-held Parameters
+    observe the change (weight-surgery pattern)."""
+    t = paddle.ones([2, 3])
+    t.set_value(np.full((2, 3), 7.0))
+    np.testing.assert_allclose(t.numpy(), 7.0)
+    t.set_value(paddle.zeros([2, 3]))
+    np.testing.assert_allclose(t.numpy(), 0.0)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        t.set_value(np.zeros((3, 2), "float32"))
+
+    lin = paddle.nn.Linear(3, 2)
+    w = np.arange(6, dtype="float32").reshape(3, 2)
+    lin.weight.set_value(w)
+    out = lin(paddle.to_tensor(np.ones((1, 3), "float32")))
+    np.testing.assert_allclose(out.numpy(), w.sum(0)[None] + lin.bias.numpy(),
+                               rtol=1e-6)
