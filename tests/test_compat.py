@@ -1,0 +1,31 @@
+"""paddle.compat text helpers (reference python/paddle/compat.py)."""
+import paddle_tpu as paddle
+from paddle_tpu import compat
+
+
+def test_to_text_recurses_containers():
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_text([b"a", "b", 3]) == ["a", "b", 3]
+    assert compat.to_text({b"k": b"v"}) == {"k": "v"}
+    assert compat.to_text({b"x", "y"}) == {"x", "y"}
+    assert compat.to_text(None) is None
+
+
+def test_to_bytes_round_trips():
+    obj = ["a", {"k": "v"}, 7]
+    assert compat.to_text(compat.to_bytes(obj)) == obj
+
+
+def test_inplace_mutates_containers():
+    lst = [b"a", [b"b"]]
+    out = compat.to_text(lst, inplace=True)
+    assert out is lst
+    assert lst == ["a", ["b"]]
+    d = {b"k": b"v"}
+    assert compat.to_text(d, inplace=True) is d
+    assert d == {"k": "v"}
+
+
+def test_floor_division_and_exception_message():
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"

@@ -1021,3 +1021,44 @@ def test_concrete_for_break_exits_early():
                                np.asarray(static._value), rtol=1e-6)
     assert n_eager == 3
     assert len(seen) <= 4, f"tail iterations not skipped: {len(seen)}"
+
+
+class _ContainerBreakNet(paddle.nn.Layer):
+    """Container branch outputs + break in a tensor loop, in one forward:
+    the integration shape for jit.save below."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:
+            parts = [h * 2.0, h + 1.0]
+        else:
+            parts = [h * 0.5, h - 1.0]
+        out = parts[0] + parts[1]
+        i = paddle.zeros([], dtype="int32")
+        while i < 5:
+            out = out * 1.2
+            if out.sum() > 50.0:
+                break
+            i = i + 1
+        return out
+
+
+def test_containers_and_break_through_jit_save(tmp_path):
+    """The new dy2static features must survive the export path: eager ==
+    to_static == jit.load(jit.save(...)) on the same input."""
+    paddle.seed(0)
+    net = _ContainerBreakNet()
+    x = paddle.to_tensor(np.full((2, 4), 0.7, "float32"))
+    eager = net(x).numpy()
+    np.testing.assert_allclose(eager, paddle.jit.to_static(net)(x).numpy(),
+                               rtol=1e-5)
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+    out = paddle.jit.load(path)(x)
+    out = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    np.testing.assert_allclose(eager, out, rtol=1e-5)

@@ -158,8 +158,3 @@ def test_cost_model():
     finally:
         paddle.disable_static()
 
-
-def test_compat():
-    assert paddle.compat.to_text(b"abc") == "abc"
-    assert paddle.compat.to_bytes(["a", "b"]) == [b"a", b"b"]
-    assert paddle.compat.to_text({b"k": b"v"}) == {"k": "v"}
