@@ -335,6 +335,28 @@ class Tensor:
     def tolist(self):
         return np.asarray(self._value).tolist()
 
+    def gradient(self):
+        """Numpy value of this tensor's gradient, or None (reference
+        varbase_patch_methods.py:306; the reference itself steers users
+        toward `.grad`, which we also provide)."""
+        return None if self.grad is None else np.asarray(self.grad._value)
+
+    def to_sparse_coo(self, sparse_dim):
+        """Dense -> SparseCooTensor over the leading `sparse_dim` dims
+        (reference varbase_patch_methods.py:949); conversion itself lives
+        in sparse.dense_to_coo, shared with the sparse-conv paths."""
+        from ..sparse import dense_to_coo
+        ndim = len(self.shape)
+        if not 0 < sparse_dim <= ndim:
+            raise ValueError(f"sparse_dim must be in [1, {ndim}], "
+                             f"got {sparse_dim}")
+        return dense_to_coo(self, sparse_dim)
+
+    def to_dense(self):
+        """Already dense — identity (parity with SparseCooTensor.to_dense
+        so generic code can call .to_dense() on either)."""
+        return self
+
     def set_value(self, value):
         """In-place value assignment (reference
         fluid/dygraph/varbase_patch_methods.py:132 set_value): the shape

@@ -227,3 +227,27 @@ def test_set_value_in_place():
     out = lin(paddle.to_tensor(np.ones((1, 3), "float32")))
     np.testing.assert_allclose(out.numpy(), w.sum(0)[None] + lin.bias.numpy(),
                                rtol=1e-6)
+
+
+def test_gradient_returns_numpy_or_none():
+    """Reference varbase gradient(): numpy of .grad, None before any
+    backward (their own docs' x**4 example)."""
+    x = paddle.to_tensor(5.0, stop_gradient=False)
+    (x ** 4.0).backward()
+    np.testing.assert_allclose(x.gradient(), 500.0)
+    assert paddle.ones([2]).gradient() is None
+
+
+def test_to_sparse_coo_round_trips():
+    d = paddle.to_tensor(np.array([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]],
+                                  "float32"))
+    sp = d.to_sparse_coo(2)
+    assert sp.nnz() == 3
+    np.testing.assert_allclose(sp.to_dense().numpy(), d.numpy())
+    # hybrid: leading dim sparse, values are row slices
+    sp1 = d.to_sparse_coo(1)
+    assert sp1.nnz() == 2
+    np.testing.assert_allclose(sp1.to_dense().numpy(), d.numpy())
+    with pytest.raises(ValueError, match="sparse_dim"):
+        d.to_sparse_coo(3)
+    assert d.to_dense() is d
