@@ -2,6 +2,8 @@
 Tensor methods (reference: python/paddle/tensor/__init__.py tensor_method_func
 + monkey_patch_varbase)."""
 from ..framework import set_printoptions  # noqa: F401
+import jax.numpy as _jnp
+
 from ..framework.core import Tensor
 from . import array, attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat
 from .array import *  # noqa: F401,F403
@@ -16,7 +18,11 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
-# names that are Tensor properties or core methods — never overwrite
+# names excluded from the BULK patch loop: Tensor properties that must
+# not be shadowed (shape), creation/random free functions whose first
+# argument is not a tensor, and names needing a hand-written method form
+# — four of which (rank / is_empty / is_tensor / broadcast_shape) are
+# patched explicitly at the end of _patch_tensor_methods.
 _SKIP = {
     "shape", "rank", "to_tensor", "as_tensor", "is_tensor", "numel",
     "seed", "get_rng_state", "set_rng_state", "rand", "randn", "randint",
@@ -27,7 +33,7 @@ _SKIP = {
 def _patch_tensor_methods():
     for mod in (attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat):
         for name in getattr(mod, "__all__", []):
-            if name in _SKIP or hasattr(Tensor, name) and name not in getattr(mod, "__all__", []):
+            if name in _SKIP:
                 continue
             fn = getattr(mod, name)
             if callable(fn) and not hasattr(Tensor, name):
@@ -42,7 +48,15 @@ def _patch_tensor_methods():
     Tensor.multiply = math.multiply
     Tensor.divide = math.divide
     Tensor.matmul = math.matmul
-    Tensor.numel = lambda self: self.size
+    # reference numel() returns a 0-D Tensor (int(t.numel()) and
+    # arithmetic both work through the Tensor wrapper)
+    Tensor.numel = lambda self: Tensor(_jnp.asarray(self.size))
+    # last four names from the reference tensor_method_func list
+    Tensor.rank = attribute.rank
+    Tensor.is_empty = logic.is_empty
+    Tensor.is_tensor = logic.is_tensor
+    Tensor.broadcast_shape = \
+        lambda self, y_shape: math.broadcast_shape(self.shape, y_shape)
 
 
 _patch_tensor_methods()

@@ -251,3 +251,15 @@ def test_to_sparse_coo_round_trips():
     with pytest.raises(ValueError, match="sparse_dim"):
         d.to_sparse_coo(3)
     assert d.to_dense() is d
+
+
+def test_last_tensor_method_func_names_attached():
+    """The reference patches 220 functions onto Tensor
+    (tensor/__init__.py tensor_method_func); these four were the last
+    missing as METHODS (the free functions already existed)."""
+    t = paddle.ones([2, 3])
+    assert int(t.rank()) == 2
+    assert not bool(t.is_empty())
+    assert bool(paddle.zeros([0, 3]).is_empty())
+    assert t.is_tensor()
+    assert t.broadcast_shape([4, 1, 3]) == [4, 2, 3]
