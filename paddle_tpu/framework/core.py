@@ -367,6 +367,12 @@ class Tensor:
         object, only its buffer is replaced."""
         v = value._value if isinstance(value, Tensor) else \
             jnp.asarray(value)   # handles list/np/jax without a host hop
+        if self._value.size == 0 and self._value.ndim == 1:
+            # an empty placeholder (Layer.create_tensor) takes its shape
+            # from the first assignment, like the reference's
+            # uninitialized Variables
+            self._value = v.astype(self._value.dtype)
+            return self
         if tuple(v.shape) != tuple(self._value.shape):
             raise ValueError(
                 f"set_value: shape mismatch — tensor is "

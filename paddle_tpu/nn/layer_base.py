@@ -62,6 +62,7 @@ class Layer:
         d["_name_scope"] = name_scope or type(self).__name__.lower()
         d["_forward_pre_hooks"] = collections.OrderedDict()
         d["_forward_post_hooks"] = collections.OrderedDict()
+        d["_state_dict_hooks"] = collections.OrderedDict()
 
     # -- attribute routing -------------------------------------------------
     def __setattr__(self, name, value):
@@ -130,6 +131,52 @@ class Layer:
         handle = _HookHandle(self._forward_post_hooks)
         self._forward_post_hooks[handle.id] = hook
         return handle
+
+    def register_state_dict_hook(self, hook):
+        """hook(state_dict) runs on every state_dict() result (reference
+        layers.py register_state_dict_hook); a non-None return replaces
+        the dict."""
+        handle = _HookHandle(self._state_dict_hooks)
+        self._state_dict_hooks[handle.id] = hook
+        return handle
+
+    def backward(self, *inputs):
+        # reference layers.py: autograd owns backward; a Layer must not
+        raise ValueError("Layer shouldn't implement backward")
+
+    def clear_gradients(self):
+        """Zero out every parameter's .grad (reference layers.py
+        clear_gradients — the per-layer form of optimizer.clear_grad)."""
+        for p in self.parameters():
+            p.clear_grad()
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        """An empty tensor attached to this layer as a (by default
+        non-persistable) buffer — reference layers.py create_tensor,
+        typically filled later via set_value (set_value accepts any
+        shape while the tensor is still empty). Defaults to the layer's
+        dtype, matching create_parameter."""
+        t = Tensor(jnp.zeros(
+            (0,), dtypes.dtype(dtype) if dtype is not None else self._dtype))
+        n = name or f"_generated_tensor_{len(self._buffers)}"
+        self.register_buffer(n, t, persistable=bool(persistable))
+        return t
+
+    # deprecated reference spelling of create_tensor
+    create_variable = create_tensor
+
+    def to_static_state_dict(self, destination=None, include_sublayers=True,
+                             use_hook=True):
+        """state_dict that also includes NON-persistable buffers
+        (reference layers.py to_static_state_dict: the static-graph
+        export needs every buffer)."""
+        dest = destination if destination is not None \
+            else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[name] = p
+        for name, b in self.named_buffers():
+            dest[name] = b
+        return self._apply_state_dict_hooks(dest, use_hook)
 
     # -- parameter management ----------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
@@ -259,13 +306,37 @@ class Layer:
 
     # -- state dict ----------------------------------------------------------
     def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
-        dest = destination if destination is not None else collections.OrderedDict()
-        for name, p in self.named_parameters():
-            dest[name] = p
-        for name, b in self.named_buffers():
-            last = name.rsplit(".", 1)[-1]
-            if last not in self._non_persistable_buffer_names:
+        """Parameters + persistable buffers, collected RECURSIVELY so
+        that (a) each layer's own _non_persistable_buffer_names filters
+        its own buffers — a sublayer's scratch buffer can't leak through
+        an ancestor, nor can a same-named persistable one be dropped —
+        and (b) every layer's state_dict hooks run on its own sub-dict
+        before prefixing, wherever in the tree state_dict() is called."""
+        dest = collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                dest[name] = p
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
                 dest[name] = b
+        if include_sublayers:
+            for sname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                for k, v in sub.state_dict(use_hook=use_hook).items():
+                    dest[f"{sname}.{k}"] = v
+        dest = self._apply_state_dict_hooks(dest, use_hook)
+        if destination is not None:
+            destination.update(dest)
+            return destination
+        return dest
+
+    def _apply_state_dict_hooks(self, dest, use_hook):
+        if use_hook:
+            for hook in self._state_dict_hooks.values():
+                out = hook(dest)
+                if out is not None:
+                    dest = out
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):

@@ -196,3 +196,63 @@ def test_grad_clip_global_norm():
     g = paddle.to_tensor(np.full(4, 10.0, np.float32))
     (_, clipped), = clip([(p, g)])
     np.testing.assert_allclose(np.linalg.norm(clipped.numpy()), 1.0, rtol=1e-5)
+
+
+def test_layer_method_gaps_closed():
+    """Reference Layer methods found missing in a class-surface audit:
+    clear_gradients, create_tensor/create_variable, backward stub,
+    register_state_dict_hook, to_static_state_dict."""
+    lin = paddle.nn.Linear(3, 2)
+
+    # clear_gradients zeroes every param grad
+    loss = lin(paddle.ones([1, 3])).sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    lin.clear_gradients()
+    assert lin.weight.grad is None
+
+    # Layer.backward must refuse (autograd owns backward)
+    with pytest.raises(ValueError, match="backward"):
+        lin.backward()
+
+    # create_tensor attaches a non-persistable buffer, fillable later
+    t = lin.create_tensor(name="scratch")
+    assert tuple(t.shape) == (0,)
+    assert "scratch" not in lin.state_dict()          # non-persistable
+    assert "scratch" in lin.to_static_state_dict()    # static export sees it
+    assert lin.create_variable.__func__ is paddle.nn.Layer.create_tensor
+
+    # state_dict hooks can rewrite the result; handle.remove() unhooks
+    def drop_bias(sd):
+        sd = {k: v for k, v in sd.items() if "bias" not in k}
+        return sd
+
+    h = lin.register_state_dict_hook(drop_bias)
+    assert "bias" not in lin.state_dict()
+    h.remove()
+    assert "bias" in lin.state_dict()
+
+    # empty placeholder takes its shape on first set_value
+    t.set_value(np.ones((3,), "float32"))
+    assert tuple(t.shape) == (3,)
+
+    # a SUBLAYER's non-persistable buffer must not leak through the
+    # parent's state_dict, and sublayer hooks fire from the parent
+    class Holder(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(3, 2)
+
+        def forward(self, x):
+            return self.lin(x)
+
+    net = Holder()
+    net.lin.create_tensor(name="scratch")
+    sd = net.state_dict()
+    assert "lin.scratch" not in sd
+    assert "lin.scratch" in net.to_static_state_dict()
+    net.lin.register_state_dict_hook(
+        lambda d: {k: v for k, v in d.items() if "bias" not in k})
+    assert "lin.bias" not in net.state_dict()
+    assert "lin.weight" in net.state_dict()
+
