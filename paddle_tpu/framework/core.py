@@ -262,7 +262,8 @@ class Tensor:
     """
 
     __slots__ = ("_value", "stop_gradient", "grad", "_producer", "name",
-                 "persistable", "partition_spec", "__weakref__")
+                 "persistable", "partition_spec", "_deferred_shape",
+                 "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None):
         if isinstance(value, Tensor):
@@ -367,11 +368,12 @@ class Tensor:
         object, only its buffer is replaced."""
         v = value._value if isinstance(value, Tensor) else \
             jnp.asarray(value)   # handles list/np/jax without a host hop
-        if self._value.size == 0 and self._value.ndim == 1:
-            # an empty placeholder (Layer.create_tensor) takes its shape
-            # from the first assignment, like the reference's
-            # uninitialized Variables
+        if getattr(self, "_deferred_shape", False):
+            # a Layer.create_tensor placeholder takes its shape from the
+            # first assignment (like the reference's uninitialized
+            # Variables); ordinary empty tensors keep strict validation
             self._value = v.astype(self._value.dtype)
+            self._deferred_shape = False
             return self
         if tuple(v.shape) != tuple(self._value.shape):
             raise ValueError(

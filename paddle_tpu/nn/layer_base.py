@@ -158,6 +158,7 @@ class Layer:
         dtype, matching create_parameter."""
         t = Tensor(jnp.zeros(
             (0,), dtypes.dtype(dtype) if dtype is not None else self._dtype))
+        t._deferred_shape = True   # set_value fills any shape ONCE
         n = name or f"_generated_tensor_{len(self._buffers)}"
         self.register_buffer(n, t, persistable=bool(persistable))
         return t
@@ -170,13 +171,12 @@ class Layer:
         """state_dict that also includes NON-persistable buffers
         (reference layers.py to_static_state_dict: the static-graph
         export needs every buffer)."""
-        dest = destination if destination is not None \
-            else collections.OrderedDict()
-        for name, p in self.named_parameters():
-            dest[name] = p
-        for name, b in self.named_buffers():
-            dest[name] = b
-        return self._apply_state_dict_hooks(dest, use_hook)
+        dest = self._collect_state(include_sublayers, use_hook,
+                                   persistable_only=False, seen=set())
+        if destination is not None:
+            destination.update(dest)
+            return destination
+        return dest
 
     # -- parameter management ----------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
@@ -311,25 +311,39 @@ class Layer:
         its own buffers — a sublayer's scratch buffer can't leak through
         an ancestor, nor can a same-named persistable one be dropped —
         and (b) every layer's state_dict hooks run on its own sub-dict
-        before prefixing, wherever in the tree state_dict() is called."""
-        dest = collections.OrderedDict()
-        for name, p in self._parameters.items():
-            if p is not None:
-                dest[name] = p
-        for name, b in self._buffers.items():
-            if b is not None and name not in self._non_persistable_buffer_names:
-                dest[name] = b
-        if include_sublayers:
-            for sname, sub in self._sub_layers.items():
-                if sub is None:
-                    continue
-                for k, v in sub.state_dict(use_hook=use_hook).items():
-                    dest[f"{sname}.{k}"] = v
-        dest = self._apply_state_dict_hooks(dest, use_hook)
+        before prefixing, wherever in the tree state_dict() is called.
+        Shared/tied objects serialize once under their first name, the
+        same dedup named_parameters applies."""
+        dest = self._collect_state(include_sublayers, use_hook,
+                                   persistable_only=True, seen=set())
         if destination is not None:
             destination.update(dest)
             return destination
         return dest
+
+    def _collect_state(self, include_sublayers, use_hook, persistable_only,
+                       seen):
+        dest = collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                dest[name] = p
+        for name, b in self._buffers.items():
+            if b is None or id(b) in seen:
+                continue
+            if persistable_only and name in self._non_persistable_buffer_names:
+                continue
+            seen.add(id(b))
+            dest[name] = b
+        if include_sublayers:
+            for sname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sd = sub._collect_state(True, use_hook, persistable_only,
+                                        seen)
+                for k, v in sd.items():
+                    dest[f"{sname}.{k}"] = v
+        return self._apply_state_dict_hooks(dest, use_hook)
 
     def _apply_state_dict_hooks(self, dest, use_hook):
         if use_hook:
@@ -340,7 +354,9 @@ class Layer:
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
-        own = self.state_dict()
+        # hooks filter what gets SAVED; loading must see the raw surface
+        # or a save-filtering hook silently blocks restoring those keys
+        own = self.state_dict(use_hook=False)
         missing, unexpected = [], []
         for name, t in own.items():
             if name in state_dict:

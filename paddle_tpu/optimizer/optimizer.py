@@ -100,7 +100,31 @@ class Optimizer:
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
-        lr = self.get_lr()
+        self._apply_param_updates(params_grads, self.get_lr())
+
+    def apply_gradients(self, params_grads):
+        """Reference optimizer.apply_gradients — the second half of
+        minimize: apply THIS optimizer's update rule to explicit
+        (param, grad) pairs. The optimizer's grad_clip applies, exactly
+        as in step()."""
+        self._step_count += 1
+        pairs = [(p, g if isinstance(g, Tensor) else Tensor(jnp.asarray(g)))
+                 for p, g in params_grads]
+        if self._grad_clip is not None:
+            pairs = self._grad_clip(pairs)
+        self._apply_param_updates(pairs, self.get_lr())
+
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        """Reference optimizer.backward — the first half of minimize:
+        run autograd and return the (param, grad) pairs this optimizer
+        would update."""
+        loss.backward()
+        plist = parameters if parameters is not None else self._parameter_list
+        return [(p, p.grad) for p in plist
+                if not p.stop_gradient and p.grad is not None]
+
+    def _apply_param_updates(self, params_grads, lr):
         for p, g in params_grads:
             slots = self._ensure_slots(id(p), p)
             p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \

@@ -256,3 +256,38 @@ def test_layer_method_gaps_closed():
     assert "lin.bias" not in net.state_dict()
     assert "lin.weight" in net.state_dict()
 
+
+
+def test_state_dict_hook_does_not_block_loading():
+    """Hooks filter SAVING; set_state_dict must see the raw surface."""
+    lin = paddle.nn.Linear(2, 2)
+    lin.register_state_dict_hook(
+        lambda d: {k: v for k, v in d.items() if "bias" not in k})
+    lin.set_state_dict({"weight": np.ones((2, 2), "float32"),
+                        "bias": np.full((2,), 7.0, "float32")})
+    np.testing.assert_allclose(lin.bias.numpy(), 7.0)
+
+
+def test_tied_parameters_serialize_once():
+    """Shared/tied params keep the named_parameters dedup in state_dict
+    (one entry under the first name), and the dict round-trips."""
+    class Tied(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(2, 2)
+            self.b = paddle.nn.Linear(2, 2)
+            self.b.weight = self.a.weight
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    net = Tied()
+    sd = net.state_dict()
+    assert "a.weight" in sd and "b.weight" not in sd
+    net.set_state_dict(sd)
+
+
+def test_plain_empty_tensor_set_value_still_validates():
+    t = paddle.to_tensor(np.array([], dtype="float32"))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        t.set_value(np.ones((3, 3), "float32"))

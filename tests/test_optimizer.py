@@ -239,3 +239,43 @@ def test_adam_lazy_mode_compiled_path():
     np.testing.assert_array_equal(before[0], after[0])
     np.testing.assert_array_equal(before[1], after[1])
     assert not np.allclose(before[2], after[2])
+
+
+def test_backward_apply_gradients_split_matches_step():
+    """Reference minimize = backward() + apply_gradients(); the split
+    path must produce the same update as loss.backward()+step()."""
+    paddle.seed(0)
+    a = nn.Linear(3, 2)
+    b = nn.Linear(3, 2)
+    b.set_state_dict(a.state_dict())
+    x = paddle.rand([4, 3])
+    y = paddle.rand([4, 2])
+
+    opt_a = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=a.parameters())
+    loss = F.mse_loss(a(x), y)
+    loss.backward()
+    opt_a.step()
+
+    opt_b = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=b.parameters())
+    pg = opt_b.backward(F.mse_loss(b(x), y))
+    assert len(pg) == 2 and all(g is not None for _, g in pg)
+    opt_b.apply_gradients(pg)
+
+    for (n1, p1), (n2, p2) in zip(a.named_parameters(), b.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6,
+                                   err_msg=n1)
+
+
+def test_apply_gradients_respects_grad_clip():
+    paddle.seed(0)
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(
+        learning_rate=1.0, parameters=lin.parameters(),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1e-3))
+    before = lin.weight.numpy().copy()
+    big = paddle.to_tensor(np.full((2, 2), 1e3, "float32"))
+    opt.apply_gradients([(lin.weight, big)])
+    delta = np.abs(lin.weight.numpy() - before).sum()
+    assert 0 < delta < 1e-2, delta  # clipped to ~1e-3 global norm
