@@ -529,6 +529,78 @@ class _MultiprocessPool:
                 self.shutdown()
 
 
+class _GeneratorLoader:
+    """Loader returned by DataLoader.from_generator (the deprecated
+    fluid feeder, reference fluid/reader.py): old migration code calls
+    one of the set_*_generator methods and then iterates. Batches pass
+    through as tensors; sample generators are batched with the given
+    batch_size."""
+
+    def __init__(self, return_list=True, drop_last=True):
+        if not return_list:
+            raise NotImplementedError(
+                "return_list=False (dict batches keyed by feed names) is a "
+                "static-graph fluid behavior; this loader yields tensor "
+                "lists/tuples")
+        self._gen = None
+        self._mode = "batch"
+        self._batch_size = 1
+        self._drop_last = drop_last
+
+    def set_batch_generator(self, generator, places=None):
+        self._gen, self._mode = generator, "batch"
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        # each yielded item is a LIST OF SAMPLES -> collate to batch
+        # tensors (reference fluid reader semantics)
+        self._gen, self._mode = generator, "sample_list"
+        return self
+
+    def set_sample_generator(self, generator, batch_size=1, drop_last=None,
+                             places=None):
+        self._gen, self._mode = generator, "sample"
+        self._batch_size = batch_size
+        if drop_last is not None:   # else keep from_generator's setting
+            self._drop_last = drop_last
+        return self
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError("call set_batch_generator / "
+                               "set_sample_generator first")
+        if self._mode == "batch":
+            for item in self._gen():
+                yield _to_tensor_tree(item)
+            return
+        if self._mode == "sample_list":
+            for samples in self._gen():
+                yield default_collate_fn(list(samples))
+            return
+        buf = []
+        for sample in self._gen():
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                yield default_collate_fn(buf)
+                buf = []
+        if buf and not self._drop_last:
+            yield default_collate_fn(buf)
+
+
+def _to_tensor_tree(item):
+    if isinstance(item, (list, tuple)):
+        return type(item)(_to_tensor_tree(v) for v in item)
+    if isinstance(item, dict):
+        return {k: _to_tensor_tree(v) for k, v in item.items()}
+    if isinstance(item, Tensor) or np.isscalar(item):
+        return item
+    import jax.numpy as jnp
+    try:
+        return Tensor(jnp.asarray(item))
+    except TypeError:
+        return item
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -689,3 +761,25 @@ class DataLoader:
                 pool.shutdown()
             except Exception:
                 pass
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None, use_double_buffer=True,
+                       iterable=True, return_list=True,
+                       use_multiprocess=False, drop_last=True):
+        """Deprecated fluid feeder (reference fluid/reader.py
+        from_generator): returns a loader whose set_*_generator methods
+        install a python generator; new code should construct
+        DataLoader(dataset) directly."""
+        return _GeneratorLoader(return_list=return_list,
+                                drop_last=drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Reference from_dataset feeds the C++ parameter-server Dataset
+        (fleet PS mode). The TPU-native answer to that workload is
+        mesh-sharded embedding tables (distributed.ShardedEmbedding) +
+        a normal DataLoader — see docs/distributed.md."""
+        raise NotImplementedError(
+            "from_dataset wraps the fluid parameter-server Dataset; use "
+            "DataLoader(dataset) with distributed.ShardedEmbedding for "
+            "recsys-scale tables (docs/distributed.md)")

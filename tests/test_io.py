@@ -1,0 +1,43 @@
+"""DataLoader compat surface (fluid feeder migration paths)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_from_generator_batch_and_sample_modes():
+    """Deprecated fluid feeder (reference fluid/reader.py): migration
+    code calling set_batch_generator / set_sample_generator iterates
+    tensors; from_dataset (the C++ PS feeder) deflects to
+    ShardedEmbedding."""
+    loader = paddle.io.DataLoader.from_generator(capacity=4)
+    loader.set_batch_generator(
+        lambda: iter([np.ones((2, 3), "float32") * i for i in range(3)]))
+    batches = list(loader)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[2].numpy(), 2.0)
+
+    loader2 = paddle.io.DataLoader.from_generator()
+    loader2.set_sample_generator(
+        lambda: iter([np.full((3,), i, "float32") for i in range(5)]),
+        batch_size=2, drop_last=False)
+    shapes = [tuple(b.shape) for b in loader2]
+    assert shapes == [(2, 3), (2, 3), (1, 3)]
+
+    # sample-LIST generator collates each yielded list into batch tensors
+    loader3 = paddle.io.DataLoader.from_generator()
+    loader3.set_sample_list_generator(lambda: iter(
+        [[(np.ones((3,), "float32") * i, np.int64(i)) for i in range(2)]]))
+    (imgs, lbls), = list(loader3)
+    assert tuple(imgs.shape) == (2, 3) and tuple(lbls.shape) == (2,)
+
+    # drop_last given to from_generator survives set_sample_generator
+    loader4 = paddle.io.DataLoader.from_generator(drop_last=False)
+    loader4.set_sample_generator(
+        lambda: iter([np.zeros((2,), "float32")] * 3), batch_size=2)
+    assert len(list(loader4)) == 2  # partial final batch kept
+
+    with pytest.raises(NotImplementedError, match="ShardedEmbedding"):
+        paddle.io.DataLoader.from_dataset(None)
+    with pytest.raises(NotImplementedError, match="return_list"):
+        paddle.io.DataLoader.from_generator(return_list=False)
