@@ -167,10 +167,10 @@ def cross(x, y, axis=9, name=None):
     return apply_op(_f, x, y)
 
 
-def t(x, name=None):
-    if x.ndim < 2:
-        return apply_op(lambda v: v, x)
-    return apply_op(lambda v: jnp.swapaxes(v, -1, -2), x)
+def t(input, name=None):
+    if input.ndim < 2:
+        return apply_op(lambda v: v, input)
+    return apply_op(lambda v: jnp.swapaxes(v, -1, -2), input)
 
 
 def histogram(input, bins=100, min=0, max=0, name=None):

@@ -11,32 +11,41 @@ __all__ = [
 ]
 
 
-def _cmp(fn):
-    def op(x, y, name=None):
+def _cmp(fn, opname=None):
+    # `out` is accepted for signature parity but IGNORED, exactly like
+    # the reference's dygraph _logical_op: eager mode always returns a
+    # fresh bool tensor and leaves `out` untouched
+    def op(x, y, out=None, name=None):
         return apply_op(fn, x, y)
+    if opname:
+        op.__name__ = opname
     return op
 
 
-equal = _cmp(lambda a, b: a == b)
-not_equal = _cmp(lambda a, b: a != b)
-greater_than = _cmp(lambda a, b: a > b)
-greater_equal = _cmp(lambda a, b: a >= b)
-less_than = _cmp(lambda a, b: a < b)
-less_equal = _cmp(lambda a, b: a <= b)
-logical_and = _cmp(jnp.logical_and)
-logical_or = _cmp(jnp.logical_or)
-logical_xor = _cmp(jnp.logical_xor)
-bitwise_and = _cmp(jnp.bitwise_and)
-bitwise_or = _cmp(jnp.bitwise_or)
-bitwise_xor = _cmp(jnp.bitwise_xor)
+equal = _cmp(lambda a, b: a == b, "equal")
+not_equal = _cmp(lambda a, b: a != b, "not_equal")
+greater_than = _cmp(lambda a, b: a > b, "greater_than")
+greater_equal = _cmp(lambda a, b: a >= b, "greater_equal")
+less_than = _cmp(lambda a, b: a < b, "less_than")
+less_equal = _cmp(lambda a, b: a <= b, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
 
 
-def logical_not(x, name=None):
-    return apply_op(jnp.logical_not, x)
+def _unary_out(fn, opname):
+    # `out` accepted for parity, ignored in eager mode (see _cmp)
+    def op(x, out=None, name=None):
+        return apply_op(fn, x)
+    op.__name__ = opname
+    return op
 
 
-def bitwise_not(x, name=None):
-    return apply_op(jnp.bitwise_not, x)
+logical_not = _unary_out(jnp.logical_not, "logical_not")
+bitwise_not = _unary_out(jnp.bitwise_not, "bitwise_not")
 
 
 def equal_all(x, y, name=None):

@@ -106,8 +106,8 @@ def chunk(x, chunks, axis=0, name=None):
     return split(x, chunks, axis)
 
 
-def unbind(x, axis=0):
-    return unstack(x, axis)
+def unbind(input, axis=0):
+    return unstack(input, axis)
 
 
 def squeeze(x, axis=None, name=None):
@@ -191,8 +191,8 @@ def broadcast_to(x, shape, name=None):
     return expand(x, shape)
 
 
-def broadcast_tensors(inputs, name=None):
-    outs = apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs)
+def broadcast_tensors(input, name=None):
+    outs = apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *input)
     return list(outs)
 
 

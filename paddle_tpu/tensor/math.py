@@ -51,7 +51,7 @@ for _n, _f in {
     "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
     "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
     "rsqrt": jax.lax.rsqrt, "abs": jnp.abs, "ceil": jnp.ceil,
-    "floor": jnp.floor, "round": jnp.round, "trunc": jnp.trunc,
+    "floor": jnp.floor, "round": jnp.round,
     "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
     "acos": jnp.arccos, "atan": jnp.arctan, "sinh": jnp.sinh,
     "cosh": jnp.cosh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
@@ -63,7 +63,6 @@ for _n, _f in {
     "digamma": jax.scipy.special.digamma, "lgamma": jax.scipy.special.gammaln,
     "i0": jax.scipy.special.i0, "i1": jax.scipy.special.i1,
     "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
-    "logit": jax.scipy.special.logit,
     "nan_to_num": jnp.nan_to_num,
 }.items():
     _un(_n, _f)
@@ -82,6 +81,32 @@ for _n, _f in {
     "kron": jnp.kron,
 }.items():
     _bin(_n, _f)
+
+
+def logit(x, eps=None, name=None):
+    """logit(x) = log(x / (1-x)); with eps, x is clamped to
+    [eps, 1-eps] first (reference tensor/math.py logit)."""
+    def _f(v, _e=eps):
+        if _e is not None:
+            v = jnp.clip(v, _e, 1.0 - _e)
+        return jax.scipy.special.logit(v)
+    return apply_op(_f, x)
+
+
+def trunc(input, name=None):
+    return apply_op(jnp.trunc, input)
+
+
+def trunc_(input, name=None):
+    return input._inplace_update(jnp.trunc)
+
+
+def logit_(x, eps=None, name=None):
+    def _f(v, _e=eps):
+        if _e is not None:
+            v = jnp.clip(v, _e, 1.0 - _e)
+        return jax.scipy.special.logit(v)
+    return x._inplace_update(_f)
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -261,8 +286,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return apply_op(_f, x, y)
 
 
-def mm(x, y, name=None):
-    return matmul(x, y)
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
 
 
 def bmm(x, y, name=None):
@@ -441,4 +466,4 @@ def inverse(x, name=None):
     return apply_op(jnp.linalg.inv, x)
 
 
-__all__ += ["scale_", "lerp_", "inverse"]
+__all__ += ["scale_", "lerp_", "inverse", "logit", "logit_", "trunc", "trunc_"]

@@ -1,0 +1,65 @@
+"""Signature parity: every reference tensor/nn.functional parameter name
+must exist in our signature (name-only presence is covered by
+test_api_parity; this catches KEYWORD drift — `paddle.mm(input=, mat2=)`
+must not break for a switching user).
+
+`name` params are exempt (accepted everywhere already, asserted
+separately for a sample) and *args/**kwargs absorb anything.
+"""
+import ast
+import glob
+import inspect
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+_REF = "/root/reference/python/paddle"
+
+
+def _ref_signatures(pattern):
+    out = {}
+    for path in glob.glob(pattern):
+        try:
+            tree = ast.parse(open(path).read())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and \
+                    not node.name.startswith("_"):
+                a = node.args
+                out.setdefault(node.name, [
+                    p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)])
+    return out
+
+
+def _drift(ref_sigs, namespace):
+    drift = {}
+    for name, params in sorted(ref_sigs.items()):
+        fn = getattr(namespace, name, None)
+        if fn is None or not callable(fn):
+            continue
+        try:
+            ours = set(inspect.signature(fn).parameters)
+        except (ValueError, TypeError):
+            continue
+        if "kwargs" in ours or "args" in ours:
+            continue
+        missing = [p for p in params if p not in ours and p != "name"]
+        if missing:
+            drift[name] = missing
+    return drift
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_tensor_function_keywords_match_reference():
+    drift = _drift(_ref_signatures(f"{_REF}/tensor/*.py"), paddle)
+    assert not drift, drift
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_nn_functional_keywords_match_reference():
+    drift = _drift(_ref_signatures(f"{_REF}/nn/functional/*.py"), F)
+    assert not drift, drift
