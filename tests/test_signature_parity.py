@@ -63,3 +63,40 @@ def test_tensor_function_keywords_match_reference():
 def test_nn_functional_keywords_match_reference():
     drift = _drift(_ref_signatures(f"{_REF}/nn/functional/*.py"), F)
     assert not drift, drift
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_layer_constructor_keywords_match_reference():
+    import paddle_tpu.nn as nn
+    ref = {}
+    for path in glob.glob(f"{_REF}/nn/layer/*.py"):
+        try:
+            tree = ast.parse(open(path).read())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and \
+                    not node.name.startswith("_"):
+                for n in node.body:
+                    if isinstance(n, ast.FunctionDef) and \
+                            n.name == "__init__":
+                        a = n.args
+                        ref.setdefault(node.name, [
+                            p.arg for p in
+                            (a.posonlyargs + a.args + a.kwonlyargs)
+                            if p.arg != "self"])
+    drift = {}
+    for name, params in sorted(ref.items()):
+        cls = getattr(nn, name, None)
+        if cls is None or not isinstance(cls, type):
+            continue
+        try:
+            ours = set(inspect.signature(cls.__init__).parameters)
+        except (ValueError, TypeError):
+            continue
+        if "kwargs" in ours or "args" in ours:
+            continue
+        missing = [p for p in params if p not in ours and p != "name"]
+        if missing:
+            drift[name] = missing
+    assert not drift, drift
