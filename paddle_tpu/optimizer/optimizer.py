@@ -132,6 +132,11 @@ class Optimizer:
             master = slots.get("master")
             pv = master if master is not None else p._value.astype(jnp.float32)
             gv = g._value.astype(jnp.float32)
+            rs = getattr(self, "_rescale_grad", 1.0)
+            if rs != 1.0:
+                # reference kernels rescale the RAW gradient, then add
+                # the decay term — the decay coefficient must not scale
+                gv = gv * rs
             if self._wd and not self._decoupled_wd() and p.regularizer is None:
                 gv = gv + self._wd * pv
             rule_slots = self._slots_to_f32({k: v for k, v in slots.items() if k != "master"})
@@ -196,6 +201,9 @@ class Optimizer:
             master = slots.pop("master", None)
             pv = master if master is not None else p.astype(jnp.float32)
             gv = g.astype(jnp.float32)
+            rs = getattr(self, "_rescale_grad", 1.0)
+            if rs != 1.0:
+                gv = gv * rs
             if self._wd and not self._decoupled_wd():
                 gv = gv + self._wd * pv
             self._current_param_name = name
@@ -251,12 +259,18 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, rescale_grad=1.0,
+                 use_multi_tensor=False, name=None):
+        # use_multi_tensor is the reference's fused CUDA multi-tensor
+        # apply — accepted for parity, meaningless here: the whole train
+        # step already compiles to one XLA program
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._rescale_grad = float(rescale_grad)
 
     def _update_rule(self, p, g, slots, lr, step):
+        # rescale_grad is applied by the base class BEFORE weight decay
         v = self._momentum * slots["velocity"] + g
         if self._nesterov:
             new_p = p - lr * (g + self._momentum * v)

@@ -279,3 +279,44 @@ def test_apply_gradients_respects_grad_clip():
     opt.apply_gradients([(lin.weight, big)])
     delta = np.abs(lin.weight.numpy() - before).sum()
     assert 0 < delta < 1e-2, delta  # clipped to ~1e-3 global norm
+
+
+def test_momentum_rescale_grad():
+    """rescale_grad multiplies gradients before the update (reference
+    Momentum kwarg); use_multi_tensor is accepted (XLA fuses the whole
+    step anyway)."""
+    paddle.seed(0)
+    a, b = nn.Linear(2, 2), nn.Linear(2, 2)
+    b.set_state_dict(a.state_dict())
+    x = paddle.ones([1, 2])
+    oa = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                                   parameters=a.parameters(),
+                                   rescale_grad=0.5)
+    ob = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.0,
+                                   parameters=b.parameters(),
+                                   use_multi_tensor=True)
+    a(x).sum().backward()
+    oa.step()
+    b(x).sum().backward()
+    ob.step()
+    np.testing.assert_allclose(a.weight.numpy(), b.weight.numpy(), rtol=1e-6)
+
+
+def test_momentum_rescale_grad_does_not_scale_weight_decay():
+    """Reference kernels rescale the RAW gradient then add the L2 term;
+    scaling the folded sum would silently under-regularize."""
+    paddle.seed(0)
+    a, b = nn.Linear(2, 2), nn.Linear(2, 2)
+    b.set_state_dict(a.state_dict())
+    x = paddle.ones([1, 2])
+    wd = 0.5
+    oa = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                                   parameters=a.parameters(),
+                                   weight_decay=wd, rescale_grad=0.25)
+    a(x).sum().backward()
+    w0, g = b.weight.numpy().copy(), None
+    b(x).sum().backward()
+    g = b.weight.grad.numpy()
+    oa.step()
+    expected = w0 - 0.1 * (0.25 * g + wd * w0)
+    np.testing.assert_allclose(a.weight.numpy(), expected, rtol=1e-5)

@@ -65,11 +65,9 @@ def test_nn_functional_keywords_match_reference():
     assert not drift, drift
 
 
-@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
-def test_layer_constructor_keywords_match_reference():
-    import paddle_tpu.nn as nn
+def _ctor_sweep(globpat, namespace):
     ref = {}
-    for path in glob.glob(f"{_REF}/nn/layer/*.py"):
+    for path in glob.glob(globpat):
         try:
             tree = ast.parse(open(path).read())
         except SyntaxError:
@@ -87,7 +85,7 @@ def test_layer_constructor_keywords_match_reference():
                             if p.arg != "self"])
     drift = {}
     for name, params in sorted(ref.items()):
-        cls = getattr(nn, name, None)
+        cls = getattr(namespace, name, None)
         if cls is None or not isinstance(cls, type):
             continue
         try:
@@ -99,4 +97,19 @@ def test_layer_constructor_keywords_match_reference():
         missing = [p for p in params if p not in ours and p != "name"]
         if missing:
             drift[name] = missing
-    assert not drift, drift
+    return drift
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_layer_constructor_keywords_match_reference():
+    import paddle_tpu.nn as nn
+    assert not _ctor_sweep(f"{_REF}/nn/layer/*.py", nn)
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_optimizer_and_transform_constructors_match_reference():
+    import paddle_tpu.vision.transforms as T
+    assert not _ctor_sweep(f"{_REF}/optimizer/*.py", paddle.optimizer)
+    assert not _ctor_sweep(f"{_REF}/distribution/*.py", paddle.distribution)
+    assert not _ctor_sweep(f"{_REF}/vision/transforms/*.py", T)
+    assert not _ctor_sweep(f"{_REF}/metric/*.py", paddle.metric)
