@@ -113,3 +113,13 @@ def test_optimizer_and_transform_constructors_match_reference():
     assert not _ctor_sweep(f"{_REF}/distribution/*.py", paddle.distribution)
     assert not _ctor_sweep(f"{_REF}/vision/transforms/*.py", T)
     assert not _ctor_sweep(f"{_REF}/metric/*.py", paddle.metric)
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_fft_signal_linalg_vision_ops_keywords_match_reference():
+    import paddle_tpu.vision.ops as vops
+    assert not _drift(_ref_signatures(f"{_REF}/fft.py"), paddle.fft)
+    assert not _drift(_ref_signatures(f"{_REF}/signal.py"), paddle.signal)
+    assert not _drift(_ref_signatures(f"{_REF}/vision/ops.py"), vops)
+    assert not _drift(_ref_signatures(f"{_REF}/tensor/linalg.py"),
+                      paddle.linalg)
