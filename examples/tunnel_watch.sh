@@ -26,11 +26,13 @@ while true; do
     echo "$(date -u +%FT%TZ) tunnel UP — launching perf campaign" >> tunnel_watch.log
     have resnet || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1
     have bert   || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1
+    have yolo   || timeout 2400 python examples/perf_campaign.py yolo   >> tunnel_watch.log 2>&1
+    have moe    || timeout 2400 python examples/perf_campaign.py moe    >> tunnel_watch.log 2>&1
     grep -q '"config": "resnet50_hlo_audit"' perf_campaign_results.jsonl 2>/dev/null \
                 || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1
     have gpt    || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1
     have decode || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1
-    if have resnet && have bert && have gpt && have decode; then
+    if have resnet && have bert && have yolo && have moe && have gpt && have decode; then
       timeout 3600 python bench.py >> tunnel_watch.log 2>&1
       echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
       break
