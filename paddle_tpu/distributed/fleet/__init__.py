@@ -28,6 +28,7 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
+from . import metrics  # noqa: F401  (distributed metric aggregation)
 from . import utils  # noqa: F401  (LocalFS/HDFSClient/recompute)
 from .utils import DistributedInfer, HDFSClient, LocalFS, recompute  # noqa: F401
 

@@ -1,0 +1,5 @@
+"""Reference python/paddle/distributed/models/ — model-specific
+distributed helpers (currently MoE routing utilities)."""
+from . import moe  # noqa: F401
+
+__all__ = ["moe"]

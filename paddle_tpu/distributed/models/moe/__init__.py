@@ -1,0 +1,6 @@
+"""Reference python/paddle/distributed/models/moe/__init__.py — the
+routing-utility namespace a migrating Paddle user imports from. The MoE
+model family itself lives in paddle_tpu.models.moe / incubate.moe."""
+from . import utils  # noqa: F401
+
+__all__ = ["utils"]

@@ -1,0 +1,101 @@
+"""MoE routing utilities — reference
+python/paddle/distributed/models/moe/utils.py:22-230.
+
+The reference binds five CUDA ops (number_count, assign_pos,
+random_routing, limit_by_capacity, prune_gate_by_capacity); here each
+is a vectorized jnp computation — bincount / stable-argsort / cumsum /
+one-hot-cumsum shapes that XLA lowers to a handful of fused kernels, no
+scalar loops — so they jit cleanly on TPU. Semantics (including the
+within-expert token ordering of assign_pos and the worker-greedy
+capacity split of limit_by_capacity) match the reference docstring
+examples bit-for-bit; each is pinned by tests/test_moe_routing_utils.py.
+"""
+import jax.numpy as jnp
+
+from ....framework.core import apply_op
+
+__all__ = []
+
+
+def _number_count(numbers, upper_range):
+    """Per-expert token count from gate indices (reference utils.py:22):
+    _number_count([[0,2],[0,2]], 6) == [2,0,2,0,0,0]. Entries outside
+    [0, upper_range) (e.g. -1 pruned tokens) are not counted."""
+    def f(n):
+        flat = n.reshape(-1)
+        valid = (flat >= 0) & (flat < upper_range)
+        counts = jnp.bincount(jnp.where(valid, flat, 0),
+                              weights=valid.astype(jnp.float32),
+                              length=upper_range)
+        return counts.astype(n.dtype)
+    return apply_op(f, numbers)
+
+
+def _assign_pos(x, cum_count):
+    """Token order for expert-contiguous dispatch (reference utils.py:62):
+    out[slot] is the token index occupying that slot when tokens are
+    grouped by expert. The reference CUDA kernel fills each expert's
+    slots back-to-front while scanning tokens forward, so later tokens
+    take earlier slots within an expert — reproduced here with a single
+    stable argsort on (expert, -token) keys:
+    _assign_pos([[0,2],[0,2]], cumsum([2,0,2,0])) == [2,0,3,1]."""
+    import numpy as np
+    cc_host = cum_count._value if hasattr(cum_count, "_value") else cum_count
+    total = int(np.asarray(cc_host).reshape(-1)[-1])
+
+    def f(xv, cc):
+        flat = xv.reshape(-1).astype(jnp.int32)
+        n = flat.shape[0]
+        tok = jnp.arange(n, dtype=jnp.int32)
+        # int32-safe keys (x64 is disabled on TPU): requires
+        # n_tokens * (n_experts+1) < 2^31, true for any per-step dispatch.
+        # Invalid (negative) gates get the largest representable expert id
+        # so they sort past every real one.
+        big = (jnp.iinfo(jnp.int32).max - n) // n
+        expert = jnp.where(flat >= 0, flat, big)
+        order = jnp.argsort(expert * n + (n - 1 - tok))
+        return order[:total].astype(cc.dtype)
+    return apply_op(f, x, cum_count)
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Stochastically drop the 2nd expert (reference utils.py:113):
+    out[i][topk-1] = -1 where topk * value[i][topk-1] < prob[i]."""
+    if topk != 2:
+        raise RuntimeError("only topk=2 is supported now")
+
+    def f(idx, val, p):
+        drop = topk * val[:, topk - 1] < p
+        col = jnp.where(drop, jnp.asarray(-1, idx.dtype), idx[:, topk - 1])
+        return idx.at[:, topk - 1].set(col)
+    return apply_op(f, topk_idx, topk_value, prob)
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clamp per-(worker, expert) counts so each expert's total across
+    workers fits its capacity, granted to workers in rank order
+    (reference utils.py:138): _limit_by_capacity([1,2,2,8,3,6], [5,5,5],
+    2) == [1,2,2,4,3,3]."""
+    def f(ec, cap):
+        n_expert = ec.size // n_worker
+        grid = ec.reshape(n_worker, n_expert).astype(jnp.int64)
+        cum = jnp.cumsum(grid, axis=0)
+        capped = jnp.minimum(cum, cap.astype(jnp.int64)[None, :])
+        prev = jnp.concatenate(
+            [jnp.zeros((1, n_expert), jnp.int64), capped[:-1]], axis=0)
+        return (capped - prev).reshape(-1).astype(ec.dtype)
+    return apply_op(f, expert_count, capacity)
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Replace over-capacity gate assignments with -1, keeping each
+    expert's first expert_count[e] tokens in order (reference
+    utils.py:181): _prune_gate_by_capacity([1,3,3,3,3,2,1,1],
+    [0,3,1,3,0,0,0,0], 4, 2) == [1,3,3,3,-1,2,1,1]."""
+    def f(g, ec):
+        total_experts = n_expert * n_worker
+        oh = (g[:, None] == jnp.arange(total_experts)[None, :])
+        occ = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1  # 0-based occurrence
+        keep = occ < ec[jnp.clip(g, 0, total_experts - 1)]
+        return jnp.where(keep & (g >= 0), g, -1).astype(g.dtype)
+    return apply_op(f, gate_idx, expert_count)

@@ -1,0 +1,14 @@
+"""Reference python/paddle/incubate/multiprocessing/__init__.py: a
+drop-in for the stdlib multiprocessing module with Tensor reducers
+installed — `import paddle_tpu.incubate.multiprocessing as mp` then use
+mp.Process / mp.Queue and put Tensors on them directly."""
+import multiprocessing
+
+from multiprocessing import *  # noqa: F401,F403
+
+from .reductions import init_reductions
+
+__all__ = []
+__all__ += multiprocessing.__all__
+
+init_reductions()

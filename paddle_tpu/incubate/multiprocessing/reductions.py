@@ -1,0 +1,151 @@
+"""Tensor IPC over multiprocessing — reference
+python/paddle/incubate/multiprocessing/reductions.py:33-196.
+
+The reference registers ForkingPickler reducers so Tensors travel
+through multiprocessing Queues/Pipes via shared-memory files (CPU) or
+CUDA IPC handles (GPU). The TPU-native equivalent: host-side transport
+through multiprocessing.shared_memory — the same segment-passing
+protocol the io worker pool uses — with the receiving process copying
+out and taking ownership of the segment.
+
+One deliberate semantic difference, documented rather than hidden: jax
+arrays are immutable and device memory has no cross-process IPC handle
+on PJRT, so a received Tensor is a VALUE COPY of the sender's data, not
+a view onto shared mutable storage. Code that relied on the reference's
+shared-storage mutation (rare; the docs steer users to Queues) must
+send updated tensors explicitly.
+"""
+import atexit
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+__all__ = []
+
+# segments created by this process that were never (yet) consumed, with
+# creation time: a dead receiver must not leak /dev/shm forever, but a
+# normally-exiting sender must not destroy payloads a live receiver
+# hasn't rebuilt yet — at exit only segments past the grace window
+# (long-undelivered, ergo orphaned) are reclaimed. Receivers normally
+# rebuild within milliseconds of Queue.put, so the window only matters
+# for fire-and-forget sends to slow consumers.
+_SEGMENT_GRACE_S = 120.0
+_created_segments = {}
+
+
+@atexit.register
+def _cleanup_segments():
+    import time
+    now = time.monotonic()
+    for name, born in list(_created_segments.items()):
+        if now - born < _SEGMENT_GRACE_S:
+            continue
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+
+
+class LRUSharedCache(OrderedDict):
+    """Rebuilt-tensor cache keyed by segment name (reference
+    reductions.py:49): a pickle delivered twice within a process
+    rebuilds the same Tensor instead of re-attaching a segment the
+    first rebuild already unlinked."""
+
+    def __init__(self, limit=128):
+        self.limit = limit
+        super().__init__()
+
+    def get(self, key):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return None
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.limit:
+            self.popitem(last=False)
+
+
+shared_cache = LRUSharedCache()
+
+
+def _supported_check():
+    import sys
+    if sys.platform == "win32":
+        import warnings
+        warnings.warn("paddle_tpu.incubate.multiprocessing needs POSIX "
+                      "shared memory; falling back to default pickling")
+        return False
+    return True
+
+
+def _np_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends register through ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def rebuild_tensor(cls, shm_name, shape, dtype, stop_gradient):
+    cached = shared_cache.get(shm_name)
+    if cached is not None:
+        return cached
+    seg = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.array(np.ndarray(shape, _np_dtype(dtype), buffer=seg.buf))
+    finally:
+        seg.close()
+        try:
+            seg.unlink()  # receiver takes ownership (io _decode_tree protocol)
+        except FileNotFoundError:
+            pass
+    _created_segments.pop(shm_name, None)
+    t = cls(arr)
+    t.stop_gradient = stop_gradient
+    shared_cache[shm_name] = t
+    return t
+
+
+def rebuild_empty(cls, shape, dtype, stop_gradient):
+    t = cls(np.zeros(shape, _np_dtype(dtype)))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def reduce_tensor(tensor):
+    arr = np.asarray(tensor.numpy())
+    if arr.size == 0:
+        return (rebuild_empty, (type(tensor), arr.shape, str(arr.dtype),
+                                tensor.stop_gradient))
+    seg = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    np.ndarray(arr.shape, arr.dtype, buffer=seg.buf)[...] = arr
+    name = seg.name
+    seg.close()
+    import time
+    _created_segments[name] = time.monotonic()
+    try:
+        # ownership transfers to the receiver, which unlinks after the
+        # copy-out; drop this process's tracker registration so neither
+        # side double-cleans or warns (same dance as io._encode_tree)
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+    return (rebuild_tensor, (type(tensor), name, arr.shape, str(arr.dtype),
+                             tensor.stop_gradient))
+
+
+def init_reductions():
+    if not _supported_check():
+        return
+    from ...framework.core import Parameter, Tensor
+    ForkingPickler.register(Tensor, reduce_tensor)
+    ForkingPickler.register(Parameter, reduce_tensor)

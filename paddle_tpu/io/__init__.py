@@ -536,12 +536,15 @@ class _GeneratorLoader:
     through as tensors; sample generators are batched with the given
     batch_size."""
 
-    def __init__(self, return_list=True, drop_last=True):
+    def __init__(self, return_list=False, drop_last=True):
         if not return_list:
-            raise NotImplementedError(
-                "return_list=False (dict batches keyed by feed names) is a "
-                "static-graph fluid behavior; this loader yields tensor "
-                "lists/tuples")
+            # reference DygraphGeneratorLoader (fluid/reader.py:967-971)
+            # warns and coerces to list mode — dict-of-feed-name batches
+            # are a static-graph-only behavior
+            import warnings
+            warnings.warn(
+                "Please NOTE: DygraphGeneratorLoader supports returning "
+                "as list only. Change to return as list.")
         self._gen = None
         self._mode = "batch"
         self._batch_size = 1
@@ -764,11 +767,13 @@ class DataLoader:
 
     @staticmethod
     def from_generator(feed_list=None, capacity=None, use_double_buffer=True,
-                       iterable=True, return_list=True,
+                       iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
-        """Deprecated fluid feeder (reference fluid/reader.py
-        from_generator): returns a loader whose set_*_generator methods
-        install a python generator; new code should construct
+        """Deprecated fluid feeder (reference fluid/reader.py:570
+        from_generator, default return_list=False): returns a loader
+        whose set_*_generator methods install a python generator. Like
+        the reference dygraph loader, return_list=False warns and
+        coerces to list mode; new code should construct
         DataLoader(dataset) directly."""
         return _GeneratorLoader(return_list=return_list,
                                 drop_last=drop_last)

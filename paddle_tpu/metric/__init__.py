@@ -133,11 +133,9 @@ class Auc(Metric):
             p = p[:, 1]
         l = _np(labels).reshape(-1)
         bins = np.minimum((p * self.num_thresholds).astype(np.int64), self.num_thresholds - 1)
-        for b, y in zip(bins, l):
-            if y:
-                self._stat_pos[b] += 1
-            else:
-                self._stat_neg[b] += 1
+        pos = l.astype(bool)
+        self._stat_pos += np.bincount(bins[pos], minlength=self.num_thresholds)
+        self._stat_neg += np.bincount(bins[~pos], minlength=self.num_thresholds)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds, np.int64)
@@ -148,9 +146,11 @@ class Auc(Metric):
         tot_neg = self._stat_neg.sum()
         if not tot_pos or not tot_neg:
             return 0.0
-        # trapezoid over descending threshold
-        tp = np.cumsum(self._stat_pos[::-1])
-        fp = np.cumsum(self._stat_neg[::-1])
+        # trapezoid over descending threshold, from the (0,0) origin —
+        # reference metrics.py Auc.accumulate starts tot_pos/tot_neg at 0
+        # so the first bucket contributes a triangle too
+        tp = np.concatenate([[0], np.cumsum(self._stat_pos[::-1])])
+        fp = np.concatenate([[0], np.cumsum(self._stat_neg[::-1])])
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr))

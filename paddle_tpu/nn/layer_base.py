@@ -171,12 +171,8 @@ class Layer:
         """state_dict that also includes NON-persistable buffers
         (reference layers.py to_static_state_dict: the static-graph
         export needs every buffer)."""
-        dest = self._collect_state(include_sublayers, use_hook,
-                                   persistable_only=False, seen=set())
-        if destination is not None:
-            destination.update(dest)
-            return destination
-        return dest
+        return self._collect_state(destination, include_sublayers, use_hook,
+                                   persistable_only=False, prefix="")
 
     # -- parameter management ----------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
@@ -310,48 +306,51 @@ class Layer:
         that (a) each layer's own _non_persistable_buffer_names filters
         its own buffers — a sublayer's scratch buffer can't leak through
         an ancestor, nor can a same-named persistable one be dropped —
-        and (b) every layer's state_dict hooks run on its own sub-dict
-        before prefixing, wherever in the tree state_dict() is called.
-        Shared/tied objects serialize once under their first name, the
-        same dedup named_parameters applies."""
-        dest = self._collect_state(include_sublayers, use_hook,
-                                   persistable_only=True, seen=set())
-        if destination is not None:
-            destination.update(dest)
-            return destination
-        return dest
+        and (b) every layer's state_dict hooks run on the ACCUMULATED
+        destination with fully prefixed names, as the reference
+        _state_dict_impl does (fluid/dygraph/layers.py:1322-1362), so
+        hooks ported from reference code see the same dict shape.
+        Shared/tied objects are emitted under EVERY structured name —
+        the reference does not dedup here (dedup applies only to
+        named_parameters/optimizer state), so weight-tied checkpoints
+        round-trip with reference paddle."""
+        return self._collect_state(destination, include_sublayers, use_hook,
+                                   persistable_only=True, prefix="")
 
-    def _collect_state(self, include_sublayers, use_hook, persistable_only,
-                       seen):
-        dest = collections.OrderedDict()
+    def _collect_state(self, destination, include_sublayers, use_hook,
+                       persistable_only, prefix):
+        if destination is None:
+            destination = collections.OrderedDict()
         for name, p in self._parameters.items():
-            if p is not None and id(p) not in seen:
-                seen.add(id(p))
-                dest[name] = p
+            if p is not None:
+                destination[prefix + name] = p
         for name, b in self._buffers.items():
-            if b is None or id(b) in seen:
+            if b is None:
                 continue
             if persistable_only and name in self._non_persistable_buffer_names:
                 continue
-            seen.add(id(b))
-            dest[name] = b
+            destination[prefix + name] = b
         if include_sublayers:
             for sname, sub in self._sub_layers.items():
                 if sub is None:
                     continue
-                sd = sub._collect_state(True, use_hook, persistable_only,
-                                        seen)
-                for k, v in sd.items():
-                    dest[f"{sname}.{k}"] = v
-        return self._apply_state_dict_hooks(dest, use_hook)
-
-    def _apply_state_dict_hooks(self, dest, use_hook):
+                # reference protocol (layers.py:1349-1356): the child gets a
+                # COPY of the accumulated dict and its hooks' return value is
+                # MERGED back — so a descendant's filtering hook can see the
+                # whole prefixed dict but cannot drop siblings' or ancestors'
+                # entries; only hooks of the layer state_dict() was called on
+                # (applied last, below, by replacement) can filter.
+                destination_temp = destination.copy()
+                destination_temp.update(sub._collect_state(
+                    destination_temp, True, use_hook, persistable_only,
+                    f"{prefix}{sname}."))
+                destination = destination_temp
         if use_hook:
             for hook in self._state_dict_hooks.values():
-                out = hook(dest)
+                out = hook(destination)
                 if out is not None:
-                    dest = out
-        return dest
+                    destination = out
+        return destination
 
     def set_state_dict(self, state_dict, use_structured_name=True):
         # hooks filter what gets SAVED; loading must see the raw surface

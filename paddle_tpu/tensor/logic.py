@@ -11,14 +11,22 @@ __all__ = [
 ]
 
 
-def _cmp(fn, opname=None):
+def _cmp(fn, opname):
+    # reference comparison signature is (x, y, name=None) — no `out`;
+    # only the logical_*/bitwise_* families take one (see _logical)
+    def op(x, y, name=None):
+        return apply_op(fn, x, y)
+    op.__name__ = opname
+    return op
+
+
+def _logical(fn, opname):
     # `out` is accepted for signature parity but IGNORED, exactly like
     # the reference's dygraph _logical_op: eager mode always returns a
-    # fresh bool tensor and leaves `out` untouched
+    # fresh tensor and leaves `out` untouched
     def op(x, y, out=None, name=None):
         return apply_op(fn, x, y)
-    if opname:
-        op.__name__ = opname
+    op.__name__ = opname
     return op
 
 
@@ -28,12 +36,12 @@ greater_than = _cmp(lambda a, b: a > b, "greater_than")
 greater_equal = _cmp(lambda a, b: a >= b, "greater_equal")
 less_than = _cmp(lambda a, b: a < b, "less_than")
 less_equal = _cmp(lambda a, b: a <= b, "less_equal")
-logical_and = _cmp(jnp.logical_and, "logical_and")
-logical_or = _cmp(jnp.logical_or, "logical_or")
-logical_xor = _cmp(jnp.logical_xor, "logical_xor")
-bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
-bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
-bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+logical_and = _logical(jnp.logical_and, "logical_and")
+logical_or = _logical(jnp.logical_or, "logical_or")
+logical_xor = _logical(jnp.logical_xor, "logical_xor")
+bitwise_and = _logical(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _logical(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _logical(jnp.bitwise_xor, "bitwise_xor")
 
 
 def _unary_out(fn, opname):

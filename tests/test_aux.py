@@ -61,6 +61,45 @@ def test_metrics():
     assert abs(m.accumulate() - 0.5) < 1e-6
 
 
+def test_auc_vectorized_update():
+    """Auc.update is a vectorized bincount: stat arrays identical to the
+    per-sample definition, and fast enough for 1M samples per call (the
+    timing guard keeps it from regressing to a Python loop)."""
+    import time
+
+    rng = np.random.RandomState(0)
+    m = paddle.metric.Auc(num_thresholds=4095)
+    p = rng.rand(10_000)
+    l = rng.randint(0, 2, 10_000)
+    m.update(p, l)
+    # oracle: the per-sample scatter the vectorized path must match
+    pos = np.zeros(4095, np.int64)
+    neg = np.zeros(4095, np.int64)
+    bins = np.minimum((p * 4095).astype(np.int64), 4094)
+    for b, y in zip(bins, l):
+        (pos if y else neg)[b] += 1
+    np.testing.assert_array_equal(m._stat_pos, pos)
+    np.testing.assert_array_equal(m._stat_neg, neg)
+    # separable scores -> AUC near 1; symmetric -> near 0.5
+    assert 0.45 < m.accumulate() < 0.55
+    m2 = paddle.metric.Auc()
+    good = np.concatenate([rng.rand(500) * 0.4, 0.6 + rng.rand(500) * 0.4])
+    m2.update(good, np.repeat([0, 1], 500))
+    assert m2.accumulate() > 0.99
+    # 2D [N, 2] preds use the positive-class column
+    m3 = paddle.metric.Auc()
+    m3.update(np.stack([1 - good, good], 1), np.repeat([0, 1], 500))
+    assert abs(m3.accumulate() - m2.accumulate()) < 1e-12
+
+    big_p = rng.rand(1_000_000)
+    big_l = rng.randint(0, 2, 1_000_000)
+    t0 = time.perf_counter()
+    m.update(big_p, big_l)
+    # generous bound: bincount takes ~5ms; the old per-sample loop took
+    # seconds even unloaded, so 5s stays unflaky on contended CI
+    assert time.perf_counter() - t0 < 5.0
+
+
 def test_amp_autocast_and_scaler():
     from paddle_tpu.amp import GradScaler, auto_cast
     with auto_cast(True, level="O1"):

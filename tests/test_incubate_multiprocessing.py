@@ -1,0 +1,60 @@
+"""incubate.multiprocessing — Tensor IPC via ForkingPickler reducers
+over shared memory (reference incubate/multiprocessing/reductions.py).
+Received tensors are value copies (jax arrays are immutable; no device
+IPC on PJRT) — that divergence is documented in the module."""
+import pickle
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.multiprocessing as pmp
+from multiprocessing.reduction import ForkingPickler
+
+
+def test_forking_pickler_roundtrip_through_shm():
+    t = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    t.stop_gradient = False
+    buf = ForkingPickler.dumps(t)
+    out = pickle.loads(buf)
+    assert isinstance(out, type(t))
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+    assert out.stop_gradient is False
+    # duplicate delivery of the same pickle hits the LRU cache (the
+    # first rebuild consumed the segment)
+    again = pickle.loads(buf)
+    assert again is out
+
+    # bf16 payloads survive (ml_dtypes round-trip)
+    b = paddle.to_tensor(np.ones((2, 2), "float32")).astype("bfloat16")
+    np.testing.assert_array_equal(
+        pickle.loads(ForkingPickler.dumps(b)).astype("float32").numpy(),
+        np.ones((2, 2), "float32"))
+
+    # empty tensors skip shm entirely
+    e = paddle.to_tensor(np.zeros((0, 5), "int32"))
+    out_e = pickle.loads(ForkingPickler.dumps(e))
+    assert tuple(out_e.shape) == (0, 5)
+
+    p = paddle.framework.Parameter(np.ones((2,), "float32"))
+    out_p = pickle.loads(ForkingPickler.dumps(p))
+    np.testing.assert_array_equal(out_p.numpy(), [1, 1])
+
+
+def _child_echo(q_in, q_out):
+    t = q_in.get(timeout=30)
+    q_out.put(paddle.to_tensor(t.numpy() * 2.0))
+
+
+def test_tensor_over_process_queue():
+    ctx = pmp.get_context("spawn")
+    q_in, q_out = ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=_child_echo, args=(q_in, q_out))
+    proc.start()
+    try:
+        q_in.put(paddle.to_tensor(np.full((4,), 3.0, "float32")))
+        out = q_out.get(timeout=120)
+        np.testing.assert_array_equal(out.numpy(), np.full((4,), 6.0))
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()

@@ -39,5 +39,10 @@ def test_from_generator_batch_and_sample_modes():
 
     with pytest.raises(NotImplementedError, match="ShardedEmbedding"):
         paddle.io.DataLoader.from_dataset(None)
-    with pytest.raises(NotImplementedError, match="return_list"):
-        paddle.io.DataLoader.from_generator(return_list=False)
+    # reference default is return_list=False (fluid/reader.py:570); the
+    # dygraph loader warns and coerces to list mode rather than raising
+    with pytest.warns(UserWarning, match="return as list"):
+        loader5 = paddle.io.DataLoader.from_generator(return_list=False)
+    loader5.set_batch_generator(
+        lambda: iter([np.ones((1, 2), "float32")]))
+    assert len(list(loader5)) == 1

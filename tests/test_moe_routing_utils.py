@@ -1,0 +1,74 @@
+"""distributed.models.moe.utils — the reference's five CUDA routing ops
+re-done as vectorized jnp (reference distributed/models/moe/utils.py).
+Every expected value below is the reference docstring's own example."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.models.moe import utils
+
+
+def test_number_count():
+    numbers = paddle.to_tensor([[0, 2], [0, 2]], dtype="int32")
+    out = utils._number_count(numbers, 6)
+    np.testing.assert_array_equal(out.numpy(), [2, 0, 2, 0, 0, 0])
+    # pruned (-1) tokens don't count
+    pruned = paddle.to_tensor([0, -1, 1, -1], dtype="int64")
+    np.testing.assert_array_equal(
+        utils._number_count(pruned, 3).numpy(), [1, 1, 0])
+
+
+def test_assign_pos():
+    numbers = paddle.to_tensor([[0, 2], [0, 2]], dtype="int32")
+    count = utils._number_count(numbers, 4)
+    cum = paddle.cumsum(count)
+    pos = utils._assign_pos(numbers, cum)
+    np.testing.assert_array_equal(pos.numpy(), [2, 0, 3, 1])
+    # slots are expert-contiguous: gathering gates by pos sorts them
+    gates = numbers.numpy().reshape(-1)[pos.numpy()]
+    assert (np.diff(gates) >= 0).all()
+
+    # pruned (-1) gates sort past every real expert and are cut by
+    # eff_num_len — the composed prune -> count -> assign pipeline
+    pruned = paddle.to_tensor([2, -1, 0, 2, -1, 0], dtype="int32")
+    cnt = utils._number_count(pruned, 3)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 0, 2])
+    pos2 = utils._assign_pos(pruned, paddle.cumsum(cnt))
+    # expert 0 tokens (idx 2,5; later first) then expert 2 (idx 0,3)
+    np.testing.assert_array_equal(pos2.numpy(), [5, 2, 3, 0])
+
+
+def test_random_routing():
+    idx = paddle.to_tensor([[0, 1], [2, 3], [4, 5]], dtype="int64")
+    val = paddle.to_tensor([[0.9, 0.4], [0.9, 0.1], [0.9, 0.6]])
+    prob = paddle.to_tensor([0.5, 0.5, 0.5])
+    out = utils._random_routing(idx, val, prob)
+    # 2*0.4 >= .5 keep; 2*0.1 < .5 drop; 2*0.6 >= .5 keep
+    np.testing.assert_array_equal(out.numpy(), [[0, 1], [2, -1], [4, 5]])
+    try:
+        utils._random_routing(idx, val, prob, topk=3)
+        raise AssertionError("topk=3 should raise")
+    except RuntimeError:
+        pass
+
+
+def test_limit_by_capacity():
+    ec = paddle.to_tensor([1, 2, 2, 8, 3, 6], dtype="int32")
+    cap = paddle.to_tensor([5, 5, 5], dtype="int32")
+    out = utils._limit_by_capacity(ec, cap, 2)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 2, 4, 3, 3])
+
+
+def test_prune_gate_by_capacity():
+    gate = paddle.to_tensor([1, 3, 3, 3, 3, 2, 1, 1], dtype="int32")
+    ec = paddle.to_tensor([0, 3, 1, 3, 0, 0, 0, 0], dtype="int32")
+    out = utils._prune_gate_by_capacity(gate, ec, 8, 1)
+    np.testing.assert_array_equal(out.numpy(), [1, 3, 3, 3, -1, 2, 1, 1])
+
+
+def test_namespace_importable_like_reference():
+    import paddle_tpu.distributed.models.moe.utils as u
+    from paddle_tpu.distributed import models
+    assert models.moe.utils is u
+    for name in ("_number_count", "_assign_pos", "_random_routing",
+                 "_limit_by_capacity", "_prune_gate_by_capacity"):
+        assert callable(getattr(u, name))

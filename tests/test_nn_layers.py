@@ -253,8 +253,13 @@ def test_layer_method_gaps_closed():
     assert "lin.scratch" in net.to_static_state_dict()
     net.lin.register_state_dict_hook(
         lambda d: {k: v for k, v in d.items() if "bias" not in k})
-    assert "lin.bias" not in net.state_dict()
+    # reference merge protocol: a DESCENDANT's filtering hook sees the
+    # accumulated prefixed dict but its return is merged (not replaced)
+    # into the parent's, so it cannot drop entries from the parent's
+    # state_dict — only the called layer's own hooks filter
+    assert "lin.bias" in net.state_dict()
     assert "lin.weight" in net.state_dict()
+    assert "bias" not in net.lin.state_dict()      # own hook does filter
 
 
 
@@ -268,9 +273,11 @@ def test_state_dict_hook_does_not_block_loading():
     np.testing.assert_allclose(lin.bias.numpy(), 7.0)
 
 
-def test_tied_parameters_serialize_once():
-    """Shared/tied params keep the named_parameters dedup in state_dict
-    (one entry under the first name), and the dict round-trips."""
+def test_tied_parameters_serialize_under_every_name():
+    """Shared/tied params appear under EVERY structured name in
+    state_dict, matching reference _state_dict_impl (no dedup on save)
+    so weight-tied checkpoints round-trip with reference paddle.
+    named_parameters keeps the dedup (one entry, first name)."""
     class Tied(paddle.nn.Layer):
         def __init__(self):
             super().__init__()
@@ -283,8 +290,16 @@ def test_tied_parameters_serialize_once():
 
     net = Tied()
     sd = net.state_dict()
-    assert "a.weight" in sd and "b.weight" not in sd
+    assert "a.weight" in sd and "b.weight" in sd
+    assert sd["a.weight"] is sd["b.weight"]
+    names = [n for n, _ in net.named_parameters()]
+    assert "a.weight" in names and "b.weight" not in names
     net.set_state_dict(sd)
+    # a reference checkpoint carries both keys; loading must accept both
+    # with no missing/unexpected
+    ref_ckpt = {k: v.numpy() for k, v in sd.items()}
+    missing, unexpected = net.set_state_dict(ref_ckpt)
+    assert not missing and not unexpected
 
 
 def test_plain_empty_tensor_set_value_still_validates():
