@@ -198,6 +198,25 @@ def _check_same_static(name, a, b):
             f"a traced condition{hint}")
 
 
+def _public_name(n):
+    """Transformer-synthesized names, translated for diagnostics — the
+    user never wrote `_retv_0`."""
+    if isinstance(n, str):
+        if n.startswith("_retv_"):
+            return "return value"
+        if n.startswith("_retf_"):
+            return "return flag"
+        if n.startswith("_brk_"):
+            return "loop break flag"
+        if n.startswith("_cont_"):
+            return "loop continue flag"
+    return n
+
+
+def _public_names(names):
+    return [_public_name(n) for n in names]
+
+
 def _dyn_names(names, mask, dyn_vals=None):
     """Names of the dynamic operands, expanded per pytree LEAF when
     `dyn_vals` is given: error paths (_check_branch_match,
@@ -206,6 +225,7 @@ def _dyn_names(names, mask, dyn_vals=None):
     blame the wrong variable."""
     out, it = [], iter(dyn_vals if dyn_vals is not None else ())
     for n, m in zip(names, mask):
+        n = _public_name(n)
         if not m:
             continue
         if dyn_vals is None:
@@ -217,12 +237,51 @@ def _dyn_names(names, mask, dyn_vals=None):
             out.append(n)
         else:
             out.extend(f"{n} (leaf {j})" for j in range(k))
-    return out or list(names)
+    return out or _public_names(names)
 
 
 # --------------------------------------------------------------------------
 # if / else
 # --------------------------------------------------------------------------
+
+def _placeholder_like(aval_tree):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), aval_tree)
+
+
+def _fix_ret_placeholders(true_fn, false_fn, t_out, f_out, stash, names):
+    """Synthetic `_retv_*` early-return carriers (transformer-generated,
+    read only under their matching `_retf_*` flag) are legitimately
+    None/UNDEF on the branch that doesn't return: substitute an
+    unobservable zeros placeholder shaped like the returning branch's
+    value so lax.cond sees matching pytrees.  Returns wrapped
+    (true_fn, false_fn) or None when the mismatch involves any real
+    user variable (caller raises its usual diagnostic)."""
+    t_full = _merge(list(t_out), *stash["t"])
+    f_full = _merge(list(f_out), *stash["f"])
+    avals = {}
+    for pos, nm in enumerate(names):
+        if stash["t"][1][pos] == stash["f"][1][pos]:
+            continue
+        if not nm.startswith("_retv_"):
+            return None
+        static_v = f_full[pos] if stash["t"][1][pos] else t_full[pos]
+        if static_v is not None and static_v is not UNDEF:
+            return None
+        avals[pos] = t_full[pos] if stash["t"][1][pos] else f_full[pos]
+    if not avals:
+        return None
+
+    def fix(fn):
+        def wrapped(*ops):
+            outs = list(fn(*ops))
+            for pos, aval in avals.items():
+                if outs[pos] is None or outs[pos] is UNDEF:
+                    outs[pos] = _placeholder_like(aval)
+            return tuple(outs)
+        return wrapped
+    return fix(true_fn), fix(false_fn)
+
 
 def convert_ifelse(pred, true_fn, false_fn, operands, names=()):
     """`if`-statement converter.  `operands` holds the current values of
@@ -233,32 +292,40 @@ def convert_ifelse(pred, true_fn, false_fn, operands, names=()):
         return (true_fn if p else false_fn)(*operands)
 
     dyn, stat, mask = _split(operands)
-    stash = {}
+    for attempt in (0, 1):
+        stash = {}
 
-    def run(fn, tag):
-        def inner(dyn_in):
-            outs = fn(*_merge(list(dyn_in), stat, mask))
-            nd, ns, nm = _split(outs)
-            stash[tag] = (ns, nm)
-            return tuple(nd)
-        return inner
+        def run(fn, tag):
+            def inner(dyn_in):
+                outs = fn(*_merge(list(dyn_in), stat, mask))
+                nd, ns, nm = _split(outs)
+                stash[tag] = (ns, nm)
+                return tuple(nd)
+            return inner
 
-    # pre-check with eval_shape for readable errors (lax.cond's structure
-    # errors don't mention the user's variable names)
-    dyn_in = tuple(dyn)
-    try:
-        t_out = jax.eval_shape(run(true_fn, "t"), dyn_in)
-        f_out = jax.eval_shape(run(false_fn, "f"), dyn_in)
-    except TypeError as e:
-        raise TypeError(
-            f"dy2static: a branch of a tensor-dependent `if` assigning "
-            f"{list(names)} produced a non-traceable value: {e}") from None
-    if stash["t"][1] != stash["f"][1]:
-        raise TypeError(
-            f"dy2static: the branches of a tensor-dependent `if` disagree "
-            f"on which of {list(names)} are tensors; a variable set in "
-            "only one branch must already have a tensor value before the "
-            "`if`")
+        # pre-check with eval_shape for readable errors (lax.cond's
+        # structure errors don't mention the user's variable names)
+        dyn_in = tuple(dyn)
+        try:
+            t_out = jax.eval_shape(run(true_fn, "t"), dyn_in)
+            f_out = jax.eval_shape(run(false_fn, "f"), dyn_in)
+        except TypeError as e:
+            raise TypeError(
+                f"dy2static: a branch of a tensor-dependent `if` assigning "
+                f"{_public_names(names)} produced a non-traceable value: {e}") from None
+        if stash["t"][1] != stash["f"][1]:
+            fixed = (_fix_ret_placeholders(true_fn, false_fn, t_out, f_out,
+                                           stash, names)
+                     if attempt == 0 else None)
+            if fixed is None:
+                raise TypeError(
+                    f"dy2static: the branches of a tensor-dependent `if` "
+                    f"disagree on which of {_public_names(names)} are tensors; a "
+                    "variable set in only one branch must already have a "
+                    "tensor value before the `if`")
+            true_fn, false_fn = fixed
+            continue
+        break
     _check_branch_match(t_out, f_out,
                         _dyn_names(names, stash["t"][1], list(t_out)))
     for n, a, b in zip([nm for nm, m in zip(names, stash["t"][1]) if not m],
@@ -276,7 +343,7 @@ def _check_branch_match(t_out, f_out, names):
     if t_tree != f_tree or len(t_flat) != len(f_flat):
         raise TypeError(
             f"dy2static: the branches of a tensor-dependent `if` produce "
-            f"different structures for {list(names)} ({t_tree} vs {f_tree})")
+            f"different structures for {_public_names(names)} ({t_tree} vs {f_tree})")
     for i, (a, b) in enumerate(zip(t_flat, f_flat)):
         nm = names[i] if i < len(names) else f"value {i}"
         if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
@@ -287,16 +354,21 @@ def _check_branch_match(t_out, f_out, names):
                 "produce matching tensors")
 
 
-def convert_ifelse_ret(pred, true_fn, false_fn):
+def convert_ifelse_ret(pred, true_fn, false_fn, operands=()):
     """Both-branches-return form: the converted statement returns the
-    chosen branch's return value directly."""
+    chosen branch's return value directly.  `operands` are the locals a
+    branch reads before (re)assigning (UNDEF thunks for names unbound at
+    the call site — using one inside the taken branch raises loudly,
+    matching plain Python's UnboundLocalError timing for the not-taken
+    branch's names)."""
     p = to_bool(pred, "`if` condition")
     if not isinstance(p, jax.core.Tracer):
-        return (true_fn if p else false_fn)()
-    t_out = jax.eval_shape(lambda: true_fn())
-    f_out = jax.eval_shape(lambda: false_fn())
+        return (true_fn if p else false_fn)(*operands)
+    t_out = jax.eval_shape(lambda: true_fn(*operands))
+    f_out = jax.eval_shape(lambda: false_fn(*operands))
     _check_branch_match(t_out, f_out, ("return value",))
-    return jax.lax.cond(p, lambda _: true_fn(), lambda _: false_fn(), 0)
+    return jax.lax.cond(p, lambda _: true_fn(*operands),
+                        lambda _: false_fn(*operands), 0)
 
 
 # --------------------------------------------------------------------------
@@ -354,7 +426,31 @@ def convert_while_loop(cond_fn, body_fn, operands, names=()):
     return _traced_while(cond_fn, body_fn, operands, names)
 
 
+def _init_ret_carries(run_body, operands, names):
+    """A `_retv_*` early-return carrier entering a traced loop with no
+    prior value (None/UNDEF init from the return rewrite) gets a zeros
+    placeholder shaped like the value the body assigns it — reads are
+    guarded by the matching `_retf_*` flag, so the placeholder is
+    unobservable.  `run_body(operands)` applies one loop body (the
+    while/for callers bind their iteration argument).  Real user
+    variables are left alone for _check_no_undef's diagnostic."""
+    pending = [i for i, (n, v) in enumerate(zip(names, operands))
+               if n.startswith("_retv_") and (v is None or v is UNDEF)]
+    if not pending:
+        return operands
+    try:
+        out = jax.eval_shape(lambda: run_body(operands))
+    except Exception:
+        return operands
+    ops = list(operands)
+    for i in pending:
+        if i < len(out) and out[i] is not None and out[i] is not UNDEF:
+            ops[i] = _placeholder_like(out[i])
+    return tuple(ops)
+
+
 def _traced_while(cond_fn, body_fn, operands, names):
+    operands = _init_ret_carries(lambda ops: body_fn(*ops), operands, names)
     _check_no_undef(names, operands, "while")
     dyn, stat, mask = _split(operands)
     dyn_flat, dyn_tree = jax.tree_util.tree_flatten(tuple(dyn))
@@ -373,7 +469,7 @@ def _traced_while(cond_fn, body_fn, operands, names):
         if nm != mask:
             raise TypeError(
                 f"dy2static: the `while` body changed which of "
-                f"{list(names)} are tensors; loop variables must stay "
+                f"{_public_names(names)} are tensors; loop variables must stay "
                 "tensor/numeric")
         for n, a, b in zip(static_names, stat, ns):
             _check_same_static(n, a, b)
@@ -381,7 +477,7 @@ def _traced_while(cond_fn, body_fn, operands, names):
         if new_tree != dyn_tree:
             raise TypeError(
                 f"dy2static: the `while` body changed the structure of "
-                f"loop variables {list(names)}")
+                f"loop variables {_public_names(names)}")
         return new_flat
 
     leaf_names = _dyn_names(names, mask, dyn)
@@ -430,11 +526,18 @@ def convert_for(iterable, body_fn, operands, names=(), target_arity=1,
                     break
         return vals
 
+    wrap = Tensor if isinstance(iterable, Tensor) else (lambda x: x)
+    x0_probe = it[0] if it.shape[0] else it  # aval probe only
+    if target_arity == 1:
+        xs0 = (wrap(x0_probe),)
+    else:
+        xs0 = tuple(wrap(x0_probe[i]) for i in range(target_arity))
+    operands = _init_ret_carries(lambda ops: body_fn(*xs0, *ops),
+                                 operands, names)
     _check_no_undef(names, operands, "for")
     dyn, stat, mask = _split(operands)
     dyn_flat, dyn_tree = jax.tree_util.tree_flatten(tuple(dyn))
     static_names = [n for n, m in zip(names, mask) if not m]
-    wrap = Tensor if isinstance(iterable, Tensor) else (lambda x: x)
 
     def step_raw(flat, x):
         vals = _merge(list(jax.tree_util.tree_unflatten(dyn_tree, flat)),
@@ -448,7 +551,7 @@ def convert_for(iterable, body_fn, operands, names=(), target_arity=1,
         if nm != mask:
             raise TypeError(
                 f"dy2static: the `for` body changed which of "
-                f"{list(names)} are tensors; loop variables must stay "
+                f"{_public_names(names)} are tensors; loop variables must stay "
                 "tensor/numeric")
         for n, a, b in zip(static_names, stat, ns):
             _check_same_static(n, a, b)
@@ -456,13 +559,12 @@ def convert_for(iterable, body_fn, operands, names=(), target_arity=1,
         if new_tree != dyn_tree:
             raise TypeError(
                 f"dy2static: the `for` body changed the structure of loop "
-                f"variables {list(names)}")
+                f"variables {_public_names(names)}")
         return new_flat
 
     leaf_names = _dyn_names(names, mask, dyn)
     init_flat = [jnp.asarray(_plain(x)) for x in dyn_flat]
-    x0 = it[0] if it.shape[0] else it  # aval probe only
-    dtypes = _stable_dtypes(lambda flat: step_raw(list(flat), x0),
+    dtypes = _stable_dtypes(lambda flat: step_raw(list(flat), x0_probe),
                             init_flat, leaf_names)
     init = tuple(x.astype(d) for x, d in zip(init_flat, dtypes))
 

@@ -19,12 +19,21 @@ lax.cond / lax.while_loop / lax.scan.
 iteration-local skip flag guarding the rest of the body) and then ride
 the normal if/while functionalization.
 
+Early returns are functionalized by restructuring (_fold_early_returns):
+`if c: ...return` folds its fall-through into the other branch — with or
+without an existing else — until every data-dependent return covers both
+branches of a lax.cond; `return` inside a loop becomes a guard flag +
+value carrier + `break` (riding the break machinery), re-raised after
+the loop.  A return whose VALUE is only defined under a traced loop
+carry still needs a pre-loop tensor value (lax carries are shape-static)
+— the converter says so explicitly.
+
 Deliberately NOT functionalized (left as plain Python, which still works
 for concrete conditions and raises jax's tracer error for traced ones):
-jumps inside with/try blocks, early returns that don't cover both
-branches, `global`/`nonlocal`, loop-`else`.
+jumps inside with/try blocks, `global`/`nonlocal`, loop-`else`.
 """
 import ast
+import copy
 import functools
 import inspect
 import linecache
@@ -139,6 +148,10 @@ def _reads(node):
         for n in ast.walk(root):
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
                 out.add(n.id)
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                # `x += e` reads x even though the target is Store ctx
+                out.add(n.target.id)
     return out
 
 
@@ -295,33 +308,119 @@ def _ends_in_return(stmts):
     return False
 
 
+_RET_UID = iter(range(1 << 30))
+
+
+def _rw_loop_returns(body, flag, val):
+    """Rewrite `return e` bound directly to this loop body (not inside a
+    nested loop/scope, which binds or re-folds its own) into
+
+        <flag> = True; <val> = e; break
+
+    — the break then rides the existing guard-flag machinery
+    (_rewrite_loop_jumps), and the caller re-raises the return AFTER the
+    loop from the flag.  Returns None when a return hides inside a
+    construct we don't restructure (with/try)."""
+    out = []
+    for i, st in enumerate(body):
+        if isinstance(st, ast.Return):
+            out.append(_assign_const(flag, True))
+            out.append(ast.Assign(
+                targets=[_name(val, ast.Store())],
+                value=st.value or ast.Constant(value=None)))
+            out.append(ast.Break())
+            return out                          # rest is dead code
+        if isinstance(st, (ast.While, ast.For)) or \
+                isinstance(st, _SCOPE_NODES):
+            out.append(st)
+            continue
+        if _contains(st, ast.Return, stop=(ast.While, ast.For)):
+            if not isinstance(st, ast.If):
+                return None                     # return inside with/try
+            t = _rw_loop_returns(st.body, flag, val)
+            f = _rw_loop_returns(st.orelse, flag, val) if st.orelse else []
+            if t is None or f is None:
+                return None
+            out.append(ast.If(test=st.test, body=t, orelse=f))
+            # no guard needed on the suffix: every rewritten return path
+            # ends in `break`, which truncates natively (concrete) or via
+            # the break-flag guards (traced)
+            rest = _rw_loop_returns(body[i + 1:], flag, val)
+            if rest is None:
+                return None
+            out.extend(rest)
+            return out
+        out.append(st)
+    return out
+
+
 def _fold_early_returns(stmts, is_func_tail):
-    """Rewrite `if c: ...return` followed by REST into `if c: ... else:
-    REST` (semantics-identical since the body always returns), so the
-    both-branches-return functionalization can lower early-return guards —
-    the most common data-dependent `if` shape.  Only statement lists whose
-    fall-through means "function returns None" may have an implicit
-    `return None` appended."""
+    """Functionalize early returns (reference return_transformer.py, by
+    restructuring instead of flag-threading where possible):
+
+    * `if c: ...return` + REST  ->  `if c: ...return else: REST` — and
+      when the `if` HAS an else, REST moves onto whichever branch falls
+      through, so any partial-return if/else lowers to the
+      both-branches-return lax.cond form.
+    * `return` inside a loop  ->  flag + value carrier + `break`
+      (_rw_loop_returns), with `if <flag>: return <val>` re-raised after
+      the loop — the same guard-flag trick as break/continue; the
+      injected if is then folded by the rule above.
+
+    Only statement lists whose fall-through means "function returns
+    None" may have an implicit `return None` appended.  Still excluded
+    (left as plain Python): returns inside with/try and loop-`else`."""
     stmts = list(stmts)
     for i, st in enumerate(stmts):
+        if isinstance(st, ast.Return):
+            del stmts[i + 1:]                   # anything after is dead
+            return stmts
         if isinstance(st, ast.If):
             rest = stmts[i + 1:]
             st.body[:] = _fold_early_returns(st.body,
                                              is_func_tail and not rest)
             st.orelse[:] = _fold_early_returns(st.orelse,
                                                is_func_tail and not rest)
-            if (not st.orelse and _ends_in_return(st.body)
-                    and not _has_loop_jump(st.body)):
-                if rest:
-                    st.orelse = _fold_early_returns(rest, is_func_tail)
-                    if is_func_tail and not _ends_in_return(st.orelse):
-                        st.orelse.append(
-                            ast.Return(value=ast.Constant(value=None)))
-                    del stmts[i + 1:]
-                    return stmts
-                if is_func_tail:
-                    st.orelse = [ast.Return(value=ast.Constant(value=None))]
-        elif isinstance(st, (ast.While, ast.For, ast.With)):
+            has_ret = _has_return(st.body) or _has_return(st.orelse)
+            jumps = _has_loop_jump(st.body) or _has_loop_jump(st.orelse)
+            if has_ret and not jumps and (rest or is_func_tail):
+                # distribute REST onto every fall-through path: each
+                # branch re-folds with REST appended (a branch that
+                # already returns strips it as dead code), so partial /
+                # nested early returns reduce to the both-branches-return
+                # lax.cond form.  REST is deep-copied for the second
+                # placement — later visitors mutate nodes in place.
+                st.body[:] = _fold_early_returns(
+                    st.body + copy.deepcopy(rest), is_func_tail)
+                if is_func_tail and not _ends_in_return(st.body):
+                    st.body.append(ast.Return(value=ast.Constant(value=None)))
+                st.orelse[:] = _fold_early_returns(
+                    st.orelse + rest, is_func_tail)
+                if is_func_tail and not _ends_in_return(st.orelse):
+                    st.orelse.append(
+                        ast.Return(value=ast.Constant(value=None)))
+                del stmts[i + 1:]
+                return stmts
+        elif isinstance(st, (ast.While, ast.For)):
+            st.body[:] = _fold_early_returns(st.body, False)
+            if (_has_return(st.body) and not st.orelse
+                    and not _has_scope_escape(st.body)):
+                uid = next(_RET_UID)
+                flag, val = f"_retf_{uid}", f"_retv_{uid}"
+                new_body = _rw_loop_returns(st.body, flag, val)
+                if new_body is not None:
+                    st.body[:] = new_body
+                    raise_if = ast.If(
+                        test=_name(flag),
+                        body=[ast.Return(value=_name(val))], orelse=[])
+                    spliced = (stmts[:i]
+                               + [_assign_const(flag, False),
+                                  _assign_const(val, None), st, raise_if]
+                               + stmts[i + 1:])
+                    # reprocess: the loop body is now return-free and the
+                    # injected raise_if folds like any early-return if
+                    return _fold_early_returns(spliced, is_func_tail)
+        elif isinstance(st, ast.With):
             st.body[:] = _fold_early_returns(st.body, False)
         elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
             st.body[:] = _fold_early_returns(st.body, True)
@@ -578,11 +677,22 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                     and not _has_scope_escape(node.body + node.orelse)):
                 uid = self._uid()
                 tname, fname = f"_pt_ret_true_{uid}", f"_pt_ret_false_{uid}"
-                t_fn = _make_fn(tname, [], node.body)
-                f_fn = _make_fn(fname, [], node.orelse)
+                # locals a branch reads before (re)assigning must come in
+                # as PARAMETERS: assigning a name anywhere in the branch
+                # fn makes it fn-local, so the closure read that zero-arg
+                # fns relied on would raise UnboundLocalError (e.g. a
+                # folded `x = x * 2; return x - 7` branch)
+                params = sorted(
+                    _use_before_def(node.body, self._locals, self._locals)
+                    | _use_before_def(node.orelse, self._locals,
+                                      self._locals))
+                t_fn = _make_fn(tname, params, node.body)
+                f_fn = _make_fn(fname, params, node.orelse)
                 ret = ast.Return(value=_call(
                     _jst("convert_ifelse_ret"),
-                    [node.test, _name(tname), _name(fname)]))
+                    [node.test, _name(tname), _name(fname),
+                     ast.Tuple(elts=[_arg_thunk(n) for n in params],
+                               ctx=ast.Load())]))
                 return [t_fn, f_fn, ret]
             return node
         if (_has_loop_jump(node.body) or _has_loop_jump(node.orelse)

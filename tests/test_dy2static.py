@@ -1062,3 +1062,164 @@ def test_containers_and_break_through_jit_save(tmp_path):
     out = paddle.jit.load(path)(x)
     out = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
     np.testing.assert_allclose(eager, out, rtol=1e-5)
+
+
+# -- early returns (reference return_transformer.py) ------------------------
+
+def test_early_return_with_else_branch():
+    """`if c: return a else: y = ... ; return f(y)` — the fall-through
+    folds onto the else branch and lowers to both-branches-return
+    lax.cond."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x * 2.0
+        else:
+            y = x + 3.0
+        return y * y
+
+    for v in (1.0, -3.0):
+        x = paddle.to_tensor(np.asarray([v, v], "float32"))
+        np.testing.assert_allclose(np.asarray(f(x)._value),
+                                   np.asarray(paddle.jit.to_static(f)(x)._value),
+                                   rtol=1e-5)
+
+
+def test_early_return_in_else_only():
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 5.0
+        else:
+            return -x
+        return y + 1.0
+
+    for v in (1.0, -3.0):
+        x = paddle.to_tensor(np.asarray([v], "float32"))
+        np.testing.assert_allclose(np.asarray(f(x)._value),
+                                   np.asarray(paddle.jit.to_static(f)(x)._value),
+                                   rtol=1e-5)
+
+
+def test_nested_partial_early_returns():
+    """Inner `if` returns on one path only; REST is distributed onto
+    every fall-through path."""
+    def f(x):
+        if paddle.max(x) > 0:
+            if paddle.min(x) > -5.0:
+                return x + 7.0
+            x = x * 2.0
+        return x - 7.0
+
+    for v in (1.0, -3.0, -60.0):
+        x = paddle.to_tensor(np.asarray([v, 2.0], "float32"))
+        np.testing.assert_allclose(np.asarray(f(x)._value),
+                                   np.asarray(paddle.jit.to_static(f)(x)._value),
+                                   rtol=1e-5)
+
+
+def test_return_from_concrete_for_loop_traced_condition():
+    """`return` inside a for loop rides the flag + carrier + break
+    rewrite; the traced exit condition lowers to lax.cond with a zeros
+    placeholder for the carrier on the not-returning branch."""
+    def f(x):
+        for _ in range(3):
+            x = x + 1.0
+            if paddle.sum(x) > 100.0:
+                return x * 10.0
+        return x
+
+    for v in (1.0, -3.0, 60.0):
+        x = paddle.to_tensor(np.asarray([v, v, v], "float32"))
+        np.testing.assert_allclose(np.asarray(f(x)._value),
+                                   np.asarray(paddle.jit.to_static(f)(x)._value),
+                                   rtol=1e-5)
+
+
+def test_return_from_traced_while_loop():
+    """Early return from a lax.while_loop: the `_retv_*` carry enters
+    the loop with a shaped placeholder discovered from the body."""
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        while i < 10:
+            i = i + 1
+            x = x * 1.5
+            if paddle.sum(x) > 50.0:
+                return x + 1000.0
+        return x
+
+    for v in (1.0, -1.0, 30.0):
+        x = paddle.to_tensor(np.asarray([v, v], "float32"))
+        np.testing.assert_allclose(np.asarray(f(x)._value),
+                                   np.asarray(paddle.jit.to_static(f)(x)._value),
+                                   rtol=1e-4)
+
+
+def test_return_none_fallthrough():
+    """Early return with implicit `return None` fall-through: the
+    concrete-condition path keeps exact Python semantics (a traced
+    condition with a None-vs-tensor return is correctly rejected)."""
+    def f(x, flip):
+        if flip > 0:
+            return x * 2.0
+
+    conv = convert_to_static(f)        # eager dual-path: flip stays concrete
+    x = paddle.to_tensor(np.asarray([1.0], "float32"))
+    np.testing.assert_allclose(np.asarray(conv(x, 1)._value), [2.0],
+                               rtol=1e-6)
+    assert conv(x, -1) is None
+    # under jit every arg traces; None-vs-tensor returns are rejected
+    # with the named-variable diagnostic, not a raw tracer error
+    with pytest.raises(TypeError, match="different structures"):
+        paddle.jit.to_static(f)(x, 1)
+
+
+def test_return_from_tensor_iterable_for():
+    """Early return from a for-over-tensor (lax.scan path): the carrier
+    gets its placeholder from a one-step body probe."""
+    def f(x, t):
+        for v in t:
+            x = x + v
+            if paddle.sum(x) > 3.0:
+                return x * 10.0
+        return x
+
+    for scale in (1.0, 0.1):
+        x = paddle.to_tensor(np.zeros((2,), "float32"))
+        t = paddle.to_tensor(np.full((4, 2), scale, "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(x, t)._value),
+            np.asarray(paddle.jit.to_static(f)(x, t)._value), rtol=1e-5)
+
+
+def test_augassign_read_in_returning_branch():
+    """`x += e` reads x: branches whose first touch is an AugAssign must
+    receive it as a parameter, not an unbound local."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            x += 2.0
+            return x
+        else:
+            x *= 3.0
+            return x
+
+    for v in (1.0, -2.0):
+        x = paddle.to_tensor(np.asarray([v], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.asarray([v], "float32")))._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+def test_synthetic_names_translated_in_diagnostics():
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        while i < 5:
+            i = i + 1
+            if paddle.sum(x) > 10.0:
+                return paddle.sum(x)   # scalar vs vector fall-through
+            x = x * 1.1
+        return x
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with pytest.raises(TypeError) as ei:
+        paddle.jit.to_static(f)(x)
+    assert "_retv_" not in str(ei.value)
+    assert "return value" in str(ei.value)
