@@ -21,8 +21,24 @@ _MASKS = {}  # id(param) -> mask jnp array
 
 
 def set_excluded_layers(main_program=None, param_names=None):
+    """Exclude layers/params from pruning (reference
+    asp/asp.py set_excluded_layers). Accepts either full parameter
+    names ('fc.weight') or layer prefixes ('fc', 'backbone.conv1') —
+    the reference takes layer names and derives their params."""
+    if param_names is None and main_program is not None and \
+            not hasattr(main_program, "global_block"):
+        # dygraph call style: set_excluded_layers(["fc1", ...])
+        param_names, main_program = main_program, None
     for n in param_names or []:
         _EXCLUDED.add(n)
+
+
+def _is_excluded(param_name):
+    if param_name in _EXCLUDED:
+        return True
+    parts = param_name.split(".")
+    return any(".".join(parts[:k]) in _EXCLUDED
+               for k in range(1, len(parts)))
 
 
 def reset_excluded_layers(main_program=None):
@@ -35,8 +51,19 @@ def calculate_density(x):
 
 
 def create_mask(tensor, func_name="mask_1d", n=2, m=4):
-    """n:m magnitude mask along the last axis (keep n largest of every m)."""
+    """n:m magnitude mask (reference sparsity/utils.py create_mask):
+    mask_1d keeps the n largest of every m along the last axis;
+    mask_2d_greedy/mask_2d_best keep at most n per row AND per column
+    of every m x m block (greedy by magnitude)."""
     arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
+    if func_name in ("mask_2d_greedy", "get_mask_2d_greedy"):
+        return _mask_2d_greedy(arr, n, m)
+    if func_name in ("mask_2d_best", "get_mask_2d_best"):
+        return _mask_2d_best(arr, n, m)
+    if func_name not in ("mask_1d", "get_mask_1d"):
+        raise ValueError(
+            f"unknown mask algorithm {func_name!r}; expected mask_1d, "
+            "mask_2d_greedy or mask_2d_best")
     flat = arr.reshape(-1, arr.shape[-1])
     cols = flat.shape[1]
     pad = (-cols) % m
@@ -48,6 +75,70 @@ def create_mask(tensor, func_name="mask_1d", n=2, m=4):
     np.put_along_axis(mask, order[..., :m - n], False, axis=-1)
     mask = mask.reshape(flat.shape[0], -1)[:, :cols].reshape(arr.shape)
     return mask
+
+
+def _mask_2d_greedy(arr, n, m):
+    """Per m x m block, admit entries in descending |magnitude| while
+    row- and column-budgets (n each) allow — the reference
+    get_mask_2d_greedy algorithm."""
+    mat = arr.reshape(-1, arr.shape[-1]) if arr.ndim != 2 else arr
+    rows, cols = mat.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(mat, ((0, pr), (0, pc)))
+    mask = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = np.abs(padded[bi:bi + m, bj:bj + m])
+            order = np.dstack(np.unravel_index(
+                np.argsort(-block, axis=None), block.shape))[0]
+            rbud = np.full(m, n)
+            cbud = np.full(m, n)
+            for r, c in order:
+                if rbud[r] and cbud[c]:
+                    mask[bi + r, bj + c] = True
+                    rbud[r] -= 1
+                    cbud[c] -= 1
+    mask = mask[:rows, :cols]
+    return mask.reshape(arr.shape)
+
+
+_BEST_PATTERNS = {}  # (n, m) -> [m x m bool candidates], lazily built
+
+
+def _mask_2d_best(arr, n, m):
+    """Exhaustive per-block search (reference get_mask_2d_best): among
+    all masks with exactly n kept per row and per column of the m x m
+    block, pick the one maximizing kept |magnitude|.  The candidate set
+    is enumerated once per (n, m) — 90 patterns for 2:4."""
+    import itertools
+    if (n, m) not in _BEST_PATTERNS:
+        row_choices = list(itertools.combinations(range(m), n))
+        cands = []
+        for rows_sel in itertools.product(row_choices, repeat=m):
+            colcount = [0] * m
+            for sel in rows_sel:
+                for c in sel:
+                    colcount[c] += 1
+            if all(c == n for c in colcount):
+                pat = np.zeros((m, m), bool)
+                for r, sel in enumerate(rows_sel):
+                    pat[r, list(sel)] = True
+                cands.append(pat)
+        _BEST_PATTERNS[(n, m)] = np.stack(cands)
+    cands = _BEST_PATTERNS[(n, m)]
+
+    mat = arr.reshape(-1, arr.shape[-1]) if arr.ndim != 2 else arr
+    rows, cols = mat.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(np.abs(mat), ((0, pr), (0, pc)))
+    mask = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            scores = (cands * block[None]).sum(axis=(1, 2))
+            mask[bi:bi + m, bj:bj + m] = cands[int(scores.argmax())]
+    mask = mask[:rows, :cols]
+    return mask.reshape(arr.shape)
 
 
 def check_mask_1d(mask, n=2, m=4):
@@ -68,7 +159,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Apply n:m masks to every prunable weight (>=2D, not excluded)."""
     pruned = {}
     for name, p in model.named_parameters():
-        if p.stop_gradient or len(p.shape) < 2 or name in _EXCLUDED:
+        if p.stop_gradient or len(p.shape) < 2 or _is_excluded(name):
             continue
         mask = create_mask(p, mask_algo, n, m)
         jmask = jnp.asarray(mask, p._value.dtype)

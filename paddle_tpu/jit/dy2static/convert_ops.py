@@ -177,6 +177,7 @@ def _merge(dyn, stat, mask):
 
 
 def _check_same_static(name, a, b):
+    name = _public_name(name)
     same = a is b
     if not same:
         try:

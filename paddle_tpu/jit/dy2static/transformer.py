@@ -310,6 +310,18 @@ def _ends_in_return(stmts):
 
 _RET_UID = iter(range(1 << 30))
 
+# REST duplication bound: each partial-return `if` copies its suffix
+# onto both branches, so k NESTED partial returns grow the tail 2^k-
+# fold.  Inner folds run first and see the already-grown suffix, so a
+# per-site size check bounds the cumulative blowup; an over-limit fold
+# is skipped (plain-Python fallback — concrete conditions still work,
+# traced ones get the tracer error, exactly the pre-fold behavior).
+_FOLD_REST_LIMIT = 4000
+
+
+def _ast_size(stmts):
+    return sum(1 for s in stmts for _ in ast.walk(s))
+
 
 def _rw_loop_returns(body, flag, val):
     """Rewrite `return e` bound directly to this loop body (not inside a
@@ -383,7 +395,8 @@ def _fold_early_returns(stmts, is_func_tail):
                                                is_func_tail and not rest)
             has_ret = _has_return(st.body) or _has_return(st.orelse)
             jumps = _has_loop_jump(st.body) or _has_loop_jump(st.orelse)
-            if has_ret and not jumps and (rest or is_func_tail):
+            if (has_ret and not jumps and (rest or is_func_tail)
+                    and _ast_size(rest) <= _FOLD_REST_LIMIT):
                 # distribute REST onto every fall-through path: each
                 # branch re-folds with REST appended (a branch that
                 # already returns strips it as dead code), so partial /

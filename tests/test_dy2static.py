@@ -1223,3 +1223,21 @@ def test_synthetic_names_translated_in_diagnostics():
         paddle.jit.to_static(f)(x)
     assert "_retv_" not in str(ei.value)
     assert "return value" in str(ei.value)
+
+
+def test_nontensor_return_value_diagnostic_translated():
+    """A non-tensor early-return value under traced control flow names
+    'return value', never the synthetic _retv_* carrier."""
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        while i < 5:
+            i = i + 1
+            if paddle.sum(x) > 10.0:
+                return "done"
+            x = x * 1.1
+        return x
+
+    with pytest.raises(TypeError) as ei:
+        paddle.jit.to_static(f)(paddle.to_tensor(np.ones(2, "float32")))
+    assert "_retv_" not in str(ei.value)
+    assert "return value" in str(ei.value)

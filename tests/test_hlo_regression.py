@@ -318,3 +318,76 @@ def test_bert_encoder_bf16_graph():
                 if d:
                     n *= int(d)
             assert n <= 8, (m.group(1), shape)
+
+
+def test_yolov3_nhwc_bf16_graph():
+    """YOLOv3 is a first-ever-on-chip campaign stage: pin the graph
+    properties its trial depends on before any tunnel window — NHWC
+    stays activation-transpose-free through the darknet body + FPN
+    neck (upsample/concat are the usual layout breakers), and every
+    conv takes bf16 operands."""
+    from paddle_tpu.vision.models import yolov3_darknet53
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = yolov3_darknet53(num_classes=8, data_format="NHWC")
+    model.bfloat16()
+    model.eval()
+    x = jnp.zeros((1, 128, 128, 3), jnp.bfloat16)
+    txt = _lower_forward(model, x)
+    transposes = [l for l in txt.splitlines()
+                  if "stablehlo.transpose" in l]
+    act = [l for l in transposes
+           if not re.search(r"transpose %arg\d+, dims = \[2, 3, 1, 0\]",
+                            l)]
+    # the ONLY allowed activation transposes are the 3 head outputs
+    # converting to the reference's NCHW prediction layout
+    # [B, anchors*(5+C), H, W] at the API boundary — 39-channel tensors
+    # at stride-32/16/8 resolution, noise next to the conv work
+    assert len(act) == 3, act[:4]
+    for l in act:
+        assert "dims = [0, 3, 1, 2]" in l and "x39x" in l.split("->")[1], l
+    n_conv = _count(txt, "convolution")
+    # darknet53 (52 convs) + neck/heads; the exact count pins the
+    # architecture the bench measures
+    assert n_conv == 75, n_conv
+    assert len(transposes) == n_conv + 3
+    for line in txt.splitlines():
+        if "stablehlo.convolution" in line:
+            operands = line.split(":")[1].split("->")[0]
+            assert "f32" not in operands, line
+
+
+def test_gpt_moe_expert_matmuls_bf16_router_f32():
+    """GPT-MoE campaign stage: the expert FF einsums (where the FLOPs
+    are) must take bf16 operands, while the ROUTER keeps f32 by design
+    (top-k gate logits in bf16 destabilize capacity assignment — the
+    reference gate computes fp32 too). Every f32 dot_general must be
+    router-sized (trailing dim == num_experts); anything bigger in f32
+    is a down-cast regression the on-chip trial would misreport as a
+    tuning gap."""
+    from paddle_tpu.models import GPTMoE
+    from paddle_tpu.models.moe import gpt_moe_tiny
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    cfg = gpt_moe_tiny(dtype="bfloat16")
+    model = GPTMoE(cfg)
+    model.bfloat16()
+    model.eval()
+    ids = jnp.zeros((2, 32), jnp.int32)
+    txt = _lower_forward(model, ids)
+    dots = [l for l in txt.splitlines() if "stablehlo.dot_general" in l]
+    bf16_dots = [l for l in dots
+                 if "f32" not in l.split(":")[1].split("->")[0]]
+    # at least the dense projections + expert w1/w2 einsums ride bf16
+    assert len(bf16_dots) >= cfg.num_layers * 4, len(bf16_dots)
+    for l in dots:
+        operands = l.split(":")[1].split("->")[0]
+        if "f32" not in operands:
+            continue
+        out_ty = l.split("->")[-1]
+        shapes = re.findall(r"tensor<([0-9x]+)x?f32", out_ty)
+        assert shapes, l
+        dims = [int(d) for d in shapes[0].split("x") if d]
+        assert dims[-1] == cfg.num_experts, l   # router logits only

@@ -158,3 +158,95 @@ def test_cost_model():
     finally:
         paddle.disable_static()
 
+
+
+def test_asp_e2e_masked_finetune():
+    """Reference ASP workflow end-to-end: train briefly, prune 2:4,
+    fine-tune with the decorated optimizer — the 2:4 pattern must
+    survive every Adam step (momentum would otherwise resurrect pruned
+    weights), excluded layers stay dense, and the masked model still
+    learns (loss decreases)."""
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 32)
+            self.head = nn.Linear(32, 4)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.fc1(x))
+            h = paddle.nn.functional.relu(self.fc2(h))
+            return self.head(h)
+
+    net = Net()
+    x = paddle.to_tensor(rng.randn(64, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (64,)).astype("int64"))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+
+    def train_step():
+        loss = paddle.nn.functional.cross_entropy(net(x), y)
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+        return float(loss.numpy())
+
+    for _ in range(3):      # pretrain dense
+        train_step()
+
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers(["head"])      # layer-prefix exclusion
+    pruned = asp.prune_model(net)
+    assert set(pruned) == {"fc1.weight", "fc2.weight"}  # head excluded
+    assert asp.check_mask_1d(net.fc1.weight.numpy())
+    zero_map = net.fc1.weight.numpy() == 0
+
+    opt = asp.decorate(opt)
+    losses = [train_step() for _ in range(8)]
+    # 2:4 pattern survives 8 Adam updates, pruned slots stay exactly 0
+    assert asp.check_mask_1d(net.fc1.weight.numpy())
+    assert asp.check_mask_1d(net.fc2.weight.numpy())
+    assert (net.fc1.weight.numpy()[zero_map] == 0).all()
+    assert abs(asp.calculate_density(net.fc1.weight) - 0.5) < 1e-6
+    assert asp.calculate_density(net.head.weight) > 0.9   # stayed dense
+    assert losses[-1] < losses[0]          # masked model still learns
+    # minimize() routes through the decorated step too
+    loss = paddle.nn.functional.cross_entropy(net(x), y)
+    opt.clear_grad()
+    opt.minimize(loss)
+    assert asp.check_mask_1d(net.fc1.weight.numpy())
+    asp.reset_excluded_layers()
+
+
+def test_asp_mask_2d_greedy():
+    from paddle_tpu.incubate import asp
+
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 12).astype("float32")
+    mask = asp.create_mask(w, func_name="mask_2d_greedy")
+    assert asp.check_mask_2d(mask)         # <=2 per row AND column of 4x4
+    # greedy keeps the block's largest entry
+    blk = np.abs(w[:4, :4])
+    r, c = np.unravel_index(blk.argmax(), blk.shape)
+    assert mask[r, c]
+
+
+def test_asp_mask_2d_best_and_validation():
+    from paddle_tpu.incubate import asp
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(8, 8).astype("float32")
+    greedy = asp.create_mask(w, func_name="mask_2d_greedy")
+    best = asp.create_mask(w, func_name="mask_2d_best")
+    assert asp.check_mask_2d(best)
+    # exhaustive search keeps at least the greedy magnitude (usually more)
+    assert (np.abs(w) * best).sum() >= (np.abs(w) * greedy).sum() - 1e-6
+    # best keeps exactly n per row AND column in every full block
+    assert (best.sum(0) == 4).all() and (best.sum(1) == 4).all()
+    with pytest.raises(ValueError, match="unknown mask algorithm"):
+        asp.create_mask(w, func_name="mask2d_greedy")
