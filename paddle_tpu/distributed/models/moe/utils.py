@@ -77,12 +77,14 @@ def _limit_by_capacity(expert_count, capacity, n_worker):
     (reference utils.py:138): _limit_by_capacity([1,2,2,8,3,6], [5,5,5],
     2) == [1,2,2,4,3,3]."""
     def f(ec, cap):
+        # int32 math: counts are token counts, far below 2^31, and x64
+        # is disabled on TPU (int64 would warn + truncate anyway)
         n_expert = ec.size // n_worker
-        grid = ec.reshape(n_worker, n_expert).astype(jnp.int64)
+        grid = ec.reshape(n_worker, n_expert).astype(jnp.int32)
         cum = jnp.cumsum(grid, axis=0)
-        capped = jnp.minimum(cum, cap.astype(jnp.int64)[None, :])
+        capped = jnp.minimum(cum, cap.astype(jnp.int32)[None, :])
         prev = jnp.concatenate(
-            [jnp.zeros((1, n_expert), jnp.int64), capped[:-1]], axis=0)
+            [jnp.zeros((1, n_expert), jnp.int32), capped[:-1]], axis=0)
         return (capped - prev).reshape(-1).astype(ec.dtype)
     return apply_op(f, expert_count, capacity)
 
@@ -94,8 +96,10 @@ def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
     [0,3,1,3,0,0,0,0], 4, 2) == [1,3,3,3,-1,2,1,1]."""
     def f(g, ec):
         total_experts = n_expert * n_worker
-        oh = (g[:, None] == jnp.arange(total_experts)[None, :])
+        flat = g.reshape(-1)   # [T, k] topk indices prune in row-major order
+        oh = (flat[:, None] == jnp.arange(total_experts)[None, :])
         occ = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1  # 0-based occurrence
-        keep = occ < ec[jnp.clip(g, 0, total_experts - 1)]
-        return jnp.where(keep & (g >= 0), g, -1).astype(g.dtype)
+        keep = occ < ec[jnp.clip(flat, 0, total_experts - 1)]
+        return jnp.where(keep & (flat >= 0), flat, -1).astype(g.dtype) \
+                  .reshape(g.shape)
     return apply_op(f, gate_idx, expert_count)

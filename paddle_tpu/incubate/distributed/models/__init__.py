@@ -1,0 +1,4 @@
+"""Reference python/paddle/incubate/distributed/models/."""
+from . import moe  # noqa: F401
+
+__all__ = ["moe"]

@@ -1,0 +1,136 @@
+"""Gate networks — reference incubate/distributed/models/moe/gate/
+{base_gate,naive_gate,switch_gate,gshard_gate}.py (fastmoe lineage).
+
+Same class surface and constructor signatures; the capacity pruning
+runs through paddle_tpu.distributed.models.moe.utils (vectorized jnp)
+instead of CUDA ops.
+"""
+import math
+
+from .....nn import Layer, Linear
+from ..... import nn
+
+
+class BaseGate(Layer):
+    """Reference gate/base_gate.py:25."""
+
+    def __init__(self, num_expert, world_size):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError("Base gate cannot be directly used for fwd")
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Linear router returning the raw top-k (value, index) pairs —
+    reference gate/naive_gate.py:29."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        import paddle_tpu as paddle
+        gate = self.gate(inp)
+        gate_top_k_val, gate_top_k_idx = paddle.topk(
+            gate, k=self.top_k, axis=-1, largest=True, sorted=False)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 routing with training noise and load-balance loss —
+    reference gate/switch_gate.py:30."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.group = group
+
+    def forward(self, inp):
+        import paddle_tpu as paddle
+        from .utils import limit_by_capacity
+
+        score = self.gate(inp)
+        if self.training:
+            noise = paddle.rand(shape=score.shape)
+            noise = noise * 2 * self.switch_eps + 1.0 - self.switch_eps
+            score = score + noise
+        score = nn.functional.softmax(score, axis=-1)
+        top1_score, top1_idx = paddle.topk(score, k=1, axis=-1, largest=True)
+
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * inp.shape[0])
+        _, _, top1_idx = limit_by_capacity(
+            top1_idx, self.num_expert, self.world_size, capacity,
+            group=self.group)
+
+        # load-balance loss over the post-prune assignment (reference
+        # switch_gate.py:62-76): fraction of tokens vs mean prob
+        kept = (top1_idx.reshape([-1]) > -1).astype("float32")
+        onehot = nn.functional.one_hot(
+            paddle.clip(top1_idx.reshape([-1]), 0, self.tot_expert - 1),
+            self.tot_expert) * kept.unsqueeze(-1)
+        fraction_expert = onehot.sum(0) / max(int(inp.shape[0]), 1)
+        prob_expert = score.sum(0) / max(int(inp.shape[0]), 1)
+        loss = (fraction_expert * prob_expert).sum() * self.tot_expert
+        self.set_loss(loss)
+        return top1_score, top1_idx
+
+
+class GShardGate(NaiveGate):
+    """Top-2 routing with gshard aux loss, capacity pruning, and
+    random second-expert drop — reference gate/gshard_gate.py:30."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_expert, world_size)
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.group = group
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        from .....distributed.models.moe.utils import (
+            _random_routing as rr_util)
+        from .utils import limit_by_capacity
+
+        topk_val, topk_idx, gate_score = super().forward(
+            x, return_all_scores=True)
+        s = gate_score.shape[0]
+        top1_idx = topk_idx.flatten()
+        c_e = nn.functional.one_hot(
+            top1_idx, self.tot_expert).astype("float32").sum(0) / s
+        m_e = nn.functional.softmax(gate_score, axis=1).mean(0)
+        loss = (c_e * m_e).mean() * (self.num_expert ** 2)
+        self.set_loss(loss)
+
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * x.shape[0])
+        _, _, topk_idx = limit_by_capacity(
+            topk_idx, self.num_expert, self.world_size, capacity,
+            group=self.group)
+
+        if self.random_routing:
+            rand_routing_prob = paddle.rand(shape=[s], dtype="float32")
+            topk_idx = rr_util(topk_idx, topk_val, rand_routing_prob)
+        return topk_val, topk_idx

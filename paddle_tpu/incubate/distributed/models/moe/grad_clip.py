@@ -1,0 +1,5 @@
+"""Reference incubate/distributed/models/moe/grad_clip.py — the
+MoE-aware global-norm clip lives in nn.clip (shared with incubate.moe)."""
+from .....nn.clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]

@@ -1,0 +1,111 @@
+"""MoELayer — reference incubate/distributed/models/moe/moe_layer.py:233
+(fastmoe lineage: gate -> scatter -> per-expert forward -> gather ->
+weighted combine).
+
+TPU-native dispatch: instead of the reference's dynamic MoEScatter/
+MoEGather (variable-length per-expert slices, which XLA cannot compile
+— shapes must be static), every expert runs over the full token batch
+and each token's outputs are combined with its gate weights, with
+non-selected experts masked to zero.  That is shape-static, jittable,
+and exactly equal numerically (pruned -1 assignments contribute 0,
+like the reference's zero-filled gather).  The cost is num_expert/top_k
+redundant expert FLOPs — acceptable for the API-compat layer with its
+handful of experts per device; the performance path for large E is
+models.moe.MoEMLP, whose stacked-weight einsum dispatch pads to
+capacity instead (see docs/distributed.md).
+
+Per-rank concepts (`moe_group`/`mp_group` with nranks > 1) raise with
+guidance: single-controller JAX holds the full expert set and shards it
+over the 'ep'/'tp' mesh axes via pjit/GSPMD instead of splitting state
+by process rank.
+"""
+from ..... import nn
+from .....nn import Layer
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Layer):
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, **kwargs):
+        super().__init__()
+        recompute_interval = kwargs.get("recompute_interval", 0)
+        if gate is None:
+            gate = dict()
+        assert isinstance(gate, (dict, BaseGate)), \
+            "gate config' type must be dict or an instance of BaseGate"
+        self.group = moe_group
+        self.world_size = 1
+        if self.group is not None:
+            self.world_size = self.group.nranks
+        if self.world_size > 1:
+            # per-rank expert hosting is a multi-controller concept: this
+            # layer's dense dispatch sees only its local experts, so
+            # tokens routed to ids >= len(experts) would silently drop.
+            # Single-controller JAX holds the FULL expert set and shards
+            # it over the 'ep' mesh axis via pjit/GSPMD instead.
+            raise NotImplementedError(
+                "moe_group with nranks > 1 hosts experts per rank; in "
+                "single-controller JAX construct MoELayer with the full "
+                "expert list and moe_group=None, then shard over the 'ep' "
+                "mesh axis with pjit (docs/distributed.md) — or use "
+                "models.moe.MoEMLP, the einsum-dispatch performance path")
+        assert experts is not None
+        self.num_expert = len(experts)
+        self.recompute_interval = recompute_interval
+        self.experts = experts
+        if mp_group is not None and mp_group.nranks > 1:
+            raise NotImplementedError(
+                "mp_group slicing is a per-rank concept; shard the "
+                "surrounding module over the 'tp' mesh axis with pjit "
+                "instead (docs/distributed.md)")
+        self.mp_group = mp_group
+        self.d_model = d_model
+
+        if isinstance(gate, dict):
+            self.top_k = gate.get("top_k", 2)
+            kind = gate.get("type", "gshard")
+            if kind == "naive" or kind is None:
+                gate = NaiveGate(d_model, num_expert=len(experts),
+                                 world_size=self.world_size,
+                                 topk=self.top_k)
+            elif kind == "gshard":
+                gate = GShardGate(d_model, num_expert=len(experts),
+                                  world_size=self.world_size,
+                                  topk=self.top_k, group=self.group)
+            elif kind == "switch":
+                self.top_k = 1
+                gate = SwitchGate(d_model, num_expert=len(experts),
+                                  world_size=self.world_size,
+                                  topk=1, group=self.group)
+            else:
+                raise AssertionError(
+                    "We only support naive gate, gshard gate and switch "
+                    f"gate, but you choose {kind} gate.")
+        elif isinstance(gate, NaiveGate):
+            self.top_k = gate.top_k
+        else:
+            raise TypeError("Unimplemented gate type: ", type(gate))
+        self.gate = gate
+
+    def forward(self, inp):
+        import paddle_tpu as paddle
+        assert len(inp.shape) == 3, "MoELayer input must be [batch, seq, d]"
+        origin_shape = inp.shape
+        x = inp.reshape([-1, origin_shape[-1]])
+
+        value, gate_idx = self.gate(x)          # [T, k] each
+
+        combined = paddle.zeros_like(x)
+        for e, expert in enumerate(self.experts):
+            sel = (gate_idx == e).astype(value.dtype)       # [T, k]
+            w = (value * sel).sum(-1)                       # [T]
+            if self.recompute_interval > 0 and self.training:
+                from paddle_tpu.distributed.fleet.utils import recompute
+                y = recompute(expert, x)
+            else:
+                y = expert(x)
+            combined = combined + y * w.unsqueeze(-1)
+
+        return combined.reshape(origin_shape)

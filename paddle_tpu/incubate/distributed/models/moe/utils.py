@@ -1,0 +1,68 @@
+"""Reference incubate/distributed/models/moe/utils.py (fastmoe
+count_by_gate / limit_by_capacity), on top of the vectorized routing
+ops in paddle_tpu.distributed.models.moe.utils.
+
+Single-controller semantics: with world_size == 1 (or outside a live
+shard_map axis) the local and global counts coincide; inside an 'ep'
+axis scope the count exchange rides lax collectives, mirroring how
+collective.all_reduce treats replicated arrays."""
+import paddle_tpu as paddle
+from paddle_tpu.distributed.models.moe.utils import (
+    _assign_pos, _limit_by_capacity, _number_count,
+    _prune_gate_by_capacity)
+
+__all__ = []
+
+
+def _exchange_counts(counts, group):
+    """fastmoe count exchange: a [world_size * num_expert] vector splits
+    into world_size chunks of num_expert and each chunk travels to its
+    rank — lax.all_to_all(tiled=True) over the expert-parallel axis is
+    exactly that shape.  Outside a live axis (eager single-controller,
+    counts already global) it is the identity."""
+    import jax
+
+    from paddle_tpu.distributed.mesh import current_axis_context
+    from paddle_tpu.framework.core import Tensor, apply_op
+
+    axis = group.axis if group is not None else "ep"
+    if axis not in current_axis_context():
+        return counts
+
+    def f(v):
+        return jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+    return apply_op(f, counts) if isinstance(counts, Tensor) else f(counts)
+
+
+def count_by_gate(gate, num_expert, world_size, require_pos=True,
+                  group=None):
+    total_expert_count = num_expert * world_size
+    with paddle.no_grad():
+        local_expert_count = _number_count(gate, total_expert_count)
+        if world_size > 1:
+            global_expert_count = _exchange_counts(local_expert_count, group)
+        else:
+            global_expert_count = local_expert_count
+        if not require_pos:
+            pos = None
+        else:
+            lec_cum = paddle.cumsum(local_expert_count, axis=0)
+            pos = _assign_pos(gate, lec_cum)
+    return pos, local_expert_count, global_expert_count
+
+
+def limit_by_capacity(topk_idx, num_expert, world_size, capacity,
+                      group=None):
+    with paddle.no_grad():
+        capacity = paddle.ones(shape=[num_expert], dtype="int32") * capacity
+        _, lec, gec = count_by_gate(topk_idx, num_expert, world_size,
+                                    require_pos=False, group=group)
+        new_gec = _limit_by_capacity(gec, capacity, world_size)
+        if world_size > 1:
+            new_lec = _exchange_counts(new_gec, group)
+        else:
+            new_lec = new_gec
+        topk_idx = _prune_gate_by_capacity(topk_idx, new_lec, num_expert,
+                                           world_size)
+    return new_lec, new_gec, topk_idx

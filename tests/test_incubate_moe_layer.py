@@ -1,0 +1,176 @@
+"""incubate.distributed.models.moe — the reference's user-facing
+MoELayer + gate family (fastmoe lineage), dispatched shape-statically
+(dense masked combine) for XLA."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.distributed.models.moe import (
+    BaseGate, ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate,
+    SwitchGate)
+from paddle_tpu.incubate.distributed.models.moe.utils import (
+    count_by_gate, limit_by_capacity)
+
+
+class Expert(nn.Layer):
+    def __init__(self, d, h):
+        super().__init__()
+        self.htoh4 = nn.Linear(d, h)
+        self.h4toh = nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.h4toh(paddle.nn.functional.relu(self.htoh4(x)))
+
+
+def _make(gate, n_expert=4, d=16):
+    paddle.seed(0)
+    experts = nn.LayerList([Expert(d, 32) for _ in range(n_expert)])
+    return MoELayer(d_model=d, experts=experts, gate=gate)
+
+
+def test_naive_gate_combine_matches_manual():
+    """The dense masked combine must equal the definition: for each
+    token, sum over its top-k experts of raw gate value * expert(x)."""
+    layer = _make({"type": "naive", "top_k": 2})
+    layer.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6, 16).astype("float32"))
+    out = layer(x).numpy()
+
+    flat = paddle.to_tensor(x.numpy().reshape(-1, 16))
+    val, idx = layer.gate(flat)
+    val, idx = val.numpy(), idx.numpy()
+    expert_outs = [e(flat).numpy() for e in layer.experts]
+    want = np.zeros_like(flat.numpy())
+    for t in range(flat.shape[0]):
+        for k in range(2):
+            want[t] += val[t, k] * expert_outs[idx[t, k]][t]
+    np.testing.assert_allclose(out.reshape(-1, 16), want, rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_gshard_and_switch_train_step():
+    for cfg, gate_cls in (({"type": "gshard", "top_k": 2}, GShardGate),
+                          ({"type": "switch"}, SwitchGate)):
+        layer = _make(cfg)
+        assert isinstance(layer.gate, gate_cls)
+        layer.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, 16).astype("float32"))
+        out = layer(x)
+        aux = layer.gate.get_loss()
+        assert aux is not None and float(aux.numpy()) >= 0
+        assert layer.gate.get_loss() is None       # cleared on read
+        loss = (out ** 2).mean() + (aux if aux is not None else 0.0)
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+        g = layer.experts[0].htoh4.weight.grad
+        assert g is None or np.isfinite(g.numpy()).all()
+
+
+def test_gate_instance_and_errors():
+    layer = _make(NaiveGate(16, 4, 1, topk=2))
+    assert layer.top_k == 2
+    with pytest.raises(TypeError):
+        _make(BaseGate(4, 1))
+    with pytest.raises(AssertionError, match="only support"):
+        _make({"type": "expert_choice"})
+
+
+def test_capacity_pruning_2d_topk():
+    """limit_by_capacity over [T, k] top-k indices (the gates' shape):
+    over-capacity assignments prune to -1 in row-major token order."""
+    idx = paddle.to_tensor(
+        np.array([[0, 1], [0, 1], [0, 2], [0, 3]], "int32"))
+    new_lec, new_gec, pruned = limit_by_capacity(idx, 4, 1, capacity=2)
+    p = pruned.numpy()
+    assert p.shape == (4, 2)
+    # expert 0 requested 4 times, capacity 2: first two kept
+    assert list(p[:, 0]) == [0, 0, -1, -1]
+    assert list(p[:, 1]) == [1, 1, 2, 3]
+    np.testing.assert_array_equal(new_gec.numpy(), [2, 2, 1, 1])
+
+    pos, lec, gec = count_by_gate(idx, 4, 1)
+    np.testing.assert_array_equal(lec.numpy(), [4, 2, 1, 1])
+    assert pos.numpy().shape == (8,)
+
+
+def test_moe_layer_under_jit():
+    """Dense masked dispatch is shape-static: the whole layer jits."""
+    import jax
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.nn.layer_base import functional_call, state_pytree
+
+    layer = _make({"type": "naive", "top_k": 2})
+    layer.eval()
+    params = state_pytree(layer)
+
+    def pure(p, a):
+        with functional_call(layer, p):
+            return layer(Tensor(a))._value
+
+    x = np.random.RandomState(2).randn(2, 4, 16).astype("float32")
+    got = jax.jit(pure)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), layer(paddle.to_tensor(x)).numpy(), rtol=2e-5,
+        atol=1e-5)
+
+
+def test_grad_clip_reexport():
+    from paddle_tpu.nn.clip import (
+        ClipGradForMOEByGlobalNorm as inner)
+    assert ClipGradForMOEByGlobalNorm is inner
+
+
+def test_per_rank_groups_rejected_with_guidance():
+    from paddle_tpu.distributed.collective import Group
+    experts = nn.LayerList([Expert(8, 16) for _ in range(2)])
+    with pytest.raises(NotImplementedError, match="ep"):
+        MoELayer(d_model=8, experts=experts,
+                 gate={"type": "naive"}, moe_group=Group(0, 2, axis="ep"))
+    with pytest.raises(NotImplementedError, match="tp"):
+        MoELayer(d_model=8, experts=experts, gate={"type": "naive"},
+                 mp_group=Group(0, 2, axis="tp"))
+
+
+def test_count_exchange_over_real_ep_axis():
+    """fastmoe count exchange semantics over an actual 2-device
+    shard_map: each rank's [W*E] counts split into W chunks of E;
+    chunk j travels to rank j."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from paddle_tpu.distributed.collective import Group
+    from paddle_tpu.distributed.mesh import axis_scope
+    from paddle_tpu.incubate.distributed.models.moe.utils import (
+        _exchange_counts)
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("ep",))
+    group = Group(0, 2, axis="ep")
+    E = 3
+    # rank r counts: [r*10+0 .. r*10+5] — chunk j of rank r is
+    # [r*10 + j*E ...]; after exchange rank r holds chunk r of everyone
+    local = np.stack([np.arange(6) + r * 10 for r in range(2)]) \
+        .astype(np.int32)
+
+    def body(c):
+        with axis_scope("ep"):
+            return _exchange_counts(c.reshape(-1), group).reshape(1, -1)
+
+    out = jax.jit(shard_map(body, mesh=mesh, in_specs=P("ep"),
+                            out_specs=P("ep")))(local)
+    out = np.asarray(out)
+    # rank 0 gets chunk 0 of rank0 + chunk 0 of rank1
+    np.testing.assert_array_equal(out[0], [0, 1, 2, 10, 11, 12])
+    np.testing.assert_array_equal(out[1], [3, 4, 5, 13, 14, 15])
+    # outside a live axis: identity
+    np.testing.assert_array_equal(
+        np.asarray(_exchange_counts(jnp.arange(6), group)), np.arange(6))
