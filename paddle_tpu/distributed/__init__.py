@@ -271,3 +271,7 @@ class QueueDataset(InMemoryDataset):
 __all__ += ["ParallelMode", "split", "gloo_init_parallel_env", "gloo_barrier",
             "gloo_release", "CountFilterEntry", "ShowClickEntry",
             "ProbabilityEntry", "InMemoryDataset", "QueueDataset"]
+
+from . import metric  # noqa: F401,E402  (PS metric deflection)
+from . import passes  # noqa: F401,E402  (pass framework + deflections)
+from . import ps  # noqa: F401,E402  (PS runtime deflection)

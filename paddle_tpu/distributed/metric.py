@@ -1,0 +1,31 @@
+"""Reference python/paddle/distributed/metric/metrics.py — yaml-driven
+metric tables for the parameter-server runtime (init_metric wires C++
+metric instances into the PS trainer; print_metric/print_auc read them
+back).
+
+The PS runtime is deflected on TPU (docs/distributed.md): embedding
+tables shard over the mesh and metric aggregation is
+distributed.fleet.metrics over collectives.  These entry points exist
+so migrating imports resolve, and fail with that mapping instead of an
+AttributeError."""
+
+__all__ = ["init_metric", "print_metric", "print_auc"]
+
+_MSG = ("the parameter-server metric runtime is replaced on TPU: compute "
+        "shard-local stats with paddle_tpu.metric.Auc/Accuracy and "
+        "aggregate with paddle_tpu.distributed.fleet.metrics "
+        "(sum/max/min/auc/mae/rmse/mse/acc over mesh collectives)")
+
+
+def init_metric(metric_ptr, metric_yaml_path, cmatch_rank_var="",
+                mask_var="", uid_var="", phase=-1, cmatch_rank_group="",
+                ignore_rank=False, bucket_size=1000000):
+    raise NotImplementedError(_MSG)
+
+
+def print_metric(metric_ptr, name):
+    raise NotImplementedError(_MSG)
+
+
+def print_auc(metric_ptr, is_day, phase="all"):
+    raise NotImplementedError(_MSG)

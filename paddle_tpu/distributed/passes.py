@@ -1,0 +1,127 @@
+"""Reference python/paddle/distributed/passes/ (pass_base.py new_pass /
+PassManager / PassContext + the auto_parallel_* program passes).
+
+The pass FRAMEWORK is real here (registration, ordering, context); the
+reference's program-rewriting passes themselves are compile-time
+behaviors on TPU — XLA/GSPMD performs the rewrite the pass encoded, or
+the framework exposes it as a first-class knob.  Applying one of those
+passes therefore raises with its TPU-native replacement spelled out,
+instead of silently no-op-ing on a Program that doesn't exist.
+
+    new_pass("fuse_all_reduce")     -> XLA all-reduce combiner (automatic)
+    new_pass("auto_parallel_amp")   -> amp.auto_cast / amp.decorate
+    new_pass("auto_parallel_fp16")  -> amp O2 (dtype="float16")
+    new_pass("auto_parallel_recompute") -> remat policies / fleet recompute
+    new_pass("auto_parallel_sharding")  -> mesh axes + shard_params
+    new_pass("auto_parallel_gradient_merge") -> Trainer(grad_accum_steps=N)
+"""
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "register_pass"]
+
+_PASS_REGISTRY = {}
+
+
+class PassContext:
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    name = None
+
+    def __init__(self, attrs=None):
+        self._attrs = dict(attrs or {})
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def check_before(self):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        return self._apply_impl(main_programs, startup_programs,
+                                context or PassContext())
+
+    def _apply_impl(self, mains, startups, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+class _DeflectedPass(PassBase):
+    replacement = ""
+
+    def _apply_impl(self, mains, startups, context):
+        raise NotImplementedError(
+            f"pass {self.name!r} is a fluid Program rewrite; on TPU use "
+            f"{self.replacement} — XLA/GSPMD applies the equivalent "
+            "transform at compile time")
+
+
+def _deflect(name, replacement):
+    cls = type(f"_Pass_{name}", (_DeflectedPass,),
+               {"name": name, "replacement": replacement})
+    _PASS_REGISTRY[name] = cls
+    return cls
+
+
+_deflect("fuse_all_reduce",
+         "nothing: the XLA all-reduce combiner fuses collectives")
+_deflect("fuse_optimizer", "nothing: XLA fuses the optimizer update")
+_deflect("auto_parallel_amp", "paddle_tpu.amp.auto_cast / amp.decorate")
+_deflect("auto_parallel_fp16",
+         "paddle_tpu.amp.decorate(level='O2', dtype='float16')")
+_deflect("auto_parallel_bf16",
+         "paddle_tpu.amp.decorate(level='O2', dtype='bfloat16')")
+_deflect("auto_parallel_recompute",
+         "model remat policies / distributed.fleet.utils.recompute")
+_deflect("auto_parallel_sharding",
+         "distributed.build_mesh + shard_params (GSPMD)")
+_deflect("auto_parallel_gradient_merge",
+         "distributed.trainer.Trainer(grad_accum_steps=N)")
+_deflect("ps_trainer_pass",
+         "distributed.ShardedEmbedding (docs/distributed.md)")
+_deflect("ps_server_pass",
+         "distributed.ShardedEmbedding (docs/distributed.md)")
+
+
+def new_pass(name, pass_attrs=None):
+    if name not in _PASS_REGISTRY:
+        raise ValueError(f"unknown pass {name!r}; registered: "
+                         f"{sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name](pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return ctx

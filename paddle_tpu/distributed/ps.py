@@ -1,0 +1,22 @@
+"""Reference python/paddle/distributed/ps/ — the parameter-server
+runtime.  Deliberately deflected on TPU (accepted design, see
+docs/distributed.md): recsys-scale embedding tables shard over the
+device mesh via distributed.ShardedEmbedding, the data path keeps
+InMemoryDataset/QueueDataset shims, and metric aggregation is
+fleet.metrics.  Importing resolves; instantiating explains the
+mapping."""
+
+__all__ = ["TheOnePSRuntime"]
+
+_MSG = ("the parameter-server runtime is replaced on TPU by mesh-sharded "
+        "embedding tables: use distributed.ShardedEmbedding with a "
+        "normal DataLoader (docs/distributed.md 'PS-mode mapping')")
+
+
+class TheOnePSRuntime:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_MSG)
+
+
+def __getattr__(name):
+    raise AttributeError(f"paddle_tpu.distributed.ps.{name}: {_MSG}")

@@ -17,3 +17,6 @@ __all__ = ["nn", "checkpoint", "autotune", "asp", "autograd", "operators", "opti
            "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage"]
 
 
+
+from . import distributed  # noqa: F401,E402
+from . import passes  # noqa: F401,E402

@@ -84,13 +84,17 @@ class SwitchGate(NaiveGate):
             group=self.group)
 
         # load-balance loss over the post-prune assignment (reference
-        # switch_gate.py:62-76): fraction of tokens vs mean prob
+        # switch_gate.py:62-76): fraction of tokens vs mean prob, both
+        # normalized by the KEPT token count (valid_idx.numel() there) —
+        # under heavy pruning the loss must grow, that's its job.
+        # kept.sum() is shape-static, so this stays jittable.
         kept = (top1_idx.reshape([-1]) > -1).astype("float32")
+        n_kept = paddle.clip(kept.sum(), min=1.0)
         onehot = nn.functional.one_hot(
             paddle.clip(top1_idx.reshape([-1]), 0, self.tot_expert - 1),
             self.tot_expert) * kept.unsqueeze(-1)
-        fraction_expert = onehot.sum(0) / max(int(inp.shape[0]), 1)
-        prob_expert = score.sum(0) / max(int(inp.shape[0]), 1)
+        fraction_expert = onehot.sum(0) / n_kept
+        prob_expert = score.sum(0) / n_kept
         loss = (fraction_expert * prob_expert).sum() * self.tot_expert
         self.set_loss(loss)
         return top1_score, top1_idx

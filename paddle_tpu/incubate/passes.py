@@ -1,0 +1,20 @@
+"""Reference python/paddle/incubate/passes/ (ir.py: RegisterPass and
+fuse-pattern descriptions).  On TPU the IR is StableHLO and operator
+fusion is XLA's job — custom fuse patterns are expressed as Pallas
+kernels (ops/) or custom ops (incubate.operators) instead of graph
+rewrites, so RegisterPass resolves but explains that mapping."""
+
+__all__ = ["ir"]
+
+
+class _IRModule:
+    @staticmethod
+    def RegisterPass(function=None, input_specs=None):
+        raise NotImplementedError(
+            "IR fuse passes rewrite fluid graphs; on TPU write the fused "
+            "computation as a Pallas kernel (paddle_tpu.ops) or a custom "
+            "op (incubate.operators) — XLA fuses elementwise chains "
+            "automatically")
+
+
+ir = _IRModule()

@@ -198,3 +198,21 @@ class Predictor:
 
 def create_predictor(config: Config):
     return Predictor(config)
+
+
+class _ContribUtils:
+    """Reference inference/contrib/utils: copy_tensor(dst, src)."""
+
+    @staticmethod
+    def copy_tensor(dst, src):
+        from .framework.core import Tensor
+        v = src._value if isinstance(src, Tensor) else src
+        dst.set_value(np.asarray(v))
+        return dst
+
+
+class _Contrib:
+    utils = _ContribUtils()
+
+
+contrib = _Contrib()

@@ -1145,3 +1145,7 @@ def _static_nn_extend():
 
 
 _static_nn_extend()
+
+
+from . import amp  # noqa: F401,E402  (reference static.amp surface)
+from . import sparsity  # noqa: F401,E402  (reference static.sparsity surface)

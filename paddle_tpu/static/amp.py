@@ -1,0 +1,161 @@
+"""Reference python/paddle/static/amp/__init__.py (fluid
+mixed_precision): the static-graph AMP surface, mapped onto the eager
+amp module — on TPU the precision policy is applied while TRACING (the
+same trace serves eager and static/jit), so `decorate`/`fp16_guard`
+delegate to amp.auto_cast machinery rather than rewriting a Program.
+
+`cast_model_to_fp16` / `cast_parameters_to_fp16` accept a Layer (the
+dygraph object our static mode traces); raw fluid Programs don't exist
+here.
+"""
+import contextlib
+
+from ..amp import auto_cast
+from ..amp import decorate as _amp_decorate
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "OptimizerWithMixedPrecision", "fp16_guard",
+           "cast_model_to_fp16", "cast_parameters_to_fp16", "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """Reference fluid/contrib/mixed_precision/fp16_lists.py: the
+    white/black op-name lists auto_cast consults."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    """Reference fluid OptimizerWithMixedPrecision: minimize() scales
+    the loss, backprops the scaled value, unscales gradients, skips
+    non-finite steps, and updates the loss scale — all through the
+    eager GradScaler, which is the same machinery our trace uses."""
+
+    def __init__(self, optimizer, scaler, amp_lists=None,
+                 use_pure_fp16=False):
+        self._optimizer = optimizer
+        self._scaler = scaler
+        self._amp_lists = amp_lists
+        self._use_pure_fp16 = use_pure_fp16
+
+    def backward(self, loss, **kw):
+        scaled = self._scaler.scale(loss)
+        scaled.backward()
+        return []
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.backward(loss)
+        self._scaler.step(self._optimizer)   # unscale + nonfinite skip
+        self._scaler.update()
+        params = getattr(self._optimizer, "_parameter_list", None) or []
+        return None, [(p, p.grad) for p in params]
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        return None   # parameters cast at decorate time on this backend
+
+    def get_loss_scaling(self):
+        return self._scaler.get_init_loss_scaling()
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=False):
+    """Reference mixed_precision.decorate: returns an
+    OptimizerWithMixedPrecision whose minimize() runs the full
+    scale -> backward -> unscale -> skip-nonfinite -> rescale loop
+    (dynamic scaling is disabled for bf16, like the reference's bf16
+    path — bf16's exponent range needs none)."""
+    from ..amp import GradScaler
+    scaler = GradScaler(
+        enable=use_dynamic_loss_scaling and not use_bf16,
+        init_loss_scaling=init_loss_scaling,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf)
+    return OptimizerWithMixedPrecision(optimizer, scaler,
+                                       amp_lists=amp_lists,
+                                       use_pure_fp16=use_pure_fp16)
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Reference fp16_guard: marks a region to run in low precision
+    under pure-fp16 mode; here it opens an O2 autocast scope."""
+    with auto_cast(True, level="O2", dtype="float16"):
+        yield
+
+
+def cast_model_to_fp16(model, amp_lists=None, use_fp16_guard=True):
+    from ..nn import Layer
+    if isinstance(model, Layer):
+        return model.astype("float16")
+    raise TypeError(
+        "cast_model_to_fp16 takes the nn.Layer the static trace runs; "
+        "fluid Programs don't exist on the TPU backend")
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, model=None):
+    from ..nn import Layer
+    target = model if model is not None else program
+    if isinstance(target, Layer):
+        return target.astype("float16")
+    raise TypeError(
+        "cast_parameters_to_fp16 takes the nn.Layer the static trace "
+        "runs (model=...); fluid Programs don't exist on the TPU backend")
+
+
+class _BF16Module:
+    """Reference static/amp/bf16: same decorate/guard surface at
+    bfloat16 — the TPU-native dtype, where no loss scaling is needed."""
+
+    AutoMixedPrecisionListsBF16 = AutoMixedPrecisionLists
+
+    @staticmethod
+    def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                      use_bf16_guard=None):
+        return decorate(optimizer, amp_lists=amp_lists, use_bf16=True,
+                        use_pure_fp16=use_pure_bf16)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def bf16_guard():
+        with auto_cast(True, level="O2", dtype="bfloat16"):
+            yield
+
+    @staticmethod
+    def cast_model_to_bf16(model, amp_lists=None, use_bf16_guard=True):
+        from ..nn import Layer
+        if isinstance(model, Layer):
+            return model.bfloat16()
+        raise TypeError("cast_model_to_bf16 takes an nn.Layer")
+
+    @staticmethod
+    def cast_parameters_to_bf16(place=None, program=None, scope=None,
+                                to_bf16_var_names=None, model=None):
+        from ..nn import Layer
+        target = model if model is not None else program
+        if isinstance(target, Layer):
+            return target.bfloat16()
+        raise TypeError("cast_parameters_to_bf16 takes an nn.Layer "
+                        "(model=...)")
+
+
+bf16 = _BF16Module()

@@ -1,0 +1,8 @@
+"""Reference python/paddle/static/sparsity/__init__.py — the static ASP
+surface re-exports the same five functions as incubate.asp (the
+reference routes both through fluid.contrib.sparsity)."""
+from ..incubate.asp import (calculate_density, decorate, prune_model,
+                            reset_excluded_layers, set_excluded_layers)
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]

@@ -1,0 +1,8 @@
+"""Reference python/paddle/text/datasets/ — the dataset classes live in
+paddle_tpu.text; this submodule preserves the reference import path
+(`from paddle.text.datasets import Conll05st`)."""
+from . import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14,
+               WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,0 +1,115 @@
+"""Reference namespace paths that must resolve for a migrating user —
+real implementations where they map onto the TPU stack, documented
+deflections (clear NotImplementedError naming the replacement) where
+the fluid/PS machinery is compile-time behavior here."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_all_reference_namespaces_resolve():
+    for path in ("cost_model", "device.cuda", "distributed.metric",
+                 "distributed.passes", "distributed.ps", "distributed.models.moe",
+                 "incubate.nn.functional", "incubate.optimizer.functional",
+                 "incubate.passes", "incubate.distributed.models.moe",
+                 "inference.contrib.utils", "static.amp", "static.nn",
+                 "static.sparsity", "text.datasets", "utils.cpp_extension",
+                 "reader", "onnx"):
+        mod = paddle
+        for part in path.split("."):
+            mod = getattr(mod, part)
+
+
+def test_static_amp_maps_to_eager_amp():
+    from paddle_tpu.static.amp import (AutoMixedPrecisionLists, bf16,
+                                       decorate, fp16_guard)
+    opt = decorate(paddle.optimizer.SGD(learning_rate=0.1),
+                   init_loss_scaling=1024.0)
+    assert opt.get_loss_scaling() == 1024.0
+    # bf16 decorate disables loss scaling (bf16 needs none)
+    opt2 = bf16.decorate_bf16(paddle.optimizer.SGD(learning_rate=0.1))
+    assert opt2._scaler._enable is False
+    lists = AutoMixedPrecisionLists(custom_white_list=["matmul"])
+    assert "matmul" in lists.white_list
+    with fp16_guard():
+        pass
+    m = paddle.nn.Linear(2, 2)
+    from paddle_tpu.static.amp import cast_model_to_fp16
+    cast_model_to_fp16(m)
+    assert "float16" in str(m.weight.dtype)
+
+
+def test_static_amp_minimize_scales_and_unscales():
+    """The decorated minimize() must produce the SAME update as an
+    unscaled step (scale -> backward -> unscale) and skip non-finite
+    steps — the reference OptimizerWithMixedPrecision loop."""
+    from paddle_tpu.static.amp import decorate
+
+    w = paddle.framework.Parameter(np.full((2,), 3.0, "float32"))
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = decorate(inner, init_loss_scaling=256.0)
+    loss = (w * w).sum()
+    opt.minimize(loss)
+    # d(loss)/dw = 2w = 6; step = 3 - 0.1*6 = 2.4 — NOT 3 - 0.1*6*256
+    np.testing.assert_allclose(w.numpy(), 2.4, rtol=1e-6)
+
+    # a non-finite loss must skip the update and shrink the scale
+    before = w.numpy().copy()
+    scale0 = opt._scaler._scale
+    bad = (w * float("nan")).sum()
+    opt.minimize(bad)
+    np.testing.assert_array_equal(w.numpy(), before)
+    assert opt._scaler._scale <= scale0
+
+
+def test_static_sparsity_is_asp():
+    from paddle_tpu.incubate import asp
+    from paddle_tpu.static import sparsity
+    assert sparsity.prune_model is asp.prune_model
+    assert sparsity.calculate_density is asp.calculate_density
+
+
+def test_pass_framework_and_deflections():
+    from paddle_tpu.distributed.passes import (PassBase, PassContext,
+                                               PassManager, new_pass,
+                                               register_pass)
+    p = new_pass("auto_parallel_gradient_merge", {"k_steps": 4})
+    assert p.get_attr("k_steps") == 4
+    with pytest.raises(NotImplementedError, match="grad_accum_steps"):
+        p.apply(None)
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("nonexistent")
+
+    @register_pass("my_pass")
+    class MyPass(PassBase):
+        def _apply_impl(self, mains, startups, context):
+            context.set_attr("ran", True)
+            return mains
+
+    ctx = PassManager([new_pass("my_pass")]).apply("prog")
+    assert ctx.get_attr("ran") is True
+
+
+def test_ps_and_ir_deflections_name_replacement():
+    from paddle_tpu.distributed import ps
+    with pytest.raises(NotImplementedError, match="ShardedEmbedding"):
+        ps.TheOnePSRuntime()
+    with pytest.raises(NotImplementedError, match="fleet.metrics"):
+        paddle.distributed.metric.init_metric(None, "m.yaml")
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        paddle.incubate.passes.ir.RegisterPass(lambda: None)
+
+
+def test_inference_contrib_copy_tensor():
+    t1 = paddle.to_tensor(np.zeros(3, "float32"))
+    t2 = paddle.to_tensor(np.arange(3, dtype="float32"))
+    out = paddle.inference.contrib.utils.copy_tensor(t1, t2)
+    np.testing.assert_array_equal(out.numpy(), [0, 1, 2])
+    assert out is t1
+
+
+def test_text_datasets_path():
+    from paddle_tpu.text.datasets import WMT14, Conll05st  # noqa: F401
+    import paddle_tpu.text as text
+    assert text.datasets.Conll05st is text.Conll05st
