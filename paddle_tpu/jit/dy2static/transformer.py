@@ -28,9 +28,14 @@ the loop.  A return whose VALUE is only defined under a traced loop
 carry still needs a pre-loop tensor value (lax carries are shape-static)
 — the converter says so explicitly.
 
+A `with ctx: ... return e` tail rides WHOLE into its branch fn (the
+context manager is never split), so returns inside with-blocks
+functionalize too.
+
 Deliberately NOT functionalized (left as plain Python, which still works
 for concrete conditions and raises jax's tracer error for traced ones):
-jumps inside with/try blocks, `global`/`nonlocal`, loop-`else`.
+break/continue inside with/try blocks, returns inside try,
+`global`/`nonlocal`, loop-`else`.
 """
 import ast
 import copy
@@ -297,7 +302,9 @@ def _has_scope_escape(stmts):
 
 def _ends_in_return(stmts):
     """Every execution path through `stmts` ends in `return`?  (tail
-    return, or an if whose both branches end in return)."""
+    return, an if whose both branches end in return, or a with whose
+    body does — the with-block travels WHOLE into a branch fn, so its
+    context-manager semantics are untouched)."""
     if not stmts:
         return False
     last = stmts[-1]
@@ -305,6 +312,8 @@ def _ends_in_return(stmts):
         return True
     if isinstance(last, ast.If) and last.orelse:
         return _ends_in_return(last.body) and _ends_in_return(last.orelse)
+    if isinstance(last, ast.With):
+        return _ends_in_return(last.body)
     return False
 
 

@@ -53,8 +53,10 @@ class OptimizerWithMixedPrecision:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         self.backward(loss)
-        self._scaler.step(self._optimizer)   # unscale + nonfinite skip
-        self._scaler.update()
+        # GradScaler.step() unscales, skips non-finite steps AND updates
+        # the dynamic scale — calling update() again here would clear the
+        # nan counter every step and freeze the scale
+        self._scaler.step(self._optimizer)
         params = getattr(self._optimizer, "_parameter_list", None) or []
         return None, [(p, p.grad) for p in params]
 
@@ -83,8 +85,13 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
     (dynamic scaling is disabled for bf16, like the reference's bf16
     path — bf16's exponent range needs none)."""
     from ..amp import GradScaler
+    # bf16 needs no scaling at all (enable=False); fp16 with static
+    # scaling keeps the CONSTANT init_loss_scaling applied+unscaled
+    # (use_dynamic_loss_scaling=False), matching the reference's
+    # static-scale mode — underflow protection is the whole point
     scaler = GradScaler(
-        enable=use_dynamic_loss_scaling and not use_bf16,
+        enable=not use_bf16,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
         init_loss_scaling=init_loss_scaling,
         incr_ratio=incr_ratio, decr_ratio=decr_ratio,
         incr_every_n_steps=incr_every_n_steps,

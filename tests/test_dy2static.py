@@ -1241,3 +1241,20 @@ def test_nontensor_return_value_diagnostic_translated():
         paddle.jit.to_static(f)(paddle.to_tensor(np.ones(2, "float32")))
     assert "_retv_" not in str(ei.value)
     assert "return value" in str(ei.value)
+
+
+def test_early_return_inside_with_block():
+    """`with ctx: return e` rides whole into the branch fn (the context
+    manager is never split), so traced conditions around it lower to
+    lax.cond."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            with paddle.no_grad():
+                return x * 2.0
+        return x + 1.0
+
+    for v in (1.0, -3.0):
+        x = paddle.to_tensor(np.asarray([v, v], "float32"))
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.asarray([v, v], "float32")))._value),
+            np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)

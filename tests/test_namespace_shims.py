@@ -54,13 +54,29 @@ def test_static_amp_minimize_scales_and_unscales():
     # d(loss)/dw = 2w = 6; step = 3 - 0.1*6 = 2.4 — NOT 3 - 0.1*6*256
     np.testing.assert_allclose(w.numpy(), 2.4, rtol=1e-6)
 
-    # a non-finite loss must skip the update and shrink the scale
+    # non-finite losses must skip the update, and decr_every_n_nan_or_inf
+    # (=2) consecutive NaNs must STRICTLY shrink the dynamic scale —
+    # `<=` would pass even with the scale frozen
     before = w.numpy().copy()
     scale0 = opt._scaler._scale
-    bad = (w * float("nan")).sum()
-    opt.minimize(bad)
+    for _ in range(2):
+        bad = (w * float("nan")).sum()
+        opt.clear_grad()
+        opt.minimize(bad)
     np.testing.assert_array_equal(w.numpy(), before)
-    assert opt._scaler._scale <= scale0
+    assert opt._scaler._scale < scale0
+
+    # static-scaling mode: constant scale still applied+unscaled (the
+    # underflow protection is the point), never adjusted
+    w2 = paddle.framework.Parameter(np.full((2,), 3.0, "float32"))
+    opt_s = decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                          parameters=[w2]),
+                     init_loss_scaling=128.0,
+                     use_dynamic_loss_scaling=False)
+    opt_s.minimize((w2 * w2).sum())
+    np.testing.assert_allclose(w2.numpy(), 2.4, rtol=1e-6)  # unscaled step
+    assert opt_s._scaler._scale == 128.0
+    assert opt_s._scaler.is_enable()
 
 
 def test_static_sparsity_is_asp():
