@@ -369,6 +369,49 @@ def run_yolov3(batch_size=16, size=320, steps=10):
     return imgs_s, mfu
 
 
+def run_crnn(batch_size=64, width=320, steps=10):
+    """BASELINE.json config 4, OCR half — CRNN recognition (CTC) train
+    step at PP-OCR's 32xW crop shape, imgs/sec/chip."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.vision.models import CRNN
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = CRNN(num_classes=97, data_format="NHWC")
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                accumulator_dtype="bfloat16")
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["image"]))
+        return m.loss(logits, paddle.to_tensor(b["label"]),
+                      paddle.to_tensor(b["length"]))
+
+    trainer = Trainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    # CTC needs T (=width/4 columns) comfortably above the label length
+    max_len = max(2, min(24, width // 16))
+    lens = rng.randint(max(1, max_len // 4), max_len + 1, batch_size)
+    labels = rng.randint(1, 97, (batch_size, max_len))
+    labels *= (np.arange(max_len)[None, :] < lens[:, None])
+    batch = _stage({
+        "image": rng.randn(batch_size, 32, width, 3).astype("float32"),
+        "label": labels.astype("int32"),
+        "length": lens.astype("int32")})
+    fwd = _fwd_flops(trainer, batch)
+    dt = _measure(trainer, batch, steps, "crnn")
+    imgs_s = batch_size / dt
+    mfu = 3 * fwd / batch_size * imgs_s / chip_peak_flops() if fwd else 0.0
+    log(f"crnn: {dt*1e3:.1f} ms/step, {imgs_s:.0f} imgs/s, MFU={mfu:.3f} "
+        f"(fwd {fwd/batch_size/1e9:.2f} GFLOP/img)")
+    return imgs_s, mfu
+
+
 def run_gpt_moe(batch_size=8, seq_len=1024, steps=10, gate=None):
     """BASELINE.json config 5: GPT-MoE (top-2 routed experts), tokens/s/chip.
     Single-chip: measures the dispatch/combine einsums + expert FFs; the ep
@@ -795,6 +838,14 @@ def main():
             extras["yolov3_mfu"] = round(mfu, 4)
         except Exception as e:
             _record_failure(extras, "yolov3_error", "yolov3", e)
+    if only in (None, "yolo", "ocr"):
+        try:
+            with _alarm(600, "crnn"):
+                imgs_s, mfu = run_crnn()
+            extras["crnn_imgs_per_sec_per_chip"] = round(imgs_s, 1)
+            extras["crnn_mfu"] = round(mfu, 4)
+        except Exception as e:
+            _record_failure(extras, "crnn_error", "crnn", e)
     if only in (None, "moe"):
         try:
             with _alarm(900, "gpt_moe"):

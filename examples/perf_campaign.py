@@ -260,6 +260,30 @@ def run_yolo():
         record({"config": "yolo_stage_done"})
 
 
+def run_ocr():
+    """The OCR half of BASELINE config 4: CRNN recognition at PP-OCR's
+    32xW crop shape.  Own stage marker — a wedge after the yolo sweep
+    must not bank this stage as done."""
+    import bench
+    ok = 0
+    for bs in (64, 128):
+        if banked(config="crnn", bs=bs):
+            ok += 1
+            continue
+        try:
+            imgs_s, mfu = bench.run_crnn(batch_size=bs)
+            record({"config": "crnn", "bs": bs,
+                    "imgs_s": round(imgs_s, 1), "mfu": round(mfu, 4)})
+            ok += 1
+        except Exception as e:
+            record({"config": "crnn", "bs": bs,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            import gc
+            gc.collect()
+    if ok:
+        record({"config": "ocr_stage_done"})
+
+
 def run_moe():
     """First-ever on-chip GPT-MoE numbers (BASELINE config 5): bs sweep
     on the default top-k gate, plus one gshard trial."""
@@ -354,6 +378,8 @@ def main():
         run_flash_tune()
     if which in ("yolo", "all"):
         run_yolo()
+    if which in ("ocr", "crnn", "all"):
+        run_ocr()
     if which in ("moe", "all"):
         run_moe()
     if which in ("gpt", "all"):

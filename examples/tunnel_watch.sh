@@ -21,19 +21,34 @@ cd "$(dirname "$0")/.."
 
 have() { grep -q "\"config\": \"$1_stage_done\"" perf_campaign_results.jsonl 2>/dev/null; }
 
+# never collide with the driver's end-of-round bench: stop watching
+# after MAX_WATCH_S (default 8h) or when a STOP_WATCH file appears
+START_TS=$(date +%s)
+MAX_WATCH_S=${MAX_WATCH_S:-28800}
+
+# deadline is re-checked before EVERY stage launch, not just per loop
+# iteration — a probe success minutes before the deadline must not run
+# a multi-hour campaign into the driver's end-of-round bench
+alive() { [ ! -e STOP_WATCH ] && [ $(( $(date +%s) - START_TS )) -le "$MAX_WATCH_S" ]; }
+
 while true; do
+  if ! alive; then
+    echo "$(date -u +%FT%TZ) watch deadline/stop reached — exiting" >> tunnel_watch.log
+    break
+  fi
   if timeout 180 python examples/tunnel_probe.py --quick 2>/dev/null | grep -q "PROBE OK"; then
     echo "$(date -u +%FT%TZ) tunnel UP — launching perf campaign" >> tunnel_watch.log
-    have resnet || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1
-    have bert   || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1
-    have yolo   || timeout 2400 python examples/perf_campaign.py yolo   >> tunnel_watch.log 2>&1
-    have moe    || timeout 2400 python examples/perf_campaign.py moe    >> tunnel_watch.log 2>&1
-    grep -q '"config": "resnet50_hlo_audit"' perf_campaign_results.jsonl 2>/dev/null \
-                || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1
-    have gpt    || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1
-    have decode || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1
-    if have resnet && have bert && have yolo && have moe && have gpt && have decode; then
-      timeout 3600 python bench.py >> tunnel_watch.log 2>&1
+    alive && { have resnet || timeout 2400 python examples/perf_campaign.py resnet >> tunnel_watch.log 2>&1; }
+    alive && { have bert   || timeout 2400 python examples/perf_campaign.py bert   >> tunnel_watch.log 2>&1; }
+    alive && { have yolo   || timeout 2400 python examples/perf_campaign.py yolo   >> tunnel_watch.log 2>&1; }
+    alive && { have ocr    || timeout 1800 python examples/perf_campaign.py ocr    >> tunnel_watch.log 2>&1; }
+    alive && { have moe    || timeout 2400 python examples/perf_campaign.py moe    >> tunnel_watch.log 2>&1; }
+    alive && { grep -q '"config": "resnet50_hlo_audit"' perf_campaign_results.jsonl 2>/dev/null \
+                || timeout 1800 python examples/perf_campaign.py hlo >> tunnel_watch.log 2>&1; }
+    alive && { have gpt    || timeout 3000 python examples/perf_campaign.py gpt    >> tunnel_watch.log 2>&1; }
+    alive && { have decode || timeout 2400 python examples/perf_campaign.py decode >> tunnel_watch.log 2>&1; }
+    if have resnet && have bert && have yolo && have ocr && have moe && have gpt && have decode; then
+      alive && timeout 3600 python bench.py >> tunnel_watch.log 2>&1
       echo "$(date -u +%FT%TZ) campaign complete" >> tunnel_watch.log
       break
     fi

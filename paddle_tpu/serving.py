@@ -569,8 +569,11 @@ class ContinuousBatchingEngine:
         S = decoder.max_batch
         self._slot_req = [None] * S          # request id per slot
         self._slot_pages = [[] for _ in range(S)]
-        self._lens = np.zeros(S, np.int64)
-        self._tokens = np.zeros(S, np.int64)
+        # int32 end to end: decode() feeds these to the kernel as int32,
+        # so int64 here would insert a convert_element_type every tick
+        self._lens = np.zeros(S, np.int32)
+        self._tokens = np.zeros(S, np.int32)
+        self._table_cache = None             # rebuilt on admit/retire only
         self._queue = []                     # (req_id, ids)
         self._outputs = {}                   # req_id -> [generated ids]
         self._next_id = 0
@@ -609,6 +612,7 @@ class ContinuousBatchingEngine:
         admitted = self._gather_admissions()
         if not admitted:
             return
+        self._table_cache = None
         firsts = self.d.prefill_batch(
             [(ids, pages) for _, _, ids, pages in admitted])
         self._extra_prefill(admitted)
@@ -651,6 +655,7 @@ class ContinuousBatchingEngine:
         self._slot_pages[slot] = []
         self._lens[slot] = 0
         self._tokens[slot] = 0
+        self._table_cache = None
 
     def _table(self, pages_per_slot, decoder):
         """Page table with inactive/unused entries routed to the reserved
@@ -670,8 +675,10 @@ class ContinuousBatchingEngine:
                   if self._slot_req[s] is not None]
         if not active:
             return 0
-        table = self._table(self._slot_pages, self.d)
-        nxt = np.asarray(self.d.decode(self._tokens, self._lens, table))
+        if self._table_cache is None:        # slots changed since last tick
+            self._table_cache = self._table(self._slot_pages, self.d)
+        nxt = np.asarray(self.d.decode(self._tokens, self._lens,
+                                       self._table_cache))
         self.steps += 1
         for s in active:
             rid = self._slot_req[s]
@@ -733,7 +740,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self.k = int(k)
         self._draft_free = list(range(draft_decoder.num_pages - 2, -1, -1))
         self._draft_pages = [[] for _ in range(decoder.max_batch)]
-        self._dlens = np.zeros(decoder.max_batch, np.int64)
+        self._dlens = np.zeros(decoder.max_batch, np.int32)
         self.target_calls = 0
 
     def submit(self, prompt_ids):
@@ -803,13 +810,15 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         if not active:
             return 0
         k = self.k
-        ttable = self._table(self._slot_pages, self.d)
-        dtable = self._table(self._draft_pages, self.draft)
+        if self._table_cache is None:        # slots changed since last tick
+            self._table_cache = (self._table(self._slot_pages, self.d),
+                                 self._table(self._draft_pages, self.draft))
+        ttable, dtable = self._table_cache
 
         sampled = self.d.sampling is not None
 
         # draft proposes k tokens (k cheap ticks over all slots)
-        proposals = np.zeros((self.d.max_batch, k), np.int64)
+        proposals = np.zeros((self.d.max_batch, k), np.int32)
         qrows = None
         d_in = self._tokens.copy()
         dlens = self._dlens.copy()
@@ -828,7 +837,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 nxt = np.asarray(self.draft.decode(d_in, dlens, dtable))
             proposals[:, j] = nxt
             dlens = dlens + 1
-            d_in = nxt.astype(np.int64)
+            d_in = nxt.astype(np.int32)
 
         # target verifies [cur, d1..dk] in one forward
         window = np.concatenate(

@@ -123,3 +123,56 @@ def test_fft_signal_linalg_vision_ops_keywords_match_reference():
     assert not _drift(_ref_signatures(f"{_REF}/vision/ops.py"), vops)
     assert not _drift(_ref_signatures(f"{_REF}/tensor/linalg.py"),
                       paddle.linalg)
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_fleet_metrics_and_moe_util_keywords_match_reference():
+    """The round-5 surfaces: fleet.metrics aggregation fns, the MoE
+    routing utils, and the fastmoe count/limit wrappers."""
+    from paddle_tpu.distributed.fleet import metrics as our_metrics
+    drift = _drift(
+        _ref_signatures(f"{_REF}/distributed/fleet/metrics/metric.py"),
+        our_metrics)
+    assert not drift, drift
+
+    import paddle_tpu.incubate.distributed.models.moe.utils as our_moe_utils
+    ref = _ref_signatures(
+        f"{_REF}/incubate/distributed/models/moe/utils.py")
+    drift = _drift(ref, our_moe_utils)
+    assert not drift, drift
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="no reference checkout")
+def test_moe_gate_constructor_keywords_match_reference():
+    from paddle_tpu.incubate.distributed.models import moe as our_moe
+
+    ref_ctors = {}
+    for path in glob.glob(
+            f"{_REF}/incubate/distributed/models/moe/gate/*.py") + [
+            f"{_REF}/incubate/distributed/models/moe/moe_layer.py"]:
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and \
+                            item.name == "__init__":
+                        a = item.args
+                        ref_ctors[node.name] = [
+                            p.arg for p in (a.posonlyargs + a.args
+                                            + a.kwonlyargs)
+                            if p.arg != "self"]
+    assert set(ref_ctors) >= {"BaseGate", "NaiveGate", "SwitchGate",
+                              "GShardGate", "MoELayer"}
+    drift = {}
+    for cls_name, params in sorted(ref_ctors.items()):
+        cls = getattr(our_moe, cls_name, None)
+        if cls is None:
+            drift[cls_name] = ["<class missing>"]
+            continue
+        ours = set(inspect.signature(cls.__init__).parameters)
+        if "kwargs" in ours:
+            continue
+        missing = [p for p in params if p not in ours]
+        if missing:
+            drift[cls_name] = missing
+    assert not drift, drift
