@@ -391,3 +391,32 @@ def test_gpt_moe_expert_matmuls_bf16_router_f32():
         assert shapes, l
         dims = [int(d) for d in shapes[0].split("x") if d]
         assert dims[-1] == cfg.num_experts, l   # router logits only
+
+
+def test_crnn_nhwc_bf16_graph():
+    """CRNN campaign stage (the PP-OCR half of BASELINE config 4): all
+    6 convs and all 9 matmuls (RNN cells + CTC head) take bf16
+    operands; the only activation transpose is the single by-design
+    [B, W', C] -> [W', B, C] sequence-major conversion — weight-layout
+    transposes (applied to %arg parameters) fold into XLA's free
+    parameter layout assignment."""
+    from paddle_tpu.vision.models import CRNN
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = CRNN(num_classes=97, data_format="NHWC")
+    model.bfloat16()
+    model.eval()
+    x = jnp.zeros((2, 32, 64, 3), jnp.bfloat16)
+    txt = _lower_forward(model, x)
+    convs = [l for l in txt.splitlines() if "stablehlo.convolution" in l]
+    assert len(convs) == 6, len(convs)
+    for l in convs:
+        assert "f32" not in l.split(":")[1].split("->")[0], l
+    dots = [l for l in txt.splitlines() if "stablehlo.dot_general" in l]
+    assert len(dots) == 9, len(dots)
+    for l in dots:
+        assert "f32" not in l.split(":")[1].split("->")[0], l
+    act = [l for l in txt.splitlines() if "stablehlo.transpose" in l
+           and not re.search(r"transpose %arg\d+, dims = ", l)]
+    assert len(act) == 1 and "dims = [1, 0, 2]" in act[0], act
