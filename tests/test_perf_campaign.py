@@ -1,0 +1,101 @@
+"""Campaign harness logic, offline: stage markers are written only when
+at least one trial succeeded, banked trials skip on retry (a wedge
+mid-sweep resumes at the trial it cut short), and one trial's failure
+never aborts the stage.  bench is monkeypatched — no device."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+import perf_campaign as pc  # noqa: E402
+
+
+@pytest.fixture
+def campaign_dir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _rows(path="perf_campaign_results.jsonl"):
+    if not os.path.exists(path):
+        return []
+    return [json.loads(l) for l in open(path)]
+
+
+def test_banked_skips_only_successful_matching_trials(campaign_dir):
+    pc.record({"config": "yolov3", "bs": 16, "size": 320, "mfu": 0.3})
+    pc.record({"config": "yolov3", "bs": 32, "size": 320,
+               "error": "Wedge: ..."})
+    assert pc.banked(config="yolov3", bs=16, size=320)
+    assert not pc.banked(config="yolov3", bs=32, size=320)  # errored
+    assert not pc.banked(config="yolov3", bs=16, size=416)  # never ran
+    # r4-era gpt rows carry no accum key; accum=1 matches them
+    pc.record({"config": "gpt_1p3b", "bs": 6, "remat": "dots", "mfu": 0.64})
+    assert pc.banked(config="gpt_1p3b", bs=6, remat="dots")
+
+
+def test_ocr_stage_marker_independent_of_yolo(campaign_dir, monkeypatch):
+    """A crnn wedge must not be hidden behind yolo's success marker."""
+    import bench
+
+    monkeypatch.setattr(bench, "run_yolov3",
+                        lambda batch_size, size: (100.0, 0.4))
+
+    def crnn_fails(batch_size):
+        raise RuntimeError("wedge")
+    monkeypatch.setattr(bench, "run_crnn", crnn_fails)
+
+    pc.run_yolo()
+    pc.run_ocr()
+    rows = _rows()
+    assert any(r.get("config") == "yolo_stage_done" for r in rows)
+    assert not any(r.get("config") == "ocr_stage_done" for r in rows)
+    assert sum("error" in r and r["config"] == "crnn" for r in rows) == 2
+
+    # crnn recovers on retry: marker appears, yolo trials all skip
+    calls = {"n": 0}
+
+    def yolo_counts(batch_size, size):
+        calls["n"] += 1
+        return (100.0, 0.4)
+    monkeypatch.setattr(bench, "run_yolov3", yolo_counts)
+    monkeypatch.setattr(bench, "run_crnn", lambda batch_size: (500.0, 0.2))
+    pc.run_yolo()
+    pc.run_ocr()
+    assert calls["n"] == 0                  # everything banked
+    assert any(r.get("config") == "ocr_stage_done" for r in _rows())
+
+
+def test_gpt_stage_resumes_past_banked_trials(campaign_dir, monkeypatch):
+    import bench
+
+    pc.record({"config": "gpt_1p3b", "bs": 4, "remat": "dots",
+               "tok_s": 15567.6, "mfu": 0.623})
+    pc.record({"config": "gpt_1p3b", "bs": 6, "remat": "dots",
+               "tok_s": 16027.8, "mfu": 0.6414})
+    ran = []
+
+    def fake_run_config(name, bs, seq, remat_policy=None, grad_accum=1):
+        ran.append((bs, remat_policy, grad_accum))
+        return 16000.0, 0.64, 1.3e9
+    monkeypatch.setattr(bench, "run_config", fake_run_config)
+    pc.run_gpt()
+    # banked bs4/bs6 skipped; the wedge-quarantined configs run, bs8 last
+    assert ran == [(7, "dots", 1), (8, "dots", 2), (8, "full", 1)]
+    assert any(r.get("config") == "gpt_stage_done" for r in _rows())
+
+
+def test_all_errored_stage_stays_unbanked(campaign_dir, monkeypatch):
+    import bench
+
+    def always_fails(*a, **kw):
+        raise RuntimeError("device init hung")
+    monkeypatch.setattr(bench, "run_gpt_moe", always_fails)
+    pc.run_moe()
+    rows = _rows()
+    assert not any(r.get("config") == "moe_stage_done" for r in rows)
+    assert all("error" in r for r in rows if r.get("config") == "gpt_moe")
