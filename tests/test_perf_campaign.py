@@ -84,9 +84,15 @@ def test_gpt_stage_resumes_past_banked_trials(campaign_dir, monkeypatch):
         return 16000.0, 0.64, 1.3e9
     monkeypatch.setattr(bench, "run_config", fake_run_config)
     pc.run_gpt()
-    # banked bs4/bs6 skipped; the wedge-quarantined configs run, bs8 last
-    assert ran == [(7, "dots", 1), (8, "dots", 2), (8, "full", 1)]
+    # banked bs4/bs6 skipped; new accum2 + wedge-quarantined configs
+    # run, bs8 last
+    assert ran == [(6, "dots", 2), (7, "dots", 1), (8, "dots", 2),
+                   (8, "full", 1)]
     assert any(r.get("config") == "gpt_stage_done" for r in _rows())
+    # retry: the accum2 rows now banked (matched WITH the accum key)
+    ran.clear()
+    pc.run_gpt()
+    assert ran == []
 
 
 def test_all_errored_stage_stays_unbanked(campaign_dir, monkeypatch):
