@@ -29,10 +29,14 @@ def record(trial):
         f.write(line + "\n")
 
 
-def banked(**keys):
+def banked(_defaults=None, **keys):
     """True if a successful trial matching every key=value is already in
     the results file — lets a retried stage skip straight to the trials a
-    wedge cut short instead of re-spending tunnel minutes."""
+    wedge cut short instead of re-spending tunnel minutes.  `_defaults`
+    supplies values for keys older rows never recorded (e.g. pre-r5 gpt
+    rows carry no `accum`: matching accum=1 against them is correct,
+    while an accum=2 row must NOT satisfy an accum=1 query)."""
+    defaults = _defaults or {}
     try:
         with open("perf_campaign_results.jsonl") as f:
             for line in f:
@@ -42,7 +46,8 @@ def banked(**keys):
                     continue
                 if "error" in row:
                     continue
-                if all(row.get(k) == v for k, v in keys.items()):
+                if all(row.get(k, defaults.get(k)) == v
+                       for k, v in keys.items()):
                     return True
     except OSError:
         pass
@@ -349,11 +354,8 @@ def run_gpt():
             ("gpt_1p3b", 4, "dots", 1), ("gpt_1p3b", 6, "dots", 1),
             ("gpt_1p3b", 6, "dots", 2), ("gpt_1p3b", 7, "dots", 1),
             ("gpt_1p3b", 8, "dots", 2), ("gpt_1p3b", 8, "full", 1)):
-        # rows banked before the r4 wedge carry no accum key — treat
-        # accum=1 as matching them; accum>1 trials match on accum too
-        if (accum == 1 and banked(config=name, bs=bs, remat=rp)) or \
-                (accum > 1 and banked(config=name, bs=bs, remat=rp,
-                                      accum=accum)):
+        if banked(config=name, bs=bs, remat=rp, accum=accum,
+                  _defaults={"accum": 1}):
             ok += 1
             continue
         try:

@@ -159,27 +159,76 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         out_shape = list(v.shape)
         for a, s in zip(spatial_axes, out_sizes):
             out_shape[a] = s
-        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-        if mode == "nearest" or not align_corners:
-            return jax.image.resize(v, out_shape, method=jmode).astype(v.dtype)
-        # align_corners: gather with exact corner-aligned coordinates
-        out = v
+        if mode == "area":
+            # paddle/torch 'area' = adaptive average pooling
+            from .pooling import _adaptive_avg
+            return _adaptive_avg(v, out_sizes, spatial_axes)
+        # Explicit per-axis source-coordinate gather. jax.image.resize
+        # is unusable here: it ANTIALIASES when downsampling (PIL-style
+        # scale-widened kernels), its cubic kernel is a=-0.5, and its
+        # nearest rule is half-pixel — the reference *_interp_v2 ops do
+        # plain source sampling (nearest: floor(j*in/out); cubic: Keys
+        # a=-0.75 with border-replicated taps).
+        if mode == "nearest":
+            # pure gather — no float round-trip (int tensors > 2^24
+            # must survive); paddle nearest_interp_v2 rounds HALF-UP
+            # (floor(ratio*j + 0.5)) under align_corners, not
+            # ties-to-even
+            out = v
+            for a, s_out in zip(spatial_axes, out_sizes):
+                s_in = out.shape[a]
+                j = jnp.arange(s_out, dtype=jnp.float32)
+                if align_corners and s_out > 1:
+                    ii = jnp.floor(j * ((s_in - 1.0) / (s_out - 1.0))
+                                   + 0.5)
+                else:
+                    ii = jnp.floor(j * (s_in / s_out))
+                out = jnp.take(out, jnp.clip(ii, 0, s_in - 1)
+                               .astype(jnp.int32), axis=a)
+            return out
+        out = v.astype(jnp.float32)
         for a, s_out in zip(spatial_axes, out_sizes):
             s_in = out.shape[a]
+            j = jnp.arange(s_out, dtype=jnp.float32)
             if s_out == 1 or s_in == 1:
                 idx = jnp.zeros((s_out,), jnp.float32)
+            elif align_corners:
+                idx = j * ((s_in - 1.0) / (s_out - 1.0))
+            elif align_mode == 1 and mode in ("linear", "bilinear",
+                                              "trilinear"):
+                idx = j * (s_in / s_out)          # legacy align_mode=1
             else:
-                idx = jnp.linspace(0.0, s_in - 1.0, s_out)
-            i0 = jnp.floor(idx).astype(jnp.int32)
-            i1 = jnp.minimum(i0 + 1, s_in - 1)
-            w = (idx - i0).astype(v.dtype)
-            lo = jnp.take(out, i0, axis=a)
-            hi = jnp.take(out, i1, axis=a)
+                idx = (j + 0.5) * (s_in / s_out) - 0.5  # half-pixel
             bshape = [1] * out.ndim
             bshape[a] = s_out
-            w = w.reshape(bshape)
-            out = lo * (1 - w) + hi * w
+            if mode == "bicubic":
+                # Keys cubic, a = -0.75.  idx stays UNCLIPPED: the
+                # fractional offset t keeps its true value at borders
+                # (a half-pixel idx of -0.25 means i0=-1, t=0.75) and
+                # only the TAP indices replicate the border.
+                i0 = jnp.floor(idx).astype(jnp.int32)
+                t = idx - i0
+                A = -0.75
+
+                def k1(s):   # |s| <= 1
+                    return ((A + 2) * s - (A + 3)) * s * s + 1
+
+                def k2(s):   # 1 < |s| < 2
+                    return ((A * s - 5 * A) * s + 8 * A) * s - 4 * A
+                ws = [k2(t + 1), k1(t), k1(1 - t), k2(2 - t)]
+                acc = 0.0
+                for o, wt in zip((-1, 0, 1, 2), ws):
+                    ii = jnp.clip(i0 + o, 0, s_in - 1)
+                    acc = acc + jnp.take(out, ii, axis=a) \
+                        * wt.reshape(bshape)
+                out = acc
+                continue
+            idx = jnp.clip(idx, 0.0, s_in - 1.0)
+            i0 = jnp.floor(idx).astype(jnp.int32)
+            i1 = jnp.minimum(i0 + 1, s_in - 1)
+            w = (idx - i0).reshape(bshape)
+            out = jnp.take(out, i0, axis=a) * (1 - w) \
+                + jnp.take(out, i1, axis=a) * w
         return out.astype(v.dtype)
     return apply_op(_f, x)
 

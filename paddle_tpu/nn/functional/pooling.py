@@ -242,23 +242,37 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  ceil_mode, exclusive)
 
 
+def _adaptive_avg(v, out_sizes, spatial_axes):
+    """Raw-array adaptive mean over explicit axes — the kernel behind
+    interpolate(mode='area') and the _adaptive wrapper."""
+    out = v
+    for ax, o in zip(spatial_axes, out_sizes):
+        s_in = out.shape[ax]
+        starts = (np.arange(o) * s_in) // o
+        ends = ((np.arange(o) + 1) * s_in + o - 1) // o
+        out = jnp.concatenate(
+            [jnp.mean(jax.lax.slice_in_dim(out, int(s), int(e), axis=ax),
+                      axis=ax, keepdims=True)
+             for s, e in zip(starts, ends)], axis=ax)
+    return out
+
+
 def _adaptive(x, output_size, n, mode, channel_last=False):
     def _f(v):
         spatial = list(range(1, 1 + n)) if channel_last else list(range(v.ndim - n, v.ndim))
         osz = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
         osz = [v.shape[ax] if o is None else int(o) for ax, o in zip(spatial, osz)]
+        if mode == "avg":
+            return _adaptive_avg(v, osz, spatial)
         out = v
         for ax, o in zip(spatial, osz):
             s_in = out.shape[ax]
             starts = (np.arange(o) * s_in) // o
             ends = ((np.arange(o) + 1) * s_in + o - 1) // o
-            slices = []
-            for s, e in zip(starts, ends):
-                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
-                red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" \
-                    else jnp.mean(seg, axis=ax, keepdims=True)
-                slices.append(red)
-            out = jnp.concatenate(slices, axis=ax)
+            out = jnp.concatenate(
+                [jnp.max(jax.lax.slice_in_dim(out, int(s), int(e), axis=ax),
+                         axis=ax, keepdims=True)
+                 for s, e in zip(starts, ends)], axis=ax)
         return out
     return apply_op(_f, x)
 

@@ -90,10 +90,20 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
                 ixc, iyc = jnp.clip(ix, 0, w - 1), jnp.clip(iy, 0, h - 1)
                 inb = jnp.ones_like(inb)
             elif padding_mode == "reflection":
-                span_x = 2 * (w - 1) if align_corners else 2 * w
-                span_y = 2 * (h - 1) if align_corners else 2 * h
-                ixc = jnp.abs(jnp.mod(ix + (w - 1), span_x) - (w - 1)) if align_corners else ix
-                iyc = jnp.abs(jnp.mod(iy + (h - 1), span_y) - (h - 1)) if align_corners else iy
+                # sampling the reflected SIGNAL at the original taps ==
+                # reflecting the continuous coordinate first (torch's
+                # rule): ac=True mirrors about pixel CENTERS (period
+                # 2(w-1)), ac=False about pixel EDGES -0.5/w-0.5
+                # (period 2w, tap m >= w folds to 2w-1-m)
+                if align_corners:
+                    ixc = jnp.abs(jnp.mod(ix + (w - 1), 2 * (w - 1))
+                                  - (w - 1))
+                    iyc = jnp.abs(jnp.mod(iy + (h - 1), 2 * (h - 1))
+                                  - (h - 1))
+                else:
+                    mx, my = jnp.mod(ix, 2 * w), jnp.mod(iy, 2 * h)
+                    ixc = jnp.where(mx >= w, 2 * w - 1 - mx, mx)
+                    iyc = jnp.where(my >= h, 2 * h - 1 - my, my)
                 ixc, iyc = jnp.clip(ixc, 0, w - 1), jnp.clip(iyc, 0, h - 1)
                 inb = jnp.ones_like(inb)
             else:

@@ -1,0 +1,112 @@
+"""interpolate / grid_sample pinned against torch-CPU as the oracle
+(paddle's *_interp_v2 and grid_sampler share torch's sampling rules for
+these modes), plus analytic roi_align cases.
+
+These caught two real bugs: jax.image.resize antialiases on downsample
+(the reference ops don't) and uses half-pixel nearest + a=-0.5 cubic —
+interpolate now does its own per-axis source-coordinate gather.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+RNG = np.random.RandomState(0)
+X = RNG.randn(2, 3, 8, 10).astype("float32")
+
+
+def _cmp(ours, theirs, tol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("size", [(16, 20), (5, 7), (8, 21), (3, 10)])
+@pytest.mark.parametrize("mode,ac", [
+    ("nearest", False), ("bilinear", False), ("bilinear", True),
+    ("bicubic", False), ("bicubic", True), ("area", False)])
+def test_interpolate_2d_matches_torch(size, mode, ac):
+    xp, xt = paddle.to_tensor(X), torch.tensor(X)
+    kw = {} if mode in ("nearest", "area") else {"align_corners": ac}
+    if mode in ("nearest", "area") and ac:
+        pytest.skip("torch rejects align_corners for this mode")
+    _cmp(F.interpolate(xp, size=list(size), mode=mode,
+                       align_corners=ac).numpy(),
+         TF.interpolate(xt, size=size, mode=mode, **kw))
+
+
+def test_interpolate_1d_3d_matches_torch():
+    x1 = RNG.randn(2, 3, 9).astype("float32")
+    _cmp(F.interpolate(paddle.to_tensor(x1), size=[15], mode="linear",
+                       data_format="NCW").numpy(),
+         TF.interpolate(torch.tensor(x1), size=15, mode="linear",
+                        align_corners=False))
+    x3 = RNG.randn(1, 2, 4, 5, 6).astype("float32")
+    _cmp(F.interpolate(paddle.to_tensor(x3), size=[8, 9, 10],
+                       mode="trilinear", data_format="NCDHW").numpy(),
+         TF.interpolate(torch.tensor(x3), size=(8, 9, 10),
+                        mode="trilinear", align_corners=False))
+    # scale_factor form + NHWC layout round-trip
+    nhwc = np.transpose(X, (0, 2, 3, 1))
+    got = F.interpolate(paddle.to_tensor(nhwc), scale_factor=2,
+                        mode="nearest", data_format="NHWC").numpy()
+    want = TF.interpolate(torch.tensor(X), scale_factor=2,
+                          mode="nearest").numpy()
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want,
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("ac", [True, False])
+def test_grid_sample_matches_torch(mode, pad, ac):
+    grid = (RNG.rand(2, 6, 7, 2) * 2.4 - 1.2).astype("float32")  # OOB too
+    _cmp(F.grid_sample(paddle.to_tensor(X), paddle.to_tensor(grid),
+                       mode=mode, padding_mode=pad,
+                       align_corners=ac).numpy(),
+         TF.grid_sample(torch.tensor(X), torch.tensor(grid), mode=mode,
+                        padding_mode=pad, align_corners=ac))
+
+
+def test_roi_align_analytic():
+    """paddle's aligned=True default: continuous coords shift by -0.5;
+    a linear ramp's cell averages land mid-sample exactly."""
+    from paddle_tpu.vision.ops import roi_align
+
+    x = paddle.to_tensor(np.full((1, 2, 16, 16), 5.0, "float32"))
+    boxes = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]], "float32"))
+    num = paddle.to_tensor(np.array([1], "int32"))
+    out = roi_align(x, boxes, num, output_size=4)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-6)
+
+    ramp = np.tile(np.arange(16, dtype="float32"), (16, 1))[None, None]
+    out2 = roi_align(paddle.to_tensor(ramp), boxes, num, output_size=2,
+                     sampling_ratio=2)
+    np.testing.assert_allclose(out2.numpy().reshape(2, 2),
+                               [[3.5, 7.5], [3.5, 7.5]], rtol=1e-6)
+
+
+def test_nearest_align_corners_rounds_half_up():
+    """paddle nearest_interp_v2 under align_corners rounds half-up
+    (floor(ratio*j + 0.5)): size 3 -> 5 has idx ties at 0.5/1.5 which
+    must pick the HIGHER source pixel (ties-to-even would give
+    [0,0,1,2,2])."""
+    x = paddle.to_tensor(np.arange(3, dtype="float32").reshape(1, 1, 1, 3))
+    out = F.interpolate(x, size=[1, 5], mode="nearest", align_corners=True)
+    np.testing.assert_array_equal(out.numpy().reshape(-1), [0, 1, 1, 2, 2])
+
+
+def test_nearest_preserves_large_ints():
+    """nearest is a pure gather: integer payloads above 2^24 must not
+    round-trip through float32."""
+    big = np.array([[16777217, 16777219, 33554433, 33554437]],
+                   dtype="int32").reshape(1, 1, 1, 4)
+    out = F.interpolate(paddle.to_tensor(big), scale_factor=2,
+                        mode="nearest")
+    assert out.numpy().dtype == np.int32
+    np.testing.assert_array_equal(np.unique(out.numpy()),
+                                  np.unique(big))

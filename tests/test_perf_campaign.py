@@ -105,3 +105,20 @@ def test_all_errored_stage_stays_unbanked(campaign_dir, monkeypatch):
     rows = _rows()
     assert not any(r.get("config") == "moe_stage_done" for r in rows)
     assert all("error" in r for r in rows if r.get("config") == "gpt_moe")
+
+
+def test_banked_accum_defaults(campaign_dir):
+    """accum-less r4 rows satisfy accum=1 queries; an accum=2 row must
+    NOT satisfy an accum=1 query (else a wedged accum=1 trial is never
+    retried once its accum=2 sibling lands)."""
+    pc.record({"config": "gpt_1p3b", "bs": 6, "remat": "dots",
+               "mfu": 0.64})                      # r4-era, no accum key
+    pc.record({"config": "gpt_1p3b", "bs": 8, "remat": "dots",
+               "accum": 2, "mfu": 0.6})
+    d = {"accum": 1}
+    assert pc.banked(config="gpt_1p3b", bs=6, remat="dots", accum=1,
+                     _defaults=d)
+    assert not pc.banked(config="gpt_1p3b", bs=8, remat="dots", accum=1,
+                         _defaults=d)
+    assert pc.banked(config="gpt_1p3b", bs=8, remat="dots", accum=2,
+                     _defaults=d)
