@@ -32,6 +32,19 @@ def _pads(padding, n):
     return [(int(padding), int(padding))] * n
 
 
+def _ceil_extend(size, k, s, pad):
+    """Trailing-pad extension for ceil_mode with the torch/paddle drop
+    rule: windows from ceil division fit, but a window that would start
+    past input + left-pad is discarded, not emitted."""
+    pl, ph = pad
+    eff = size + pl + ph
+    out_floor = (eff - k) // s + 1
+    out_ceil = -(-(eff - k) // s) + 1
+    if out_ceil > out_floor and (out_ceil - 1) * s >= size + pl:
+        out_ceil -= 1
+    return pl, ph + max(0, (out_ceil - 1) * s + k - eff)
+
+
 def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
           exclusive=True, count_include_pad=False):
     kernel = _tuple(kernel, n)
@@ -43,12 +56,20 @@ def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
             window = (1,) + kernel + (1,)
             strides = (1,) + stride + (1,)
             wpads = ([(0, 0)] + list(pads) + [(0, 0)]) if not isinstance(pads, str) else pads
+            sdims = list(range(1, 1 + n))
         else:
             window = (1, 1) + kernel
             strides = (1, 1) + stride
             wpads = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
+            sdims = list(range(2, 2 + n))
         if isinstance(wpads, str):
             wpads = jax.lax.padtype_to_pads(v.shape, window, strides, wpads)
+        wpads = [tuple(p) for p in wpads]
+        orig_pads = list(wpads)
+        if ceil_mode:
+            for d in sdims:
+                wpads[d] = _ceil_extend(v.shape[d], window[d], strides[d],
+                                        wpads[d])
         # init values MUST be python scalars: an array init is a traced
         # constant under jit, which defeats lax's monoid specialization and
         # lands on the generic reduce_window (not reverse-differentiable)
@@ -65,6 +86,19 @@ def _pool(x, kernel, stride, padding, n, mode, channel_last, ceil_mode=False,
             ones = jnp.ones_like(v)
             counts = jax.lax.reduce_window(ones, zero, jax.lax.add,
                                            window, strides, wpads)
+            return summed / counts
+        if ceil_mode and wpads != orig_pads:
+            # inclusive divisor counts input + REQUESTED padding but not
+            # the ceil extension (torch/paddle rule): ones padded 1 over
+            # the original pads, 0 over the extension
+            ones = jnp.pad(jnp.ones_like(v),
+                           [orig_pads[d] if d in sdims else (0, 0)
+                            for d in range(v.ndim)],
+                           constant_values=1)
+            ext_pads = [(0, wpads[d][1] - orig_pads[d][1])
+                        if d in sdims else (0, 0) for d in range(v.ndim)]
+            counts = jax.lax.reduce_window(ones, zero, jax.lax.add,
+                                           window, strides, ext_pads)
             return summed / counts
         return summed / float(np.prod(kernel))
     return apply_op(_f, x)
@@ -91,12 +125,11 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last=False,
         strides = (1, 1) + stride
         wpads = [(0, 0), (0, 0)] + list(pads)
         if ceil_mode:
-            # extend right padding so the last partial window is kept
+            # same extension + drop rule as _pool (shared helper): the
+            # mask path must emit exactly the no-mask path's shape
             for i in range(n):
-                size = spatial[i] + pads[i][0] + pads[i][1]
-                rem = (size - kernel[i]) % stride[i]
-                if rem:
-                    wpads[2 + i] = (pads[i][0], pads[i][1] + stride[i] - rem)
+                wpads[2 + i] = _ceil_extend(spatial[i], kernel[i],
+                                            stride[i], wpads[2 + i])
         neg = jnp.asarray(-jnp.inf if jnp.issubdtype(v.dtype, np.floating)
                           else jnp.iinfo(v.dtype).min, v.dtype)
         # variadic reduce: track (max value, its flat source index) per window

@@ -1,0 +1,151 @@
+"""Broad op-semantics oracle vs torch-CPU (shared sampling/pooling/
+activation/loss rules with the reference).  This sweep caught
+ceil_mode pooling being silently ignored — the shape AND the
+boundary-window divisor rules are pinned here."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+RNG = np.random.RandomState(0)
+X = RNG.randn(2, 3, 8, 10).astype("float32")
+
+
+def _cmp(ours, theirs, tol=1e-5):
+    ours, theirs = np.asarray(ours), theirs.detach().numpy()
+    assert ours.shape == theirs.shape, (ours.shape, theirs.shape)
+    np.testing.assert_allclose(ours, theirs, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("pad,ceil", [(0, True), (1, True), (1, False)])
+def test_max_pool_matches_torch(pad, ceil):
+    _cmp(F.max_pool2d(paddle.to_tensor(X), 3, stride=2, padding=pad,
+                      ceil_mode=ceil).numpy(),
+         TF.max_pool2d(torch.tensor(X), 3, stride=2, padding=pad,
+                       ceil_mode=ceil))
+
+
+@pytest.mark.parametrize("pad", [0, 1])
+@pytest.mark.parametrize("ceil", [True, False])
+@pytest.mark.parametrize("exclusive", [True, False])
+def test_avg_pool_matches_torch(pad, ceil, exclusive):
+    """paddle exclusive=True == torch count_include_pad=False; under
+    ceil_mode the inclusive divisor counts requested padding but never
+    the ceil extension."""
+    _cmp(F.avg_pool2d(paddle.to_tensor(X), 3, stride=2, padding=pad,
+                      ceil_mode=ceil, exclusive=exclusive).numpy(),
+         TF.avg_pool2d(torch.tensor(X), 3, stride=2, padding=pad,
+                       ceil_mode=ceil,
+                       count_include_pad=not exclusive))
+
+
+def test_pool_1d_3d_ceil():
+    x1 = RNG.randn(2, 3, 11).astype("float32")
+    _cmp(F.avg_pool1d(paddle.to_tensor(x1), 4, stride=3, ceil_mode=True,
+                      exclusive=False).numpy(),
+         TF.avg_pool1d(torch.tensor(x1), 4, stride=3, ceil_mode=True))
+    x3 = RNG.randn(1, 2, 7, 8, 9).astype("float32")
+    _cmp(F.max_pool3d(paddle.to_tensor(x3), 2, stride=2,
+                      ceil_mode=True).numpy(),
+         TF.max_pool3d(torch.tensor(x3), 2, stride=2, ceil_mode=True))
+
+
+@pytest.mark.parametrize("mode", ["reflect", "replicate", "circular",
+                                  "constant"])
+def test_pad_modes_match_torch(mode):
+    _cmp(F.pad(paddle.to_tensor(X), [1, 2, 2, 1], mode=mode).numpy(),
+         TF.pad(torch.tensor(X), (1, 2, 2, 1), mode=mode))
+
+
+def test_pixel_shuffle_roundtrip():
+    ps = RNG.randn(2, 12, 4, 5).astype("float32")
+    _cmp(F.pixel_shuffle(paddle.to_tensor(ps), 2).numpy(),
+         TF.pixel_shuffle(torch.tensor(ps), 2))
+    pu = RNG.randn(2, 3, 12, 15).astype("float32")
+    _cmp(F.pixel_unshuffle(paddle.to_tensor(pu), 3).numpy(),
+         TF.pixel_unshuffle(torch.tensor(pu), 3))
+
+
+def test_norms_match_torch():
+    g = RNG.randn(2, 6, 5, 5).astype("float32")
+    w, b = RNG.randn(6).astype("float32"), RNG.randn(6).astype("float32")
+    _cmp(F.group_norm(paddle.to_tensor(g), 3, weight=paddle.to_tensor(w),
+                      bias=paddle.to_tensor(b)).numpy(),
+         TF.group_norm(torch.tensor(g), 3, torch.tensor(w),
+                       torch.tensor(b)))
+    _cmp(F.instance_norm(paddle.to_tensor(g), weight=paddle.to_tensor(w),
+                         bias=paddle.to_tensor(b)).numpy(),
+         TF.instance_norm(torch.tensor(g), weight=torch.tensor(w),
+                          bias=torch.tensor(b)))
+    _cmp(F.layer_norm(paddle.to_tensor(X), [8, 10]).numpy(),
+         TF.layer_norm(torch.tensor(X), (8, 10)))
+
+
+_ACTS = [
+    ("gelu", lambda v: F.gelu(v), lambda v: TF.gelu(v)),
+    ("gelu_tanh", lambda v: F.gelu(v, approximate=True),
+     lambda v: TF.gelu(v, approximate="tanh")),
+    ("silu", F.silu, TF.silu), ("hardswish", F.hardswish, TF.hardswish),
+    ("hardsigmoid", F.hardsigmoid, TF.hardsigmoid),
+    ("softplus", F.softplus, TF.softplus), ("mish", F.mish, TF.mish),
+    ("elu", F.elu, TF.elu), ("selu", F.selu, TF.selu),
+    ("log_sigmoid", F.log_sigmoid, TF.logsigmoid),
+    ("tanhshrink", F.tanhshrink, TF.tanhshrink),
+    ("softsign", F.softsign, TF.softsign),
+    ("hardshrink", F.hardshrink, TF.hardshrink),
+    ("softshrink", F.softshrink, TF.softshrink),
+    ("celu", F.celu, TF.celu), ("relu6", F.relu6, TF.relu6),
+]
+
+
+@pytest.mark.parametrize("name,ours,theirs", _ACTS,
+                         ids=[a[0] for a in _ACTS])
+def test_activations_match_torch(name, ours, theirs):
+    _cmp(ours(paddle.to_tensor(X)).numpy(), theirs(torch.tensor(X)))
+
+
+def test_losses_match_torch():
+    logits = RNG.randn(8, 5).astype("float32")
+    labels = RNG.randint(0, 5, (8,)).astype("int64")
+    lt = torch.tensor(logits)
+    tgt = np.abs(RNG.randn(8, 5)).astype("float32")
+    lg = np.log(np.abs(logits) + 1).astype("float32")
+    _cmp(F.kl_div(paddle.to_tensor(lg), paddle.to_tensor(tgt),
+                  reduction="batchmean").numpy(),
+         TF.kl_div(torch.tensor(lg), torch.tensor(tgt),
+                   reduction="batchmean"))
+    _cmp(F.smooth_l1_loss(paddle.to_tensor(X),
+                          paddle.to_tensor(X * 0.5)).numpy(),
+         TF.smooth_l1_loss(torch.tensor(X), torch.tensor(X * 0.5)))
+    logp = np.log(TF.softmax(lt, -1).numpy())
+    _cmp(F.nll_loss(paddle.to_tensor(logp),
+                    paddle.to_tensor(labels)).numpy(),
+         TF.nll_loss(torch.tensor(logp), torch.tensor(labels)))
+    _cmp(F.margin_ranking_loss(
+            paddle.to_tensor(logits[:, 0]), paddle.to_tensor(logits[:, 1]),
+            paddle.to_tensor(np.sign(logits[:, 2]).astype("float32")),
+            margin=0.3).numpy(),
+         TF.margin_ranking_loss(lt[:, 0], lt[:, 1],
+                                torch.sign(lt[:, 2]), margin=0.3))
+    _cmp(F.triplet_margin_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(logits * 0.9),
+            paddle.to_tensor(logits[::-1].copy())).numpy(),
+         TF.triplet_margin_loss(lt, lt * 0.9,
+                                torch.tensor(logits[::-1].copy())))
+
+
+def test_max_pool_mask_shape_matches_no_mask_path():
+    """return_mask=True must emit the same ceil_mode shape as the
+    no-mask path and torch (the mask feeds max_unpool)."""
+    x = RNG.randn(1, 1, 3, 3).astype("float32")
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, padding=1,
+                             ceil_mode=True, return_mask=True)
+    want = TF.max_pool2d(torch.tensor(x), 2, stride=2, padding=1,
+                         ceil_mode=True)
+    assert tuple(out.shape) == tuple(want.shape)
+    _cmp(out.numpy(), want)
+    assert tuple(mask.shape) == tuple(want.shape)
