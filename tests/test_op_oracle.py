@@ -149,3 +149,90 @@ def test_max_pool_mask_shape_matches_no_mask_path():
     assert tuple(out.shape) == tuple(want.shape)
     _cmp(out.numpy(), want)
     assert tuple(mask.shape) == tuple(want.shape)
+
+
+@pytest.mark.parametrize("case", [
+    dict(stride=2, padding=1), dict(dilation=2, padding=2),
+    dict(groups=2, padding=1), dict(padding=[1, 2])])
+def test_conv2d_matches_torch(case):
+    x = RNG.randn(2, 4, 9, 11).astype("float32")
+    cout_in = 2 if case.get("groups") == 2 else 4
+    w = RNG.randn(6, cout_in, 3, 3).astype("float32")
+    b = RNG.randn(6).astype("float32")
+    tcase = {k: tuple(v) if isinstance(v, list) else v
+             for k, v in case.items()}
+    _cmp(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                  paddle.to_tensor(b), **case).numpy(),
+         TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                   **tcase), tol=1e-4)
+
+
+@pytest.mark.parametrize("case", [
+    dict(stride=2, padding=1), dict(stride=2, padding=1,
+                                    output_padding=1),
+    dict(dilation=2, padding=2)])
+def test_conv2d_transpose_matches_torch(case):
+    x = RNG.randn(2, 4, 9, 11).astype("float32")
+    w = RNG.randn(4, 6, 3, 3).astype("float32")
+    b = RNG.randn(6).astype("float32")
+    _cmp(F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                            paddle.to_tensor(b), **case).numpy(),
+         TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                             torch.tensor(b), **case), tol=1e-4)
+
+
+def test_conv_1d_3d_matches_torch():
+    x1 = RNG.randn(2, 4, 13).astype("float32")
+    w1 = RNG.randn(6, 4, 3).astype("float32")
+    _cmp(F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1), stride=2,
+                  padding=1).numpy(),
+         TF.conv1d(torch.tensor(x1), torch.tensor(w1), stride=2,
+                   padding=1), tol=1e-4)
+    x3 = RNG.randn(1, 2, 5, 6, 7).astype("float32")
+    w3 = RNG.randn(4, 2, 3, 3, 3).astype("float32")
+    _cmp(F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                  padding=1).numpy(),
+         TF.conv3d(torch.tensor(x3), torch.tensor(w3), padding=1),
+         tol=1e-4)
+
+
+@pytest.mark.parametrize("kind", ["LSTM", "GRU", "RNN"])
+def test_rnn_family_matches_torch(kind):
+    """Gate math pinned by weight transplant: torch weights loaded into
+    our cells must reproduce torch's full-sequence outputs."""
+    T, B, I, H = 5, 3, 4, 6
+    x = RNG.randn(T, B, I).astype("float32")
+    tl = getattr(torch.nn, kind)(I, H, num_layers=1, batch_first=False)
+    pl = getattr(paddle.nn,
+                 "SimpleRNN" if kind == "RNN" else kind)(I, H,
+                                                         time_major=True)
+    tp = dict(tl.named_parameters())
+    pl.set_state_dict({
+        "cells.0.weight_ih": tp["weight_ih_l0"].detach().numpy(),
+        "cells.0.weight_hh": tp["weight_hh_l0"].detach().numpy(),
+        "cells.0.bias_ih": tp["bias_ih_l0"].detach().numpy(),
+        "cells.0.bias_hh": tp["bias_hh_l0"].detach().numpy()})
+    tout, _ = tl(torch.tensor(x))
+    pout, _ = pl(paddle.to_tensor(x))
+    _cmp(pout.numpy(), tout, tol=1e-4)
+
+
+def test_bidirectional_lstm_matches_torch():
+    T, B, I, H = 5, 3, 4, 6
+    x = RNG.randn(T, B, I).astype("float32")
+    tl = torch.nn.LSTM(I, H, num_layers=1, batch_first=False,
+                       bidirectional=True)
+    pl = paddle.nn.LSTM(I, H, time_major=True, direction="bidirect")
+    tp = dict(tl.named_parameters())
+    pl.set_state_dict({
+        "cells.0.weight_ih": tp["weight_ih_l0"].detach().numpy(),
+        "cells.0.weight_hh": tp["weight_hh_l0"].detach().numpy(),
+        "cells.0.bias_ih": tp["bias_ih_l0"].detach().numpy(),
+        "cells.0.bias_hh": tp["bias_hh_l0"].detach().numpy(),
+        "cells.1.weight_ih": tp["weight_ih_l0_reverse"].detach().numpy(),
+        "cells.1.weight_hh": tp["weight_hh_l0_reverse"].detach().numpy(),
+        "cells.1.bias_ih": tp["bias_ih_l0_reverse"].detach().numpy(),
+        "cells.1.bias_hh": tp["bias_hh_l0_reverse"].detach().numpy()})
+    tout, _ = tl(torch.tensor(x))
+    pout, _ = pl(paddle.to_tensor(x))
+    _cmp(pout.numpy(), tout, tol=1e-4)
