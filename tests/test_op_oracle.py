@@ -236,3 +236,80 @@ def test_bidirectional_lstm_matches_torch():
     tout, _ = tl(torch.tensor(x))
     pout, _ = pl(paddle.to_tensor(x))
     _cmp(pout.numpy(), tout, tol=1e-4)
+
+
+@pytest.mark.parametrize("ac", [True, False])
+def test_affine_grid_matches_torch(ac):
+    theta = (RNG.randn(2, 2, 3).astype("float32") * 0.3
+             + np.array([[1, 0, 0], [0, 1, 0]], "float32"))
+    _cmp(F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                       align_corners=ac).numpy(),
+         TF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                        align_corners=ac))
+
+
+def test_cross_entropy_variants_match_torch():
+    logits = RNG.randn(8, 5).astype("float32")
+    labels = RNG.randint(0, 5, (8,)).astype("int64")
+    w = np.abs(RNG.randn(5)).astype("float32")
+    lt, labt = torch.tensor(logits), torch.tensor(labels)
+    _cmp(F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels)).numpy(),
+         TF.cross_entropy(lt, labt))
+    _cmp(F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels),
+                         weight=paddle.to_tensor(w)).numpy(),
+         TF.cross_entropy(lt, labt, weight=torch.tensor(w)))
+    lab_ig = labels.copy()
+    lab_ig[::3] = -100
+    _cmp(F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(lab_ig),
+                         ignore_index=-100).numpy(),
+         TF.cross_entropy(lt, torch.tensor(lab_ig), ignore_index=-100))
+    soft = np.abs(RNG.rand(8, 5)).astype("float32")
+    soft /= soft.sum(-1, keepdims=True)
+    _cmp(F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(soft),
+                         soft_label=True).numpy(),
+         TF.cross_entropy(lt, torch.tensor(soft)))
+
+
+def test_order_statistics_match_torch():
+    v = RNG.randn(3, 7).astype("float32")
+    vp, vt = paddle.to_tensor(v), torch.tensor(v)
+    _cmp(paddle.median(vp, axis=1).numpy(),
+         torch.median(vt, dim=1).values)
+    _cmp(paddle.quantile(vp, 0.3, axis=1).numpy(),
+         torch.quantile(vt, 0.3, dim=1))
+    _cmp(paddle.kthvalue(vp, 3, axis=1)[0].numpy(),
+         torch.kthvalue(vt, 3, dim=1).values)
+    _cmp(paddle.logcumsumexp(vp, axis=1).numpy(),
+         torch.logcumsumexp(vt, dim=1))
+    _cmp(paddle.cummax(vp, axis=1)[0].numpy(),
+         torch.cummax(vt, dim=1).values)
+    _cmp(paddle.searchsorted(paddle.to_tensor(np.sort(v, 1)), vp).numpy(),
+         torch.searchsorted(torch.tensor(np.sort(v, 1)), vt))
+    _cmp(paddle.nanmedian(vp, axis=1).numpy(),
+         torch.nanmedian(vt, dim=1).values)
+
+
+def test_batchnorm_running_stats_reference_semantics():
+    """Train-mode BN: outputs + running MEAN match torch (momentum
+    conventions mirrored: paddle 0.9 == torch 0.1), but running VAR
+    follows the REFERENCE phi kernel (batch_norm_kernel.cc:125 divides
+    by N*sample_size — biased), where torch applies Bessel's
+    correction. The biased update is pinned here as correct parity."""
+    xb = RNG.randn(4, 3, 5, 5).astype("float32")
+    bn_p = paddle.nn.BatchNorm2D(3, momentum=0.9)
+    bn_t = torch.nn.BatchNorm2d(3, momentum=0.1)
+    bn_p.train()
+    bn_t.train()
+    _cmp(bn_p(paddle.to_tensor(xb)).numpy(), bn_t(torch.tensor(xb)))
+    _cmp(bn_p._mean.numpy(), bn_t.running_mean)
+    # biased batch variance (reference), not torch's unbiased
+    batch_var = xb.transpose(1, 0, 2, 3).reshape(3, -1).var(axis=1)
+    want = 1.0 * 0.9 + batch_var * 0.1
+    np.testing.assert_allclose(bn_p._variance.numpy(), want, rtol=1e-5)
+    n = xb.size // 3
+    assert not np.allclose(bn_p._variance.numpy(),
+                           1.0 * 0.9 + batch_var * (n / (n - 1)) * 0.1)
