@@ -313,3 +313,98 @@ def test_batchnorm_running_stats_reference_semantics():
     n = xb.size // 3
     assert not np.allclose(bn_p._variance.numpy(),
                            1.0 * 0.9 + batch_var * (n / (n - 1)) * 0.1)
+
+
+def _pgrad(fn, x):
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    fn(t).sum().backward()
+    return t.grad.numpy()
+
+
+def _tgrad(fn, x):
+    t = torch.tensor(x, requires_grad=True)
+    fn(t).sum().backward()
+    return t.grad
+
+
+_GRAD_CASES = [
+    ("interp_bilinear_down",
+     lambda v: F.interpolate(v, size=[5, 7], mode="bilinear"),
+     lambda v: TF.interpolate(v, size=(5, 7), mode="bilinear")),
+    ("interp_bicubic_up",
+     lambda v: F.interpolate(v, size=[11, 13], mode="bicubic"),
+     lambda v: TF.interpolate(v, size=(11, 13), mode="bicubic")),
+    ("interp_area",
+     lambda v: F.interpolate(v, size=[4, 5], mode="area"),
+     lambda v: TF.interpolate(v, size=(4, 5), mode="area")),
+    ("maxpool_ceil",
+     lambda v: F.max_pool2d(v, 3, stride=2, ceil_mode=True),
+     lambda v: TF.max_pool2d(v, 3, stride=2, ceil_mode=True)),
+    ("maxpool_mask_custom_vjp",
+     lambda v: F.max_pool2d(v, 3, stride=2, padding=1, ceil_mode=True,
+                            return_mask=True)[0],
+     lambda v: TF.max_pool2d(v, 3, stride=2, padding=1, ceil_mode=True)),
+    ("avgpool_ceil_excl",
+     lambda v: F.avg_pool2d(v, 3, stride=2, padding=1, ceil_mode=True,
+                            exclusive=True),
+     lambda v: TF.avg_pool2d(v, 3, stride=2, padding=1, ceil_mode=True,
+                             count_include_pad=False)),
+]
+
+
+@pytest.mark.parametrize("name,ours,theirs", _GRAD_CASES,
+                         ids=[c[0] for c in _GRAD_CASES])
+def test_backward_matches_torch_autograd(name, ours, theirs):
+    """Gradients through the rewritten sampling/pooling kernels and the
+    custom-vjp mask path must equal torch autograd's."""
+    _cmp(_pgrad(ours, X), _tgrad(theirs, X), tol=1e-4)
+
+
+def test_grid_sample_gradients_match_torch():
+    grid = (RNG.rand(2, 6, 7, 2) * 2.2 - 1.1).astype("float32")
+    _cmp(_pgrad(lambda v: F.grid_sample(
+            v, paddle.to_tensor(grid), padding_mode="reflection",
+            align_corners=False), X),
+         _tgrad(lambda v: TF.grid_sample(
+            v, torch.tensor(grid), padding_mode="reflection",
+            align_corners=False), X), tol=1e-4)
+    gp = paddle.to_tensor(grid)
+    gp.stop_gradient = False
+    F.grid_sample(paddle.to_tensor(X), gp,
+                  align_corners=True).sum().backward()
+    gt = torch.tensor(grid, requires_grad=True)
+    TF.grid_sample(torch.tensor(X), gt,
+                   align_corners=True).sum().backward()
+    _cmp(gp.grad.numpy(), gt.grad, tol=1e-4)
+
+
+def test_ctc_loss_gradient_matches_torch():
+    T, N, C, S = 12, 3, 6, 4
+    lp = RNG.randn(T, N, C).astype("float32")
+    lab = RNG.randint(1, C, (N, S)).astype("int32")
+    il = np.full((N,), T, "int32")
+    ll = np.full((N,), S, "int32")
+    p_in = paddle.to_tensor(lp)
+    p_in.stop_gradient = False
+    F.ctc_loss(p_in, paddle.to_tensor(lab), paddle.to_tensor(il),
+               paddle.to_tensor(ll), blank=0).backward()
+    t_in = torch.tensor(lp, requires_grad=True)
+    TF.ctc_loss(torch.log_softmax(t_in, -1),
+                torch.tensor(lab.astype("int64")),
+                torch.tensor(il.astype("int64")),
+                torch.tensor(ll.astype("int64")), blank=0,
+                reduction="mean").backward()
+    _cmp(p_in.grad.numpy(), t_in.grad, tol=1e-3)
+
+
+def test_scatter_accumulate_reference_docstring():
+    """paddle scatter overwrite=False ZEROES the indexed rows first,
+    then accumulates — the reference docstring's own example."""
+    x = np.array([[1, 1], [2, 2], [3, 3]], "float32")
+    index = np.array([2, 1, 0, 1], "int64")
+    updates = np.array([[1, 1], [2, 2], [3, 3], [4, 4]], "float32")
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(index),
+                         paddle.to_tensor(updates),
+                         overwrite=False).numpy()
+    np.testing.assert_allclose(out, [[3, 3], [6, 6], [1, 1]])
