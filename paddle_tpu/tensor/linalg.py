@@ -18,6 +18,14 @@ __all__ = [
 
 
 def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(p, str) and p != "fro":
+        # reference tensor/linalg.py:282 — "Not supported: ord < 0 and
+        # nuclear norm" (paddle.linalg.cond DOES take p='nuc')
+        raise ValueError(
+            f"norm does not support string order {p!r}; supported: 'fro', "
+            "0, 1, 2, inf, -inf and positive real p (use linalg.cond for "
+            "p='nuc')")
+
     def _f(v):
         if axis is None:
             flat = v.reshape(-1)

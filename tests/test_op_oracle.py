@@ -408,3 +408,40 @@ def test_scatter_accumulate_reference_docstring():
                          paddle.to_tensor(updates),
                          overwrite=False).numpy()
     np.testing.assert_allclose(out, [[3, 3], [6, 6], [1, 1]])
+
+
+def test_linalg_matches_torch():
+    a = RNG.randn(4, 4).astype("float32")
+    spd = (a @ a.T + 4 * np.eye(4)).astype("float32")
+    b = RNG.randn(4, 3).astype("float32")
+    ap, at = paddle.to_tensor(a), torch.tensor(a)
+    _cmp(paddle.linalg.solve(paddle.to_tensor(spd),
+                             paddle.to_tensor(b)).numpy(),
+         torch.linalg.solve(torch.tensor(spd), torch.tensor(b)),
+         tol=1e-4)
+    _cmp(paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy(),
+         torch.linalg.cholesky(torch.tensor(spd)), tol=1e-4)
+    tri = np.tril(a + 4 * np.eye(4)).astype("float32")
+    _cmp(paddle.linalg.triangular_solve(paddle.to_tensor(tri),
+                                        paddle.to_tensor(b),
+                                        upper=False).numpy(),
+         torch.linalg.solve_triangular(torch.tensor(tri),
+                                       torch.tensor(b), upper=False),
+         tol=1e-4)
+    _cmp(paddle.linalg.pinv(ap).numpy(), torch.linalg.pinv(at),
+         tol=1e-3)
+    _cmp(paddle.linalg.matrix_power(ap, 3).numpy(),
+         torch.linalg.matrix_power(at, 3), tol=1e-3)
+    _cmp(paddle.linalg.det(ap).numpy(), torch.linalg.det(at), tol=1e-4)
+    for p in ("nuc", "fro", 1, -1, float("inf")):
+        _cmp(paddle.linalg.cond(ap, p=p).numpy(),
+             torch.linalg.cond(at, p), tol=1e-3)
+    evals, evecs = paddle.linalg.eigh(paddle.to_tensor(spd))
+    _cmp(evals.numpy(), torch.linalg.eigh(torch.tensor(spd)).eigenvalues,
+         tol=1e-3)
+    rec = (evecs.numpy() * evals.numpy()[None, :]) @ evecs.numpy().T
+    np.testing.assert_allclose(rec, spd, rtol=1e-3, atol=1e-3)
+    _, s, _ = paddle.linalg.svd(ap)
+    _cmp(s.numpy(), torch.linalg.svdvals(at), tol=1e-4)
+    with pytest.raises(ValueError, match="nuc"):
+        paddle.linalg.norm(ap, p="nuc")
