@@ -110,29 +110,53 @@ class Uniform(Distribution):
 
 
 class Categorical(Distribution):
+    """Reference distribution/categorical.py — NOTE its two-faced
+    normalization, reproduced faithfully: `logits` are treated as
+    nonnegative relative WEIGHTS for probs/log_prob/sample
+    (categorical.py:118 divides by the sum), but entropy and
+    kl_divergence exponentiate them as real logits
+    (categorical.py:218-262 softmax)."""
+
     def __init__(self, logits, name=None):
         lv = _val(logits)
+        self.raw = lv
         self.logits = lv - jax.scipy.special.logsumexp(lv, axis=-1, keepdims=True)
+        # sum-normalized weights (reference _prob) + their log, computed
+        # once: log_prob/sample in a training loop must not re-reduce
+        self._probs = lv / jnp.sum(lv, axis=-1, keepdims=True)
+        self._log_probs = jnp.log(self._probs)
         super().__init__(lv.shape[:-1])
 
     @property
     def probs_array(self):
-        return jnp.exp(self.logits)
+        return self._probs
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
-        return Tensor(jax.random.categorical(next_key(), self.logits, shape=shape))
+        return Tensor(jax.random.categorical(
+            next_key(), self._log_probs, shape=shape))
+
+    def _gather(self, table, value):
+        """Per-element category lookup with value/batch broadcasting —
+        the reference's own docstring queries a BATCH of categories
+        against an unbatched distribution (categorical.py log_prob
+        example: Categorical(x[6]).log_prob([2,1,3]) -> [3])."""
+        idx = _val(value).astype(jnp.int32)
+        b = jnp.broadcast_shapes(table.shape[:-1], idx.shape)
+        table_b = jnp.broadcast_to(table, b + table.shape[-1:])
+        idx_b = jnp.broadcast_to(idx, b)
+        return Tensor(jnp.take_along_axis(table_b, idx_b[..., None],
+                                          axis=-1)[..., 0])
 
     def log_prob(self, value):
-        idx = _val(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(self.logits, idx[..., None], axis=-1)[..., 0])
+        return self._gather(self._log_probs, value)
 
     def probs(self, value):
-        idx = _val(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(self.probs_array, idx[..., None], axis=-1)[..., 0])
+        return self._gather(self.probs_array, value)
 
     def entropy(self):
-        p = self.probs_array
+        # softmax convention (reference categorical.py:258-262)
+        p = jnp.exp(self.logits)
         return Tensor(-jnp.sum(p * self.logits, axis=-1))
 
 
@@ -296,7 +320,8 @@ def _kl_uniform(p, q):
 
 @register_kl(Categorical, Categorical)
 def _kl_categorical(p, q):
-    pr = p.probs_array
+    # softmax convention on both sides (reference categorical.py:218-223)
+    pr = jnp.exp(p.logits)
     return Tensor(jnp.sum(pr * (p.logits - q.logits), axis=-1))
 
 

@@ -37,6 +37,8 @@ def norm(x, p="fro", axis=None, keepdim=False, name=None):
                 return jnp.max(jnp.abs(flat))
             if p == -np.inf:
                 return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(v.dtype))
             return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
         ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
         if p == "fro":

@@ -121,7 +121,7 @@ def test_distributions():
     paddle.seed(0)
     s = n1.sample([1000])
     assert abs(float(s.numpy().mean())) < 0.2
-    c = Categorical(paddle.to_tensor([[0.0, 0.0]])._value)
+    c = Categorical(paddle.to_tensor([[1.0, 1.0]])._value)
     lp = c.log_prob(paddle.to_tensor([0])._value)
     np.testing.assert_allclose(np.asarray(lp._value), np.log(0.5), rtol=1e-5)
 

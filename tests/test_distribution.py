@@ -30,10 +30,20 @@ def test_normal_logprob_entropy_kl():
 def test_uniform_categorical():
     u = Uniform(0.0, 4.0)
     assert np.allclose(float(u.entropy().numpy()), np.log(4.0), atol=1e-6)
-    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
-    c = Categorical(paddle.to_tensor(logits))
+    # reference categorical.py:118 treats `logits` as nonnegative
+    # WEIGHTS normalized by their sum for probs/log_prob/sample
+    weights = np.array([0.1, 0.2, 0.7], np.float32)
+    c = Categorical(paddle.to_tensor(weights))
     assert np.allclose(float(c.log_prob(paddle.to_tensor(2)).numpy()),
                        np.log(0.7), atol=1e-5)
+    # the reference docstring's own batched-value-on-unbatched query
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor(np.array([0, 2], np.int64))).numpy(),
+        np.log([0.1, 0.7]), rtol=1e-5)
+    # entropy/kl keep the SOFTMAX convention (categorical.py:218-262)
+    soft = np.exp(weights) / np.exp(weights).sum()
+    assert np.allclose(float(c.entropy().numpy()),
+                       -(soft * np.log(soft)).sum(), atol=1e-5)
 
 
 def test_beta_dirichlet_multinomial_logprob():

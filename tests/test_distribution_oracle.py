@@ -1,0 +1,95 @@
+"""Distribution log_prob/entropy/kl pinned against torch.distributions.
+The Categorical rows encode the REFERENCE's two-faced normalization
+(sum-normalized weights for log_prob/probs/sample, softmax for
+entropy/kl — categorical.py:118 vs :218-262)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+torch = pytest.importorskip("torch")
+import torch.distributions as TD  # noqa: E402
+
+RNG = np.random.RandomState(0)
+V = RNG.randn(5).astype("float32")
+W = np.array([0.2, 0.3, 0.5], "float32")
+
+
+def _cmp(ours, theirs, tol=1e-4):
+    ours = np.asarray(ours._value if hasattr(ours, "_value") else ours)
+    theirs = theirs.detach().numpy() if hasattr(theirs, "detach") \
+        else np.asarray(theirs)
+    assert np.shape(ours) == np.shape(theirs)
+    np.testing.assert_allclose(ours, theirs, rtol=tol, atol=tol)
+
+
+def test_continuous_log_probs_match_torch():
+    _cmp(D.Normal(0.3, 1.7).log_prob(paddle.to_tensor(V)),
+         TD.Normal(0.3, 1.7).log_prob(torch.tensor(V)))
+    _cmp(D.Normal(0.3, 1.7).entropy(),
+         TD.Normal(torch.tensor(0.3), torch.tensor(1.7)).entropy())
+    b01 = (np.abs(V) % 0.9 + 0.05).astype("float32")
+    _cmp(D.Beta(2.0, 3.0).log_prob(paddle.to_tensor(b01)),
+         TD.Beta(2.0, 3.0).log_prob(torch.tensor(b01)))
+    _cmp(D.Beta(2.0, 3.0).entropy(), TD.Beta(2.0, 3.0).entropy())
+    _cmp(D.Uniform(-1.0, 2.0).log_prob(paddle.to_tensor(V % 1.0)),
+         TD.Uniform(-1.0, 2.0).log_prob(torch.tensor(V % 1.0)))
+    alpha = np.array([1.5, 2.0, 3.0], "float32")
+    _cmp(D.Dirichlet(paddle.to_tensor(alpha)).log_prob(
+            paddle.to_tensor(W)),
+         TD.Dirichlet(torch.tensor(alpha)).log_prob(torch.tensor(W)))
+    _cmp(D.Dirichlet(paddle.to_tensor(alpha)).entropy(),
+         TD.Dirichlet(torch.tensor(alpha)).entropy())
+    counts = np.array([1.0, 1.0, 2.0], "float32")
+    _cmp(D.Multinomial(4, paddle.to_tensor(W)).log_prob(
+            paddle.to_tensor(counts)),
+         TD.Multinomial(4, torch.tensor(W)).log_prob(
+            torch.tensor(counts)))
+
+
+def test_categorical_reference_conventions():
+    c = D.Categorical(paddle.to_tensor(W))
+    # log_prob: sum-normalized weights == torch's probs= convention,
+    # incl. the docstring's batched-value-on-unbatched query
+    _cmp(c.log_prob(paddle.to_tensor(np.array([0, 2], "int64"))),
+         TD.Categorical(probs=torch.tensor(W)).log_prob(
+            torch.tensor([0, 2])))
+    # entropy/kl: softmax convention == torch's logits= convention
+    _cmp(c.entropy(),
+         TD.Categorical(logits=torch.tensor(W)).entropy())
+    q = D.Categorical(paddle.to_tensor(W[::-1].copy()))
+    _cmp(D.kl_divergence(c, q),
+         TD.kl_divergence(TD.Categorical(logits=torch.tensor(W)),
+                          TD.Categorical(
+                            logits=torch.tensor(W[::-1].copy()))))
+
+
+def test_kl_matches_torch():
+    _cmp(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.5, 2.0)),
+         TD.kl_divergence(TD.Normal(0.0, 1.0), TD.Normal(0.5, 2.0)))
+    _cmp(D.kl_divergence(D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)),
+         TD.kl_divergence(TD.Beta(2.0, 3.0), TD.Beta(4.0, 1.5)))
+    a1 = np.array([1.5, 2.0, 3.0], "float32")
+    a2 = np.array([2.5, 1.0, 2.0], "float32")
+    _cmp(D.kl_divergence(D.Dirichlet(paddle.to_tensor(a1)),
+                         D.Dirichlet(paddle.to_tensor(a2))),
+         TD.kl_divergence(TD.Dirichlet(torch.tensor(a1)),
+                          TD.Dirichlet(torch.tensor(a2))))
+
+
+def test_transformed_exp_normal_is_lognormal():
+    td = D.TransformedDistribution(D.Normal(0.1, 0.9),
+                                   [D.ExpTransform()])
+    u = np.abs(V) + 0.1
+    _cmp(td.log_prob(paddle.to_tensor(u)),
+         TD.LogNormal(0.1, 0.9).log_prob(torch.tensor(u)))
+
+
+def test_categorical_sampling_follows_weights():
+    paddle.seed(0)
+    c = D.Categorical(paddle.to_tensor(np.array([0.1, 0.1, 0.8],
+                                                "float32")))
+    s = np.asarray(c.sample([4000])._value)
+    freq = np.bincount(s, minlength=3) / 4000
+    np.testing.assert_allclose(freq, [0.1, 0.1, 0.8], atol=0.04)

@@ -1,0 +1,58 @@
+"""Tensor __getitem__/__setitem__ pinned against numpy semantics
+(paddle follows numpy's advanced-indexing rules)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(0)
+X = RNG.randn(4, 5, 6).astype("float32")
+
+
+def _wrap(v):
+    return paddle.to_tensor(v)
+
+
+GET_CASES = [
+    ("int", lambda v, w: v[2]),
+    ("neg_slice_step", lambda v, w: v[::-1, 1:4]),
+    ("ellipsis", lambda v, w: v[..., 2]),
+    ("newaxis", lambda v, w: v[:, None, 3]),
+    ("int_array", lambda v, w: v[w(np.array([2, 0, 3]))]),
+    ("bool_mask_full", lambda v, w: v[w(X > 0)]),
+    ("bool_mask_axis0",
+     lambda v, w: v[w(np.array([True, False, True, False]))]),
+    ("two_int_arrays",
+     lambda v, w: v[w(np.array([1, 2])), w(np.array([3, 4]))]),
+    ("mixed_slice_array", lambda v, w: v[:, w(np.array([0, 2])), 1]),
+]
+
+
+@pytest.mark.parametrize("name,fn", GET_CASES,
+                         ids=[c[0] for c in GET_CASES])
+def test_getitem_matches_numpy(name, fn):
+    ours = np.asarray(fn(paddle.to_tensor(X), _wrap)._value)
+    want = fn(X, lambda v: v)
+    assert ours.shape == want.shape
+    np.testing.assert_allclose(ours, want, rtol=1e-6)
+
+
+SET_CASES = [
+    ("int", lambda v, w: v.__setitem__(1, 7.0)),
+    ("strided", lambda v, w: v.__setitem__((slice(None, None, 2), 1), 3.0)),
+    ("bool_mask", lambda v, w: v.__setitem__(w(X > 1), 0.0)),
+    ("int_array_rows", lambda v, w: v.__setitem__(
+        w(np.array([0, 2])), w(np.ones((2, 5, 6), "float32")))),
+    ("multislice", lambda v, w: v.__setitem__(
+        (slice(1, 3), slice(2, 4), 0), 9.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn", SET_CASES,
+                         ids=[c[0] for c in SET_CASES])
+def test_setitem_matches_numpy(name, fn):
+    ours = paddle.to_tensor(X.copy())
+    fn(ours, _wrap)
+    want = X.copy()
+    fn(want, lambda v: np.asarray(v))
+    np.testing.assert_allclose(np.asarray(ours._value), want, rtol=1e-6)
