@@ -320,3 +320,87 @@ def test_momentum_rescale_grad_does_not_scale_weight_decay():
     oa.step()
     expected = w0 - 0.1 * (0.25 * g + wd * w0)
     np.testing.assert_allclose(a.weight.numpy(), expected, rtol=1e-5)
+
+
+def test_adagrad_exact_update_rule():
+    """Reference adagrad.py:26: moment += g^2;
+    param -= lr*g/(sqrt(moment)+eps) — note eps OUTSIDE the sqrt."""
+    lr, eps = 0.1, 1e-6
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    p = paddle.framework.Parameter(w0.copy())
+    opt = paddle.optimizer.Adagrad(learning_rate=lr, epsilon=eps,
+                                   parameters=[p])
+    moment = np.zeros_like(w0)
+    want = w0.copy()
+    for _ in range(3):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        moment += g * g
+        want -= lr * g / (np.sqrt(moment) + eps)
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-6)
+
+
+def test_rmsprop_exact_update_rule():
+    """Reference rmsprop.py:32 (momentum form): r = rho*r + (1-rho)g^2;
+    v = beta*v + lr*g/sqrt(r+eps); w -= v."""
+    lr, rho, eps, beta = 0.05, 0.95, 1e-6, 0.9
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    p = paddle.framework.Parameter(w0.copy())
+    opt = paddle.optimizer.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                                   momentum=beta, parameters=[p])
+    r = np.zeros_like(w0)
+    v = np.zeros_like(w0)
+    want = w0.copy()
+    for _ in range(3):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        r = rho * r + (1 - rho) * g * g
+        v = beta * v + lr * g / np.sqrt(r + eps)
+        want -= v
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-6)
+
+
+def test_adadelta_exact_update_rule():
+    """Reference adadelta.py:34-40: Eg = rho*Eg + (1-rho)g^2;
+    delta = sqrt((Edx+eps)/(Eg+eps)) * g; Edx = rho*Edx + (1-rho)d^2;
+    w -= lr*delta."""
+    lr, rho, eps = 1.0, 0.9, 1e-6
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    p = paddle.framework.Parameter(w0.copy())
+    opt = paddle.optimizer.Adadelta(learning_rate=lr, rho=rho,
+                                    epsilon=eps, parameters=[p])
+    Eg = np.zeros_like(w0)
+    Edx = np.zeros_like(w0)
+    want = w0.copy()
+    for _ in range(3):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        Eg = rho * Eg + (1 - rho) * g * g
+        delta = np.sqrt((Edx + eps) / (Eg + eps)) * g
+        Edx = rho * Edx + (1 - rho) * delta * delta
+        want -= lr * delta
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-5)
+
+
+def test_adamax_exact_update_rule():
+    """Reference adamax.py:28-42: m = b1*m + (1-b1)g;
+    u = max(b2*u + eps, |g|); w -= lr/(1-b1^t) * m/u."""
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    p = paddle.framework.Parameter(w0.copy())
+    opt = paddle.optimizer.Adamax(learning_rate=lr, beta1=b1, beta2=b2,
+                                  epsilon=eps, parameters=[p])
+    m = np.zeros_like(w0)
+    u = np.zeros_like(w0)
+    want = w0.copy()
+    for t in range(1, 4):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u + eps, np.abs(g))
+        want -= lr / (1 - b1 ** t) * m / u
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-5)
