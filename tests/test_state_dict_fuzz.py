@@ -1,0 +1,81 @@
+"""Property fuzz of the reference state_dict protocol over random
+module trees with tied parameters: every structured name appears
+(including every alias of a shared tensor), save -> load round-trips
+with no missing/unexpected keys, and named_parameters keeps its
+dedup."""
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _build(rng, depth=0):
+    n_children = rng.randint(1, 3) if depth < 2 else 0
+    layer = nn.Layer()
+    dims = rng.choice([2, 3, 4])
+    layer.add_sublayer("lin", nn.Linear(dims, dims))
+    if rng.random() < 0.5:
+        layer.register_buffer(
+            "buf", paddle.to_tensor(np.ones((dims,), "float32")),
+            persistable=rng.random() < 0.7)
+    for i in range(n_children):
+        layer.add_sublayer(f"c{i}", _build(rng, depth + 1))
+    return layer
+
+
+def _collect_linears(layer, out):
+    for _, sub in layer.named_sublayers():
+        if isinstance(sub, nn.Linear):
+            out.append(sub)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_state_dict_roundtrip_with_random_tying(seed):
+    rng = random.Random(seed)
+    net = _build(rng)
+    # tie a few same-shaped weights
+    linears = _collect_linears(net, [])
+    by_shape = {}
+    for lin in linears:
+        by_shape.setdefault(tuple(lin.weight.shape), []).append(lin)
+    n_tied = 0
+    for group in by_shape.values():
+        if len(group) >= 2 and rng.random() < 0.8:
+            for other in group[1:]:
+                other.weight = group[0].weight
+                n_tied += 1
+
+    sd = net.state_dict()
+    # every structured parameter name present — tied aliases included
+    names = {n for n, _ in net.named_parameters()}
+    structured = set()
+    for lname, sub in [("", net)] + list(net.named_sublayers()):
+        prefix = lname + "." if lname else ""
+        for pname, p in sub._parameters.items():
+            if p is not None:
+                structured.add(prefix + pname)
+    assert structured <= set(sd), structured - set(sd)
+    # named_parameters dedups ties; state_dict does not
+    assert len(sd) >= len(names)
+    if n_tied:
+        assert len(sd) > len(names)
+        shared = [k for k in sd
+                  if any(sd[k] is sd[j] for j in sd if j != k)]
+        assert len(shared) >= 2
+
+    # round-trip through raw numpy (a reference checkpoint shape)
+    ckpt = {k: v.numpy().copy() for k, v in sd.items()}
+    fresh = _rebuild_like(net)
+    missing, unexpected = fresh.set_state_dict(ckpt)
+    assert not missing and not unexpected, (missing, unexpected)
+    for k, v in fresh.state_dict().items():
+        np.testing.assert_array_equal(v.numpy(), ckpt[k])
+
+
+def _rebuild_like(net):
+    import copy
+    return copy.deepcopy(net)
