@@ -383,3 +383,30 @@ def test_inference_config_toggles_map_to_real_choices():
     t = paddle.to_tensor(x)
     don_pred.run([t]); don_pred.run([t])
     np.testing.assert_allclose(t.numpy(), x)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_continuous_batching_fuzz_matches_golden(tiny_model, seed):
+    """Randomized admission churn: random prompt lengths and request
+    counts (always exceeding the slot count), with EOS enabled so some
+    sequences retire early — every request's output must equal its
+    isolated golden greedy decode truncated at EOS."""
+    rng = np.random.RandomState(seed)
+    dec = PagedGPTDecoder(tiny_model, num_pages=48, page_size=16,
+                          max_batch=3)
+    eos = int(rng.randint(0, tiny_model.cfg.vocab_size))
+    max_new = int(rng.randint(3, 9))
+    eng = ContinuousBatchingEngine(dec, eos_token_id=eos,
+                                   max_new_tokens=max_new)
+    n_req = int(rng.randint(4, 8))
+    prompts = [list(rng.randint(0, tiny_model.cfg.vocab_size,
+                                rng.randint(1, 12)).astype(int))
+               for _ in range(n_req)]
+    rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+    outs = eng.run()
+    for rid, p in zip(rids, prompts):
+        golden = _golden_greedy(tiny_model, p, max_new)
+        if eos in golden:
+            golden = golden[:golden.index(eos) + 1]
+        assert outs[rid] == golden, (p, eos, max_new)
+    assert len(eng._free) == dec.num_pages - 1   # no page leaks
