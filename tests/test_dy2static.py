@@ -1258,3 +1258,43 @@ def test_early_return_inside_with_block():
         np.testing.assert_allclose(
             np.asarray(f(paddle.to_tensor(np.asarray([v, v], "float32")))._value),
             np.asarray(paddle.jit.to_static(f)(x)._value), rtol=1e-5)
+
+
+class _EarlyReturnGate(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        for _ in range(2):
+            h = h * 1.1
+            if paddle.sum(h) > 40.0:
+                return h * 10.0
+        if paddle.max(h) > 0:
+            return h + 1.0
+        return h - 1.0
+
+
+def test_early_returns_through_jit_save(tmp_path):
+    """Functionalized early returns (loop carrier + nested partial ifs)
+    survive jit.save -> jit.load AND the Predictor's executable
+    jax.export artifact, hitting all three return paths."""
+    paddle.seed(0)
+    net = _EarlyReturnGate()
+    path = str(tmp_path / "gate")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    for v in (1.0, -3.0, 9.0):
+        x = np.full((2, 4), v, "float32")
+        np.testing.assert_allclose(
+            loaded(paddle.to_tensor(x)).numpy(),
+            net(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    out = pred.run([np.full((2, 4), 9.0, "float32")])
+    first = out[0].numpy() if hasattr(out[0], "numpy") else np.asarray(out[0])
+    np.testing.assert_allclose(
+        first, net(paddle.to_tensor(np.full((2, 4), 9.0, "float32"))).numpy(),
+        rtol=1e-5)
