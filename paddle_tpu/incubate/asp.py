@@ -17,7 +17,22 @@ __all__ = ["prune_model", "decorate", "set_excluded_layers",
            "create_mask", "check_mask_1d", "check_mask_2d"]
 
 _EXCLUDED = set()
-_MASKS = {}  # id(param) -> mask jnp array
+# id(param) -> (weakref(param), mask). The weakref guards against id
+# RECYCLING: CPython reuses a freed parameter's id, so a bare id-keyed
+# dict could hand a brand-new parameter a stale (wrong-shaped) mask —
+# observed as a test-order-dependent broadcast ValueError.
+_MASKS = {}
+
+
+def _mask_for(p):
+    entry = _MASKS.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:      # id recycled by a dead parameter
+        del _MASKS[id(p)]
+        return None
+    return mask
 
 
 def set_excluded_layers(main_program=None, param_names=None):
@@ -164,7 +179,8 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = create_mask(p, mask_algo, n, m)
         jmask = jnp.asarray(mask, p._value.dtype)
         p._value = p._value * jmask
-        _MASKS[id(p)] = jmask
+        import weakref
+        _MASKS[id(p)] = (weakref.ref(p), jmask)
         pruned[name] = float(mask.mean())
     return pruned
 
@@ -177,7 +193,7 @@ def decorate(optimizer):
     def step():
         inner_step()
         for p in optimizer._parameter_list or []:
-            mask = _MASKS.get(id(p))
+            mask = _mask_for(p)
             if mask is not None:
                 p._value = p._value * mask
 

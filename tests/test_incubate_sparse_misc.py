@@ -250,3 +250,30 @@ def test_asp_mask_2d_best_and_validation():
     assert (best.sum(0) == 4).all() and (best.sum(1) == 4).all()
     with pytest.raises(ValueError, match="unknown mask algorithm"):
         asp.create_mask(w, func_name="mask2d_greedy")
+
+
+def test_asp_masks_survive_id_recycling():
+    """A dead pruned parameter's recycled id() must not hand its stale
+    mask to a brand-new parameter (was a test-order-dependent broadcast
+    ValueError in the decorated step)."""
+    import gc
+
+    from paddle_tpu.incubate import asp
+
+    m1 = nn.Linear(8, 8)
+    asp.prune_model(m1)
+    dead_id = id(m1.weight)
+    del m1
+    gc.collect()
+    # allocate parameters until one lands on the recycled id (usually
+    # immediate in CPython), then step a decorated optimizer over it
+    for _ in range(64):
+        p = paddle.framework.Parameter(
+            np.ones((3,), "float32"))        # different SHAPE than mask
+        if id(p) == dead_id:
+            break
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=[p]))
+    p.grad = paddle.to_tensor(np.ones((3,), "float32"))
+    opt.step()                                # must not apply a stale mask
+    np.testing.assert_allclose(p.numpy(), 0.9, rtol=1e-6)
