@@ -42,8 +42,15 @@ class Accuracy(Metric):
         pred_np = _np(pred)
         label_np = _np(label)
         idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        # reference metrics.py Accuracy.compute: a trailing dim of 1 means
+        # INDEX labels [N, ..., 1]; only wider trailing dims are one-hot.
+        # (ndim alone misclassifies [N, 1] int labels as one-hot and
+        # argmax turns every label into class 0.)
         if label_np.ndim == pred_np.ndim:
-            label_np = np.argmax(label_np, axis=-1)
+            if label_np.shape[-1] == 1:
+                label_np = label_np[..., 0]
+            else:
+                label_np = np.argmax(label_np, axis=-1)
         correct = (idx == label_np[..., None]).astype(np.float32)
         return Tensor(correct)
 

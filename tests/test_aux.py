@@ -207,3 +207,41 @@ def test_device_memory_queries():
     import pytest
     with pytest.raises(ValueError, match="invalid device"):
         device.memory_allocated("tpu:99")
+
+
+def test_accuracy_index_and_onehot_labels():
+    """[N, 1] trailing-1 labels are INDEX labels (the reference rule);
+    only wider trailing dims are one-hot — the ndim heuristic argmax'd
+    every [N,1] label to class 0, freezing hapi accuracy at ~1/C."""
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, (32,))
+    perfect = np.full((32, 10), -5.0, "float32")
+    for i, c in enumerate(labels):
+        perfect[i, c] = 5.0
+
+    m = paddle.metric.Accuracy()
+    m.update(m.compute(paddle.to_tensor(perfect),
+                       paddle.to_tensor(labels.reshape(-1, 1))))
+    assert m.accumulate() == 1.0
+
+    m2 = paddle.metric.Accuracy()    # flat [N] index labels
+    m2.update(m2.compute(paddle.to_tensor(perfect),
+                         paddle.to_tensor(labels)))
+    assert m2.accumulate() == 1.0
+
+    onehot = np.eye(10, dtype="float32")[labels]
+    m3 = paddle.metric.Accuracy()
+    m3.update(m3.compute(paddle.to_tensor(perfect),
+                         paddle.to_tensor(onehot)))
+    assert m3.accumulate() == 1.0
+
+    # top-2: predictor whose 2nd choice is always right
+    second = np.full((32, 10), -5.0, "float32")
+    for i, c in enumerate(labels):
+        second[i, (c + 1) % 10] = 5.0
+        second[i, c] = 4.0
+    m4 = paddle.metric.Accuracy(topk=(1, 2))
+    m4.update(m4.compute(paddle.to_tensor(second),
+                         paddle.to_tensor(labels.reshape(-1, 1))))
+    top1, top2 = m4.accumulate()
+    assert top1 == 0.0 and top2 == 1.0
