@@ -20,6 +20,9 @@ __all__ = ["Model"]
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = (list(inputs) if isinstance(inputs, (list, tuple))
+                        else [inputs]) if inputs is not None else None
+        self._labels = labels
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -206,8 +209,20 @@ class Model:
     def save(self, path, training=True):
         from ..framework.io import save as fsave
         self._sync_params_back()
+        if not training:
+            # reference Model.save(training=False): export the INFERENCE
+            # program (jit.save artifact executable without the Python
+            # network) — requires the input specs given at Model(...)
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference "
+                    "program and needs input specs: construct the model "
+                    "as Model(net, inputs=[InputSpec(...)])")
+            from .. import jit
+            jit.save(self.network, path, input_spec=self._inputs)
+            return
         fsave(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             fsave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

@@ -57,3 +57,34 @@ def test_fit_learns_and_metrics_track():
 
     model.fit(loader, epochs=2, verbose=0, callbacks=[Spy()])
     assert len(seen) == 2 and "loss" in seen[0][1]
+
+
+def test_model_save_inference_export(tmp_path):
+    """Model.save(training=False) exports the executable inference
+    program via jit.save (the reference behavior), using the InputSpec
+    given at construction; training=True keeps the ckpt pair."""
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(64, 4))
+    model = paddle.Model(net, inputs=[InputSpec([2, 1, 8, 8], "float32")])
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=model.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    path = str(tmp_path / "m")
+    model.save(path)                         # training checkpoint
+    import os
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model.save(path + "_infer", training=False)
+    loaded = paddle.jit.load(path + "_infer")
+    x = paddle.to_tensor(np.zeros((2, 1, 8, 8), "float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5)
+
+    # without input specs the export fails with guidance, not silently
+    bare = paddle.Model(nn.Linear(4, 2))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="InputSpec"):
+        bare.save(str(tmp_path / "bare"), training=False)
