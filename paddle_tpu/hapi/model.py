@@ -85,8 +85,10 @@ class Model:
         lr = self._optimizer.get_lr()
         self._params, self._opt_state, self._buffers, loss_v, out = self._compiled_step(
             self._params, self._buffers, self._opt_state, lr, in_vals, lab_vals)
-        if self._optimizer._lr_scheduler is not None:
-            self._optimizer._lr_scheduler.step()
+        # scheduler stepping belongs to the LRScheduler CALLBACK (fit
+        # auto-configures one, reference hapi/callbacks.config_callbacks)
+        # — stepping here too would double-advance it whenever a user
+        # adds the callback explicitly, as the reference docs show
         metrics_out = []
         for m in self._metrics:
             correct = m.compute(Tensor(out), labels[0])
@@ -129,7 +131,14 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle,
             drop_last=drop_last, num_workers=num_workers)
-        cbs = CallbackList([ProgBarLogger(log_freq, verbose)] + (callbacks or []))
+        from .callbacks import LRScheduler
+        user_cbs = list(callbacks or [])
+        auto = [ProgBarLogger(log_freq, verbose)]
+        # reference config_callbacks: an LRScheduler callback is always
+        # present (it owns scheduler stepping); a user-provided one wins
+        if not any(isinstance(c, LRScheduler) for c in user_cbs):
+            auto.append(LRScheduler())
+        cbs = CallbackList(auto + user_cbs)
         cbs.set_model(self)
         try:
             cbs.set_params({"epochs": epochs, "steps": len(loader)})

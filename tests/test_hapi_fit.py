@@ -88,3 +88,27 @@ def test_model_save_inference_export(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="InputSpec"):
         bare.save(str(tmp_path / "bare"), training=False)
+
+
+def test_lr_scheduler_steps_once_per_batch():
+    """The LRScheduler CALLBACK owns scheduler stepping (reference
+    config_callbacks): fit auto-adds one, and a user-supplied callback
+    replaces it — the scheduler must advance exactly once per batch
+    either way (train_batch stepping it too would double-advance)."""
+    def run(callbacks):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 4))
+        model = paddle.Model(net)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        model.prepare(paddle.optimizer.SGD(learning_rate=sched,
+                                           parameters=model.parameters()),
+                      paddle.nn.CrossEntropyLoss())
+        loader = DataLoader(PatchDigits(n=96), batch_size=32)  # 3 batches
+        model.fit(loader, epochs=1, verbose=0, callbacks=callbacks)
+        return sched.last_epoch
+
+    assert run(None) == 3                      # auto-added callback
+    assert run([paddle.callbacks.LRScheduler()]) == 3   # no double step
+    assert run([paddle.callbacks.LRScheduler(by_step=False,
+                                             by_epoch=True)]) == 1
