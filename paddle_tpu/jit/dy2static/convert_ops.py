@@ -205,6 +205,8 @@ def _public_name(n):
     if isinstance(n, str):
         if n.startswith("_retv_"):
             return "return value"
+        if n.startswith("_ptlk_"):
+            return "loop variable " + n.split("_", 3)[-1]
         if n.startswith("_retf_"):
             return "return flag"
         if n.startswith("_brk_"):
@@ -264,7 +266,7 @@ def _fix_ret_placeholders(true_fn, false_fn, t_out, f_out, stash, names):
     for pos, nm in enumerate(names):
         if stash["t"][1][pos] == stash["f"][1][pos]:
             continue
-        if not nm.startswith("_retv_"):
+        if not nm.startswith(("_retv_", "_ptlk_")):
             return None
         static_v = f_full[pos] if stash["t"][1][pos] else t_full[pos]
         if static_v is not None and static_v is not UNDEF:
@@ -436,7 +438,8 @@ def _init_ret_carries(run_body, operands, names):
     while/for callers bind their iteration argument).  Real user
     variables are left alone for _check_no_undef's diagnostic."""
     pending = [i for i, (n, v) in enumerate(zip(names, operands))
-               if n.startswith("_retv_") and (v is None or v is UNDEF)]
+               if n.startswith(("_retv_", "_ptlk_"))
+               and (v is None or v is UNDEF)]
     if not pending:
         return operands
     try:

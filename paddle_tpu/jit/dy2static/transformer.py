@@ -833,6 +833,20 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             tnames = [e.id for e in node.target.elts]
         else:
             tnames = None
+        # python LEAKS the loop target: code after the loop (including an
+        # enclosing same-name loop's tests — the nested-shadow shape)
+        # reads whatever `j` holds at loop exit, INCLUDING rebinds by
+        # inner same-name loops mid-iteration. When leaked, the target
+        # becomes an ORDINARY carried variable: the body fn receives the
+        # iterated value under a fresh `_ptt_` parameter and re-binds the
+        # real name at iteration start (inside the brk guard, so a traced
+        # break freezes it), letting the standard loop_vars machinery
+        # thread every later rebind out.
+        leak_uid = self._uid()
+        leaked = [t for t in (tnames or []) if t in tail]
+        fresh = {t: f"_ptt_{leak_uid}_{t}" for t in leaked}
+        renames = [ast.Assign(targets=[_name(t, ast.Store())],
+                              value=_name(fresh[t])) for t in leaked]
         prelude, brk = ([], None) if tnames is None \
             else self._rewrite_jumps(node)
         if brk:
@@ -841,7 +855,8 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             # early-stops inside convert_for via the brk kwarg)
             wrap = ast.If(
                 test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
-                body=node.body, orelse=[])
+                body=renames + node.body, orelse=[])
+            renames = []                        # consumed by the wrap
             self._tail_reads[id(wrap)] = (
                 self._after_reads.get(id(node), set())
                 | _use_before_def(node.body, _reads(node), self._locals)
@@ -853,27 +868,38 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 or _has_scope_escape(node.body)):
             return prelude + [node]
         stored = [n for n in _stores(node.body, self._locals)
-                  if n not in tnames]
+                  if n not in set(tnames) - set(leaked)]
         carried = _use_before_def(node.body, set(stored), self._locals)
         loop_vars = [n for n in stored if n in carried or n in tail]
+        for t in leaked:
+            if t not in loop_vars:
+                loop_vars.append(t)
         if not loop_vars:
             return prelude + [node]
+        uid = self._uid()
         it = node.iter
         if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range"):
             it = _call(_jst("convert_range"), it.args)
-        uid = self._uid()
         bname = f"_pt_for_body_{uid}"
-        b_fn = _make_fn(bname, tnames + loop_vars,
-                        node.body + [_ret_tuple(loop_vars)])
+        params = [fresh.get(t, t) for t in tnames] + loop_vars
+        b_fn = _make_fn(bname, params,
+                        renames + node.body + [_ret_tuple(loop_vars)])
         kwargs = [("target_arity", ast.Constant(value=len(tnames)))]
         if brk:
             kwargs.append(("brk", ast.Constant(value=brk)))
+        # leaked-target SLOTS are named with the _ptlk_ prefix so the
+        # traced-scan path may seed an unbound leak with a zeros
+        # placeholder (_init_ret_carries) — the body overwrites it every
+        # iteration, so it is unobservable for any >=1-trip scan, where
+        # rejecting it would break `for k in tensor: ...; use(k)`
+        slot_names = [f"_ptlk_{uid}_{n}" if n in leaked else n
+                      for n in loop_vars]
         call = _call(_jst("convert_for"),
                      [it, _name(bname),
                       ast.Tuple(elts=[_arg_thunk(n) for n in loop_vars],
                                 ctx=ast.Load()),
-                      _const_tuple(loop_vars)],
+                      _const_tuple(slot_names)],
                      kwargs=kwargs)
         return prelude + [b_fn, _assign_tuple(loop_vars, call)]
 

@@ -1298,3 +1298,107 @@ def test_early_returns_through_jit_save(tmp_path):
     np.testing.assert_allclose(
         first, net(paddle.to_tensor(np.full((2, 4), 9.0, "float32"))).numpy(),
         rtol=1e-5)
+
+
+# -- loop-target leak semantics (python: `for j ...` leaks j) ---------------
+
+def test_loop_target_leaks_after_loop():
+    def h(x):
+        for k in range(4):
+            x = x + 1.0
+        return x + k
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(
+        paddle.jit.to_static(h)(x).numpy(),
+        h(paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))).numpy())
+
+
+def test_sequential_same_name_loops_leak():
+    def g(x):
+        for i in range(2):
+            x = x + 1.0
+        for i in range(3):
+            x = x + 0.5
+        if i == 2:
+            x = x * 2.0
+        return x
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(
+        paddle.jit.to_static(g)(x).numpy(),
+        g(paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))).numpy())
+
+
+def test_nested_shadowed_loop_targets():
+    """Nested loops sharing one target name: the inner loop's final j is
+    what the outer body's tests read afterwards (python shares ONE
+    binding), including through break/continue and mid-iteration
+    rebinds — the fuzz-found silent-mismatch class."""
+    def f(x):
+        for j in range(3):
+            for j in range(2):
+                x = x + 1.0
+            if j == 1:
+                x = x * 2.0
+        return x
+
+    def t2(x):
+        for j in range(4):
+            for j in range(2):
+                x = x + 1.0
+            if j == 1:
+                break
+        if j == 1:
+            x = x * 2.0
+        return x
+
+    def t1(x):
+        for j in range(3):
+            x = x * 0.5 + 0.1
+            for j in range(4):
+                for j in range(2):
+                    x = x + 0.7
+                    if j == 0:
+                        continue
+                    x = x + 0.01
+                if j == 1:
+                    break
+            if j == 0:
+                continue
+            x = x + 0.01
+        return x
+
+    def d(x):
+        if paddle.max(x) < 100.0:      # whole nest under a traced branch
+            for j in range(2):
+                for j in range(2):
+                    x = x - 1.2
+                    if j == 1:
+                        break
+                if j % 2 == 0:
+                    x = x * 2.0
+        return x
+
+    for fn in (f, t2, t1, d):
+        x = np.asarray([1.0, 0.5], "float32")
+        want = fn(paddle.to_tensor(x)).numpy()
+        got = paddle.jit.to_static(fn)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=3e-5,
+                                   err_msg=fn.__name__)
+
+
+def test_tensor_iterable_target_leak():
+    """`for k in tensor:` then reading k after the loop (lax.scan path):
+    the leaked target's carry seeds with an unobservable placeholder and
+    ends as the last slice."""
+    def h(x):
+        s = x[0] * 0.0
+        for k in x:
+            s = s + k
+        return s + k
+
+    x = np.asarray([2.0, 3.0, 4.0], "float32")
+    np.testing.assert_allclose(
+        paddle.jit.to_static(h)(paddle.to_tensor(x)).numpy(),
+        h(paddle.to_tensor(x)).numpy())

@@ -110,3 +110,81 @@ def test_random_control_flow_program(seed, tmp_path):
         np.testing.assert_allclose(
             got, want, rtol=2e-5, atol=1e-6,
             err_msg=f"eager/converted mismatch on input {v} for:\n{src}")
+
+
+class _DeepGen(_Gen):
+    """Nastier generator: nested loops SHARE the target name `j` (python
+    shares one binding — the leak-semantics class), deeper nesting,
+    jumps at any level."""
+
+    def block(self, depth, in_loop, indent, allow_return):
+        lines = []
+        n = self.rng.randint(1, 4)
+        for _ in range(n):
+            kind = self.rng.random()
+            if kind < 0.35 or depth >= 3:
+                lines.append(f"{indent}x = {self.expr()}")
+            elif kind < 0.7:
+                body = self.block(depth + 1, in_loop, indent + "    ",
+                                  allow_return)
+                line = [f"{indent}if {self.cond(in_loop)}:"] + body
+                if self.rng.random() < 0.6:
+                    line += [f"{indent}else:"] + self.block(
+                        depth + 1, in_loop, indent + "    ", allow_return)
+                lines += line
+            elif kind < 0.88:
+                body = self.block(depth + 1, True, indent + "    ",
+                                  allow_return and not in_loop)
+                jump = self.rng.random()
+                if jump < 0.35:
+                    body.append(f"{indent}    if j == 1:")
+                    body.append(f"{indent}        break")
+                elif jump < 0.55:
+                    body.append(f"{indent}    if j == 0:")
+                    body.append(f"{indent}        continue")
+                    body.append(f"{indent}    x = x + 0.01")
+                lines.append(
+                    f"{indent}for j in range({self.rng.randint(2, 4)}):")
+                lines += body
+            else:
+                if allow_return and self.rng.random() < 0.6:
+                    lines.append(f"{indent}if {self.cond(in_loop)}:")
+                    lines.append(f"{indent}    return {self.expr()}")
+                else:
+                    lines.append(f"{indent}x = {self.expr()}")
+        return lines
+
+
+def _make_deep_program(seed):
+    g = _DeepGen(random.Random(seed))
+    body = g.block(0, False, "    ", allow_return=True)
+    return "\n".join(["import paddle_tpu as paddle", "",
+                      f"def f{seed}(x):"] + body
+                     + ["    return x - 0.25", ""])
+
+
+@pytest.mark.parametrize("seed", range(2000, 2040))
+def test_deep_shadowed_control_flow(seed, tmp_path):
+    """Eager == converted, OR a clear dy2static diagnostic (a variable
+    bound on only one data-dependent branch genuinely cannot compile to
+    lax.cond — python only works by taking one concrete path). A silent
+    numeric mismatch is always a failure."""
+    src = _make_deep_program(seed)
+    mod_file = tmp_path / f"deep_{seed}.py"
+    mod_file.write_text(src)
+    spec = importlib.util.spec_from_file_location(f"deep_{seed}", mod_file)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn = getattr(mod, f"f{seed}")
+    static = paddle.jit.to_static(fn)
+    for v in INPUTS[:2]:
+        x = np.asarray([v, v * 0.5], "float32")
+        want = fn(paddle.to_tensor(x)).numpy()
+        try:
+            got = static(paddle.to_tensor(x)).numpy()
+        except TypeError as e:
+            assert "dy2static" in str(e), f"non-diagnostic error for:\n{src}"
+            continue
+        np.testing.assert_allclose(
+            got, want, rtol=3e-5, atol=1e-6,
+            err_msg=f"eager/converted mismatch on input {v} for:\n{src}")
