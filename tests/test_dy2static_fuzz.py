@@ -188,3 +188,55 @@ def test_deep_shadowed_control_flow(seed, tmp_path):
         np.testing.assert_allclose(
             got, want, rtol=3e-5, atol=1e-6,
             err_msg=f"eager/converted mismatch on input {v} for:\n{src}")
+
+
+def _make_while_program(seed):
+    rng = random.Random(seed)
+    lines = ["import paddle_tpu as paddle", "", f"def f{seed}(x):",
+             "    i = 0"]
+    ind2 = "        "
+    lines.append(f"    while i < {rng.randint(3, 6)}:")
+    lines.append(f"{ind2}i = i + 1")
+    for _ in range(rng.randint(2, 4)):
+        k = rng.random()
+        if k < 0.4:
+            lines.append(f"{ind2}x = x * 0.8 + 0.1")
+        elif k < 0.6:
+            lines.append(f"{ind2}if paddle.sum(x) > {rng.uniform(-2, 4):.1f}:")
+            lines.append(f"{ind2}    x = x - 0.3")
+            if rng.random() < 0.5:
+                lines.append(f"{ind2}else:")
+                lines.append(f"{ind2}    x = x + 0.2")
+        elif k < 0.75:
+            lines.append(f"{ind2}if i == {rng.randint(1, 3)}:")
+            lines.append(
+                f"{ind2}    {'break' if rng.random() < 0.5 else 'continue'}")
+        else:
+            lines.append(f"{ind2}if paddle.max(x) > {rng.uniform(0, 5):.1f}:")
+            lines.append(f"{ind2}    return x * {rng.uniform(0.5, 2):.2f}")
+    lines.append("    return x + i * 0.01")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.mark.parametrize("seed", range(3000, 3025))
+def test_while_loop_programs(seed, tmp_path):
+    """while-loops with counter + tensor conditions, jumps, and early
+    returns: eager == converted (or a clear dy2static diagnostic)."""
+    src = _make_while_program(seed)
+    mod_file = tmp_path / f"wf_{seed}.py"
+    mod_file.write_text(src)
+    spec = importlib.util.spec_from_file_location(f"wf_{seed}", mod_file)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn = getattr(mod, f"f{seed}")
+    static = paddle.jit.to_static(fn)
+    for v in (1.0, -2.0, 5.0):
+        x = np.asarray([v, v * 0.5], "float32")
+        want = fn(paddle.to_tensor(x)).numpy()
+        try:
+            got = static(paddle.to_tensor(x)).numpy()
+        except TypeError as e:
+            assert "dy2static" in str(e), src
+            continue
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-6,
+                                   err_msg=src)
