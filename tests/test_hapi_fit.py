@@ -112,3 +112,31 @@ def test_lr_scheduler_steps_once_per_batch():
     assert run([paddle.callbacks.LRScheduler()]) == 3   # no double step
     assert run([paddle.callbacks.LRScheduler(by_step=False,
                                              by_epoch=True)]) == 1
+
+
+def test_fit_save_dir_and_resume(tmp_path):
+    """fit(save_dir=...) writes per-epoch param+opt checkpoints that
+    Model.load restores exactly (same eval accuracy) and training
+    resumes from the checkpointed optimizer state."""
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 4))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=m.parameters()),
+                  paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        return m
+
+    loader = DataLoader(PatchDigits(), batch_size=32)
+    m1 = build()
+    m1.fit(loader, epochs=3, verbose=0, save_dir=str(tmp_path))
+    acc1 = float(m1.evaluate(loader, verbose=0)["acc"])
+    assert (tmp_path / "2.pdparams").exists()
+    assert (tmp_path / "2.pdopt").exists()
+
+    m2 = build()
+    m2.load(str(tmp_path / "2"))
+    acc2 = float(m2.evaluate(loader, verbose=0)["acc"])
+    assert abs(acc1 - acc2) < 1e-6
+    m2.fit(loader, epochs=1, verbose=0)
+    assert float(m2.evaluate(loader, verbose=0)["acc"]) >= acc2 - 0.05
