@@ -306,3 +306,33 @@ def test_plain_empty_tensor_set_value_still_validates():
     t = paddle.to_tensor(np.array([], dtype="float32"))
     with pytest.raises(ValueError, match="shape mismatch"):
         t.set_value(np.ones((3, 3), "float32"))
+
+
+def test_forward_hooks_contract():
+    """Reference forward hook contract: pre-hooks may rewrite inputs,
+    post-hooks may replace outputs, handles remove cleanly."""
+    lin = paddle.nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(
+        lambda layer, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(
+        lambda layer, inp, out: calls.append("post"))
+    x = paddle.to_tensor(np.ones((1, 2), "float32"))
+    lin(x)
+    assert calls == ["pre", "post"]
+
+    lin2 = paddle.nn.Linear(2, 2)
+    lin2.register_forward_pre_hook(lambda layer, inp: (inp[0] * 2.0,))
+    manual = (lin2.weight.numpy().T @ (np.ones(2, "float32") * 2)
+              + lin2.bias.numpy())
+    np.testing.assert_allclose(lin2(x).numpy()[0], manual, rtol=1e-5)
+
+    lin3 = paddle.nn.Linear(2, 2)
+    lin3.register_forward_post_hook(lambda layer, inp, out: out * 0.0)
+    assert float(lin3(x).numpy().sum()) == 0.0
+
+    h1.remove()
+    h2.remove()
+    n = len(calls)
+    lin(x)
+    assert len(calls) == n
