@@ -1,0 +1,72 @@
+"""paddle_tpu.analysis — the Graph Doctor: chip-independent static
+analysis of lowered programs (StableHLO + jaxpr on the CPU platform)
+and of python-side dy2static hazards, run as a pass catalog that emits
+structured Findings before a model ever reaches a chip.
+
+Three front doors:
+  * ``paddle.jit.to_static(fn, lint=True)`` — lint at conversion/first
+    compile, warnings surfaced inline;
+  * ``python -m paddle_tpu.analysis [config ...]`` — CLI over the five
+    BASELINE configs (or any ``module:builder`` spec), writing the
+    committed per-model lint manifests;
+  * the pytest gate (tests/test_graph_lint.py, ``lint_graphs`` marker)
+    — every BASELINE config must lint clean against its committed
+    manifest in the standard tier-1 sweep.
+
+See docs/static_analysis.md for the rule catalog and how to add an
+analyzer.
+"""
+from .findings import Finding, Report, Severity  # noqa: F401
+from .lowering import (LoweredProgram, lower_callable,  # noqa: F401
+                       lower_layer, tensor_type_bytes)
+from .pass_manager import (AnalysisContext, Analyzer,  # noqa: F401
+                           PassManager, default_catalog, get_analyzer,
+                           register_analyzer)
+from . import analyzers  # noqa: F401  (registers the graph passes)
+from .analyzers import COLLECTIVE_OPS, MXU_OPS  # noqa: F401
+from .ast_lint import lint_function  # noqa: F401
+from .manifest import (build_manifest, load_manifest,  # noqa: F401
+                       manifest_path, write_manifest)
+
+__all__ = [
+    "Finding", "Report", "Severity",
+    "LoweredProgram", "lower_callable", "lower_layer",
+    "AnalysisContext", "Analyzer", "PassManager", "default_catalog",
+    "get_analyzer", "register_analyzer",
+    "lint_function", "analyze", "analyze_layer",
+    "build_manifest", "load_manifest", "manifest_path", "write_manifest",
+    "BASELINE_CONFIGS",
+]
+
+
+def analyze_layer(model, *example_arrays, context=None, analyzers=None):
+    """One-call Graph Doctor: lower `model` at the example inputs and
+    run the full catalog. Returns a Report."""
+    return PassManager(analyzers).run_layer(model, *example_arrays,
+                                            context=context)
+
+
+def analyze(fn, *example_args, context=None, analyzers=None):
+    """Analyze a jittable callable (already functional — no Layer
+    plumbing). Every argument of a plain callable is an INPUT, so all
+    %arg ids are input ids: a transpose applied directly to an input
+    is activation traffic, not a free weight-layout move."""
+    import jax
+    pm = PassManager(analyzers)
+    context = context or AnalysisContext(
+        name=getattr(fn, "__name__", "program"))
+    report = pm.run_source(fn, context)
+    n_in = len(jax.tree_util.tree_leaves(list(example_args)))
+    program = lower_callable(fn, *example_args, name=context.name,
+                             input_arg_ids=range(n_in))
+    report.extend(pm.run(program, context))
+    return report
+
+
+def __getattr__(name):
+    # BASELINE_CONFIGS builds models on import; keep it lazy so
+    # `import paddle_tpu.analysis` stays cheap
+    if name == "BASELINE_CONFIGS":
+        from .baseline import BASELINE_CONFIGS
+        return BASELINE_CONFIGS
+    raise AttributeError(name)

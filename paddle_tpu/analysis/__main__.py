@@ -1,0 +1,112 @@
+"""CLI: ``python -m paddle_tpu.analysis [config ...] [options]``
+
+Runs the full analyzer catalog over BASELINE configs (default: all
+five) or any custom ``module.path:builder`` spec whose builder returns
+``(model, example_arrays[, AnalysisContext])``. Prints findings, checks
+drift against committed lint manifests, and with --write-manifests
+regenerates them.
+
+Exit code: 0 clean / manifest-matching, 1 any ERROR finding (the CI
+gate), 2 usage problems.
+"""
+import argparse
+import importlib
+import json
+import sys
+
+
+def _run_spec(spec, write, as_json, no_manifest):
+    from . import (AnalysisContext, PassManager, load_manifest,
+                   lower_layer, write_manifest)
+    from .baseline import BASELINE_CONFIGS, lowered_program
+
+    pm = PassManager()
+    if spec in BASELINE_CONFIGS:
+        program, ctx, fwd = lowered_program(spec)
+    else:
+        if ":" not in spec:
+            raise SystemExit(
+                f"unknown config {spec!r} (known: "
+                f"{', '.join(sorted(BASELINE_CONFIGS))}) and not a "
+                "module:builder spec")
+        mod_name, attr = spec.split(":", 1)
+        builder = getattr(importlib.import_module(mod_name), attr)
+        built = builder()
+        model, examples = built[0], built[1]
+        ctx = (built[2] if len(built) > 2
+               else AnalysisContext(name=attr))
+        program = lower_layer(model, *examples, name=ctx.name)
+        fwd = type(model).forward
+    if not no_manifest and not write:
+        # regeneration must be idempotent: checking the OLD manifest
+        # while writing the new one would bake transition-run DRIFT
+        # findings into the fresh manifest
+        ctx.manifest = load_manifest(ctx.name)
+    report = pm.run_source(fwd, ctx)
+    report.extend(pm.run(program, ctx))
+    if write:
+        data = write_manifest(ctx.name, program, report)
+        print(f"wrote {ctx.name} manifest "
+              f"({sum(data['op_counts'].values())} pinned ops)")
+    if as_json:
+        print(json.dumps({ctx.name: report.to_dict()}, indent=1,
+                         sort_keys=True))
+    else:
+        print(f"== {ctx.name} ==")
+        print(report if report else "clean (0 findings)")
+        gs = report.metrics.get("graph-shape", {}).get("op_counts", {})
+        if gs:
+            print("   ops: " + ", ".join(f"{k}={v}"
+                                         for k, v in sorted(gs.items())))
+    return report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Graph Doctor: static-analyze lowered programs on "
+                    "CPU (no TPU needed)")
+    parser.add_argument("configs", nargs="*", default=[],
+                        help="BASELINE config names (default: all) or "
+                             "module.path:builder specs")
+    parser.add_argument("--list", action="store_true",
+                        help="list BASELINE configs and analyzers")
+    parser.add_argument("--write-manifests", action="store_true",
+                        help="regenerate lint_manifests/<config>.json")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--no-manifest-check", action="store_true",
+                        help="skip drift checks against committed "
+                             "manifests")
+    parser.add_argument("--fail-on", choices=("error", "warning",
+                                              "never"),
+                        default="error",
+                        help="severity that makes the exit code "
+                             "nonzero (default: error)")
+    args = parser.parse_args(argv)
+
+    from . import Severity, default_catalog
+    from .baseline import BASELINE_CONFIGS
+
+    if args.list:
+        print("BASELINE configs: " + ", ".join(sorted(BASELINE_CONFIGS)))
+        print("analyzers: " + ", ".join(default_catalog()))
+        return 0
+
+    names = args.configs or list(BASELINE_CONFIGS)
+    worst = None
+    for name in names:
+        report = _run_spec(name, args.write_manifests, args.json,
+                           args.no_manifest_check)
+        sev = report.max_severity
+        if sev is not None and (worst is None or sev > worst):
+            worst = sev
+    if args.fail_on == "never" or worst is None:
+        return 0
+    gate = (Severity.ERROR if args.fail_on == "error"
+            else Severity.WARNING)
+    return 1 if worst >= gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""Graph analyzer catalog — each pass reads the lowered StableHLO and
+emits Findings with stable rule ids (docs/static_analysis.md).
+
+These generalize the graph pins that previously lived as inline regexes
+in tests/test_hlo_regression.py: layout (the r2 NHWC win), dtype (bf16
+at the MXU boundary), host transfers, graph shape vs a committed
+manifest, and collective accounting cross-checked against cost_model's
+analytic wire-bytes model (fine-grained compute/collective split after
+T3, arxiv 2401.16677).
+"""
+import re
+
+from .findings import Finding, Severity
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
+           "GraphShapeAnalyzer", "CollectiveAnalyzer", "COLLECTIVE_OPS",
+           "MXU_OPS"]
+
+MXU_OPS = ("dot_general", "convolution")
+COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
+                  "reduce_scatter", "collective_permute",
+                  "collective_broadcast")
+
+
+@register_analyzer
+class LayoutAnalyzer(Analyzer):
+    """Activation transposes inside conv/matmul bodies.
+
+    Weight-layout transposes applied directly to parameters (`%argN`)
+    fold into XLA's free parameter-layout assignment and are counted but
+    never flagged. Everything else is HBM traffic NHWC exists to avoid
+    (~15x measured on NCHW ResNet-50): flagged ERROR under a pinned
+    data_format, WARNING otherwise. By-design transposes (s2d input
+    pack, sequence-major flip, API-boundary NCHW heads) are exempted via
+    context.allowed_activation_transposes regexes and reported INFO."""
+    name = "layout"
+
+    def run(self, program, ctx):
+        transposes = program.ops_named("transpose")
+        act = program.activation_transposes()
+        allowed_pats = [re.compile(p)
+                        for p in ctx.allowed_activation_transposes]
+        findings = []
+        n_allowed = 0
+        for op in act:
+            if any(p.search(op.line) for p in allowed_pats):
+                n_allowed += 1
+                continue
+            sev = (Severity.ERROR if ctx.data_format == "NHWC"
+                   else Severity.WARNING)
+            findings.append(Finding(
+                "LAYOUT-ACT-TRANSPOSE", sev,
+                "activation transpose in the lowered graph — layout "
+                "left the TPU-preferred minor-to-major order "
+                f"(~{max(op.operand_bytes(), 1)} bytes of HBM traffic "
+                "per call)",
+                op=op.line,
+                suggested_fix="keep data_format=NHWC end to end; the "
+                "usual breakers are concat/upsample/reshape between "
+                "convs, or an NCHW-assuming head"))
+        if n_allowed:
+            findings.append(Finding(
+                "LAYOUT-ALLOWED-TRANSPOSE", Severity.INFO,
+                f"{n_allowed} by-design activation transpose(s) "
+                "(exempted by context)"))
+        self.metrics = {"n_transposes": len(transposes),
+                        "n_weight_transposes": len(transposes) - len(act),
+                        "n_activation_transposes": len(act),
+                        "n_allowed_activation_transposes": n_allowed}
+        return findings
+
+
+@register_analyzer
+class DtypeAnalyzer(Analyzer):
+    """f32 upcasts of matmul/conv OPERANDS under a bf16/amp policy.
+
+    f32 inputs halve the MXU rate; f32 accumulation on the output side
+    is free and numerically right, so only operand types are checked.
+    context.f32_dot_allow exempts by-design f32 matmuls (MoE router
+    logits). f64 anywhere is flagged regardless of policy."""
+    name = "dtype"
+
+    def run(self, program, ctx):
+        findings = []
+        mxu = program.ops_named(*MXU_OPS)
+        n_f32 = 0
+        low = ctx.policy_dtype in ("bfloat16", "float16")
+        for op in mxu:
+            elems = [t.split("x")[-1] for t in op.operand_types]
+            if "f64" in elems:
+                findings.append(Finding(
+                    "DTYPE-F64-OPERAND", Severity.ERROR,
+                    f"f64 operand on {op.name} (no TPU f64 MXU path)",
+                    op=op.line))
+                continue
+            if not low:
+                continue
+            if "f32" in elems:
+                if ctx.f32_dot_allow is not None and ctx.f32_dot_allow(op):
+                    findings.append(Finding(
+                        "DTYPE-F32-ALLOWED", Severity.INFO,
+                        f"by-design f32 {op.name} (exempted)",
+                        op=op.line))
+                    continue
+                n_f32 += 1
+                findings.append(Finding(
+                    "DTYPE-F32-MATMUL", Severity.ERROR,
+                    f"f32 operand on {op.name} under {ctx.policy_dtype} "
+                    "policy — halves the MXU rate",
+                    op=op.line,
+                    suggested_fix="cast the activation down at the op "
+                    "boundary (amp_compute_cast / model.bfloat16()); "
+                    "keep f32 only on the accumulation output"))
+        self.metrics = {"n_mxu_ops": len(mxu), "n_f32_mxu_ops": n_f32,
+                        "policy_dtype": ctx.policy_dtype}
+        return findings
+
+
+# custom_call targets that move data to/from the host or re-enter python
+_HOST_TARGET_RE = re.compile(
+    r"@([\w.]*(?:callback|CallbackTo|host_to_device|device_to_host)[\w.]*)")
+
+
+@register_analyzer
+class HostTransferAnalyzer(Analyzer):
+    """Device<->host transfers hiding inside a jit region: python
+    callbacks (io_callback/debug.print left in a model), infeed/outfeed,
+    send/recv. Each one serializes the step against the host and kills
+    async dispatch — on TPU that's a full pipeline bubble per call."""
+    name = "host-transfer"
+
+    def run(self, program, ctx):
+        findings = []
+        n_callbacks = 0
+        allow = tuple(ctx.host_callback_allow) + _device_custom_calls()
+        for op in program.ops_named("custom_call"):
+            m = _HOST_TARGET_RE.search(op.line)
+            if not m:
+                continue
+            target = m.group(1)
+            if any(a in target for a in allow):
+                continue
+            n_callbacks += 1
+            findings.append(Finding(
+                "HOST-CALLBACK", Severity.ERROR,
+                f"host python callback `{target}` inside the jit region",
+                op=op.line,
+                suggested_fix="move the callback out of the compiled "
+                "step (log post-step from host) or switch to an "
+                "in-graph equivalent (debug.check_numerics)"))
+        for op in program.ops_named("infeed", "outfeed"):
+            findings.append(Finding(
+                "HOST-INFEED", Severity.ERROR,
+                f"{op.name} op in the jit region (host data dependency "
+                "per step)", op=op.line))
+        for op in program.ops_named("send", "recv"):
+            findings.append(Finding(
+                "HOST-SENDRECV", Severity.WARNING,
+                f"{op.name} op in the jit region", op=op.line))
+        self.metrics = {
+            "n_custom_calls": program.count("custom_call"),
+            "n_host_callbacks": n_callbacks,
+        }
+        return findings
+
+
+def _device_custom_calls():
+    """Known device-side custom_call target fragments (Pallas kernels,
+    sharding annotations) that must not be mistaken for host traffic."""
+    try:
+        from ..ops import DEVICE_CUSTOM_CALL_TARGETS
+        return tuple(DEVICE_CUSTOM_CALL_TARGETS)
+    except Exception:   # keep the analyzer usable mid-bootstrap
+        return ("Sharding", "tpu_custom_call")
+
+
+# the op families a manifest pins: MXU work, layout traffic, control
+# flow, collectives, and escape hatches. Elementwise noise is excluded
+# so a fusion-neutral refactor doesn't churn manifests.
+MANIFEST_OPS = ("dot_general", "convolution", "transpose", "while",
+                "custom_call", "reduce", "sort", "scatter", "gather",
+                "iota", "rng_bit_generator") + COLLECTIVE_OPS
+
+
+@register_analyzer
+class GraphShapeAnalyzer(Analyzer):
+    """Op-count contract: exact expected counts (the architecture's
+    signature — 53 convs in ResNet-50, 6 dots/block + lm_head in GPT)
+    and drift against a committed lint manifest. A duplicate forward,
+    double-remat, or lost fusion shows up here as a count change and is
+    reviewed in-diff instead of discovered on-chip."""
+    name = "graph-shape"
+
+    def run(self, program, ctx):
+        hist = program.op_histogram
+        counts = {op: hist.get(op, 0) for op in MANIFEST_OPS
+                  if hist.get(op, 0)}
+        self.metrics = {"op_counts": counts}
+        findings = []
+        for op, want in (ctx.expected_counts or {}).items():
+            got = hist.get(op, 0)
+            if got != want:
+                findings.append(Finding(
+                    "GRAPH-OPCOUNT-DRIFT", Severity.ERROR,
+                    f"{op} count changed: {got} != expected {want} — "
+                    "graph structure shifted; re-derive and update the "
+                    "contract if intentional", ))
+        committed = (ctx.manifest or {}).get("op_counts")
+        if committed is not None:
+            deltas = {op: (committed.get(op, 0), counts.get(op, 0))
+                      for op in set(committed) | set(counts)
+                      if committed.get(op, 0) != counts.get(op, 0)}
+            if deltas:
+                for op, (want, got) in sorted(deltas.items()):
+                    sev = Severity.ERROR
+                    msg = (f"manifest drift: {op} {want} -> {got}")
+                    if op in MXU_OPS and want and got >= 2 * want:
+                        findings.append(Finding(
+                            "GRAPH-DOUBLE-FORWARD", Severity.ERROR,
+                            f"{op} count doubled vs manifest ({want} -> "
+                            f"{got}): duplicate forward or broken remat "
+                            "policy (a third body copy blows HBM at "
+                            "1.3B scale)"))
+                    findings.append(Finding(
+                        "GRAPH-MANIFEST-DRIFT", sev, msg,
+                        suggested_fix="python -m paddle_tpu.analysis "
+                        "--write-manifests (then review the diff)"))
+        return findings
+
+
+def _attribute_mesh_axis(mesh_axes, group_size, groups):
+    """Mesh axis a collective's replica groups run along, or None."""
+    if not mesh_axes or not group_size or group_size <= 1:
+        return None
+    names = list(mesh_axes)
+    sizes = [mesh_axes[n] for n in names]
+    first = groups[0] if groups else None
+    if first and len(first) == group_size:
+        stride = 1
+        for i in range(len(names) - 1, -1, -1):
+            if sizes[i] == group_size:
+                expect = [first[0] + k * stride
+                          for k in range(group_size)]
+                if list(first) == expect:
+                    return names[i]
+            stride *= sizes[i]
+    matches = [n for n, s in mesh_axes.items() if s == group_size]
+    return matches[0] if len(matches) == 1 else None
+
+
+@register_analyzer
+class CollectiveAnalyzer(Analyzer):
+    """Collective count + payload bytes per op, cross-checked against
+    cost_model's analytic wire-bytes (ring algorithms). Flags
+    collectives in programs pinned single-device, and latency-bound
+    tiny-payload collectives that should be bucketed."""
+    name = "collective"
+
+    # below this payload a ring all-reduce is latency- not bandwidth-
+    # bound on ICI — many of these means gradient bucketing is off
+    TINY_PAYLOAD = 16 * 1024
+
+    def run(self, program, ctx):
+        from ..cost_model import collective_wire_bytes
+        findings = []
+        entries = []
+        for op in program.ops_named(*COLLECTIVE_OPS):
+            payload = op.operand_bytes()
+            group, n_groups = op.replica_group_size()
+            # the ring model wants the FULL payload: for all_gather the
+            # operand is the 1/n shard and the result is the gathered
+            # array (the reverse for reduce_scatter), so max() of the
+            # two sides is the full payload for every collective kind
+            from .lowering import tensor_type_bytes
+            full = max(payload,
+                       sum(tensor_type_bytes(t) for t in op.result_types))
+            wire = collective_wire_bytes(op.name, full, group or 1)
+            entries.append({"op": op.name, "payload_bytes": payload,
+                            "group_size": group, "num_groups": n_groups,
+                            "wire_bytes": wire, "line": op.line_no})
+            if ctx.expect_collectives is False:
+                findings.append(Finding(
+                    "COLL-UNEXPECTED", Severity.ERROR,
+                    f"{op.name} in a program pinned single-device "
+                    f"({payload} payload bytes)", op=op.line))
+            elif payload and payload < self.TINY_PAYLOAD:
+                findings.append(Finding(
+                    "COLL-TINY-PAYLOAD", Severity.WARNING,
+                    f"{op.name} with {payload}-byte payload is latency-"
+                    "bound", op=op.line,
+                    suggested_fix="bucket gradients (grad merge / "
+                    "fused allreduce) so payloads amortize ring latency"))
+        per_axis = {}
+        if ctx.mesh_axes:
+            # attribute each collective to a mesh axis (the T3-style
+            # split): primary signal is the device-id STRIDE of its
+            # replica groups (row-major mesh ⇒ axis i groups step by
+            # the product of later axis sizes), which disambiguates
+            # equal-sized axes; size matching is the fallback
+            groups_by_line = {op.line_no: op.replica_groups()
+                              for op in program.ops_named(*COLLECTIVE_OPS)}
+            for e in entries:
+                e["mesh_axis"] = _attribute_mesh_axis(
+                    ctx.mesh_axes, e["group_size"],
+                    groups_by_line.get(e["line"]))
+                axis = e["mesh_axis"]
+                if axis:
+                    acc = per_axis.setdefault(
+                        axis, {"count": 0, "payload_bytes": 0,
+                               "wire_bytes": 0})
+                    acc["count"] += 1
+                    acc["payload_bytes"] += e["payload_bytes"]
+                    acc["wire_bytes"] += e["wire_bytes"]
+        self.metrics = {
+            "n_collectives": len(entries),
+            "collectives": entries,
+            "total_payload_bytes": sum(e["payload_bytes"]
+                                       for e in entries),
+            "total_wire_bytes": sum(e["wire_bytes"] for e in entries),
+        }
+        if per_axis:
+            self.metrics["per_mesh_axis"] = per_axis
+        return findings

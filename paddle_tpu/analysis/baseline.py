@@ -1,0 +1,155 @@
+"""The five BASELINE configs (BASELINE.json) as lintable model specs.
+
+Each entry builds a tiny, CPU-lowerable stand-in for a headline
+workload (same architecture family, same graph invariants, shrunk
+shapes) plus the AnalysisContext carrying its contracts: data format,
+dtype policy, by-design transpose exemptions, f32 exemptions, and
+expected op counts published by the model modules themselves
+(GRAPH_CONTRACT / graph_contract next to each architecture).
+
+Lowerings are cached per config for the process lifetime — the pytest
+lint gate and the CLI share one trace per model.
+"""
+import jax.numpy as jnp
+
+from .pass_manager import AnalysisContext
+
+__all__ = ["BASELINE_CONFIGS", "build_config", "lowered_program",
+           "forward_fn"]
+
+_CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
+
+
+def _fresh():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    paddle.seed(0)
+    build_mesh(dp=1)
+    return paddle
+
+
+def _resnet50():
+    paddle = _fresh()
+    from paddle_tpu.vision.models import resnet
+    model = paddle.vision.models.resnet50(num_classes=10,
+                                          data_format="NHWC")
+    model.bfloat16()
+    model.eval()
+    x = jnp.zeros((2, 64, 64, 3), jnp.bfloat16)
+    ctx = AnalysisContext(
+        name="resnet50", policy_dtype="bfloat16", data_format="NHWC",
+        expected_counts=dict(resnet.GRAPH_CONTRACT),
+        expect_collectives=False)
+    return model, (x,), ctx
+
+
+def _bert_base():
+    paddle = _fresh()
+    from paddle_tpu.models import bert as bert_mod
+    cfg = bert_mod.bert_base(dtype="bfloat16")
+    cfg.num_layers = 2          # graph shape per layer is what matters
+    model = bert_mod.BertModel(cfg)
+    model.bfloat16()
+    model.train()               # dropout ACTIVE — that's the pin
+    ids = jnp.zeros((2, 64), jnp.int32)
+    from paddle_tpu.models.gpt import ATTENTION_TRANSPOSES
+    ctx = AnalysisContext(
+        name="bert_base", policy_dtype="bfloat16",
+        allowed_activation_transposes=ATTENTION_TRANSPOSES,
+        expected_counts=bert_mod.graph_contract(cfg),
+        expect_collectives=False)
+    return model, (ids,), ctx
+
+
+def _gpt():
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    cfg = gpt_tiny(dtype="bfloat16", remat=False)
+    model = GPT(cfg)
+    model.bfloat16()
+    model.eval()
+    ids = jnp.zeros((2, 32), jnp.int32)
+    ctx = AnalysisContext(
+        name="gpt", policy_dtype="bfloat16",
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES,
+        expected_counts=gpt_mod.graph_contract(cfg),
+        expect_collectives=False)
+    return model, (ids,), ctx
+
+
+def _ppocr_crnn():
+    paddle = _fresh()
+    from paddle_tpu.vision.models import CRNN
+    from paddle_tpu.vision.models import ocr as ocr_mod
+    model = CRNN(num_classes=97, data_format="NHWC")
+    model.bfloat16()
+    model.eval()
+    x = jnp.zeros((2, 32, 64, 3), jnp.bfloat16)
+    ctx = AnalysisContext(
+        name="ppocr_crnn", policy_dtype="bfloat16", data_format="NHWC",
+        # the single by-design [B,W',C]->[W',B,C] sequence-major flip
+        allowed_activation_transposes=(
+            r"dims = \[1, 0, 2\]",),
+        expected_counts=dict(ocr_mod.GRAPH_CONTRACT),
+        expect_collectives=False)
+    return model, (x,), ctx
+
+
+def _gpt_moe():
+    paddle = _fresh()
+    from paddle_tpu.models import GPTMoE
+    from paddle_tpu.models import moe as moe_mod
+    cfg = moe_mod.gpt_moe_tiny(dtype="bfloat16")
+    model = GPTMoE(cfg)
+    model.bfloat16()
+    model.eval()
+    ids = jnp.zeros((2, 32), jnp.int32)
+    from paddle_tpu.models.gpt import ATTENTION_TRANSPOSES
+    ctx = AnalysisContext(
+        name="gpt_moe", policy_dtype="bfloat16",
+        allowed_activation_transposes=ATTENTION_TRANSPOSES,
+        f32_dot_allow=moe_mod.router_f32_allow(cfg),
+        expect_collectives=False)
+    return model, (ids,), ctx
+
+
+# config name -> builder() -> (model, example_arrays, AnalysisContext)
+BASELINE_CONFIGS = {
+    "resnet50": _resnet50,        # ResNet-50 imgs/sec (vision config)
+    "bert_base": _bert_base,      # ERNIE/BERT encoder config
+    "gpt": _gpt,                  # GPT-3 1.3B pretraining family
+    "ppocr_crnn": _ppocr_crnn,    # PP-OCR conv+RNN config
+    "gpt_moe": _gpt_moe,          # GPT-MoE expert-parallel config
+}
+
+
+def build_config(name):
+    try:
+        builder = BASELINE_CONFIGS[name]
+    except KeyError:
+        raise KeyError(f"unknown BASELINE config {name!r}; known: "
+                       f"{sorted(BASELINE_CONFIGS)}")
+    return builder()
+
+
+def lowered_program(name):
+    """(LoweredProgram, AnalysisContext, forward fn) for a BASELINE
+    config — lowered once per process (the lint gate's time budget
+    rides on this cache). The context is a fresh copy per call:
+    consumers set run-local fields on it (manifest, mesh_axes) and a
+    shared instance would leak one run's manifest into the next —
+    e.g. baking transition-run DRIFT findings into a regenerated
+    manifest."""
+    import dataclasses
+    if name not in _CACHE:
+        from .lowering import lower_layer
+        model, examples, ctx = build_config(name)
+        program = lower_layer(model, *examples, name=name)
+        _CACHE[name] = (program, ctx, type(model).forward)
+    program, ctx, fwd = _CACHE[name]
+    return program, dataclasses.replace(ctx), fwd
+
+
+def forward_fn(name):
+    return lowered_program(name)[2]

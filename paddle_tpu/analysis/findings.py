@@ -1,0 +1,99 @@
+"""Structured findings — the unit of output of every Graph Doctor
+analyzer (pass-pipeline design after TPU-MLIR, arxiv 2210.15016: each
+pass consumes the lowered program and emits diagnostics instead of
+mutating it).
+
+A Finding carries a stable rule id (documented in
+docs/static_analysis.md), a severity, the offending op/source location,
+and a suggested fix — enough for the CI gate to print an actionable
+line and for lint manifests to diff across commits.
+"""
+import enum
+from dataclasses import dataclass, field
+
+__all__ = ["Severity", "Finding", "Report"]
+
+
+class Severity(enum.IntEnum):
+    # ordered so max() over a report gives the gate outcome
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass
+class Finding:
+    rule_id: str                 # e.g. "LAYOUT-ACT-TRANSPOSE"
+    severity: Severity
+    message: str
+    analyzer: str = ""           # registry name of the emitting pass
+    op: str = None               # offending op line (HLO) or AST snippet
+    location: str = None         # "line 123" / "file.py:45" / model name
+    suggested_fix: str = None
+
+    def to_dict(self):
+        d = {"rule_id": self.rule_id, "severity": str(self.severity),
+             "message": self.message, "analyzer": self.analyzer}
+        for k in ("op", "location", "suggested_fix"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    def __str__(self):
+        loc = f" [{self.location}]" if self.location else ""
+        fix = f"\n      fix: {self.suggested_fix}" if self.suggested_fix else ""
+        return f"{self.severity:<7} {self.rule_id}{loc}: {self.message}{fix}"
+
+
+@dataclass
+class Report:
+    """Ordered findings from one pass-manager run, plus per-analyzer
+    metrics (op counts, payload bytes) that manifests persist even when
+    no finding fires."""
+    findings: list = field(default_factory=list)
+    metrics: dict = field(default_factory=dict)
+
+    def add(self, finding):
+        self.findings.append(finding)
+
+    def extend(self, other):
+        self.findings.extend(other.findings)
+        for k, v in other.metrics.items():
+            self.metrics.setdefault(k, v)
+
+    def by_rule(self, rule_id):
+        return [f for f in self.findings if f.rule_id == rule_id]
+
+    def by_severity(self, severity):
+        return [f for f in self.findings if f.severity >= severity]
+
+    @property
+    def errors(self):
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    @property
+    def max_severity(self):
+        if not self.findings:
+            return None
+        return max(f.severity for f in self.findings)
+
+    def to_dict(self):
+        return {"findings": [f.to_dict() for f in self.findings],
+                "metrics": self.metrics}
+
+    def __str__(self):
+        if not self.findings:
+            return "clean (0 findings)"
+        return "\n".join(str(f) for f in self.findings)
+
+    def __bool__(self):
+        # truthy when anything fired — `if report:` reads naturally
+        return bool(self.findings)

@@ -1,0 +1,243 @@
+"""Lowering front-end for the Graph Doctor: turn any nn.Layer or jitted
+callable into a `LoweredProgram` — pre-optimization StableHLO text plus
+the closed jaxpr — on the CPU platform (chip-independent; no TPU or
+tunnel needed), then give analyzers a cheap structured view of the ops.
+
+The parser is deliberately line-oriented: StableHLO's pretty printer
+emits one op per line except for region-carrying generic ops
+(all_reduce, reduce, sort, ...), whose type signature lands on the
+closing `}) : (...) -> ...` line — those are stitched by brace
+balancing. This matches (and replaces) the regex counting the old
+tests/test_hlo_regression.py did inline.
+"""
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+
+__all__ = ["HloOp", "LoweredProgram", "lower_layer", "lower_callable",
+           "tensor_type_bytes"]
+
+_OP_RE = re.compile(r'"?stablehlo\.([a-zA-Z0-9_]+)"?')
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_WEIGHT_TRANSPOSE_RE = re.compile(r"transpose %arg\d+, dims = ")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+
+def tensor_type_bytes(type_str):
+    """Byte size of one `tensor<2x4xf32>`-style type string (0 when the
+    element type is unknown or a dim is symbolic)."""
+    m = _TENSOR_RE.search(type_str)
+    body = m.group(1) if m else type_str
+    parts = body.split("x")
+    elem = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        if not d.isdigit():
+            return 0
+        n *= int(d)
+    return n * _DTYPE_BYTES.get(elem, 0)
+
+
+@dataclass
+class HloOp:
+    """One stablehlo op occurrence (nested region ops included, matching
+    whole-text regex-count semantics)."""
+    name: str                    # "dot_general", "all_reduce", ...
+    line_no: int                 # 1-based line in the module text
+    line: str                    # the op's first line, stripped
+    operand_types: list = field(default_factory=list)
+    result_types: list = field(default_factory=list)
+    attrs: str = ""              # full text slice incl. closing sig line
+
+    @property
+    def is_weight_transpose(self):
+        """A transpose applied directly to a parameter argument (OIHW->
+        HWIO and friends): folds into XLA's free parameter-layout
+        assignment, so layout lint must not count it as activation
+        traffic. NOTE: textual heuristic only — a program that knows
+        which %arg ids are model INPUTS (LoweredProgram.input_arg_ids)
+        refines this via LoweredProgram.is_weight_transpose, since an
+        input-image transpose is exactly the layout bug to catch."""
+        return (self.name == "transpose"
+                and _WEIGHT_TRANSPOSE_RE.search(self.line) is not None)
+
+    def arg_operand_id(self):
+        """The N of a direct `%argN` first operand, or None."""
+        m = re.search(r"transpose %arg(\d+)\b", self.line)
+        return int(m.group(1)) if m else None
+
+    def operand_bytes(self):
+        return sum(tensor_type_bytes(t) for t in self.operand_types)
+
+    def replica_group_size(self):
+        """(group_size, num_groups) from a replica_groups attr, or
+        (None, None) when absent."""
+        m = re.search(r"replica_groups\s*=\s*dense<(\[\[.*?\]\]|\[\]|"
+                      r"[0-9]+)>\s*:\s*tensor<(\d+)x(\d+)", self.attrs,
+                      re.S)
+        if not m:
+            return None, None
+        return int(m.group(3)), int(m.group(2))
+
+    def replica_groups(self):
+        """The replica_groups device-id lists, e.g. [[0, 2], [1, 3]],
+        or None when absent (lets the collective analyzer attribute a
+        group to a mesh AXIS by id stride, not just by size — two axes
+        of equal size are otherwise indistinguishable)."""
+        m = re.search(r"replica_groups\s*=\s*dense<(\[\[.*?\]\])>",
+                      self.attrs, re.S)
+        if not m:
+            return None
+        try:
+            import json
+            return json.loads(m.group(1).replace(" ", "")
+                              .replace("\n", ""))
+        except ValueError:
+            return None
+
+
+def _split_signature(line):
+    """Parse the trailing ` : (operands) -> results` / ` : type` section
+    of a one-line op. Returns (operand_types, result_types)."""
+    idx = line.rfind(" : ")
+    if idx < 0:
+        return [], []
+    sig = line[idx + 3:]
+    if "->" in sig:
+        left, right = sig.split("->", 1)
+        return _TENSOR_RE.findall(left), _TENSOR_RE.findall(right)
+    tys = _TENSOR_RE.findall(sig)
+    # shorthand form: operand and result share the type
+    return list(tys), list(tys)
+
+
+def parse_hlo_ops(text):
+    """All stablehlo op occurrences in a module's textual form.
+    `stablehlo.return` is skipped (region plumbing, not computation)."""
+    lines = text.splitlines()
+    ops = []
+    for i, raw in enumerate(lines):
+        m = _OP_RE.search(raw)
+        if m is None:
+            continue
+        name = m.group(1)
+        if name == "return":
+            continue
+        line = raw.strip()
+        attrs = line
+        if f'"stablehlo.{name}"' in raw:
+            # generic (quoted) form: a region op whose type signature is
+            # on the closing `}) : ...` line — stitch by brace balance
+            depth = raw.count("{") - raw.count("}")
+            j = i
+            while depth > 0 and j + 1 < len(lines):
+                j += 1
+                depth += lines[j].count("{") - lines[j].count("}")
+            attrs = "\n".join(lines[i:j + 1])
+            sig_line = lines[j] if j > i else raw
+            operand_types, result_types = _split_signature(sig_line)
+        else:
+            operand_types, result_types = _split_signature(line)
+        ops.append(HloOp(name=name, line_no=i + 1, line=line,
+                         operand_types=operand_types,
+                         result_types=result_types, attrs=attrs))
+    return ops
+
+
+class LoweredProgram:
+    """StableHLO text + jaxpr of one lowered callable, with a parsed op
+    view. `jaxpr` is produced from the same single trace as the HLO (no
+    double tracing)."""
+
+    def __init__(self, text, jaxpr=None, name="program", platform="cpu",
+                 input_arg_ids=None):
+        self.text = text
+        self.jaxpr = jaxpr
+        self.name = name
+        self.platform = platform
+        # %arg indices of the main function that are model INPUTS (vs
+        # parameters/buffers); None when unknown (raw-text programs)
+        self.input_arg_ids = (None if input_arg_ids is None
+                              else frozenset(input_arg_ids))
+        self.ops = parse_hlo_ops(text)
+
+    def is_weight_transpose(self, op):
+        """Argument transposes are free parameter-layout moves ONLY for
+        parameter args — a transpose of an INPUT arg is real activation
+        traffic (the NHWC-defeating bug itself)."""
+        if not op.is_weight_transpose:
+            return False
+        if self.input_arg_ids is None:
+            return True
+        return op.arg_operand_id() not in self.input_arg_ids
+
+    def ops_named(self, *names):
+        wanted = set(names)
+        return [op for op in self.ops if op.name in wanted]
+
+    def count(self, op_name):
+        return sum(1 for op in self.ops if op.name == op_name)
+
+    @property
+    def op_histogram(self):
+        return Counter(op.name for op in self.ops)
+
+    def activation_transposes(self):
+        return [op for op in self.ops
+                if op.name == "transpose"
+                and not self.is_weight_transpose(op)]
+
+    def __repr__(self):
+        return (f"LoweredProgram({self.name!r}, {len(self.ops)} ops, "
+                f"{len(self.text.splitlines())} lines)")
+
+
+def _untensor(tree):
+    from ..framework.core import Tensor
+    import jax
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def lower_callable(fn, *example_args, name="program", input_arg_ids=None):
+    """Trace `fn` once; return StableHLO + jaxpr as a LoweredProgram."""
+    import jax
+    traced = jax.jit(fn).trace(*example_args)
+    return LoweredProgram(traced.lower().as_text(), jaxpr=traced.jaxpr,
+                          name=name, input_arg_ids=input_arg_ids)
+
+
+def lower_layer(model, *example_arrays, name=None):
+    """Lower a Layer's forward (functional form: params/buffers as
+    arguments) at the given example inputs — the same pure-call shape
+    the Trainer and jit.save use, so lint sees the graph that ships."""
+    from ..framework.core import Tensor
+    from ..nn.layer_base import (buffer_pytree, functional_call,
+                                 state_pytree)
+    params = state_pytree(model)
+    params.update(buffer_pytree(model))
+
+    def pure(p, *args):
+        with functional_call(model, p):
+            out = model(*[Tensor(a) for a in args])
+        return _untensor(out)
+
+    # flattened calling convention: params-dict leaves first, then the
+    # example arrays — so the inputs are the TRAILING %arg ids, letting
+    # the layout analyzer tell a free param-layout transpose from an
+    # input-activation transpose
+    import jax
+    n_params = len(jax.tree_util.tree_leaves(params))
+    n_inputs = len(jax.tree_util.tree_leaves(list(example_arrays)))
+    return lower_callable(
+        pure, params, *example_arrays,
+        name=name or type(model).__name__,
+        input_arg_ids=range(n_params, n_params + n_inputs))

@@ -1,0 +1,71 @@
+"""Per-model lint manifests — the committed, diffable face of the
+Graph Doctor (same role as perf_evidence.json for the analytic perf
+model: regenerate, diff, review).
+
+`lint_manifests/<config>.json` pins each BASELINE config's op counts,
+collective accounting, and finding summary. The graph-shape analyzer
+treats the committed manifest as the baseline: any drift is an ERROR
+until the manifest is regenerated and the diff reviewed.
+"""
+import json
+import os
+
+__all__ = ["manifest_dir", "manifest_path", "load_manifest",
+           "build_manifest", "write_manifest"]
+
+_SCHEMA = 1
+
+
+def manifest_dir():
+    """Repo-root lint_manifests/ (next to perf_evidence.json)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "lint_manifests")
+
+
+def manifest_path(name):
+    return os.path.join(manifest_dir(), f"{name}.json")
+
+
+def load_manifest(name):
+    """The committed manifest dict, or None when not yet committed."""
+    try:
+        with open(manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_manifest(name, program, report):
+    """Manifest dict from one pass-manager run (deterministic: sorted
+    keys, no timestamps — a re-run on an unchanged graph must produce a
+    byte-identical file)."""
+    counts = report.metrics.get("graph-shape", {}).get("op_counts", {})
+    coll = report.metrics.get("collective", {})
+    by_rule = {}
+    for f in report.findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    return {
+        "schema": _SCHEMA,
+        "model": name,
+        "op_counts": {k: counts[k] for k in sorted(counts)},
+        "collectives": {
+            "count": coll.get("n_collectives", 0),
+            "total_payload_bytes": coll.get("total_payload_bytes", 0),
+            "total_wire_bytes": coll.get("total_wire_bytes", 0),
+        },
+        "findings_by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+        "max_severity": (str(report.max_severity)
+                         if report.findings else None),
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_manifest(name, program, report):
+    os.makedirs(manifest_dir(), exist_ok=True)
+    data = build_manifest(name, program, report)
+    with open(manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data

@@ -1,0 +1,137 @@
+"""Pass manager for the Graph Doctor (TPU-MLIR-style pass pipeline,
+arxiv 2210.15016): a catalog of registered analyzers, each a pure
+function of (LoweredProgram | python callable, AnalysisContext) ->
+Findings, run in registration order and merged into one Report.
+
+Two analyzer kinds:
+  * ``graph``  — consumes the lowered StableHLO/jaxpr program;
+  * ``source`` — consumes the *python* function pre-tracing (the
+    dy2static AST linter), catching hazards the graph can't show
+    because conversion already erased or mangled them.
+"""
+from dataclasses import dataclass, field
+
+from .findings import Report
+
+__all__ = ["Analyzer", "AnalysisContext", "PassManager",
+           "register_analyzer", "get_analyzer", "default_catalog"]
+
+_REGISTRY = {}   # name -> Analyzer subclass (insertion-ordered)
+
+
+def register_analyzer(cls):
+    """Class decorator: adds the analyzer to the default catalog under
+    its ``name`` attribute."""
+    if not getattr(cls, "name", None):
+        raise ValueError(f"{cls.__name__} needs a `name` attribute")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_analyzer(name):
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise KeyError(f"no analyzer {name!r}; known: {sorted(_REGISTRY)}")
+
+
+def default_catalog():
+    """Registered analyzer names, registration-ordered."""
+    from . import analyzers as _a   # noqa: F401  (registers graph passes)
+    from . import ast_lint as _l    # noqa: F401  (registers source pass)
+    return list(_REGISTRY)
+
+
+@dataclass
+class AnalysisContext:
+    """Everything an analyzer may consult beyond the program itself.
+    All fields optional: a default-constructed context runs every pass
+    in reporting mode (metrics, no expectations)."""
+    name: str = "program"
+    # dtype policy: "bfloat16"/"float16" activates the f32-upcast rule
+    policy_dtype: str = None
+    # "NHWC" makes activation transposes errors (the r2 layout pin)
+    data_format: str = None
+    # regexes for activation transposes that are by-design (s2d pack,
+    # sequence-major flip, head-output NCHW boundary, ...)
+    allowed_activation_transposes: tuple = ()
+    # predicate(HloOp) -> True to exempt an f32 matmul (MoE router)
+    f32_dot_allow: object = None
+    # op name -> exact expected count (architecture contract)
+    expected_counts: dict = None
+    # committed lint manifest dict (see manifest.py) for drift checks
+    manifest: dict = None
+    # mesh axis -> size, for collective accounting
+    mesh_axes: dict = None
+    # False => any collective op is an error (single-device program)
+    expect_collectives: bool = None
+    # extra custom_call targets that are known device-side (Pallas etc.)
+    host_callback_allow: tuple = ()
+    # free-form knobs for user analyzers
+    extra: dict = field(default_factory=dict)
+
+
+class Analyzer:
+    """Base class. Subclasses set `name`, `kind` ("graph"|"source") and
+    implement run(target, context) -> iterable of Finding (or None).
+    Metrics go through report.metrics[self.name] = {...} via
+    `self.metrics` captured per run by the PassManager."""
+    name = None
+    kind = "graph"
+
+    def run(self, target, context):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class PassManager:
+    def __init__(self, analyzers=None):
+        if analyzers is None:
+            analyzers = default_catalog()
+        self.analyzers = [a if isinstance(a, Analyzer) else get_analyzer(a)
+                          for a in analyzers]
+
+    def _run_kind(self, kind, target, context):
+        context = context or AnalysisContext()
+        if kind == "graph" and context.mesh_axes is None:
+            # default the collective accounting to the live global mesh
+            # so every entry point (CLI, diagnose, jit lint, gate) gets
+            # per-axis attribution without hand-wiring
+            try:
+                from ..distributed import mesh_axis_sizes
+                context.mesh_axes = mesh_axis_sizes()
+            except Exception:
+                pass
+        report = Report()
+        for a in self.analyzers:
+            if a.kind != kind:
+                continue
+            a.metrics = {}
+            found = a.run(target, context) or ()
+            for f in found:
+                if not f.analyzer:
+                    f.analyzer = a.name
+                if f.location is None:
+                    f.location = context.name
+                report.add(f)
+            if a.metrics:
+                report.metrics[a.name] = a.metrics
+        return report
+
+    def run(self, program, context=None):
+        """Run graph analyzers over a LoweredProgram."""
+        return self._run_kind("graph", program, context)
+
+    def run_source(self, fn, context=None):
+        """Run source analyzers over a python function (or source str)."""
+        return self._run_kind("source", fn, context)
+
+    def run_layer(self, model, *example_arrays, context=None):
+        """Lower a Layer on CPU and run the full catalog: source passes
+        over its forward, graph passes over the lowered program."""
+        from .lowering import lower_layer
+        context = context or AnalysisContext(name=type(model).__name__)
+        report = self.run_source(
+            getattr(type(model), "forward", None) or model, context)
+        program = lower_layer(model, *example_arrays, name=context.name)
+        report.extend(self.run(program, context))
+        return report

@@ -8,7 +8,34 @@ import time
 
 import numpy as np
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "collective_wire_bytes"]
+
+
+def collective_wire_bytes(op, payload_bytes, group_size):
+    """Analytic bytes-on-the-wire per participating device for one
+    collective, assuming the bandwidth-optimal ring algorithms XLA uses
+    on ICI (the offline half of the T3-style compute/collective split;
+    paddle_tpu.analysis cross-checks lowered programs against this).
+
+    all_reduce      ring reduce-scatter + all-gather: 2(n-1)/n * payload
+    all_gather      (n-1)/n * full gathered payload
+    reduce_scatter  (n-1)/n * payload
+    all_to_all      (n-1)/n * payload (each device keeps 1/n)
+    collective_permute / broadcast: one payload hop
+    """
+    n = max(int(group_size or 1), 1)
+    if n == 1:
+        return 0
+    frac = (n - 1) / n
+    factor = {
+        "all_reduce": 2 * frac,
+        "all_gather": frac,
+        "reduce_scatter": frac,
+        "all_to_all": frac,
+        "collective_permute": 1.0,
+        "collective_broadcast": 1.0,
+    }.get(op, 1.0)
+    return int(payload_bytes * factor)
 
 
 class CostModel:

@@ -12,7 +12,27 @@ import jax.numpy as jnp
 from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
-           "assert_finite_pytree", "TensorCheckerConfig"]
+           "assert_finite_pytree", "TensorCheckerConfig", "diagnose"]
+
+
+def diagnose(model_or_fn, *example_inputs, context=None, print_report=True):
+    """Graph Doctor house call: lower `model_or_fn` on CPU, run the full
+    paddle_tpu.analysis pass catalog (layout, dtype, host-transfer,
+    graph-shape, collective, dy2static AST lint), and return the Report.
+    The numerics checkers above catch *runtime* failures; this catches
+    the *structural* ones (activation transposes, f32 upcasts, host
+    callbacks) before a chip ever sees the program."""
+    from .analysis import analyze, analyze_layer
+    from .nn.layer_base import Layer
+    args = [x._value if isinstance(x, Tensor) else x
+            for x in example_inputs]
+    if isinstance(model_or_fn, Layer):
+        report = analyze_layer(model_or_fn, *args, context=context)
+    else:
+        report = analyze(model_or_fn, *args, context=context)
+    if print_report:
+        print(report)
+    return report
 
 _state = {"enabled": False}
 

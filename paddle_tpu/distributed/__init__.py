@@ -28,6 +28,7 @@ from .mesh import (  # noqa: F401
     get_mesh,
     in_shard_map,
     mesh_axis_size,
+    mesh_axis_sizes,
     named_sharding,
     set_mesh,
 )

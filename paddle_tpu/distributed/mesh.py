@@ -63,6 +63,17 @@ def mesh_axis_size(axis):
     return mesh.shape.get(axis, 1)
 
 
+def mesh_axis_sizes():
+    """{axis: size} of the current global mesh (empty dict when none is
+    built). The Graph Doctor's collective analyzer uses this to
+    attribute each lowered collective's replica-group size to a mesh
+    axis (per-axis payload accounting, T3-style)."""
+    mesh = get_mesh(create_default=False)
+    if mesh is None:
+        return {}
+    return dict(mesh.shape)
+
+
 def named_sharding(*spec):
     return NamedSharding(get_mesh(), PartitionSpec(*spec))
 

@@ -197,10 +197,31 @@ class Trainer:
             lambda v: jax.device_get(v) if hasattr(v, "dtype") else v, s)
 
     def load_state(self, state):
-        self.params = jax.tree_util.tree_map(lambda t, v: jax.device_put(v, t.sharding)
-                                             if hasattr(t, "sharding") else v,
-                                             self.params, state["params"])
-        self.opt_state = state["opt_state"]
+        # EVERY restored leaf is device_put onto the current trainer's
+        # template sharding — params AND opt/grad-transform state. The
+        # old code handed opt_state to the compiled step as raw numpy:
+        # wrong placement semantics under a resharded mesh, and feeding
+        # numpy into a DONATED argument of a deserialized (persistent-
+        # cache-hit) executable mis-executes outright — silently wrong
+        # resume losses, then heap corruption (the
+        # tests/test_cross_mesh_resume.py crash that killed whole suite
+        # runs).
+        def put(t, v):
+            if not hasattr(v, "dtype"):
+                return v
+            sh = getattr(t, "sharding", None)
+            if sh is not None and getattr(sh, "num_devices", 1) > 1:
+                return jax.device_put(v, sh)
+            # template leaf is default-placed (eager opt-state init):
+            # an uncommitted device array lets dispatch place it, while
+            # still never handing raw HOST memory to a donated argument
+            return jnp.asarray(v)
+
+        def put_tree(template, tree):
+            return jax.tree_util.tree_map(put, template, tree)
+
+        self.params = put_tree(self.params, state["params"])
+        self.opt_state = put_tree(self.opt_state, state["opt_state"])
         if "gt_state" in state:
-            self.gt_state = state["gt_state"]
+            self.gt_state = put_tree(self.gt_state, state["gt_state"])
         self._host_step = int(state.get("step", 0))

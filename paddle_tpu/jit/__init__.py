@@ -27,10 +27,19 @@ class _StaticFunction:
     """dy2static-converted, jax.jit-compiled wrapper around a Layer or
     python function (reference dygraph_to_static.StaticFunction)."""
 
-    def __init__(self, fn_or_layer, input_spec=None, donate_params=False):
+    def __init__(self, fn_or_layer, input_spec=None, donate_params=False,
+                 lint=False):
         self._target = fn_or_layer
         self._input_spec = input_spec
+        self._lint = bool(lint)
+        self._lint_graph_done = False
+        self.lint_report = None
         self._is_layer = isinstance(fn_or_layer, Layer)
+        if self._lint:
+            # source lint at CONVERSION time: hazards like a global
+            # write or return-in-try are visible here and invisible in
+            # the traced graph
+            self.lint_report = self._run_source_lint(fn_or_layer)
         if self._is_layer:
             layer = fn_or_layer
             # convert whatever Layer.__call__ would dispatch to: an
@@ -82,20 +91,94 @@ class _StaticFunction:
             params = state_pytree(layer)
             from ..nn.layer_base import buffer_pytree
             bufs = buffer_pytree(layer)
+            if self._lint and not self._lint_graph_done:
+                # flatten order is (params, bufs, *inputs): the model
+                # inputs are the trailing %arg ids, which the layout
+                # analyzer needs to tell an input-activation transpose
+                # from a free parameter-layout one
+                n_fixed = len(jax.tree_util.tree_leaves((params, bufs)))
+                n_in = len(jax.tree_util.tree_leaves((args, kwargs)))
+                self._run_graph_lint(
+                    range(n_fixed, n_fixed + n_in),
+                    params, bufs, *args, **kwargs)
             return self._jitted(params, bufs, *args, **kwargs)
+        if self._lint and not self._lint_graph_done:
+            n_in = len(jax.tree_util.tree_leaves((args, kwargs)))
+            self._run_graph_lint(range(n_in), *args, **kwargs)
         return self._jitted(*args, **kwargs)
+
+    def _run_source_lint(self, fn_or_layer):
+        from ..analysis.ast_lint import lint_function
+        target = fn_or_layer
+        if isinstance(fn_or_layer, Layer):
+            target = (fn_or_layer.__dict__.get("forward")
+                      or type(fn_or_layer).forward)
+        report = lint_function(target)
+        self._warn_lint(report, "dy2static lint")
+        return report
+
+    def _run_graph_lint(self, input_arg_ids, *jit_args, **jit_kwargs):
+        """Graph Doctor over the program about to run: one extra trace
+        (lint=True is an explicit opt-in), findings merged into
+        self.lint_report and surfaced as warnings."""
+        self._lint_graph_done = True
+        from ..analysis import (AnalysisContext, LoweredProgram,
+                                PassManager)
+        try:
+            text = self._jitted.lower(*jit_args, **jit_kwargs).as_text()
+        except Exception as e:   # lint must never break the real call
+            import warnings
+            warnings.warn(f"graph lint skipped (lowering failed: {e})")
+            return
+        name = getattr(self._target, "__name__",
+                       type(self._target).__name__)
+        ctx = AnalysisContext(name=name,
+                              policy_dtype=self._guess_policy())
+        program = LoweredProgram(text, name=name,
+                                 input_arg_ids=input_arg_ids)
+        report = PassManager().run(program, ctx)
+        if self.lint_report is None:
+            self.lint_report = report
+        else:
+            self.lint_report.extend(report)
+        self._warn_lint(report, "graph lint")
+
+    def _guess_policy(self):
+        if self._is_layer:
+            import jax.numpy as jnp
+            for p in self._target.parameters():
+                if p._value.dtype == jnp.bfloat16:
+                    return "bfloat16"
+        return None
+
+    @staticmethod
+    def _warn_lint(report, what):
+        from ..analysis import Severity
+        import warnings
+        worth = [f for f in report.findings
+                 if f.severity >= Severity.WARNING]
+        if worth:
+            warnings.warn(
+                f"{what}: {len(worth)} finding(s):\n"
+                + "\n".join(str(f) for f in worth))
 
     @property
     def forward(self):
         return self
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, lint=False, **kwargs):
+    """`lint=True` runs the Graph Doctor (paddle_tpu.analysis): the
+    dy2static AST linter at conversion time, plus the full graph pass
+    catalog on the first compiled call. Findings land on the returned
+    object's `.lint_report`; WARNING+ ones also surface as python
+    warnings."""
     if function is None:
         def deco(fn):
-            return _StaticFunction(fn, input_spec)
+            return _StaticFunction(fn, input_spec, lint=lint)
         return deco
-    return _StaticFunction(function, input_spec)
+    return _StaticFunction(function, input_spec, lint=lint)
 
 
 def not_to_static(fn):
@@ -103,8 +186,7 @@ def not_to_static(fn):
 
 
 def _example_from_spec(spec):
-    shape = [1 if (s is None or s < 0) else int(s) for s in spec.shape]
-    return jnp.zeros(shape, jnp.dtype(spec.dtype or "float32"))
+    return spec.example_array(batch=1)
 
 
 def _symbolic_args(specs):

@@ -164,3 +164,13 @@ def bert_large(**kw):
     base = dict(hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096)
     base.update(kw)
     return BertConfig(**base)
+
+
+def graph_contract(cfg):
+    """Graph Doctor contract (paddle_tpu.analysis): the encoder's
+    dot_general budget — qkv/proj/fc1/fc2 + 2 attention matmuls per
+    layer, pooler + embedding matmul excluded (model-level extras vary
+    by head) — plus the counter-hash dropout pin: tensor-wide
+    rng_bit_generator must never appear (threefry inside an encoder
+    step costs more than the matmuls it regularizes)."""
+    return {"rng_bit_generator": 0}

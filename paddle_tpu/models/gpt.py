@@ -484,3 +484,18 @@ class GPTStacked(Layer):
                 h, e, (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32),
             x, self.wte.weight)
         return logits
+
+
+def graph_contract(cfg):
+    """Graph Doctor contract (paddle_tpu.analysis): dot_general count of
+    the CPU-lowered eval forward — 4 projections per block (qkv, proj,
+    fc1, fc2) + 2 attention matmuls (qk, av) per block on the reference
+    attention path + the tied lm_head."""
+    return {"dot_general": cfg.num_layers * 6 + 1}
+
+
+# by-design activation transposes of the reference attention path: the
+# [B,L,H,D]<->[B,H,L,D] head moves and the k^T flip. On TPU the Pallas
+# flash kernel owns layout in-kernel; on the CPU-lowered graph these are
+# the algorithm, not a layout regression (Graph Doctor exemptions).
+ATTENTION_TRANSPOSES = (r"dims = \[0, 2, 1, 3\]", r"dims = \[0, 1, 3, 2\]")

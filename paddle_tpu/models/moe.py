@@ -271,3 +271,21 @@ def gpt_moe_small(**kw):
                 num_experts=8, top_k=2)
     base.update(kw)
     return MoEConfig(**base)
+
+
+def router_f32_allow(cfg):
+    """Graph Doctor exemption (paddle_tpu.analysis): the ROUTER keeps
+    f32 by design (bf16 top-k gate logits destabilize capacity
+    assignment — the reference gate computes fp32 too), so an f32
+    dot_general is legal iff it is router-sized: result trailing dim ==
+    num_experts. Anything bigger in f32 is a down-cast regression."""
+    import re as _re
+
+    def allow(op):
+        out_ty = op.result_types[-1] if op.result_types else ""
+        m = _re.match(r"((?:\d+x)*)f32", out_ty)
+        if not m:
+            return False
+        dims = [int(d) for d in m.group(1).split("x") if d]
+        return bool(dims) and dims[-1] == cfg.num_experts
+    return allow

@@ -5,3 +5,23 @@ from . import attention  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import paged_attention  # noqa: F401
 from . import ulysses  # noqa: F401
+
+# custom_call target fragments that stay ON DEVICE: Pallas/Mosaic kernel
+# calls, GSPMD sharding annotations, and XLA's own device RNG. The Graph
+# Doctor's host-transfer analyzer (paddle_tpu.analysis) exempts any
+# target containing one of these fragments from host-callback
+# classification — today only callback-patterned names are candidates,
+# so most entries are future-proofing for a deny-by-default mode; keep
+# the list current when adding Pallas kernels with host-ish names.
+DEVICE_CUSTOM_CALL_TARGETS = frozenset({
+    "tpu_custom_call",          # Mosaic/Pallas TPU kernels
+    "mosaic",
+    "triton_kernel_call",       # Pallas GPU lowering (parity runs)
+    "Sharding",                 # GSPMD annotation, erased by SPMD part.
+    "SPMDFullToShardShape",
+    "SPMDShardToFullShape",
+    "cu_threefry2x32",          # device-side RNG
+    "LuDecomposition",          # linalg custom calls (lapack on CPU)
+    "lapack",
+    "ducc_fft",
+})

@@ -28,3 +28,12 @@ class InputSpec:
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def example_array(self, batch=1):
+        """Concrete zeros array at this spec's shape — dynamic (None/-1)
+        dims materialize as `batch`. Shared by jit.save's export path
+        and the Graph Doctor CLI (lint a model straight from its
+        InputSpec without hand-built examples)."""
+        shape = [batch if (s is None or s < 0) else int(s)
+                 for s in self.shape]
+        return jnp.zeros(shape, self.dtype or jnp.float32)

@@ -180,3 +180,9 @@ def crnn_mobilenet(num_classes=97, **kw):
 
 def dbnet_mobilenet(**kw):
     return DBNet(**kw)
+
+
+# Graph Doctor contract (paddle_tpu.analysis): CRNN's lowered forward is
+# 6 convolutions (backbone) + 9 dot_generals (2-layer BiLSTM cells + CTC
+# head); the only legal activation transpose is the sequence-major flip.
+GRAPH_CONTRACT = {"convolution": 6, "dot_general": 9}

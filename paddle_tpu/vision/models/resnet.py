@@ -210,3 +210,10 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
     return _resnet("wide_resnet101_2", BottleneckBlock, 101, pretrained, **kwargs)
+
+
+# Graph Doctor contract (paddle_tpu.analysis): the op-count signature of
+# a lowered resnet50 forward — 53 convolutions (49 block convs + stem +
+# 3 downsample projections). A drift here means the architecture (or a
+# fusion-blocking rewrite) changed and must be reviewed, not shipped.
+GRAPH_CONTRACT = {"convolution": 53}

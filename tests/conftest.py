@@ -37,6 +37,48 @@ if not os.environ.get("PADDLE_TPU_NO_COMPILE_CACHE"):
 
 assert jax.default_backend() == "cpu"
 
+# MULTI-DEVICE executables must never come back from the persistent
+# cache on this jaxlib/CPU combo: deserialized sharded+donated step
+# programs mis-execute nondeterministically — silently wrong losses,
+# then heap corruption (`malloc(): unsorted double linked list
+# corrupted`) / SIGSEGV that kills the whole pytest process
+# (tests/test_cross_mesh_resume.py was the canary; reproduced with a
+# completely FRESH same-machine cache, so it is the deserialize path
+# itself, not staleness). Single-device entries — the bulk of the
+# suite's compile time — keep riding the persistent cache; multi-device
+# programs compile once and are memoized IN-PROCESS by their cache key,
+# which recovers the intra-run reuse (the suite is one process) without
+# ever touching the broken serialize/deserialize round trip.
+import jax._src.compiler as _compiler  # noqa: E402
+from jax._src import compilation_cache as _cc  # noqa: E402
+
+_orig_compile_or_get_cached = _compiler.compile_or_get_cached
+_multi_device_memo = {}
+
+
+def _compile_memo_multidevice(backend, computation, devices,
+                              compile_options, host_callbacks,
+                              *args, **kwargs):
+    if getattr(devices, "size", 1) <= 1:
+        return _orig_compile_or_get_cached(backend, computation, devices,
+                                           compile_options, host_callbacks,
+                                           *args, **kwargs)
+    try:
+        key = _cc.get_cache_key(computation, devices, compile_options,
+                                backend)
+    except Exception:
+        key = None
+    if key is not None and key in _multi_device_memo:
+        return _multi_device_memo[key]
+    executable = _compiler.backend_compile(backend, computation,
+                                           compile_options, host_callbacks)
+    if key is not None:
+        _multi_device_memo[key] = executable
+    return executable
+
+
+_compiler.compile_or_get_cached = _compile_memo_multidevice
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,330 @@
+"""Planted-defect proofs for the Graph Doctor rules: each test builds a
+program WITH a known performance defect and asserts the right analyzer
+catches it (and that the healthy twin stays clean) — the acceptance
+bar for trusting the lint gate's green.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.analysis import (AnalysisContext, LoweredProgram,
+                                 PassManager, Severity, lower_callable,
+                                 lower_layer)
+from paddle_tpu.distributed import build_mesh
+from paddle_tpu.framework.core import apply_op
+
+
+def _graph_pm():
+    return PassManager(["layout", "dtype", "host-transfer",
+                        "graph-shape", "collective"])
+
+
+# ---------------------------------------------------------------- layout
+
+class _ConvNet(nn.Layer):
+    """NHWC conv stack; with `defect` an NCHW round-trip is planted
+    between the convs (the exact pattern that cost ~15x on ResNet)."""
+
+    def __init__(self, defect):
+        super().__init__()
+        self.c1 = nn.Conv2D(3, 8, 3, padding=1, data_format="NHWC")
+        self.c2 = nn.Conv2D(8, 8, 3, padding=1, data_format="NHWC")
+        self._defect = defect
+
+    def forward(self, x):
+        x = self.c1(x)
+        if self._defect:
+            x = apply_op(lambda v: jnp.transpose(v, (0, 3, 1, 2)), x)
+            x = apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 1)), x)
+        return self.c2(x)
+
+
+def test_layout_rule_catches_planted_body_transpose():
+    paddle.seed(0)
+    build_mesh(dp=1)
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    ctx = AnalysisContext(name="convnet", data_format="NHWC")
+
+    clean = _graph_pm().run(lower_layer(_ConvNet(False), x), ctx)
+    assert clean.by_rule("LAYOUT-ACT-TRANSPOSE") == []
+
+    bad = _graph_pm().run(lower_layer(_ConvNet(True), x), ctx)
+    hits = bad.by_rule("LAYOUT-ACT-TRANSPOSE")
+    assert len(hits) == 2, [str(f) for f in bad.findings]
+    assert all(f.severity == Severity.ERROR for f in hits)
+    assert "NHWC" in hits[0].suggested_fix
+
+
+class _InputTransposeNet(nn.Layer):
+    """The sneakiest layout defect: transposing the INPUT image itself.
+    In the lowered functional form the input is also a %arg, so a
+    naive applied-to-%arg exemption would misread it as a free weight-
+    layout move — the program's input_arg_ids must catch it."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(3, 8, 3, padding=1, data_format="NHWC")
+
+    def forward(self, x):
+        x = apply_op(lambda v: jnp.transpose(v, (0, 2, 1, 3)), x)
+        return self.c1(x)
+
+
+def test_layout_rule_catches_input_arg_transpose():
+    paddle.seed(0)
+    build_mesh(dp=1)
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    program = lower_layer(_InputTransposeNet(), x)
+    assert program.input_arg_ids, "lower_layer lost input arg tracking"
+    report = _graph_pm().run(program, AnalysisContext(
+        name="input_t", data_format="NHWC"))
+    hits = report.by_rule("LAYOUT-ACT-TRANSPOSE")
+    assert hits and hits[0].severity == Severity.ERROR, \
+        [str(f) for f in report.findings]
+    # the jit front door sees it too: to_static(lint=True) must thread
+    # input arg ids through to the same classification
+    paddle.seed(0)
+    sf = paddle.jit.to_static(_InputTransposeNet(), lint=True)
+    with pytest.warns(UserWarning):
+        sf(paddle.to_tensor(np.zeros((2, 16, 16, 3), "float32")))
+    assert sf.lint_report.by_rule("LAYOUT-ACT-TRANSPOSE")
+
+
+# ----------------------------------------------------------------- dtype
+
+class _MatNet(nn.Layer):
+    """bf16 linear; the defect runs the matmul in f32 via a raw jnp op
+    (the amp_compute_cast rule would neutralize a plain astype before
+    nn.Linear — which is itself worth knowing: the planted defect must
+    bypass amp exactly like a hand-rolled kernel would)."""
+
+    def __init__(self, defect):
+        super().__init__()
+        self.fc = nn.Linear(16, 16)
+        self._defect = defect
+
+    def forward(self, x):
+        if self._defect:
+            return apply_op(
+                lambda v, w: (v.astype(jnp.float32)
+                              @ w.astype(jnp.float32)),
+                x, self.fc.weight)
+        return self.fc(x)
+
+
+def test_dtype_rule_catches_planted_f32_upcast():
+    paddle.seed(0)
+    build_mesh(dp=1)
+    ctx = AnalysisContext(name="matnet", policy_dtype="bfloat16")
+    x = jnp.zeros((4, 16), jnp.bfloat16)
+
+    clean_model = _MatNet(False)
+    clean_model.bfloat16()
+    clean = _graph_pm().run(lower_layer(clean_model, x), ctx)
+    assert clean.by_rule("DTYPE-F32-MATMUL") == []
+
+    bad_model = _MatNet(True)
+    bad_model.bfloat16()
+    bad = _graph_pm().run(lower_layer(bad_model, x), ctx)
+    hits = bad.by_rule("DTYPE-F32-MATMUL")
+    # the planted upcast promotes the matmul: amp_compute_cast would
+    # normally down-cast, so the defect plants the cast INSIDE the op's
+    # operand set — at least the poisoned dot must be flagged
+    assert hits, [str(f) for f in bad.findings]
+    assert all(f.severity == Severity.ERROR for f in hits)
+
+
+def test_dtype_rule_honors_router_exemption():
+    """An f32 dot is an ERROR unless the context's f32_dot_allow
+    blesses it (the MoE router rule)."""
+    def f(x, w):
+        return x.astype(jnp.float32) @ w.astype(jnp.float32)
+
+    program = lower_callable(f, jnp.zeros((4, 8), jnp.bfloat16),
+                             jnp.zeros((8, 4), jnp.bfloat16),
+                             name="router")
+    strict = _graph_pm().run(program, AnalysisContext(
+        policy_dtype="bfloat16"))
+    assert strict.by_rule("DTYPE-F32-MATMUL")
+    lax_ctx = AnalysisContext(policy_dtype="bfloat16",
+                              f32_dot_allow=lambda op: True)
+    blessed = _graph_pm().run(program, lax_ctx)
+    assert blessed.by_rule("DTYPE-F32-MATMUL") == []
+    assert blessed.by_rule("DTYPE-F32-ALLOWED")
+
+
+# --------------------------------------------------------- host transfer
+
+def test_host_transfer_rule_catches_debug_callback():
+    def bad(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    program = lower_callable(bad, jnp.zeros((4,)), name="cb")
+    report = _graph_pm().run(program, AnalysisContext())
+    hits = report.by_rule("HOST-CALLBACK")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert report.metrics["host-transfer"]["n_host_callbacks"] >= 1
+
+    def clean(x):
+        return x * 2
+
+    report = _graph_pm().run(lower_callable(clean, jnp.zeros((4,))),
+                             AnalysisContext())
+    assert report.by_rule("HOST-CALLBACK") == []
+
+
+# ----------------------------------------------------------- graph shape
+
+def test_graph_shape_rule_catches_opcount_and_double_forward():
+    def once(x, w):
+        return x @ w
+
+    def twice(x, w):
+        # the duplicate-forward defect: the same matmul materialized
+        # twice (lost CSE / broken remat shows up exactly like this)
+        return x @ w + jnp.sin(x @ w + 1.0)
+
+    args = (jnp.zeros((4, 8)), jnp.zeros((8, 4)))
+    p1 = lower_callable(once, *args, name="once")
+    p2 = lower_callable(twice, *args, name="twice")
+
+    ok = _graph_pm().run(p1, AnalysisContext(
+        expected_counts={"dot_general": 1}))
+    assert ok.by_rule("GRAPH-OPCOUNT-DRIFT") == []
+
+    drift = _graph_pm().run(p2, AnalysisContext(
+        expected_counts={"dot_general": 1}))
+    assert drift.by_rule("GRAPH-OPCOUNT-DRIFT")
+
+    # manifest drift + the doubled-MXU-op heuristic
+    manifest = {"op_counts": {"dot_general": 1}}
+    rep = _graph_pm().run(p2, AnalysisContext(manifest=manifest))
+    assert rep.by_rule("GRAPH-MANIFEST-DRIFT")
+    assert rep.by_rule("GRAPH-DOUBLE-FORWARD")
+
+
+# ------------------------------------------------------------ collective
+
+def test_collective_rule_counts_payload_and_cross_checks_cost_model():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.cost_model import collective_wire_bytes
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(dp=n_dev)   # conftest pins an 8-device CPU mesh
+
+    def allreduce(x):
+        return jax.lax.psum(x, "dp")
+
+    fn = shard_map(allreduce, mesh=mesh, in_specs=P("dp"),
+                   out_specs=P())
+    program = lower_callable(fn, jnp.zeros((n_dev, 4), jnp.float32),
+                             name="psum")
+    report = _graph_pm().run(program, AnalysisContext(
+        mesh_axes={"dp": n_dev}))
+    coll = report.metrics["collective"]
+    assert coll["n_collectives"] == 1
+    entry = coll["collectives"][0]
+    assert entry["op"] == "all_reduce"
+    # per-shard payload: 1x4 f32 = 16 bytes
+    assert entry["payload_bytes"] == 16
+    assert entry["group_size"] == n_dev
+    assert entry["wire_bytes"] == collective_wire_bytes(
+        "all_reduce", 16, n_dev)
+    assert entry["mesh_axis"] == "dp"
+    assert report.metrics["collective"]["per_mesh_axis"]["dp"]["count"] == 1
+    # tiny payload -> bucketing advice
+    assert report.by_rule("COLL-TINY-PAYLOAD")
+
+    # the same program pinned single-device is an ERROR
+    pinned = _graph_pm().run(program, AnalysisContext(
+        expect_collectives=False))
+    assert pinned.by_rule("COLL-UNEXPECTED")
+    assert pinned.errors
+
+    # all_gather: the OPERAND is the 1/n shard but the ring moves
+    # (n-1)/n of the FULL gathered payload — the analyzer must feed the
+    # result (full) size into the cost model, not the shard size
+    def gather(x):
+        return jax.lax.all_gather(x, "dp")
+
+    g_fn = shard_map(gather, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"))
+    g_prog = lower_callable(g_fn, jnp.zeros((n_dev, 4), jnp.float32),
+                            name="gather")
+    g_rep = _graph_pm().run(g_prog, AnalysisContext())
+    entries = [e for e in g_rep.metrics["collective"]["collectives"]
+               if e["op"] == "all_gather"]
+    assert entries, g_rep.metrics["collective"]
+    e = entries[0]
+    full = n_dev * 4 * 4          # gathered [n_dev, 4] f32
+    assert e["wire_bytes"] == collective_wire_bytes(
+        "all_gather", full, n_dev) == int(full * (n_dev - 1) / n_dev)
+
+
+def test_collective_axis_attribution_disambiguates_equal_sizes():
+    """On a square mesh two axes share a group SIZE; only the device-id
+    stride of the replica groups tells them apart — tp (innermost,
+    stride 1) vs dp (stride = tp size)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+
+    def body(x):
+        a = jax.lax.psum(x, "tp")
+        return jax.lax.psum(a, "dp")
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("dp", "tp"),
+                   out_specs=P())
+    program = lower_callable(fn, jnp.zeros((4, 8), jnp.float32),
+                             name="square")
+    report = _graph_pm().run(program, AnalysisContext(
+        mesh_axes={"dp": 2, "tp": 2}))
+    axes = [e["mesh_axis"] for e in
+            report.metrics["collective"]["collectives"]]
+    assert sorted(a for a in axes if a) == ["dp", "tp"], (
+        axes, report.metrics["collective"]["collectives"])
+
+
+def test_collective_wire_bytes_model():
+    from paddle_tpu.cost_model import collective_wire_bytes
+    # ring all-reduce moves 2(n-1)/n of the payload per device
+    assert collective_wire_bytes("all_reduce", 1024, 8) == \
+        int(1024 * 2 * 7 / 8)
+    assert collective_wire_bytes("all_gather", 1024, 8) == \
+        int(1024 * 7 / 8)
+    assert collective_wire_bytes("all_reduce", 1024, 1) == 0
+
+
+# ----------------------------------------------------- jit / to_static
+
+def test_to_static_lint_populates_report(tmp_path):
+    """to_static(lint=True): graph findings appear on .lint_report after
+    the first call (the planted f32 upcast is visible through the jit
+    wrapper too)."""
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = _MatNet(True)
+    model.bfloat16()
+    sf = paddle.jit.to_static(model, lint=True)
+    with pytest.warns(UserWarning):
+        sf(paddle.to_tensor(np.zeros((4, 16), "float32")).astype(
+            "bfloat16"))
+    assert sf.lint_report is not None
+    assert sf.lint_report.by_rule("DTYPE-F32-MATMUL")
+
+
+def test_debug_diagnose_entry_point():
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = _ConvNet(True)
+    report = paddle.debug.diagnose(
+        model, jnp.zeros((2, 16, 16, 3), jnp.float32),
+        context=AnalysisContext(name="convnet", data_format="NHWC"),
+        print_report=False)
+    assert report.by_rule("LAYOUT-ACT-TRANSPOSE")

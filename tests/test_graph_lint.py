@@ -1,0 +1,89 @@
+"""The lint-graphs CI gate: every BASELINE config's lowered program
+must pass the full Graph Doctor catalog against its COMMITTED lint
+manifest (lint_manifests/<config>.json, regenerated with
+`python -m paddle_tpu.analysis --write-manifests`).
+
+Runs inside the standard tier-1 sweep (`pytest tests/ -m 'not slow'`);
+select just the gate with `-m lint_graphs`. Lowerings are cached per
+config inside paddle_tpu.analysis.baseline, so the five models trace
+once per process no matter how many tests consume them.
+"""
+import pytest
+
+from paddle_tpu.analysis import PassManager, Severity, load_manifest
+from paddle_tpu.analysis.baseline import BASELINE_CONFIGS, lowered_program
+
+pytestmark = pytest.mark.lint_graphs
+
+
+@pytest.fixture(scope="module")
+def pass_manager():
+    return PassManager()
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_baseline_config_lints_clean(name, pass_manager):
+    program, ctx, fwd = lowered_program(name)
+    ctx.manifest = load_manifest(name)
+    assert ctx.manifest is not None, (
+        f"lint_manifests/{name}.json is not committed — run "
+        "python -m paddle_tpu.analysis --write-manifests")
+    report = pass_manager.run_source(fwd, ctx)
+    report.extend(pass_manager.run(program, ctx))
+    errors = report.errors
+    assert errors == [], "\n".join(str(f) for f in errors)
+    # and the committed manifest is current (no silent op-count drift)
+    drift = report.by_rule("GRAPH-MANIFEST-DRIFT")
+    assert drift == [], "\n".join(str(f) for f in drift)
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_manifest_findings_summary_is_current(name, pass_manager):
+    """The manifest's findings_by_rule/max_severity mirror a fresh run
+    (a rule silenced or newly firing without a manifest regen is itself
+    drift)."""
+    from paddle_tpu.analysis import build_manifest
+    program, ctx, fwd = lowered_program(name)
+    ctx.manifest = load_manifest(name)
+    report = pass_manager.run_source(fwd, ctx)
+    report.extend(pass_manager.run(program, ctx))
+    fresh = build_manifest(name, program, report)
+    committed = ctx.manifest
+    assert fresh["findings_by_rule"] == committed["findings_by_rule"], (
+        name, fresh["findings_by_rule"], committed["findings_by_rule"])
+    assert fresh["op_counts"] == committed["op_counts"]
+
+
+def test_cli_runs_all_analyzers_over_baseline(capsys):
+    """`python -m paddle_tpu.analysis` (in-process main): all >=6
+    analyzers over all five configs, exit 0 on the clean committed
+    state."""
+    from paddle_tpu.analysis import default_catalog
+    from paddle_tpu.analysis.__main__ import main
+    assert len(default_catalog()) >= 6
+    rc = main(list(sorted(BASELINE_CONFIGS)))
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for name in BASELINE_CONFIGS:
+        assert f"== {name} ==" in out
+
+
+def test_cli_list(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "resnet50" in out and "dy2static-ast" in out
+
+
+def test_gate_reports_metrics_per_analyzer(pass_manager):
+    """Every graph analyzer contributes metrics (the manifest's raw
+    material) even when nothing fires."""
+    program, ctx, _ = lowered_program("resnet50")
+    report = pass_manager.run(program, ctx)
+    for analyzer in ("layout", "dtype", "host-transfer", "graph-shape",
+                     "collective"):
+        assert analyzer in report.metrics, analyzer
+    assert report.metrics["layout"]["n_activation_transposes"] == 0
+    assert report.metrics["graph-shape"]["op_counts"]["convolution"] == 53
+    # severity never reaches ERROR on the committed baseline
+    assert report.max_severity in (None, Severity.INFO, Severity.WARNING)
