@@ -18,11 +18,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _stage(batch):
+def _stage(batch, trainer=None):
     """device_put once, outside the timed loop: steady-state training keeps
-    batches device-resident via the input pipeline's async prefetch; timing
-    a synchronous 77MB host->device copy per step would measure the dev
-    tunnel, not the chip."""
+    batches device-resident via the input pipeline's async prefetch
+    (io.DeviceLoader); timing a synchronous 77MB host->device copy per step
+    would measure the dev tunnel, not the chip. With a trainer given the
+    batch lands with the trainer's OWN GSPMD batch sharding (the layout its
+    step pins via in_shardings), so the timed loop dispatches with zero
+    copies and zero reshards — exactly what DeviceLoader feeds in
+    production."""
+    if trainer is not None:
+        placed, _, _ = trainer.place_batch(batch)
+        return placed
     import jax.numpy as jnp
     return {k: jnp.asarray(v) for k, v in batch.items()}
 
@@ -107,6 +114,7 @@ def _alarm(seconds, label):
 def _measure(trainer, batch, steps, label):
     """Shared timing harness: compile+first step, one warm step, timed loop
     (async dispatch, single trailing sync). Returns seconds/step."""
+    batch = _stage(batch, trainer)   # mesh-sharded, matches step in_shardings
     t0 = time.time()
     with _alarm(600, f"{label} compile+first step"):
         loss = trainer.step(batch)
@@ -231,8 +239,7 @@ def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full",
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
     batch = {"input_ids": ids[:, :-1].astype("int32"),
              "labels": ids[:, 1:].astype("int32")}
-    batch = _stage(batch)
-    dt = _measure(trainer, batch, steps, cfg_name)
+    dt = _measure(trainer, batch, steps, cfg_name)   # _measure stages
     tokens_per_sec = batch_size * seq_len / dt
     n_params = cfg.num_params()
     flops_per_token = 6 * n_params  # fwd+bwd heuristic
@@ -268,7 +275,6 @@ def run_resnet50(batch_size=128, steps=10):
     rng = np.random.RandomState(0)
     batch = {"image": rng.randn(batch_size, 224, 224, 3).astype("float32"),
              "label": rng.randint(0, 1000, (batch_size,)).astype("int64")}
-    batch = _stage(batch)
     dt = _measure(trainer, batch, steps, "resnet50")
     imgs_s = batch_size / dt
     # ~4.09e9 MACs fwd at 224^2 -> 8.2 GFLOP fwd, x3 for train
@@ -319,7 +325,6 @@ def run_bert_base(batch_size=32, seq_len=512, steps=10):
              "attention_mask": attn_mask.astype("int32"),  # [B, L]: model expands
              "mlm_labels": labels.astype("int32"),
              "nsp_labels": rng.randint(0, 2, (batch_size,)).astype("int64")}
-    batch = _stage(batch)
     dt = _measure(trainer, batch, steps, "bert_base")
     seqs_s = batch_size / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
@@ -358,7 +363,6 @@ def run_yolov3(batch_size=16, size=320, steps=10):
              "gt_box": np.clip(rng.rand(batch_size, nb, 4) * 0.5 + 0.1, 0, 1)
              .astype("float32"),
              "gt_label": rng.randint(0, 80, (batch_size, nb)).astype("int32")}
-    batch = _stage(batch)
     fwd = _fwd_flops(trainer, batch)
     dt = _measure(trainer, batch, steps, "yolov3")
     imgs_s = batch_size / dt
@@ -399,10 +403,10 @@ def run_crnn(batch_size=64, width=320, steps=10):
     lens = rng.randint(max(1, max_len // 4), max_len + 1, batch_size)
     labels = rng.randint(1, 97, (batch_size, max_len))
     labels *= (np.arange(max_len)[None, :] < lens[:, None])
-    batch = _stage({
+    batch = {
         "image": rng.randn(batch_size, 32, width, 3).astype("float32"),
         "label": labels.astype("int32"),
-        "length": lens.astype("int32")})
+        "length": lens.astype("int32")}
     fwd = _fwd_flops(trainer, batch)
     dt = _measure(trainer, batch, steps, "crnn")
     imgs_s = batch_size / dt
@@ -442,8 +446,8 @@ def run_gpt_moe(batch_size=8, seq_len=1024, steps=10, gate=None):
     trainer = Trainer(model, opt, loss_fn)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
-    batch = _stage({"input_ids": ids[:, :-1].astype("int32"),
-                    "labels": ids[:, 1:].astype("int32")})
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
     dt = _measure(trainer, batch, steps, "gpt_moe")
     tok_s = batch_size * seq_len / dt
     # roofline on ACTIVATED params (top_k of E experts): 6N_active per token

@@ -57,10 +57,7 @@ def main():
             loss = trainer.step(batch)
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / 5
-        lowered = trainer._step_fn.lower(
-            trainer.params, trainer.opt_state, trainer.gt_state,
-            trainer.consts, 1e-3,
-            {k: jnp.asarray(v) for k, v in batch.items()})
+        lowered = trainer.lower_step(batch, 1e-3)
         ma = lowered.compile().memory_analysis()
         temp = getattr(ma, "temp_size_in_bytes", 0)
         results[sched] = (dt, temp)

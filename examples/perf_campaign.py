@@ -115,9 +115,7 @@ def run_hlo_audit():
     profiling rules)."""
     import jax.numpy as jnp
     trial, trainer, batch = _resnet_trial(128, steps=1)
-    lowered = trainer._step_fn.lower(
-        trainer.params, trainer.opt_state, trainer.gt_state, trainer.consts,
-        0.1, {k: jnp.asarray(v) for k, v in batch.items()})
+    lowered = trainer.lower_step(batch, 0.1)
     txt = lowered.compile().as_text()
     counts = {
         "conv_f32": sum(1 for l in txt.splitlines()

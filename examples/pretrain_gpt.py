@@ -36,8 +36,9 @@ def main():
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed import build_mesh
-    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.distributed.trainer import LossBuffer, Trainer
     from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.io import DeviceLoader
     from paddle_tpu.models import GPT, GPTPretrainingCriterion
     from paddle_tpu.models import gpt as gpt_mod
     from paddle_tpu.runtime import TokenLoader
@@ -79,21 +80,30 @@ def main():
                 yield {"input_ids": ids[:, :-1].astype("int32"),
                        "labels": ids[:, 1:].astype("int32")}
 
+    # async input pipeline: token assembly + sharded H2D copy run in a
+    # background thread, two batches ahead of the compiled step; losses
+    # stay on-device and sync once per log window (LossBuffer)
+    loader = DeviceLoader(batches(), depth=2)
+    losses = LossBuffer(drain_every=10)
     t0 = time.time()
-    for step, batch in enumerate(batches()):
+    for step, batch in enumerate(loader):
         if step >= args.steps:
             break
-        loss = trainer.step(batch)
+        losses.append(trainer.step(batch))
         if step % 10 == 0:
             dt = time.time() - t0
             tok_s = args.batch * args.seq * (step + 1) / max(dt, 1e-9)
-            print(f"step {step}: loss={float(loss):.4f} "
+            print(f"step {step}: loss={losses.drain():.4f} "
                   f"({tok_s:.0f} tok/s, lr={opt.get_lr():.2e})")
         if mgr and step and step % 100 == 0:
+            losses.drain()          # sync before touching host state
             trainer.sync_to_model()
             mgr.save(step, {"model": model.state_dict(),
                             "opt": opt.state_dict(), "step": step})
-    print(f"done: {args.steps} steps in {time.time()-t0:.1f}s")
+    losses.drain()
+    loader.close()
+    print(f"done: {args.steps} steps in {time.time()-t0:.1f}s "
+          f"(input pipeline: {loader.stats.snapshot()})")
 
 
 if __name__ == "__main__":

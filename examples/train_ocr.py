@@ -19,7 +19,8 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.distributed import build_mesh
-from paddle_tpu.distributed.trainer import Trainer
+from paddle_tpu.distributed.trainer import LossBuffer, Trainer
+from paddle_tpu.io import prefetch_to_device
 from paddle_tpu.vision.models import CRNN, DBNet
 
 CHARSET = "0123456789abcdefghijklmnopqrstuvwxyz"   # + blank at id 0
@@ -77,10 +78,14 @@ def main():
     opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                 parameters=model.parameters())
     trainer = Trainer(model, opt, loss_fn)
+    # prefetch_to_device: synthetic batches are assembled + sharded onto
+    # the mesh in a background thread; LossBuffer batches the host syncs
+    batches = prefetch_to_device(batches, depth=2)
+    losses = LossBuffer(drain_every=10)
     for step in range(1, args.steps + 1):
-        loss = trainer.step(next(batches))
+        losses.append(trainer.step(next(batches)))
         if step % 10 == 0 or step == 1:
-            print(f"step {step}: loss={float(loss):.4f}")
+            print(f"step {step}: loss={losses.drain():.4f}")
     if args.task == "rec":
         trainer.sync_to_model()
         ids = model.decode_greedy(model(paddle.to_tensor(

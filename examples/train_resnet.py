@@ -63,8 +63,8 @@ def main():
     args = ap.parse_args()
 
     import paddle_tpu as paddle
-    from paddle_tpu.distributed import Trainer, build_mesh
-    from paddle_tpu.io import DataLoader
+    from paddle_tpu.distributed import LossBuffer, Trainer, build_mesh
+    from paddle_tpu.io import DataLoader, DeviceLoader
 
     paddle.seed(0)
     build_mesh()  # dp over all attached devices
@@ -89,19 +89,26 @@ def main():
                          "lower --batch (drop_last would yield zero batches)")
     loader = DataLoader(ds, batch_size=args.batch, shuffle=True, drop_last=True,
                         num_workers=args.workers, persistent_workers=True)
+    # device-side prefetch: worker batches are sharded + H2D-copied two
+    # steps ahead; the step loop never blocks on input OR on the loss
+    dloader = DeviceLoader(loader, depth=2)
+    losses = LossBuffer(drain_every=10)
 
     step, t0 = 0, time.time()
     while step < args.steps:
-        for image, label in loader:
-            loss = trainer.step({"image": image, "label": label})
+        for image, label in dloader:
+            losses.append(trainer.step({"image": image, "label": label}))
             step += 1
             if step % 10 == 0:
                 dt = (time.time() - t0) / 10
-                print(f"step {step}: loss {float(loss):.4f}  "
+                print(f"step {step}: loss {losses.drain():.4f}  "
                       f"{args.batch / dt:.0f} imgs/s")
                 t0 = time.time()
             if step >= args.steps:
                 break
+    losses.drain()
+    dloader.close()
+    print(f"input pipeline: {dloader.stats.snapshot()}")
     trainer.sync_to_model()  # params + BN running stats back into the Layer
     paddle.save(model.state_dict(), f"{args.arch}.pdparams")
     print(f"saved {args.arch}.pdparams")

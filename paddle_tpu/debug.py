@@ -12,7 +12,19 @@ import jax.numpy as jnp
 from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
-           "assert_finite_pytree", "TensorCheckerConfig", "diagnose"]
+           "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
+           "input_pipeline_stats"]
+
+
+def input_pipeline_stats():
+    """Aggregate telemetry of every live `io.DeviceLoader`/prefetcher:
+    batches prefetched, current/max queue depth, host time blocked
+    waiting on input, H2D enqueue time. The observability half of the
+    async input pipeline — when `time_blocked_on_input_s` grows with
+    step count, the pipeline (not the chip) is the bottleneck: raise
+    `depth`, add DataLoader workers, or cheapen the transform."""
+    from .io.prefetch import prefetch_stats
+    return prefetch_stats()
 
 
 def diagnose(model_or_fn, *example_inputs, context=None, print_report=True):

@@ -50,7 +50,7 @@ from .compression import DGCCompressor, bf16_compress  # noqa: F401
 from .localsgd import LocalSGDTrainer  # noqa: F401
 from .sharded_embedding import ShardedEmbedding  # noqa: F401
 from .sharding_utils import constraint, plan_shardings, shard_params  # noqa: F401
-from .trainer import Trainer  # noqa: F401
+from .trainer import LossBuffer, Trainer, shard_batch  # noqa: F401
 from . import sharding  # noqa: F401  (group_sharded_parallel API)
 from . import utils  # noqa: F401  (Cluster/Pod/Trainer launch plumbing)
 
@@ -61,7 +61,7 @@ __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "wait", "fleet",
     "get_mesh", "build_mesh", "Mesh", "PartitionSpec", "NamedSharding",
     "plan_shardings", "shard_params", "constraint", "spawn", "launch",
-    "Trainer", "LocalSGDTrainer",
+    "Trainer", "LocalSGDTrainer", "LossBuffer", "shard_batch",
 ]
 
 

@@ -196,12 +196,9 @@ class Engine:
         """Lowered+compiled HLO text of the train step for `batch` —
         lets callers (and tests) inspect the GSPMD shardings."""
         self.prepare()
-        import jax.numpy as jnp
         t = self._trainer
-        b = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()}
-        lowered = t._step_fn.lower(t.params, t.opt_state, t.gt_state,
-                                   t.consts, self.optimizer.get_lr(), b)
-        return lowered.as_text()
+        b = {k: np.asarray(v) for k, v in batch.items()}
+        return t.lower_step(b, self.optimizer.get_lr()).as_text()
 
     # -- loops ------------------------------------------------------------
     def _loader(self, data, batch_size):

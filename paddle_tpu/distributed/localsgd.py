@@ -99,23 +99,14 @@ class LocalSGDTrainer:
         strip = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
 
         def step(params, opt_state, consts, lr, batch, do_sync):
-            try:                     # jax >= 0.6 exports it top-level
-                from jax import shard_map
-            except ImportError:      # 0.4.x: experimental
-                from jax.experimental.shard_map import shard_map
-            # the replication-check kwarg was renamed check_rep ->
-            # check_vma independently of the import move: pick by
-            # signature, not by jax version
-            import inspect as _inspect
-            params_ = _inspect.signature(shard_map).parameters
-            kw = "check_vma" if "check_vma" in params_ else "check_rep"
-            replication_kw = {kw: False}
-            return shard_map(
+            # version/kwarg portability lives in mesh.compat_shard_map
+            from .mesh import compat_shard_map
+            return compat_shard_map(
                 local_step, mesh=self.mesh,
                 in_specs=(strip(params), strip(opt_state), P(), P(),
                           jax.tree_util.tree_map(lambda _: P(axis), batch), P()),
                 out_specs=(strip(params), strip(opt_state), P(), P()),
-                **replication_kw,
+                check=False,
             )(params, opt_state, consts, lr, batch, do_sync)
 
         return jax.jit(step, donate_argnums=(0, 1))

@@ -9,6 +9,8 @@ collectives with compute on ICI.
 """
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
@@ -16,9 +18,10 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..framework.core import Tensor
 from ..nn.layer_base import functional_call, load_state_pytree
 from .mesh import get_mesh
-from .sharding_utils import feasible_spec, plan_shardings
+from .sharding_utils import plan_shardings
 
-__all__ = ["Trainer", "shard_batch", "make_compute_loss", "batch_to_arrays"]
+__all__ = ["Trainer", "LossBuffer", "shard_batch", "make_compute_loss",
+           "batch_to_arrays"]
 
 # consts key carrying the step counter that salts in-step RNG draws
 _RNG_STEP = "__rng_step__"
@@ -62,14 +65,65 @@ def shard_batch(batch, mesh=None, spec=("dp", "fsdp")):
 
     Axes that don't divide the batch dim are dropped (replicated) so user
     batches of any size are accepted, mirroring `sharding_utils.constraint`."""
+    from ..io.prefetch import _leaf_arrays, batch_shardings
     mesh = mesh or get_mesh()
+    arrays = _leaf_arrays(batch)
+    return jax.device_put(arrays, batch_shardings(arrays, mesh, spec))
 
-    def put(x):
-        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-        fspec = feasible_spec(v.shape, (tuple(spec),) + (None,) * (v.ndim - 1), mesh)
-        sh = NamedSharding(mesh, PartitionSpec(*fspec))
-        return jax.device_put(v, sh)
-    return jax.tree_util.tree_map(put, batch)
+
+class LossBuffer:
+    """Async metrics drain: `Trainer.step` returns an UNFETCHED device
+    loss — calling `float(loss)` every step blocks the host on step N and
+    stalls dispatch of N+1 (the dispatch-queue bubble docs/performance.md
+    rule 4 warns about). A LossBuffer holds the unfetched losses and
+    syncs ONCE per `drain_every` appends, so the host keeps running ahead
+    of the device.
+
+        buf = LossBuffer(drain_every=10)
+        for batch in loader:
+            buf.append(trainer.step(batch))   # no host sync here
+        print(buf.drain())                    # final sync + last loss
+
+    `maxlen` bounds the drained-history list; `fetches` counts host
+    syncs (observability: it must stay ~steps/drain_every)."""
+
+    def __init__(self, drain_every=16, maxlen=65536):
+        self.drain_every = max(1, int(drain_every))
+        self.maxlen = maxlen
+        self._pending = []
+        self.losses = []     # drained python floats, oldest first
+        self.fetches = 0     # number of host syncs issued
+
+    def append(self, loss):
+        self._pending.append(loss)
+        if len(self._pending) >= self.drain_every:
+            self.drain()
+        return self
+
+    @property
+    def pending(self):
+        """Dispatched-but-unfetched loss count."""
+        return len(self._pending)
+
+    @property
+    def last(self):
+        """Most recently DRAINED loss (no sync), or None."""
+        return self.losses[-1] if self.losses else None
+
+    def drain(self):
+        """Fetch every pending loss in one host sync; returns the latest
+        loss value."""
+        if self._pending:
+            vals = jax.device_get(self._pending)
+            self.fetches += 1
+            self.losses.extend(float(np.asarray(v)) for v in vals)
+            self._pending = []
+            if self.maxlen and len(self.losses) > self.maxlen:
+                del self.losses[:len(self.losses) - self.maxlen]
+        return self.last
+
+    def __len__(self):
+        return len(self.losses) + len(self._pending)
 
 
 class Trainer:
@@ -80,7 +134,8 @@ class Trainer:
     """
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, donate=True,
-                 grad_accum_steps=1, grad_transform=None):
+                 grad_accum_steps=1, grad_transform=None,
+                 batch_spec=("dp", "fsdp")):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -103,21 +158,54 @@ class Trainer:
             consts[name] = jax.device_put(b._value, self._plan[name])
         # per-step RNG salt rides consts so stochastic layers (dropout,
         # noisy MoE gates) draw FRESH randomness every compiled step
-        # (framework.random.traced_salt); load_state_pytree ignores it
-        consts[_RNG_STEP] = jnp.zeros((), jnp.uint32)
+        # (framework.random.traced_salt); load_state_pytree ignores it.
+        # Mesh-placed like every other const so the whole consts tree has
+        # one device assignment (required for the in_shardings step below)
+        consts[_RNG_STEP] = jax.device_put(
+            jnp.zeros((), jnp.uint32),
+            NamedSharding(self.mesh, PartitionSpec()))
         self.params = trainable
         self.consts = consts
         # slots inherit param shardings: zeros_like under jit keeps sharding
-        self.opt_state = jax.jit(optimizer.init_state_pytree)(self.params)
+        self.opt_state = self._mesh_place(
+            jax.jit(optimizer.init_state_pytree)(self.params))
         if self.grad_transform is not None and \
                 hasattr(self.grad_transform, "init_state"):
-            self.gt_state = jax.jit(self.grad_transform.init_state)(self.params)
+            self.gt_state = self._mesh_place(
+                jax.jit(self.grad_transform.init_state)(self.params))
         else:
             self.gt_state = None
+        self._donate = donate
         self._step_fn = self._build(donate)
         self._host_step = 0
+        # batch placement: precomputed NamedSharding pytrees + specialized
+        # compiled steps, keyed by the batch's (structure, shapes, dtypes)
+        # signature. The specialized step pins the batch argument's
+        # in_shardings, so the compiled program expects the batch already
+        # laid out over the data axes — no replicate-then-reshard inside
+        # jit, and host-numpy vs device-resident feeds share ONE program.
+        self._batch_spec = tuple(batch_spec)
+        self._batch_shardings = {}
+        self._placed_steps = {}
 
-    def _build(self, donate):
+    def _mesh_place(self, tree):
+        """Replicate any single-device leaf onto the full mesh. A state
+        leaf that depends on NO parameter (e.g. a stateless optimizer's
+        bare step counter) gets its params pruned from the init jit, which
+        then executes on one device — mixing that with mesh-committed
+        params in a single step program is an invalid device assignment."""
+        if self.mesh.devices.size <= 1:
+            return tree
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def fix(v):
+            sh = getattr(v, "sharding", None)
+            if sh is not None and getattr(sh, "num_devices", 1) == 1:
+                return jax.device_put(v, rep)
+            return v
+        return jax.tree_util.tree_map(fix, tree)
+
+    def _build(self, donate, in_shardings=None):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
         accum = self.grad_accum_steps
 
@@ -166,13 +254,93 @@ class Trainer:
                 new_consts[_RNG_STEP] = consts[_RNG_STEP] + 1
             return new_params, new_state, gt_state, new_consts, loss_v
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3) if donate else ())
+        kwargs = {}
+        if in_shardings is not None:
+            kwargs["in_shardings"] = in_shardings
+            # pin outputs to the same layout: step N's outputs then carry
+            # shardings EQUAL to step N+1's pinned inputs, so the dispatch
+            # cache hits from the first step onward (without this, the
+            # first step's GSPMD-typed outputs force one extra compile)
+            state_sh = in_shardings[:4]
+            kwargs["out_shardings"] = state_sh + (
+                NamedSharding(self.mesh, PartitionSpec()),)   # fp32 loss
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3) if donate else (),
+                       **kwargs)
+
+    # -- batch placement ----------------------------------------------------
+
+    def place_batch(self, batch):
+        """Normalize a batch onto the mesh with the precomputed GSPMD batch
+        sharding (leading dim over the data axes). Host numpy / Tensor
+        leaves are device_put — sharded and committed; already-resident
+        leaves (`io.DeviceLoader` / `shard_batch` output) pass through
+        untouched, since device_put with a matching sharding is a no-op.
+        Every feed path therefore reaches the compiled step with identical
+        input shardings: ONE compilation, zero per-step reshards."""
+        from ..io.prefetch import (_leaf_arrays, batch_shardings,
+                                   batch_signature)
+        arrays = _leaf_arrays(batch)
+        sig = batch_signature(arrays)
+        sh = self._batch_shardings.get(sig)
+        if sh is None:
+            sh = batch_shardings(arrays, self.mesh, self._batch_spec)
+            self._batch_shardings[sig] = sh
+        return jax.device_put(arrays, sh), sig, sh
+
+    def _placed_step(self, sig, batch_sh):
+        """Compiled step specialized to one batch signature, with every
+        argument's sharding pinned via in_shardings (batch included — the
+        program is compiled to CONSUME the sharded batch, not to reshard a
+        replicated one). Falls back to the generic jit when a sharding
+        can't be derived (exotic state pytrees)."""
+        fn = self._placed_steps.get(sig)
+        if fn is None:
+            try:
+                leaf_sh = lambda v: v.sharding  # noqa: E731
+                in_sh = (
+                    jax.tree_util.tree_map(leaf_sh, self.params),
+                    jax.tree_util.tree_map(leaf_sh, self.opt_state),
+                    (jax.tree_util.tree_map(leaf_sh, self.gt_state)
+                     if self.gt_state is not None else None),
+                    jax.tree_util.tree_map(leaf_sh, self.consts),
+                    NamedSharding(self.mesh, PartitionSpec()),   # lr scalar
+                    batch_sh)
+                fn = self._build(self._donate, in_shardings=in_sh)
+            except (AttributeError, TypeError) as e:
+                # a state leaf with no .sharding (exotic pytree): fall
+                # back to the unpinned jit — LOUDLY, because the fallback
+                # re-introduces the in-jit batch reshard this class
+                # exists to avoid
+                import warnings
+                warnings.warn(
+                    "Trainer: could not derive in_shardings for the "
+                    f"compiled step ({e!r}); falling back to the "
+                    "unpinned jit (batch resharding inside the step)")
+                fn = self._step_fn
+            self._placed_steps[sig] = fn
+        return fn
+
+    def lower_step(self, batch, lr=0.0):
+        """Lower the SAME specialized program `step()` dispatches for this
+        batch's signature (in/out shardings pinned) — the honest target
+        for static analysis, HLO pins, and memory audits. `_step_fn` (the
+        unspecialized jit) exists only as the fallback for state pytrees
+        whose shardings can't be derived; don't analyze that one."""
+        arrays, sig, batch_sh = self.place_batch(batch)
+        fn = self._placed_step(sig, batch_sh)
+        return fn.lower(self.params, self.opt_state, self.gt_state,
+                        self.consts, lr, arrays)
 
     def step(self, batch, lr=None):
+        """Dispatch one compiled step. NON-BLOCKING: the returned loss is
+        an unfetched device array — `float()` it only when you must (or
+        batch the syncs through a `LossBuffer`), so dispatch of step N+1
+        overlaps step N's compute."""
         lr = self.optimizer.get_lr() if lr is None else lr
-        batch = batch_to_arrays(batch)
+        batch, sig, batch_sh = self.place_batch(batch)
+        step_fn = self._placed_step(sig, batch_sh)
         (self.params, self.opt_state, self.gt_state, self.consts,
-         loss) = self._step_fn(
+         loss) = step_fn(
             self.params, self.opt_state, self.gt_state, self.consts, lr, batch)
         sched = self.optimizer._lr_scheduler
         if sched is not None:
@@ -225,3 +393,7 @@ class Trainer:
         if "gt_state" in state:
             self.gt_state = put_tree(self.gt_state, state["gt_state"])
         self._host_step = int(state.get("step", 0))
+        # restored leaves may carry different shardings (resharded mesh,
+        # default-placed opt state): drop the specialized steps so the next
+        # step() re-derives in_shardings from the actual arrays
+        self._placed_steps = {}

@@ -68,7 +68,7 @@ class Model:
 
         return jax.jit(step, donate_argnums=(0, 2))
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, fetch=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
         net = self.network
@@ -80,8 +80,8 @@ class Model:
                 **buffer_pytree(net)}.items() if k not in self._params}
             self._opt_state = self._optimizer.init_state_pytree(self._params)
             self._compiled_step = self._build_train_step()
-        in_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in inputs]
-        lab_vals = [x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x)) for x in labels]
+        in_vals = [self._leaf_value(x) for x in inputs]
+        lab_vals = [self._leaf_value(x) for x in labels]
         lr = self._optimizer.get_lr()
         self._params, self._opt_state, self._buffers, loss_v, out = self._compiled_step(
             self._params, self._buffers, self._opt_state, lr, in_vals, lab_vals)
@@ -94,7 +94,20 @@ class Model:
             correct = m.compute(Tensor(out), labels[0])
             m.update(correct)
             metrics_out.append(m.accumulate())
-        return (float(loss_v), metrics_out) if metrics_out else float(loss_v)
+        if metrics_out:
+            return float(loss_v), metrics_out
+        # fetch=False: hand back the UNFETCHED device loss (async metrics
+        # drain — fit's prefetch path batches the host syncs through a
+        # LossBuffer instead of stalling dispatch every step)
+        return float(loss_v) if fetch else loss_v
+
+    @staticmethod
+    def _leaf_value(x):
+        if isinstance(x, Tensor):
+            return x._value
+        if isinstance(x, jax.Array):   # device-resident (io.DeviceLoader)
+            return x
+        return jnp.asarray(np.asarray(x))
 
     def _sync_params_back(self):
         if self._compiled_step is not None:
@@ -126,11 +139,24 @@ class Model:
     # -- loops ---------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kwargs):
-        from ..io import DataLoader, Dataset
-        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
-            train_data, batch_size=batch_size, shuffle=shuffle,
-            drop_last=drop_last, num_workers=num_workers)
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            prefetch=False, prefetch_depth=2, **kwargs):
+        from ..io import DataLoader, Dataset, DeviceLoader
+        loader = train_data if isinstance(train_data, (DataLoader, DeviceLoader)) \
+            else DataLoader(
+                train_data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers)
+        # async input pipeline: device-resident sharded batches `depth`
+        # ahead + loss syncs batched per log window instead of per step
+        loss_buf = None
+        own_device_loader = None
+        if prefetch:
+            from ..distributed.trainer import LossBuffer
+            if not isinstance(loader, DeviceLoader):
+                loader = own_device_loader = DeviceLoader(
+                    loader, depth=prefetch_depth)
+            if not self._metrics:   # metrics force a per-step host sync
+                loss_buf = LossBuffer(drain_every=max(1, log_freq))
         from .callbacks import LRScheduler
         user_cbs = list(callbacks or [])
         auto = [ProgBarLogger(log_freq, verbose)]
@@ -146,32 +172,47 @@ class Model:
             cbs.set_params({"epochs": epochs, "steps": None})
         cbs.on_train_begin()
         self.stop_training = False
-        for epoch in range(epochs):
-            cbs.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbs.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                res = self.train_batch(inputs, labels)
-                if isinstance(res, tuple):
-                    loss, mvals = res
-                    logs = {"loss": loss}
-                    for m, v in zip(self._metrics, mvals):
-                        names = m.name() if isinstance(m.name(), list) else [m.name()]
-                        vals = v if isinstance(v, list) else [v]
-                        logs.update(dict(zip(names, vals)))
-                else:
-                    logs = {"loss": res}
-                cbs.on_train_batch_end(step, logs)
-            cbs.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
-            if self.stop_training:
-                break
+        try:
+            for epoch in range(epochs):
+                cbs.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbs.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    res = self.train_batch(inputs, labels,
+                                           fetch=loss_buf is None)
+                    if isinstance(res, tuple):
+                        loss, mvals = res
+                        logs = {"loss": loss}
+                        for m, v in zip(self._metrics, mvals):
+                            names = m.name() if isinstance(m.name(), list) else [m.name()]
+                            vals = v if isinstance(v, list) else [v]
+                            logs.update(dict(zip(names, vals)))
+                    elif loss_buf is not None:
+                        # non-blocking: the device loss lands in the buffer;
+                        # one host sync per drain window feeds the logs
+                        loss_buf.append(res)
+                        logs = {"loss": loss_buf.last
+                                if loss_buf.last is not None else float("nan")}
+                    else:
+                        logs = {"loss": res}
+                    cbs.on_train_batch_end(step, logs)
+                if loss_buf is not None:
+                    logs = {"loss": loss_buf.drain()}
+                cbs.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/{epoch}")
+                if self.stop_training:
+                    break
+        finally:
+            # close the loader fit itself created: an exception mid-epoch
+            # must not strand the prefetch thread holding device batches
+            if own_device_loader is not None:
+                own_device_loader.close()
         cbs.on_train_end()
 
     def _split_batch(self, batch):

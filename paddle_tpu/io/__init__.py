@@ -27,6 +27,8 @@ __all__ = [
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "get_worker_info",
+    "DeviceLoader", "prefetch_to_device", "batch_shardings",
+    "PrefetchStats", "prefetch_stats", "reset_prefetch_stats",
 ]
 
 
@@ -536,7 +538,8 @@ class _GeneratorLoader:
     through as tensors; sample generators are batched with the given
     batch_size."""
 
-    def __init__(self, return_list=False, drop_last=True):
+    def __init__(self, return_list=False, drop_last=True, capacity=None,
+                 use_double_buffer=True):
         if not return_list:
             # reference DygraphGeneratorLoader (fluid/reader.py:967-971)
             # warns and coerces to list mode — dict-of-feed-name batches
@@ -549,6 +552,11 @@ class _GeneratorLoader:
         self._mode = "batch"
         self._batch_size = 1
         self._drop_last = drop_last
+        # reference from_generator(capacity, use_double_buffer) fed the C++
+        # DoubleBufferReader; here they parameterize the thread prefetcher
+        # (io.prefetch): capacity = queue depth, use_double_buffer = on/off
+        self._capacity = capacity
+        self._use_double_buffer = use_double_buffer
 
     def set_batch_generator(self, generator, places=None):
         self._gen, self._mode = generator, "batch"
@@ -568,10 +576,7 @@ class _GeneratorLoader:
             self._drop_last = drop_last
         return self
 
-    def __iter__(self):
-        if self._gen is None:
-            raise RuntimeError("call set_batch_generator / "
-                               "set_sample_generator first")
+    def _batches(self):
         if self._mode == "batch":
             for item in self._gen():
                 yield _to_tensor_tree(item)
@@ -588,6 +593,17 @@ class _GeneratorLoader:
                 buf = []
         if buf and not self._drop_last:
             yield default_collate_fn(buf)
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError("call set_batch_generator / "
+                               "set_sample_generator first")
+        if self._use_double_buffer and self._capacity:
+            # batch assembly runs in a background thread, `capacity` deep;
+            # generator errors re-raise at next() with the worker traceback
+            from .prefetch import prefetch_iterator
+            return prefetch_iterator(self._batches(), depth=self._capacity)
+        return self._batches()
 
 
 def _to_tensor_tree(item):
@@ -695,27 +711,59 @@ class DataLoader:
 
     def _iter_map_threaded(self):
         """Thread pool + bounded queue: overlap host batch assembly with device
-        compute (the role of the reference's C++ DoubleBufferReader)."""
-        q = _queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
-        idx_q = _queue.Queue()
-        for i, idxs in enumerate(self.batch_sampler):
-            idx_q.put((i, idxs))
-        n_batches = idx_q.qsize()
-        stop = threading.Event()
+        compute (the role of the reference's C++ DoubleBufferReader).
 
-        def worker(wid):
-            _worker_info.info = _WorkerInfo(wid, self.num_workers, self.dataset)
-            if self.worker_init_fn:
-                self.worker_init_fn(wid)
+        Index batches are pulled LAZILY from the sampler under a lock — a
+        huge epoch never materializes its whole index list up front — and
+        completion is tracked by per-worker done markers instead of
+        `Queue.qsize` (approximate on some platforms)."""
+        q = _queue.Queue(maxsize=max(1, self.num_workers * self.prefetch_factor))
+        src = enumerate(iter(self.batch_sampler))
+        src_lock = threading.Lock()
+        stop = threading.Event()
+        done_marker = object()
+
+        def pull():
+            with src_lock:
+                return next(src, None)
+
+        def put(payload):
+            # stop-aware bounded put: a consumer that breaks early must not
+            # strand workers blocked on a full queue
             while not stop.is_set():
                 try:
-                    i, idxs = idx_q.get_nowait()
-                except _queue.Empty:
-                    return
-                try:
-                    q.put((i, self.collate_fn([self.dataset[j] for j in idxs])))
-                except Exception as e:  # surface worker errors to the consumer
-                    q.put((i, e))
+                    q.put(payload, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def worker(wid):
+            # the done marker is put UNCONDITIONALLY (finally): a worker
+            # dying in worker_init_fn or in the user sampler's iterator
+            # must not leave the consumer blocked on q.get() forever —
+            # those errors travel as an index-less (None, exc) payload
+            try:
+                _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                                self.dataset)
+                if self.worker_init_fn:
+                    self.worker_init_fn(wid)
+                while not stop.is_set():
+                    item = pull()
+                    if item is None:
+                        break
+                    i, idxs = item
+                    try:
+                        payload = (i, self.collate_fn(
+                            [self.dataset[j] for j in idxs]))
+                    except Exception as e:  # surface errors to the consumer
+                        payload = (i, e)
+                    if not put(payload):
+                        return
+            except Exception as e:      # init / sampler failure
+                put((None, e))
+            finally:
+                put(done_marker)
 
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(self.num_workers)]
@@ -725,10 +773,15 @@ class DataLoader:
             # reorder to sequential batch order
             pending = {}
             next_i = 0
-            received = 0
-            while received < n_batches:
-                i, payload = q.get()
-                received += 1
+            done = 0
+            while done < len(threads) or pending:
+                item = q.get()
+                if item is done_marker:
+                    done += 1
+                    continue
+                i, payload = item
+                if i is None:           # worker died outside a batch
+                    raise payload
                 pending[i] = payload
                 while next_i in pending:
                     item = pending.pop(next_i)
@@ -738,6 +791,13 @@ class DataLoader:
                     yield item
         finally:
             stop.set()
+            while True:   # unblock workers parked on a full queue
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            for t in threads:
+                t.join(timeout=5)
 
     def _iter_map_multiprocess(self):
         # pool is created lazily HERE (inside the generator) so that an
@@ -774,9 +834,16 @@ class DataLoader:
         whose set_*_generator methods install a python generator. Like
         the reference dygraph loader, return_list=False warns and
         coerces to list mode; new code should construct
-        DataLoader(dataset) directly."""
+        DataLoader(dataset) directly.
+
+        `capacity`/`use_double_buffer` map onto the thread prefetcher
+        (io.prefetch): with a capacity given and double buffering on
+        (the reference default), batches are assembled `capacity` ahead
+        in a background thread. Device placement belongs to
+        `io.DeviceLoader`, which new code should use instead."""
         return _GeneratorLoader(return_list=return_list,
-                                drop_last=drop_last)
+                                drop_last=drop_last, capacity=capacity,
+                                use_double_buffer=use_double_buffer)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
@@ -788,3 +855,8 @@ class DataLoader:
             "from_dataset wraps the fluid parameter-server Dataset; use "
             "DataLoader(dataset) with distributed.ShardedEmbedding for "
             "recsys-scale tables (docs/distributed.md)")
+
+
+from .prefetch import (  # noqa: E402  (DataLoader must exist first)
+    DeviceLoader, PrefetchStats, batch_shardings, prefetch_stats,
+    prefetch_to_device, reset_prefetch_stats)

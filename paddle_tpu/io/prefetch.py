@@ -1,0 +1,332 @@
+"""Async device input pipeline — sharded batch prefetch.
+
+The Trainer compiles ONE XLA program per step; the paper's thesis only
+holds if that program is fully fed. A synchronous feed breaks it twice
+per step: the H2D copy of the batch sits on the dispatch path, and the
+batch arrives replicated (or host-resident) so GSPMD reshards it inside
+the step. `DeviceLoader` is the reference DoubleBufferReader rebuilt for
+the mesh era: a background thread pulls batches from any
+DataLoader/iterator and keeps `depth` upcoming batches resident on the
+mesh with the GSPMD batch sharding (leading dim over the data axes,
+`distributed.trainer.shard_batch` semantics) via `jax.device_put` — an
+async enqueue, so the copy of batch N+1 overlaps step N's compute.
+
+Telemetry rides along (`PrefetchStats` / `prefetch_stats()`): batches
+prefetched, queue depth, and host time blocked waiting on input, so the
+overlap is observable rather than asserted — if `time_blocked_on_input_s`
+dominates, the pipeline (not the chip) is the bottleneck.
+"""
+import queue as _queue
+import threading
+import time
+import traceback as _traceback
+import weakref
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["DeviceLoader", "prefetch_to_device", "batch_shardings",
+           "batch_signature", "prefetch_iterator", "PrefetchStats",
+           "prefetch_stats", "reset_prefetch_stats"]
+
+
+def batch_signature(arrays):
+    """Cache key for a batch pytree: (treedef, ((shape, dtype), ...)).
+    dtypes are canonicalized (int64 numpy and the int32 device array it
+    becomes under disabled x64 must hit the same entry). NEVER touches
+    the data — `np.asarray` only for leaves with no `.dtype` (python
+    scalars); a device array must not be fetched just to read its dtype."""
+    leaves, treedef = jax.tree_util.tree_flatten(arrays)
+    sig = []
+    for v in leaves:
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            dt = np.asarray(v).dtype
+        sig.append((np.shape(v), str(jax.dtypes.canonicalize_dtype(dt))))
+    return (treedef, tuple(sig))
+
+_END = object()      # producer-side end-of-stream marker
+
+
+class _PrefetchError:
+    """Worker-thread failure, re-raised at the consumer's next() with the
+    original traceback (same contract as the process workers'
+    ``_WorkerError.reraise``)."""
+
+    def __init__(self, exc):
+        self.message = "".join(_traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+
+    def reraise(self):
+        raise RuntimeError(f"prefetch worker failed:\n{self.message}")
+
+
+class PrefetchStats:
+    """Per-loader input-pipeline telemetry."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.batches = 0            # batches handed to the consumer
+        self.epochs = 0             # __iter__ calls
+        self.put_time_s = 0.0       # host time spent enqueueing H2D copies
+        self.blocked_time_s = 0.0   # host time blocked waiting on input
+        self.queue_depth = 0        # depth observed at the last next()
+        self.max_queue_depth = 0
+
+    def snapshot(self):
+        return {"batches_prefetched": self.batches,
+                "epochs": self.epochs,
+                "h2d_put_time_s": round(self.put_time_s, 6),
+                "time_blocked_on_input_s": round(self.blocked_time_s, 6),
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": self.max_queue_depth}
+
+
+_STATS_REGISTRY = []   # weakrefs; aggregate view for debug telemetry
+
+
+def _register_stats(stats):
+    _STATS_REGISTRY.append(weakref.ref(stats))
+
+
+def prefetch_stats():
+    """Aggregate snapshot over every live prefetcher (the
+    `debug.input_pipeline_stats()` backend)."""
+    agg = PrefetchStats().snapshot()
+    live = []
+    for ref in _STATS_REGISTRY:
+        s = ref()
+        if s is None:
+            continue
+        live.append(ref)
+        snap = s.snapshot()
+        for k in ("batches_prefetched", "epochs", "h2d_put_time_s",
+                  "time_blocked_on_input_s"):
+            agg[k] = round(agg[k] + snap[k], 6)
+        agg["queue_depth"] += snap["queue_depth"]
+        agg["max_queue_depth"] = max(agg["max_queue_depth"],
+                                     snap["max_queue_depth"])
+    _STATS_REGISTRY[:] = live
+    return agg
+
+
+def reset_prefetch_stats():
+    for ref in _STATS_REGISTRY:
+        s = ref()
+        if s is not None:
+            s.reset()
+    _STATS_REGISTRY[:] = [r for r in _STATS_REGISTRY if r() is not None]
+
+
+def _leaf_array(v):
+    """Batch leaf -> raw array WITHOUT copying device arrays back to host."""
+    from ..framework.core import Tensor
+    if isinstance(v, Tensor):
+        return v._value
+    if isinstance(v, (jax.Array, np.ndarray)):
+        return v
+    return np.asarray(v)
+
+
+def _leaf_arrays(tree):
+    from ..framework.core import Tensor
+    return jax.tree_util.tree_map(
+        _leaf_array, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def batch_shardings(batch, mesh=None, spec=("dp", "fsdp")):
+    """NamedSharding pytree for a batch: leading dim over the data axes,
+    everything else replicated (`distributed.trainer.shard_batch`
+    placement). Axes that don't divide the batch dim are dropped
+    (feasible_spec policy) so user-sized batches degrade to replication
+    instead of raising. Computed from SHAPES only, so the result can be
+    cached and passed as jit ``in_shardings``."""
+    from ..distributed.mesh import get_mesh
+    from ..distributed.sharding_utils import feasible_spec
+    from ..framework.core import Tensor
+    mesh = mesh or get_mesh()
+    spec = tuple(spec)
+
+    def sh(v):
+        shape = np.shape(v._value) if isinstance(v, Tensor) else np.shape(v)
+        if not shape:
+            return NamedSharding(mesh, PartitionSpec())
+        fspec = feasible_spec(shape, (spec,) + (None,) * (len(shape) - 1),
+                              mesh)
+        return NamedSharding(mesh, PartitionSpec(*fspec))
+
+    return jax.tree_util.tree_map(sh, batch,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class _PrefetchIterator:
+    """Bounded-queue background producer. `transform` runs IN the worker
+    thread (this is where DeviceLoader's device_put goes — off the
+    consumer's critical path); errors re-raise at next(); close() joins
+    the thread."""
+
+    def __init__(self, source, depth, transform=None, stats=None):
+        self._q = _queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._stats = stats
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._producer, args=(source, transform),
+            daemon=True, name="paddle_tpu-prefetch")
+        self._thread.start()
+
+    # -- producer side ------------------------------------------------------
+    def _producer(self, source, transform):
+        try:
+            for item in source:
+                if self._stop.is_set():
+                    return
+                if transform is not None:
+                    item = transform(item)
+                if not self._put(item):
+                    return
+            self._put(_END)
+        except BaseException as e:   # re-raised at the consumer's next()
+            self._put(_PrefetchError(e))
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                if self._stats is not None:
+                    self._stats.max_queue_depth = max(
+                        self._stats.max_queue_depth, self._q.qsize())
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        t0 = time.monotonic()
+        item = self._q.get()
+        if self._stats is not None:
+            self._stats.blocked_time_s += time.monotonic() - t0
+        if item is _END:
+            self._exhausted = True
+            self._thread.join(timeout=5)
+            raise StopIteration
+        if isinstance(item, _PrefetchError):
+            self._exhausted = True
+            self._thread.join(timeout=5)
+            item.reraise()
+        if self._stats is not None:
+            self._stats.batches += 1
+            self._stats.queue_depth = self._q.qsize()
+        return item
+
+    def close(self):
+        """Stop the producer and join its thread (no leak even when the
+        consumer breaks mid-epoch). Idempotent."""
+        self._stop.set()
+        self._exhausted = True
+        while True:   # unblock a producer waiting on a full queue
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        self._thread.join(timeout=5)
+        return not self._thread.is_alive()
+
+    def __del__(self):
+        try:
+            if not self._exhausted:
+                self.close()
+        except Exception:
+            pass
+
+
+def prefetch_iterator(source, depth=2, transform=None, stats=None):
+    """Host-side prefetch: background thread + bounded queue over any
+    iterable, no device placement. Backs `DataLoader.from_generator`'s
+    `use_double_buffer`/`capacity` flags."""
+    return _PrefetchIterator(iter(source), depth, transform, stats)
+
+
+class DeviceLoader:
+    """Wrap any DataLoader/iterable; yield mesh-resident, GSPMD-sharded
+    batches, keeping `depth` batches in flight.
+
+        loader = io.DataLoader(dataset, batch_size=128, num_workers=4)
+        for batch in io.DeviceLoader(loader, depth=2):
+            loss = trainer.step(batch)       # zero H2D on the step path
+
+    Leaves arrive as committed jax Arrays sharded over `spec` on their
+    leading dim (axes that don't divide are dropped), exactly the layout
+    `Trainer` pins as its batch `in_shardings` — so the step dispatches
+    with no copy and no reshard. Sharding pytrees are computed once per
+    (structure, shapes, dtypes) signature and reused."""
+
+    def __init__(self, loader, mesh=None, depth=2, spec=("dp", "fsdp")):
+        self.loader = loader
+        self.depth = max(1, int(depth))
+        self.spec = tuple(spec)
+        self._mesh = mesh
+        self.stats = PrefetchStats()
+        _register_stats(self.stats)
+        self._sharding_cache = {}
+        self._live = []   # weakrefs to iterators, for close()
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..distributed.mesh import get_mesh
+            self._mesh = get_mesh()
+        return self._mesh
+
+    def _shardings_for(self, arrays):
+        key = batch_signature(arrays)
+        sh = self._sharding_cache.get(key)
+        if sh is None:
+            sh = batch_shardings(arrays, self.mesh, self.spec)
+            self._sharding_cache[key] = sh
+        return sh
+
+    def _place(self, batch):
+        """Runs in the prefetch thread: async H2D enqueue off the step
+        path. device_put on an already-matching array is a no-op."""
+        arrays = _leaf_arrays(batch)
+        t0 = time.monotonic()
+        out = jax.device_put(arrays, self._shardings_for(arrays))
+        self.stats.put_time_s += time.monotonic() - t0
+        return out
+
+    def __iter__(self):
+        self.stats.epochs += 1
+        it = _PrefetchIterator(iter(self.loader), self.depth,
+                               transform=self._place, stats=self.stats)
+        self._live = [r for r in self._live if r() is not None]
+        self._live.append(weakref.ref(it))
+        return it
+
+    def __len__(self):
+        return len(self.loader)
+
+    def close(self):
+        """Close every live iterator (join prefetch threads)."""
+        for ref in self._live:
+            it = ref()
+            if it is not None:
+                it.close()
+        self._live = []
+
+
+def prefetch_to_device(iterator, depth=2, mesh=None, spec=("dp", "fsdp")):
+    """Functional face of DeviceLoader: wrap an iterator/generator and get
+    an iterator of device-resident sharded batches (works with `next()`,
+    e.g. over an infinite synthetic-batch generator)."""
+    return iter(DeviceLoader(iterator, mesh=mesh, depth=depth, spec=spec))

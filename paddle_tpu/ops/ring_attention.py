@@ -31,6 +31,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+def _axis_size(axis_name):
+    """Static size of a shard_map axis: `jax.lax.axis_size` on jax >= 0.6;
+    on 0.4.x, psum of a literal 1 (constant-folded to the static size)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
 __all__ = ["ring_attention_local", "ring_attention",
            "ring_flash_attention_local", "zigzag_ring_attention_local",
            "zigzag_ring_flash_attention_local"]
@@ -68,7 +77,7 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
 def _ring_flash_fwd_compute(q, k, v, axis_name, causal, scale):
     from .attention import _flash_fwd_lse_impl
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -111,7 +120,7 @@ def _ring_flash_bwd(axis_name, causal, scale, res, cts):
 
     q, k, v, out, lse = res
     g = cts[0].astype(q.dtype)   # lse cotangent is zero in ring use
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -203,7 +212,7 @@ def _zz_ring_flash(q, k, v, axis_name, scale):
 def _zz_ring_flash_fwd_compute(q, k, v, axis_name, scale):
     from .attention import _flash_fwd_lse_impl
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     d = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     Lh = q.shape[1] // 2
@@ -269,7 +278,7 @@ def _zz_ring_flash_bwd(axis_name, scale, res, cts):
 
     q, k, v, out, lse = res
     g = cts[0].astype(q.dtype)
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     d = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     Lh = q.shape[1] // 2
@@ -370,7 +379,7 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=True, scale=None,
 
 def _ring_dense_local(q, k, v, axis_name="sp", causal=True, scale=None):
     """Dense per-step scores (materializes Lq x Lk per ring step)."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
 
@@ -445,7 +454,7 @@ def zigzag_ring_attention_local(q, k, v, axis_name="sp", scale=None,
 
 def _zigzag_dense_local(q, k, v, axis_name="sp", scale=None):
     """Dense zigzag step blocks (materializes Lh x Lh scores per block)."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     d = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
 
@@ -574,9 +583,8 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     supported shape; zigzag additionally needs 128-aligned half-chunks).
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
-    from ..distributed.mesh import get_mesh
+    from ..distributed.mesh import compat_shard_map, get_mesh
 
     mesh = mesh or get_mesh()
     spec = P(batch_axes, axis_name, None, None)
@@ -613,5 +621,5 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
         # the vma checker can't see through pallas_call's out_shape (same
         # caveat as ulysses.py); keep it active for the dense paths
         check_vma = not use_flash
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=check_vma)(q, k, v)
+    return compat_shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check=check_vma)(q, k, v)

@@ -61,9 +61,8 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
     """shard_map wrapper: q,k,v GLOBAL [B, L, H, D], sequence dim split over
     `axis_name`. Requires H % sp == 0."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
-    from ..distributed.mesh import get_mesh
+    from ..distributed.mesh import compat_shard_map, get_mesh
 
     mesh = mesh or get_mesh()
     sp = mesh.shape[axis_name]
@@ -77,5 +76,5 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=True,
                            causal=causal, scale=scale)
     # check_vma=False: the vma checker can't see through pallas_call's
     # out_shape, so it would force the flash kernel onto the fallback path
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return compat_shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check=False)(q, k, v)

@@ -141,9 +141,7 @@ def test_gpt_train_step_remat_policy_graph():
     trainer = Trainer(model, opt, loss_fn)
     ids = np.zeros((2, 33), np.int32)
     batch = {"x": jnp.asarray(ids[:, :-1]), "y": jnp.asarray(ids[:, 1:])}
-    lowered = trainer._step_fn.lower(
-        trainer.params, trainer.opt_state, trainer.gt_state, trainer.consts,
-        1e-4, batch)
+    lowered = trainer.lower_step(batch, 1e-4)
     program = LoweredProgram(lowered.as_text(), name="gpt_train_step")
     n_dots = program.count("dot_general")
     # fwd(6/block+1) + recompute(6/block) + bwd(2 per fwd dot: dx, dw)
@@ -232,9 +230,7 @@ def test_gpt_gradient_merge_graph_scans_microbatches():
     trainer = Trainer(model, opt, loss_fn, grad_accum_steps=2)
     ids = np.zeros((4, 33), np.int32)  # global batch 4 = 2 micro x 2
     batch = {"x": jnp.asarray(ids[:, :-1]), "y": jnp.asarray(ids[:, 1:])}
-    lowered = trainer._step_fn.lower(
-        trainer.params, trainer.opt_state, trainer.gt_state, trainer.consts,
-        1e-4, batch)
+    lowered = trainer.lower_step(batch, 1e-4)
     program = LoweredProgram(lowered.as_text(), name="gpt_accum_step")
     assert program.count("while") > 0, "gradient-merge scan was unrolled"
     n_dots = program.count("dot_general")

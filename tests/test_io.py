@@ -39,6 +39,32 @@ def test_from_generator_batch_and_sample_modes():
 
     with pytest.raises(NotImplementedError, match="ShardedEmbedding"):
         paddle.io.DataLoader.from_dataset(None)
+    # capacity/use_double_buffer now drive the io.prefetch thread: with a
+    # capacity given, batch assembly runs `capacity` ahead in a worker
+    # thread — same values, same order, fresh thread per epoch
+    buffered = paddle.io.DataLoader.from_generator(capacity=2)
+    buffered.set_batch_generator(
+        lambda: iter([np.full((2, 2), i, "float32") for i in range(5)]))
+    for _ in range(2):
+        vals = [float(b.numpy()[0, 0]) for b in buffered]
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # generator errors re-raise at next() with the worker's traceback
+    def _bad():
+        yield np.zeros((1,), "float32")
+        raise ValueError("generator boom")
+    broken = paddle.io.DataLoader.from_generator(capacity=2)
+    broken.set_batch_generator(_bad)
+    it = iter(broken)
+    next(it)
+    with pytest.raises(RuntimeError, match="generator boom"):
+        next(it)
+    # use_double_buffer=False opts out: plain in-line generator
+    plain = paddle.io.DataLoader.from_generator(capacity=2,
+                                                use_double_buffer=False)
+    plain.set_batch_generator(
+        lambda: iter([np.zeros((1,), "float32")]))
+    import types
+    assert isinstance(iter(plain), types.GeneratorType)
     # reference default is return_list=False (fluid/reader.py:570); the
     # dygraph loader warns and coerces to list mode rather than raising
     with pytest.warns(UserWarning, match="return as list"):
