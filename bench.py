@@ -129,6 +129,27 @@ def _measure(trainer, batch, steps, label):
     return (time.time() - t0) / steps
 
 
+def _static_hbm(trainer, batch):
+    """Static per-device peak-HBM estimate of the REAL compiled step
+    (Memory Doctor liveness over the traced jaxpr, shardings + donation
+    captured) — banked next to the measured throughput so a perf run
+    also records how close the config sits to the HBM ceiling. Pure
+    host-side tracing: no extra compile, no device work."""
+    try:
+        from paddle_tpu.analysis import estimate_jaxpr_memory
+        program = trainer.analysis_program(batch)
+        est = estimate_jaxpr_memory(program.jaxpr,
+                                    arg_infos=program.arg_infos)
+        log(f"static per-device peak HBM: {est.peak_bytes / 2**30:.2f} "
+            f"GiB (args {est.args_bytes / 2**30:.2f}, donated credit "
+            f"{est.donated_bytes / 2**30:.2f})")
+        return est.peak_bytes
+    except Exception as e:
+        log(f"static memory estimate failed: "
+            f"{type(e).__name__}: {str(e)[:200]}")
+        return 0
+
+
 def _fwd_flops(trainer, batch):
     """Executed FLOPs of ONE forward pass (XLA cost analysis of the traced
     loss computation): the roofline denominator for configs like detection
@@ -239,13 +260,14 @@ def run_config(cfg_name, batch_size, seq_len, steps=10, remat_policy="full",
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
     batch = {"input_ids": ids[:, :-1].astype("int32"),
              "labels": ids[:, 1:].astype("int32")}
+    static_hbm = _static_hbm(trainer, batch)
     dt = _measure(trainer, batch, steps, cfg_name)   # _measure stages
     tokens_per_sec = batch_size * seq_len / dt
     n_params = cfg.num_params()
     flops_per_token = 6 * n_params  # fwd+bwd heuristic
     mfu = flops_per_token * tokens_per_sec / chip_peak_flops()
     log(f"{cfg_name}: {dt*1e3:.1f} ms/step, {tokens_per_sec:.0f} tok/s, MFU={mfu:.3f}")
-    return tokens_per_sec, mfu, n_params
+    return tokens_per_sec, mfu, n_params, static_hbm
 
 
 def run_resnet50(batch_size=128, steps=10):
@@ -781,8 +803,8 @@ def main():
             for cfg_name, bs, seq, rp in group:
                 try:
                     with _alarm(900, f"{cfg_name} bs{bs}/{rp}"):
-                        tok_s, mfu, n_params = run_config(cfg_name, bs, seq,
-                                                          remat_policy=rp)
+                        tok_s, mfu, n_params, static_hbm = run_config(
+                            cfg_name, bs, seq, remat_policy=rp)
                 except Exception as e:  # OOM or tunnel issues → try smaller
                     # keep only the STRING: holding the exception pins its
                     # traceback frames, which pin the failed Trainer's params
@@ -801,6 +823,7 @@ def main():
                     "mfu": round(mfu, 4),
                     "params": n_params,
                     "batch": bs, "seq": seq, "remat": rp,
+                    "static_peak_hbm_per_device_bytes": static_hbm,
                 }
                 break               # best-first: first success is the answer
             if result is not None:

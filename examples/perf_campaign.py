@@ -357,10 +357,11 @@ def run_gpt():
             ok += 1
             continue
         try:
-            tok_s, mfu, _ = bench.run_config(name, bs, 1024, remat_policy=rp,
-                                             grad_accum=accum)
+            tok_s, mfu, _, static_hbm = bench.run_config(
+                name, bs, 1024, remat_policy=rp, grad_accum=accum)
             record({"config": name, "bs": bs, "remat": rp, "accum": accum,
-                    "tok_s": round(tok_s, 1), "mfu": round(mfu, 4)})
+                    "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
+                    "static_peak_hbm": static_hbm})
             ok += 1
         except Exception as e:
             record({"config": name, "bs": bs, "remat": rp, "accum": accum,

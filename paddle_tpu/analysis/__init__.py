@@ -23,18 +23,30 @@ from .pass_manager import (AnalysisContext, Analyzer,  # noqa: F401
                            PassManager, default_catalog, get_analyzer,
                            register_analyzer)
 from . import analyzers  # noqa: F401  (registers the graph passes)
+from . import memory as _memory  # noqa: F401  (registers the memory pass)
+from . import sharding as _sharding  # noqa: F401  (registers sharding pass)
 from .analyzers import COLLECTIVE_OPS, MXU_OPS  # noqa: F401
 from .ast_lint import lint_function  # noqa: F401
+from .lowering import ArgInfo, sharding_shard_count  # noqa: F401
 from .manifest import (build_manifest, load_manifest,  # noqa: F401
-                       manifest_path, write_manifest)
+                       manifest_path, write_manifest,
+                       build_memory_manifest, load_memory_manifest,
+                       manifest_drift, memory_manifest_path,
+                       write_memory_manifest)
+from .memory import (MemoryEstimate,  # noqa: F401
+                     estimate_jaxpr_memory)
 
 __all__ = [
     "Finding", "Report", "Severity",
     "LoweredProgram", "lower_callable", "lower_layer",
+    "ArgInfo", "sharding_shard_count",
     "AnalysisContext", "Analyzer", "PassManager", "default_catalog",
     "get_analyzer", "register_analyzer",
     "lint_function", "analyze", "analyze_layer",
     "build_manifest", "load_manifest", "manifest_path", "write_manifest",
+    "build_memory_manifest", "load_memory_manifest", "manifest_drift",
+    "memory_manifest_path", "write_memory_manifest",
+    "MemoryEstimate", "estimate_jaxpr_memory",
     "BASELINE_CONFIGS",
 ]
 

@@ -3,11 +3,14 @@
 Runs the full analyzer catalog over BASELINE configs (default: all
 five) or any custom ``module.path:builder`` spec whose builder returns
 ``(model, example_arrays[, AnalysisContext])``. Prints findings, checks
-drift against committed lint manifests, and with --write-manifests
-regenerates them.
+drift against committed lint AND memory manifests, and with
+--write-manifests regenerates both. ``--memory`` adds the per-device
+HBM breakdown (peak, args/transient split, top live tensors);
+``--check`` regenerates every committed manifest in-memory and fails on
+any drift — the CI answer to stale manifests.
 
-Exit code: 0 clean / manifest-matching, 1 any ERROR finding (the CI
-gate), 2 usage problems.
+Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
+(the CI gate), 2 usage problems.
 """
 import argparse
 import importlib
@@ -15,39 +18,46 @@ import json
 import sys
 
 
-def _run_spec(spec, write, as_json, no_manifest):
-    from . import (AnalysisContext, PassManager, load_manifest,
-                   lower_layer, write_manifest)
+def _build_spec(spec):
+    """(program, ctx, fwd) for a BASELINE name or module:builder spec."""
+    from . import AnalysisContext, lower_layer
     from .baseline import BASELINE_CONFIGS, lowered_program
+    if spec in BASELINE_CONFIGS:
+        return lowered_program(spec)
+    if ":" not in spec:
+        raise SystemExit(
+            f"unknown config {spec!r} (known: "
+            f"{', '.join(sorted(BASELINE_CONFIGS))}) and not a "
+            "module:builder spec")
+    mod_name, attr = spec.split(":", 1)
+    builder = getattr(importlib.import_module(mod_name), attr)
+    built = builder()
+    model, examples = built[0], built[1]
+    ctx = built[2] if len(built) > 2 else AnalysisContext(name=attr)
+    program = lower_layer(model, *examples, name=ctx.name)
+    return program, ctx, type(model).forward
+
+
+def _run_spec(spec, write, as_json, no_manifest, show_memory):
+    from . import (PassManager, load_manifest, load_memory_manifest,
+                   write_manifest, write_memory_manifest)
 
     pm = PassManager()
-    if spec in BASELINE_CONFIGS:
-        program, ctx, fwd = lowered_program(spec)
-    else:
-        if ":" not in spec:
-            raise SystemExit(
-                f"unknown config {spec!r} (known: "
-                f"{', '.join(sorted(BASELINE_CONFIGS))}) and not a "
-                "module:builder spec")
-        mod_name, attr = spec.split(":", 1)
-        builder = getattr(importlib.import_module(mod_name), attr)
-        built = builder()
-        model, examples = built[0], built[1]
-        ctx = (built[2] if len(built) > 2
-               else AnalysisContext(name=attr))
-        program = lower_layer(model, *examples, name=ctx.name)
-        fwd = type(model).forward
+    program, ctx, fwd = _build_spec(spec)
     if not no_manifest and not write:
         # regeneration must be idempotent: checking the OLD manifest
         # while writing the new one would bake transition-run DRIFT
         # findings into the fresh manifest
         ctx.manifest = load_manifest(ctx.name)
+        ctx.memory_manifest = load_memory_manifest(ctx.name)
     report = pm.run_source(fwd, ctx)
     report.extend(pm.run(program, ctx))
     if write:
         data = write_manifest(ctx.name, program, report)
-        print(f"wrote {ctx.name} manifest "
-              f"({sum(data['op_counts'].values())} pinned ops)")
+        mem = write_memory_manifest(ctx.name, report)
+        print(f"wrote {ctx.name} manifests "
+              f"({sum(data['op_counts'].values())} pinned ops, "
+              f"{mem['per_device_peak_bytes']} peak bytes)")
     if as_json:
         print(json.dumps({ctx.name: report.to_dict()}, indent=1,
                          sort_keys=True))
@@ -58,7 +68,65 @@ def _run_spec(spec, write, as_json, no_manifest):
         if gs:
             print("   ops: " + ", ".join(f"{k}={v}"
                                          for k, v in sorted(gs.items())))
+        if show_memory:
+            _print_memory(report)
     return report
+
+
+def _print_memory(report):
+    mem = report.metrics.get("memory", {})
+    if not mem.get("available"):
+        print("   memory: no jaxpr available")
+        return
+    mib = 1024.0 ** 2
+    print(f"   memory: per-device peak {mem['peak_bytes'] / mib:.2f} MiB"
+          f" (args {mem['args_bytes'] / mib:.2f} + transient "
+          f"{mem['temp_peak_bytes'] / mib:.2f}; donated "
+          f"{mem['donated_bytes'] / mib:.2f})")
+    for b in mem.get("top_live", []):
+        print(f"     {b['device_bytes']:>12d} B  {b['op']:<14} "
+              f"{b['name']}")
+    sh = report.metrics.get("sharding", {})
+    if sh:
+        print(f"   sharding: {sh.get('n_replicated_big', 0)} big "
+              f"replicated tensor(s), wire "
+              f"{sh.get('total_wire_bytes', 0)} B, "
+              f"{sh.get('n_mid_program_reshards', 0)} mid-program "
+              "reshard(s)")
+
+
+def _check_manifests(names):
+    """Regenerate every manifest in-memory and diff against the
+    committed files. Returns the number of drifting/missing manifests
+    (the --check CI mode: stale manifests fail instead of silently
+    re-baselining)."""
+    from . import (PassManager, build_manifest, build_memory_manifest,
+                   load_manifest, load_memory_manifest, manifest_drift)
+
+    pm = PassManager()
+    n_bad = 0
+    for name in names:
+        program, ctx, fwd = _build_spec(name)
+        # no committed manifests on the context: the rebuild must see
+        # exactly what --write-manifests would write
+        report = pm.run_source(fwd, ctx)
+        report.extend(pm.run(program, ctx))
+        drift = manifest_drift(build_manifest(name, program, report),
+                               load_manifest(name), path="lint")
+        drift += manifest_drift(build_memory_manifest(name, report),
+                                load_memory_manifest(name), path="memory")
+        if drift:
+            n_bad += 1
+            print(f"== {name}: STALE ==")
+            for line in drift:
+                print(f"   {line}")
+        else:
+            print(f"== {name}: manifests current ==")
+    if n_bad:
+        print(f"{n_bad} config(s) drifted — regenerate with "
+              "python -m paddle_tpu.analysis --write-manifests "
+              "and review the diff")
+    return n_bad
 
 
 def main(argv=None):
@@ -72,7 +140,15 @@ def main(argv=None):
     parser.add_argument("--list", action="store_true",
                         help="list BASELINE configs and analyzers")
     parser.add_argument("--write-manifests", action="store_true",
-                        help="regenerate lint_manifests/<config>.json")
+                        help="regenerate lint_manifests/<config>.json "
+                             "and memory_manifests/<config>.json")
+    parser.add_argument("--check", action="store_true",
+                        help="regenerate all manifests in-memory and "
+                             "exit non-zero on drift (CI staleness "
+                             "gate); writes nothing")
+    parser.add_argument("--memory", action="store_true",
+                        help="print the per-device HBM breakdown "
+                             "(peak, args/transient, top live tensors)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable findings")
     parser.add_argument("--no-manifest-check", action="store_true",
@@ -94,10 +170,12 @@ def main(argv=None):
         return 0
 
     names = args.configs or list(BASELINE_CONFIGS)
+    if args.check:
+        return 1 if _check_manifests(names) else 0
     worst = None
     for name in names:
         report = _run_spec(name, args.write_manifests, args.json,
-                           args.no_manifest_check)
+                           args.no_manifest_check, args.memory)
         sev = report.max_severity
         if sev is not None and (worst is None or sev > worst):
             worst = sev

@@ -14,8 +14,9 @@ import re
 from collections import Counter
 from dataclasses import dataclass, field
 
-__all__ = ["HloOp", "LoweredProgram", "lower_layer", "lower_callable",
-           "tensor_type_bytes"]
+__all__ = ["ArgInfo", "HloOp", "LoweredProgram", "lower_layer",
+           "lower_callable", "tensor_type_bytes", "sharding_shard_count",
+           "tree_arg_infos"]
 
 _OP_RE = re.compile(r'"?stablehlo\.([a-zA-Z0-9_]+)"?')
 _TENSOR_RE = re.compile(r"tensor<([^>]*)>")
@@ -43,6 +44,49 @@ def tensor_type_bytes(type_str):
             return 0
         n *= int(d)
     return n * _DTYPE_BYTES.get(elem, 0)
+
+
+@dataclass
+class ArgInfo:
+    """Per-argument metadata of a lowered program's flattened calling
+    convention (one entry per %arg of the main function, jaxpr invar
+    order). Carries the sharding/donation facts the memory & sharding
+    passes need but the HLO text alone can't recover: what the arg IS
+    (param vs optimizer slot vs batch), how many shards its sharding
+    splits it into, and whether the buffer is donated."""
+    name: str                    # pytree path, e.g. "params/fc.weight"
+    role: str                    # param|opt_state|gt_state|const|lr|batch|input
+    shape: tuple = ()
+    dtype: str = ""
+    bytes: int = 0               # global (unsharded) size
+    spec: tuple = None           # PartitionSpec entries, None when unknown
+    shard_count: int = 1         # devices one shard of this arg lands on
+    donated: bool = False
+
+    @property
+    def device_bytes(self):
+        """Per-device footprint: global bytes split over the shard count
+        (replicated args cost their full size on EVERY device)."""
+        return self.bytes // max(self.shard_count, 1)
+
+
+def sharding_shard_count(sharding):
+    """How many ways a NamedSharding/PositionalSharding splits a value
+    (1 = fully replicated). Robust to plain specs and None."""
+    if sharding is None:
+        return 1
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None:
+        return max(int(getattr(sharding, "num_devices", 1) or 1), 1)
+    count = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for a in axes:
+            count *= int(mesh.shape.get(a, 1))
+    return max(count, 1)
 
 
 @dataclass
@@ -151,13 +195,47 @@ def parse_hlo_ops(text):
     return ops
 
 
+def tree_arg_infos(tree, role, prefix="", donated=False, shardings=None):
+    """Flatten one pytree argument into ArgInfo entries (jaxpr invar
+    order). `shardings` is an optional parallel pytree of shardings; a
+    leaf's shard count comes from it (or from the value's own committed
+    .sharding when absent)."""
+    import jax
+    import numpy as np
+    leaves_p = jax.tree_util.tree_flatten_with_path(tree)[0]
+    sh_leaves = (jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: x is None)
+        if shardings is not None else [None] * len(leaves_p))
+    infos = []
+    for (path, leaf), sh in zip(leaves_p, sh_leaves):
+        name = jax.tree_util.keystr(path).strip("[]'\"").replace(
+            "']['", "/").replace("][", "/") or role
+        if prefix:
+            name = f"{prefix}/{name}" if name != role else prefix
+        if sh is None:
+            sh = getattr(leaf, "sharding", None)
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = getattr(dtype, "itemsize", np.dtype(type(leaf)).itemsize
+                           if np.isscalar(leaf) else 0)
+        spec = getattr(sh, "spec", None)
+        infos.append(ArgInfo(
+            name=name, role=role, shape=shape,
+            dtype=str(dtype) if dtype is not None else "",
+            bytes=int(np.prod(shape, dtype=np.int64)) * int(itemsize or 0),
+            spec=tuple(spec) if spec is not None else None,
+            shard_count=sharding_shard_count(sh), donated=donated))
+    return infos
+
+
 class LoweredProgram:
     """StableHLO text + jaxpr of one lowered callable, with a parsed op
     view. `jaxpr` is produced from the same single trace as the HLO (no
-    double tracing)."""
+    double tracing). `arg_infos`, when given, aligns one ArgInfo with
+    each flattened jaxpr invar (sharding + donation capture)."""
 
     def __init__(self, text, jaxpr=None, name="program", platform="cpu",
-                 input_arg_ids=None):
+                 input_arg_ids=None, arg_infos=None):
         self.text = text
         self.jaxpr = jaxpr
         self.name = name
@@ -166,6 +244,7 @@ class LoweredProgram:
         # parameters/buffers); None when unknown (raw-text programs)
         self.input_arg_ids = (None if input_arg_ids is None
                               else frozenset(input_arg_ids))
+        self.arg_infos = arg_infos
         self.ops = parse_hlo_ops(text)
 
     def is_weight_transpose(self, op):
@@ -207,12 +286,18 @@ def _untensor(tree):
         is_leaf=lambda t: isinstance(t, Tensor))
 
 
-def lower_callable(fn, *example_args, name="program", input_arg_ids=None):
+def lower_callable(fn, *example_args, name="program", input_arg_ids=None,
+                   arg_infos=None):
     """Trace `fn` once; return StableHLO + jaxpr as a LoweredProgram."""
     import jax
     traced = jax.jit(fn).trace(*example_args)
+    if arg_infos is None:
+        arg_infos = []
+        for i, a in enumerate(example_args):
+            arg_infos.extend(tree_arg_infos(a, "input", prefix=f"arg{i}"))
     return LoweredProgram(traced.lower().as_text(), jaxpr=traced.jaxpr,
-                          name=name, input_arg_ids=input_arg_ids)
+                          name=name, input_arg_ids=input_arg_ids,
+                          arg_infos=arg_infos)
 
 
 def lower_layer(model, *example_arrays, name=None):
@@ -237,7 +322,11 @@ def lower_layer(model, *example_arrays, name=None):
     import jax
     n_params = len(jax.tree_util.tree_leaves(params))
     n_inputs = len(jax.tree_util.tree_leaves(list(example_arrays)))
+    infos = tree_arg_infos(params, "param")
+    for i, a in enumerate(example_arrays):
+        infos.extend(tree_arg_infos(a, "input", prefix=f"input{i}"))
     return lower_callable(
         pure, params, *example_arrays,
         name=name or type(model).__name__,
-        input_arg_ids=range(n_params, n_params + n_inputs))
+        input_arg_ids=range(n_params, n_params + n_inputs),
+        arg_infos=infos)

@@ -1,19 +1,28 @@
-"""Per-model lint manifests — the committed, diffable face of the
-Graph Doctor (same role as perf_evidence.json for the analytic perf
+"""Per-model lint & memory manifests — the committed, diffable face of
+the Graph Doctor (same role as perf_evidence.json for the analytic perf
 model: regenerate, diff, review).
 
 `lint_manifests/<config>.json` pins each BASELINE config's op counts,
 collective accounting, and finding summary. The graph-shape analyzer
 treats the committed manifest as the baseline: any drift is an ERROR
 until the manifest is regenerated and the diff reviewed.
-"""
+
+`memory_manifests/<config>.json` pins the static per-device HBM
+estimate (liveness peak, breakdown, top-k attribution) and the analytic
+collective wire budget. The memory/sharding passes gate fresh runs
+against it; `manifest_drift` powers the CLI's `--check` mode (stale
+manifests fail CI instead of silently re-baselining the lint)."""
 import json
 import os
 
 __all__ = ["manifest_dir", "manifest_path", "load_manifest",
-           "build_manifest", "write_manifest"]
+           "build_manifest", "write_manifest",
+           "memory_manifest_dir", "memory_manifest_path",
+           "load_memory_manifest", "build_memory_manifest",
+           "write_memory_manifest", "manifest_drift"]
 
 _SCHEMA = 1
+_MEMORY_SCHEMA = 1
 
 
 def manifest_dir():
@@ -69,3 +78,97 @@ def write_manifest(name, program, report):
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
     return data
+
+
+# ---------------------------------------------------------------- memory
+
+
+def memory_manifest_dir():
+    """Repo-root memory_manifests/ (next to lint_manifests/)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "memory_manifests")
+
+
+def memory_manifest_path(name):
+    return os.path.join(memory_manifest_dir(), f"{name}.json")
+
+
+def load_memory_manifest(name):
+    """The committed memory manifest dict, or None when not committed."""
+    try:
+        with open(memory_manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_memory_manifest(name, report):
+    """Memory manifest dict from one pass-manager run (deterministic:
+    sorted keys, no timestamps, native dtype widths — platform
+    independent, so a TPU and a CPU checkout agree byte-for-byte)."""
+    mem = report.metrics.get("memory", {})
+    sh = report.metrics.get("sharding", {})
+    return {
+        "schema": _MEMORY_SCHEMA,
+        "model": name,
+        "per_device_peak_bytes": mem.get("peak_bytes", 0),
+        "args_bytes": mem.get("args_bytes", 0),
+        "output_bytes": mem.get("out_bytes", 0),
+        "temp_peak_bytes": mem.get("temp_peak_bytes", 0),
+        "donated_bytes": mem.get("donated_bytes", 0),
+        "top_live": [
+            {"op": b.get("op"), "name": b.get("name"),
+             "device_bytes": b.get("device_bytes")}
+            for b in mem.get("top_live", [])],
+        "replication": {
+            "n_replicated_big": sh.get("n_replicated_big", 0),
+            "replicated_big_bytes": sh.get("replicated_big_bytes", 0),
+        },
+        "collectives": {
+            "total_wire_bytes": sh.get("total_wire_bytes", 0),
+            "n_mid_program_reshards": sh.get("n_mid_program_reshards", 0),
+        },
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_memory_manifest(name, report):
+    os.makedirs(memory_manifest_dir(), exist_ok=True)
+    data = build_memory_manifest(name, report)
+    with open(memory_manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def manifest_drift(fresh, committed, path=""):
+    """Recursive diff of a regenerated manifest dict vs the committed
+    one. Returns ["path: committed -> fresh", ...] — empty means the
+    committed file is current. The CLI's --check mode fails CI on any
+    entry, so stale manifests can't silently re-baseline the lint."""
+    if committed is None and isinstance(fresh, dict):
+        # a manifest is always a dict, so a None here is the missing
+        # FILE — a None VALUE (e.g. max_severity on a clean model)
+        # falls through to the scalar compare below
+        return [f"{path or '<manifest>'}: missing committed file"]
+    if isinstance(fresh, dict) and isinstance(committed, dict):
+        out = []
+        for k in sorted(set(fresh) | set(committed)):
+            sub = f"{path}.{k}" if path else str(k)
+            if k not in fresh:
+                out.append(f"{sub}: {committed[k]!r} -> <gone>")
+            elif k not in committed:
+                out.append(f"{sub}: <absent> -> {fresh[k]!r}")
+            else:
+                out.extend(manifest_drift(fresh[k], committed[k], sub))
+        return out
+    if isinstance(fresh, list) and isinstance(committed, list):
+        if fresh != committed:
+            return [f"{path}: list changed ({len(committed)} -> "
+                    f"{len(fresh)} entries)"]
+        return []
+    if fresh != committed:
+        return [f"{path}: {committed!r} -> {fresh!r}"]
+    return []

@@ -1,0 +1,358 @@
+"""Static per-device HBM estimation — a jaxpr-order liveness analysis.
+
+The estimator walks the closed jaxpr in equation order and tracks the
+set of live buffers: non-donated arguments and constants live for the
+whole execution (XLA cannot reuse caller-owned buffers), donated
+arguments free at their last use (the buffer is recycled into outputs —
+exactly the Trainer's params/opt-state donation), intermediates live
+from definition to last use, program outputs to the end.  Each buffer's
+per-device cost is its global size divided by its sharding's shard
+count (replicated tensors cost full size on EVERY device).  Equations
+carrying sub-jaxprs (pjit, scan, while, cond, remat, custom_vjp)
+contribute their own recursive transient peak on top of the outer live
+set, so inner temporaries aren't silently dropped.
+
+The peak is attributed to the top-k live buffers at the peak program
+point with their defining ops — the "what do I shard/remat/donate to
+fit" answer, produced on CPU before a chip sees the program
+(liveness-as-a-pass after TPU-MLIR, arxiv 2210.15016; the memory half
+of MPK-style per-program planning, arxiv 2512.22219).
+
+Cross-check: on jaxlibs whose `compiled.memory_analysis()` works on
+CPU, `cpu_calibrated=True` reproduces the XLA CPU buffer model (no
+native bf16 MXU there: sub-f32 floats widen to f32 temporaries, and
+dot operands get materialized f32 conversion copies) so the estimate
+lands within the lint gate's tolerance of XLA's own number.  Manifests
+and TPU advice always use the native-width (uncalibrated) estimate.
+"""
+from dataclasses import dataclass, field
+
+from .findings import Finding, Severity
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["MemoryAnalyzer", "MemoryEstimate", "estimate_jaxpr_memory"]
+
+# primitives whose sub-f32 operands XLA CPU materializes as f32 copies
+# (no native bf16 matmul path on the host; convolutions lower through a
+# different path that fuses the widening and shows no copy)
+_CPU_WIDENED_MXU = ("dot_general",)
+
+# ops small enough that attributing the peak to them is noise
+_ATTRIBUTION_MIN_BYTES = 1024
+
+
+def _aval_bytes(aval, widen_sub_f32=False):
+    """Byte size of one abstract value; 0 when shape/dtype is unknown.
+    `widen_sub_f32` models XLA CPU's f32 compute width for bf16/f16."""
+    import numpy as np
+    try:
+        import jax.numpy as jnp
+        itemsize = aval.dtype.itemsize
+        if widen_sub_f32 and itemsize < 4 and \
+                jnp.issubdtype(aval.dtype, jnp.floating):
+            itemsize = 4
+        return int(np.prod(aval.shape, dtype=np.int64)) * itemsize
+    except Exception:
+        return 0
+
+
+def _sub_jaxprs(eqn):
+    """All Jaxprs hiding in an eqn's params (pjit/scan/while/cond/...)."""
+    found = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            tn = type(x).__name__
+            if tn == "ClosedJaxpr":
+                found.append(x.jaxpr)
+            elif tn == "Jaxpr":
+                found.append(x)
+    return found
+
+
+def _is_var(v):
+    return type(v).__name__ != "Literal"
+
+
+@dataclass
+class LiveBuffer:
+    """One buffer in the live set at the peak point."""
+    op: str                      # defining primitive ("argument" for invars)
+    name: str                    # arg name / "eqn12:dot_general output"
+    bytes: int                   # global size
+    device_bytes: int            # bytes / shard_count
+    shard_count: int = 1
+    role: str = None             # arg role when the buffer is an argument
+
+    def to_dict(self):
+        d = {"op": self.op, "name": self.name, "bytes": self.bytes,
+             "device_bytes": self.device_bytes,
+             "shard_count": self.shard_count}
+        if self.role:
+            d["role"] = self.role
+        return d
+
+
+@dataclass
+class MemoryEstimate:
+    """Static per-device HBM footprint of one lowered program."""
+    peak_bytes: int = 0          # per-device peak live bytes
+    args_bytes: int = 0          # per-device resident arguments
+    out_bytes: int = 0           # per-device program outputs
+    temp_peak_bytes: int = 0     # peak minus always-resident args
+    donated_bytes: int = 0       # per-device donated-arg bytes (credit)
+    peak_eqn: int = -1           # eqn index where the peak occurs
+    peak_op: str = ""            # primitive at the peak point
+    top: list = field(default_factory=list)   # top-k LiveBuffers at peak
+    cpu_calibrated: bool = False
+
+    def to_dict(self):
+        return {"peak_bytes": self.peak_bytes,
+                "args_bytes": self.args_bytes,
+                "out_bytes": self.out_bytes,
+                "temp_peak_bytes": self.temp_peak_bytes,
+                "donated_bytes": self.donated_bytes,
+                "peak_eqn": self.peak_eqn, "peak_op": self.peak_op,
+                "top_live": [b.to_dict() for b in self.top]}
+
+    def __str__(self):
+        gib = 1024.0 ** 3
+        resident = self.args_bytes - self.donated_bytes
+        lines = [f"per-device peak: {self.peak_bytes / gib:.4f} GiB = "
+                 f"resident args {resident / gib:.4f} + working set "
+                 f"{self.temp_peak_bytes / gib:.4f} (donation frees "
+                 f"{self.donated_bytes / gib:.4f})"]
+        for b in self.top:
+            lines.append(f"  {b.device_bytes:>12d} B  {b.op:<16} {b.name}")
+        return "\n".join(lines)
+
+
+def _eqn_source(eqn, idx):
+    """Short human label for an eqn's output buffer."""
+    prim = eqn.primitive.name
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            import os
+            return (f"{prim} @ {os.path.basename(frame.file_name)}:"
+                    f"{frame.start_line}")
+    except Exception:
+        pass
+    return f"{prim} #eqn{idx}"
+
+
+def _inner_transient(jx, widen, memo):
+    """Transient extra bytes an eqn's sub-jaxpr adds on top of the outer
+    live set (its own peak minus its invars, which are already counted
+    as live operands outside)."""
+    key = id(jx)
+    if key not in memo:
+        peak, _, _ = _walk(jx, arg_counts=None, donated=(), widen=widen,
+                           pin_invars=False, memo=memo)
+        inb = sum(_aval_bytes(v.aval) for v in jx.invars)
+        memo[key] = max(0, peak - inb)
+    return memo[key]
+
+
+def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
+          arg_infos=None):
+    """Liveness walk of one jaxpr. Returns (peak, peak_eqn_idx,
+    top_buffers_at_peak)."""
+    last_use = {}
+    for i, eqn in enumerate(jx.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last_use[v] = i
+    n = len(jx.eqns)
+    for v in jx.outvars:
+        if _is_var(v):
+            last_use[v] = n
+    invars = list(jx.invars)
+    if pin_invars:
+        # non-donated arguments + baked constants are caller-owned: XLA
+        # keeps them resident for the whole execution
+        for k, v in enumerate(invars):
+            if not (donated and k < len(donated) and donated[k]):
+                last_use[v] = n
+        for v in jx.constvars:
+            last_use[v] = n
+
+    counts = {}          # var -> shard count (propagated)
+    live = {}            # var -> (device_bytes, LiveBuffer)
+    for k, v in enumerate(invars):
+        if v not in last_use:
+            continue
+        cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
+        counts[v] = cnt
+        info = (arg_infos[k] if arg_infos and k < len(arg_infos) else None)
+        gb = _aval_bytes(v.aval)
+        live[v] = (gb // max(cnt, 1), LiveBuffer(
+            op="argument",
+            name=info.name if info else f"arg{k}",
+            bytes=gb, device_bytes=gb // max(cnt, 1), shard_count=cnt,
+            role=info.role if info else None))
+    for v in jx.constvars:
+        if v in last_use:
+            gb = _aval_bytes(v.aval)
+            live[v] = (gb, LiveBuffer(op="constant", name="const",
+                                      bytes=gb, device_bytes=gb))
+
+    cur = sum(b for b, _ in live.values())
+    peak, peak_idx, peak_top = cur, -1, list(live.values())
+    for i, eqn in enumerate(jx.eqns):
+        inner = 0
+        for sj in _sub_jaxprs(eqn):
+            inner = max(inner, _inner_transient(sj, widen, memo))
+        if widen and eqn.primitive.name in _CPU_WIDENED_MXU:
+            # XLA CPU materializes f32 conversion copies of sub-f32
+            # dot operands (bf16 has no host MXU path)
+            for v in eqn.invars:
+                if _is_var(v):
+                    w = _aval_bytes(v.aval, widen_sub_f32=True)
+                    if w > _aval_bytes(v.aval):
+                        inner += w
+        out_count = 1
+        in_counts = [counts.get(v, 1) for v in eqn.invars if _is_var(v)]
+        if in_counts:
+            # sharding propagation heuristic: an op's result is at best
+            # as sharded as its most-sharded operand (GSPMD propagates
+            # along data paths; a reduction to scalar only shrinks the
+            # buffer, so the error is bounded by the tiny result)
+            out_count = max(in_counts)
+        for v in eqn.outvars:
+            if v in last_use:
+                counts[v] = out_count
+                gb = _aval_bytes(v.aval, widen_sub_f32=widen)
+                db = gb // max(out_count, 1)
+                live[v] = (db, LiveBuffer(
+                    op=eqn.primitive.name, name=_eqn_source(eqn, i),
+                    bytes=gb, device_bytes=db, shard_count=out_count))
+                cur += db
+        if cur + inner > peak:
+            peak, peak_idx = cur + inner, i
+            peak_top = list(live.values())
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if _is_var(v) and last_use.get(v) == i and v in live:
+                cur -= live.pop(v)[0]
+    top = []
+    if top_k:
+        top = sorted((b for _, b in peak_top
+                      if b.device_bytes >= _ATTRIBUTION_MIN_BYTES),
+                     key=lambda b: -b.device_bytes)[:top_k]
+    return peak, peak_idx, top
+
+
+def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
+                          cpu_calibrated=False):
+    """Static per-device HBM estimate of one closed jaxpr.
+
+    `arg_infos`: optional list of `lowering.ArgInfo` aligned with the
+    flattened invars — supplies shard counts (per-device division),
+    donation flags (donated args free at last use), and names for the
+    peak attribution. Without it every arg is assumed replicated and
+    non-donated (the single-device forward-program case).
+    """
+    jx = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
+    infos = arg_infos or []
+    arg_counts = [i.shard_count for i in infos] or None
+    donated = [i.donated for i in infos]
+    memo = {}
+    peak, peak_idx, top = _walk(
+        jx, arg_counts=arg_counts, donated=donated, widen=cpu_calibrated,
+        pin_invars=True, memo=memo, top_k=top_k, arg_infos=infos)
+
+    def _arg_db(k, v):
+        cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
+        return _aval_bytes(v.aval) // max(cnt, 1)
+
+    args_bytes = sum(_arg_db(k, v) for k, v in enumerate(jx.invars))
+    out_bytes = 0
+    for v in jx.outvars:
+        if _is_var(v):
+            cnt = 1  # conservative: treat outputs as replicated w/o info
+            out_bytes += _aval_bytes(v.aval, widen_sub_f32=cpu_calibrated) \
+                // cnt
+    donated_bytes = sum(_arg_db(k, v) for k, v in enumerate(jx.invars)
+                        if k < len(donated) and donated[k])
+    est = MemoryEstimate(
+        peak_bytes=peak, args_bytes=args_bytes, out_bytes=out_bytes,
+        temp_peak_bytes=max(0, peak - (args_bytes - donated_bytes)),
+        donated_bytes=donated_bytes, peak_eqn=peak_idx,
+        peak_op=(jx.eqns[peak_idx].primitive.name
+                 if 0 <= peak_idx < len(jx.eqns) else ""),
+        top=top, cpu_calibrated=cpu_calibrated)
+    return est
+
+
+@register_analyzer
+class MemoryAnalyzer(Analyzer):
+    """Per-device peak-HBM pass: liveness estimate + regression gate.
+
+    Findings:
+      MEM-PEAK-REGRESSION  ERROR    fresh peak exceeds the committed
+                                    memory manifest beyond tolerance
+      MEM-PEAK-IMPROVED    INFO     peak dropped below tolerance — the
+                                    manifest is stale, regenerate it
+      MEM-OVER-BUDGET      ERROR    peak exceeds ctx.hbm_budget_bytes
+      MEM-NO-DONATION      WARNING  params+opt state bigger than the
+                                    donation credit — train-step args
+                                    are not donated, doubling resident
+                                    state
+    Metrics feed memory_manifests/<config>.json (peak, breakdown, top-k
+    attribution)."""
+    name = "memory"
+
+    def run(self, program, ctx):
+        if getattr(program, "jaxpr", None) is None:
+            self.metrics = {"available": False}
+            return []
+        est = estimate_jaxpr_memory(
+            program.jaxpr, arg_infos=getattr(program, "arg_infos", None),
+            top_k=ctx.extra.get("memory_top_k", 8))
+        self.metrics = {"available": True, **est.to_dict()}
+        findings = []
+        committed = (ctx.memory_manifest or {})
+        want = committed.get("per_device_peak_bytes")
+        tol = ctx.memory_tolerance
+        if want:
+            if est.peak_bytes > want * (1 + tol):
+                findings.append(Finding(
+                    "MEM-PEAK-REGRESSION", Severity.ERROR,
+                    f"per-device peak HBM {est.peak_bytes} exceeds the "
+                    f"committed manifest's {want} by more than "
+                    f"{tol:.0%} — the step no longer fits the same "
+                    "chip headroom",
+                    suggested_fix="shard or remat the top live tensors "
+                    "(debug.memory_report), or regenerate manifests if "
+                    "the growth is intentional: python -m "
+                    "paddle_tpu.analysis --write-manifests"))
+            elif est.peak_bytes < want * (1 - tol):
+                findings.append(Finding(
+                    "MEM-PEAK-IMPROVED", Severity.INFO,
+                    f"per-device peak HBM {est.peak_bytes} is more than "
+                    f"{tol:.0%} below the committed {want} — regenerate "
+                    "the manifest to bank the improvement"))
+        budget = ctx.hbm_budget_bytes
+        if budget and est.peak_bytes > budget:
+            findings.append(Finding(
+                "MEM-OVER-BUDGET", Severity.ERROR,
+                f"per-device peak HBM {est.peak_bytes} exceeds the "
+                f"budget {budget}",
+                suggested_fix="raise fsdp sharding, enable remat, or "
+                "shrink the per-device batch"))
+        infos = getattr(program, "arg_infos", None) or []
+        state_bytes = sum(i.device_bytes for i in infos
+                          if i.role in ("param", "opt_state"))
+        if state_bytes and not any(i.donated for i in infos
+                                   if i.role in ("param", "opt_state")):
+            if ctx.extra.get("expect_donation", True) and \
+                    any(i.role == "opt_state" for i in infos):
+                findings.append(Finding(
+                    "MEM-NO-DONATION", Severity.WARNING,
+                    f"{state_bytes} bytes of params/opt-state are not "
+                    "donated — the step holds two copies of the model "
+                    "state in HBM",
+                    suggested_fix="donate params/opt state into the "
+                    "compiled step (Trainer(donate=True))"))
+        return findings

@@ -38,6 +38,8 @@ def get_analyzer(name):
 def default_catalog():
     """Registered analyzer names, registration-ordered."""
     from . import analyzers as _a   # noqa: F401  (registers graph passes)
+    from . import memory as _m      # noqa: F401  (registers memory pass)
+    from . import sharding as _s    # noqa: F401  (registers sharding pass)
     from . import ast_lint as _l    # noqa: F401  (registers source pass)
     return list(_REGISTRY)
 
@@ -67,6 +69,18 @@ class AnalysisContext:
     expect_collectives: bool = None
     # extra custom_call targets that are known device-side (Pallas etc.)
     host_callback_allow: tuple = ()
+    # committed memory manifest (manifest.load_memory_manifest) for the
+    # peak-HBM / wire-byte regression gates
+    memory_manifest: dict = None
+    # relative drift allowed against the memory manifest before the
+    # memory/sharding passes turn it into an ERROR
+    memory_tolerance: float = 0.10
+    # per-device HBM budget; peak above it is MEM-OVER-BUDGET
+    hbm_budget_bytes: int = None
+    # replicated tensors at/above this size trip the sharding rules
+    replicated_bytes_threshold: int = 1 << 20
+    # regexes for by-design mid-program reshards (MoE all_to_all dispatch)
+    allowed_resharding: tuple = ()
     # free-form knobs for user analyzers
     extra: dict = field(default_factory=dict)
 

@@ -1,0 +1,169 @@
+"""GSPMD sharding lint — flags the silent ways a distributed program
+wastes HBM or wire bandwidth, from the captured argument shardings
+(`LoweredProgram.arg_infos`) plus the lowered collectives.
+
+Rules (docs/static_analysis.md):
+  SHARD-REPLICATED-BIG      a tensor above the size threshold is fully
+                            replicated while the mesh has model-sharding
+                            axes — every device pays full price
+  SHARD-OPT-STATE-UNSHARDED optimizer state replicated under a ZeRO/
+                            fsdp config whose params ARE sharded (the
+                            classic silent 2-3x HBM leak: slots must
+                            inherit the param sharding)
+  SHARD-MID-PROGRAM-RESHARD collective_permute / all_to_all in the
+                            step — a spec mismatch made GSPMD move the
+                            tensor mid-program (exempt by-design ones,
+                            e.g. MoE dispatch, via
+                            ctx.allowed_resharding regexes)
+  SHARD-WIRE-REGRESSION     total analytic wire bytes (cost_model ring
+                            formulas) drifted above the committed memory
+                            manifest beyond tolerance — a collective got
+                            bigger or a new one appeared
+  SHARD-UNKNOWN-PAYLOAD     a collective whose payload can't be sized
+                            from the HLO types (symbolic dims) — the
+                            wire accounting under-reports
+
+Metrics: replicated big-tensor count/bytes, per-role shard coverage,
+and the cost-model wire-byte total the memory manifest pins.
+"""
+import re
+
+from .findings import Finding, Severity
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["ShardingAnalyzer", "RESHARD_OPS", "SHARDING_AXES"]
+
+# collectives GSPMD inserts when producer/consumer specs disagree
+RESHARD_OPS = ("collective_permute", "all_to_all")
+
+# mesh axes that shard MODEL state (dp replicates params by design, so
+# it never triggers the replication rules on its own)
+SHARDING_AXES = ("fsdp", "tp", "sp", "ep")
+
+
+@register_analyzer
+class ShardingAnalyzer(Analyzer):
+    name = "sharding"
+
+    def run(self, program, ctx):
+        from ..cost_model import collective_wire_bytes
+        from .lowering import tensor_type_bytes
+
+        findings = []
+        infos = getattr(program, "arg_infos", None) or []
+        mesh_axes = ctx.mesh_axes or {}
+        sharding_size = 1
+        for a in SHARDING_AXES:
+            sharding_size *= int(mesh_axes.get(a, 1))
+        n_devices = 1
+        for s in mesh_axes.values():
+            n_devices *= int(s)
+
+        threshold = ctx.replicated_bytes_threshold
+        replicated = [i for i in infos
+                      if i.shard_count <= 1 and i.bytes >= threshold]
+        sharded_param_shapes = {tuple(i.shape) for i in infos
+                                if i.role == "param" and i.shard_count > 1}
+        if sharding_size > 1:
+            for info in replicated:
+                if info.role == "opt_state":
+                    continue   # covered by the dedicated rule below
+                sev = (Severity.ERROR if info.role == "param"
+                       and mesh_axes.get("fsdp", 1) > 1
+                       else Severity.WARNING)
+                findings.append(Finding(
+                    "SHARD-REPLICATED-BIG", sev,
+                    f"{info.role} `{info.name}` ({info.bytes} bytes, "
+                    f"shape {list(info.shape)}) is replicated on all "
+                    f"{n_devices} devices under a model-sharding mesh "
+                    f"{dict(mesh_axes)}",
+                    suggested_fix="give it a partition_spec (or let the "
+                    "fsdp planner shard it: check min_fsdp_numel and "
+                    "dim divisibility)"))
+        # ZeRO promise: optimizer slots inherit the param sharding. A
+        # replicated slot whose same-shape param IS sharded broke it.
+        for info in infos:
+            if info.role != "opt_state" or info.shard_count > 1 or \
+                    info.bytes < threshold:
+                continue
+            if tuple(info.shape) in sharded_param_shapes or \
+                    mesh_axes.get("fsdp", 1) > 1:
+                findings.append(Finding(
+                    "SHARD-OPT-STATE-UNSHARDED", Severity.ERROR,
+                    f"optimizer state `{info.name}` ({info.bytes} bytes) "
+                    "is replicated while the mesh shards parameters — "
+                    "ZeRO semantics lost, every device holds the full "
+                    "slot",
+                    suggested_fix="init slots with zeros_like under jit "
+                    "so they inherit the param sharding, or device_put "
+                    "them with the param's NamedSharding"))
+
+        allowed = [re.compile(p) for p in ctx.allowed_resharding]
+        n_reshards = 0
+        for op in program.ops_named(*RESHARD_OPS):
+            if any(p.search(op.line) for p in allowed):
+                continue
+            n_reshards += 1
+            findings.append(Finding(
+                "SHARD-MID-PROGRAM-RESHARD", Severity.WARNING,
+                f"{op.name} moves data mid-program — producer and "
+                "consumer shardings disagree, so GSPMD inserted a "
+                "reshard on the step's critical path", op=op.line,
+                suggested_fix="align the sharding_constraint specs on "
+                "both sides (distributed.sharding_utils.constraint), or "
+                "exempt a by-design dispatch via "
+                "context.allowed_resharding"))
+
+        # analytic wire volume (ring formulas) — the collective budget
+        # the memory manifest pins
+        total_wire = 0
+        n_unknown = 0
+        from .analyzers import COLLECTIVE_OPS
+        for op in program.ops_named(*COLLECTIVE_OPS):
+            group, _ = op.replica_group_size()
+            payload = max(op.operand_bytes(),
+                          sum(tensor_type_bytes(t)
+                              for t in op.result_types))
+            if payload == 0 and (group or 1) > 1:
+                n_unknown += 1
+                findings.append(Finding(
+                    "SHARD-UNKNOWN-PAYLOAD", Severity.INFO,
+                    f"{op.name} payload could not be sized from the "
+                    "HLO types — wire accounting under-reports",
+                    op=op.line))
+            total_wire += collective_wire_bytes(op.name, payload,
+                                                group or 1)
+        committed = (ctx.memory_manifest or {}).get("collectives", {})
+        want_wire = committed.get("total_wire_bytes")
+        tol = ctx.memory_tolerance
+        if want_wire is not None and \
+                total_wire > max(want_wire * (1 + tol), want_wire + 1024):
+            findings.append(Finding(
+                "SHARD-WIRE-REGRESSION", Severity.ERROR,
+                f"analytic collective wire bytes {total_wire} exceed "
+                f"the committed manifest's {want_wire} by more than "
+                f"{tol:.0%} — a collective grew or a new one appeared",
+                suggested_fix="diff the collectives against the "
+                "manifest (python -m paddle_tpu.analysis --memory) and "
+                "regenerate if intentional"))
+
+        self.metrics = {
+            "n_args": len(infos),
+            "n_replicated_big": len(replicated),
+            "replicated_big_bytes": sum(i.bytes for i in replicated),
+            "n_mid_program_reshards": n_reshards,
+            "total_wire_bytes": total_wire,
+            "sharded_by_role": self._role_coverage(infos),
+        }
+        return findings
+
+    @staticmethod
+    def _role_coverage(infos):
+        """{role: [sharded_leaves, total_leaves]} — quick coverage view."""
+        cov = {}
+        for i in infos:
+            role = i.role or "input"
+            n_sharded, n_total = cov.get(role, (0, 0))
+            cov[role] = (n_sharded + (1 if i.shard_count > 1 else 0),
+                         n_total + 1)
+        return {k: list(v) for k, v in sorted(cov.items())}

@@ -11,6 +11,21 @@ import numpy as np
 __all__ = ["CostModel", "collective_wire_bytes"]
 
 
+# jaxpr primitive names -> the StableHLO collective they lower to, so
+# callers can query with either vocabulary (the memory/sharding passes
+# walk jaxprs, the HLO analyzers walk StableHLO text)
+_COLLECTIVE_ALIASES = {
+    "psum": "all_reduce",
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "ppermute": "collective_permute",
+    "pshuffle": "collective_permute",
+    "psum_scatter": "reduce_scatter",
+    "pbroadcast": "collective_broadcast",
+    "all_gather_invariant": "all_gather",
+}
+
+
 def collective_wire_bytes(op, payload_bytes, group_size):
     """Analytic bytes-on-the-wire per participating device for one
     collective, assuming the bandwidth-optimal ring algorithms XLA uses
@@ -19,13 +34,22 @@ def collective_wire_bytes(op, payload_bytes, group_size):
 
     all_reduce      ring reduce-scatter + all-gather: 2(n-1)/n * payload
     all_gather      (n-1)/n * full gathered payload
-    reduce_scatter  (n-1)/n * payload
+    reduce_scatter  (n-1)/n * full pre-scatter payload
     all_to_all      (n-1)/n * payload (each device keeps 1/n)
     collective_permute / broadcast: one payload hop
+
+    `payload_bytes` is the FULL (gathered/unreduced) array size for
+    every op. group_size<=1 is a degenerate group (XLA folds the op to
+    a copy): 0 wire bytes. jaxpr primitive names (psum, ppermute,
+    psum_scatter, ...) are accepted as aliases.
     """
-    n = max(int(group_size or 1), 1)
-    if n == 1:
+    try:
+        n = int(group_size or 1)
+    except (TypeError, ValueError):
+        n = 1
+    if n <= 1 or not payload_bytes or payload_bytes <= 0:
         return 0
+    op = _COLLECTIVE_ALIASES.get(op, op)
     frac = (n - 1) / n
     factor = {
         "all_reduce": 2 * frac,

@@ -13,7 +13,44 @@ from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
-           "input_pipeline_stats"]
+           "input_pipeline_stats", "memory_report"]
+
+
+def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
+                  print_report=True):
+    """Static per-device HBM report, before a chip sees the program.
+
+    `target` may be a `distributed.Trainer` (pass the training `batch`;
+    the report covers the FULL compiled step — fwd+bwd+optimizer, with
+    the real shardings and donation), an `nn.Layer` (pass example
+    inputs; forward only), or any jittable callable. Returns the
+    `analysis.MemoryEstimate`: per-device peak live bytes, the
+    args/transient split, the donation credit, and the top-k live
+    tensors at the peak with their defining ops — the "what do I shard,
+    remat or donate to fit" answer.  Estimates use native dtype widths
+    (the TPU numbers), chip-independent: lowering happens on CPU."""
+    from .analysis import estimate_jaxpr_memory
+    from .analysis.lowering import lower_callable, lower_layer
+    from .nn.layer_base import Layer
+
+    if hasattr(target, "analysis_program"):        # Trainer-shaped
+        if batch is None:
+            raise ValueError("memory_report(trainer) needs batch=...")
+        program = target.analysis_program(batch, lr=lr)
+    elif isinstance(target, Layer):
+        args = [x._value if isinstance(x, Tensor) else x
+                for x in example_inputs]
+        program = lower_layer(target, *args)
+    else:
+        args = [x._value if isinstance(x, Tensor) else x
+                for x in example_inputs]
+        program = lower_callable(target, *args)
+    est = estimate_jaxpr_memory(program.jaxpr,
+                                arg_infos=program.arg_infos, top_k=top_k)
+    if print_report:
+        print(f"== memory report: {program.name} ==")
+        print(est)
+    return est
 
 
 def input_pipeline_stats():

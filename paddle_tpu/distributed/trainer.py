@@ -331,6 +331,33 @@ class Trainer:
         return fn.lower(self.params, self.opt_state, self.gt_state,
                         self.consts, lr, arrays)
 
+    def analysis_program(self, batch, lr=0.0):
+        """Graph Doctor view of the SAME specialized step `step()`
+        dispatches: one trace yields the StableHLO text AND jaxpr, plus
+        per-argument capture of role (param / opt_state / gt_state /
+        const / lr / batch), sharding (shard count per leaf, from the
+        pinned in_shardings), and donation — everything the memory and
+        sharding passes need for per-device peak-HBM estimation and
+        replication lint that the HLO text alone can't recover."""
+        from ..analysis.lowering import LoweredProgram, tree_arg_infos
+        arrays, sig, batch_sh = self.place_batch(batch)
+        fn = self._placed_step(sig, batch_sh)
+        traced = fn.trace(self.params, self.opt_state, self.gt_state,
+                          self.consts, lr, arrays)
+        donate = bool(self._donate)
+        infos = tree_arg_infos(self.params, "param", donated=donate)
+        infos += tree_arg_infos(self.opt_state, "opt_state",
+                                donated=donate)
+        if self.gt_state is not None:
+            infos += tree_arg_infos(self.gt_state, "gt_state",
+                                    donated=donate)
+        infos += tree_arg_infos(self.consts, "const", donated=donate)
+        infos += tree_arg_infos(lr, "lr")
+        infos += tree_arg_infos(arrays, "batch", shardings=batch_sh)
+        return LoweredProgram(traced.lower().as_text(),
+                              jaxpr=traced.jaxpr, name="train_step",
+                              arg_infos=infos)
+
     def step(self, batch, lr=None):
         """Dispatch one compiled step. NON-BLOCKING: the returned loss is
         an unfetched device array — `float()` it only when you must (or

@@ -301,6 +301,159 @@ def test_collective_wire_bytes_model():
     assert collective_wire_bytes("all_reduce", 1024, 1) == 0
 
 
+def test_collective_wire_bytes_edge_cases_and_aliases():
+    """Degenerate groups are free; reduce_scatter/all_to_all have ring
+    formulas; jaxpr primitive names alias to their HLO collectives so
+    the sharding pass can price every collective either walk emits."""
+    from paddle_tpu.cost_model import collective_wire_bytes as w
+    # group_size=1 (or absent/invalid) folds to a copy: zero wire bytes
+    assert w("all_gather", 4096, 1) == 0
+    assert w("reduce_scatter", 4096, None) == 0
+    assert w("all_to_all", 4096, 0) == 0
+    assert w("all_reduce", 0, 8) == 0
+    assert w("all_reduce", None, 8) == 0
+    # full-payload ring formulas
+    assert w("reduce_scatter", 4096, 8) == int(4096 * 7 / 8)
+    assert w("all_to_all", 4096, 8) == int(4096 * 7 / 8)
+    assert w("collective_permute", 4096, 8) == 4096
+    # jaxpr-name aliases agree with their HLO lowerings
+    assert w("psum", 4096, 8) == w("all_reduce", 4096, 8)
+    assert w("ppermute", 4096, 8) == w("collective_permute", 4096, 8)
+    assert w("psum_scatter", 4096, 8) == w("reduce_scatter", 4096, 8)
+
+
+# -------------------------------------------------------------- sharding
+
+def _info(name, role, shape, shard_count, itemsize=4):
+    import numpy as np
+    from paddle_tpu.analysis import ArgInfo
+    return ArgInfo(name=name, role=role, shape=tuple(shape),
+                   dtype="float32",
+                   bytes=int(np.prod(shape)) * itemsize,
+                   shard_count=shard_count)
+
+
+def _sharding_pm():
+    return PassManager(["sharding"])
+
+
+def test_sharding_rule_catches_replicated_param_under_fsdp():
+    """A big replicated param on an fsdp mesh is the ZeRO promise broken
+    — ERROR; the sharded twin stays clean."""
+    program = LoweredProgram("", name="synthetic")
+    ctx = AnalysisContext(name="synthetic", mesh_axes={"fsdp": 8})
+
+    program.arg_infos = [_info("w", "param", (1024, 1024), 1)]
+    bad = _sharding_pm().run(program, ctx)
+    hits = bad.by_rule("SHARD-REPLICATED-BIG")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert bad.metrics["sharding"]["n_replicated_big"] == 1
+
+    program.arg_infos = [_info("w", "param", (1024, 1024), 8)]
+    clean = _sharding_pm().run(program, ctx)
+    assert clean.by_rule("SHARD-REPLICATED-BIG") == []
+    # small replicated tensors never fire (below the threshold)
+    program.arg_infos = [_info("b", "param", (128,), 1)]
+    small = _sharding_pm().run(program, ctx)
+    assert small.by_rule("SHARD-REPLICATED-BIG") == []
+    # replication under a dp-only mesh is by design — no finding
+    program.arg_infos = [_info("w", "param", (1024, 1024), 1)]
+    dp_only = _sharding_pm().run(
+        program, AnalysisContext(mesh_axes={"dp": 8}))
+    assert dp_only.by_rule("SHARD-REPLICATED-BIG") == []
+
+
+def test_sharding_rule_catches_unsharded_opt_state():
+    """Optimizer slots replicated while their same-shape param is
+    sharded: the silent 2-3x HBM leak the ZeRO configs exist to kill."""
+    program = LoweredProgram("", name="synthetic")
+    ctx = AnalysisContext(name="synthetic", mesh_axes={"fsdp": 8})
+
+    program.arg_infos = [
+        _info("w", "param", (1024, 1024), 8),
+        _info("slots/w/moment1", "opt_state", (1024, 1024), 1),
+    ]
+    bad = _sharding_pm().run(program, ctx)
+    hits = bad.by_rule("SHARD-OPT-STATE-UNSHARDED")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "moment1" in hits[0].message
+
+    program.arg_infos = [
+        _info("w", "param", (1024, 1024), 8),
+        _info("slots/w/moment1", "opt_state", (1024, 1024), 8),
+    ]
+    clean = _sharding_pm().run(program, ctx)
+    assert clean.by_rule("SHARD-OPT-STATE-UNSHARDED") == []
+
+
+def test_sharding_rule_catches_mid_program_reshard():
+    """A planted ppermute lowers to collective_permute — the signature
+    of a GSPMD spec mismatch; the exemption regex silences by-design
+    dispatch."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(dp=n_dev)
+
+    def shift(x):
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        return jax.lax.ppermute(x, "dp", perm)
+
+    fn = shard_map(shift, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    program = lower_callable(fn, jnp.zeros((n_dev, 8), jnp.float32),
+                             name="shift")
+    report = _sharding_pm().run(program, AnalysisContext(
+        mesh_axes={"dp": n_dev}))
+    hits = report.by_rule("SHARD-MID-PROGRAM-RESHARD")
+    assert hits and hits[0].severity == Severity.WARNING
+    assert report.metrics["sharding"]["n_mid_program_reshards"] == 1
+
+    blessed = _sharding_pm().run(program, AnalysisContext(
+        mesh_axes={"dp": n_dev},
+        allowed_resharding=(r"collective_permute",)))
+    assert blessed.by_rule("SHARD-MID-PROGRAM-RESHARD") == []
+
+    # a collective-free program never fires
+    clean_prog = lower_callable(lambda x: x * 2,
+                                jnp.zeros((8,), jnp.float32))
+    clean = _sharding_pm().run(clean_prog, AnalysisContext())
+    assert clean.by_rule("SHARD-MID-PROGRAM-RESHARD") == []
+
+
+def test_sharding_rule_catches_wire_byte_regression():
+    """Total analytic wire bytes above the committed memory manifest's
+    pin is an ERROR (a collective grew or a new one appeared)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.cost_model import collective_wire_bytes
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(dp=n_dev)
+
+    def allreduce(x):
+        return jax.lax.psum(x, "dp")
+
+    fn = shard_map(allreduce, mesh=mesh, in_specs=P("dp"), out_specs=P())
+    program = lower_callable(fn, jnp.zeros((n_dev, 1024), jnp.float32),
+                             name="psum")
+    fresh = _sharding_pm().run(program, AnalysisContext())
+    wire = fresh.metrics["sharding"]["total_wire_bytes"]
+    # per-shard [1,1024] f32 is both operand and result of the psum
+    assert wire == collective_wire_bytes("all_reduce", 1024 * 4, n_dev)
+
+    # committed manifest pinned half the volume -> regression fires
+    ctx = AnalysisContext(memory_manifest={
+        "collectives": {"total_wire_bytes": wire // 2}})
+    bad = _sharding_pm().run(program, ctx)
+    assert bad.by_rule("SHARD-WIRE-REGRESSION")
+    # pinned at the current volume -> clean
+    ctx = AnalysisContext(memory_manifest={
+        "collectives": {"total_wire_bytes": wire}})
+    ok = _sharding_pm().run(program, ctx)
+    assert ok.by_rule("SHARD-WIRE-REGRESSION") == []
+
+
 # ----------------------------------------------------- jit / to_static
 
 def test_to_static_lint_populates_report(tmp_path):

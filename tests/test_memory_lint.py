@@ -1,0 +1,230 @@
+"""The lint-memory CI gate: every BASELINE config's static per-device
+HBM estimate must match its COMMITTED memory manifest
+(memory_manifests/<config>.json, regenerated with
+`python -m paddle_tpu.analysis --write-manifests`), the estimator must
+agree with XLA's own `compiled.memory_analysis()` on CPU within 20%,
+and an injected peak-HBM regression must fail the gate.
+
+Runs inside the standard tier-1 sweep; select alone with
+`-m lint_memory`. Lowerings ride the per-process cache in
+paddle_tpu.analysis.baseline; compiles ride the persistent XLA cache.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import (AnalysisContext, PassManager,
+                                 estimate_jaxpr_memory,
+                                 load_memory_manifest, manifest_drift)
+from paddle_tpu.analysis.baseline import BASELINE_CONFIGS, lowered_program
+from paddle_tpu.analysis.lowering import ArgInfo
+
+pytestmark = pytest.mark.lint_memory
+
+
+@pytest.fixture(scope="module")
+def pass_manager():
+    return PassManager()
+
+
+def _fresh_report(name, pm, with_manifest=True):
+    program, ctx, fwd = lowered_program(name)
+    if with_manifest:
+        ctx.memory_manifest = load_memory_manifest(name)
+    return program, ctx, pm.run(program, ctx)
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_memory_manifest_is_committed_and_current(name, pass_manager):
+    """Gate: a fresh estimate agrees with the committed manifest (no
+    MEM-PEAK-REGRESSION / SHARD-WIRE-REGRESSION, no raw drift)."""
+    from paddle_tpu.analysis import build_memory_manifest
+    program, ctx, report = _fresh_report(name, pass_manager)
+    assert ctx.memory_manifest is not None, (
+        f"memory_manifests/{name}.json is not committed — run "
+        "python -m paddle_tpu.analysis --write-manifests")
+    for rule in ("MEM-PEAK-REGRESSION", "MEM-OVER-BUDGET",
+                 "SHARD-WIRE-REGRESSION"):
+        assert report.by_rule(rule) == [], \
+            "\n".join(str(f) for f in report.by_rule(rule))
+    drift = manifest_drift(build_memory_manifest(name, report),
+                           ctx.memory_manifest)
+    assert drift == [], "\n".join(drift)
+    mem = report.metrics["memory"]
+    assert mem["available"] and mem["peak_bytes"] > 0
+    assert mem["peak_bytes"] >= mem["args_bytes"]
+    # attribution names real buffers, biggest first
+    top = mem["top_live"]
+    assert top and top[0]["device_bytes"] >= top[-1]["device_bytes"]
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_static_peak_within_20pct_of_xla(name):
+    """The acceptance cross-check: the CPU-calibrated liveness estimate
+    lands within 20% of XLA's own buffer-assignment numbers where this
+    jaxlib exposes them on CPU."""
+    from paddle_tpu.analysis.baseline import build_config
+    model, examples, _ = build_config(name)
+    import jax.tree_util as jtu
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.nn.layer_base import (buffer_pytree, functional_call,
+                                          state_pytree)
+    params = state_pytree(model)
+    params.update(buffer_pytree(model))
+
+    def pure(p, *args):
+        with functional_call(model, p):
+            out = model(*[Tensor(a) for a in args])
+        return jtu.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    traced = jax.jit(pure).trace(params, *examples)
+    ma = traced.lower().compile().memory_analysis()
+    if ma is None or ma.argument_size_in_bytes == 0:
+        pytest.skip("compiled.memory_analysis() unavailable on CPU here")
+    xla_peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    est = estimate_jaxpr_memory(traced.jaxpr, cpu_calibrated=True)
+    assert abs(est.peak_bytes - xla_peak) <= 0.20 * xla_peak, (
+        name, est.peak_bytes, xla_peak, est.peak_bytes / xla_peak)
+
+
+@pytest.mark.parametrize("name", ["gpt"])
+def test_gate_fails_on_injected_peak_regression(name, pass_manager):
+    """A +30% per-device peak regression against the committed manifest
+    must produce an ERROR (the gate's reason to exist)."""
+    from paddle_tpu.analysis import Severity
+    program, ctx, fwd = lowered_program(name)
+    fresh = pass_manager.run(program, ctx).metrics["memory"]["peak_bytes"]
+    # simulate: the committed baseline was 30% smaller than this run
+    ctx.memory_manifest = {
+        "per_device_peak_bytes": int(fresh / 1.3),
+        "collectives": {"total_wire_bytes": 0},
+    }
+    report = pass_manager.run(program, ctx)
+    hits = report.by_rule("MEM-PEAK-REGRESSION")
+    assert hits and hits[0].severity == Severity.ERROR, \
+        [str(f) for f in report.findings]
+    assert report.errors
+
+
+def test_manifest_drift_detects_tampering():
+    committed = load_memory_manifest("gpt")
+    assert committed is not None
+    tampered = dict(committed, per_device_peak_bytes=1)
+    assert manifest_drift(committed, committed) == []
+    drift = manifest_drift(committed, tampered)
+    assert drift and "per_device_peak_bytes" in drift[0]
+    assert manifest_drift(committed, None)  # missing file is drift
+
+
+def test_cli_check_mode_clean_and_memory_output(capsys):
+    """`--check` exits 0 on the committed state; `--memory` prints the
+    HBM breakdown."""
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["gpt", "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "manifests current" in out
+    assert main(["gpt", "--memory"]) == 0
+    out = capsys.readouterr().out
+    assert "per-device peak" in out and "sharding:" in out
+
+
+# ------------------------------------------------- estimator unit proofs
+
+
+def test_donated_args_free_at_last_use():
+    """Donation credit: a donated arg dies after its last use, so the
+    peak drops vs the caller-owned version of the same program."""
+    big = jnp.zeros((256, 256), jnp.float32)
+
+    def f(a, b):
+        c = a + 1.0          # a dead afterwards
+        return c * b
+
+    traced = jax.jit(f).trace(big, big)
+    base = [ArgInfo(name="a", role="param", shape=(256, 256),
+                    dtype="float32", bytes=big.nbytes),
+            ArgInfo(name="b", role="param", shape=(256, 256),
+                    dtype="float32", bytes=big.nbytes)]
+    keep = estimate_jaxpr_memory(traced.jaxpr, arg_infos=base)
+    donated = [ArgInfo(**{**vars(i), "donated": True}) for i in base]
+    freed = estimate_jaxpr_memory(traced.jaxpr, arg_infos=donated)
+    assert freed.peak_bytes < keep.peak_bytes
+    assert freed.donated_bytes == 2 * big.nbytes
+
+
+def test_per_device_division_by_shard_count():
+    """An 8-way-sharded arg costs 1/8 per device; replicated costs full."""
+    x = jnp.zeros((64, 64), jnp.float32)
+
+    def f(a):
+        return a * 2.0
+
+    traced = jax.jit(f).trace(x)
+    rep = estimate_jaxpr_memory(traced.jaxpr, arg_infos=[
+        ArgInfo(name="x", role="batch", shape=(64, 64), dtype="float32",
+                bytes=x.nbytes, shard_count=1)])
+    shard = estimate_jaxpr_memory(traced.jaxpr, arg_infos=[
+        ArgInfo(name="x", role="batch", shape=(64, 64), dtype="float32",
+                bytes=x.nbytes, shard_count=8)])
+    assert rep.args_bytes == x.nbytes
+    assert shard.args_bytes == x.nbytes // 8
+    # the intermediate inherits the operand's sharding (propagation)
+    assert shard.peak_bytes <= rep.peak_bytes // 4
+
+
+def test_trainer_analysis_program_captures_roles_and_donation():
+    """The Trainer front door: per-arg roles/shardings/donation reach
+    the passes; donate=False trips MEM-NO-DONATION."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+
+    paddle.seed(0)
+    build_mesh(dp=len(jax.devices()))
+    model = nn.Linear(32, 32)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+
+    def loss_fn(m, batch):
+        return (m(paddle.to_tensor(batch["x"])) ** 2).mean()
+
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(16, 32).astype("float32")}
+
+    tr = Trainer(model, opt, loss_fn)
+    prog = tr.analysis_program(batch)
+    roles = {i.role for i in prog.arg_infos}
+    assert {"param", "opt_state", "const", "lr", "batch"} <= roles
+    batch_args = [i for i in prog.arg_infos if i.role == "batch"]
+    assert batch_args and all(i.shard_count == len(jax.devices())
+                              for i in batch_args)
+    assert all(i.donated for i in prog.arg_infos if i.role == "param")
+    pm = PassManager(["memory", "sharding"])
+    report = pm.run(prog, AnalysisContext(name="step"))
+    assert report.by_rule("MEM-NO-DONATION") == []
+    assert report.metrics["memory"]["donated_bytes"] > 0
+
+    tr2 = Trainer(model, opt, loss_fn, donate=False)
+    prog2 = tr2.analysis_program(batch)
+    report2 = pm.run(prog2, AnalysisContext(name="step"))
+    assert report2.by_rule("MEM-NO-DONATION")
+
+
+def test_debug_memory_report_front_doors(capsys):
+    """debug.memory_report works for a Layer and prints the breakdown."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import build_mesh
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = nn.Linear(16, 16)
+    est = paddle.debug.memory_report(model, jnp.zeros((4, 16)))
+    out = capsys.readouterr().out
+    assert "per-device peak" in out
+    assert est.peak_bytes > 0
+    assert est.top and est.top[0].device_bytes > 0

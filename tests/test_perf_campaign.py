@@ -81,7 +81,7 @@ def test_gpt_stage_resumes_past_banked_trials(campaign_dir, monkeypatch):
 
     def fake_run_config(name, bs, seq, remat_policy=None, grad_accum=1):
         ran.append((bs, remat_policy, grad_accum))
-        return 16000.0, 0.64, 1.3e9
+        return 16000.0, 0.64, 1.3e9, 0
     monkeypatch.setattr(bench, "run_config", fake_run_config)
     pc.run_gpt()
     # banked bs4/bs6 skipped; new accum2 + wedge-quarantined configs
