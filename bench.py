@@ -172,36 +172,18 @@ def _fwd_flops(trainer, batch):
 
 
 def chip_peak_flops():
-    """bf16 peak FLOP/s for the attached chip. v6e ('TPU v6 lite') must
-    be checked BEFORE the generic 'lite' clause or it reads as v5e."""
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    if "v6" in kind:
-        return 918e12
-    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    return 197e12
+    """bf16 peak FLOP/s for the attached chip (table now lives in
+    cost_model.CHIP_SPECS — one source for MFU, decode rooflines AND
+    the autotuner's step-time model)."""
+    from paddle_tpu.cost_model import chip_spec
+    return chip_spec().peak_flops
 
 
 def chip_hbm_bw():
     """HBM bytes/s for the attached chip (decode is bandwidth-bound).
-    Branch order mirrors chip_peak_flops: v6 before the 'lite' catch-all,
-    bare 'v5' treated as v5p."""
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    if "v6" in kind:
-        return 1640e9
-    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
-        return 819e9
-    if "v5p" in kind or "v5" in kind:
-        return 2765e9
-    if "v4" in kind:
-        return 1228e9
-    return 819e9
+    Same cost_model.CHIP_SPECS row as chip_peak_flops."""
+    from paddle_tpu.cost_model import chip_spec
+    return chip_spec().hbm_bw
 
 
 def decode_roofline_tok_s(cfg, batch, avg_ctx, quant=None, kv_bytes=2):
@@ -668,14 +650,35 @@ def _on_cpu_backend():
         return True
 
 
-def _device_watchdog(timeout_s=150, attempts=4, backoff_s=45):
+def _device_watchdog(timeout_s=None, attempts=None, backoff_s=45):
     """Probe jax backend init in a subprocess: a dead TPU tunnel HANGS
     jax.devices() forever, which would leave the driver with no JSON at
-    all. Tunnel flaps are transient, so retry with backoff before giving
-    up (~11 min worst case). Returns None if healthy, else an error
-    string."""
+    all. Returns None if healthy, else an error string.
+
+    Failure modes differ: a probe that ERRORS (nonzero exit) may be a
+    transient flap — retry with backoff; a probe that HANGS to its
+    timeout means the tunnel is down, and r5 burned 4x45s retries plus
+    a 150s hang each before reaching the cached-campaign fallback — so
+    a hang on ANY probe short-circuits immediately (error exits, which
+    really are transient flaps, keep the retry budget). Budgets are
+    env-tunable: PADDLE_TPU_BENCH_PROBE_TIMEOUT (seconds per probe,
+    default 150) and PADDLE_TPU_BENCH_PROBE_ATTEMPTS (error-retry
+    budget, default 4; set 1 for single-probe runs)."""
     import subprocess
     import time as _time
+    def _env_int(name, default, lo=1):
+        # a malformed env ("90s") must not crash bench before the
+        # watchdog's JSON fallback it exists to guarantee
+        try:
+            return max(lo, int(os.environ.get(name, default)))
+        except ValueError:
+            log(f"ignoring malformed {name}={os.environ[name]!r}; "
+                f"using {default}")
+            return default
+    if timeout_s is None:
+        timeout_s = _env_int("PADDLE_TPU_BENCH_PROBE_TIMEOUT", 150)
+    if attempts is None:
+        attempts = _env_int("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", 4)
     code = "import jax; d = jax.devices(); print(d[0].platform)"
     err = None
     for i in range(attempts):
@@ -691,6 +694,13 @@ def _device_watchdog(timeout_s=150, attempts=4, backoff_s=45):
             err = f"device init failed: {(p.stderr or '')[-200:]}"
         except subprocess.TimeoutExpired:
             err = f"device init hung >{timeout_s}s (TPU tunnel down?)"
+            # a hang is a down tunnel, not a flap — no matter which
+            # probe it lands on (an error-exit flap followed by a hang
+            # would otherwise still burn the remaining retry budget):
+            # skip straight to the cached-campaign fallback instead of
+            # ~11 min of retries that will hang the same way
+            which = "first probe" if i == 0 else f"probe {i + 1} hang"
+            return f"{err} [fast-fail on {which}]"
     return f"{err} [after {attempts} attempts]"
 
 
@@ -797,6 +807,30 @@ def main():
         [("gpt_350m", 16, 1024, "full")],
         [("gpt_125m", 16, 1024, "full")],
     ]
+    # PADDLE_TPU_BENCH_ADVISE=1: let the static remat/microbatch
+    # advisor (paddle_tpu.analysis.autotune — host-side tracing only,
+    # no device work) reorder the headline group before any compiles.
+    # Off by default because the hand ordering above IS measured truth;
+    # the advisor is for fresh configs the grid never tried.
+    if os.environ.get("PADDLE_TPU_BENCH_ADVISE") == "1":
+        try:
+            from paddle_tpu.analysis.autotune import rank_gpt_candidates
+            seqs = {(n, bs, rp): s for n, bs, s, rp in groups[0]}
+            if len(set(seqs.values())) != 1:
+                # the probe prices ONE seq; a mixed-seq group would be
+                # silently re-priced at the wrong length — keep the
+                # measured hand ordering instead
+                raise ValueError(
+                    f"mixed seq lengths {sorted(set(seqs.values()))}")
+            grid = [(n, bs, rp, 1) for n, bs, _s, rp in groups[0]]
+            ranked = rank_gpt_candidates(grid, seq=next(iter(seqs.values())),
+                                         top=len(grid), log=log)
+            groups[0] = [(n, bs, seqs[(n, bs, rp)], rp)
+                         for n, bs, rp, _a in ranked]
+            log(f"advisor reordered headline group: {groups[0]}")
+        except Exception as e:
+            log(f"advisor failed ({type(e).__name__}: {str(e)[:160]}); "
+                "keeping measured ordering")
     result, last_err = None, None
     if only in (None, "gpt"):
         for group in groups:

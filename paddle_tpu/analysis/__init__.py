@@ -32,9 +32,15 @@ from .manifest import (build_manifest, load_manifest,  # noqa: F401
                        manifest_path, write_manifest,
                        build_memory_manifest, load_memory_manifest,
                        manifest_drift, memory_manifest_path,
-                       write_memory_manifest)
+                       write_memory_manifest,
+                       build_tuning_manifest, load_tuning_manifest,
+                       tuning_manifest_path, write_tuning_manifest)
 from .memory import (MemoryEstimate,  # noqa: F401
-                     estimate_jaxpr_memory)
+                     estimate_jaxpr_memory, propagate_shard_counts)
+from .remat_advisor import (REMAT_POLICIES, RematWhatIf,  # noqa: F401
+                            advise_remat, replay_remat)
+from .autotune import (AutotuneReport, CandidateEstimate,  # noqa: F401
+                       autotune, autotune_layer, rank_gpt_candidates)
 
 __all__ = [
     "Finding", "Report", "Severity",
@@ -46,7 +52,12 @@ __all__ = [
     "build_manifest", "load_manifest", "manifest_path", "write_manifest",
     "build_memory_manifest", "load_memory_manifest", "manifest_drift",
     "memory_manifest_path", "write_memory_manifest",
-    "MemoryEstimate", "estimate_jaxpr_memory",
+    "build_tuning_manifest", "load_tuning_manifest",
+    "tuning_manifest_path", "write_tuning_manifest",
+    "MemoryEstimate", "estimate_jaxpr_memory", "propagate_shard_counts",
+    "REMAT_POLICIES", "RematWhatIf", "advise_remat", "replay_remat",
+    "AutotuneReport", "CandidateEstimate", "autotune", "autotune_layer",
+    "rank_gpt_candidates",
     "BASELINE_CONFIGS",
 ]
 

@@ -6,8 +6,11 @@ five) or any custom ``module.path:builder`` spec whose builder returns
 drift against committed lint AND memory manifests, and with
 --write-manifests regenerates both. ``--memory`` adds the per-device
 HBM breakdown (peak, args/transient split, top live tensors);
-``--check`` regenerates every committed manifest in-memory and fails on
-any drift — the CI answer to stale manifests.
+``--autotune`` prints the remat advisor's what-if table (per-policy
+peak, recompute FLOPs, roofline step time — tuning_manifests/*.json
+pins it); ``--check`` regenerates every committed manifest in-memory
+(lint, memory AND tuning) and fails on any drift — the CI answer to
+stale manifests.
 
 Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
 (the CI gate), 2 usage problems.
@@ -38,9 +41,12 @@ def _build_spec(spec):
     return program, ctx, type(model).forward
 
 
-def _run_spec(spec, write, as_json, no_manifest, show_memory):
+def _run_spec(spec, write, as_json, no_manifest, show_memory,
+              show_autotune=False):
     from . import (PassManager, load_manifest, load_memory_manifest,
-                   write_manifest, write_memory_manifest)
+                   write_manifest, write_memory_manifest,
+                   write_tuning_manifest)
+    from .baseline import BASELINE_CONFIGS
 
     pm = PassManager()
     program, ctx, fwd = _build_spec(spec)
@@ -55,9 +61,13 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory):
     if write:
         data = write_manifest(ctx.name, program, report)
         mem = write_memory_manifest(ctx.name, report)
-        print(f"wrote {ctx.name} manifests "
-              f"({sum(data['op_counts'].values())} pinned ops, "
-              f"{mem['per_device_peak_bytes']} peak bytes)")
+        msg = (f"wrote {ctx.name} manifests "
+               f"({sum(data['op_counts'].values())} pinned ops, "
+               f"{mem['per_device_peak_bytes']} peak bytes")
+        if spec in BASELINE_CONFIGS:
+            tun = write_tuning_manifest(ctx.name, _tuning_report(spec))
+            msg += f", best remat={tun['best']}"
+        print(msg + ")")
     if as_json:
         print(json.dumps({ctx.name: report.to_dict()}, indent=1,
                          sort_keys=True))
@@ -70,7 +80,21 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory):
                                          for k, v in sorted(gs.items())))
         if show_memory:
             _print_memory(report)
+        if show_autotune:
+            print(_tuning_report(spec))
     return report
+
+
+def _tuning_report(spec):
+    """AutotuneReport for a BASELINE name (cached) or module:builder
+    spec (built fresh)."""
+    from .baseline import BASELINE_CONFIGS, tuning_report
+    if spec in BASELINE_CONFIGS:
+        return tuning_report(spec)
+    from . import autotune_layer
+    mod_name, attr = spec.split(":", 1)
+    built = getattr(importlib.import_module(mod_name), attr)()
+    return autotune_layer(built[0], *built[1], name=attr)
 
 
 def _print_memory(report):
@@ -96,12 +120,15 @@ def _print_memory(report):
 
 
 def _check_manifests(names):
-    """Regenerate every manifest in-memory and diff against the
-    committed files. Returns the number of drifting/missing manifests
-    (the --check CI mode: stale manifests fail instead of silently
-    re-baselining)."""
+    """Regenerate every manifest in-memory (lint, memory AND tuning)
+    and diff against the committed files. Returns the number of
+    drifting/missing manifests (the --check CI mode: stale manifests
+    fail instead of silently re-baselining)."""
     from . import (PassManager, build_manifest, build_memory_manifest,
-                   load_manifest, load_memory_manifest, manifest_drift)
+                   build_tuning_manifest, load_manifest,
+                   load_memory_manifest, load_tuning_manifest,
+                   manifest_drift)
+    from .baseline import BASELINE_CONFIGS
 
     pm = PassManager()
     n_bad = 0
@@ -115,6 +142,10 @@ def _check_manifests(names):
                                load_manifest(name), path="lint")
         drift += manifest_drift(build_memory_manifest(name, report),
                                 load_memory_manifest(name), path="memory")
+        if name in BASELINE_CONFIGS:
+            drift += manifest_drift(
+                build_tuning_manifest(name, _tuning_report(name)),
+                load_tuning_manifest(name), path="tuning")
         if drift:
             n_bad += 1
             print(f"== {name}: STALE ==")
@@ -149,6 +180,10 @@ def main(argv=None):
     parser.add_argument("--memory", action="store_true",
                         help="print the per-device HBM breakdown "
                              "(peak, args/transient, top live tensors)")
+    parser.add_argument("--autotune", action="store_true",
+                        help="print the remat advisor's what-if table "
+                             "(per-policy peak, recompute FLOPs, "
+                             "roofline step time) for each config")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable findings")
     parser.add_argument("--no-manifest-check", action="store_true",
@@ -175,7 +210,8 @@ def main(argv=None):
     worst = None
     for name in names:
         report = _run_spec(name, args.write_manifests, args.json,
-                           args.no_manifest_check, args.memory)
+                           args.no_manifest_check, args.memory,
+                           show_autotune=args.autotune)
         sev = report.max_severity
         if sev is not None and (worst is None or sev > worst):
             worst = sev

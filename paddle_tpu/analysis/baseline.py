@@ -15,9 +15,10 @@ import jax.numpy as jnp
 from .pass_manager import AnalysisContext
 
 __all__ = ["BASELINE_CONFIGS", "build_config", "lowered_program",
-           "forward_fn"]
+           "forward_fn", "tuning_report"]
 
 _CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
+_TUNING_CACHE = {}   # name -> AutotuneReport (autotune.autotune_layer)
 
 
 def _fresh():
@@ -153,3 +154,17 @@ def lowered_program(name):
 
 def forward_fn(name):
     return lowered_program(name)[2]
+
+
+def tuning_report(name):
+    """The remat advisor's AutotuneReport for a BASELINE config —
+    what-if peak + recompute per policy over a fresh seeded grad trace,
+    roofline-priced against the fixed v5e spec (deterministic: this is
+    what tuning_manifests/<name>.json pins). Cached per process like
+    the lowerings."""
+    if name not in _TUNING_CACHE:
+        from .autotune import autotune_layer
+        model, examples, ctx = build_config(name)
+        _TUNING_CACHE[name] = autotune_layer(model, *examples,
+                                             chip="v5e", name=name)
+    return _TUNING_CACHE[name]

@@ -19,10 +19,14 @@ __all__ = ["manifest_dir", "manifest_path", "load_manifest",
            "build_manifest", "write_manifest",
            "memory_manifest_dir", "memory_manifest_path",
            "load_memory_manifest", "build_memory_manifest",
-           "write_memory_manifest", "manifest_drift"]
+           "write_memory_manifest", "manifest_drift",
+           "tuning_manifest_dir", "tuning_manifest_path",
+           "load_tuning_manifest", "build_tuning_manifest",
+           "write_tuning_manifest"]
 
 _SCHEMA = 1
 _MEMORY_SCHEMA = 1
+_TUNING_SCHEMA = 1
 
 
 def manifest_dir():
@@ -138,6 +142,64 @@ def write_memory_manifest(name, report):
     os.makedirs(memory_manifest_dir(), exist_ok=True)
     data = build_memory_manifest(name, report)
     with open(memory_manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+# ---------------------------------------------------------------- tuning
+
+
+def tuning_manifest_dir():
+    """Repo-root tuning_manifests/ (next to memory_manifests/)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "tuning_manifests")
+
+
+def tuning_manifest_path(name):
+    return os.path.join(tuning_manifest_dir(), f"{name}.json")
+
+
+def load_tuning_manifest(name):
+    """The committed tuning manifest dict, or None when not committed."""
+    try:
+        with open(tuning_manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_tuning_manifest(name, report):
+    """Tuning manifest dict from one `autotune_layer` report
+    (analysis/autotune.py): per-policy what-if peaks, recompute %, and
+    the advisor's ranking. Deterministic — the replay runs over one
+    seeded CPU trace and the roofline prices against a FIXED chip spec
+    (v5e), so a TPU and a CPU checkout agree byte-for-byte."""
+    return {
+        "schema": _TUNING_SCHEMA,
+        "model": name,
+        "chip": report.chip,
+        "hbm_budget_bytes": report.hbm_budget,
+        "policies": {
+            c.policy: {
+                "peak_bytes": c.peak_bytes,
+                "recompute_pct": round(c.recompute_pct, 2),
+                "predicted_step_us": round(c.step_s * 1e6, 3),
+                "bound": c.bound,
+                "feasible": c.feasible,
+            } for c in report.candidates},
+        "ranked": [c.policy for c in report.candidates],
+        "best": report.best.policy if report.best else None,
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_tuning_manifest(name, report):
+    os.makedirs(tuning_manifest_dir(), exist_ok=True)
+    data = build_tuning_manifest(name, report)
+    with open(tuning_manifest_path(name), "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
     return data

@@ -25,12 +25,19 @@ dot operands get materialized f32 conversion copies) so the estimate
 lands within the lint gate's tolerance of XLA's own number.  Manifests
 and TPU advice always use the native-width (uncalibrated) estimate.
 """
+import re
 from dataclasses import dataclass, field
 
 from .findings import Finding, Severity
 from .pass_manager import Analyzer, register_analyzer
 
-__all__ = ["MemoryAnalyzer", "MemoryEstimate", "estimate_jaxpr_memory"]
+__all__ = ["MemoryAnalyzer", "MemoryEstimate", "estimate_jaxpr_memory",
+           "propagate_shard_counts"]
+
+# arg names that identify decode-loop KV-cache state when the capture
+# didn't assign an explicit role="cache" (serving front doors do)
+_KV_CACHE_RE = re.compile(r"(^|[/.])(k|v|kv)?_?(cache|pages)(s)?([/.]|$)",
+                          re.IGNORECASE)
 
 # primitives whose sub-f32 operands XLA CPU materializes as f32 copies
 # (no native bf16 matmul path on the host; convolutions lower through a
@@ -156,9 +163,16 @@ def _inner_transient(jx, widen, memo):
 
 
 def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
-          arg_infos=None):
+          arg_infos=None, last_use_override=None, extra_after=None):
     """Liveness walk of one jaxpr. Returns (peak, peak_eqn_idx,
-    top_buffers_at_peak)."""
+    top_buffers_at_peak).
+
+    `last_use_override` ({var: eqn_idx}) truncates live ranges — the
+    remat advisor's what-if replay drops checkpointed intermediates by
+    ending them at their last FORWARD use. `extra_after` ((idx, bytes))
+    adds a flat byte bump to every program point past idx — the
+    advisor's model of one segment's recompute working set during the
+    backward. Output vars are never truncated."""
     last_use = {}
     for i, eqn in enumerate(jx.eqns):
         for v in eqn.invars:
@@ -168,6 +182,11 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     for v in jx.outvars:
         if _is_var(v):
             last_use[v] = n
+    if last_use_override:
+        for v, idx in last_use_override.items():
+            if last_use.get(v, n) < n:
+                last_use[v] = idx
+    bump_after, bump = extra_after if extra_after else (n + 1, 0)
     invars = list(jx.invars)
     if pin_invars:
         # non-donated arguments + baked constants are caller-owned: XLA
@@ -229,8 +248,9 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
                     op=eqn.primitive.name, name=_eqn_source(eqn, i),
                     bytes=gb, device_bytes=db, shard_count=out_count))
                 cur += db
-        if cur + inner > peak:
-            peak, peak_idx = cur + inner, i
+        extra = bump if i > bump_after else 0
+        if cur + inner + extra > peak:
+            peak, peak_idx = cur + inner + extra, i
             peak_top = list(live.values())
         for v in list(eqn.invars) + list(eqn.outvars):
             if _is_var(v) and last_use.get(v) == i and v in live:
@@ -243,8 +263,27 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     return peak, peak_idx, top
 
 
+def propagate_shard_counts(jx, arg_counts=None):
+    """{var: shard_count} over one jaxpr, using the same propagation
+    heuristic as the liveness walk (a result is at best as sharded as
+    its most-sharded operand). The remat advisor prices dropped/saved
+    residuals per device with it."""
+    jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
+    counts = {}
+    for k, v in enumerate(jx.invars):
+        counts[v] = (arg_counts[k]
+                     if arg_counts and k < len(arg_counts) else 1)
+    for eqn in jx.eqns:
+        in_counts = [counts.get(v, 1) for v in eqn.invars if _is_var(v)]
+        out = max(in_counts) if in_counts else 1
+        for v in eqn.outvars:
+            counts[v] = out
+    return counts
+
+
 def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
-                          cpu_calibrated=False):
+                          cpu_calibrated=False, last_use_override=None,
+                          extra_after=None):
     """Static per-device HBM estimate of one closed jaxpr.
 
     `arg_infos`: optional list of `lowering.ArgInfo` aligned with the
@@ -252,6 +291,11 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
     donation flags (donated args free at last use), and names for the
     peak attribution. Without it every arg is assumed replicated and
     non-donated (the single-device forward-program case).
+
+    `last_use_override`/`extra_after` thread through to the liveness
+    walk — the remat advisor's what-if replay (remat_advisor.py) re-runs
+    the SAME walk with checkpointed intermediates dropped and one
+    segment's recompute working set added past the fwd/bwd boundary.
     """
     jx = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
     infos = arg_infos or []
@@ -260,7 +304,8 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
     memo = {}
     peak, peak_idx, top = _walk(
         jx, arg_counts=arg_counts, donated=donated, widen=cpu_calibrated,
-        pin_invars=True, memo=memo, top_k=top_k, arg_infos=infos)
+        pin_invars=True, memo=memo, top_k=top_k, arg_infos=infos,
+        last_use_override=last_use_override, extra_after=extra_after)
 
     def _arg_db(k, v):
         cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
@@ -299,6 +344,12 @@ class MemoryAnalyzer(Analyzer):
                                     donation credit — train-step args
                                     are not donated, doubling resident
                                     state
+      MEM-NO-DONATION-KVCACHE WARNING  decode-loop program whose KV
+                                    cache is not donated — the cache is
+                                    the carried state in inference (the
+                                    params are read-only there), so a
+                                    non-donated cache copies the whole
+                                    KV store every decode step
     Metrics feed memory_manifests/<config>.json (peak, breakdown, top-k
     attribution)."""
     name = "memory"
@@ -355,4 +406,27 @@ class MemoryAnalyzer(Analyzer):
                     "state in HBM",
                     suggested_fix="donate params/opt state into the "
                     "compiled step (Trainer(donate=True))"))
+        # decode-loop variant: in inference the carried state is the KV
+        # cache, not params — jit.save/serving paths never donate params
+        # (correctly: they're read-only across steps), but a non-donated
+        # cache double-buffers the whole KV store on every step
+        cache_infos = [i for i in infos
+                       if i.role == "cache"
+                       or (i.role not in ("param", "opt_state", "gt_state")
+                           and _KV_CACHE_RE.search(i.name or ""))]
+        # per-ARG, not any(): k_pages donated with v_pages forgotten
+        # still double-buffers half the store
+        undonated = [i for i in cache_infos if not i.donated]
+        undonated_bytes = sum(i.device_bytes for i in undonated)
+        if undonated_bytes and ctx.extra.get("expect_donation", True):
+            names = ", ".join(sorted(i.name or "?" for i in undonated)[:4])
+            findings.append(Finding(
+                "MEM-NO-DONATION-KVCACHE", Severity.WARNING,
+                f"{undonated_bytes} bytes of KV-cache state ({names}) "
+                "are not donated into the decode step — XLA must "
+                "allocate a second full cache for the updated pages "
+                "every step",
+                suggested_fix="donate the cache buffers "
+                "(jax.jit(step, donate_argnums=...) on the k/v page "
+                "arguments, as serving.PagedGPTDecoder does)"))
         return findings

@@ -3,12 +3,198 @@
 The reference profiles a static Program op-by-op against a benchmark JSON.
 TPU-native: XLA's compiled cost analysis gives per-program FLOPs/bytes
 analytically, and profile_measure times the real jitted program.
+
+This module also hosts the OFFLINE half of config selection:
+
+  * `ChipSpec` / `chip_spec` — the per-generation peak FLOP/s, HBM
+    bandwidth/size and interconnect numbers bench.py uses for MFU and
+    roofline framing, in one queryable table;
+  * `eqn_flops` / `jaxpr_flops` — analytic FLOPs of a traced jaxpr
+    (dot/conv priced exactly from shapes, elementwise at 1 flop/elem,
+    scan multiplied by trip count) — the compute numerator no chip is
+    needed for;
+  * `roofline_step_time` — price one training step as
+    max(compute-bound, HBM-bound, wire-bound) time (the T3-style
+    compute/collective split, arxiv 2401.16677; static per-program cost
+    modeling after TPU-MLIR, arxiv 2210.15016). analysis/autotune.py
+    ranks (microbatch, remat) candidates with it before anything
+    compiles;
+  * `collective_wire_bytes` / `collective_wire_split` — ring-model
+    bytes-on-the-wire per collective, with DCN-spanning hops priced
+    separately from ICI when the mesh axis crosses hosts.
 """
 import time
+from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["CostModel", "collective_wire_bytes"]
+__all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
+           "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
+           "eqn_flops", "jaxpr_flops", "RooflineTime",
+           "roofline_step_time"]
+
+
+# ------------------------------------------------------------------ chips
+#
+# Per-chip peak numbers (bf16 MXU FLOP/s, HBM bytes/s and capacity,
+# aggregate one-direction ICI bytes/s, per-chip share of the host DCN
+# NIC). The flops/HBM columns are the same table bench.py has always
+# used for MFU; ICI/DCN are approximate public figures — they feed
+# RELATIVE ranking and the wire-bound roofline leg, not accounting.
+
+@dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_flops: float      # bf16 FLOP/s
+    hbm_bw: float          # HBM bytes/s
+    hbm_bytes: int         # HBM capacity per chip
+    ici_bw: float          # aggregate ICI bytes/s per chip (one dir)
+    dcn_bw: float          # per-chip share of host DCN bytes/s
+
+
+CHIP_SPECS = {
+    "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30, 300e9, 3.1e9),
+    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30, 200e9, 3.1e9),
+    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30, 600e9, 3.1e9),
+    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 << 30, 448e9, 3.1e9),
+}
+
+
+def chip_spec(kind=None):
+    """Resolve a ChipSpec from an explicit name ("v5e") or a jax
+    device_kind string ("TPU v5 lite"). With kind=None, asks the live
+    backend; a CPU/no-device environment resolves to v5e (the paper's
+    reference chip), so static analysis off-chip prices for the chip
+    the campaign targets. Branch order matters: 'v6 lite' must check
+    before the generic 'lite' clause or it reads as v5e."""
+    if kind is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            if d.platform != "cpu":
+                kind = d.device_kind
+        except Exception:
+            kind = None
+    if not kind:
+        return CHIP_SPECS["v5e"]
+    k = str(kind).lower()
+    if k in CHIP_SPECS:
+        return CHIP_SPECS[k]
+    if "v6" in k:
+        return CHIP_SPECS["v6e"]
+    if "v5 lite" in k or "v5e" in k or "lite" in k:
+        return CHIP_SPECS["v5e"]
+    if "v5p" in k or "v5" in k:
+        return CHIP_SPECS["v5p"]
+    if "v4" in k:
+        return CHIP_SPECS["v4"]
+    return CHIP_SPECS["v5e"]
+
+
+# ------------------------------------------------------------ jaxpr flops
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def eqn_flops(eqn):
+    """Analytic executed FLOPs of one jaxpr eqn. dot_general and
+    conv_general_dilated are priced exactly from shapes (2*M*N*K per
+    contraction); eqns carrying sub-jaxprs recurse (scan multiplied by
+    its trip count, cond priced at its most expensive branch);
+    everything else is 1 flop per output element — elementwise ops are
+    bandwidth-bound on TPU, so their flop count only needs the right
+    order of magnitude."""
+    name = eqn.primitive.name
+    try:
+        if name == "dot_general":
+            (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval
+            rhs = eqn.invars[1].aval
+            batch = _prod(lhs.shape[i] for i in lb)
+            k = _prod(lhs.shape[i] for i in lc)
+            m = _prod(d for i, d in enumerate(lhs.shape)
+                      if i not in set(lc) | set(lb))
+            n = _prod(rhs.shape) // max(batch * k, 1)
+            return 2 * batch * m * n * k
+        if name == "conv_general_dilated":
+            out = eqn.outvars[0].aval
+            rhs = eqn.invars[1].aval
+            dn = eqn.params["dimension_numbers"]
+            out_ch = rhs.shape[dn.rhs_spec[0]]
+            # per output element: one MAC per (kernel spatial x in-ch)
+            return 2 * _prod(out.shape) * (_prod(rhs.shape) // max(out_ch, 1))
+        subs = _eqn_sub_jaxprs(eqn)
+        if subs:
+            inner = [jaxpr_flops(sj) for sj in subs]
+            if name == "scan":
+                return int(eqn.params.get("length", 1)) * sum(inner)
+            if name == "cond":
+                return max(inner)
+            return sum(inner)
+        return _prod(getattr(eqn.outvars[0].aval, "shape", ()))
+    except Exception:
+        return 0
+
+
+def _eqn_sub_jaxprs(eqn):
+    found = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            tn = type(x).__name__
+            if tn == "ClosedJaxpr":
+                found.append(x.jaxpr)
+            elif tn == "Jaxpr":
+                found.append(x)
+    return found
+
+
+def jaxpr_flops(jx):
+    """Total analytic FLOPs of a (closed) jaxpr, sub-jaxprs included."""
+    jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
+    return sum(eqn_flops(eqn) for eqn in jx.eqns)
+
+
+# -------------------------------------------------------------- roofline
+
+@dataclass
+class RooflineTime:
+    """One candidate's step-time breakdown: the step takes at least as
+    long as its slowest resource (compute, HBM, interconnect) — XLA
+    overlaps the three, so the max is the analytic floor."""
+    compute_s: float
+    hbm_s: float
+    wire_s: float
+
+    @property
+    def step_s(self):
+        return max(self.compute_s, self.hbm_s, self.wire_s)
+
+    @property
+    def bound(self):
+        return max((self.compute_s, "compute"), (self.hbm_s, "hbm"),
+                   (self.wire_s, "wire"))[1]
+
+
+def roofline_step_time(flops, hbm_bytes, ici_bytes=0, dcn_bytes=0,
+                       chip=None, mxu_efficiency=0.65):
+    """Analytic step time: max(compute, HBM, wire) seconds.
+
+    `mxu_efficiency` derates peak FLOP/s for the achievable fraction on
+    real schedules (the campaign's best measured MFU on compute-bound
+    GPT configs is ~0.64 — rankings are insensitive to the constant,
+    absolute tok/s predictions are honest with it). DCN hops are priced
+    at DCN bandwidth on top of the ICI time: a multi-host ring's wire
+    time is gated by its slowest link."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    compute = flops / (chip.peak_flops * mxu_efficiency)
+    hbm = hbm_bytes / chip.hbm_bw
+    wire = ici_bytes / chip.ici_bw + dcn_bytes / chip.dcn_bw
+    return RooflineTime(compute_s=compute, hbm_s=hbm, wire_s=wire)
 
 
 # jaxpr primitive names -> the StableHLO collective they lower to, so
@@ -60,6 +246,48 @@ def collective_wire_bytes(op, payload_bytes, group_size):
         "collective_broadcast": 1.0,
     }.get(op, 1.0)
     return int(payload_bytes * factor)
+
+
+def collective_wire_split(op, payload_bytes, group_size, host_count=1):
+    """ICI/DCN split of `collective_wire_bytes`: a ring over n devices
+    spanning h hosts crosses a host boundary on h of its n hops, so
+    h/n of the wire volume rides DCN and the rest stays on ICI (the
+    ROADMAP "multi-host memory model" item — every hop used to be
+    priced at ICI cost). h<=1 (chip-local axis) puts everything on ICI.
+    Returns {"ici": bytes, "dcn": bytes}."""
+    total = collective_wire_bytes(op, payload_bytes, group_size)
+    try:
+        n = max(int(group_size or 1), 1)
+        h = max(int(host_count or 1), 1)
+    except (TypeError, ValueError):
+        n, h = 1, 1
+    if total <= 0 or h <= 1 or n <= 1:
+        return {"ici": total, "dcn": 0}
+    dcn = int(total * min(h, n) / n)
+    return {"ici": total - dcn, "dcn": dcn}
+
+
+def axis_host_count(mesh, axis):
+    """How many hosts one line of `axis` spans in this mesh — the h of
+    `collective_wire_split`. Walks mesh.devices along the axis with all
+    other axes held at 0 and counts distinct process indexes (duck-typed:
+    anything with .axis_names and a .devices ndarray of objects carrying
+    .process_index works, so multi-host topologies are testable offline).
+    Unknown axes or failures fall back to 1 (chip-local)."""
+    try:
+        names = list(mesh.axis_names)
+        if axis not in names:
+            return 1
+        devs = mesh.devices
+        idx = [0] * devs.ndim
+        ax = names.index(axis)
+        procs = set()
+        for i in range(devs.shape[ax]):
+            idx[ax] = i
+            procs.add(getattr(devs[tuple(idx)], "process_index", 0))
+        return max(len(procs), 1)
+    except Exception:
+        return 1
 
 
 class CostModel:

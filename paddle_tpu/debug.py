@@ -13,7 +13,47 @@ from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
-           "input_pipeline_stats", "memory_report"]
+           "input_pipeline_stats", "memory_report", "autotune"]
+
+
+def autotune(target, *example_inputs, batch=None, hbm_budget=None,
+             print_report=True, **kw):
+    """Static (microbatch, remat) autotuner — the front door of
+    `paddle_tpu.analysis.autotune`. No compile, no device execution:
+    one no-remat CPU trace per candidate batch size, a what-if liveness
+    replay per remat policy (what the Memory Doctor's peak becomes when
+    the policy's checkpointed intermediates are dropped), and a
+    roofline step-time ranking (max of compute/HBM/wire time).
+
+    `target` may be a `distributed.Trainer` (pass the training
+    `batch=`; candidates cover microbatch x policy for the REAL
+    compiled step) or an `nn.Layer` (pass example inputs; policy sweep
+    over a synthetic grad program). Returns an
+    `analysis.AutotuneReport`: `.best` is the config to measure first,
+    `.advice` the per-policy "peak X → Y per device, +Z% recompute
+    FLOPs" lines. `hbm_budget` (bytes) prunes configs that don't fit —
+    default is the chip's HBM capacity."""
+    from .analysis.autotune import autotune as _autotune, autotune_layer
+    from .nn.layer_base import Layer
+
+    # Trainer-shaped = analysis_program AND step: PagedGPTDecoder also
+    # exposes analysis_program (for memory_report/lints) but has no
+    # train step to tune — it must fall through to the clear TypeError
+    if hasattr(target, "analysis_program") and hasattr(target, "step"):
+        if batch is None:
+            raise ValueError("debug.autotune(trainer) needs batch=...")
+        report = _autotune(target, batch, hbm_budget=hbm_budget, **kw)
+    elif isinstance(target, Layer):
+        args = [x._value if isinstance(x, Tensor) else x
+                for x in example_inputs]
+        report = autotune_layer(target, *args, hbm_budget=hbm_budget,
+                                **kw)
+    else:
+        raise TypeError("debug.autotune wants a Trainer or an nn.Layer, "
+                        f"got {type(target).__name__}")
+    if print_report:
+        print(report)
+    return report
 
 
 def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
@@ -33,10 +73,13 @@ def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
     from .analysis.lowering import lower_callable, lower_layer
     from .nn.layer_base import Layer
 
-    if hasattr(target, "analysis_program"):        # Trainer-shaped
-        if batch is None:
-            raise ValueError("memory_report(trainer) needs batch=...")
-        program = target.analysis_program(batch, lr=lr)
+    if hasattr(target, "analysis_program"):
+        if hasattr(target, "step"):                # Trainer-shaped
+            if batch is None:
+                raise ValueError("memory_report(trainer) needs batch=...")
+            program = target.analysis_program(batch, lr=lr)
+        else:            # decoder-shaped (PagedGPTDecoder): the program
+            program = target.analysis_program()    # is self-contained
     elif isinstance(target, Layer):
         args = [x._value if isinstance(x, Tensor) else x
                 for x in example_inputs]

@@ -51,7 +51,11 @@ class ElasticManager:
             return
         self._last_beat = now
         os.makedirs(os.path.dirname(self.heartbeat_path), exist_ok=True)
-        tmp = self.heartbeat_path + ".tmp"
+        # per-pid temp name: every rank heartbeats the same path, and two
+        # ranks sharing one ".tmp" race write-vs-replace into
+        # FileNotFoundError (surfaced once CPU gloo collectives let
+        # multi-process groups actually train)
+        tmp = f"{self.heartbeat_path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump({"step": step, "ts": now, **(extra or {})}, f)
         os.replace(tmp, self.heartbeat_path)
@@ -128,7 +132,12 @@ def launch_elastic(training_script, script_args=(), nproc_per_node=1,
         cmd += [training_script, *script_args]
         _clear_beat(heartbeat_path)
         started = time.time()
-        proc = subprocess.Popen(cmd, env=env)
+        run_env = dict(env) if env is not None else dict(os.environ)
+        # same fail-fast barrier as launch_elastic_node: THIS loop is the
+        # recovery path, so a relaunched group must not wait out jax's
+        # 300 s coordinator default when its peer rank died at startup
+        run_env.setdefault("PADDLE_TPU_DIST_INIT_TIMEOUT", "60")
+        proc = subprocess.Popen(cmd, env=run_env)
         reason = None
         while True:
             rc = proc.poll()
@@ -252,6 +261,11 @@ def launch_elastic_node(node_rank, nnodes, training_script, script_args=(),
         _clear_beat(heartbeat_path)
         started = time.time()
         run_env = dict(env) if env is not None else dict(os.environ)
+        # an elastic job must fail-fast at the coordinator barrier: the
+        # supervisor's restart loop IS the recovery path, so waiting out
+        # jax.distributed.initialize's 300 s default when the peer host
+        # is mid-teardown only delays it (see init_parallel_env)
+        run_env.setdefault("PADDLE_TPU_DIST_INIT_TIMEOUT", "60")
         if heartbeat_path:
             # workers find THIS node's beat file via the env
             # (ElasticManager defaults its path from it)

@@ -76,6 +76,12 @@ def force_cpu_devices(env, n):
                 "PJRT_LIBRARY_PATH", "_AXON_REGISTERED"):
             env.pop(k)
     env["JAX_PLATFORMS"] = "cpu"
+    # multi-PROCESS computations need a CPU collectives backend: without
+    # one XLA refuses outright ("Multiprocess computations aren't
+    # implemented on the CPU backend") — the root cause of the two-process
+    # launch/elastic failures this repo carried since the seed. This
+    # jaxlib ships gloo; respect an explicit override.
+    env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
     flags = env.get("XLA_FLAGS", "")
     flags = " ".join(f for f in flags.split()
                      if "xla_force_host_platform_device_count" not in f)
@@ -124,6 +130,7 @@ def main(argv=None):
     rc = 0
     try:
         pending = dict(procs)
+        termed_at = None
         while pending:
             for rank, proc in list(pending.items()):
                 r = proc.poll()
@@ -138,11 +145,37 @@ def main(argv=None):
                     for _, q in procs:
                         if q.poll() is None:
                             q.terminate()
+                    termed_at = time.time()
+            if termed_at is not None and pending and \
+                    time.time() - termed_at > 10:
+                # SIGTERM can't land on a rank wedged inside a gloo
+                # collective whose partner died: the C++ socket read
+                # never returns, so a python-level signal handler (e.g.
+                # ElasticManager's graceful-exit hook) never runs.
+                # Escalate so the group always reaps and the elastic
+                # supervisor can restart it.
+                print("[launch] peers ignored SIGTERM for 10s; killing",
+                      file=sys.stderr)
+                for _, q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                termed_at = None
             time.sleep(0.2)
     except KeyboardInterrupt:
         for _, q in procs:
             if q.poll() is None:
                 q.send_signal(signal.SIGINT)
+        # a rank wedged in a gloo collective never sees SIGINT (same
+        # C++-block story as the SIGTERM escalation above) — reap it
+        # rather than orphan it past our own exit
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                q.poll() is None for _, q in procs):
+            time.sleep(0.2)
+        for _, q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait()
         rc = 130
     finally:
         for f in logs:

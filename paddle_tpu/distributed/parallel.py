@@ -28,10 +28,33 @@ def init_parallel_env():
     master = os.environ.get("PADDLE_MASTER")
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if master and world > 1 and not _dist_client_active():
+        # multi-PROCESS computations on the CPU backend need a CPU
+        # collectives implementation or XLA refuses with "Multiprocess
+        # computations aren't implemented on the CPU backend". The
+        # launcher's force_cpu_devices exports the choice (gloo on this
+        # jaxlib); jax's enum flag never reads env vars, so it must be
+        # applied here, before the backend initializes.
+        impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+        if impl and os.environ.get("JAX_PLATFORMS") == "cpu":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  impl)
+            except Exception:
+                pass   # older jax: flag absent, collectives unavailable
+        # The coordinator barrier defaults to 300 s. Under an elastic
+        # supervisor that is FAR too patient: a group relaunched while
+        # its peer host is still tearing down (epoch race) sits the full
+        # barrier out — twice, if both sides miss — before failing and
+        # triggering the restart that actually fixes things (observed as
+        # a 10-minute test_multihost_kill_restarts_both_groups). The
+        # supervisor sets a short timeout; a timed-out init exits
+        # nonzero, bumps the epoch, and the next launch pairs up.
+        timeout = int(os.environ.get("PADDLE_TPU_DIST_INIT_TIMEOUT", "300"))
         jax.distributed.initialize(
             coordinator_address=master,
             num_processes=world,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            initialization_timeout=timeout)
     get_mesh(create_default=True)
     return ParallelEnv()
 

@@ -358,6 +358,18 @@ class Trainer:
                               jaxpr=traced.jaxpr, name="train_step",
                               arg_infos=infos)
 
+    def suggest_config(self, batch, hbm_budget=None, **kw):
+        """Static config advice for THIS trainer: candidate microbatch
+        sizes x remat policies ranked by roofline-predicted throughput,
+        HBM-infeasible points pruned — one CPU trace per batch size, a
+        what-if liveness replay per policy, zero compiles, zero device
+        work (analysis/autotune.py). Returns an AutotuneReport whose
+        `.best` names the config to measure first and whose `.advice`
+        lines read "remat=dots: peak X → Y per device, +Z% recompute
+        FLOPs"."""
+        from ..analysis.autotune import autotune
+        return autotune(self, batch, hbm_budget=hbm_budget, **kw)
+
     def step(self, batch, lr=None):
         """Dispatch one compiled step. NON-BLOCKING: the returned loss is
         an unfetched device array — `float()` it only when you must (or

@@ -533,6 +533,39 @@ class PagedGPTDecoder:
                     results[i] = int(nxt[r])
         return results
 
+    def analysis_program(self, donate=True):
+        """Graph Doctor view of the compiled decode step: one fresh
+        trace of `_decode_step` with per-argument role capture —
+        weights/embeddings are `param` (read-only across steps, NOT
+        donated: that's correct for inference), the K/V page pools are
+        `cache` with donated=True matching the real donate_argnums=(1,2)
+        (the cache is the decode loop's carried state — an undonated
+        cache is the MEM-NO-DONATION-KVCACHE lint), tokens/lens/table/
+        draw are `input`. `donate=False` traces the defective variant
+        the planted-defect test lints."""
+        from .analysis.lowering import LoweredProgram, tree_arg_infos
+
+        S = self.max_batch
+        tokens = jnp.zeros((S,), jnp.int32)
+        lens = jnp.zeros((S,), jnp.int32)
+        table = jnp.zeros((S, self.max_pages), jnp.int32)
+        draw = jnp.zeros((), jnp.int32)
+        fn = jax.jit(self._decode_step,
+                     donate_argnums=(1, 2) if donate else ())
+        traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                          tokens, lens, table, draw)
+        infos = tree_arg_infos(self.weights, "param")
+        infos += tree_arg_infos(self.k_pages, "cache", prefix="k_pages",
+                                donated=donate)
+        infos += tree_arg_infos(self.v_pages, "cache", prefix="v_pages",
+                                donated=donate)
+        for nm, v in (("tokens", tokens), ("lens", lens),
+                      ("table", table), ("draw", draw)):
+            infos += tree_arg_infos(v, "input", prefix=nm)
+        return LoweredProgram(traced.lower().as_text(),
+                              jaxpr=traced.jaxpr, name="decode_step",
+                              arg_infos=infos)
+
     def decode(self, tokens, lens, table, return_probs=False):
         """One decode step for all slots (greedy, or the configured
         sampling with deterministic per-(seed, round, slot) keys).

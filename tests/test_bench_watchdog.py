@@ -82,6 +82,87 @@ class TestRecordFailure:
         assert len(extras["k"]) <= 160
 
 
+class TestDeviceProbe:
+    def test_first_probe_hang_short_circuits(self, monkeypatch):
+        """A probe HANG means the tunnel is down: no 4x45s retry burn
+        (r5 spent ~11 min reaching the cached-campaign fallback)."""
+        calls = []
+
+        def hang(*a, **kw):
+            calls.append(kw.get("timeout"))
+            raise subprocess.TimeoutExpired(cmd="probe",
+                                            timeout=kw.get("timeout"))
+        monkeypatch.setattr(subprocess, "run", hang)
+        monkeypatch.setattr(time, "sleep",
+                            lambda s: pytest.fail("slept on a hang"))
+        err = bench._device_watchdog(timeout_s=1)
+        assert err is not None
+        assert "fast-fail on first probe" in err
+        assert calls == [1]          # exactly one probe, no retries
+
+    def test_error_then_hang_short_circuits(self, monkeypatch):
+        """A hang fast-fails on ANY probe, not just the first: an
+        error-exit flap followed by a hang must not burn the remaining
+        retry budget (each retry would hang the same 150s way)."""
+        monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", "4")
+        calls = []
+
+        class P:
+            returncode = 1
+            stderr = "flap"
+
+        def flap_then_hang(*a, **kw):
+            calls.append(1)
+            if len(calls) == 1:
+                return P()
+            raise subprocess.TimeoutExpired(cmd="probe",
+                                            timeout=kw.get("timeout"))
+        monkeypatch.setattr(subprocess, "run", flap_then_hang)
+        slept = []
+        monkeypatch.setattr(time, "sleep", lambda s: slept.append(s))
+        err = bench._device_watchdog(timeout_s=1, backoff_s=5)
+        assert len(calls) == 2 and slept == [5]   # one retry, then hang
+        assert "fast-fail on probe 2 hang" in err
+
+    def test_probe_timeout_env_is_honored(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "7")
+        monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", "1")
+        seen = []
+
+        def hang(*a, **kw):
+            seen.append(kw.get("timeout"))
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=7)
+        monkeypatch.setattr(subprocess, "run", hang)
+        err = bench._device_watchdog()
+        assert seen == [7] and "hung >7s" in err
+
+    def test_error_exits_still_retry(self, monkeypatch):
+        """Nonzero-exit probes are transient flaps: the retry budget
+        (env-tunable) still applies — only hangs fast-fail."""
+        monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", "3")
+        calls = []
+
+        class P:
+            returncode = 1
+            stderr = "boom"
+
+        monkeypatch.setattr(subprocess, "run",
+                            lambda *a, **kw: calls.append(1) or P())
+        slept = []
+        monkeypatch.setattr(time, "sleep", lambda s: slept.append(s))
+        err = bench._device_watchdog(backoff_s=5)
+        assert len(calls) == 3 and slept == [5, 5]
+        assert "after 3 attempts" in err
+
+    def test_healthy_probe_returns_none(self, monkeypatch):
+        class P:
+            returncode = 0
+            stderr = ""
+
+        monkeypatch.setattr(subprocess, "run", lambda *a, **kw: P())
+        assert bench._device_watchdog(timeout_s=5) is None
+
+
 class TestCachedCampaign:
     def test_keeps_strongest_variants_not_most_recent(self, tmp_path):
         p = tmp_path / "sweep.jsonl"

@@ -214,6 +214,83 @@ def test_trainer_analysis_program_captures_roles_and_donation():
     assert report2.by_rule("MEM-NO-DONATION")
 
 
+def test_kv_cache_donation_lint_planted_defect():
+    """MEM-NO-DONATION's decode-loop extension: the serving decoder's
+    REAL decode step (cache donated via donate_argnums) lints clean,
+    and the planted-defect variant (donate=False — the cache copied
+    every step) trips MEM-NO-DONATION-KVCACHE. Params being non-donated
+    must NOT fire anything in a decode program: they're read-only
+    there, the cache is the carried state."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import PagedGPTDecoder
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = GPT(gpt_tiny(max_seq_len=64))
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=8, page_size=16, max_batch=2)
+    pm = PassManager(["memory"])
+
+    good = dec.analysis_program(donate=True)
+    cache_infos = [i for i in good.arg_infos if i.role == "cache"]
+    assert cache_infos and all(i.donated for i in cache_infos)
+    report = pm.run(good, AnalysisContext(name="decode"))
+    assert report.by_rule("MEM-NO-DONATION-KVCACHE") == []
+    assert report.by_rule("MEM-NO-DONATION") == []
+
+    # planted defect: same program with the cache's donation dropped
+    # (what analysis_program(donate=False) captures — the lint reads
+    # arg_infos, so flipping them spares a second trace in tier-1)
+    from dataclasses import replace
+    infos = [replace(i, donated=False) if i.role == "cache" else i
+             for i in good.arg_infos]
+    from paddle_tpu.analysis.lowering import LoweredProgram
+    defective = LoweredProgram(good.text, jaxpr=good.jaxpr,
+                               name="decode_step", arg_infos=infos)
+    report2 = pm.run(defective, AnalysisContext(name="decode"))
+    hits = report2.by_rule("MEM-NO-DONATION-KVCACHE")
+    assert hits and "KV-cache" in hits[0].message
+    assert report2.by_rule("MEM-NO-DONATION") == []
+
+    # PARTIAL defect: k_pages donated but v_pages forgotten — half the
+    # store still double-buffers, so the rule must check per arg, not
+    # any(); the finding names the forgotten bufs
+    partial = [replace(i, donated=not (i.name or "").startswith("v_"))
+               if i.role == "cache" else i for i in good.arg_infos]
+    defective3 = LoweredProgram(good.text, jaxpr=good.jaxpr,
+                                name="decode_step", arg_infos=partial)
+    report3 = pm.run(defective3, AnalysisContext(name="decode"))
+    hits3 = report3.by_rule("MEM-NO-DONATION-KVCACHE")
+    assert hits3 and "v_pages" in hits3[0].message
+    assert "k_pages" not in hits3[0].message
+
+
+def test_kv_cache_rule_matches_names_without_role():
+    """Programs captured outside serving (raw jit decode loops) are
+    still caught by the k_pages/v_pages/cache name heuristic."""
+    big = jnp.zeros((4, 64, 64), jnp.float32)
+
+    def step(k_pages, x):
+        return k_pages + x, (x * 2.0).sum()
+
+    traced = jax.jit(step).trace(big, big)
+    infos = [ArgInfo(name="k_pages", role="input", shape=big.shape,
+                     dtype="float32", bytes=big.nbytes),
+             ArgInfo(name="x", role="batch", shape=big.shape,
+                     dtype="float32", bytes=big.nbytes)]
+    from paddle_tpu.analysis.lowering import LoweredProgram
+    program = LoweredProgram(traced.lower().as_text(),
+                             jaxpr=traced.jaxpr, arg_infos=infos)
+    pm = PassManager(["memory"])
+    report = pm.run(program, AnalysisContext(name="loop"))
+    assert report.by_rule("MEM-NO-DONATION-KVCACHE")
+    infos[0].donated = True
+    report2 = pm.run(program, AnalysisContext(name="loop"))
+    assert report2.by_rule("MEM-NO-DONATION-KVCACHE") == []
+
+
 def test_debug_memory_report_front_doors(capsys):
     """debug.memory_report works for a Layer and prints the breakdown."""
     import paddle_tpu as paddle

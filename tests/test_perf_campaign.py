@@ -83,7 +83,7 @@ def test_gpt_stage_resumes_past_banked_trials(campaign_dir, monkeypatch):
         ran.append((bs, remat_policy, grad_accum))
         return 16000.0, 0.64, 1.3e9, 0
     monkeypatch.setattr(bench, "run_config", fake_run_config)
-    pc.run_gpt()
+    pc.run_gpt(exhaustive=True)
     # banked bs4/bs6 skipped; new accum2 + wedge-quarantined configs
     # run, bs8 last
     assert ran == [(6, "dots", 2), (7, "dots", 1), (8, "dots", 2),
@@ -91,8 +91,102 @@ def test_gpt_stage_resumes_past_banked_trials(campaign_dir, monkeypatch):
     assert any(r.get("config") == "gpt_stage_done" for r in _rows())
     # retry: the accum2 rows now banked (matched WITH the accum key)
     ran.clear()
-    pc.run_gpt()
+    pc.run_gpt(exhaustive=True)
     assert ran == []
+
+
+# the advisor's real static ranking of GPT_GRID (verified at full scale
+# by tests/test_remat_advisor.py::test_rank_gpt_1p3b_matches_measured_best
+# and the committed docs/performance.md table): bs6/dots first
+_ADVISOR_TOP2 = [("gpt_1p3b", 6, "dots", 1), ("gpt_1p3b", 4, "dots", 1)]
+
+
+def _autotune_module():
+    # `paddle_tpu.analysis.autotune` the ATTRIBUTE is the re-exported
+    # function (package __init__ shadows the submodule); fetch the
+    # module itself for monkeypatching
+    import importlib
+    return importlib.import_module("paddle_tpu.analysis.autotune")
+
+
+@pytest.fixture
+def static_advisor(monkeypatch):
+    """Pin the advisor's selection so the plumbing test doesn't trace
+    1.3B probes inside tier-1 (the ranking itself has its own tests)."""
+    monkeypatch.setattr(_autotune_module(), "rank_gpt_candidates",
+                        lambda grid, top=2, **kw: list(_ADVISOR_TOP2)[:top])
+
+
+def test_advisor_measures_at_most_half_the_grid(campaign_dir,
+                                                static_advisor,
+                                                monkeypatch):
+    """Acceptance: the advisor-gated gpt stage measures <= half the
+    candidate grid and reports the same best config as --exhaustive on
+    the same cached results."""
+    import bench
+
+    ran = []
+
+    def fake_run_config(name, bs, seq, remat_policy=None, grad_accum=1):
+        ran.append((bs, remat_policy, grad_accum))
+        mfu = {(6, "dots", 1): 0.6414, (4, "dots", 1): 0.623}.get(
+            (bs, remat_policy, grad_accum), 0.5)
+        return mfu * 25000, mfu, 1.3e9, 0
+    monkeypatch.setattr(bench, "run_config", fake_run_config)
+
+    pc.run_gpt()                       # advisor mode (default)
+    assert len(ran) == 2 <= len(pc.GPT_GRID) // 2
+    assert set(ran) == {(6, "dots", 1), (4, "dots", 1)}
+    best_advisor = pc.best_gpt_config()
+    assert (best_advisor["bs"], best_advisor["remat"]) == (6, "dots")
+
+    # exhaustive on the SAME results file: measures the rest, best
+    # config unchanged
+    ran.clear()
+    pc.run_gpt(exhaustive=True)
+    assert len(ran) == len(pc.GPT_GRID) - 2   # advisor's picks banked
+    best_full = pc.best_gpt_config()
+    assert (best_full["bs"], best_full["remat"]) == \
+        (best_advisor["bs"], best_advisor["remat"])
+
+
+def test_advisor_all_banked_widens_to_full_grid(campaign_dir,
+                                                static_advisor,
+                                                monkeypatch):
+    """A repeat advisor-mode run whose top-2 are already banked widens
+    to the full grid — the other 4 points stay reachable without the
+    operator having to know about --exhaustive."""
+    import bench
+
+    ran = []
+    monkeypatch.setattr(
+        bench, "run_config",
+        lambda name, bs, seq, remat_policy=None, grad_accum=1:
+        ran.append((bs, remat_policy, grad_accum)) or (1.0, 0.1, 1, 0))
+    pc.run_gpt()                                # measures the top-2
+    assert len(ran) == 2
+    ran.clear()
+    pc.run_gpt()                                # top-2 banked -> widen
+    assert len(ran) == len(pc.GPT_GRID) - 2
+    ran.clear()
+    pc.run_gpt()                                # everything banked now
+    assert ran == []
+
+
+def test_advisor_failure_falls_back_to_full_grid(campaign_dir,
+                                                 monkeypatch):
+    import bench
+
+    def boom(*a, **kw):
+        raise RuntimeError("probe exploded")
+    monkeypatch.setattr(_autotune_module(), "rank_gpt_candidates", boom)
+    ran = []
+    monkeypatch.setattr(
+        bench, "run_config",
+        lambda name, bs, seq, remat_policy=None, grad_accum=1:
+        ran.append((bs, remat_policy, grad_accum)) or (1.0, 0.1, 1, 0))
+    pc.run_gpt()
+    assert len(ran) == len(pc.GPT_GRID)
 
 
 def test_all_errored_stage_stays_unbanked(campaign_dir, monkeypatch):

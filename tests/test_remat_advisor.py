@@ -1,0 +1,277 @@
+"""What-if remat replay validation — the acceptance proof that the
+advisor's replayed peaks track reality.
+
+The core test lowers the SAME transformer block stack twice: once plain
+and once with jax.checkpoint(policy=...) actually applied per block,
+measures the rematted program's liveness peak with the Memory Doctor,
+and pins the replay's prediction (made from the PLAIN trace alone)
+within 20% — for multiple policies. Everything here is host-side
+tracing; no compiles.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import estimate_jaxpr_memory
+from paddle_tpu.analysis.remat_advisor import (
+    BENCH_POLICY_NAMES, advise_remat, canonical_policy, find_boundary,
+    replay_remat, saveable_predicate)
+
+L, B, S, H, NH = 4, 8, 128, 256, 4
+D = H // NH
+
+_JAX_POLICIES = {
+    "none": None,
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def _mkw(rng):
+    return dict(
+        ln1=(jnp.ones(H), jnp.zeros(H)),
+        qkv=jnp.asarray(rng.randn(H, 3 * H) * 0.02, jnp.float32),
+        proj=jnp.asarray(rng.randn(H, H) * 0.02, jnp.float32),
+        ln2=(jnp.ones(H), jnp.zeros(H)),
+        fc1=jnp.asarray(rng.randn(H, 4 * H) * 0.02, jnp.float32),
+        fc2=jnp.asarray(rng.randn(4 * H, H) * 0.02, jnp.float32))
+
+
+def _ln(x, w, b):
+    mu = x.mean(-1, keepdims=True)
+    v = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(v + 1e-5) * w + b
+
+
+def _block(w, x):
+    y = _ln(x, *w["ln1"])
+    qkv = (y @ w["qkv"]).reshape(B, S, 3, NH, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = (q @ jnp.swapaxes(k, -1, -2)) / np.sqrt(D)
+    s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    a = jnp.swapaxes(jax.nn.softmax(s, axis=-1) @ v, 1, 2).reshape(B, S, H)
+    x = x + a @ w["proj"]
+    y = _ln(x, *w["ln2"])
+    return x + jax.nn.gelu(y @ w["fc1"], approximate=True) @ w["fc2"]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    rng = np.random.RandomState(0)
+    ws = [_mkw(rng) for _ in range(L)]
+    x0 = jnp.asarray(rng.randn(B, S, H), jnp.float32)
+    cache = {}
+
+    def net(policy):
+        blk = _block if policy is None else \
+            partial(jax.checkpoint, policy=policy)(_block)
+
+        def f(ws, x):
+            for w in ws:
+                x = blk(w, x)
+            return jnp.sum(jnp.square(x.astype(jnp.float32))) / x.size
+        return f
+
+    def trace(policy):
+        # tracing is the whole cost of this module — share per policy
+        key = getattr(policy, "__name__", policy)
+        if key not in cache:
+            cache[key] = jax.jit(jax.value_and_grad(net(policy))).trace(
+                ws, x0)
+        return cache[key]
+
+    return trace
+
+
+@pytest.mark.parametrize("policy", ["full", "dots_with_no_batch_dims"])
+def test_replay_matches_actually_rematted_program(stack, policy):
+    """Acceptance: replayed peak within 20% of the Memory Doctor's
+    measured liveness peak of the program with jax.checkpoint(policy)
+    REALLY applied per block."""
+    measured = estimate_jaxpr_memory(
+        stack(_JAX_POLICIES[policy]).jaxpr).peak_bytes
+    replayed = replay_remat(stack(None).jaxpr, policy, segments=L)
+    assert abs(replayed.peak_bytes - measured) <= 0.20 * measured, (
+        policy, replayed.peak_bytes, measured,
+        replayed.peak_bytes / measured)
+
+
+def test_replay_none_is_identity(stack):
+    base = estimate_jaxpr_memory(stack(None).jaxpr).peak_bytes
+    r = replay_remat(stack(None).jaxpr, "none", segments=L)
+    assert r.peak_bytes == base
+    assert r.recompute_flops == 0 and r.dropped_bytes == 0
+
+
+def test_replay_orders_policies_and_prices_recompute(stack):
+    """Qualitative pins that survive model drift: every remat policy
+    sits below the no-remat peak; 'full' recomputes ~the whole forward
+    (~33% of the 3x-forward step) while 'dots' recomputes only the
+    cheap elementwise tail. ('dots' rides the same cached no-remat
+    trace — the measured-vs-replayed cross-check above keeps to two
+    policies to hold the tier-1 time budget.)"""
+    by = {r.policy: r for r in advise_remat(stack(None).jaxpr, segments=L)}
+    assert by["full"].peak_bytes < by["none"].peak_bytes
+    assert by["dots"].peak_bytes < by["none"].peak_bytes
+    assert 25.0 < by["full"].recompute_pct < 40.0
+    assert by["dots"].recompute_pct < 5.0
+    assert by["dots"].recompute_pct <= \
+        by["dots_with_no_batch_dims"].recompute_pct
+    # advice line: the exact "peak X -> Y, +Z%" shape the CLI prints
+    import re
+    assert re.match(r"remat=full: peak [\d.]+ GiB → [\d.]+ GiB per "
+                    r"device, \+[\d.]+% recompute FLOPs",
+                    by["full"].advice)
+
+
+def test_boundary_detection_value_and_grad(stack):
+    jx = stack(None).jaxpr.jaxpr
+    b = find_boundary(jx)
+    assert 0 < b < len(jx.eqns) - 1
+    # the loss is defined at the boundary; grads all come later
+    defs = {}
+    for i, eqn in enumerate(jx.eqns):
+        for v in eqn.outvars:
+            defs[v] = i
+    grad_defs = [defs[v] for v in jx.outvars[1:] if v in defs]
+    assert all(g > b for g in grad_defs)
+
+
+def test_policy_aliases_and_predicates():
+    assert canonical_policy("nothing_saveable") == "full"
+    assert canonical_policy("dots_saveable") == "dots"
+    assert BENCH_POLICY_NAMES["dots"] == "dots_with_no_batch_dims"
+    with pytest.raises(KeyError):
+        canonical_policy("everything")
+    # dots_with_no_batch_dims keeps plain matmuls, drops batched ones
+    x = jnp.zeros((2, 8, 8))
+    plain = jax.make_jaxpr(lambda a, b: a @ b)(
+        jnp.zeros((8, 8)), jnp.zeros((8, 8))).jaxpr.eqns[-1]
+    batched = jax.make_jaxpr(lambda a, b: jnp.einsum("bij,bjk->bik",
+                                                     a, b))(x, x).jaxpr
+    batched = [e for e in batched.eqns
+               if e.primitive.name == "dot_general"][-1]
+    nb = saveable_predicate("dots_with_no_batch_dims")
+    assert nb(plain) and not nb(batched)
+    assert saveable_predicate("dots")(batched)
+
+
+# ------------------------------------------------- trainer front doors
+
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import GPT, GPTPretrainingCriterion
+    from paddle_tpu.models import gpt as gpt_mod
+
+    paddle.seed(0)
+    # single-device mesh: the advisor prices per chip, and the monotone
+    # test below must not have some batch sizes silently dp-sharded by
+    # the test harness's 8-virtual-device CPU platform
+    build_mesh(dp=1, devices=jax.devices()[:1])
+    cfg = gpt_mod.gpt_tiny(max_seq_len=128, remat_policy="dots")
+    model = GPT(cfg)
+    model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=2e-4,
+                                 accumulator_dtype="bfloat16")
+
+    def loss_fn(m, b):
+        logits = m(paddle.to_tensor(b["input_ids"]))
+        return crit(logits, paddle.to_tensor(b["labels"]))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 129))
+    batch = {"input_ids": ids[:, :-1].astype("int32"),
+             "labels": ids[:, 1:].astype("int32")}
+    return Trainer(model, opt, loss_fn), batch
+
+
+@pytest.fixture(scope="module")
+def tiny_report(tiny_trainer):
+    """One suggest_config sweep shared by the ranking and monotonicity
+    tests (each candidate batch size costs a full step trace)."""
+    trainer, batch = tiny_trainer
+    return trainer.suggest_config(batch, batch_sizes=(2, 4, 8))
+
+
+def test_trainer_suggest_config_ranks_and_advises(tiny_trainer,
+                                                  tiny_report):
+    trainer, batch = tiny_trainer
+    rep = tiny_report
+    assert rep.best is not None and rep.best.feasible
+    assert rep.advice and all("recompute FLOPs" in a for a in rep.advice)
+    # per-policy advice exists for the example batch size
+    assert any(a.startswith("remat=dots") for a in rep.advice)
+    # tracing with remat disabled must not leak into the trainer's
+    # compiled-step cache or flip the model config
+    assert trainer.model.cfg.remat is True
+    assert trainer._placed_steps == {}
+
+
+def test_predicted_step_time_monotone_in_microbatch(tiny_report):
+    """Acceptance sanity: predicted step time grows with microbatch
+    size for every policy (compute and HBM legs both scale with B)."""
+    rep = tiny_report
+    per_policy = {}
+    for c in rep.candidates:
+        per_policy.setdefault(c.policy, {})[c.batch] = c.step_s
+    for policy, d in per_policy.items():
+        assert list(d) and sorted(d) == [2, 4, 8], policy
+        assert d[2] < d[4] < d[8], (policy, d)
+
+
+def test_debug_autotune_front_door(tiny_trainer, capsys):
+    import paddle_tpu as paddle
+    trainer, batch = tiny_trainer
+    rep = paddle.debug.autotune(trainer, batch=batch,
+                                batch_sizes=(4,))
+    out = capsys.readouterr().out
+    assert "autotune:" in out and "recompute FLOPs" in out
+    assert rep.best is not None
+    with pytest.raises(ValueError):
+        paddle.debug.autotune(trainer)
+
+
+def test_hbm_budget_prunes(tiny_trainer):
+    trainer, batch = tiny_trainer
+    rep = trainer.suggest_config(batch, batch_sizes=(4,),
+                                 hbm_budget=1)   # nothing fits 1 byte
+    assert rep.best is None
+    assert all(not c.feasible for c in rep.candidates)
+
+
+def test_rank_gpt_candidates_mechanism():
+    """Grid ranking at gpt_tiny scale: returns `top` entries from the
+    grid, feasible-and-fastest first (the full-1.3B ranking is the
+    slow-marked test below)."""
+    from paddle_tpu.analysis.autotune import rank_gpt_candidates
+    # one probe microbatch (accum entry included: 4//2 = 2) keeps this
+    # to two host-side traces
+    grid = [("gpt_tiny", 2, "dots", 1), ("gpt_tiny", 2, "full", 1),
+            ("gpt_tiny", 4, "dots", 2)]
+    top = rank_gpt_candidates(grid, seq=64, top=2, probe_layers=(2, 3))
+    assert len(top) == 2
+    assert all(e in grid for e in top)
+
+
+@pytest.mark.slow
+def test_rank_gpt_1p3b_matches_measured_best():
+    """Acceptance (full scale): on the real campaign grid the advisor
+    ranks the measured-best (bs=6, remat=dots) in its top 2 from static
+    analysis alone."""
+    from paddle_tpu.analysis.autotune import rank_gpt_candidates
+    grid = [("gpt_1p3b", 4, "dots", 1), ("gpt_1p3b", 6, "dots", 1),
+            ("gpt_1p3b", 6, "dots", 2), ("gpt_1p3b", 7, "dots", 1),
+            ("gpt_1p3b", 8, "dots", 2), ("gpt_1p3b", 8, "full", 1)]
+    top = rank_gpt_candidates(grid, top=2)
+    assert ("gpt_1p3b", 6, "dots", 1) in top

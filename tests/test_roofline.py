@@ -1,0 +1,129 @@
+"""cost_model's offline pricing: chip-spec resolution, analytic jaxpr
+FLOPs, the max(compute, HBM, wire) roofline, and the ICI/DCN wire-byte
+split for host-crossing mesh axes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.cost_model import (CHIP_SPECS, ChipSpec, axis_host_count,
+                                   chip_spec, collective_wire_bytes,
+                                   collective_wire_split, eqn_flops,
+                                   jaxpr_flops, roofline_step_time)
+
+
+class TestChipSpec:
+    def test_device_kind_strings_resolve(self):
+        assert chip_spec("TPU v5 lite").name == "v5e"
+        assert chip_spec("TPU v6 lite").name == "v6e"   # before 'lite'
+        assert chip_spec("TPU v5p").name == "v5p"
+        assert chip_spec("TPU v4").name == "v4"
+        assert chip_spec("v5e") is CHIP_SPECS["v5e"]
+
+    def test_cpu_defaults_to_v5e(self):
+        # no-TPU environments price for the campaign's reference chip
+        assert chip_spec().name == "v5e"
+        assert chip_spec("cpu").name == "v5e"
+
+    def test_bench_delegates_to_the_same_table(self):
+        import bench
+        assert bench.chip_peak_flops() == chip_spec().peak_flops
+        assert bench.chip_hbm_bw() == chip_spec().hbm_bw
+
+
+class TestJaxprFlops:
+    def test_matmul_exact(self):
+        m, k, n = 8, 16, 32
+        jx = jax.make_jaxpr(lambda a, b: a @ b)(
+            jnp.zeros((m, k)), jnp.zeros((k, n)))
+        assert jaxpr_flops(jx) == 2 * m * k * n
+
+    def test_batched_matmul_counts_batch(self):
+        b, m, k, n = 4, 8, 16, 32
+        jx = jax.make_jaxpr(
+            lambda a, c: jnp.einsum("bmk,bkn->bmn", a, c))(
+            jnp.zeros((b, m, k)), jnp.zeros((b, k, n)))
+        dot = [e for e in jx.jaxpr.eqns
+               if e.primitive.name == "dot_general"][0]
+        assert eqn_flops(dot) == 2 * b * m * k * n
+
+    def test_scan_multiplies_by_trip_count(self):
+        def body(c, _):
+            return c @ c, None
+
+        def f(x):
+            out, _ = jax.lax.scan(body, x, None, length=7)
+            return out
+        jx = jax.make_jaxpr(f)(jnp.zeros((8, 8)))
+        assert jaxpr_flops(jx) == 7 * 2 * 8 * 8 * 8
+
+    def test_elementwise_is_cheap(self):
+        jx = jax.make_jaxpr(lambda a: a + 1.0)(jnp.zeros((16, 16)))
+        assert jaxpr_flops(jx) == 16 * 16
+
+
+class TestRoofline:
+    def test_bound_classification(self):
+        chip = ChipSpec("t", peak_flops=1e12, hbm_bw=1e9,
+                        hbm_bytes=1 << 30, ici_bw=1e9, dcn_bw=1e8)
+        rt = roofline_step_time(1e12, 1e3, chip=chip, mxu_efficiency=1.0)
+        assert rt.bound == "compute" and rt.step_s == pytest.approx(1.0)
+        rt = roofline_step_time(1e3, 1e9, chip=chip)
+        assert rt.bound == "hbm" and rt.step_s == pytest.approx(1.0)
+        rt = roofline_step_time(1e3, 1e3, ici_bytes=1e9, chip=chip)
+        assert rt.bound == "wire"
+
+    def test_step_time_is_max_of_legs(self):
+        rt = roofline_step_time(1e12, 1e9, chip="v5e")
+        assert rt.step_s == max(rt.compute_s, rt.hbm_s, rt.wire_s)
+
+
+class TestWireSplit:
+    def test_single_host_is_all_ici(self):
+        s = collective_wire_split("all_reduce", 1 << 20, 8, host_count=1)
+        assert s["dcn"] == 0
+        assert s["ici"] == collective_wire_bytes("all_reduce", 1 << 20, 8)
+
+    def test_two_host_dp_mesh_pin(self):
+        """The ROADMAP multi-host item: dp=8 over 2 hosts, all_reduce of
+        a 1 MiB payload. Ring wire = 2*(7/8)*P per device; 2 of the 8
+        hops cross DCN, so exactly 2/8 of the volume prices at DCN."""
+        payload = 1 << 20
+        total = collective_wire_bytes("all_reduce", payload, 8)
+        assert total == int(2 * (7 / 8) * payload)
+        s = collective_wire_split("all_reduce", payload, 8, host_count=2)
+        assert s["dcn"] == int(total * 2 / 8)
+        assert s["ici"] + s["dcn"] == total
+        # jaxpr alias vocabulary works here too
+        s2 = collective_wire_split("psum", payload, 8, host_count=2)
+        assert s2 == s
+
+    def test_degenerate_groups(self):
+        assert collective_wire_split("all_reduce", 1 << 20, 1,
+                                     host_count=4) == {"ici": 0, "dcn": 0}
+        assert collective_wire_split("all_reduce", 0, 8,
+                                     host_count=2) == {"ici": 0, "dcn": 0}
+
+    def test_axis_host_count_duck_typed_mesh(self):
+        class Dev:
+            def __init__(self, proc):
+                self.process_index = proc
+
+        class FakeMesh:
+            axis_names = ("dp", "tp")
+            # dp=4 spans 2 hosts (2 chips per host); tp=2 chip-local
+            devices = np.array(
+                [[Dev(0), Dev(0)], [Dev(0), Dev(0)],
+                 [Dev(1), Dev(1)], [Dev(1), Dev(1)]])
+
+        m = FakeMesh()
+        assert axis_host_count(m, "dp") == 2
+        assert axis_host_count(m, "tp") == 1
+        assert axis_host_count(m, "ep") == 1      # unknown axis
+        assert axis_host_count(None, "dp") == 1   # robustness
+
+    def test_live_single_process_mesh_is_chip_local(self):
+        from paddle_tpu.distributed import build_mesh
+        mesh = build_mesh(dp=1)
+        for a in mesh.axis_names:
+            assert axis_host_count(mesh, a) == 1

@@ -1,0 +1,92 @@
+"""The lint-tuning CI gate: every BASELINE config's static remat-advice
+manifest (tuning_manifests/<config>.json — what-if peak + recompute %
+per policy, roofline ranking against the fixed v5e spec) must match the
+committed file, and the CLI's --check must cover tuning drift.
+
+Runs inside the standard tier-1 sweep; select alone with
+`-m lint_tuning`. Reports ride the per-process cache in
+paddle_tpu.analysis.baseline (one grad trace per config)."""
+import re
+
+import pytest
+
+from paddle_tpu.analysis import (build_tuning_manifest,
+                                 load_tuning_manifest, manifest_drift)
+from paddle_tpu.analysis.baseline import BASELINE_CONFIGS, tuning_report
+
+pytestmark = pytest.mark.lint_tuning
+
+_ADVICE_RE = re.compile(
+    r"remat=[\w-]+: peak [\d.]+ GiB → [\d.]+ GiB per device, "
+    r"\+[\d.]+% recompute FLOPs")
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_tuning_manifest_is_committed_and_current(name):
+    committed = load_tuning_manifest(name)
+    assert committed is not None, (
+        f"tuning_manifests/{name}.json is not committed — run "
+        "python -m paddle_tpu.analysis --write-manifests")
+    fresh = build_tuning_manifest(name, tuning_report(name))
+    drift = manifest_drift(fresh, committed)
+    assert drift == [], "\n".join(drift)
+
+
+@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+def test_tuning_report_shape(name):
+    """Structural pins that outlive re-baselining: all four policies
+    priced, positive peaks, a full ranking, recompute ordered
+    none=0 <= dots <= full, and CLI-shaped advice lines."""
+    rep = tuning_report(name)
+    by = {c.policy: c for c in rep.candidates}
+    assert set(by) == {"none", "full", "dots", "dots_with_no_batch_dims"}
+    assert all(c.peak_bytes > 0 for c in rep.candidates)
+    assert by["none"].recompute_pct == 0.0
+    assert by["dots"].recompute_pct <= by["full"].recompute_pct
+    assert 20.0 <= by["full"].recompute_pct <= 40.0
+    assert len(rep.advice) == 4
+    for line in rep.advice:
+        assert _ADVICE_RE.match(line), line
+
+
+def test_manifest_drift_detects_tampering():
+    committed = load_tuning_manifest("gpt")
+    assert committed is not None
+    tampered = dict(committed, best="definitely-not-a-policy")
+    assert manifest_drift(committed, committed) == []
+    drift = manifest_drift(committed, tampered)
+    assert drift and any("best" in d for d in drift)
+    assert manifest_drift(committed, None)   # missing file is drift
+
+
+def test_cli_check_covers_tuning_drift(tmp_path, monkeypatch, capsys):
+    """--check exits 1 when ONLY the tuning manifest is stale (lint and
+    memory current), proving the new family is inside the CI gate."""
+    from paddle_tpu.analysis import __main__ as cli
+    from paddle_tpu.analysis import manifest as mf
+
+    assert cli.main(["gpt", "--check"]) == 0
+    capsys.readouterr()
+
+    real = mf.load_tuning_manifest
+
+    def stale(name):
+        data = real(name)
+        if data:
+            data = dict(data, best="stale-policy")
+        return data
+    monkeypatch.setattr(mf, "load_tuning_manifest", stale)
+    # the package re-exports the symbol; patch the import site too
+    import paddle_tpu.analysis as pkg
+    monkeypatch.setattr(pkg, "load_tuning_manifest", stale)
+    assert cli.main(["gpt", "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "STALE" in out and "tuning" in out
+
+
+def test_cli_autotune_prints_table(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["gpt", "--autotune"]) == 0
+    out = capsys.readouterr().out
+    assert "autotune: gpt" in out
+    assert "recompute FLOPs" in out
