@@ -40,6 +40,9 @@ def main():
     model.eval()
     dec = PagedGPTDecoder(model, num_pages=64, page_size=16, max_batch=4,
                           temperature=0.8, top_p=0.95, seed=0, quant=quant)
+    # k_max defaults to cost_model.decode_horizon's priced K: blocks of
+    # K decode ticks run device-resident (one compiled lax.scan), the
+    # host syncing only at block boundaries for admission/retirement
     eng = ContinuousBatchingEngine(dec, max_new_tokens=16)
 
     prompts = ["the quick brown fox", "tpu chips compile fast",
@@ -53,8 +56,13 @@ def main():
         toks = [t % dec.cfg.vocab_size for t in outs[rid]]
         print(f"{p!r} -> {len(outs[rid])} tokens in "
               f"{eng.steps} engine ticks: {toks[:8]}...")
-    print(f"served {len(prompts)} prompts through "
-          f"{dec.max_batch}-slot continuous batching")
+    s = paddle.debug.serving_stats()[-1]
+    print(f"served {s['requests']} prompts through "
+          f"{dec.max_batch}-slot continuous batching: "
+          f"{s['tokens']} tokens, K={s['k_max']} multi-step horizons, "
+          f"{s['host_syncs_per_token']:.3f} host syncs/token "
+          f"(per-tick engine pays ~1), "
+          f"p50 {s.get('token_p50_ms', 0)} ms/token")
 
 
 if __name__ == "__main__":

@@ -1,7 +1,9 @@
 """CLI: ``python -m paddle_tpu.analysis [config ...] [options]``
 
 Runs the full analyzer catalog over BASELINE configs (default: all
-five) or any custom ``module.path:builder`` spec whose builder returns
+five, plus the PROGRAM configs — ready-made LoweredPrograms like the
+``gpt_decode`` fused serving loop) or any custom ``module.path:builder``
+spec whose builder returns
 ``(model, example_arrays[, AnalysisContext])``. Prints findings, checks
 drift against committed lint AND memory manifests, and with
 --write-manifests regenerates both. ``--memory`` adds the per-device
@@ -22,23 +24,28 @@ import sys
 
 
 def _build_spec(spec):
-    """(program, ctx, fwd) for a BASELINE name or module:builder spec."""
+    """(program, ctx, fwd, built) for a BASELINE/PROGRAM name or a
+    module:builder spec. `built` is the custom spec's (model, examples)
+    so later stages (--autotune) reuse the SAME build instead of
+    calling the builder a second time; None for named configs (their
+    builds are process-cached in baseline.py)."""
     from . import AnalysisContext, lower_layer
-    from .baseline import BASELINE_CONFIGS, lowered_program
-    if spec in BASELINE_CONFIGS:
-        return lowered_program(spec)
+    from .baseline import (BASELINE_CONFIGS, PROGRAM_CONFIGS,
+                           lowered_program)
+    if spec in BASELINE_CONFIGS or spec in PROGRAM_CONFIGS:
+        return lowered_program(spec) + (None,)
     if ":" not in spec:
         raise SystemExit(
             f"unknown config {spec!r} (known: "
-            f"{', '.join(sorted(BASELINE_CONFIGS))}) and not a "
-            "module:builder spec")
+            f"{', '.join(sorted(BASELINE_CONFIGS) + sorted(PROGRAM_CONFIGS))}"
+            ") and not a module:builder spec")
     mod_name, attr = spec.split(":", 1)
     builder = getattr(importlib.import_module(mod_name), attr)
     built = builder()
     model, examples = built[0], built[1]
     ctx = built[2] if len(built) > 2 else AnalysisContext(name=attr)
     program = lower_layer(model, *examples, name=ctx.name)
-    return program, ctx, type(model).forward
+    return program, ctx, type(model).forward, (model, examples, ctx)
 
 
 def _run_spec(spec, write, as_json, no_manifest, show_memory,
@@ -49,7 +56,7 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
     from .baseline import BASELINE_CONFIGS
 
     pm = PassManager()
-    program, ctx, fwd = _build_spec(spec)
+    program, ctx, fwd, built = _build_spec(spec)
     if not no_manifest and not write:
         # regeneration must be idempotent: checking the OLD manifest
         # while writing the new one would bake transition-run DRIFT
@@ -81,19 +88,28 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
         if show_memory:
             _print_memory(report)
         if show_autotune:
-            print(_tuning_report(spec))
+            from .baseline import PROGRAM_CONFIGS
+            if spec in PROGRAM_CONFIGS:
+                print(f"(no tuning report for program config {spec}: "
+                      "a decode loop has no grad step to remat)")
+            else:
+                print(_tuning_report(spec, built=built))
     return report
 
 
-def _tuning_report(spec):
-    """AutotuneReport for a BASELINE name (cached) or module:builder
-    spec (built fresh)."""
+def _tuning_report(spec, built=None):
+    """AutotuneReport for a BASELINE name (cached) or a module:builder
+    spec. Custom specs pass their ALREADY-BUILT (model, examples[, ctx])
+    through `built` so lint and tuning share one model build — without
+    it the CLI used to call the user's builder twice."""
     from .baseline import BASELINE_CONFIGS, tuning_report
     if spec in BASELINE_CONFIGS:
         return tuning_report(spec)
     from . import autotune_layer
-    mod_name, attr = spec.split(":", 1)
-    built = getattr(importlib.import_module(mod_name), attr)()
+    _, attr = spec.split(":", 1)
+    if built is None:
+        mod_name, attr = spec.split(":", 1)
+        built = getattr(importlib.import_module(mod_name), attr)()
     return autotune_layer(built[0], *built[1], name=attr)
 
 
@@ -133,7 +149,7 @@ def _check_manifests(names):
     pm = PassManager()
     n_bad = 0
     for name in names:
-        program, ctx, fwd = _build_spec(name)
+        program, ctx, fwd, _built = _build_spec(name)
         # no committed manifests on the context: the rebuild must see
         # exactly what --write-manifests would write
         report = pm.run_source(fwd, ctx)
@@ -197,14 +213,16 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from . import Severity, default_catalog
-    from .baseline import BASELINE_CONFIGS
+    from .baseline import BASELINE_CONFIGS, PROGRAM_CONFIGS
 
     if args.list:
         print("BASELINE configs: " + ", ".join(sorted(BASELINE_CONFIGS)))
+        print("PROGRAM configs: " + ", ".join(sorted(PROGRAM_CONFIGS)))
         print("analyzers: " + ", ".join(default_catalog()))
         return 0
 
-    names = args.configs or list(BASELINE_CONFIGS)
+    names = args.configs or \
+        list(BASELINE_CONFIGS) + list(PROGRAM_CONFIGS)
     if args.check:
         return 1 if _check_manifests(names) else 0
     worst = None

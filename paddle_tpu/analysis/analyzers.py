@@ -14,8 +14,8 @@ from .findings import Finding, Severity
 from .pass_manager import Analyzer, register_analyzer
 
 __all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
-           "GraphShapeAnalyzer", "CollectiveAnalyzer", "COLLECTIVE_OPS",
-           "MXU_OPS"]
+           "GraphShapeAnalyzer", "CollectiveAnalyzer", "ServingAnalyzer",
+           "COLLECTIVE_OPS", "MXU_OPS"]
 
 MXU_OPS = ("dot_general", "convolution")
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
@@ -122,6 +122,26 @@ _HOST_TARGET_RE = re.compile(
     r"@([\w.]*(?:callback|CallbackTo|host_to_device|device_to_host)[\w.]*)")
 
 
+def _host_transfer_ops(program, ctx):
+    """ONE detector for host traffic inside a compiled program, shared
+    by the HOST-* rules and the SERVE-HOST-SYNC-DECODE serving gate (a
+    new callback pattern or allowlist rule added here reaches both).
+    Returns (callbacks, data_ops): non-allowlisted host custom_calls as
+    (op, target) pairs, and raw infeed/outfeed/send/recv ops."""
+    callbacks = []
+    allow = tuple(ctx.host_callback_allow) + _device_custom_calls()
+    for op in program.ops_named("custom_call"):
+        m = _HOST_TARGET_RE.search(op.line)
+        if not m:
+            continue
+        target = m.group(1)
+        if any(a in target for a in allow):
+            continue
+        callbacks.append((op, target))
+    return callbacks, list(program.ops_named("infeed", "outfeed",
+                                             "send", "recv"))
+
+
 @register_analyzer
 class HostTransferAnalyzer(Analyzer):
     """Device<->host transfers hiding inside a jit region: python
@@ -132,16 +152,8 @@ class HostTransferAnalyzer(Analyzer):
 
     def run(self, program, ctx):
         findings = []
-        n_callbacks = 0
-        allow = tuple(ctx.host_callback_allow) + _device_custom_calls()
-        for op in program.ops_named("custom_call"):
-            m = _HOST_TARGET_RE.search(op.line)
-            if not m:
-                continue
-            target = m.group(1)
-            if any(a in target for a in allow):
-                continue
-            n_callbacks += 1
+        callbacks, data_ops = _host_transfer_ops(program, ctx)
+        for op, target in callbacks:
             findings.append(Finding(
                 "HOST-CALLBACK", Severity.ERROR,
                 f"host python callback `{target}` inside the jit region",
@@ -149,18 +161,19 @@ class HostTransferAnalyzer(Analyzer):
                 suggested_fix="move the callback out of the compiled "
                 "step (log post-step from host) or switch to an "
                 "in-graph equivalent (debug.check_numerics)"))
-        for op in program.ops_named("infeed", "outfeed"):
-            findings.append(Finding(
-                "HOST-INFEED", Severity.ERROR,
-                f"{op.name} op in the jit region (host data dependency "
-                "per step)", op=op.line))
-        for op in program.ops_named("send", "recv"):
-            findings.append(Finding(
-                "HOST-SENDRECV", Severity.WARNING,
-                f"{op.name} op in the jit region", op=op.line))
+        for op in data_ops:
+            if op.name in ("infeed", "outfeed"):
+                findings.append(Finding(
+                    "HOST-INFEED", Severity.ERROR,
+                    f"{op.name} op in the jit region (host data "
+                    "dependency per step)", op=op.line))
+            else:
+                findings.append(Finding(
+                    "HOST-SENDRECV", Severity.WARNING,
+                    f"{op.name} op in the jit region", op=op.line))
         self.metrics = {
             "n_custom_calls": program.count("custom_call"),
-            "n_host_callbacks": n_callbacks,
+            "n_host_callbacks": len(callbacks),
         }
         return findings
 
@@ -226,6 +239,63 @@ class GraphShapeAnalyzer(Analyzer):
                         "GRAPH-MANIFEST-DRIFT", sev, msg,
                         suggested_fix="python -m paddle_tpu.analysis "
                         "--write-manifests (then review the diff)"))
+        return findings
+
+
+@register_analyzer
+class ServingAnalyzer(Analyzer):
+    """SERVE-HOST-SYNC-DECODE: a fused serving decode program (the
+    `PagedGPTDecoder.decode_multi` loop, context
+    extra["serving_decode"]=True) must be fully device-resident — zero
+    per-tick host transfers (a callback/infeed inside the K-tick scan
+    pays a host round-trip PER TOKEN, exactly the cost the fused loop
+    exists to kill) — and must keep the KV-cache donation the per-tick
+    step has (composes with MEM-NO-DONATION-KVCACHE: that rule warns on
+    any decode program; here an undonated cache in the HOT fused loop
+    is an ERROR, since every horizon would copy the whole paged store).
+    Metrics record the device-loop count so manifests pin that the K
+    ticks really lower to one while loop, not K unrolled dispatches."""
+    name = "serving"
+
+    def run(self, program, ctx):
+        if not ctx.extra.get("serving_decode"):
+            self.metrics = {"checked": False}
+            return []
+        findings = []
+        callbacks, data_ops = _host_transfer_ops(program, ctx)
+        n_host = len(callbacks) + len(data_ops)
+        for op, target in callbacks:
+            findings.append(Finding(
+                "SERVE-HOST-SYNC-DECODE", Severity.ERROR,
+                f"host transfer `{target}` inside the fused decode "
+                "loop — every tick re-interposes the host, the exact "
+                "per-token round-trip decode_multi exists to eliminate",
+                op=op.line,
+                suggested_fix="move the callback out of the decode "
+                "step; telemetry belongs at horizon sync points "
+                "(ServeStats), not inside the compiled loop"))
+        for op in data_ops:
+            findings.append(Finding(
+                "SERVE-HOST-SYNC-DECODE", Severity.ERROR,
+                f"{op.name} op inside the fused decode loop (host data "
+                "dependency per tick)", op=op.line))
+        from .memory import kv_cache_infos
+        cache = kv_cache_infos(getattr(program, "arg_infos", None) or [])
+        undonated = [i for i in cache if not i.donated]
+        if undonated:
+            names = ", ".join(sorted(i.name or "?" for i in undonated)[:4])
+            findings.append(Finding(
+                "SERVE-HOST-SYNC-DECODE", Severity.ERROR,
+                f"KV-cache state ({names}) is not donated into the "
+                "fused decode loop — every K-tick horizon would copy "
+                "the whole paged store",
+                suggested_fix="jit with donate_argnums on the k/v page "
+                "arguments (serving.PagedGPTDecoder.decode_multi does)"))
+        self.metrics = {"checked": True,
+                        "n_host_transfers": n_host,
+                        "n_device_loops": program.count("while"),
+                        "cache_donated": not undonated,
+                        "n_cache_args": len(cache)}
         return findings
 
 

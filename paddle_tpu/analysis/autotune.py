@@ -396,7 +396,7 @@ def rank_gpt_candidates(grid, seq=1024, top=2, probe_layers=(2, 3),
     Runs entirely on the host: build + trace + replay, no compile."""
     import numpy as np
 
-    from ..cost_model import chip_spec, roofline_step_time
+    from ..cost_model import chip_spec
     from .remat_advisor import BENCH_POLICY_NAMES, replay_remat
 
     chip = chip_spec(chip) if not hasattr(chip, "peak_flops") else chip
@@ -475,7 +475,7 @@ def rank_gpt_candidates(grid, seq=1024, top=2, probe_layers=(2, 3),
     span = L1 - L0
 
     def lerp(a, b):
-        return a + (full_L - L0) * (b - a) / span
+        return int(a + (full_L - L0) * (b - a) / span)
 
     scored = []
     for entry in grid:
@@ -484,21 +484,29 @@ def rank_gpt_candidates(grid, seq=1024, top=2, probe_layers=(2, 3),
         mb = bs // max(accum, 1)
         w0, batch_b = probe[(L0, mb, pol)]
         w1, _ = probe[(L1, mb, pol)]
-        peak = int(lerp(w0.peak_bytes, w1.peak_bytes))
-        flops = int(lerp(w0.step_flops + w0.recompute_flops,
-                         w1.step_flops + w1.recompute_flops))
-        state_b = int(lerp(state_by_L[L0], state_by_L[L1]))
-        params_b = int(lerp(params_by_L[L0], params_by_L[L1]))
-        act_b = int(lerp(
-            2 * (w0.saved_bytes + w0.boundary_bytes + w0.dropped_bytes),
-            2 * (w1.saved_bytes + w1.boundary_bytes + w1.dropped_bytes)))
-        opt_flops = 12 * max(params_b // 2, 1)
-        flops = accum * max(flops - opt_flops, 0) + opt_flops
-        hbm = 2 * state_b + accum * (batch_b + act_b)
-        if accum > 1:
-            peak += 2 * params_b       # f32 gradient-merge accumulator
-        rt = roofline_step_time(flops, hbm, chip=chip)
-        tok_s = bs * seq / max(rt.step_s, 1e-12)
+        # extrapolate each replayed FIELD linearly in depth, then price
+        # the synthetic full-depth what-if through the SAME `_price` the
+        # trainer autotuner uses — the 12-flops/param epilogue, the f32
+        # grad-merge accumulator and the activation-traffic legs exist
+        # in exactly one place (the wire legs stay 0 by design: the
+        # probes are pinned single-device)
+        from .remat_advisor import RematWhatIf
+        w = RematWhatIf(
+            policy=pol,
+            peak_bytes=lerp(w0.peak_bytes, w1.peak_bytes),
+            base_peak_bytes=lerp(w0.base_peak_bytes, w1.base_peak_bytes),
+            saved_bytes=lerp(w0.saved_bytes, w1.saved_bytes),
+            boundary_bytes=lerp(w0.boundary_bytes, w1.boundary_bytes),
+            dropped_bytes=lerp(w0.dropped_bytes, w1.dropped_bytes),
+            bump_bytes=lerp(w0.bump_bytes, w1.bump_bytes),
+            recompute_flops=lerp(w0.recompute_flops, w1.recompute_flops),
+            step_flops=lerp(w0.step_flops, w1.step_flops),
+            segments=full_L)
+        state_b = lerp(state_by_L[L0], state_by_L[L1])
+        params_b = lerp(params_by_L[L0], params_by_L[L1])
+        peak, _flops, rt, tok_s = _price(
+            w, state_b, batch_b, params_b, mb * seq, "tokens/s", chip,
+            accum=accum)
         scored.append((entry, peak, peak <= budget, tok_s))
         if log:
             log(f"advisor {entry}: peak {peak / 2**30:.2f} GiB "

@@ -14,8 +14,8 @@ import jax.numpy as jnp
 
 from .pass_manager import AnalysisContext
 
-__all__ = ["BASELINE_CONFIGS", "build_config", "lowered_program",
-           "forward_fn", "tuning_report"]
+__all__ = ["BASELINE_CONFIGS", "PROGRAM_CONFIGS", "build_config",
+           "lowered_program", "forward_fn", "tuning_report"]
 
 _CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
 _TUNING_CACHE = {}   # name -> AutotuneReport (autotune.autotune_layer)
@@ -125,6 +125,43 @@ BASELINE_CONFIGS = {
 }
 
 
+def _gpt_decode():
+    """The SERVING config: the fused multi-step decode loop
+    (PagedGPTDecoder.decode_multi, K=4 device-resident ticks) captured
+    via analysis_program(k=4) — not an nn.Layer forward, so it lives in
+    PROGRAM_CONFIGS (no tuning manifest: there is nothing to remat in a
+    decode tick). The SERVE-HOST-SYNC-DECODE rule gates it: zero host
+    transfers inside the loop, KV-cache donation kept."""
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import PagedGPTDecoder
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2)
+    program = dec.analysis_program(k=4)
+    ctx = AnalysisContext(
+        name="gpt_decode",
+        # paged attention's per-head score reorder rides with the dense
+        # model's by-design attention transposes
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + (r"dims = \[0, 3, 1, 2\]",),
+        expect_collectives=False,
+        extra={"serving_decode": True})
+    return program, ctx, PagedGPTDecoder._decode_multi_step
+
+
+# configs whose builder yields a READY LoweredProgram (serving decode
+# loops and other non-Layer captures): builder() ->
+# (LoweredProgram, AnalysisContext, source_fn). They ride the same
+# lint/memory manifest + CI plumbing as BASELINE_CONFIGS but skip the
+# tuning manifests (no grad program to replay).
+PROGRAM_CONFIGS = {
+    "gpt_decode": _gpt_decode,    # fused multi-step serving decode
+}
+
+
 def build_config(name):
     try:
         builder = BASELINE_CONFIGS[name]
@@ -135,19 +172,22 @@ def build_config(name):
 
 
 def lowered_program(name):
-    """(LoweredProgram, AnalysisContext, forward fn) for a BASELINE
-    config — lowered once per process (the lint gate's time budget
-    rides on this cache). The context is a fresh copy per call:
+    """(LoweredProgram, AnalysisContext, forward fn) for a BASELINE or
+    PROGRAM config — lowered once per process (the lint gate's time
+    budget rides on this cache). The context is a fresh copy per call:
     consumers set run-local fields on it (manifest, mesh_axes) and a
     shared instance would leak one run's manifest into the next —
     e.g. baking transition-run DRIFT findings into a regenerated
     manifest."""
     import dataclasses
     if name not in _CACHE:
-        from .lowering import lower_layer
-        model, examples, ctx = build_config(name)
-        program = lower_layer(model, *examples, name=name)
-        _CACHE[name] = (program, ctx, type(model).forward)
+        if name in PROGRAM_CONFIGS:
+            _CACHE[name] = PROGRAM_CONFIGS[name]()
+        else:
+            from .lowering import lower_layer
+            model, examples, ctx = build_config(name)
+            program = lower_layer(model, *examples, name=name)
+            _CACHE[name] = (program, ctx, type(model).forward)
     program, ctx, fwd = _CACHE[name]
     return program, dataclasses.replace(ctx), fwd
 

@@ -39,6 +39,18 @@ __all__ = ["MemoryAnalyzer", "MemoryEstimate", "estimate_jaxpr_memory",
 _KV_CACHE_RE = re.compile(r"(^|[/.])(k|v|kv)?_?(cache|pages)(s)?([/.]|$)",
                           re.IGNORECASE)
 
+
+def kv_cache_infos(arg_infos):
+    """The args that count as decode-loop KV-cache state: explicit
+    role="cache", or cache-looking names on args that aren't
+    params/optimizer slots. ONE definition shared by
+    MEM-NO-DONATION-KVCACHE and SERVE-HOST-SYNC-DECODE, so the two
+    rules can never disagree about what the cache is."""
+    return [i for i in arg_infos
+            if i.role == "cache"
+            or (i.role not in ("param", "opt_state", "gt_state")
+                and _KV_CACHE_RE.search(i.name or ""))]
+
 # primitives whose sub-f32 operands XLA CPU materializes as f32 copies
 # (no native bf16 matmul path on the host; convolutions lower through a
 # different path that fuses the widening and shows no copy)
@@ -410,10 +422,7 @@ class MemoryAnalyzer(Analyzer):
         # cache, not params — jit.save/serving paths never donate params
         # (correctly: they're read-only across steps), but a non-donated
         # cache double-buffers the whole KV store on every step
-        cache_infos = [i for i in infos
-                       if i.role == "cache"
-                       or (i.role not in ("param", "opt_state", "gt_state")
-                           and _KV_CACHE_RE.search(i.name or ""))]
+        cache_infos = kv_cache_infos(infos)
         # per-ARG, not any(): k_pages donated with v_pages forgotten
         # still double-buffers half the store
         undonated = [i for i in cache_infos if not i.donated]

@@ -223,44 +223,82 @@ def _segment_cuts(jx, defs, uses, boundary, droppable, segments):
     return sorted(cuts)
 
 
-def replay_remat(program_or_jaxpr, policy, arg_infos=None, segments=1,
-                 boundary=None, top_k=4):
-    """What-if liveness replay of one remat policy over a NO-remat
-    grad/train-step program. Returns a RematWhatIf.
+@dataclass
+class _ReplayBase:
+    """Everything about a no-remat program that is the SAME for every
+    candidate policy: the def/use walk, the fwd/bwd boundary, the
+    propagated shard counts, the residual list, the base liveness peak,
+    the total step FLOPs and the per-eqn forward FLOPs. `advise_remat`
+    computes it once and hands it to every `replay_remat` call — the
+    policy loop used to redo this walk per policy (~2x advisor host
+    time on GPT-sized jaxprs)."""
+    jx: object
+    arg_infos: object
+    defs: dict
+    uses: dict
+    boundary: int
+    counts: dict
+    residuals: list              # (var, def_idx, last_fwd_use)
+    base_peak_bytes: int
+    step_flops: int
+    fwd_eqn_flops: list          # analytic FLOPs of eqns [0..boundary]
 
-    The program must have been traced with checkpointing disabled (the
-    autotuner's front doors arrange that); replaying over an
-    already-rematted jaxpr would discount the same residuals twice."""
+
+def _prepare_replay(program_or_jaxpr, arg_infos=None, boundary=None):
+    """The policy-independent half of the what-if replay."""
+    from ..cost_model import eqn_flops, jaxpr_flops
     program = program_or_jaxpr
     jx = getattr(program, "jaxpr", program)
     if arg_infos is None:
         arg_infos = getattr(program, "arg_infos", None)
     jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
-    policy = canonical_policy(policy)
-    save = saveable_predicate(policy)
-    segments = max(int(segments or 1), 1)
-
-    defs, uses, n = _collect(jx)
+    defs, uses, _n = _collect(jx)
     if boundary is None:
         boundary = find_boundary(jx)
     counts = propagate_shard_counts(
         jx, [i.shard_count for i in arg_infos] if arg_infos else None)
-
-    def dev_bytes(v):
-        return _aval_bytes(v.aval) // max(counts.get(v, 1), 1)
-
     residuals = []
     for v, d in defs.items():
         us = uses.get(v, [])
         if d <= boundary and us and max(us) > boundary:
             fwd = [u for u in us if u <= boundary]
             residuals.append((v, d, max(fwd) if fwd else d))
+    base = estimate_jaxpr_memory(jx, arg_infos=arg_infos, top_k=0)
+    return _ReplayBase(
+        jx=jx, arg_infos=arg_infos, defs=defs, uses=uses,
+        boundary=boundary, counts=counts, residuals=residuals,
+        base_peak_bytes=base.peak_bytes, step_flops=jaxpr_flops(jx),
+        fwd_eqn_flops=[eqn_flops(e) for e in jx.eqns[:boundary + 1]])
+
+
+def replay_remat(program_or_jaxpr, policy, arg_infos=None, segments=1,
+                 boundary=None, top_k=4, base=None):
+    """What-if liveness replay of one remat policy over a NO-remat
+    grad/train-step program. Returns a RematWhatIf.
+
+    The program must have been traced with checkpointing disabled (the
+    autotuner's front doors arrange that); replaying over an
+    already-rematted jaxpr would discount the same residuals twice.
+    `base` is an optional precomputed `_prepare_replay` result —
+    `advise_remat` shares one across its whole policy sweep."""
+    if base is None:
+        base = _prepare_replay(program_or_jaxpr, arg_infos=arg_infos,
+                               boundary=boundary)
+    jx, boundary = base.jx, base.boundary
+    policy = canonical_policy(policy)
+    save = saveable_predicate(policy)
+    segments = max(int(segments or 1), 1)
+    counts = base.counts
+
+    def dev_bytes(v):
+        return _aval_bytes(v.aval) // max(counts.get(v, 1), 1)
 
     droppable = {}
-    for v, d, _ in residuals:
+    for v, d, _ in base.residuals:
         if policy != "none" and not save(jx.eqns[d]):
             droppable[d] = droppable.get(d, 0) + dev_bytes(v)
-    cuts = _segment_cuts(jx, defs, uses, boundary, droppable, segments)
+    cuts = _segment_cuts(jx, base.defs, base.uses, boundary, droppable,
+                         segments)
 
     def chunk_of(i):
         c = 0
@@ -272,7 +310,7 @@ def replay_remat(program_or_jaxpr, policy, arg_infos=None, segments=1,
     overrides = {}
     seg_drop = [0] * (len(cuts) + 1)
     saved_b = bound_b = drop_b = 0
-    for v, d, last_fwd in residuals:
+    for v, d, last_fwd in base.residuals:
         b = dev_bytes(v)
         if policy == "none" or save(jx.eqns[d]):
             saved_b += b
@@ -285,23 +323,22 @@ def replay_remat(program_or_jaxpr, policy, arg_infos=None, segments=1,
         seg_drop[chunk_of(d)] += b
     bump = max(seg_drop) if policy != "none" else 0
 
-    base = estimate_jaxpr_memory(jx, arg_infos=arg_infos, top_k=0)
-    est = estimate_jaxpr_memory(jx, arg_infos=arg_infos, top_k=top_k,
+    est = estimate_jaxpr_memory(jx, arg_infos=base.arg_infos,
+                                top_k=top_k,
                                 last_use_override=overrides,
                                 extra_after=(boundary, bump))
 
-    from ..cost_model import eqn_flops, jaxpr_flops
-    step_flops = jaxpr_flops(jx)
     recompute = 0
     if policy != "none":
-        recompute = sum(eqn_flops(eqn) for i, eqn in enumerate(jx.eqns)
-                        if i <= boundary and not save(eqn))
+        recompute = sum(f for f, eqn in
+                        zip(base.fwd_eqn_flops, jx.eqns)
+                        if not save(eqn))
 
     return RematWhatIf(
         policy=policy, peak_bytes=est.peak_bytes,
-        base_peak_bytes=base.peak_bytes, saved_bytes=saved_b,
+        base_peak_bytes=base.base_peak_bytes, saved_bytes=saved_b,
         boundary_bytes=bound_b, dropped_bytes=drop_b, bump_bytes=bump,
-        recompute_flops=recompute, step_flops=step_flops,
+        recompute_flops=recompute, step_flops=base.step_flops,
         segments=len(cuts) + 1, top=est.top)
 
 
@@ -312,9 +349,13 @@ def advise_remat(program, policies=None, arg_infos=None, segments=1,
     carries the `.advice` line the autotuner and CLI print:
 
         remat=dots: peak 12.4 GiB -> 7.9 GiB per device, +3.2% recompute FLOPs
-    """
+
+    The base walk (defs/uses, boundary, shard counts, residuals, base
+    peak, per-eqn forward FLOPs) is computed ONCE and shared across the
+    policy sweep."""
     policies = policies or list(REMAT_POLICIES)
-    out = [replay_remat(program, p, arg_infos=arg_infos,
-                        segments=segments, boundary=boundary)
+    base = _prepare_replay(program, arg_infos=arg_infos,
+                           boundary=boundary)
+    out = [replay_remat(program, p, segments=segments, base=base)
            for p in policies]
     return sorted(out, key=lambda r: r.peak_bytes)

@@ -31,7 +31,8 @@ import numpy as np
 __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
            "eqn_flops", "jaxpr_flops", "RooflineTime",
-           "roofline_step_time"]
+           "roofline_step_time", "decode_tick_roofline_s",
+           "decode_horizon", "measured_host_sync_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -195,6 +196,77 @@ def roofline_step_time(flops, hbm_bytes, ici_bytes=0, dcn_bytes=0,
     hbm = hbm_bytes / chip.hbm_bw
     wire = ici_bytes / chip.ici_bw + dcn_bytes / chip.dcn_bw
     return RooflineTime(compute_s=compute, hbm_s=hbm, wire_s=wire)
+
+
+# ------------------------------------------------------- decode horizon
+
+# Fallback python-dispatch + device->host-fetch cost of one decode sync
+# when no measurement is available (order of magnitude of a CPython
+# jit-call + np.asarray round-trip on a dev host). The engine's horizon
+# only needs the right magnitude: K is capped and bucketed anyway.
+DEFAULT_DECODE_SYNC_S = 4e-4
+
+_MEASURED_SYNC = {}
+
+
+def measured_host_sync_s(force=False):
+    """Measure (once per process) the host cost one decode sync pays:
+    dispatch a trivial jitted program and fetch its result. This is the
+    overhead `decode_horizon` amortizes over K device-resident ticks —
+    the 'measured host overhead per sync' leg of the K pricing."""
+    if _MEASURED_SYNC and not force:
+        return _MEASURED_SYNC["s"]
+    try:
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((8,), jnp.int32)
+        np.asarray(f(x))                     # compile outside the timing
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            x = f(x)
+            np.asarray(x)
+        dt = (time.perf_counter() - t0) / n
+    except Exception:
+        dt = DEFAULT_DECODE_SYNC_S
+    _MEASURED_SYNC["s"] = max(dt, 1e-6)
+    return _MEASURED_SYNC["s"]
+
+
+def decode_tick_roofline_s(step_hbm_bytes, chip=None):
+    """Analytic floor of ONE decode tick: decode is HBM-bound (the MXU
+    idles), so a tick cannot beat its bytes moved / HBM bandwidth.
+    `step_hbm_bytes` is every weight byte + the batch's KV prefix
+    (serving.PagedGPTDecoder.step_hbm_bytes supplies it)."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    return step_hbm_bytes / chip.hbm_bw
+
+
+def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
+                   k_cap=32, sync_overhead_frac=0.10):
+    """Best multi-step decode horizon K — how many device-resident
+    ticks to fuse per host sync (serving.ContinuousBatchingEngine's
+    default k_max).
+
+    With K ticks fused, per-token time ≈ t_tick + h/K where t_tick is
+    the tick roofline and h the host overhead per sync. Pick the
+    smallest K that keeps the sync share at or below
+    `sync_overhead_frac` of the tick roofline (h/(K·t_tick) ≤ frac),
+    capped at `k_cap` (scheduling granularity: retirement/admission
+    latency grows with K, and the engine buckets K to powers of two
+    for a bounded compile count). Small models on fast chips price to
+    the cap — the tick is so short that ANY host interposition
+    dominates; models whose tick dwarfs the sync cost price K=1, where
+    the fused loop gains nothing."""
+    import math
+    if host_sync_s is None:
+        host_sync_s = measured_host_sync_s()
+    t = decode_tick_roofline_s(step_hbm_bytes, chip=chip)
+    if t <= 0:
+        return int(k_cap)
+    k = math.ceil(host_sync_s / (sync_overhead_frac * t))
+    return int(min(max(k, 1), int(k_cap)))
 
 
 # jaxpr primitive names -> the StableHLO collective they lower to, so

@@ -13,7 +13,23 @@ from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
-           "input_pipeline_stats", "memory_report", "autotune"]
+           "input_pipeline_stats", "memory_report", "autotune",
+           "serving_stats"]
+
+
+def serving_stats():
+    """Telemetry of every live serving engine
+    (`serving.ContinuousBatchingEngine` / `SpeculativeEngine`): queue
+    wait, slot occupancy, tokens/s, per-token p50/p99 latency, and —
+    the multi-step decode headline — host syncs per generated token
+    (1.0 on the per-tick path, ≤ 1/K with a K-tick horizon). The
+    observability half of device-resident decode: when
+    `host_syncs_per_token` is near 1 on a model whose tick roofline is
+    tiny, the host round-trip (not the chip) is the decode bottleneck —
+    raise the engine's `k_max` or let `cost_model.decode_horizon` price
+    it. Returns one summary dict per engine."""
+    from .serving import serving_stats as _stats
+    return _stats()
 
 
 def autotune(target, *example_inputs, batch=None, hbm_budget=None,

@@ -14,6 +14,19 @@ decoding).  TPU-native design:
   scalar-prefetch Pallas kernel); page allocation is host-side.
 - Prefill is a second compiled program per prompt-length bucket
   (powers of two) writing the prompt's K/V straight into the pages.
+- Multi-step decode: `decode_multi` fuses K decode ticks into ONE
+  compiled `lax.scan` — sampled tokens feed back on device, per-slot
+  done masks (EOS or token budget) freeze finished slots (their `lens`
+  stop and their K/V writes route to the reserved scratch page) — so
+  the engine syncs the host once per K tokens instead of once per
+  token (the host-interposed round-trip is the decode throughput
+  killer once the kernel is fast; cf. Ragged Paged Attention,
+  arXiv 2604.15464, and T3's overlap analysis, arXiv 2401.16677).
+  `ContinuousBatchingEngine.run()` schedules horizons of
+  `k = min(K_max, smallest remaining budget)` ticks and overlaps each
+  block's host fetch with the NEXT block's dispatch (one-horizon-
+  delayed retirement); `cost_model.decode_horizon` prices the default
+  K from the chip's tick roofline vs the measured host sync cost.
 - quant="a8w8": per-(layer, out-channel) int8 weights with dynamic
   per-row int8 activations — matmuls run int8xint8->int32 on the MXU
   (same recipe as quantization.QuantizedLinearA8W8).
@@ -24,8 +37,12 @@ The engine applies to GPT-family models (uniform pre-LN blocks); weights
 are extracted once into stacked per-layer arrays and the model object is
 no longer needed — pair with jit.load-style artifacts for serving.
 """
+import collections
 import functools
 import math
+import time
+import weakref
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +51,87 @@ import numpy as np
 from .framework.core import Tensor
 
 __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
-           "SpeculativeEngine"]
+           "SpeculativeEngine", "ServeStats", "serving_stats"]
+
+
+# every live engine, for debug.serving_stats() (mirrors the prefetcher
+# registry in io/prefetch.py: observability without plumbing handles)
+_ENGINES = weakref.WeakSet()
+
+
+# sample window of the per-token / queue-wait / occupancy percentiles:
+# counters run forever, distributions cover the most recent samples so
+# a long-lived engine's telemetry stays O(1) memory and O(window) to
+# summarize
+_STATS_WINDOW = 4096
+
+
+@dataclass
+class ServeStats:
+    """Serving telemetry of one engine: how often the host interposes
+    on the decode loop and what the client observes. `decode_syncs` is
+    the number under optimization — the per-tick engine pays one host
+    sync per generated token; the multi-step engine one per K.
+    Counters are lifetime totals; the latency/occupancy distributions
+    are bounded sliding windows (last `_STATS_WINDOW` samples)."""
+    engine: str = ""
+    k_max: int = 1
+    requests: int = 0            # submitted
+    completed: int = 0           # retired with output
+    tokens: int = 0              # generated tokens (prefill's included)
+    ticks: int = 0               # device decode ticks dispatched
+    decode_syncs: int = 0        # host fetches of decode results
+    prefill_syncs: int = 0       # host-blocking prefill rounds
+    queue_wait_s: collections.deque = field(      # submit -> admit
+        default_factory=lambda: collections.deque(maxlen=_STATS_WINDOW))
+    occupancy: collections.deque = field(         # active/slots per block
+        default_factory=lambda: collections.deque(maxlen=_STATS_WINDOW))
+    token_time_s: collections.deque = field(
+        # wall per token, steady-state decode syncs only (syncs that
+        # contained a prefill are excluded, or p99 becomes a prefill
+        # number)
+        default_factory=lambda: collections.deque(maxlen=_STATS_WINDOW))
+
+    @property
+    def host_syncs_per_token(self):
+        return self.decode_syncs / self.tokens if self.tokens else 0.0
+
+    def summary(self):
+        d = {"engine": self.engine, "k_max": self.k_max,
+             "requests": self.requests, "completed": self.completed,
+             "tokens": self.tokens, "ticks": self.ticks,
+             "decode_syncs": self.decode_syncs,
+             "prefill_syncs": self.prefill_syncs,
+             "host_syncs_per_token": round(self.host_syncs_per_token, 4)}
+        if self.occupancy:
+            d["mean_slot_occupancy"] = round(
+                float(np.mean(self.occupancy)), 4)
+        if self.queue_wait_s:
+            d["queue_wait_p50_ms"] = round(
+                float(np.percentile(self.queue_wait_s, 50)) * 1e3, 3)
+        if self.token_time_s:
+            tot = float(np.sum(self.token_time_s))
+            d["tokens_per_sec"] = round(len(self.token_time_s) / tot, 1) \
+                if tot else 0.0
+            d["token_p50_ms"] = round(
+                float(np.percentile(self.token_time_s, 50)) * 1e3, 3)
+            d["token_p99_ms"] = round(
+                float(np.percentile(self.token_time_s, 99)) * 1e3, 3)
+        return d
+
+
+def serving_stats():
+    """ServeStats summaries of every live engine (debug.serving_stats
+    front door)."""
+    return [e.stats.summary() for e in list(_ENGINES)]
+
+
+# decode_multi's result bundle: device arrays — the engine feeds
+# tokens/lens/done/remaining straight into the next horizon's call and
+# fetches tokens_block/done_before only at sync points
+MultiDecodeOut = collections.namedtuple(
+    "MultiDecodeOut", ["tokens_block", "done_before", "tokens", "lens",
+                       "done", "remaining", "logits_block"])
 
 
 def _ln(x, w, b):
@@ -80,9 +177,11 @@ def _spec_accept(p_rows, q_rows, drafts, rng):
 
 def _sample_tokens(logits, sampling, keys):
     """Per-slot next-token choice: greedy, or seeded temperature/top-k/
-    top-p sampling (keys: [S] per-slot PRNG keys — slot-stable draws no
-    matter how the batch is composed; the mask itself is shared with
-    generate() via models.generation.mask_logits)."""
+    top-p sampling (keys: [S] per-slot PRNG keys derived from
+    (seed, request id, position) — see PagedGPTDecoder._pos_keys — so a
+    request's draws don't depend on batch composition or scheduling;
+    the mask itself is shared with generate() via
+    models.generation.mask_logits)."""
     if sampling is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     from .models.generation import mask_logits
@@ -230,6 +329,7 @@ class PagedGPTDecoder:
             self._shard_for_tp()
 
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._multis = {}     # (k, return_logits) -> jitted fused loop
         self._verify = None   # jitted lazily (speculative decoding only)
         self._probs = None    # jitted lazily (sampled speculation)
         self._prefills = {}   # padded length -> jitted prefill
@@ -297,21 +397,20 @@ class PagedGPTDecoder:
 
     # -- compiled programs -------------------------------------------------
 
-    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table,
-                     draw):
-        """tokens [S], lens [S] (tokens already counted, i.e. position of
-        the incoming token), table [S, max_pages], draw (sampling round
-        counter for per-slot keys) -> (next [S], logits [S, V], k_pages,
-        v_pages)."""
-        cfg, ps = self.cfg, self.page_size
+    def _forward_tokens(self, weights, k_pages, v_pages, tokens, lens,
+                        table, pids, offs):
+        """Shared single-position forward over all slots: embed `tokens`
+        at position `lens`, write K/V at (pids, offs) — callers route
+        frozen slots' pids to the reserved scratch page — and attend
+        over each slot's pages. Returns (logits [S, V], k_pages,
+        v_pages). Both the per-tick step and every tick of the fused
+        multi-step scan run THIS body, so they cannot drift."""
+        cfg = self.cfg
         H, D = cfg.num_heads, cfg.head_dim
         S = tokens.shape[0]
         x = (self.wte[tokens] +
              self.wpe[jnp.clip(lens, 0, cfg.max_seq_len - 1)]
-             ).astype(self.k_pages.dtype)                      # [S, h]
-        pids = jnp.take_along_axis(table, (lens // ps)[:, None],
-                                   axis=1)[:, 0]                # [S]
-        offs = lens % ps
+             ).astype(k_pages.dtype)                           # [S, h]
         quant = self.quant
 
         def layer(x, wkv):
@@ -336,13 +435,90 @@ class PagedGPTDecoder:
             layer, x, (weights, k_pages, v_pages))
         x = _ln(x, self.ln_f_w, self.ln_f_b)
         logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        return logits, k_pages, v_pages
+
+    def _pos_keys(self, kids, pos):
+        """Per-slot PRNG keys from (seed, kid, position): draws depend
+        only on the decoder seed, the request identity (`kids` — the
+        engine passes the request id; direct callers default to the
+        slot index) and the position of the token being consumed.
+        NOTHING about scheduling enters the key, so the same request
+        sampled through the per-tick loop, the fused multi-step loop,
+        or any admission/batch composition draws the same tokens."""
+        base = jax.random.PRNGKey(self.seed)
+        return jax.vmap(lambda kid, p: jax.random.fold_in(
+            jax.random.fold_in(base, kid), p))(kids, pos)
+
+    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table,
+                     kids):
+        """tokens [S], lens [S] (tokens already counted, i.e. position of
+        the incoming token), table [S, max_pages], kids [S] (sampling
+        key ids, see _pos_keys) -> (next [S], logits [S, V], k_pages,
+        v_pages)."""
+        ps = self.page_size
+        pids = jnp.take_along_axis(table, (lens // ps)[:, None],
+                                   axis=1)[:, 0]                # [S]
+        offs = lens % ps
+        logits, k_pages, v_pages = self._forward_tokens(
+            weights, k_pages, v_pages, tokens, lens, table, pids, offs)
         keys = None
         if self.sampling is not None:
-            base = jax.random.fold_in(jax.random.PRNGKey(self.seed), draw)
-            keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
-                jnp.arange(S))
+            keys = self._pos_keys(kids, lens)
         nxt = _sample_tokens(logits, self.sampling, keys)
         return nxt, logits, k_pages, v_pages
+
+    def _decode_multi_step(self, weights, k_pages, v_pages, tokens, lens,
+                           table, kids, done, remaining, eos, *, k,
+                           return_logits=False):
+        """K fused decode ticks inside ONE compiled program (lax.scan):
+        each tick's sampled token feeds the next tick on device, so the
+        host syncs once per K tokens instead of once per token.
+
+        tokens/lens/table/kids as in `_decode_step`. Tick j draws with
+        the (seed, kid, lens+j) key — exactly the keys the per-tick
+        loop would use at those positions, so fused and per-tick decode
+        emit byte-identical streams. `done` [S] bool freezes a slot
+        from tick 0 (inactive or already finished); a slot also freezes
+        itself after emitting its first `eos` (pass -1 for none) or
+        after `remaining` [S] tokens (its budget). Frozen slots' `lens`
+        stop advancing and their K/V writes route to the reserved
+        scratch page, so the pages stay exactly as the per-tick engine
+        would leave them.
+
+        Returns (block [k, S] emitted tokens, done_before [k, S] — True
+        where the slot was already frozen, i.e. the token is filler —
+        final tokens/lens/done/remaining, k_pages, v_pages[, logits
+        [k, S, V] when return_logits])."""
+        ps = self.page_size
+        scratch = self.num_pages - 1
+
+        def tick(carry, _):
+            tokens, lens, done, remaining, kp, vp = carry
+            pids = jnp.take_along_axis(table, (lens // ps)[:, None],
+                                       axis=1)[:, 0]
+            pids = jnp.where(done, scratch, pids)
+            offs = lens % ps
+            logits, kp, vp = self._forward_tokens(
+                weights, kp, vp, tokens, lens, table, pids, offs)
+            keys = None
+            if self.sampling is not None:
+                keys = self._pos_keys(kids, lens)
+            nxt = _sample_tokens(logits, self.sampling, keys)
+            nxt = jnp.where(done, tokens, nxt)
+            rem = jnp.where(done, remaining, remaining - 1)
+            new_done = done | (nxt == eos) | (rem <= 0)
+            new_lens = jnp.where(done, lens, lens + 1)
+            out = (nxt, done, logits) if return_logits else (nxt, done)
+            return (nxt, new_lens, new_done, rem, kp, vp), out
+
+        carry = (tokens, lens, done, remaining, k_pages, v_pages)
+        carry, outs = jax.lax.scan(tick, carry, jnp.arange(k))
+        tokens, lens, done, remaining, k_pages, v_pages = carry
+        ret = (outs[0], outs[1], tokens, lens, done, remaining,
+               k_pages, v_pages)
+        if return_logits:
+            ret += (outs[2],)
+        return ret
 
     def _verify_step(self, weights, k_pages, v_pages, tokens, lens, table):
         """Speculative verify: tokens [S, W] (last accepted token + the
@@ -430,7 +606,7 @@ class PagedGPTDecoder:
         n_pg = Lp // ps
         quant = self.quant
 
-        def run(weights, k_pages, v_pages, ids, true_len, page_ids, draw):
+        def run(weights, k_pages, v_pages, ids, true_len, page_ids, kids):
             x = (self.wte[ids] + self.wpe[jnp.arange(Lp)][None]
                  ).astype(k_pages.dtype)                     # [n, Lp, h]
 
@@ -475,10 +651,11 @@ class PagedGPTDecoder:
                 self.lm_head.astype(jnp.float32)
             keys = None
             if self.sampling is not None:
-                base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
-                                          draw)
-                keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
-                    jnp.arange(n))
+                # same (seed, kid, position) key walk as decode: the
+                # prompt's last token sits at true_len-1, so the first
+                # generated token draws with that position — whatever
+                # chunk/bucket the request was prefilled in
+                keys = self._pos_keys(kids, true_len - 1)
             return _sample_tokens(logits, self.sampling, keys), \
                 k_pages, v_pages
 
@@ -486,18 +663,24 @@ class PagedGPTDecoder:
 
     # -- host-side API -----------------------------------------------------
 
-    def prefill(self, ids, page_ids):
+    def prefill(self, ids, page_ids, kid=None):
         """Run one prompt through the model, writing KV into `page_ids`;
         returns the next token (greedy, or sampled per the decoder's
         temperature/top_k/top_p config)."""
-        return self.prefill_batch([(ids, page_ids)])[0]
+        return self.prefill_batch([(ids, page_ids)],
+                                  kids=None if kid is None else [kid])[0]
 
-    def prefill_batch(self, requests):
+    def prefill_batch(self, requests, kids=None):
         """Prefill several prompts, batching same-length-bucket groups
         into single forwards. requests: [(ids, page_ids), ...]; returns
-        the first generated token per request (in order)."""
+        the first generated token per request (in order). `kids` are
+        the per-request sampling key ids (see _pos_keys; the engine
+        passes request ids — default: the request's index in this
+        call)."""
         ps = self.page_size
         results = [None] * len(requests)
+        if kids is None:
+            kids = list(range(len(requests)))
         groups = {}
         for i, (ids, page_ids) in enumerate(requests):
             ids = np.asarray(ids, np.int32)
@@ -515,9 +698,11 @@ class PagedGPTDecoder:
                 pad = np.zeros((nb, Lp), np.int32)
                 tl = np.ones(nb, np.int32)
                 pg = np.full((nb, n_pg), self.num_pages - 1, np.int32)
+                kd = np.zeros(nb, np.int32)
                 for r, (i, ids, page_ids) in enumerate(chunk):
                     pad[r, :len(ids)] = ids
                     tl[r] = len(ids)
+                    kd[r] = kids[i]
                     k = min(len(page_ids), n_pg)
                     pg[r, :k] = page_ids[:k]   # rest stays on scratch
                 key = (Lp, nb)
@@ -527,48 +712,98 @@ class PagedGPTDecoder:
                 nxt, self.k_pages, self.v_pages = self._prefills[key](
                     self.weights, self.k_pages, self.v_pages,
                     jnp.asarray(pad), jnp.asarray(tl), jnp.asarray(pg),
-                    jnp.asarray(self._draws, jnp.int32))
+                    jnp.asarray(kd))
                 nxt = np.asarray(nxt)
                 for r, (i, _, _) in enumerate(chunk):
                     results[i] = int(nxt[r])
         return results
 
-    def analysis_program(self, donate=True):
-        """Graph Doctor view of the compiled decode step: one fresh
-        trace of `_decode_step` with per-argument role capture —
-        weights/embeddings are `param` (read-only across steps, NOT
-        donated: that's correct for inference), the K/V page pools are
-        `cache` with donated=True matching the real donate_argnums=(1,2)
-        (the cache is the decode loop's carried state — an undonated
-        cache is the MEM-NO-DONATION-KVCACHE lint), tokens/lens/table/
-        draw are `input`. `donate=False` traces the defective variant
-        the planted-defect test lints."""
+    def analysis_program(self, donate=True, k=None):
+        """Graph Doctor view of the compiled decode program: one fresh
+        trace with per-argument role capture — weights/embeddings are
+        `param` (read-only across steps, NOT donated: that's correct
+        for inference), the K/V page pools are `cache` with
+        donated=True matching the real donate_argnums=(1,2) (the cache
+        is the decode loop's carried state — an undonated cache is the
+        MEM-NO-DONATION-KVCACHE lint), everything else is `input`.
+
+        With `k` the FUSED multi-step program (`_decode_multi_step`, K
+        device-resident ticks in one lax.scan) is traced instead of the
+        single tick — the SERVE-HOST-SYNC-DECODE rule checks it for
+        host transfers and kept cache donation. `donate=False` traces
+        the defective variant the planted-defect tests lint."""
         from .analysis.lowering import LoweredProgram, tree_arg_infos
 
         S = self.max_batch
         tokens = jnp.zeros((S,), jnp.int32)
         lens = jnp.zeros((S,), jnp.int32)
         table = jnp.zeros((S, self.max_pages), jnp.int32)
-        draw = jnp.zeros((), jnp.int32)
-        fn = jax.jit(self._decode_step,
-                     donate_argnums=(1, 2) if donate else ())
-        traced = fn.trace(self.weights, self.k_pages, self.v_pages,
-                          tokens, lens, table, draw)
+        kids = jnp.arange(S, dtype=jnp.int32)
+        inputs = [("tokens", tokens), ("lens", lens), ("table", table),
+                  ("kids", kids)]
+        if k:
+            done = jnp.zeros((S,), bool)
+            remaining = jnp.full((S,), int(k), jnp.int32)
+            eos = jnp.asarray(-1, jnp.int32)
+            inputs += [("done", done), ("remaining", remaining),
+                       ("eos", eos)]
+            fn = jax.jit(functools.partial(self._decode_multi_step,
+                                           k=int(k)),
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids, done, remaining,
+                              eos)
+            name = f"decode_multi_k{int(k)}"
+        else:
+            fn = jax.jit(self._decode_step,
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids)
+            name = "decode_step"
         infos = tree_arg_infos(self.weights, "param")
         infos += tree_arg_infos(self.k_pages, "cache", prefix="k_pages",
                                 donated=donate)
         infos += tree_arg_infos(self.v_pages, "cache", prefix="v_pages",
                                 donated=donate)
-        for nm, v in (("tokens", tokens), ("lens", lens),
-                      ("table", table), ("draw", draw)):
+        for nm, v in inputs:
             infos += tree_arg_infos(v, "input", prefix=nm)
         return LoweredProgram(traced.lower().as_text(),
-                              jaxpr=traced.jaxpr, name="decode_step",
+                              jaxpr=traced.jaxpr, name=name,
                               arg_infos=infos)
 
-    def decode(self, tokens, lens, table, return_probs=False):
+    def step_hbm_bytes(self, avg_ctx=None):
+        """HBM bytes ONE decode tick moves: every weight byte plus each
+        slot's KV prefix at `avg_ctx` (default: half the model's max
+        sequence). The numerator of the decode tick roofline —
+        `cost_model.decode_horizon` prices the default multi-step K
+        from it; bench.decode_roofline_tok_s is the tok/s view of the
+        same bytes model."""
+        cfg = self.cfg
+        n = cfg.num_params()
+        per = {"a8w8": 1.0, "w4a16": 0.5}.get(self.quant)
+        if per is not None:
+            h, f = cfg.hidden_size, cfg.ffn_hidden
+            lin = cfg.num_layers * (4 * h * h + 2 * h * f)
+            w_bytes = lin * per + (n - lin) * 2
+        else:
+            w_bytes = n * 2
+        if avg_ctx is None:
+            avg_ctx = max(cfg.max_seq_len // 2, 1)
+        kv = (self.max_batch * cfg.num_layers * 2 * avg_ctx *
+              cfg.num_heads * cfg.head_dim *
+              jnp.dtype(self.k_pages.dtype).itemsize)
+        return int(w_bytes + kv)
+
+    def _kids_or_default(self, kids):
+        if kids is None:
+            return np.arange(self.max_batch, dtype=np.int32)
+        return np.asarray(kids, np.int32)
+
+    def decode(self, tokens, lens, table, kids=None, return_probs=False):
         """One decode step for all slots (greedy, or the configured
-        sampling with deterministic per-(seed, round, slot) keys).
+        sampling with deterministic per-(seed, kid, position) keys —
+        kid defaults to the slot index; the engine passes request ids
+        so a request's draws are scheduling-independent).
         return_probs additionally yields the [S, V] distribution the
         token was drawn from (speculative acceptance needs it)."""
         self._draws += 1
@@ -576,20 +811,76 @@ class PagedGPTDecoder:
             self.weights, self.k_pages, self.v_pages,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
             jnp.asarray(table, jnp.int32),
-            jnp.asarray(self._draws, jnp.int32))
+            jnp.asarray(self._kids_or_default(kids)))
         if return_probs:
             return nxt, self._probs_of(logits)
         return nxt
+
+    def decode_multi(self, tokens, lens, table, k, kids=None, done=None,
+                     remaining=None, eos=None, return_logits=False):
+        """Run `k` decode ticks device-resident: ONE dispatch, zero
+        intermediate host syncs (see `_decode_multi_step`). Jitted per
+        (k, return_logits); the engine buckets k to powers of two so
+        the compile count stays bounded like the prefill buckets.
+
+        All inputs/outputs may stay on device: the engine feeds the
+        returned tokens/lens/done/remaining straight into the next
+        horizon's call and fetches tokens_block/done_before only at
+        sync points. `kids` are per-slot sampling key ids (see
+        `_pos_keys`; default slot index), `done` marks slots frozen
+        from tick 0 (default none), `remaining` per-slot token budgets
+        (default unlimited), `eos` the stop token (default none).
+        Returns a MultiDecodeOut;
+        `logits_block` is None unless return_logits (speculation wants
+        the draft's distributions)."""
+        k = int(k)
+        S = self.max_batch
+        key = (k, bool(return_logits))
+        fn = self._multis.get(key)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(self._decode_multi_step, k=k,
+                                  return_logits=bool(return_logits)),
+                donate_argnums=(1, 2))
+            self._multis[key] = fn
+        if done is None:
+            done = np.zeros(S, bool)
+        if remaining is None:
+            remaining = np.full(S, np.iinfo(np.int32).max // 2, np.int32)
+        self._draws += k             # dispatch telemetry, not key state
+        out = fn(self.weights, self.k_pages, self.v_pages,
+                 jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(lens, jnp.int32),
+                 jnp.asarray(table, jnp.int32),
+                 jnp.asarray(self._kids_or_default(kids)),
+                 jnp.asarray(done, bool),
+                 jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(-1 if eos is None else int(eos), jnp.int32))
+        self.k_pages, self.v_pages = out[6], out[7]
+        return MultiDecodeOut(out[0], out[1], out[2], out[3], out[4],
+                              out[5], out[8] if return_logits else None)
 
 
 class ContinuousBatchingEngine:
     """Slot-based continuous batching: requests are admitted into free
     slots as soon as capacity allows (iteration-level scheduling), decode
     runs one compiled step for ALL active slots, finished sequences free
-    their pages immediately."""
+    their pages.
+
+    By default `run()` schedules in HORIZONS: blocks of
+    `k = min(k_max, smallest remaining budget)` device-resident decode
+    ticks (`PagedGPTDecoder.decode_multi`), with the host syncing only
+    at block boundaries for admission/retirement/output append, and each
+    block's fetch overlapped against the NEXT block's dispatch
+    (one-horizon-delayed retirement: a slot finishing inside block N
+    stays frozen on device through block N+1 — its writes route to the
+    scratch page — and its pages are freed exactly once, when block N is
+    processed). `k_max` defaults to `cost_model.decode_horizon`'s priced
+    answer; `k_max=1` selects the legacy per-tick loop (`step()` is the
+    per-tick API either way)."""
 
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
-                 max_new_tokens=64):
+                 max_new_tokens=64, k_max=None, host_sync_s=None):
         if max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (the prefill forward always "
@@ -606,15 +897,25 @@ class ContinuousBatchingEngine:
         # so int64 here would insert a convert_element_type every tick
         self._lens = np.zeros(S, np.int32)
         self._tokens = np.zeros(S, np.int32)
+        self._kids = np.zeros(S, np.int32)   # request id per slot: the
+        # sampling key id, so a request's draws are independent of
+        # which slot/batch/schedule served it
         self._table_cache = None             # rebuilt on admit/retire only
         self._queue = []                     # (req_id, ids)
         self._outputs = {}                   # req_id -> [generated ids]
         self._next_id = 0
         self.steps = 0
+        if k_max is None:
+            from .cost_model import decode_horizon
+            k_max = decode_horizon(decoder.step_hbm_bytes(),
+                                   host_sync_s=host_sync_s)
+        self.k_max = max(1, int(k_max))
+        self.stats = ServeStats(engine=type(self).__name__,
+                                k_max=self.k_max)
+        self._submit_t = {}                  # rid -> submit wall time
+        _ENGINES.add(self)
 
     def submit(self, prompt_ids):
-        rid = self._next_id
-        self._next_id += 1
         ids = [int(t) for t in np.asarray(
             prompt_ids._value if isinstance(prompt_ids, Tensor)
             else prompt_ids).reshape(-1)]
@@ -631,6 +932,17 @@ class ContinuousBatchingEngine:
                 f"exceeds the model's max_seq_len "
                 f"{self.d.cfg.max_seq_len} (positions past it have no "
                 "embedding)")
+        return self._register_request(ids)
+
+    def _register_request(self, ids):
+        """Queue a VALIDATED request: rid allocation, queue-wait stamp,
+        stats — one implementation for both engines' submit()s, and
+        called only after validation so a rejected submission can't
+        skew stats.requests or leak a _submit_t entry."""
+        rid = self._next_id
+        self._next_id += 1
+        self._submit_t[rid] = time.perf_counter()
+        self.stats.requests += 1
         self._queue.append((rid, ids))
         return rid
 
@@ -642,15 +954,26 @@ class ContinuousBatchingEngine:
         # prompts then prefill as ONE batched forward (iteration-level
         # batching applies to prefill too, not just decode). Pages freed
         # by EOS-at-prefill become available from the NEXT step's pass.
+        # Returns the slots that entered decode (the multi-step run loop
+        # merges exactly those into its device carry).
         admitted = self._gather_admissions()
         if not admitted:
-            return
+            return []
+        now = time.perf_counter()
+        for _, rid, _, _ in admitted:
+            t0 = self._submit_t.pop(rid, None)
+            if t0 is not None:
+                self.stats.queue_wait_s.append(now - t0)
         self._table_cache = None
         firsts = self.d.prefill_batch(
-            [(ids, pages) for _, _, ids, pages in admitted])
+            [(ids, pages) for _, _, ids, pages in admitted],
+            kids=[rid for _, rid, _, _ in admitted])
+        self.stats.prefill_syncs += 1
         self._extra_prefill(admitted)
+        live = []
         for (slot, rid, ids, pages), first in zip(admitted, firsts):
             self._outputs[rid] = [first]
+            self.stats.tokens += 1
             if (self.eos is not None and first == self.eos) \
                     or self.max_new <= 1:
                 # finished at prefill: never occupy a decode slot
@@ -658,7 +981,10 @@ class ContinuousBatchingEngine:
                 continue
             self._lens[slot] = len(ids)
             self._tokens[slot] = first
+            self._kids[slot] = rid
             self._after_admit(slot, len(ids))
+            live.append(slot)
+        return live
 
     def _gather_admissions(self):
         admitted = []
@@ -689,6 +1015,7 @@ class ContinuousBatchingEngine:
         self._lens[slot] = 0
         self._tokens[slot] = 0
         self._table_cache = None
+        self.stats.completed += 1
 
     def _table(self, pages_per_slot, decoder):
         """Page table with inactive/unused entries routed to the reserved
@@ -711,12 +1038,17 @@ class ContinuousBatchingEngine:
         if self._table_cache is None:        # slots changed since last tick
             self._table_cache = self._table(self._slot_pages, self.d)
         nxt = np.asarray(self.d.decode(self._tokens, self._lens,
-                                       self._table_cache))
+                                       self._table_cache,
+                                       kids=self._kids))
         self.steps += 1
+        self.stats.ticks += 1
+        self.stats.decode_syncs += 1
+        self.stats.occupancy.append(len(active) / self.d.max_batch)
         for s in active:
             rid = self._slot_req[s]
             tok = int(nxt[s])
             self._outputs[rid].append(tok)
+            self.stats.tokens += 1
             self._lens[s] += 1
             self._tokens[s] = tok
             done = (self.eos is not None and tok == self.eos) or \
@@ -727,16 +1059,158 @@ class ContinuousBatchingEngine:
 
     def run(self, step_times=None):
         """Drain the queue; returns {request_id: generated token list}.
-        `step_times`, if given, receives each step's wall seconds (the
-        public hook benches use for per-token latency percentiles)."""
-        import time as _time
+        `step_times`, if given, receives wall seconds per host sync —
+        per decode tick on the per-tick path (k_max=1), per K-tick
+        horizon on the multi-step path (use `self.stats` for per-token
+        percentiles either way)."""
+        if self.k_max <= 1:
+            return self._run_per_tick(step_times)
+        return self._run_multi(step_times)
+
+    def _run_per_tick(self, step_times=None):
+        """Legacy loop: one compiled tick, one host sync per token."""
         while self._queue or any(r is not None for r in self._slot_req):
-            if step_times is None:
-                self.step()
-            else:
-                t0 = _time.perf_counter()
-                self.step()
-                step_times.append(_time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            before = self.stats.tokens
+            before_p = self.stats.prefill_syncs
+            self.step()
+            dt = time.perf_counter() - t0
+            if step_times is not None:
+                step_times.append(dt)
+            n = self.stats.tokens - before
+            # token_time_s is the STEADY-STATE decode latency: a sync
+            # that contained a prefill is dominated by it (orders of
+            # magnitude more work than a tick) and would turn p99 into
+            # a prefill number — keep it out of the percentiles
+            if n and self.stats.prefill_syncs == before_p:
+                self.stats.token_time_s.extend([dt / n] * n)
+        return dict(self._outputs)
+
+    def _budget_left(self, slot):
+        """Tokens this slot may still emit (host view, excludes ticks
+        already dispatched but not yet processed)."""
+        return self.max_new - len(self._outputs[self._slot_req[slot]])
+
+    def _horizon(self, slots, inflight):
+        """Largest power-of-two tick count ≤ k_max that fits every
+        dispatchable slot's remaining budget (powers of two bound the
+        decode_multi compile count, like the prefill buckets)."""
+        rem = min(self._budget_left(s) - inflight[s] for s in slots)
+        k = 1
+        while k * 2 <= min(rem, self.k_max):
+            k *= 2
+        return k
+
+    def _merge_carry(self, carry, admitted):
+        """Device-resident decode state for the next horizon. The carry
+        never round-trips through the host: newly admitted slots are
+        scattered into the in-flight arrays with device ops."""
+        S = self.d.max_batch
+        if carry is None:
+            done = np.array([r is None for r in self._slot_req])
+            rem = np.array([self._budget_left(s) if self._slot_req[s]
+                            is not None else 0 for s in range(S)],
+                           np.int32)
+            return (jnp.asarray(self._tokens), jnp.asarray(self._lens),
+                    jnp.asarray(done), jnp.asarray(rem))
+        if not admitted:
+            return carry
+        tokens, lens, done, rem = carry
+        idx = jnp.asarray(admitted, jnp.int32)
+        tokens = tokens.at[idx].set(jnp.asarray(self._tokens[admitted]))
+        lens = lens.at[idx].set(jnp.asarray(self._lens[admitted]))
+        done = done.at[idx].set(False)
+        rem = rem.at[idx].set(jnp.asarray(
+            [self._budget_left(s) for s in admitted], jnp.int32))
+        return tokens, lens, done, rem
+
+    def _process_block(self, meta, inflight, step_times,
+                       prefilled_since=False):
+        """Fetch + bookkeep one finished horizon. Called AFTER the next
+        horizon is dispatched, so the device→host wait overlaps it."""
+        block_d, done_before_d, k, rids, t0, had_prefill = meta
+        block = np.asarray(block_d)
+        done_before = np.asarray(done_before_d)
+        self.stats.decode_syncs += 1
+        emitted = 0
+        for s, rid in rids.items():
+            inflight[s] = max(0, inflight[s] - k)
+            if self._slot_req[s] != rid:
+                continue
+            for j in range(k):
+                if done_before[j, s]:
+                    break
+                tok = int(block[j, s])
+                self._outputs[rid].append(tok)
+                self.stats.tokens += 1
+                emitted += 1
+                self._lens[s] += 1
+                self._tokens[s] = tok
+                if (self.eos is not None and tok == self.eos) or \
+                        len(self._outputs[rid]) >= self.max_new:
+                    self._retire(s)
+                    break
+        dt = time.perf_counter() - t0
+        if step_times is not None:
+            step_times.append(dt)
+        # steady-state decode latency only: the block's dt window spans
+        # its dispatch iteration AND the next iteration up to this
+        # call, so a prefill in either (had_prefill at dispatch,
+        # prefilled_since at processing) would make p99 a prefill
+        # number — exclude such blocks from the percentiles (see
+        # _run_per_tick)
+        if emitted and not had_prefill and not prefilled_since:
+            self.stats.token_time_s.extend([dt / emitted] * emitted)
+
+    def _run_multi(self, step_times=None):
+        """Horizon-scheduled drain: dispatch a K-tick device-resident
+        block, then process the PREVIOUS block while the new one runs.
+        Retirement is one horizon delayed — a slot that finishes inside
+        block N stays frozen on device through block N+1 (done mask
+        carried on device; its K/V writes route to the scratch page)
+        and its pages are freed exactly once, when block N's results
+        land on the host."""
+        S = self.d.max_batch
+        pending = None               # the in-flight horizon's meta
+        carry = None                 # device (tokens, lens, done, rem)
+        inflight = [0] * S           # dispatched-not-yet-processed ticks
+        while (self._queue or pending is not None
+               or any(r is not None for r in self._slot_req)):
+            t0 = time.perf_counter()
+            admitted = self._admit()
+            carry = self._merge_carry(carry, admitted)
+            # invariant: for a live non-admitted slot, the device-side
+            # `remaining` equals budget_left - inflight exactly (both
+            # count init budget minus dispatched ticks), so a slot
+            # excluded here is always already frozen on device — its
+            # ticks in another slot's block are filler, never lost
+            # tokens
+            disp = [s for s in range(S) if self._slot_req[s] is not None
+                    and self._budget_left(s) - inflight[s] > 0]
+            meta = None
+            if disp:
+                k = self._horizon(disp, inflight)
+                if self._table_cache is None:
+                    self._table_cache = self._table(self._slot_pages,
+                                                    self.d)
+                tokens_d, lens_d, done_d, rem_d = carry
+                out = self.d.decode_multi(
+                    tokens_d, lens_d, self._table_cache, k,
+                    kids=self._kids, done=done_d, remaining=rem_d,
+                    eos=self.eos)
+                carry = (out.tokens, out.lens, out.done, out.remaining)
+                self.steps += k
+                self.stats.ticks += k
+                self.stats.occupancy.append(len(disp) / S)
+                for s in disp:
+                    inflight[s] += k
+                meta = (out.tokens_block, out.done_before, k,
+                        {s: self._slot_req[s] for s in disp}, t0,
+                        bool(admitted))
+            if pending is not None:
+                self._process_block(pending, inflight, step_times,
+                                    prefilled_since=bool(admitted))
+            pending = meta
         return dict(self._outputs)
 
 
@@ -768,7 +1242,10 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         if draft_decoder.max_batch != decoder.max_batch or \
                 draft_decoder.page_size != decoder.page_size:
             raise ValueError("draft/target max_batch and page_size must match")
-        super().__init__(decoder, eos_token_id, max_new_tokens)
+        # k_max=1: the verify cadence IS this engine's horizon — each
+        # step() already moves a k-token window; the draft's ticks are
+        # device-resident via decode_multi below
+        super().__init__(decoder, eos_token_id, max_new_tokens, k_max=1)
         self.draft = draft_decoder
         self.k = int(k)
         self._draft_free = list(range(draft_decoder.num_pages - 2, -1, -1))
@@ -795,10 +1272,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 f"prompt {len(ids)} + max_new {self.max_new} + margin "
                 f"{self.k} exceeds max_seq_len "
                 f"{min(self.d.cfg.max_seq_len, self.draft.cfg.max_seq_len)}")
-        rid = self._next_id
-        self._next_id += 1
-        self._queue.append((rid, [int(t) for t in ids]))
-        return rid
+        return self._register_request([int(t) for t in ids])
 
     def _gather_admissions(self):
         admitted = []
@@ -825,7 +1299,8 @@ class SpeculativeEngine(ContinuousBatchingEngine):
     def _extra_prefill(self, admitted):
         self.draft.prefill_batch(           # draft's guesses discarded
             [(ids, self._draft_pages[slot])
-             for slot, _, ids, _ in admitted])
+             for slot, _, ids, _ in admitted],
+            kids=[rid for _, rid, _, _ in admitted])
 
     def _after_admit(self, slot, prompt_len):
         self._dlens[slot] = prompt_len
@@ -850,27 +1325,22 @@ class SpeculativeEngine(ContinuousBatchingEngine):
 
         sampled = self.d.sampling is not None
 
-        # draft proposes k tokens (k cheap ticks over all slots)
-        proposals = np.zeros((self.d.max_batch, k), np.int32)
+        # draft proposes k tokens: K DEVICE-RESIDENT ticks in ONE
+        # compiled loop (decode_multi) — the proposal chain feeds back
+        # on device, so the k cheap ticks cost one dispatch + one fetch
+        # instead of k host round-trips
         qrows = None
-        d_in = self._tokens.copy()
-        dlens = self._dlens.copy()
-        for j in range(k):
-            if sampled and j < k - 1:
-                # the k-th draft's distribution is never judged
-                # (acceptance is capped at k-1): skip its transfer
-                nxt, qp = self.draft.decode(d_in, dlens, dtable,
-                                            return_probs=True)
-                if qrows is None:
-                    qrows = np.zeros((self.d.max_batch, k - 1,
-                                      qp.shape[-1]))
-                qrows[:, j] = qp
-                nxt = np.asarray(nxt)
-            else:
-                nxt = np.asarray(self.draft.decode(d_in, dlens, dtable))
-            proposals[:, j] = nxt
-            dlens = dlens + 1
-            d_in = nxt.astype(np.int32)
+        out = self.draft.decode_multi(self._tokens, self._dlens, dtable,
+                                      k, kids=self._kids,
+                                      return_logits=sampled)
+        proposals = np.asarray(out.tokens_block).T.astype(np.int32)
+        if sampled and k > 1:
+            # the k-th draft's distribution is never judged (acceptance
+            # is capped at k-1): skip its transfer
+            qp = self.draft._probs_of(out.logits_block[:k - 1])
+            qrows = np.moveaxis(qp, 0, 1)          # [S, k-1, V]
+        self.stats.ticks += k
+        self.stats.decode_syncs += 1
 
         # target verifies [cur, d1..dk] in one forward
         window = np.concatenate(
@@ -882,6 +1352,9 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             tgt = self.d.verify(window, self._lens, ttable)     # [S, k+1]
         self.target_calls += 1
         self.steps += 1
+        self.stats.ticks += 1
+        self.stats.decode_syncs += 1
+        self.stats.occupancy.append(len(active) / self.d.max_batch)
 
         for s in active:
             rid = self._slot_req[s]
@@ -907,6 +1380,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             done = False
             for t in emitted:
                 self._outputs[rid].append(t)
+                self.stats.tokens += 1
                 if (self.eos is not None and t == self.eos) or \
                         len(self._outputs[rid]) >= self.max_new:
                     done = True      # tokens speculated past the stop

@@ -481,3 +481,85 @@ def test_debug_diagnose_entry_point():
         context=AnalysisContext(name="convnet", data_format="NHWC"),
         print_report=False)
     assert report.by_rule("LAYOUT-ACT-TRANSPOSE")
+
+
+# -------------------------------------------------- serving decode loop
+
+def test_serving_rule_catches_undonated_cache_in_fused_loop():
+    """SERVE-HOST-SYNC-DECODE planted defect: the fused decode_multi
+    program with cache donation dropped (analysis_program(donate=False,
+    k=...)) is an ERROR — every K-tick horizon would copy the whole
+    paged KV store. The real capture (donated) stays clean, and the
+    rule is scoped: without extra["serving_decode"] it never fires."""
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import PagedGPTDecoder
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = GPT(gpt_tiny(max_seq_len=64, dtype="float32", remat=False))
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=8, page_size=16, max_batch=2)
+    pm = PassManager(["serving"])
+    ctx = AnalysisContext(name="decode", extra={"serving_decode": True})
+
+    good = dec.analysis_program(donate=True, k=2)
+    report = pm.run(good, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.metrics["serving"]["cache_donated"]
+    assert report.metrics["serving"]["n_device_loops"] >= 1
+
+    bad = dec.analysis_program(donate=False, k=2)
+    report2 = pm.run(bad, ctx)
+    hits = report2.by_rule("SERVE-HOST-SYNC-DECODE")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "KV-cache" in hits[0].message
+
+    # scope: the same defective program outside a serving context is
+    # not this rule's business (MEM-NO-DONATION-KVCACHE still warns)
+    report3 = pm.run(bad, AnalysisContext(name="decode"))
+    assert report3.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report3.metrics["serving"] == {"checked": False}
+
+
+def test_serving_rule_catches_host_callback_in_fused_loop():
+    """A host callback smuggled into a device-resident decode loop is
+    the per-tick round-trip the fused program exists to kill."""
+    def fused_loop_with_callback(tokens, k_pages):
+        def tick(carry, _):
+            t, kp = carry
+            jax.debug.print("tick {t}", t=t)     # the planted defect
+            t = t + 1
+            kp = kp + 1.0
+            return (t, kp), t
+        (tokens, k_pages), _ = jax.lax.scan(
+            tick, (tokens, k_pages), jnp.arange(4))
+        return tokens, k_pages
+
+    program = lower_callable(fused_loop_with_callback,
+                             jnp.zeros((2,), jnp.int32),
+                             jnp.zeros((4, 8), jnp.float32),
+                             name="decode_multi")
+    pm = PassManager(["serving"])
+    ctx = AnalysisContext(name="decode", extra={"serving_decode": True})
+    report = pm.run(program, ctx)
+    hits = report.by_rule("SERVE-HOST-SYNC-DECODE")
+    assert hits and any("host transfer" in h.message for h in hits)
+    assert report.metrics["serving"]["n_host_transfers"] >= 1
+
+    def clean_loop(tokens, k_pages):
+        def tick(carry, _):
+            t, kp = carry
+            return (t + 1, kp + 1.0), t
+        (tokens, k_pages), _ = jax.lax.scan(
+            tick, (tokens, k_pages), jnp.arange(4))
+        return tokens, k_pages
+
+    clean = lower_callable(clean_loop, jnp.zeros((2,), jnp.int32),
+                           jnp.zeros((4, 8), jnp.float32),
+                           name="decode_multi")
+    report2 = pm.run(clean, ctx)
+    # name-matched k_pages arg is undonated in this raw capture — only
+    # the cache finding may fire, never a host-transfer one
+    assert all("KV-cache" in h.message
+               for h in report2.by_rule("SERVE-HOST-SYNC-DECODE"))
+    assert report2.metrics["serving"]["n_host_transfers"] == 0

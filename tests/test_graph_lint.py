@@ -11,9 +11,15 @@ once per process no matter how many tests consume them.
 import pytest
 
 from paddle_tpu.analysis import PassManager, Severity, load_manifest
-from paddle_tpu.analysis.baseline import BASELINE_CONFIGS, lowered_program
+from paddle_tpu.analysis.baseline import (BASELINE_CONFIGS,
+                                          PROGRAM_CONFIGS,
+                                          lowered_program)
 
 pytestmark = pytest.mark.lint_graphs
+
+# every manifest-gated config: the five BASELINE model forwards plus
+# the PROGRAM captures (gpt_decode: the fused multi-step serving loop)
+ALL_CONFIGS = sorted(BASELINE_CONFIGS) + sorted(PROGRAM_CONFIGS)
 
 
 @pytest.fixture(scope="module")
@@ -21,7 +27,7 @@ def pass_manager():
     return PassManager()
 
 
-@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+@pytest.mark.parametrize("name", ALL_CONFIGS)
 def test_baseline_config_lints_clean(name, pass_manager):
     program, ctx, fwd = lowered_program(name)
     ctx.manifest = load_manifest(name)
@@ -37,7 +43,7 @@ def test_baseline_config_lints_clean(name, pass_manager):
     assert drift == [], "\n".join(str(f) for f in drift)
 
 
-@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+@pytest.mark.parametrize("name", ALL_CONFIGS)
 def test_manifest_findings_summary_is_current(name, pass_manager):
     """The manifest's findings_by_rule/max_severity mirror a fresh run
     (a rule silenced or newly firing without a manifest regen is itself
@@ -81,9 +87,26 @@ def test_gate_reports_metrics_per_analyzer(pass_manager):
     program, ctx, _ = lowered_program("resnet50")
     report = pass_manager.run(program, ctx)
     for analyzer in ("layout", "dtype", "host-transfer", "graph-shape",
-                     "collective"):
+                     "collective", "serving"):
         assert analyzer in report.metrics, analyzer
     assert report.metrics["layout"]["n_activation_transposes"] == 0
     assert report.metrics["graph-shape"]["op_counts"]["convolution"] == 53
+    # the serving rule only applies to decode-loop captures
+    assert report.metrics["serving"] == {"checked": False}
     # severity never reaches ERROR on the committed baseline
     assert report.max_severity in (None, Severity.INFO, Severity.WARNING)
+
+
+def test_gpt_decode_program_is_device_resident(pass_manager):
+    """The committed gpt_decode capture (fused K-tick decode loop) has
+    zero host transfers, a donated KV cache, and the ticks really lower
+    to a device loop (stablehlo.while), not K unrolled dispatches."""
+    program, ctx, _ = lowered_program("gpt_decode")
+    report = pass_manager.run(program, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.by_rule("MEM-NO-DONATION-KVCACHE") == []
+    m = report.metrics["serving"]
+    assert m["checked"] and m["cache_donated"]
+    assert m["n_host_transfers"] == 0
+    assert m["n_device_loops"] >= 1
+    assert m["n_cache_args"] == 2          # k_pages + v_pages

@@ -17,7 +17,9 @@ import pytest
 from paddle_tpu.analysis import (AnalysisContext, PassManager,
                                  estimate_jaxpr_memory,
                                  load_memory_manifest, manifest_drift)
-from paddle_tpu.analysis.baseline import BASELINE_CONFIGS, lowered_program
+from paddle_tpu.analysis.baseline import (BASELINE_CONFIGS,
+                                          PROGRAM_CONFIGS,
+                                          lowered_program)
 from paddle_tpu.analysis.lowering import ArgInfo
 
 pytestmark = pytest.mark.lint_memory
@@ -35,10 +37,13 @@ def _fresh_report(name, pm, with_manifest=True):
     return program, ctx, pm.run(program, ctx)
 
 
-@pytest.mark.parametrize("name", sorted(BASELINE_CONFIGS))
+@pytest.mark.parametrize(
+    "name", sorted(BASELINE_CONFIGS) + sorted(PROGRAM_CONFIGS))
 def test_memory_manifest_is_committed_and_current(name, pass_manager):
     """Gate: a fresh estimate agrees with the committed manifest (no
-    MEM-PEAK-REGRESSION / SHARD-WIRE-REGRESSION, no raw drift)."""
+    MEM-PEAK-REGRESSION / SHARD-WIRE-REGRESSION, no raw drift) — for
+    the five BASELINE forwards AND the PROGRAM captures (gpt_decode:
+    the fused multi-step serving loop)."""
     from paddle_tpu.analysis import build_memory_manifest
     program, ctx, report = _fresh_report(name, pass_manager)
     assert ctx.memory_manifest is not None, (

@@ -127,3 +127,67 @@ class TestWireSplit:
         mesh = build_mesh(dp=1)
         for a in mesh.axis_names:
             assert axis_host_count(mesh, a) == 1
+
+
+class TestDecodeHorizon:
+    """cost_model.decode_horizon: pricing the multi-step decode K from
+    the tick roofline vs the host sync cost."""
+
+    def test_tick_roofline_is_bytes_over_bandwidth(self):
+        from paddle_tpu.cost_model import (chip_spec,
+                                           decode_tick_roofline_s)
+        chip = chip_spec("v5e")
+        assert decode_tick_roofline_s(chip.hbm_bw, chip=chip) == \
+            pytest.approx(1.0)
+
+    def test_horizon_scales_with_host_overhead_share(self):
+        from paddle_tpu.cost_model import chip_spec, decode_horizon
+        chip = chip_spec("v5e")
+        tick_s = 1e-3
+        step_bytes = int(tick_s * chip.hbm_bw)
+        # sync cost == 10% of a tick: K=1 already meets the 10% bar
+        assert decode_horizon(step_bytes, host_sync_s=1e-4,
+                              chip=chip) == 1
+        # sync cost == 8 ticks: need K=80 to amortize to 10% -> capped
+        assert decode_horizon(step_bytes, host_sync_s=8e-3, chip=chip,
+                              k_cap=32) == 32
+        # mid-range: h/(K*t) <= 0.1 with h = t -> K = 10
+        assert decode_horizon(step_bytes, host_sync_s=1e-3,
+                              chip=chip) == 10
+
+    def test_horizon_monotone_in_model_size(self):
+        """Bigger models (longer ticks) need smaller K; a micro model
+        prices to the cap."""
+        from paddle_tpu.cost_model import decode_horizon
+        h = 5e-4
+        ks = [decode_horizon(b, host_sync_s=h, chip="v5e")
+              for b in (10**6, 10**9, 10**11)]
+        assert ks == sorted(ks, reverse=True)
+        assert ks[0] == 32 and ks[-1] == 1
+
+    def test_measured_host_sync_is_cached_and_sane(self):
+        from paddle_tpu.cost_model import measured_host_sync_s
+        s = measured_host_sync_s()
+        assert 1e-6 <= s < 1.0
+        assert measured_host_sync_s() == s        # memoized
+
+    def test_engine_defaults_to_priced_horizon(self):
+        """ContinuousBatchingEngine with no k_max asks decode_horizon;
+        on a CPU dev box the tiny decoder's tick roofline is far below
+        the measured sync cost, so the priced K lands at the cap."""
+        import paddle_tpu as paddle
+        from paddle_tpu.cost_model import decode_horizon
+        from paddle_tpu.distributed import build_mesh
+        from paddle_tpu.models import GPT, gpt_tiny
+        from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                        PagedGPTDecoder)
+        paddle.seed(0)
+        build_mesh(dp=1)
+        model = GPT(gpt_tiny(max_seq_len=64, dtype="float32",
+                             remat=False))
+        model.eval()
+        dec = PagedGPTDecoder(model, num_pages=8, page_size=16,
+                              max_batch=2)
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=4)
+        assert eng.k_max == decode_horizon(dec.step_hbm_bytes())
+        assert eng.k_max >= 1

@@ -90,3 +90,31 @@ def test_cli_autotune_prints_table(capsys):
     out = capsys.readouterr().out
     assert "autotune: gpt" in out
     assert "recompute FLOPs" in out
+
+
+def test_cli_autotune_builds_custom_spec_once(tmp_path, monkeypatch,
+                                              capsys):
+    """A custom module:builder spec with --autotune runs the user's
+    builder ONCE — lint and the tuning report share the same build
+    (the CLI used to call the builder a second time for the tuning
+    path)."""
+    counter = tmp_path / "builds.txt"
+    (tmp_path / "cli_spec_mod.py").write_text(
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "from paddle_tpu.distributed import build_mesh\n"
+        "def build():\n"
+        f"    with open({str(counter)!r}, 'a') as f:\n"
+        "        f.write('x')\n"
+        "    paddle.seed(0)\n"
+        "    build_mesh(dp=1)\n"
+        "    net = paddle.nn.Linear(8, 8)\n"
+        "    return net, (np.zeros((4, 8), 'float32'),)\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    from paddle_tpu.analysis.__main__ import main
+    rc = main(["cli_spec_mod:build", "--autotune", "--no-manifest-check",
+               "--fail-on", "never"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "autotune" in out and "recompute FLOPs" in out
+    assert counter.read_text() == "x", "builder called more than once"
