@@ -30,6 +30,11 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=1)
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--multi-step", type=int, default=0,
+                    help="fuse N train steps into one compiled scan "
+                         "(1 = per-step loop; 0 = auto: price N from the "
+                         "roofline step time vs the measured host sync "
+                         "cost, cost_model.train_horizon)")
     args = ap.parse_args()
 
     import jax
@@ -68,9 +73,12 @@ def main():
     mgr = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
 
     if args.data:
-        loader = TokenLoader(args.data, args.batch, args.seq)
+        # NOT named `loader`: the DeviceLoader below rebinds that name
+        # before this lazy generator first runs, and the closure would
+        # then feed the DeviceLoader on itself (generator reentrancy)
+        token_loader = TokenLoader(args.data, args.batch, args.seq)
         def batches():
-            for window in loader:
+            for window in token_loader:
                 yield {"input_ids": window[:, :-1], "labels": window[:, 1:]}
     else:
         rng = np.random.RandomState(0)
@@ -80,30 +88,81 @@ def main():
                 yield {"input_ids": ids[:, :-1].astype("int32"),
                        "labels": ids[:, 1:].astype("int32")}
 
+    # multi-step horizon: N fused steps per compiled dispatch, host
+    # contact only at horizon boundaries. --multi-step 0 prices N the
+    # decode_horizon way: roofline step time (analytic FLOPs of the REAL
+    # traced step vs the chip) against the measured host sync cost.
+    n_multi = args.multi_step
+    if n_multi <= 0:
+        from paddle_tpu.cost_model import (jaxpr_flops, roofline_step_time,
+                                           train_horizon)
+        probe = {"input_ids": np.zeros((args.batch, args.seq), np.int32),
+                 "labels": np.zeros((args.batch, args.seq), np.int32)}
+        flops = jaxpr_flops(trainer.analysis_program(probe).jaxpr)
+        # HBM leg: f32 params + Adam m/v, each read AND written once
+        # per step: 3 tensors x 4 bytes x 2 directions = 24 bytes/param
+        hbm = 24 * cfg.num_params()
+        step_s = roofline_step_time(flops, hbm).step_s
+        n_multi = train_horizon(step_s)
+        print(f"train_horizon: roofline step {step_s*1e3:.2f} ms -> N={n_multi}")
+    n_multi = max(1, min(int(n_multi), args.steps))
+
     # async input pipeline: token assembly + sharded H2D copy run in a
-    # background thread, two batches ahead of the compiled step; losses
-    # stay on-device and sync once per log window (LossBuffer)
+    # background thread, two batches ahead of the compiled step; with
+    # N>1 the loader stacks N batches per horizon in the worker thread.
+    # Losses stay on-device and sync once per log window / horizon
+    # boundary (LossBuffer accepts the [N] horizon vectors).
     loader = DeviceLoader(batches(), depth=2)
-    losses = LossBuffer(drain_every=10)
+    losses = LossBuffer(drain_every=max(10, n_multi))
     t0 = time.time()
-    for step, batch in enumerate(loader):
+    feed = iter(loader) if n_multi == 1 else loader.stack(n_multi)
+    step = 0
+    log_every = 10 if n_multi == 1 else n_multi * ((10 + n_multi - 1)
+                                                   // n_multi)
+    import jax
+    for item in feed:
         if step >= args.steps:
             break
-        losses.append(trainer.step(batch))
-        if step % 10 == 0:
+        if n_multi == 1:
+            losses.append(trainer.step(item))
+            step += 1
+        else:
+            # a finite --data source can yield a final stack m < n deep
+            m = jax.tree_util.tree_leaves(item)[0].shape[0]
+            if m == n_multi and step + n_multi <= args.steps:
+                losses.append(trainer.step_multi(item))
+                step += n_multi
+            else:
+                # partial final horizon (short stack OR --steps
+                # boundary): per-step fallback over slices of the
+                # stacked feed (no fresh m-step scan compile)
+                for i in range(min(m, args.steps - step)):
+                    losses.append(trainer.step(
+                        jax.tree_util.tree_map(lambda v: v[i], item)))
+                    step += 1
+        if step % log_every == 0 or step >= args.steps:
             dt = time.time() - t0
-            tok_s = args.batch * args.seq * (step + 1) / max(dt, 1e-9)
+            tok_s = args.batch * args.seq * step / max(dt, 1e-9)
             print(f"step {step}: loss={losses.drain():.4f} "
-                  f"({tok_s:.0f} tok/s, lr={opt.get_lr():.2e})")
-        if mgr and step and step % 100 == 0:
+                  f"({tok_s:.0f} tok/s, {step/max(dt,1e-9):.1f} steps/s, "
+                  f"lr={opt.get_lr():.2e})")
+        # checkpoint ticks land on horizon boundaries by construction
+        # (this loop only sees whole horizons)
+        if mgr and step and step % 100 < n_multi and step >= 100:
             losses.drain()          # sync before touching host state
             trainer.sync_to_model()
             mgr.save(step, {"model": model.state_dict(),
                             "opt": opt.state_dict(), "step": step})
     losses.drain()
+    if hasattr(feed, "close"):
+        feed.close()
     loader.close()
-    print(f"done: {args.steps} steps in {time.time()-t0:.1f}s "
-          f"(input pipeline: {loader.stats.snapshot()})")
+    dt = time.time() - t0
+    syncs_per_step = losses.fetches / max(step, 1)
+    print(f"done: {step} steps in {dt:.1f}s = "
+          f"{step/max(dt,1e-9):.2f} train_steps_per_sec "
+          f"(multi_step N={n_multi}, {syncs_per_step:.3f} host syncs/step; "
+          f"input pipeline: {loader.stats.snapshot()})")
 
 
 if __name__ == "__main__":

@@ -15,7 +15,7 @@ from .pass_manager import Analyzer, register_analyzer
 
 __all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
            "GraphShapeAnalyzer", "CollectiveAnalyzer", "ServingAnalyzer",
-           "COLLECTIVE_OPS", "MXU_OPS"]
+           "TrainingAnalyzer", "COLLECTIVE_OPS", "MXU_OPS"]
 
 MXU_OPS = ("dot_general", "convolution")
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
@@ -296,6 +296,79 @@ class ServingAnalyzer(Analyzer):
                         "n_device_loops": program.count("while"),
                         "cache_donated": not undonated,
                         "n_cache_args": len(cache)}
+        return findings
+
+
+@register_analyzer
+class TrainingAnalyzer(Analyzer):
+    """HOST-SYNC-TRAIN: a fused multi-step TRAINING program (the
+    `Trainer.step_multi` scan, context extra["train_multi"]=True) must
+    be fully device-resident — zero host transfers inside the N-tick
+    scan (a callback/infeed in the body pays a host round-trip PER
+    STEP, exactly the dispatch cost the fused loop exists to kill), a
+    DONATED carry (params/opt-state/grad-transform-state/consts thread
+    through the scan; an undonated carry double-buffers the whole model
+    state every horizon), and a real `stablehlo.while` (N ticks lowered
+    to one device loop, not N unrolled step bodies — an unrolled
+    horizon compiles N× slower and re-pays dispatch per tick on some
+    backends). The serving twin is SERVE-HOST-SYNC-DECODE; both rules
+    share `_host_transfer_ops`, so a new callback pattern reaches
+    training and serving alike. Metrics pin the device-loop count and
+    carry donation through the committed manifests."""
+    name = "training"
+
+    #: arg roles that form the fused scan's carried state
+    CARRY_ROLES = ("param", "opt_state", "gt_state", "const")
+
+    def run(self, program, ctx):
+        if not ctx.extra.get("train_multi"):
+            self.metrics = {"checked": False}
+            return []
+        findings = []
+        callbacks, data_ops = _host_transfer_ops(program, ctx)
+        n_host = len(callbacks) + len(data_ops)
+        for op, target in callbacks:
+            findings.append(Finding(
+                "HOST-SYNC-TRAIN", Severity.ERROR,
+                f"host transfer `{target}` inside the fused train scan "
+                "— every tick re-interposes the host, the per-step "
+                "round-trip step_multi exists to eliminate",
+                op=op.line,
+                suggested_fix="move the callback out of the step body; "
+                "metrics/logging belong at horizon boundaries "
+                "(LossBuffer drains), not inside the compiled loop"))
+        for op in data_ops:
+            findings.append(Finding(
+                "HOST-SYNC-TRAIN", Severity.ERROR,
+                f"{op.name} op inside the fused train scan (host data "
+                "dependency per step)", op=op.line))
+        carry = [i for i in (getattr(program, "arg_infos", None) or [])
+                 if i.role in self.CARRY_ROLES]
+        undonated = [i for i in carry if not i.donated]
+        if undonated:
+            names = ", ".join(sorted(i.name or "?" for i in undonated)[:4])
+            findings.append(Finding(
+                "HOST-SYNC-TRAIN", Severity.ERROR,
+                f"scan carry state ({names}, ...) is not donated into "
+                "the fused train loop — every horizon would keep two "
+                "resident copies of params/opt-state",
+                suggested_fix="Trainer(donate=True) (the default) "
+                "threads the carry through donate_argnums"))
+        n_loops = program.count("while")
+        if carry and n_loops == 0:
+            findings.append(Finding(
+                "HOST-SYNC-TRAIN", Severity.ERROR,
+                "the N train ticks did not lower to a device loop (no "
+                "stablehlo.while): the horizon unrolled into N step "
+                "bodies",
+                suggested_fix="keep the horizon in ONE lax.scan "
+                "(Trainer._build_multi); unrolled bodies blow compile "
+                "time and code size linearly in N"))
+        self.metrics = {"checked": True,
+                        "n_host_transfers": n_host,
+                        "n_device_loops": n_loops,
+                        "carry_donated": not undonated,
+                        "n_carry_args": len(carry)}
         return findings
 
 

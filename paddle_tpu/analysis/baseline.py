@@ -152,13 +152,53 @@ def _gpt_decode():
     return program, ctx, PagedGPTDecoder._decode_multi_step
 
 
+def _gpt_train_multi():
+    """The fused multi-step TRAINING config: `Trainer.step_multi`'s
+    N=4 scan over a leading-stacked batch (donated params/opt-state/
+    consts carry, [N] lr vector, unfetched [N] loss output) captured
+    via `Trainer.analysis_program(batch, n=4)` — a PROGRAM config like
+    gpt_decode (the capture is a whole train step, not a Layer
+    forward; no tuning manifest — the remat advisor already covers the
+    single-step twin). The HOST-SYNC-TRAIN rule gates it: zero host
+    transfers inside the scan, donated carry, a real device loop."""
+    paddle = _fresh()
+    from paddle_tpu.distributed.trainer import Trainer
+    from paddle_tpu.models import GPT, GPTPretrainingCriterion, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    cfg = gpt_tiny(max_seq_len=32, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.train()
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(m, batch):
+        logits = m(paddle.to_tensor(batch["input_ids"]))
+        return crit(logits, paddle.to_tensor(batch["labels"]))
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    trainer = Trainer(model, opt, loss_fn)
+    batch = {"input_ids": jnp.zeros((2, 32), jnp.int32),
+             "labels": jnp.zeros((2, 32), jnp.int32)}
+    program = trainer.analysis_program(batch, n=4)
+    ctx = AnalysisContext(
+        name="gpt_train_multi",
+        # backward pass: the weight-grad matmul (x^T . dy) flips one
+        # 2-D operand — by-design in every train step, rides with the
+        # dense model's attention transposes
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + (r"dims = \[1, 0\] : \(tensor<\d+x\d+xf32>\)",),
+        expect_collectives=False,
+        extra={"train_multi": True})
+    return program, ctx, Trainer._build_multi
+
+
 # configs whose builder yields a READY LoweredProgram (serving decode
 # loops and other non-Layer captures): builder() ->
 # (LoweredProgram, AnalysisContext, source_fn). They ride the same
 # lint/memory manifest + CI plumbing as BASELINE_CONFIGS but skip the
 # tuning manifests (no grad program to replay).
 PROGRAM_CONFIGS = {
-    "gpt_decode": _gpt_decode,    # fused multi-step serving decode
+    "gpt_decode": _gpt_decode,       # fused multi-step serving decode
+    "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
 
 

@@ -32,7 +32,7 @@ __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
            "eqn_flops", "jaxpr_flops", "RooflineTime",
            "roofline_step_time", "decode_tick_roofline_s",
-           "decode_horizon", "measured_host_sync_s"]
+           "decode_horizon", "train_horizon", "measured_host_sync_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -267,6 +267,32 @@ def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
         return int(k_cap)
     k = math.ceil(host_sync_s / (sync_overhead_frac * t))
     return int(min(max(k, 1), int(k_cap)))
+
+
+def train_horizon(step_s, host_sync_s=None, n_cap=32,
+                  sync_overhead_frac=0.10):
+    """Best multi-step TRAINING horizon N — how many fused train steps
+    `Trainer.step_multi` should scan per host dispatch (the `decode_horizon`
+    pricing applied to training: `step_s` is the step's analytic floor,
+    normally `roofline_step_time(...).step_s`, though a measured step
+    time prices identically).
+
+    With N steps fused, per-step overhead ≈ h/N where h is the host
+    cost of one dispatch+fetch sync (`measured_host_sync_s`). Pick the
+    smallest N that keeps the sync share at or below
+    `sync_overhead_frac` of the step floor (h/(N·step_s) ≤ frac),
+    capped at `n_cap` (horizon granularity: logging/checkpoint/callback
+    latency grows with N, and each distinct N compiles one scan
+    program). Small models price to the cap — eager host overhead
+    dominates their step; a 1.3B step dwarfs the sync cost and prices
+    N=1, where fusing gains nothing."""
+    import math
+    if host_sync_s is None:
+        host_sync_s = measured_host_sync_s()
+    if step_s is None or step_s <= 0:
+        return int(n_cap)
+    n = math.ceil(host_sync_s / (sync_overhead_frac * step_s))
+    return int(min(max(n, 1), int(n_cap)))
 
 
 # jaxpr primitive names -> the StableHLO collective they lower to, so

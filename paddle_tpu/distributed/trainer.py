@@ -76,34 +76,47 @@ class LossBuffer:
     loss — calling `float(loss)` every step blocks the host on step N and
     stalls dispatch of N+1 (the dispatch-queue bubble docs/performance.md
     rule 4 warns about). A LossBuffer holds the unfetched losses and
-    syncs ONCE per `drain_every` appends, so the host keeps running ahead
-    of the device.
+    syncs ONCE per `drain_every` appended STEPS, so the host keeps
+    running ahead of the device.
 
         buf = LossBuffer(drain_every=10)
         for batch in loader:
             buf.append(trainer.step(batch))   # no host sync here
         print(buf.drain())                    # final sync + last loss
 
-    `maxlen` bounds the drained-history list; `fetches` counts host
-    syncs (observability: it must stay ~steps/drain_every)."""
+    Appends accept both a scalar device loss (`Trainer.step`) and a
+    length-N horizon loss vector (`Trainer.step_multi`) — a vector
+    counts as N steps toward `drain_every` and drains in step order, so
+    mixed per-step / multi-step loops share one buffer. `maxlen` bounds
+    the drained-history list; `fetches` counts REAL host syncs
+    (observability: it must stay ~steps/drain_every)."""
 
     def __init__(self, drain_every=16, maxlen=65536):
         self.drain_every = max(1, int(drain_every))
         self.maxlen = maxlen
         self._pending = []
+        self._pending_steps = 0
         self.losses = []     # drained python floats, oldest first
         self.fetches = 0     # number of host syncs issued
 
+    @staticmethod
+    def _steps_of(loss):
+        """1 for a scalar loss, N for a [N] horizon vector — read from
+        shape metadata only (never fetches)."""
+        shape = getattr(loss, "shape", ())
+        return int(shape[0]) if shape else 1
+
     def append(self, loss):
         self._pending.append(loss)
-        if len(self._pending) >= self.drain_every:
+        self._pending_steps += self._steps_of(loss)
+        if self._pending_steps >= self.drain_every:
             self.drain()
         return self
 
     @property
     def pending(self):
-        """Dispatched-but-unfetched loss count."""
-        return len(self._pending)
+        """Dispatched-but-unfetched loss (step) count."""
+        return self._pending_steps
 
     @property
     def last(self):
@@ -112,18 +125,26 @@ class LossBuffer:
 
     def drain(self):
         """Fetch every pending loss in one host sync; returns the latest
-        loss value."""
+        loss value. Horizon vectors flatten in append order, so the
+        drained stream is the per-step loss sequence regardless of how
+        the steps were dispatched."""
         if self._pending:
             vals = jax.device_get(self._pending)
             self.fetches += 1
-            self.losses.extend(float(np.asarray(v)) for v in vals)
+            for v in vals:
+                arr = np.asarray(v)
+                if arr.ndim:
+                    self.losses.extend(float(x) for x in arr)
+                else:
+                    self.losses.append(float(arr))
             self._pending = []
+            self._pending_steps = 0
             if self.maxlen and len(self.losses) > self.maxlen:
                 del self.losses[:len(self.losses) - self.maxlen]
         return self.last
 
     def __len__(self):
-        return len(self.losses) + len(self._pending)
+        return len(self.losses) + self._pending_steps
 
 
 class Trainer:
@@ -187,6 +208,9 @@ class Trainer:
         self._batch_spec = tuple(batch_spec)
         self._batch_shardings = {}
         self._placed_steps = {}
+        # fused multi-step programs, keyed by the STACKED batch signature
+        # (which encodes the horizon length N in the leading dim)
+        self._placed_multis = {}
 
     def _mesh_place(self, tree):
         """Replicate any single-device leaf onto the full mesh. A state
@@ -205,18 +229,21 @@ class Trainer:
             return v
         return jax.tree_util.tree_map(fix, tree)
 
-    def _build(self, donate, in_shardings=None):
+    def _build_body(self):
+        """The ONE single-step body: (params, opt_state, gt_state,
+        consts, lr, batch) -> (params, opt_state, gt_state, consts,
+        fp32 loss). `step()`'s jit wraps it directly and every tick of
+        `step_multi`'s fused scan runs it under the scan carry — the
+        same closure, so the two paths cannot drift (the serving
+        `_forward_tokens` pattern). Callers apply the per-step RNG salt
+        (`traced_salt`) themselves: the jit wrapper once, the scan once
+        per tick with the carried counter."""
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
         accum = self.grad_accum_steps
 
         compute_loss = make_compute_loss(model, loss_fn)
 
         grad_transform = self.grad_transform
-
-        def step(params, opt_state, gt_state, consts, lr, batch):
-            from ..framework.random import traced_salt
-            with traced_salt(consts.get(_RNG_STEP)):
-                return _inner(params, opt_state, gt_state, consts, lr, batch)
 
         def _inner(params, opt_state, gt_state, consts, lr, batch):
             if accum <= 1:
@@ -254,6 +281,16 @@ class Trainer:
                 new_consts[_RNG_STEP] = consts[_RNG_STEP] + 1
             return new_params, new_state, gt_state, new_consts, loss_v
 
+        return _inner
+
+    def _build(self, donate, in_shardings=None):
+        _inner = self._build_body()
+
+        def step(params, opt_state, gt_state, consts, lr, batch):
+            from ..framework.random import traced_salt
+            with traced_salt(consts.get(_RNG_STEP)):
+                return _inner(params, opt_state, gt_state, consts, lr, batch)
+
         kwargs = {}
         if in_shardings is not None:
             kwargs["in_shardings"] = in_shardings
@@ -265,6 +302,42 @@ class Trainer:
             kwargs["out_shardings"] = state_sh + (
                 NamedSharding(self.mesh, PartitionSpec()),)   # fp32 loss
         return jax.jit(step, donate_argnums=(0, 1, 2, 3) if donate else (),
+                       **kwargs)
+
+    def _build_multi(self, donate, in_shardings=None):
+        """N train steps fused into ONE jitted lax.scan over a
+        leading-stacked batch pytree ([N, ...] leaves) and an [N] lr
+        vector, params/opt-state/grad-transform-state/consts threaded
+        through the donated carry. The scan body is `_build_body()` —
+        the SAME closure `step()` compiles — so fused and per-step loops
+        cannot drift. Returns the length-N loss vector UNFETCHED: host
+        contact happens only when the caller drains it."""
+        _inner = self._build_body()
+
+        def multi_step(params, opt_state, gt_state, consts, lrs, batches):
+            from ..framework.random import traced_salt
+
+            def tick(carry, xs):
+                params, opt_state, gt_state, consts = carry
+                lr, batch = xs
+                with traced_salt(consts.get(_RNG_STEP)):
+                    p, o, g, c, loss = _inner(params, opt_state, gt_state,
+                                              consts, lr, batch)
+                return (p, o, g, c), loss
+
+            carry = (params, opt_state, gt_state, consts)
+            (params, opt_state, gt_state, consts), losses = jax.lax.scan(
+                tick, carry, (lrs, batches))
+            return params, opt_state, gt_state, consts, losses
+
+        kwargs = {}
+        if in_shardings is not None:
+            kwargs["in_shardings"] = in_shardings
+            state_sh = in_shardings[:4]
+            kwargs["out_shardings"] = state_sh + (
+                NamedSharding(self.mesh, PartitionSpec()),)  # [N] f32 losses
+        return jax.jit(multi_step,
+                       donate_argnums=(0, 1, 2, 3) if donate else (),
                        **kwargs)
 
     # -- batch placement ----------------------------------------------------
@@ -320,6 +393,108 @@ class Trainer:
             self._placed_steps[sig] = fn
         return fn
 
+    def place_horizon(self, batches):
+        """Normalize a training horizon onto the mesh: `batches` is
+        either a list/tuple of N per-step batch pytrees (host numpy or
+        device-resident — stacked here, `io.prefetch.stack_batches`) or
+        an already leading-stacked pytree (`DeviceLoader.stack(n)`
+        output). Leaves land as [N, B, ...] arrays with the scan dim
+        replicated and the per-step batch dim sharded over the data axes
+        — the layout the fused scan pins as its batch in_shardings, so
+        every feed path hits ONE compiled program per (N, signature)."""
+        from ..io.prefetch import (_leaf_arrays, batch_signature,
+                                   horizon_shardings, stack_batches)
+        if isinstance(batches, (list, tuple)):
+            arrays = stack_batches(batches)
+        else:
+            arrays = _leaf_arrays(batches)
+        sig = ("multi", batch_signature(arrays))
+        sh = self._batch_shardings.get(sig)
+        if sh is None:
+            sh = horizon_shardings(arrays, self.mesh, self._batch_spec)
+            self._batch_shardings[sig] = sh
+        return jax.device_put(arrays, sh), sig, sh
+
+    def _placed_multi(self, sig, horizon_sh):
+        """Compiled fused-scan step specialized to one stacked-batch
+        signature (the horizon length N rides in the signature's leading
+        dim), shardings pinned like `_placed_step` (same fallback
+        contract when a state leaf has no derivable sharding)."""
+        fn = self._placed_multis.get(sig)
+        if fn is None:
+            try:
+                leaf_sh = lambda v: v.sharding  # noqa: E731
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                in_sh = (
+                    jax.tree_util.tree_map(leaf_sh, self.params),
+                    jax.tree_util.tree_map(leaf_sh, self.opt_state),
+                    (jax.tree_util.tree_map(leaf_sh, self.gt_state)
+                     if self.gt_state is not None else None),
+                    jax.tree_util.tree_map(leaf_sh, self.consts),
+                    rep,                                 # [N] lr vector
+                    horizon_sh)
+                fn = self._build_multi(self._donate, in_shardings=in_sh)
+            except (AttributeError, TypeError) as e:
+                import warnings
+                warnings.warn(
+                    "Trainer: could not derive in_shardings for the "
+                    f"fused multi-step program ({e!r}); falling back to "
+                    "the unpinned jit (batch resharding inside the scan)")
+                fn = self._build_multi(self._donate)
+            self._placed_multis[sig] = fn
+        return fn
+
+    def _horizon_lrs(self, n):
+        """Precompute the next `n` per-step learning rates HOST-SIDE by
+        advancing the real scheduler — `get_lr()` then `sched.step()`
+        per tick, exactly what n calls of `step()` would do — so
+        warmup/decay boundaries falling MID-horizon feed the scan the
+        same lr sequence the per-step loop would see."""
+        sched = self.optimizer._lr_scheduler
+        lrs = []
+        for _ in range(int(n)):
+            lrs.append(float(self.optimizer.get_lr()))
+            if sched is not None:
+                sched.step()
+        return np.asarray(lrs, np.float32)
+
+    def step_multi(self, batches, lrs=None):
+        """Dispatch N train steps as ONE compiled `lax.scan`
+        (`_build_multi`): one host dispatch per horizon instead of per
+        step, donated state threaded through the carry, per-step lrs
+        precomputed host-side (default: the optimizer's scheduler,
+        advanced exactly as N `step()` calls would). `batches` is a
+        list of N batch pytrees or a leading-stacked pytree
+        (`DeviceLoader.stack(n)`). NON-BLOCKING: returns the [N] fp32
+        loss vector unfetched — drain it through a `LossBuffer` (vector
+        appends are supported) so host contact stays at horizon
+        boundaries."""
+        arrays, sig, horizon_sh = self.place_horizon(batches)
+        n = jax.tree_util.tree_leaves(arrays)[0].shape[0]
+        if lrs is None:
+            lrs = self._horizon_lrs(n)
+        else:
+            lrs = np.asarray(lrs, np.float32)
+            if lrs.shape != (n,):
+                raise ValueError(
+                    f"step_multi: lrs shape {lrs.shape} != ({n},)")
+            # parity with step(batch, lr=x), which advances the
+            # scheduler even under an explicit lr: N explicit-lr steps
+            # leave the scheduler N positions further along
+            sched = self.optimizer._lr_scheduler
+            if sched is not None:
+                for _ in range(int(n)):
+                    sched.step()
+        fn = self._placed_multi(sig, horizon_sh)
+        (self.params, self.opt_state, self.gt_state, self.consts,
+         losses) = fn(
+            self.params, self.opt_state, self.gt_state, self.consts,
+            jnp.asarray(lrs), arrays)
+        # horizon-aware step accounting: state()/load_state round-trip
+        # the TRUE device step count, not the host dispatch count
+        self._host_step += int(n)
+        return losses
+
     def lower_step(self, batch, lr=0.0):
         """Lower the SAME specialized program `step()` dispatches for this
         batch's signature (in/out shardings pinned) — the honest target
@@ -331,19 +506,34 @@ class Trainer:
         return fn.lower(self.params, self.opt_state, self.gt_state,
                         self.consts, lr, arrays)
 
-    def analysis_program(self, batch, lr=0.0):
+    def analysis_program(self, batch, lr=0.0, n=None):
         """Graph Doctor view of the SAME specialized step `step()`
         dispatches: one trace yields the StableHLO text AND jaxpr, plus
         per-argument capture of role (param / opt_state / gt_state /
         const / lr / batch), sharding (shard count per leaf, from the
         pinned in_shardings), and donation — everything the memory and
         sharding passes need for per-device peak-HBM estimation and
-        replication lint that the HLO text alone can't recover."""
+        replication lint that the HLO text alone can't recover.
+
+        With `n` the FUSED multi-step program (`step_multi`, N ticks in
+        one lax.scan over `batch` stacked N deep) is traced instead —
+        the HOST-SYNC-TRAIN rule checks it for host transfers, donated
+        carry, and a real device loop."""
         from ..analysis.lowering import LoweredProgram, tree_arg_infos
-        arrays, sig, batch_sh = self.place_batch(batch)
-        fn = self._placed_step(sig, batch_sh)
-        traced = fn.trace(self.params, self.opt_state, self.gt_state,
-                          self.consts, lr, arrays)
+        if n:
+            stacked = [batch] * int(n)
+            arrays, sig, batch_sh = self.place_horizon(stacked)
+            fn = self._placed_multi(sig, batch_sh)
+            lrs = jnp.full((int(n),), float(lr), jnp.float32)
+            traced = fn.trace(self.params, self.opt_state, self.gt_state,
+                              self.consts, lrs, arrays)
+            lr_arg, name = lrs, f"train_multi_n{int(n)}"
+        else:
+            arrays, sig, batch_sh = self.place_batch(batch)
+            fn = self._placed_step(sig, batch_sh)
+            traced = fn.trace(self.params, self.opt_state, self.gt_state,
+                              self.consts, lr, arrays)
+            lr_arg, name = lr, "train_step"
         donate = bool(self._donate)
         infos = tree_arg_infos(self.params, "param", donated=donate)
         infos += tree_arg_infos(self.opt_state, "opt_state",
@@ -352,10 +542,10 @@ class Trainer:
             infos += tree_arg_infos(self.gt_state, "gt_state",
                                     donated=donate)
         infos += tree_arg_infos(self.consts, "const", donated=donate)
-        infos += tree_arg_infos(lr, "lr")
+        infos += tree_arg_infos(lr_arg, "lr")
         infos += tree_arg_infos(arrays, "batch", shardings=batch_sh)
         return LoweredProgram(traced.lower().as_text(),
-                              jaxpr=traced.jaxpr, name="train_step",
+                              jaxpr=traced.jaxpr, name=name,
                               arg_infos=infos)
 
     def suggest_config(self, batch, hbm_budget=None, **kw):
@@ -434,5 +624,6 @@ class Trainer:
         self._host_step = int(state.get("step", 0))
         # restored leaves may carry different shardings (resharded mesh,
         # default-placed opt state): drop the specialized steps so the next
-        # step() re-derives in_shardings from the actual arrays
+        # step()/step_multi() re-derives in_shardings from the actual arrays
         self._placed_steps = {}
+        self._placed_multis = {}

@@ -28,6 +28,7 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._compiled_step = None
+        self._compiled_multi = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -38,7 +39,11 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
 
     # -- compiled train step -------------------------------------------------
-    def _build_train_step(self):
+    def _train_step_body(self):
+        """The ONE single-step body shared by `_build_train_step`'s jit
+        and every tick of `_build_train_multi_step`'s fused scan — the
+        two paths cannot drift (`distributed.trainer._build_body`
+        pattern)."""
         net = self.network
         loss_fn = self._loss
         opt = self._optimizer
@@ -66,6 +71,10 @@ class Model:
             new_params, new_state = opt.apply_gradients_pytree(params, grads, opt_state, lr)
             return new_params, new_state, {**buffers, **updates}, loss_v, out
 
+        return step
+
+    @staticmethod
+    def _donate_argnums():
         # Donating params/opt_state lets XLA alias the new state into the
         # old buffers — the memory win training needs on TPU. But this
         # jaxlib's ASYNC CPU client can release a donated input buffer
@@ -75,21 +84,56 @@ class Model:
         # tests/test_hapi_fit.py, reproduced at 2/8 on the pristine tree
         # and 0/10 with donation off). CPU runs are functional tests, not
         # memory-bound — skip donation there, keep it on real chips.
-        donate = () if jax.default_backend() == "cpu" else (0, 2)
-        return jax.jit(step, donate_argnums=donate)
+        return () if jax.default_backend() == "cpu" else (0, 2)
 
-    def train_batch(self, inputs, labels=None, update=True, fetch=True):
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
-        net = self.network
-        net.train()
+    def _build_train_step(self):
+        return jax.jit(self._train_step_body(),
+                       donate_argnums=self._donate_argnums())
+
+    def _build_train_multi_step(self):
+        """N train steps in ONE jitted lax.scan over leading-stacked
+        inputs/labels and an [N] lr vector, params/buffers/opt-state
+        threaded through the carry. Per-step logits are NOT carried out
+        (metrics force per-step syncs and disable this path); the [N]
+        loss vector returns unfetched so host contact stays at horizon
+        boundaries."""
+        body = self._train_step_body()
+
+        def multi(params, buffers, opt_state, lrs, inputs, labels):
+            def tick(carry, xs):
+                params, buffers, opt_state = carry
+                lr, ins, labs = xs
+                params, opt_state, buffers, loss_v, _out = body(
+                    params, buffers, opt_state, lr, list(ins), list(labs))
+                return (params, buffers, opt_state), loss_v
+
+            (params, buffers, opt_state), losses = jax.lax.scan(
+                tick, (params, buffers, opt_state),
+                (lrs, tuple(inputs), tuple(labels)))
+            return params, opt_state, buffers, losses
+
+        return jax.jit(multi, donate_argnums=self._donate_argnums())
+
+    def _ensure_train_state(self):
+        """Lazy one-time bootstrap of the functional training state
+        (params/buffers/opt-state pytrees + the compiled single step) —
+        shared by train_batch and train_batch_multi so the two paths
+        can never initialize different state."""
         if self._compiled_step is None:
+            net = self.network
             self._params = state_pytree(net, trainable_only=True)
             self._buffers = {k: v for k, v in {**dict(
                 (n, p._value) for n, p in net.named_parameters() if p.stop_gradient),
                 **buffer_pytree(net)}.items() if k not in self._params}
             self._opt_state = self._optimizer.init_state_pytree(self._params)
             self._compiled_step = self._build_train_step()
+
+    def train_batch(self, inputs, labels=None, update=True, fetch=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        net = self.network
+        net.train()
+        self._ensure_train_state()
         in_vals = [self._leaf_value(x) for x in inputs]
         lab_vals = [self._leaf_value(x) for x in labels]
         lr = self._optimizer.get_lr()
@@ -111,6 +155,26 @@ class Model:
         # LossBuffer instead of stalling dispatch every step)
         return float(loss_v) if fetch else loss_v
 
+    def train_batch_multi(self, inputs_stack, labels_stack, lrs):
+        """Dispatch N fused train steps (ONE compiled lax.scan) over
+        leading-stacked inputs/labels ([N, B, ...] leaves) with the
+        precomputed per-step `lrs` vector. Returns the UNFETCHED [N]
+        device loss vector — `Model.fit(multi_step=N)` drains it at
+        horizon boundaries. Scheduler stepping stays with the
+        LRScheduler callback (fit precomputes `lrs` around it)."""
+        net = self.network
+        net.train()
+        self._ensure_train_state()
+        if self._compiled_multi is None:
+            self._compiled_multi = self._build_train_multi_step()
+        in_vals = [self._leaf_value(x) for x in inputs_stack]
+        lab_vals = [self._leaf_value(x) for x in labels_stack]
+        lrs = jnp.asarray(np.asarray(lrs, np.float32))
+        self._params, self._opt_state, self._buffers, losses = \
+            self._compiled_multi(self._params, self._buffers,
+                                 self._opt_state, lrs, in_vals, lab_vals)
+        return losses
+
     @staticmethod
     def _leaf_value(x):
         if isinstance(x, Tensor):
@@ -118,6 +182,21 @@ class Model:
         if isinstance(x, jax.Array):   # device-resident (io.DeviceLoader)
             return x
         return jnp.asarray(np.asarray(x))
+
+    @staticmethod
+    def _raw_value(x):
+        """Tensor -> raw array, everything else untouched (no device
+        placement — shape reads and host-side stacking must not pay an
+        H2D copy)."""
+        return x._value if isinstance(x, Tensor) else x
+
+    @staticmethod
+    def _stack_leaves(values):
+        """[per-step leaf, ...] -> one [N, ...] leaf (the shared
+        io.prefetch horizon policy: device leaves stack on device,
+        host leaves with numpy)."""
+        from ..io.prefetch import stack_leaf_values
+        return stack_leaf_values([Model._raw_value(v) for v in values])
 
     def _sync_params_back(self):
         if self._compiled_step is not None:
@@ -147,10 +226,29 @@ class Model:
         return self.network(*inputs)
 
     # -- loops ---------------------------------------------------------------
+    def _horizon_lrs(self, n, lr_cb):
+        """Precompute the per-step lr vector for one fused horizon.
+        Scheduler stepping is the LRScheduler CALLBACK's job and the
+        callback now ticks once per HORIZON — so for by_step scheduling
+        this advances the real scheduler n-1 times (ticks 1..n-1) and
+        leaves the n-th step to the horizon-end callback: the scheduler
+        lands exactly where n per-step batches would leave it, and
+        warmup/decay boundaries mid-horizon feed the scan the same lr
+        sequence the per-step loop sees."""
+        opt = self._optimizer
+        sched = opt._lr_scheduler if opt is not None else None
+        if sched is None or lr_cb is None or not lr_cb.by_step:
+            return [opt.get_lr()] * n
+        lrs = [opt.get_lr()]
+        for _ in range(n - 1):
+            sched.step()
+            lrs.append(opt.get_lr())
+        return lrs
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            prefetch=False, prefetch_depth=2, **kwargs):
+            prefetch=False, prefetch_depth=2, multi_step=1, **kwargs):
         from ..io import DataLoader, Dataset, DeviceLoader
         loader = train_data if isinstance(train_data, (DataLoader, DeviceLoader)) \
             else DataLoader(
@@ -167,6 +265,18 @@ class Model:
                     loader, depth=prefetch_depth)
             if not self._metrics:   # metrics force a per-step host sync
                 loss_buf = LossBuffer(drain_every=max(1, log_freq))
+        # device-resident multi-step training: fuse `multi_step` train
+        # steps into one compiled scan (train_batch_multi) and move
+        # logging/callback/scheduler ticks to horizon boundaries.
+        # Metrics force a per-step host sync (they consume per-step
+        # logits), so they disable the fused path.
+        multi_step = max(1, int(multi_step))
+        if multi_step > 1 and self._metrics:
+            import warnings
+            warnings.warn("Model.fit: multi_step>1 disabled because "
+                          "metrics require per-step outputs; running "
+                          "per-step")
+            multi_step = 1
         from .callbacks import LRScheduler
         user_cbs = list(callbacks or [])
         auto = [ProgBarLogger(log_freq, verbose)]
@@ -183,32 +293,38 @@ class Model:
         cbs.on_train_begin()
         self.stop_training = False
         try:
+            lr_cb = next((c for c in cbs.callbacks
+                          if isinstance(c, LRScheduler)), None)
             for epoch in range(epochs):
                 cbs.on_epoch_begin(epoch)
                 for m in self._metrics:
                     m.reset()
                 logs = {}
-                for step, batch in enumerate(loader):
-                    cbs.on_train_batch_begin(step)
-                    inputs, labels = self._split_batch(batch)
-                    res = self.train_batch(inputs, labels,
-                                           fetch=loss_buf is None)
-                    if isinstance(res, tuple):
-                        loss, mvals = res
-                        logs = {"loss": loss}
-                        for m, v in zip(self._metrics, mvals):
-                            names = m.name() if isinstance(m.name(), list) else [m.name()]
-                            vals = v if isinstance(v, list) else [v]
-                            logs.update(dict(zip(names, vals)))
-                    elif loss_buf is not None:
-                        # non-blocking: the device loss lands in the buffer;
-                        # one host sync per drain window feeds the logs
-                        loss_buf.append(res)
-                        logs = {"loss": loss_buf.last
-                                if loss_buf.last is not None else float("nan")}
-                    else:
-                        logs = {"loss": res}
-                    cbs.on_train_batch_end(step, logs)
+                if multi_step > 1:
+                    logs = self._fit_epoch_multi(loader, multi_step, cbs,
+                                                 lr_cb, loss_buf)
+                else:
+                    for step, batch in enumerate(loader):
+                        cbs.on_train_batch_begin(step)
+                        inputs, labels = self._split_batch(batch)
+                        res = self.train_batch(inputs, labels,
+                                               fetch=loss_buf is None)
+                        if isinstance(res, tuple):
+                            loss, mvals = res
+                            logs = {"loss": loss}
+                            for m, v in zip(self._metrics, mvals):
+                                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                                vals = v if isinstance(v, list) else [v]
+                                logs.update(dict(zip(names, vals)))
+                        elif loss_buf is not None:
+                            # non-blocking: the device loss lands in the buffer;
+                            # one host sync per drain window feeds the logs
+                            loss_buf.append(res)
+                            logs = {"loss": loss_buf.last
+                                    if loss_buf.last is not None else float("nan")}
+                        else:
+                            logs = {"loss": res}
+                        cbs.on_train_batch_end(step, logs)
                 if loss_buf is not None:
                     logs = {"loss": loss_buf.drain()}
                 cbs.on_epoch_end(epoch, logs)
@@ -224,6 +340,78 @@ class Model:
             if own_device_loader is not None:
                 own_device_loader.close()
         cbs.on_train_end()
+
+    def _fit_epoch_multi(self, loader, multi_step, cbs, lr_cb, loss_buf):
+        """One epoch of horizon-granularity training: batches group into
+        `multi_step`-deep horizons dispatched as ONE compiled scan
+        (train_batch_multi), with callback/logging ticks fired once per
+        horizon boundary. The final partial horizon (epoch length not a
+        multiple of N) falls back to per-step `train_batch` — no fresh
+        m-step scan compile for the tail."""
+        logs = {}
+        horizon = []        # [(step_idx, inputs, labels), ...]
+
+        def log_loss(fallback=None):
+            if loss_buf is not None:
+                last = loss_buf.last
+                return {"loss": last if last is not None else float("nan")}
+            return {"loss": fallback}
+
+        def uniform():
+            # a ragged final BATCH (drop_last=False default) can land
+            # inside a full group — leaves of unequal leading shape
+            # cannot stack, so such a horizon takes the per-step path.
+            # Shapes are read off the RAW leaves: no device placement
+            # just to measure them
+            sig0 = [np.shape(self._raw_value(v))
+                    for v in horizon[0][1] + horizon[0][2]]
+            return all([np.shape(self._raw_value(v))
+                        for v in h[1] + h[2]] == sig0 for h in horizon[1:])
+
+        def flush():
+            nonlocal logs
+            if not horizon:
+                return
+            n = len(horizon)
+            cbs.on_train_batch_begin(horizon[0][0])
+            if n == multi_step and uniform():
+                ins = [self._stack_leaves([h[1][i] for h in horizon])
+                       for i in range(len(horizon[0][1]))]
+                labs = [self._stack_leaves([h[2][i] for h in horizon])
+                        for i in range(len(horizon[0][2]))]
+                losses = self.train_batch_multi(
+                    ins, labs, self._horizon_lrs(n, lr_cb))
+                if loss_buf is not None:
+                    loss_buf.append(losses)
+                    logs = log_loss()
+                else:
+                    logs = {"loss": float(np.asarray(losses)[-1])}
+            else:
+                sched = (self._optimizer._lr_scheduler
+                         if lr_cb is not None and lr_cb.by_step else None)
+                for j, (_, ins, labs) in enumerate(horizon):
+                    res = self.train_batch(ins, labs,
+                                           fetch=loss_buf is None)
+                    if loss_buf is not None:
+                        loss_buf.append(res)
+                    else:
+                        logs = {"loss": res}
+                    # per-step scheduler ticks for all but the last —
+                    # the horizon-end callback supplies that one
+                    if j < n - 1 and sched is not None:
+                        sched.step()
+                if loss_buf is not None:
+                    logs = log_loss()
+            cbs.on_train_batch_end(horizon[-1][0], logs)
+            horizon.clear()
+
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            horizon.append((step, inputs, labels))
+            if len(horizon) == multi_step:
+                flush()
+        flush()
+        return logs
 
     def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):
@@ -290,6 +478,7 @@ class Model:
         state = fload(path + ".pdparams")
         self.network.set_state_dict(state)
         self._compiled_step = None  # rebuild with fresh params
+        self._compiled_multi = None
         import os
         if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(fload(path + ".pdopt"))

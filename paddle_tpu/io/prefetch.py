@@ -29,7 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 __all__ = ["DeviceLoader", "prefetch_to_device", "batch_shardings",
            "batch_signature", "prefetch_iterator", "PrefetchStats",
-           "prefetch_stats", "reset_prefetch_stats"]
+           "prefetch_stats", "reset_prefetch_stats", "stack_batches",
+           "stack_leaf_values", "horizon_shardings"]
 
 
 def batch_signature(arrays):
@@ -158,6 +159,50 @@ def batch_shardings(batch, mesh=None, spec=("dp", "fsdp")):
         fspec = feasible_spec(shape, (spec,) + (None,) * (len(shape) - 1),
                               mesh)
         return NamedSharding(mesh, PartitionSpec(*fspec))
+
+    return jax.tree_util.tree_map(sh, batch,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def stack_leaf_values(leaves):
+    """[per-step leaf, ...] -> one [N, ...] array — THE leaf-stacking
+    policy for training horizons, shared by `stack_batches` and
+    `hapi.Model`'s fit grouping: host leaves stack with numpy (no
+    device work); device-resident leaves stack with jnp — a device-side
+    concat dispatch, never a D2H fetch."""
+    if any(isinstance(v, jax.Array) for v in leaves):
+        import jax.numpy as jnp
+        return jnp.stack(leaves)
+    return np.stack([np.asarray(v) for v in leaves])
+
+
+def stack_batches(batches):
+    """[batch pytree, ...] -> ONE pytree with each leaf leading-stacked
+    to [N, ...] (the `Trainer.step_multi` horizon layout)."""
+    trees = [_leaf_arrays(b) for b in batches]
+    return jax.tree_util.tree_map(
+        lambda *leaves: stack_leaf_values(leaves), *trees)
+
+
+def horizon_shardings(batch, mesh=None, spec=("dp", "fsdp")):
+    """NamedSharding pytree for a leading-STACKED horizon batch
+    ([N, B, ...] leaves): the scan dim replicated (every device runs
+    every tick), the per-step batch dim sharded over the data axes —
+    `batch_shardings` shifted one dim right. Shapes-only, cacheable,
+    usable as the fused scan's jit in_shardings."""
+    from ..distributed.mesh import get_mesh
+    from ..distributed.sharding_utils import feasible_spec
+    from ..framework.core import Tensor
+    mesh = mesh or get_mesh()
+    spec = tuple(spec)
+
+    def sh(v):
+        shape = np.shape(v._value) if isinstance(v, Tensor) else np.shape(v)
+        if len(shape) < 2:
+            return NamedSharding(mesh, PartitionSpec())
+        fspec = feasible_spec(shape[1:],
+                              (spec,) + (None,) * (len(shape) - 2), mesh)
+        return NamedSharding(mesh, PartitionSpec(None, *fspec))
 
     return jax.tree_util.tree_map(sh, batch,
                                   is_leaf=lambda x: isinstance(x, Tensor))
@@ -309,6 +354,63 @@ class DeviceLoader:
         self.stats.epochs += 1
         it = _PrefetchIterator(iter(self.loader), self.depth,
                                transform=self._place, stats=self.stats)
+        self._live = [r for r in self._live if r() is not None]
+        self._live.append(weakref.ref(it))
+        return it
+
+    # -- horizon feed (Trainer.step_multi) -----------------------------------
+
+    def _horizon_shardings_for(self, arrays):
+        key = ("horizon", batch_signature(arrays))
+        sh = self._sharding_cache.get(key)
+        if sh is None:
+            sh = horizon_shardings(arrays, self.mesh, self.spec)
+            self._sharding_cache[key] = sh
+        return sh
+
+    def _place_stack(self, group):
+        """Runs in the prefetch thread: stack `n` source batches into
+        one [n, ...] pytree and enqueue the H2D copy — the stack happens
+        BEFORE placement (host numpy, np.stack; already-resident leaves
+        jnp.stack on device), so feeding a horizon costs zero host
+        round-trips on the step path."""
+        arrays = stack_batches(group)
+        t0 = time.monotonic()
+        out = jax.device_put(arrays, self._horizon_shardings_for(arrays))
+        self.stats.put_time_s += time.monotonic() - t0
+        return out
+
+    def stack(self, n):
+        """Horizon feed: iterate mesh-resident batches stacked `n` deep
+        ([n, B, ...] leaves, scan dim replicated, batch dim sharded) —
+        exactly the layout `Trainer.step_multi` pins as its batch
+        in_shardings, so the fused N-step scan dispatches with no copy
+        and no reshard:
+
+            for horizon in loader.stack(8):
+                losses.append(trainer.step_multi(horizon))   # 1 dispatch
+
+        The final partial group (epoch length not a multiple of n)
+        yields with leading m < n — callers fall back to per-step for
+        it (`Model.fit` does). Counts one prefetched batch per horizon
+        in the stats."""
+        n = max(1, int(n))
+        self.stats.epochs += 1
+        source = iter(self.loader)
+
+        def groups():
+            group = []
+            for item in source:
+                group.append(item)
+                if len(group) == n:
+                    yield group
+                    group = []
+            if group:
+                yield group
+
+        it = _PrefetchIterator(groups(), self.depth,
+                               transform=self._place_stack,
+                               stats=self.stats)
         self._live = [r for r in self._live if r() is not None]
         self._live.append(weakref.ref(it))
         return it

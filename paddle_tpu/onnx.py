@@ -349,8 +349,15 @@ class _Exporter:
             for var, name in zip(eqn.outvars, outs):
                 self.names[var] = name
             return
-        if p in ("custom_jvp_call", "custom_vjp_call"):
-            closed = eqn.params.get("call_jaxpr")
+        if p in ("custom_jvp_call", "custom_vjp_call",
+                 "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"):
+            # export the PRIMAL graph: ONNX carries no autodiff rules,
+            # so the custom forward/backward pair reduces to its
+            # fun_jaxpr (the *_call_jaxpr spelling is what this jaxlib
+            # stages nn ops like layer_norm through; its invars line up
+            # 1:1 with the eqn's — num_consts leading)
+            closed = (eqn.params.get("call_jaxpr")
+                      or eqn.params.get("fun_jaxpr"))
             outs = self.run_jaxpr(closed.jaxpr, closed.consts,
                                   [self.name_of(v) for v in eqn.invars])
             for var, name in zip(eqn.outvars, outs):

@@ -563,3 +563,98 @@ def test_serving_rule_catches_host_callback_in_fused_loop():
     assert all("KV-cache" in h.message
                for h in report2.by_rule("SERVE-HOST-SYNC-DECODE"))
     assert report2.metrics["serving"]["n_host_transfers"] == 0
+
+
+# ---------------------------------------------- fused multi-step training
+
+
+def _tiny_trainer(donate=True):
+    from paddle_tpu.distributed.trainer import Trainer
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def loss_fn(m, b):
+        return ((m(paddle.to_tensor(b["x"]))) ** 2).mean()
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    return Trainer(model, opt, loss_fn, donate=donate)
+
+
+def test_training_rule_clean_on_real_fused_step():
+    """The REAL Trainer.step_multi capture (analysis_program(n=4)) is
+    fully device-resident: zero host transfers, donated carry, the N
+    ticks lowered to a device loop."""
+    tr = _tiny_trainer()
+    batch = {"x": np.zeros((4, 8), np.float32)}
+    program = tr.analysis_program(batch, n=4)
+    pm = PassManager(["training"])
+    ctx = AnalysisContext(name="train", extra={"train_multi": True})
+    report = pm.run(program, ctx)
+    assert report.by_rule("HOST-SYNC-TRAIN") == [], \
+        [str(f) for f in report.findings]
+    m = report.metrics["training"]
+    assert m["checked"] and m["carry_donated"]
+    assert m["n_host_transfers"] == 0
+    assert m["n_device_loops"] >= 1
+
+    # scope: the same program outside a train-multi context never fires
+    report2 = pm.run(program, AnalysisContext(name="train"))
+    assert report2.by_rule("HOST-SYNC-TRAIN") == []
+    assert report2.metrics["training"] == {"checked": False}
+
+
+def test_training_rule_catches_host_fetch_in_scan_body():
+    """HOST-SYNC-TRAIN planted defect: a host callback smuggled into the
+    fused train scan is the per-step round-trip the device-resident
+    horizon exists to kill."""
+    def fused_with_callback(params, batches):
+        def tick(p, b):
+            loss = ((b @ p) ** 2).mean()
+            jax.debug.print("loss {l}", l=loss)     # the planted defect
+            return p - 0.1 * b.T @ (b @ p), loss
+        params, losses = jax.lax.scan(tick, params, batches)
+        return params, losses
+
+    program = lower_callable(fused_with_callback,
+                             jnp.zeros((8, 4), jnp.float32),
+                             jnp.zeros((4, 2, 8), jnp.float32),
+                             name="train_multi")
+    pm = PassManager(["training"])
+    ctx = AnalysisContext(name="train", extra={"train_multi": True})
+    report = pm.run(program, ctx)
+    hits = report.by_rule("HOST-SYNC-TRAIN")
+    assert hits and any("host transfer" in h.message for h in hits)
+    assert all(h.severity == Severity.ERROR for h in hits)
+    assert report.metrics["training"]["n_host_transfers"] >= 1
+
+    def clean(params, batches):
+        def tick(p, b):
+            loss = ((b @ p) ** 2).mean()
+            return p - 0.1 * b.T @ (b @ p), loss
+        return jax.lax.scan(tick, params, batches)
+
+    program2 = lower_callable(clean, jnp.zeros((8, 4), jnp.float32),
+                              jnp.zeros((4, 2, 8), jnp.float32),
+                              name="train_multi")
+    report2 = pm.run(program2, ctx)
+    assert report2.by_rule("HOST-SYNC-TRAIN") == []
+    assert report2.metrics["training"]["n_host_transfers"] == 0
+
+
+def test_training_rule_catches_undonated_carry():
+    """Trainer(donate=False)'s fused capture double-buffers the whole
+    model state every horizon — an ERROR in the hot loop (the MEM-NO-
+    DONATION warning composes the same way SERVE-HOST-SYNC-DECODE
+    composes with MEM-NO-DONATION-KVCACHE)."""
+    tr = _tiny_trainer(donate=False)
+    batch = {"x": np.zeros((4, 8), np.float32)}
+    program = tr.analysis_program(batch, n=4)
+    pm = PassManager(["training"])
+    ctx = AnalysisContext(name="train", extra={"train_multi": True})
+    report = pm.run(program, ctx)
+    hits = report.by_rule("HOST-SYNC-TRAIN")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert any("not donated" in h.message for h in hits)
+    assert not report.metrics["training"]["carry_donated"]

@@ -140,3 +140,112 @@ def test_fit_save_dir_and_resume(tmp_path):
     assert abs(acc1 - acc2) < 1e-6
     m2.fit(loader, epochs=1, verbose=0)
     assert float(m2.evaluate(loader, verbose=0)["acc"]) >= acc2 - 0.05
+
+
+def test_fit_multi_step_matches_per_step():
+    """Model.fit(multi_step=N): horizon-fused training walks the same
+    trajectory as the per-step loop — params AND scheduler position —
+    with callback ticks moved to horizon boundaries and the partial
+    final horizon falling back to per-step (192/32 = 6 steps/epoch: one
+    N=4 horizon + a 2-step tail)."""
+
+    def make():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        model = paddle.Model(net)
+        sched = paddle.optimizer.lr.LinearWarmup(
+            paddle.optimizer.lr.CosineAnnealingDecay(1e-2, 12), 3, 0.0,
+            1e-2)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameters=model.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        return model
+
+    m1 = make()
+    m1.fit(PatchDigits(), batch_size=32, epochs=2, shuffle=False,
+           verbose=0)
+    m2 = make()
+    ticks = []
+
+    class Spy(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            ticks.append(step)
+
+    m2.fit(PatchDigits(), batch_size=32, epochs=2, shuffle=False,
+           verbose=0, multi_step=4, callbacks=[Spy()])
+    w1, w2 = m1.network.state_dict(), m2.network.state_dict()
+    for k in w1:
+        np.testing.assert_array_equal(w1[k].numpy(), w2[k].numpy())
+    assert m1._optimizer.get_lr() == m2._optimizer.get_lr()
+    # callback ticks at horizon boundaries: steps 3 (N=4 horizon) and 5
+    # (the 2-step tail), per epoch
+    assert ticks == [3, 5, 3, 5]
+
+
+def test_fit_multi_step_with_metrics_falls_back():
+    """Metrics need per-step outputs: multi_step>1 downgrades to the
+    per-step loop with a warning and still trains/track metrics."""
+    import pytest as _pytest
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(),
+                        nn.Linear(32, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    with _pytest.warns(UserWarning, match="multi_step"):
+        model.fit(PatchDigits(), batch_size=32, epochs=3, verbose=0,
+                  multi_step=4)
+    res = model.evaluate(DataLoader(PatchDigits(), batch_size=32),
+                         verbose=0)
+    assert float(res["acc"]) > 0.8, res
+
+
+def test_fit_multi_step_with_prefetch_drains_per_horizon():
+    """prefetch=True + multi_step: losses ride the LossBuffer as [N]
+    vectors; fit completes and the model learns."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(),
+                        nn.Linear(32, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    model.fit(PatchDigits(), batch_size=32, epochs=4, verbose=0,
+              prefetch=True, multi_step=3)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    res = model.evaluate(DataLoader(PatchDigits(), batch_size=32),
+                         verbose=0)
+    assert float(res["acc"]) > 0.8, res
+
+
+def test_fit_multi_step_ragged_final_batch():
+    """drop_last=False (the default) can land a short final BATCH inside
+    a full horizon group — unstackable leaves must take the per-step
+    path, not crash, and still match the per-step trajectory."""
+
+    def make():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        return model
+
+    ds = PatchDigits(n=150)       # 150/32 -> batches 32,32,32,32,22
+    m1 = make()
+    m1.fit(ds, batch_size=32, epochs=1, shuffle=False, verbose=0)
+    m2 = make()
+    # groups of 2: [32,32], [32,32], [32,22] — the LAST group is full
+    # (n == multi_step) but ragged, the exact shape that must divert
+    # to the per-step path instead of a failing jnp.stack
+    m2.fit(ds, batch_size=32, epochs=1, shuffle=False, verbose=0,
+           multi_step=2)
+    w1, w2 = m1.network.state_dict(), m2.network.state_dict()
+    for k in w1:
+        np.testing.assert_array_equal(w1[k].numpy(), w2[k].numpy())

@@ -284,3 +284,39 @@ def test_hapi_fit_prefetch_path():
         assert n1 == n2
         np.testing.assert_allclose(p1.numpy(), p2.numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_stack_horizon_feed_order_stats_and_close():
+    """DeviceLoader.stack(n): horizons arrive in source order, stacked
+    in the worker thread (stats count one prefetched item per horizon),
+    the scan dim is replicated with the batch dim sharded, and close()
+    joins the thread mid-stream."""
+    build_mesh(dp=len(jax.devices()))
+    n_batches = 9
+    src = [{"x": np.full((8, 4), i, np.float32)} for i in range(n_batches)]
+    loader = DeviceLoader(iter(src), depth=2)
+    it = loader.stack(4)
+    first = next(it)
+    assert first["x"].shape == (4, 8, 4)
+    assert isinstance(first["x"], jax.Array)
+    # source order preserved through the stack
+    np.testing.assert_array_equal(
+        np.asarray(first["x"])[:, 0, 0], [0.0, 1.0, 2.0, 3.0])
+    # scan dim replicated, batch dim over the data axes
+    assert first["x"].sharding.spec[0] is None
+    second = next(it)
+    np.testing.assert_array_equal(
+        np.asarray(second["x"])[:, 0, 0], [4.0, 5.0, 6.0, 7.0])
+    assert loader.stats.batches == 2          # one stat tick per horizon
+    # close mid-stream: the worker joins, no leak
+    assert it.close()
+    loader.close()
+
+
+def test_stack_partial_tail_and_exhaustion():
+    build_mesh(dp=1)
+    src = [{"x": np.zeros((4, 2), np.float32)} for _ in range(5)]
+    loader = DeviceLoader(iter(src), depth=2)
+    horizons = list(loader.stack(2))
+    assert [h["x"].shape[0] for h in horizons] == [2, 2, 1]
+    loader.close()

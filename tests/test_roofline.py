@@ -191,3 +191,51 @@ class TestDecodeHorizon:
         eng = ContinuousBatchingEngine(dec, max_new_tokens=4)
         assert eng.k_max == decode_horizon(dec.step_hbm_bytes())
         assert eng.k_max >= 1
+
+
+class TestTrainHorizon:
+    """cost_model.train_horizon: pricing the multi-step training N from
+    the step roofline vs the host sync cost (decode_horizon's twin)."""
+
+    def test_horizon_scales_with_host_overhead_share(self):
+        from paddle_tpu.cost_model import train_horizon
+        step_s = 1e-3
+        # sync cost == 10% of a step: N=1 already meets the 10% bar
+        assert train_horizon(step_s, host_sync_s=1e-4) == 1
+        # sync cost == 8 steps: need N=80 to amortize to 10% -> capped
+        assert train_horizon(step_s, host_sync_s=8e-3, n_cap=32) == 32
+        # mid-range: h/(N*t) <= 0.1 with h = t -> N = 10
+        assert train_horizon(step_s, host_sync_s=1e-3) == 10
+
+    def test_horizon_monotone_in_step_time(self):
+        """Bigger steps need smaller N; a micro-model step prices to
+        the cap, a 1.3B-class step prices to 1."""
+        from paddle_tpu.cost_model import train_horizon
+        h = 5e-4
+        ns = [train_horizon(s, host_sync_s=h)
+              for s in (1e-6, 1e-4, 1e-2, 0.4)]
+        assert ns == sorted(ns, reverse=True)
+        assert ns[0] == 32 and ns[-1] == 1
+
+    def test_degenerate_step_time_prices_to_cap(self):
+        from paddle_tpu.cost_model import train_horizon
+        assert train_horizon(0.0, host_sync_s=1e-3) == 32
+        assert train_horizon(None, host_sync_s=1e-3, n_cap=16) == 16
+
+    def test_default_sync_cost_is_the_measured_one(self):
+        from paddle_tpu.cost_model import (measured_host_sync_s,
+                                           train_horizon)
+        h = measured_host_sync_s()
+        assert train_horizon(1e-3) == train_horizon(1e-3, host_sync_s=h)
+
+    def test_roofline_step_feeds_horizon(self):
+        """The intended composition: roofline_step_time(...).step_s is
+        the numerator train_horizon prices against."""
+        from paddle_tpu.cost_model import (chip_spec, roofline_step_time,
+                                           train_horizon)
+        chip = chip_spec("v5e")
+        # a compute-bound 1.3B-ish step: ~400 ms — any realistic sync
+        # cost is <10% of it, so N=1
+        rt = roofline_step_time(6 * 1.3e9 * 6 * 1024, 1.3e9 * 12,
+                                chip=chip)
+        assert train_horizon(rt.step_s, host_sync_s=4e-4) == 1
