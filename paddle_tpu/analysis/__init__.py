@@ -35,7 +35,7 @@ from .manifest import (build_manifest, load_manifest,  # noqa: F401
                        write_memory_manifest,
                        build_tuning_manifest, load_tuning_manifest,
                        tuning_manifest_path, write_tuning_manifest)
-from .memory import (MemoryEstimate,  # noqa: F401
+from .memory import (MemoryEstimate, audit_page_ledger,  # noqa: F401
                      estimate_jaxpr_memory, propagate_shard_counts)
 from .remat_advisor import (REMAT_POLICIES, RematWhatIf,  # noqa: F401
                             advise_remat, replay_remat)
@@ -55,6 +55,7 @@ __all__ = [
     "build_tuning_manifest", "load_tuning_manifest",
     "tuning_manifest_path", "write_tuning_manifest",
     "MemoryEstimate", "estimate_jaxpr_memory", "propagate_shard_counts",
+    "audit_page_ledger",
     "REMAT_POLICIES", "RematWhatIf", "advise_remat", "replay_remat",
     "AutotuneReport", "CandidateEstimate", "autotune", "autotune_layer",
     "rank_gpt_candidates",

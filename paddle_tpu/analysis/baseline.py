@@ -191,6 +191,48 @@ def _gpt_train_multi():
     return program, ctx, Trainer._build_multi
 
 
+def _gpt_decode_prefix():
+    """The PREFIX-CACHE serving config: the chunked suffix-prefill
+    program (`PagedGPTDecoder._prefill_suffix_step`, W=16 bucket)
+    captured via `analysis_program(prefix_w=16)`, plus a page LEDGER
+    committed from a real shared-prefix workload (two prompts sharing
+    one full block through a `PrefixCache`, incl. a full-hit
+    copy-on-write).  Gated by SERVE-HOST-SYNC-DECODE (zero host
+    transfers, donated KV pool — the chunked prefill is part of the
+    serving hot path) and by MEM-PAGE-REFCOUNT (the ledger audit:
+    refcounted sharing frees every page exactly once)."""
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedGPTDecoder, PrefixCache)
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2)
+    eng = ContinuousBatchingEngine(
+        dec, max_new_tokens=4, k_max=2,
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+    base = list(range(1, 17))            # one full shareable block
+    for tail in ([21, 22, 23], []):      # miss+insert, then a FULL hit
+        eng.submit(np.asarray(base + tail, np.int32))
+        eng.run()
+    program = dec.analysis_program(prefix_w=16)
+    ctx = AnalysisContext(
+        name="gpt_decode_prefix",
+        # the chunked body's per-head attention reorders ride with the
+        # dense model's by-design attention transposes (same exemptions
+        # as gpt_decode's paged gather)
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + (r"dims = \[0, 3, 1, 2\]", r"dims = \[0, 2, 3, 1\]",
+           r"dims = \[0, 1, 3, 2\]"),
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "page_ledger": eng.page_ledger()})
+    return program, ctx, PagedGPTDecoder._prefill_suffix_step
+
+
 # configs whose builder yields a READY LoweredProgram (serving decode
 # loops and other non-Layer captures): builder() ->
 # (LoweredProgram, AnalysisContext, source_fn). They ride the same
@@ -198,6 +240,7 @@ def _gpt_train_multi():
 # tuning manifests (no grad program to replay).
 PROGRAM_CONFIGS = {
     "gpt_decode": _gpt_decode,       # fused multi-step serving decode
+    "gpt_decode_prefix": _gpt_decode_prefix,   # chunked prefix-cache prefill
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
 

@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 
 __all__ = ["ArgInfo", "HloOp", "LoweredProgram", "lower_layer",
            "lower_callable", "tensor_type_bytes", "sharding_shard_count",
-           "tree_arg_infos"]
+           "sharding_dim_counts", "tree_arg_infos"]
 
 _OP_RE = re.compile(r'"?stablehlo\.([a-zA-Z0-9_]+)"?')
 _TENSOR_RE = re.compile(r"tensor<([^>]*)>")
@@ -61,6 +61,7 @@ class ArgInfo:
     bytes: int = 0               # global (unsharded) size
     spec: tuple = None           # PartitionSpec entries, None when unknown
     shard_count: int = 1         # devices one shard of this arg lands on
+    dim_shards: tuple = None     # per-dim shard counts, None when unknown
     donated: bool = False
 
     @property
@@ -87,6 +88,28 @@ def sharding_shard_count(sharding):
         for a in axes:
             count *= int(mesh.shape.get(a, 1))
     return max(count, 1)
+
+
+def sharding_dim_counts(sharding, ndim):
+    """Per-DIMENSION shard counts of a NamedSharding over an
+    `ndim`-rank value, or None when unknown. Feeds the memory pass's
+    dim-aware propagation (`memory._eqn_out_shard`): knowing WHICH dim
+    carries the sharding lets contracted `dot_general` dims drop their
+    factor instead of leaking it into the output."""
+    if sharding is None or ndim is None:
+        return None
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None:
+        return None
+    dims = [1] * int(ndim)
+    for i, entry in enumerate(spec):
+        if i >= len(dims) or entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for a in axes:
+            dims[i] *= int(mesh.shape.get(a, 1))
+    return tuple(dims)
 
 
 @dataclass
@@ -224,7 +247,9 @@ def tree_arg_infos(tree, role, prefix="", donated=False, shardings=None):
             dtype=str(dtype) if dtype is not None else "",
             bytes=int(np.prod(shape, dtype=np.int64)) * int(itemsize or 0),
             spec=tuple(spec) if spec is not None else None,
-            shard_count=sharding_shard_count(sh), donated=donated))
+            shard_count=sharding_shard_count(sh),
+            dim_shards=sharding_dim_counts(sh, len(shape)),
+            donated=donated))
     return infos
 
 

@@ -32,7 +32,8 @@ from .findings import Finding, Severity
 from .pass_manager import Analyzer, register_analyzer
 
 __all__ = ["MemoryAnalyzer", "MemoryEstimate", "estimate_jaxpr_memory",
-           "propagate_shard_counts"]
+           "propagate_shard_counts", "audit_page_ledger",
+           "PageRefcountAnalyzer"]
 
 # arg names that identify decode-loop KV-cache state when the capture
 # didn't assign an explicit role="cache" (serving front doors do)
@@ -210,8 +211,11 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
             last_use[v] = n
 
     counts = {}          # var -> shard count (propagated)
+    dimmap = {}          # var -> per-dim shard counts (None = unknown)
     live = {}            # var -> (device_bytes, LiveBuffer)
     for k, v in enumerate(invars):
+        if arg_infos and k < len(arg_infos):
+            dimmap[v] = getattr(arg_infos[k], "dim_shards", None)
         if v not in last_use:
             continue
         cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
@@ -243,15 +247,18 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
                     w = _aval_bytes(v.aval, widen_sub_f32=True)
                     if w > _aval_bytes(v.aval):
                         inner += w
-        out_count = 1
-        in_counts = [counts.get(v, 1) for v in eqn.invars if _is_var(v)]
-        if in_counts:
-            # sharding propagation heuristic: an op's result is at best
-            # as sharded as its most-sharded operand (GSPMD propagates
-            # along data paths; a reduction to scalar only shrinks the
-            # buffer, so the error is bounded by the tiny result)
-            out_count = max(in_counts)
+        # sharding propagation: an op's result is at best as sharded as
+        # its most-sharded operand (GSPMD propagates along data paths;
+        # a reduction to scalar only shrinks the buffer, so the error
+        # is bounded by the tiny result) — refined by _eqn_out_shard
+        # where per-dim counts are known (contracted dot_general dims
+        # drop their sharding instead of leaking into the output)
+        ivs = [v for v in eqn.invars if _is_var(v)]
+        out_count, out_dims = _eqn_out_shard(
+            eqn, [counts.get(v, 1) for v in ivs],
+            [dimmap.get(v) for v in ivs])
         for v in eqn.outvars:
+            dimmap[v] = out_dims
             if v in last_use:
                 counts[v] = out_count
                 gb = _aval_bytes(v.aval, widen_sub_f32=widen)
@@ -275,21 +282,90 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     return peak, peak_idx, top
 
 
-def propagate_shard_counts(jx, arg_counts=None):
+def _eqn_out_shard(eqn, in_counts, in_dims):
+    """Shard propagation for one eqn's outputs: (total_count, per-dim
+    counts or None). The default heuristic — a result is at best as
+    sharded as its most-sharded operand — is refined where per-DIM
+    shard counts are known (seeded from ArgInfo.dim_shards):
+
+    * `dot_general` respects contracted dims: sharding on a contracted
+      axis does NOT survive into the output (GSPMD all-reduces the
+      partial products; the result is replicated over that mesh axis),
+      so a tensor-parallel intermediate stops inheriting
+      max(operand counts) blindly. Output dims follow the dot layout
+      (batch, lhs free, rhs free).
+    * shape-preserving ops (elementwise chains) inherit the matching
+      operand's dim vector, `transpose` permutes it — so dim knowledge
+      survives between matmuls instead of dying at the first add/ln.
+    """
+    name = eqn.primitive.name
+    try:
+        if name == "dot_general" and len(in_dims) >= 2 and \
+                in_dims[0] is not None and in_dims[1] is not None:
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            ld, rd = in_dims[0], in_dims[1]
+            batch = [ld[i] for i in lb]
+            lfree = [ld[i] for i in range(len(ld))
+                     if i not in set(lc) | set(lb)]
+            rfree = [rd[i] for i in range(len(rd))
+                     if i not in set(rc) | set(rb)]
+            dims = tuple(batch + lfree + rfree)
+            total = 1
+            for d in dims:
+                total *= int(d)
+            # per-dim counts carry no mesh-axis identity, so the cross
+            # product of lhs/rhs free-dim factors can claim more shards
+            # than devices exist (both operands sharded on the SAME
+            # axis forces GSPMD to reshard one of them). Cap at the
+            # most-sharded operand — never claim finer sharding than
+            # any input actually had (under-counting shards
+            # OVERestimates memory, the safe direction for the gates).
+            cap = max(in_counts) if in_counts else 1
+            if total > cap:
+                return cap, None
+            return max(total, 1), dims
+        if name == "transpose" and in_dims and in_dims[0] is not None:
+            perm = eqn.params.get("permutation")
+            if perm is not None and len(perm) == len(in_dims[0]):
+                dims = tuple(in_dims[0][p] for p in perm)
+                return max(in_counts) if in_counts else 1, dims
+        out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+        best, best_dims = (max(in_counts) if in_counts else 1), None
+        for cnt, dims, v in zip(in_counts, in_dims,
+                                [v for v in eqn.invars if _is_var(v)]):
+            if dims is not None and cnt == best and \
+                    tuple(getattr(v.aval, "shape", ())) == out_shape:
+                best_dims = dims
+                break
+        return best, best_dims
+    except Exception:
+        return (max(in_counts) if in_counts else 1), None
+
+
+def propagate_shard_counts(jx, arg_counts=None, arg_dims=None):
     """{var: shard_count} over one jaxpr, using the same propagation
-    heuristic as the liveness walk (a result is at best as sharded as
-    its most-sharded operand). The remat advisor prices dropped/saved
-    residuals per device with it."""
+    rules as the liveness walk (`_eqn_out_shard`: max-operand heuristic,
+    refined with per-dim counts where known — contracted `dot_general`
+    dims drop their sharding instead of leaking into the output). The
+    remat advisor prices dropped/saved residuals per device with it.
+    `arg_dims` optionally seeds per-dim shard counts per invar (aligned
+    with `arg_counts`; `lowering.ArgInfo.dim_shards` supplies them)."""
     jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
     counts = {}
+    dims = {}
     for k, v in enumerate(jx.invars):
         counts[v] = (arg_counts[k]
                      if arg_counts and k < len(arg_counts) else 1)
+        dims[v] = (arg_dims[k]
+                   if arg_dims and k < len(arg_dims) else None)
     for eqn in jx.eqns:
-        in_counts = [counts.get(v, 1) for v in eqn.invars if _is_var(v)]
-        out = max(in_counts) if in_counts else 1
+        ivs = [v for v in eqn.invars if _is_var(v)]
+        out, out_dims = _eqn_out_shard(
+            eqn, [counts.get(v, 1) for v in ivs],
+            [dims.get(v) for v in ivs])
         for v in eqn.outvars:
             counts[v] = out
+            dims[v] = out_dims
     return counts
 
 
@@ -439,3 +515,131 @@ class MemoryAnalyzer(Analyzer):
                 "(jax.jit(step, donate_argnums=...) on the k/v page "
                 "arguments, as serving.PagedGPTDecoder does)"))
         return findings
+
+
+# ------------------------------------------------- shared-pool refcounts
+
+
+def audit_page_ledger(ledger):
+    """MEM-PAGE-REFCOUNT invariant audit of a serving engine's page
+    ledger (`ContinuousBatchingEngine.page_ledger()`): with a shared
+    (prefix-cached) KV pool, every allocatable page must be owned
+    EXACTLY once — on the free list, XOR held by slot(s) under a
+    covering cache refcount, XOR parked (refcount 0) in the cache's
+    LRU.  Double-frees, leaks, refcount drift and writes-into-shared
+    hazards all surface as findings.  Returns a list of Finding
+    (empty = consistent)."""
+    findings = []
+
+    def bad(msg, fix=None):
+        findings.append(Finding("MEM-PAGE-REFCOUNT", Severity.ERROR, msg,
+                                analyzer="page-refcount",
+                                suggested_fix=fix))
+
+    num_pages = int(ledger.get("num_pages", 0))
+    scratch = ledger.get("scratch")
+    free = list(ledger.get("free", []))
+    slots = {int(s): list(p)
+             for s, p in (ledger.get("slots") or {}).items()}
+    shared = {int(s): set(p)
+              for s, p in (ledger.get("shared") or {}).items()}
+    cache = {int(p): dict(e)
+             for p, e in (ledger.get("cache") or {}).items()}
+
+    seen = set()
+    for p in free:
+        if p in seen:
+            bad(f"page {p} appears twice in the free list (double free)")
+        seen.add(p)
+        if scratch is not None and p == scratch:
+            bad("the reserved scratch page is on the free list")
+
+    holders = {}                         # page -> [slots holding it]
+    for s, pages in slots.items():
+        for p in pages:
+            holders.setdefault(p, []).append(s)
+    for p, hs in holders.items():
+        if len(hs) > 1 and (p not in cache
+                            or int(cache[p].get("refs", 0)) < len(hs)):
+            bad(f"page {p} is held by slots {sorted(hs)} without a "
+                "covering cache refcount (unaccounted aliasing)",
+                fix="mount shared pages through the prefix cache so "
+                "refcounts track every holder")
+    for p in seen:
+        if p in holders:
+            bad(f"page {p} is both free and held by slot(s) "
+                f"{sorted(holders[p])} (double free)")
+        if p in cache:
+            bad(f"page {p} is both free and cache-tracked (double free: "
+                "eviction must unmap before returning a page)")
+
+    mounts = {}                          # page -> shared-mount count
+    for s, sh in shared.items():
+        for p in sh:
+            mounts[p] = mounts.get(p, 0) + 1
+            if p not in (slots.get(s) or []):
+                bad(f"slot {s} marks page {p} shared but does not hold "
+                    "it")
+            if p not in cache:
+                bad(f"slot {s} holds page {p} as shared but the cache "
+                    "does not track it")
+    for p, e in cache.items():
+        refs = int(e.get("refs", 0))
+        if refs < 0:
+            bad(f"page {p} has negative refcount {refs} (double "
+                "release)")
+        m = mounts.get(p, 0)
+        if refs != m:
+            bad(f"page {p} refcount {refs} != {m} mounting slot(s) "
+                "(refcount drift — the page would be freed too early "
+                "or never)")
+        if refs == 0 and p in holders:
+            # a parked page is by definition held by NOBODY: a slot
+            # still mapping it means a reference was dropped without
+            # decref — eviction would hand a live-mapped page to the
+            # free list and a later prefill would corrupt the slot's KV
+            bad(f"page {p} is parked (refcount 0) but still held by "
+                f"slot(s) {sorted(holders[p])} (reference dropped "
+                "without decref)")
+
+    owned = set(free) | set(holders) | set(cache)
+    for p in range(num_pages):
+        if scratch is not None and p == scratch:
+            continue
+        if p not in owned:
+            bad(f"page {p} is unreachable: not free, not slot-held, "
+                "not cached (leak)")
+    return findings
+
+
+@register_analyzer
+class PageRefcountAnalyzer(Analyzer):
+    """MEM-PAGE-REFCOUNT: ownership audit of the shared (prefix-cached)
+    KV page pool. Runs only when `ctx.extra["page_ledger"]` carries an
+    engine ledger — the `gpt_decode_prefix` PROGRAM config commits one
+    captured from a real shared-prefix workload, so the CI gate proves
+    on every run that refcounted sharing frees every page exactly once
+    (the one-horizon-delayed-retirement discipline extended to shared
+    pages). Planted-defect tests corrupt a ledger to prove double-free
+    / leak / refcount-drift detection."""
+    name = "page-refcount"
+
+    def run(self, program, ctx):
+        ledger = ctx.extra.get("page_ledger")
+        if not ledger:
+            self.metrics = {"checked": False}
+            return []
+        cache = ledger.get("cache") or {}
+        self.metrics = {
+            "checked": True,
+            "n_pages": int(ledger.get("num_pages", 0)),
+            "n_free": len(ledger.get("free", [])),
+            "n_held": sum(len(p)
+                          for p in (ledger.get("slots") or {}).values()),
+            "n_cached": len(cache),
+            "n_parked": sum(1 for e in cache.values()
+                            if not e.get("refs")),
+            "refcount_total": sum(int(e.get("refs", 0))
+                                  for e in cache.values()),
+        }
+        return audit_page_ledger(ledger)

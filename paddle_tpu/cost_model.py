@@ -32,7 +32,8 @@ __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
            "eqn_flops", "jaxpr_flops", "RooflineTime",
            "roofline_step_time", "decode_tick_roofline_s",
-           "decode_horizon", "train_horizon", "measured_host_sync_s"]
+           "decode_horizon", "train_horizon", "measured_host_sync_s",
+           "prefill_ttft_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -267,6 +268,29 @@ def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
         return int(k_cap)
     k = math.ceil(host_sync_s / (sync_overhead_frac * t))
     return int(min(max(k, 1), int(k_cap)))
+
+
+def prefill_ttft_s(prompt_tokens, flops_per_token, cached_frac=0.0,
+                   chip=None, host_sync_s=None, mxu_efficiency=0.65):
+    """Analytic time-to-first-token of one prompt: the compute roofline
+    of the UNCACHED prompt span plus one host sync.
+
+    `cached_frac` is the prefix-cache hit fraction of the prompt
+    (serving.ServeStats.prefix_hit_rate view): cached pages are mounted
+    into the page table HOST-side — zero device FLOPs — so prefill
+    compute scales with the (1 - cached_frac) remainder. A full hit
+    still re-consumes one position for logits, which the one-sync floor
+    absorbs. This is the pricing half of the prefix cache: TTFT and
+    prefill FLOPs both collapse linearly with hit rate (the bench
+    scenario's committed JSON lines measure the same curve)."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    if host_sync_s is None:
+        host_sync_s = measured_host_sync_s()
+    frac = min(max(float(cached_frac), 0.0), 1.0)
+    uncached = max(float(prompt_tokens), 0.0) * (1.0 - frac)
+    compute = (uncached * max(float(flops_per_token), 0.0)
+               / (chip.peak_flops * mxu_efficiency))
+    return compute + host_sync_s
 
 
 def train_horizon(step_s, host_sync_s=None, n_cap=32,

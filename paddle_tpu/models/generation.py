@@ -16,7 +16,7 @@ __all__ = ["generate", "beam_search"]
 
 def mask_logits(logits, temperature, top_k, top_p):
     """Temperature/top-k/nucleus filtering — the ONE implementation of
-    the sampling mask (generate() and serving.py both use it, so they
+    the sampling mask (generate() and the serving package both use it, so they
     can't drift)."""
     logits = logits.astype(jnp.float32) / temperature
     if top_k and top_k > 0:

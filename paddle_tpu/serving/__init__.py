@@ -1,0 +1,61 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Reference role: the fluid inference API's batched decode serving path
+(paddle/fluid/inference/api/paddle_inference_api.h + PaddleNLP FasterGPT
+decoding).  TPU-native design, split across this package:
+
+- `decoder.py` — ONE compiled decode step for a fixed slot count:
+  [max_batch] tokens in, [max_batch] next tokens out (greedy, or seeded
+  temperature/top-k/top-p sampling).  Slots hold independent sequences
+  at different lengths; position/page state rides in arrays, so
+  admission and retirement never recompile.  KV lives in paged pools
+  [L, P, page_size, H, D] (ops/paged_attention); decode attention
+  gathers each slot's pages (optionally via the scalar-prefetch Pallas
+  kernel); page allocation is host-side.  Prefill is a second compiled
+  program per prompt-length bucket (powers of two) writing the prompt's
+  K/V straight into the pages; the CHUNKED prefill
+  (`prefill_suffix_batch`) consumes only a prompt's uncached suffix,
+  attending against already-mounted prefix pages.  Multi-step decode
+  (`decode_multi`) fuses K decode ticks into ONE compiled `lax.scan` —
+  sampled tokens feed back on device, per-slot done masks freeze
+  finished slots — so the engine syncs the host once per K tokens
+  instead of once per token (cf. Ragged Paged Attention, arXiv
+  2604.15464; T3's overlap analysis, arXiv 2401.16677).
+- `engine.py` — `ContinuousBatchingEngine.run()` schedules horizons of
+  `k = min(K_max, smallest remaining budget)` ticks and overlaps each
+  block's host fetch with the NEXT block's dispatch (one-horizon-
+  delayed retirement); `cost_model.decode_horizon` prices the default
+  K from the chip's tick roofline vs the measured host sync cost.
+  `SpeculativeEngine` layers draft-propose/target-verify decoding on
+  top.
+- `prefix_cache.py` — content-addressed KV page sharing: hash (token
+  block chain, model-invariant config) -> page id with refcounts,
+  copy-on-write on the first divergent-token write, and LRU eviction of
+  refcount-0 pages under pool pressure.  Requests sharing a system
+  prompt / few-shot prefix skip prefill for the shared span entirely
+  (the Gemma-on-TPU serving comparison, PAPERS.md, leans on exactly
+  this page-level reuse).
+- `stats.py` — per-engine `ServeStats` (host syncs/token, prefix-cache
+  hit/evict/bytes-saved counters, TTFT/queue-wait/occupancy windows)
+  behind `debug.serving_stats()`.
+
+quant="a8w8": per-(layer, out-channel) int8 weights with dynamic
+per-row int8 activations — matmuls run int8xint8->int32 on the MXU
+(same recipe as quantization.QuantizedLinearA8W8).  quant="w4a16":
+weight-only int4 (ops/w4_matmul.py): nibbles unpack in VMEM, bf16
+activations — half the weight HBM traffic of a8w8.
+
+The engine applies to GPT-family models (uniform pre-LN blocks); weights
+are extracted once into stacked per-layer arrays and the model object is
+no longer needed — pair with jit.load-style artifacts for serving.
+"""
+from .decoder import (MultiDecodeOut, PagedGPTDecoder, _ln, _mm,
+                      _mm_heads, _quantize_w, _sample_tokens,
+                      _spec_accept)
+from .engine import ContinuousBatchingEngine, SpeculativeEngine
+from .prefix_cache import PrefixCache
+from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
+
+__all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
+           "SpeculativeEngine", "ServeStats", "serving_stats",
+           "PrefixCache", "MultiDecodeOut"]

@@ -1,0 +1,948 @@
+"""Paged-KV GPT decode executor: stacked weights, compiled decode /
+prefill / verify programs over page pools (see package docstring in
+`paddle_tpu/serving/__init__.py` for the architecture notes)."""
+import collections
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedGPTDecoder", "MultiDecodeOut", "_spec_accept",
+           "_sample_tokens", "_ln", "_mm", "_mm_heads", "_quantize_w"]
+
+
+# decode_multi's result bundle: device arrays — the engine feeds
+# tokens/lens/done/remaining straight into the next horizon's call and
+# fetches tokens_block/done_before only at sync points
+MultiDecodeOut = collections.namedtuple(
+    "MultiDecodeOut", ["tokens_block", "done_before", "tokens", "lens",
+                       "done", "remaining", "logits_block"])
+
+
+def _ln(x, w, b):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * w + b).astype(x.dtype)
+
+
+def _quantize_w(w):
+    """Per-out-channel symmetric int8 via the shared quantization recipe
+    (quantization.quantize_weight) — one implementation so serving a8w8
+    can't drift from QuantizedLinearA8W8/PTQ."""
+    from ..quantization import quantize_weight
+    q, scale = quantize_weight(w, axis=0)
+    return q, scale.reshape(-1)
+
+
+def _spec_accept(p_rows, q_rows, drafts, rng):
+    """Rejection-sampling acceptance for ONE slot (Leviathan et al.):
+    p_rows [n+1, V] target probs — row j is the target's conditional
+    AFTER the tokens preceding draft j (row 0 judges drafts[0]),
+    q_rows [n, V] draft probs, drafts [n] proposed tokens.  Accept draft
+    j with prob min(1, p_j(d)/q_j(d)); on rejection emit a sample from
+    norm(max(p_j - q_j, 0)); if every draft is accepted emit a fresh
+    sample from the last target row.  The emitted tokens are distributed
+    EXACTLY as target-only sampling (unit-tested by Monte Carlo).
+    Returns (n_accepted, final_token)."""
+    n = len(drafts)
+    for j in range(n):
+        d = int(drafts[j])
+        q = q_rows[j, d]
+        p = p_rows[j, d]
+        if q <= 0.0 or rng.random() >= min(1.0, p / q):
+            resid = np.maximum(p_rows[j] - q_rows[j], 0.0)
+            tot = resid.sum()
+            if tot <= 1e-12:       # p==q everywhere: any target sample
+                resid, tot = p_rows[j], p_rows[j].sum()
+            return j, int(rng.choice(len(resid), p=resid / tot))
+    row = p_rows[n]
+    return n, int(rng.choice(len(row), p=row / row.sum()))
+
+
+def _sample_tokens(logits, sampling, keys):
+    """Per-slot next-token choice: greedy, or seeded temperature/top-k/
+    top-p sampling (keys: [S] per-slot PRNG keys derived from
+    (seed, request id, position) — see PagedGPTDecoder._pos_keys — so a
+    request's draws don't depend on batch composition or scheduling;
+    the mask itself is shared with generate() via
+    models.generation.mask_logits)."""
+    if sampling is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    from ..models.generation import mask_logits
+    temperature, top_k, top_p = sampling
+    masked = mask_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+
+
+def _mm_heads(x, w, b, quant):
+    """x [S, h] @ head-major qkv weight [h, 3, H, D] -> [S, 3, H, D]."""
+    if not quant:
+        return (jnp.einsum("sh,htnd->stnd", x, w.astype(x.dtype))
+                + b.astype(x.dtype))
+    if quant == "w4a16":
+        from ..ops.w4_matmul import w4_matmul
+        packed, sw = w             # [h/2, 3, H, D] packed, [3, H, D]
+        out = w4_matmul(x, packed.reshape(packed.shape[0], -1),
+                        sw.reshape(-1), x.shape[-1])
+        return out.reshape(x.shape[0], *b.shape) + b.astype(x.dtype)
+    qw, sw = w                     # [h,3,H,D] int8, [3,H,D] f32
+    sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                 keepdims=True) / 127.0
+    sx = jnp.maximum(sx, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127,
+                  127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, qw, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx[:, :, None, None] * sw
+            + b).astype(x.dtype)
+
+
+def _mm(x, w, b, quant):
+    """x [..., in] @ w -> [..., out].  Float path, weight-only int4
+    (W4A16: Pallas in-VMEM dequant), or dynamic-A8 x W8 int8 MXU
+    matmul with per-row activation scales."""
+    if not quant:
+        return (x @ w.astype(x.dtype) + b.astype(x.dtype)).astype(x.dtype)
+    if quant == "w4a16":
+        from ..ops.w4_matmul import w4_matmul
+        out = w4_matmul(x, w[0], w[1], x.shape[-1])
+        return (out + b.astype(x.dtype)).astype(x.dtype)
+    qw, sw = w
+    sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
+    sx = jnp.maximum(sx, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, qw, (((xq.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * sw + b).astype(x.dtype)
+
+
+class PagedGPTDecoder:
+    """Stacked-weight GPT decode executor over paged KV pools."""
+
+    def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
+                 max_pages_per_seq=None, quant=None, use_kernel=False,
+                 dtype=None, temperature=0.0, top_k=0, top_p=1.0, seed=0,
+                 mesh=None):
+        cfg = model.cfg
+        self.cfg = cfg
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_batch = max_batch
+        self.max_pages = max_pages_per_seq or \
+            (cfg.max_seq_len + page_size - 1) // page_size
+        self.quant = quant
+        self.use_kernel = use_kernel
+        assert quant in (None, "a8w8", "w4a16"), quant
+        # temperature 0 = greedy (reference decode convention)
+        self.sampling = None if not temperature else \
+            (float(temperature), int(top_k), float(top_p))
+        self.seed = int(seed)
+        self._draws = 0
+        dtype = dtype or jnp.dtype(cfg.dtype)
+
+        state = {k: np.asarray(v._value)
+                 for k, v in model.state_dict().items()}
+        L = cfg.num_layers
+
+        def stack(fmt):
+            return jnp.asarray(
+                np.stack([state[fmt.format(i)] for i in range(L)]))
+
+        H, D = cfg.num_heads, cfg.head_dim
+        w = {
+            "ln1_w": stack("blocks.{}.ln1.weight"),
+            "ln1_b": stack("blocks.{}.ln1.bias"),
+            # head-major qkv layout [L, h, 3, H, D]: under tp the shard
+            # axis is the HEAD dim, which propagates cleanly through the
+            # per-head attention and the head-sharded KV pages (a flat
+            # [h, 3h] out-dim shard mixes q/k/v columns and costs an
+            # all-gather per layer)
+            "qkv_w": stack("blocks.{}.qkv.weight").reshape(
+                cfg.num_layers, cfg.hidden_size, 3, H, D),
+            "qkv_b": stack("blocks.{}.qkv.bias").reshape(
+                cfg.num_layers, 3, H, D),
+            "proj_w": stack("blocks.{}.proj.weight"),
+            "proj_b": stack("blocks.{}.proj.bias"),
+            "ln2_w": stack("blocks.{}.ln2.weight"),
+            "ln2_b": stack("blocks.{}.ln2.bias"),
+            "fc1_w": stack("blocks.{}.fc1.weight"),
+            "fc1_b": stack("blocks.{}.fc1.bias"),
+            "fc2_w": stack("blocks.{}.fc2.weight"),
+            "fc2_b": stack("blocks.{}.fc2.bias"),
+        }
+        if quant:
+            if quant == "w4a16":
+                from ..ops.w4_matmul import quantize_w4 as quantizer
+            else:
+                quantizer = _quantize_w
+            for k in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+                v = w[k]
+                shp = v.shape
+                if v.ndim > 3:          # qkv head-major: flatten to 2-D
+                    v = v.reshape(shp[0], shp[1], -1)
+                q, s = jax.vmap(quantizer)(v)
+                # restore the head-major rank (w4's packed in-dim is
+                # h/2) so _shard_for_tp's specs apply to both quant
+                # modes exactly as to fp; the scan slices tuples
+                # leaf-wise per layer
+                w[k] = (q.reshape((shp[0], q.shape[1]) + shp[2:]),
+                        s.reshape((shp[0],) + shp[2:]))
+        self.weights = w
+        self.wte = jnp.asarray(state["wte.weight"])
+        self.wpe = jnp.asarray(state["wpe.weight"])
+        self.ln_f_w = jnp.asarray(state["ln_f.weight"])
+        self.ln_f_b = jnp.asarray(state["ln_f.bias"])
+        self.lm_head = jnp.asarray(
+            state.get("lm_head.weight", state["wte.weight"].T))
+
+        H, D = cfg.num_heads, cfg.head_dim
+        self.k_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
+        self.v_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
+
+        # tensor-parallel serving: shard the 3h/ffn/head dims of the
+        # stacked weights and the HEAD dim of the KV pages over 'tp';
+        # GSPMD inserts the all-reduces after proj/ffn2 — the Megatron
+        # decode layout, no code changes in the step function
+        self.mesh = mesh
+        if mesh is None:
+            from ..distributed.mesh import get_mesh
+            m = get_mesh(create_default=False)
+            if m is not None and m.shape.get("tp", 1) > 1:
+                self.mesh = m
+        if self.mesh is not None:
+            self._shard_for_tp()
+
+        self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._multis = {}     # (k, return_logits) -> jitted fused loop
+        self._verify = None   # jitted lazily (speculative decoding only)
+        self._probs = None    # jitted lazily (sampled speculation)
+        self._prefills = {}   # padded length -> jitted prefill
+        self._suffix_prefill = None   # jitted lazily (chunked prefill)
+        self._copy = None     # jitted lazily (copy-on-write page copy)
+
+    def _probs_of(self, logits):
+        """softmax over the decoder's sampling mask (the distribution its
+        sampled tokens are actually drawn from)."""
+        if self._probs is None:
+            from ..models.generation import mask_logits
+            if self.sampling:
+                t, tk, tp = self.sampling
+                self._probs = jax.jit(lambda lg: jax.nn.softmax(
+                    mask_logits(lg, t, tk, tp), axis=-1))
+            else:
+                self._probs = jax.jit(
+                    lambda lg: jax.nn.softmax(lg, axis=-1))
+        return np.asarray(self._probs(logits))
+
+    def _shard_for_tp(self):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        tp = mesh.shape.get("tp", 1)
+        if self.cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads {self.cfg.num_heads} must divide over "
+                f"tp={tp} for tensor-parallel serving")
+        if self.cfg.ffn_hidden % tp:
+            raise ValueError(
+                f"ffn_hidden {self.cfg.ffn_hidden} must divide over "
+                f"tp={tp} for tensor-parallel serving")
+
+        def put(v, *spec):
+            return jax.device_put(v, NamedSharding(mesh, P(*spec)))
+
+        w = self.weights
+
+        def put_w(key, *spec):
+            if isinstance(w[key], tuple):      # a8w8 (q, per-out scale)
+                q, s = w[key]
+                w[key] = (put(q, *spec), put(s, spec[0], *spec[2:]))
+            else:
+                w[key] = put(w[key], *spec)
+
+        # column-parallel qkv (HEAD axis — aligns with the per-head
+        # attention and the head-sharded pages, no reshard) and fc1;
+        # row-parallel proj/fc2; biases follow their out dims
+        put_w("qkv_w", None, None, None, "tp", None)
+        w["qkv_b"] = put(w["qkv_b"], None, None, "tp", None)
+        put_w("proj_w", None, "tp", None)
+        put_w("fc1_w", None, None, "tp")
+        w["fc1_b"] = put(w["fc1_b"], None, "tp")
+        put_w("fc2_w", None, "tp", None)
+        self.wte = put(self.wte, None, None)
+        if self.lm_head.shape[-1] % tp == 0:
+            self.lm_head = put(self.lm_head, None, "tp")
+        else:
+            # odd vocab (e.g. 50257): keep the head replicated rather
+            # than fail — logits are [S, V] and small at decode batch
+            self.lm_head = put(self.lm_head, None, None)
+        # KV pages: heads sharded — each tp shard holds its heads' pages
+        self.k_pages = put(self.k_pages, None, None, None, "tp", None)
+        self.v_pages = put(self.v_pages, None, None, None, "tp", None)
+
+    # -- compiled programs -------------------------------------------------
+
+    def _forward_tokens(self, weights, k_pages, v_pages, tokens, lens,
+                        table, pids, offs):
+        """Shared single-position forward over all slots: embed `tokens`
+        at position `lens`, write K/V at (pids, offs) — callers route
+        frozen slots' pids to the reserved scratch page — and attend
+        over each slot's pages. Returns (logits [S, V], k_pages,
+        v_pages). Both the per-tick step and every tick of the fused
+        multi-step scan run THIS body, so they cannot drift."""
+        cfg = self.cfg
+        H, D = cfg.num_heads, cfg.head_dim
+        S = tokens.shape[0]
+        x = (self.wte[tokens] +
+             self.wpe[jnp.clip(lens, 0, cfg.max_seq_len - 1)]
+             ).astype(k_pages.dtype)                           # [S, h]
+        quant = self.quant
+
+        def layer(x, wkv):
+            wl, kp, vp = wkv
+            y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+            qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)  # [S,3,H,D]
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
+            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            from ..ops.paged_attention import paged_attention
+            attn = paged_attention(q[:, None], kp, vp, table, lens + 1,
+                                   use_kernel=self.use_kernel)  # [S,1,H,D]
+            x = x + _mm(attn.reshape(S, H * D), wl["proj_w"], wl["proj_b"],
+                        quant)
+            y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+            h = jax.nn.gelu(_mm(y, wl["fc1_w"], wl["fc1_b"], quant),
+                            approximate=True)
+            x = x + _mm(h, wl["fc2_w"], wl["fc2_b"], quant)
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer, x, (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        return logits, k_pages, v_pages
+
+    def _pos_keys(self, kids, pos):
+        """Per-slot PRNG keys from (seed, kid, position): draws depend
+        only on the decoder seed, the request identity (`kids` — the
+        engine passes the request id; direct callers default to the
+        slot index) and the position of the token being consumed.
+        NOTHING about scheduling enters the key, so the same request
+        sampled through the per-tick loop, the fused multi-step loop,
+        or any admission/batch composition draws the same tokens."""
+        base = jax.random.PRNGKey(self.seed)
+        return jax.vmap(lambda kid, p: jax.random.fold_in(
+            jax.random.fold_in(base, kid), p))(kids, pos)
+
+    def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table,
+                     kids):
+        """tokens [S], lens [S] (tokens already counted, i.e. position of
+        the incoming token), table [S, max_pages], kids [S] (sampling
+        key ids, see _pos_keys) -> (next [S], logits [S, V], k_pages,
+        v_pages)."""
+        ps = self.page_size
+        pids = jnp.take_along_axis(table, (lens // ps)[:, None],
+                                   axis=1)[:, 0]                # [S]
+        offs = lens % ps
+        logits, k_pages, v_pages = self._forward_tokens(
+            weights, k_pages, v_pages, tokens, lens, table, pids, offs)
+        keys = None
+        if self.sampling is not None:
+            keys = self._pos_keys(kids, lens)
+        nxt = _sample_tokens(logits, self.sampling, keys)
+        return nxt, logits, k_pages, v_pages
+
+    def _decode_multi_step(self, weights, k_pages, v_pages, tokens, lens,
+                           table, kids, done, remaining, eos, *, k,
+                           return_logits=False):
+        """K fused decode ticks inside ONE compiled program (lax.scan):
+        each tick's sampled token feeds the next tick on device, so the
+        host syncs once per K tokens instead of once per token.
+
+        tokens/lens/table/kids as in `_decode_step`. Tick j draws with
+        the (seed, kid, lens+j) key — exactly the keys the per-tick
+        loop would use at those positions, so fused and per-tick decode
+        emit byte-identical streams. `done` [S] bool freezes a slot
+        from tick 0 (inactive or already finished); a slot also freezes
+        itself after emitting its first `eos` (pass -1 for none) or
+        after `remaining` [S] tokens (its budget). Frozen slots' `lens`
+        stop advancing and their K/V writes route to the reserved
+        scratch page, so the pages stay exactly as the per-tick engine
+        would leave them.
+
+        Returns (block [k, S] emitted tokens, done_before [k, S] — True
+        where the slot was already frozen, i.e. the token is filler —
+        final tokens/lens/done/remaining, k_pages, v_pages[, logits
+        [k, S, V] when return_logits])."""
+        ps = self.page_size
+        scratch = self.num_pages - 1
+
+        def tick(carry, _):
+            tokens, lens, done, remaining, kp, vp = carry
+            pids = jnp.take_along_axis(table, (lens // ps)[:, None],
+                                       axis=1)[:, 0]
+            pids = jnp.where(done, scratch, pids)
+            offs = lens % ps
+            logits, kp, vp = self._forward_tokens(
+                weights, kp, vp, tokens, lens, table, pids, offs)
+            keys = None
+            if self.sampling is not None:
+                keys = self._pos_keys(kids, lens)
+            nxt = _sample_tokens(logits, self.sampling, keys)
+            nxt = jnp.where(done, tokens, nxt)
+            rem = jnp.where(done, remaining, remaining - 1)
+            new_done = done | (nxt == eos) | (rem <= 0)
+            new_lens = jnp.where(done, lens, lens + 1)
+            out = (nxt, done, logits) if return_logits else (nxt, done)
+            return (nxt, new_lens, new_done, rem, kp, vp), out
+
+        carry = (tokens, lens, done, remaining, k_pages, v_pages)
+        carry, outs = jax.lax.scan(tick, carry, jnp.arange(k))
+        tokens, lens, done, remaining, k_pages, v_pages = carry
+        ret = (outs[0], outs[1], tokens, lens, done, remaining,
+               k_pages, v_pages)
+        if return_logits:
+            ret += (outs[2],)
+        return ret
+
+    def _windowed_layer(self, pos, pids, offs, table):
+        """ONE gather-attention transformer layer shared by the verify
+        window (`_verify_step`) and the chunked prefill
+        (`_prefill_suffix_step`): write each position's K/V at (pids,
+        offs) — callers route out-of-range/padded positions to the
+        scratch page — gather the row's pages, attend with
+        per-position causality (kpos <= pos), then residual proj +
+        FFN. A single body means a masking or scratch-routing fix can
+        never diverge the two programs (the byte-identical
+        cache-on/off guarantee rides on the chunked prefill computing
+        exactly what the cached pages hold)."""
+        cfg, ps = self.cfg, self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        n, W = pos.shape
+        MP = table.shape[1]
+        quant = self.quant
+
+        def layer(x, wkv):
+            wl, kp, vp = wkv
+            y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+            qkv = _mm_heads(y.reshape(n * W, -1), wl["qkv_w"],
+                            wl["qkv_b"], quant).reshape(n, W, 3, H, D)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
+            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            # gather each row's pages and attend with per-row causality
+            kg = kp[table].reshape(n, MP * ps, H, D)            # [n, T, H, D]
+            vg = vp[table].reshape(n, MP * ps, H, D)
+            scale = 1.0 / float(np.sqrt(D))
+            s = jnp.einsum("swhd,sthd->shwt", q.astype(jnp.float32),
+                           kg.astype(jnp.float32)) * scale
+            kpos = jnp.arange(MP * ps)[None, None, None, :]
+            s = jnp.where(kpos <= pos[:, None, :, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("shwt,sthd->swhd", p,
+                              vg.astype(jnp.float32)).astype(x.dtype)
+            o = _mm(attn.reshape(n * W, H * D), wl["proj_w"],
+                    wl["proj_b"], quant).reshape(n, W, -1)
+            x = x + o
+            y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+            h = jax.nn.gelu(
+                _mm(y.reshape(n * W, -1), wl["fc1_w"], wl["fc1_b"],
+                    quant), approximate=True)
+            x = x + _mm(h, wl["fc2_w"], wl["fc2_b"],
+                        quant).reshape(n, W, -1)
+            return x, (kp, vp)
+
+        return layer
+
+    def _verify_step(self, weights, k_pages, v_pages, tokens, lens, table):
+        """Speculative verify: tokens [S, W] (last accepted token + the
+        draft proposals) are consumed in ONE forward — KV written at
+        positions lens..lens+W-1, causal attention against the paged
+        prefix — returning the target's greedy choice after every
+        position ([S, W] argmaxes). Rejected positions need no cleanup:
+        lens is the source of truth and stale entries are overwritten."""
+        cfg, ps = self.cfg, self.page_size
+        S, W = tokens.shape
+        pos = lens[:, None] + jnp.arange(W)[None, :]            # [S, W]
+        x = (self.wte[tokens] +
+             self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+             ).astype(self.k_pages.dtype)                       # [S, W, h]
+        MP = table.shape[1]
+        # margin guard: window positions past the table's capacity (the
+        # engine admits with a +k margin, so only pathological callers
+        # get here) write to the reserved scratch page, never to a
+        # clamped REAL page of the sequence
+        in_range = pos < MP * ps
+        pids = jnp.take_along_axis(table, jnp.minimum(pos // ps, MP - 1),
+                                   axis=1)                      # [S, W]
+        pids = jnp.where(in_range, pids, self.num_pages - 1)
+        offs = pos % ps
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            self._windowed_layer(pos, pids, offs, table), x,
+            (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        logits = x.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32), logits,
+                k_pages, v_pages)
+
+    def verify(self, tokens, lens, table, return_probs=False):
+        """Batched speculative verify (see _verify_step)."""
+        if self._verify is None:
+            self._verify = jax.jit(self._verify_step,
+                                   donate_argnums=(1, 2))
+        out, logits, self.k_pages, self.v_pages = self._verify(
+            self.weights, self.k_pages, self.v_pages,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(table, jnp.int32))
+        if return_probs:
+            return np.asarray(out), self._probs_of(logits)
+        return np.asarray(out)
+
+    def _prefill_suffix_step(self, weights, k_pages, v_pages, ids, start,
+                             true_len, table, kids):
+        """Chunked prefill: consume the UNCACHED suffix of each prompt
+        in one forward, attending against the paged prefix (the
+        prefix-cache mounts cached pages into `table` host-side; a
+        `start=0` row is simply a full, uncached prompt).
+
+        ids [n, W] suffix tokens (zero-padded), start [n] first position
+        to compute (= cached-prefix length), true_len [n] full prompt
+        length, table [n, max_pages] page rows, kids [n] sampling key
+        ids.  K/V is written at positions start..true_len-1 — padded
+        positions route to the reserved scratch page, so real pages hold
+        ONLY real prompt KV (full blocks become content-addressable
+        cache entries).  Per-position computations are independent of
+        the padded width W and the batch rows (matmuls are row-local,
+        attention reduces over the fixed [max_pages*page_size] gather),
+        so a block's bytes are identical whether its request computed it
+        alone, in a batch, or mounted it from another request's prefill
+        — the property the byte-identical cache-on/off equivalence
+        tests pin.  The layer body is `_windowed_layer`, shared with
+        `_verify_step`.  Returns (first generated token [n] — sampled
+        at position true_len-1 with the standard (seed, kid, position)
+        key — k_pages, v_pages)."""
+        cfg, ps = self.cfg, self.page_size
+        n, W = ids.shape
+        pos = start[:, None] + jnp.arange(W)[None, :]           # [n, W]
+        x = (self.wte[ids] +
+             self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+             ).astype(k_pages.dtype)                            # [n, W, h]
+        MP = table.shape[1]
+        # scratch-route every write that isn't a real prompt position:
+        # the padded tail (pos >= true_len) and table overflow
+        in_range = (pos < true_len[:, None]) & (pos < MP * ps)
+        pids = jnp.take_along_axis(table, jnp.minimum(pos // ps, MP - 1),
+                                   axis=1)                      # [n, W]
+        pids = jnp.where(in_range, pids, self.num_pages - 1)
+        offs = pos % ps
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            self._windowed_layer(pos, pids, offs, table), x,
+            (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        last = jnp.take_along_axis(
+            x, jnp.clip(true_len - 1 - start, 0, W - 1)
+            [:, None, None].astype(jnp.int32), axis=1)[:, 0]    # [n, h]
+        logits = last.astype(jnp.float32) @ \
+            self.lm_head.astype(jnp.float32)
+        keys = None
+        if self.sampling is not None:
+            # same (seed, kid, position) key walk as decode and the
+            # flash prefill: the prompt's last token sits at true_len-1,
+            # whatever span of it was cache-mounted
+            keys = self._pos_keys(kids, true_len - 1)
+        return _sample_tokens(logits, self.sampling, keys), \
+            k_pages, v_pages
+
+    def _prefill_fn(self, Lp, n):
+        """Per-(length-bucket, batch-bucket) compiled prefill: n padded
+        sequences at once. Writes prompt KV into each sequence's pages
+        and returns the n first tokens."""
+        cfg, ps = self.cfg, self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        n_pg = Lp // ps
+        quant = self.quant
+
+        def run(weights, k_pages, v_pages, ids, true_len, page_ids, kids):
+            x = (self.wte[ids] + self.wpe[jnp.arange(Lp)][None]
+                 ).astype(k_pages.dtype)                     # [n, Lp, h]
+
+            def layer(x, wkv):
+                wl, kp, vp = wkv
+                y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+                qkv = _mm_heads(y.reshape(n * Lp, -1), wl["qkv_w"],
+                                wl["qkv_b"], quant).reshape(n, Lp, 3, H, D)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                # Pallas flash kernel when backend/tiling allow, jnp
+                # reference otherwise (one shared gate + fallback).
+                # Padded-key masking is unnecessary: causal rows < true_len
+                # never see cols >= true_len, padded rows' garbage stays
+                # row-local, and only row true_len-1 feeds the logits.
+                from ..ops.attention import flash_raw_or_reference
+                attn = flash_raw_or_reference(
+                    q, k, v, causal=True, scale=1.0 / math.sqrt(D))
+                x = x + _mm(attn.reshape(n * Lp, H * D).astype(x.dtype),
+                            wl["proj_w"], wl["proj_b"],
+                            quant).reshape(n, Lp, -1)
+                y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+                h = jax.nn.gelu(
+                    _mm(y.reshape(n * Lp, -1), wl["fc1_w"], wl["fc1_b"],
+                        quant), approximate=True)
+                x = x + _mm(h, wl["fc2_w"], wl["fc2_b"],
+                            quant).reshape(n, Lp, -1)
+                # page writes: static page count, dynamic page ids; the
+                # requests' page sets are disjoint (scratch excepted)
+                kpg = k.reshape(n, n_pg, ps, H, D).astype(kp.dtype)
+                vpg = v.reshape(n, n_pg, ps, H, D).astype(vp.dtype)
+                kp = kp.at[page_ids].set(kpg)
+                vp = vp.at[page_ids].set(vpg)
+                return x, (kp, vp)
+
+            x, (k_pages, v_pages) = jax.lax.scan(
+                layer, x, (weights, k_pages, v_pages))
+            x = _ln(x, self.ln_f_w, self.ln_f_b)
+            last = jnp.take_along_axis(
+                x, (true_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                                # [n, h]
+            logits = last.astype(jnp.float32) @ \
+                self.lm_head.astype(jnp.float32)
+            keys = None
+            if self.sampling is not None:
+                # same (seed, kid, position) key walk as decode: the
+                # prompt's last token sits at true_len-1, so the first
+                # generated token draws with that position — whatever
+                # chunk/bucket the request was prefilled in
+                keys = self._pos_keys(kids, true_len - 1)
+            return _sample_tokens(logits, self.sampling, keys), \
+                k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    # -- host-side API -----------------------------------------------------
+
+    def prefill(self, ids, page_ids, kid=None):
+        """Run one prompt through the model, writing KV into `page_ids`;
+        returns the next token (greedy, or sampled per the decoder's
+        temperature/top_k/top_p config)."""
+        return self.prefill_batch([(ids, page_ids)],
+                                  kids=None if kid is None else [kid])[0]
+
+    def prefill_batch(self, requests, kids=None):
+        """Prefill several prompts, batching same-length-bucket groups
+        into single forwards. requests: [(ids, page_ids), ...]; returns
+        the first generated token per request (in order). `kids` are
+        the per-request sampling key ids (see _pos_keys; the engine
+        passes request ids — default: the request's index in this
+        call)."""
+        ps = self.page_size
+        results = [None] * len(requests)
+        if kids is None:
+            kids = list(range(len(requests)))
+        groups = {}
+        for i, (ids, page_ids) in enumerate(requests):
+            ids = np.asarray(ids, np.int32)
+            Lp = max(ps, ps * (2 ** math.ceil(
+                math.log2(max(1, (len(ids) + ps - 1) // ps)))))
+            groups.setdefault(Lp, []).append((i, ids, page_ids))
+        for Lp, group in groups.items():
+            n_pg = Lp // ps
+            while group:
+                # batch-bucket to powers of two (bounded compile count)
+                nb = 1
+                while nb * 2 <= len(group) and nb * 2 <= self.max_batch:
+                    nb *= 2
+                chunk, group = group[:nb], group[nb:]
+                pad = np.zeros((nb, Lp), np.int32)
+                tl = np.ones(nb, np.int32)
+                pg = np.full((nb, n_pg), self.num_pages - 1, np.int32)
+                kd = np.zeros(nb, np.int32)
+                for r, (i, ids, page_ids) in enumerate(chunk):
+                    pad[r, :len(ids)] = ids
+                    tl[r] = len(ids)
+                    kd[r] = kids[i]
+                    k = min(len(page_ids), n_pg)
+                    pg[r, :k] = page_ids[:k]   # rest stays on scratch
+                key = (Lp, nb)
+                if key not in self._prefills:
+                    self._prefills[key] = self._prefill_fn(Lp, nb)
+                self._draws += 1
+                nxt, self.k_pages, self.v_pages = self._prefills[key](
+                    self.weights, self.k_pages, self.v_pages,
+                    jnp.asarray(pad), jnp.asarray(tl), jnp.asarray(pg),
+                    jnp.asarray(kd))
+                nxt = np.asarray(nxt)
+                for r, (i, _, _) in enumerate(chunk):
+                    results[i] = int(nxt[r])
+        return results
+
+    def prefill_suffix_batch(self, requests, kids=None):
+        """Chunked prefill over page-table rows (the prefix-cache
+        admission path; see `_prefill_suffix_step`). requests:
+        [(suffix_ids, start, pages), ...] — `pages` is the sequence's
+        page list in block order (cached prefix pages mounted by the
+        engine + freshly allocated suffix pages), `start` the cached
+        prefix length (0 = nothing cached: the suffix IS the prompt).
+        Suffix lengths bucket to powers of two and batches to powers of
+        two like `prefill_batch`, bounding the compile count; one
+        jitted program (`_suffix_prefill`) specializes per bucket.
+        Returns the first generated token per request (in order)."""
+        results = [None] * len(requests)
+        if kids is None:
+            kids = list(range(len(requests)))
+        if self._suffix_prefill is None:
+            self._suffix_prefill = jax.jit(self._prefill_suffix_step,
+                                           donate_argnums=(1, 2))
+        MP = self.max_pages
+        groups = {}
+        for i, (ids, start, pages) in enumerate(requests):
+            ids = np.asarray(ids, np.int32)
+            W = 4
+            while W < len(ids):
+                W *= 2
+            groups.setdefault(W, []).append((i, ids, int(start), pages))
+        for W, group in groups.items():
+            while group:
+                nb = 1
+                while nb * 2 <= len(group) and nb * 2 <= self.max_batch:
+                    nb *= 2
+                chunk, group = group[:nb], group[nb:]
+                pad = np.zeros((nb, W), np.int32)
+                st = np.zeros(nb, np.int32)
+                tl = np.ones(nb, np.int32)
+                tbl = np.full((nb, MP), self.num_pages - 1, np.int32)
+                kd = np.zeros(nb, np.int32)
+                for r, (i, ids, start, pages) in enumerate(chunk):
+                    pad[r, :len(ids)] = ids
+                    st[r] = start
+                    tl[r] = start + len(ids)
+                    k = min(len(pages), MP)
+                    tbl[r, :k] = pages[:k]     # rest stays on scratch
+                    kd[r] = kids[i]
+                self._draws += 1
+                nxt, self.k_pages, self.v_pages = self._suffix_prefill(
+                    self.weights, self.k_pages, self.v_pages,
+                    jnp.asarray(pad), jnp.asarray(st), jnp.asarray(tl),
+                    jnp.asarray(tbl), jnp.asarray(kd))
+                nxt = np.asarray(nxt)
+                for r, (i, _, _, _) in enumerate(chunk):
+                    results[i] = int(nxt[r])
+        return results
+
+    def copy_page(self, src, dst):
+        """Device-side page copy (K and V, every layer): the engine's
+        copy-on-write primitive — a request about to write into a page
+        it mounted SHARED gets a private copy first, so cached prefix
+        pages stay immutable for their whole cached life."""
+        if self._copy is None:
+            def cp(kp, vp, s, d):
+                return (kp.at[:, d].set(kp[:, s]),
+                        vp.at[:, d].set(vp[:, s]))
+            self._copy = jax.jit(cp, donate_argnums=(0, 1))
+        self.k_pages, self.v_pages = self._copy(
+            self.k_pages, self.v_pages,
+            jnp.asarray(int(src), jnp.int32),
+            jnp.asarray(int(dst), jnp.int32))
+
+    @property
+    def kv_page_bytes(self):
+        """KV bytes one page holds across all layers (K and V) — the
+        prefix cache's bytes-saved unit."""
+        cfg = self.cfg
+        return int(2 * cfg.num_layers * self.page_size * cfg.num_heads *
+                   cfg.head_dim * jnp.dtype(self.k_pages.dtype).itemsize)
+
+    def cache_fingerprint(self):
+        """Model/sampling-invariant identity of this decoder's KV bytes
+        — the prefix cache's root-key salt. KV pages depend on the
+        weights, architecture, page size, pool dtype and quant mode but
+        NOT on temperature/seed, so two decoders may alias cached pages
+        exactly when this matches. Weight identity rides on cheap
+        content probes over EVERY stacked tensor (per-tensor f32 sums
+        — embeddings alone would alias a frozen-embedding fine-tune
+        with its base model)."""
+        cfg = self.cfg
+
+        def probe(v):
+            if isinstance(v, tuple):         # quantized (q, scale)
+                return tuple(probe(x) for x in v)
+            return float(jnp.sum(v.astype(jnp.float32)))
+
+        probes = tuple(probe(self.weights[k])
+                       for k in sorted(self.weights))
+        probes += (probe(self.wte), probe(self.wpe),
+                   probe(self.lm_head), probe(self.ln_f_w),
+                   probe(self.ln_f_b))
+        parts = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                 cfg.head_dim, cfg.vocab_size, cfg.max_seq_len,
+                 self.page_size, str(jnp.dtype(self.k_pages.dtype)),
+                 self.quant or "", probes)
+        return repr(parts).encode()
+
+    def analysis_program(self, donate=True, k=None, prefix_w=None):
+        """Graph Doctor view of the compiled decode program: one fresh
+        trace with per-argument role capture — weights/embeddings are
+        `param` (read-only across steps, NOT donated: that's correct
+        for inference), the K/V page pools are `cache` with
+        donated=True matching the real donate_argnums=(1,2) (the cache
+        is the decode loop's carried state — an undonated cache is the
+        MEM-NO-DONATION-KVCACHE lint), everything else is `input`.
+
+        With `k` the FUSED multi-step program (`_decode_multi_step`, K
+        device-resident ticks in one lax.scan) is traced instead of the
+        single tick — the SERVE-HOST-SYNC-DECODE rule checks it for
+        host transfers and kept cache donation. With `prefix_w` the
+        CHUNKED prefill program (`_prefill_suffix_step`, suffix bucket
+        W=prefix_w) is traced — the prefix-cache admission path, gated
+        by the same serving rules plus the MEM-PAGE-REFCOUNT ledger
+        audit (`gpt_decode_prefix` PROGRAM config). `donate=False`
+        traces the defective variant the planted-defect tests lint."""
+        from ..analysis.lowering import LoweredProgram, tree_arg_infos
+
+        S = self.max_batch
+        kids = jnp.arange(S, dtype=jnp.int32)
+        table = jnp.zeros((S, self.max_pages), jnp.int32)
+        if k and prefix_w:
+            raise ValueError("pass k= or prefix_w=, not both")
+        if prefix_w:
+            W = int(prefix_w)
+            ids = jnp.zeros((S, W), jnp.int32)
+            start = jnp.zeros((S,), jnp.int32)
+            true_len = jnp.ones((S,), jnp.int32)
+            inputs = [("ids", ids), ("start", start),
+                      ("true_len", true_len), ("table", table),
+                      ("kids", kids)]
+            fn = jax.jit(self._prefill_suffix_step,
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              ids, start, true_len, table, kids)
+            name = f"prefill_suffix_w{W}"
+        elif k:
+            tokens = jnp.zeros((S,), jnp.int32)
+            lens = jnp.zeros((S,), jnp.int32)
+            done = jnp.zeros((S,), bool)
+            remaining = jnp.full((S,), int(k), jnp.int32)
+            eos = jnp.asarray(-1, jnp.int32)
+            inputs = [("tokens", tokens), ("lens", lens),
+                      ("table", table), ("kids", kids), ("done", done),
+                      ("remaining", remaining), ("eos", eos)]
+            fn = jax.jit(functools.partial(self._decode_multi_step,
+                                           k=int(k)),
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids, done, remaining,
+                              eos)
+            name = f"decode_multi_k{int(k)}"
+        else:
+            tokens = jnp.zeros((S,), jnp.int32)
+            lens = jnp.zeros((S,), jnp.int32)
+            inputs = [("tokens", tokens), ("lens", lens),
+                      ("table", table), ("kids", kids)]
+            fn = jax.jit(self._decode_step,
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids)
+            name = "decode_step"
+        infos = tree_arg_infos(self.weights, "param")
+        infos += tree_arg_infos(self.k_pages, "cache", prefix="k_pages",
+                                donated=donate)
+        infos += tree_arg_infos(self.v_pages, "cache", prefix="v_pages",
+                                donated=donate)
+        for nm, v in inputs:
+            infos += tree_arg_infos(v, "input", prefix=nm)
+        return LoweredProgram(traced.lower().as_text(),
+                              jaxpr=traced.jaxpr, name=name,
+                              arg_infos=infos)
+
+    def step_hbm_bytes(self, avg_ctx=None):
+        """HBM bytes ONE decode tick moves: every weight byte plus each
+        slot's KV prefix at `avg_ctx` (default: half the model's max
+        sequence). The numerator of the decode tick roofline —
+        `cost_model.decode_horizon` prices the default multi-step K
+        from it; bench.decode_roofline_tok_s is the tok/s view of the
+        same bytes model."""
+        cfg = self.cfg
+        n = cfg.num_params()
+        per = {"a8w8": 1.0, "w4a16": 0.5}.get(self.quant)
+        if per is not None:
+            h, f = cfg.hidden_size, cfg.ffn_hidden
+            lin = cfg.num_layers * (4 * h * h + 2 * h * f)
+            w_bytes = lin * per + (n - lin) * 2
+        else:
+            w_bytes = n * 2
+        if avg_ctx is None:
+            avg_ctx = max(cfg.max_seq_len // 2, 1)
+        kv = (self.max_batch * cfg.num_layers * 2 * avg_ctx *
+              cfg.num_heads * cfg.head_dim *
+              jnp.dtype(self.k_pages.dtype).itemsize)
+        return int(w_bytes + kv)
+
+    def _kids_or_default(self, kids):
+        if kids is None:
+            return np.arange(self.max_batch, dtype=np.int32)
+        return np.asarray(kids, np.int32)
+
+    def decode(self, tokens, lens, table, kids=None, return_probs=False):
+        """One decode step for all slots (greedy, or the configured
+        sampling with deterministic per-(seed, kid, position) keys —
+        kid defaults to the slot index; the engine passes request ids
+        so a request's draws are scheduling-independent).
+        return_probs additionally yields the [S, V] distribution the
+        token was drawn from (speculative acceptance needs it)."""
+        self._draws += 1
+        nxt, logits, self.k_pages, self.v_pages = self._decode(
+            self.weights, self.k_pages, self.v_pages,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(table, jnp.int32),
+            jnp.asarray(self._kids_or_default(kids)))
+        if return_probs:
+            return nxt, self._probs_of(logits)
+        return nxt
+
+    def decode_multi(self, tokens, lens, table, k, kids=None, done=None,
+                     remaining=None, eos=None, return_logits=False):
+        """Run `k` decode ticks device-resident: ONE dispatch, zero
+        intermediate host syncs (see `_decode_multi_step`). Jitted per
+        (k, return_logits); the engine buckets k to powers of two so
+        the compile count stays bounded like the prefill buckets.
+
+        All inputs/outputs may stay on device: the engine feeds the
+        returned tokens/lens/done/remaining straight into the next
+        horizon's call and fetches tokens_block/done_before only at
+        sync points. `kids` are per-slot sampling key ids (see
+        `_pos_keys`; default slot index), `done` marks slots frozen
+        from tick 0 (default none), `remaining` per-slot token budgets
+        (default unlimited), `eos` the stop token (default none).
+        Returns a MultiDecodeOut;
+        `logits_block` is None unless return_logits (speculation wants
+        the draft's distributions)."""
+        k = int(k)
+        S = self.max_batch
+        key = (k, bool(return_logits))
+        fn = self._multis.get(key)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(self._decode_multi_step, k=k,
+                                  return_logits=bool(return_logits)),
+                donate_argnums=(1, 2))
+            self._multis[key] = fn
+        if done is None:
+            done = np.zeros(S, bool)
+        if remaining is None:
+            remaining = np.full(S, np.iinfo(np.int32).max // 2, np.int32)
+        self._draws += k             # dispatch telemetry, not key state
+        out = fn(self.weights, self.k_pages, self.v_pages,
+                 jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(lens, jnp.int32),
+                 jnp.asarray(table, jnp.int32),
+                 jnp.asarray(self._kids_or_default(kids)),
+                 jnp.asarray(done, bool),
+                 jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(-1 if eos is None else int(eos), jnp.int32))
+        self.k_pages, self.v_pages = out[6], out[7]
+        return MultiDecodeOut(out[0], out[1], out[2], out[3], out[4],
+                              out[5], out[8] if return_logits else None)

@@ -1,0 +1,764 @@
+"""Continuous-batching engines over the paged decoder: slot scheduling,
+horizon-fused decode, prefix-cache admission, speculative decoding."""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from .decoder import PagedGPTDecoder, _spec_accept
+from .stats import _ENGINES, ServeStats
+
+__all__ = ["ContinuousBatchingEngine", "SpeculativeEngine"]
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching: requests are admitted into free
+    slots as soon as capacity allows (iteration-level scheduling), decode
+    runs one compiled step for ALL active slots, finished sequences free
+    their pages.
+
+    By default `run()` schedules in HORIZONS: blocks of
+    `k = min(k_max, smallest remaining budget)` device-resident decode
+    ticks (`PagedGPTDecoder.decode_multi`), with the host syncing only
+    at block boundaries for admission/retirement/output append, and each
+    block's fetch overlapped against the NEXT block's dispatch
+    (one-horizon-delayed retirement: a slot finishing inside block N
+    stays frozen on device through block N+1 — its writes route to the
+    scratch page — and its pages are freed exactly once, when block N is
+    processed). `k_max` defaults to `cost_model.decode_horizon`'s priced
+    answer; `k_max=1` selects the legacy per-tick loop (`step()` is the
+    per-tick API either way).
+
+    With `prefix_cache` (a `PrefixCache`) admission becomes
+    content-addressed: each prompt's full token blocks are hashed
+    against the cache, fully-cached prefix spans are MOUNTED into the
+    request's page-table row host-side (zero device work — the pages
+    already hold exactly the KV bytes this prompt's prefill would
+    write), and only the uncached suffix runs through the chunked
+    prefill (`PagedGPTDecoder.prefill_suffix_batch`). Mounted pages are
+    refcounted and immutable: a request about to write into a shared
+    page (the first divergent token — only possible when the WHOLE
+    prompt was cached and its last position must be re-consumed for
+    logits) gets a copy-on-write private copy first. Retirement decrefs
+    shared pages instead of freeing them; refcount-0 pages park in the
+    cache's LRU and are evicted back to the free list only under pool
+    pressure — every page freed exactly once, auditable via
+    `page_ledger()`/`audit_pages()` (MEM-PAGE-REFCOUNT)."""
+
+    def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
+                 max_new_tokens=64, k_max=None, host_sync_s=None,
+                 prefix_cache=None):
+        if max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (the prefill forward always "
+                f"produces one token), got {max_new_tokens}")
+        self.d = decoder
+        self.eos = eos_token_id
+        self.max_new = max_new_tokens
+        # page 0..num_pages-2 allocatable; last page reserved as scratch
+        self._free = list(range(decoder.num_pages - 2, -1, -1))
+        S = decoder.max_batch
+        self._slot_req = [None] * S          # request id per slot
+        self._slot_pages = [[] for _ in range(S)]
+        # pages a slot holds as SHARED (cache-refcounted, never written)
+        self._slot_shared = [set() for _ in range(S)]
+        # int32 end to end: decode() feeds these to the kernel as int32,
+        # so int64 here would insert a convert_element_type every tick
+        self._lens = np.zeros(S, np.int32)
+        self._tokens = np.zeros(S, np.int32)
+        self._kids = np.zeros(S, np.int32)   # request id per slot: the
+        # sampling key id, so a request's draws are independent of
+        # which slot/batch/schedule served it
+        self._table_cache = None             # rebuilt on admit/retire only
+        self._queue = []                     # (req_id, ids)
+        self._outputs = {}                   # req_id -> [generated ids]
+        self._next_id = 0
+        self.steps = 0
+        if k_max is None:
+            from ..cost_model import decode_horizon
+            k_max = decode_horizon(decoder.step_hbm_bytes(),
+                                   host_sync_s=host_sync_s)
+        self.k_max = max(1, int(k_max))
+        if prefix_cache is True:
+            from .prefix_cache import PrefixCache
+            prefix_cache = PrefixCache(decoder.page_size,
+                                       salt=decoder.cache_fingerprint())
+        if prefix_cache is not None and \
+                prefix_cache.page_size != decoder.page_size:
+            raise ValueError(
+                f"prefix cache page_size {prefix_cache.page_size} != "
+                f"decoder page_size {decoder.page_size}")
+        self.cache = prefix_cache
+        self._cache_meta = {}                # rid -> (start, keys, n_hit)
+        self.stats = ServeStats(engine=type(self).__name__,
+                                k_max=self.k_max)
+        self._submit_t = {}                  # rid -> submit wall time
+        _ENGINES.add(self)
+
+    def submit(self, prompt_ids):
+        ids = [int(t) for t in np.asarray(
+            prompt_ids._value if isinstance(prompt_ids, Tensor)
+            else prompt_ids).reshape(-1)]
+        if not ids:
+            raise ValueError(
+                "prompt must contain at least one token (prefill "
+                "samples the first generated token after the prompt's "
+                "last position — an empty prompt has none)")
+        total = len(ids) + self.max_new
+        need = self._pages_for(total)
+        if need > min(self.d.max_pages, self.d.num_pages - 1):
+            raise ValueError(
+                f"request needs {need} pages (prompt {len(ids)} + "
+                f"max_new {self.max_new} tokens) but the pool allows "
+                f"{min(self.d.max_pages, self.d.num_pages - 1)}")
+        if total > self.d.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {len(ids)} + max_new {self.max_new} tokens "
+                f"exceeds the model's max_seq_len "
+                f"{self.d.cfg.max_seq_len} (positions past it have no "
+                "embedding)")
+        return self._register_request(ids)
+
+    def _register_request(self, ids):
+        """Queue a VALIDATED request: rid allocation, queue-wait stamp,
+        stats — one implementation for both engines' submit()s, and
+        called only after validation so a rejected submission can't
+        skew stats.requests or leak a _submit_t entry."""
+        rid = self._next_id
+        self._next_id += 1
+        self._submit_t[rid] = time.perf_counter()
+        self.stats.requests += 1
+        self._queue.append((rid, ids))
+        return rid
+
+    def _pages_for(self, n_tokens):
+        return (n_tokens + self.d.page_size - 1) // self.d.page_size
+
+    def _admit(self):
+        # gather every admittable request first: same-length-bucket
+        # prompts then prefill as ONE batched forward (iteration-level
+        # batching applies to prefill too, not just decode). Pages freed
+        # by EOS-at-prefill become available from the NEXT step's pass.
+        # Returns the slots that entered decode (the multi-step run loop
+        # merges exactly those into its device carry).
+        admitted = self._gather_admissions()
+        if not admitted:
+            return []
+        now = time.perf_counter()
+        t0s = {}
+        for _, rid, _, _ in admitted:
+            t0 = self._submit_t.pop(rid, None)
+            if t0 is not None:
+                self.stats.queue_wait_s.append(now - t0)
+                t0s[rid] = t0
+        self._table_cache = None
+        firsts = self._prefill_admitted(admitted)
+        self.stats.prefill_syncs += 1
+        self._extra_prefill(admitted)
+        done_t = time.perf_counter()
+        live = []
+        for (slot, rid, ids, pages), first in zip(admitted, firsts):
+            if rid in t0s:
+                self.stats.ttft_s.append(done_t - t0s[rid])
+            self._outputs[rid] = [first]
+            self.stats.tokens += 1
+            if (self.eos is not None and first == self.eos) \
+                    or self.max_new <= 1:
+                # finished at prefill: never occupy a decode slot
+                self._retire(slot)
+                continue
+            self._lens[slot] = len(ids)
+            self._tokens[slot] = first
+            self._kids[slot] = rid
+            self._after_admit(slot, len(ids))
+            live.append(slot)
+        return live
+
+    def _prefill_admitted(self, admitted):
+        """Dispatch the admitted requests' prefills: the flash-attention
+        full prefill without a prefix cache; the CHUNKED suffix path
+        with one (the cached span is mounted host-side — zero device
+        work — and only positions start..L-1 compute). Freshly computed
+        full blocks are published to the cache afterwards."""
+        if self.cache is None:
+            return self.d.prefill_batch(
+                [(ids, pages) for _, _, ids, pages in admitted],
+                kids=[rid for _, rid, _, _ in admitted])
+        reqs = []
+        for _, rid, ids, pages in admitted:
+            start = self._cache_meta[rid][0]
+            reqs.append((ids[start:], start, pages))
+        firsts = self.d.prefill_suffix_batch(
+            reqs, kids=[rid for _, rid, _, _ in admitted])
+        # publish newly computed full blocks: content-addressable from
+        # now on (the cache takes one reference-managed view; the slot
+        # keeps holding the page until retirement decrefs it). A
+        # same-batch duplicate whose insert is refused keeps its copy
+        # private — two requests never alias a page they both wrote —
+        # and publishing STOPS at the first refusal: a deeper block
+        # would chain under a parent this request neither mounted nor
+        # inserted, breaking the every-ancestor-referenced invariant
+        # the eviction cascade relies on (a parked parent could then
+        # cascade into a still-referenced child).
+        for slot, rid, ids, pages in admitted:
+            start, keys, n_hit = self._cache_meta.pop(rid)
+            for b in range(n_hit, len(keys)):
+                parent = keys[b - 1] if b else None
+                if not self.cache.insert(keys[b], pages[b],
+                                         parent=parent):
+                    break
+                self._slot_shared[slot].add(pages[b])
+        return firsts
+
+    def _gather_admissions(self):
+        if self.cache is not None:
+            return self._gather_admissions_cached()
+        admitted = []
+        for slot in range(self.d.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            rid, ids = self._queue[0]
+            need = self._pages_for(len(ids) + self.max_new)
+            if need > len(self._free) or need > self.d.max_pages:
+                break                        # head-of-line: wait for pages
+            self._queue.pop(0)
+            pages = [self._free.pop() for _ in range(need)]
+            self._slot_req[slot] = rid
+            self._slot_pages[slot] = pages
+            admitted.append((slot, rid, ids, pages))
+        return admitted
+
+    def _gather_admissions_cached(self):
+        """Prefix-cache admission: hash the prompt's full blocks, mount
+        the longest cached run into the page-table row (incref), evict
+        parked refcount-0 pages if the free list can't cover the
+        uncached remainder, and record (start, keys, n_hit) for the
+        chunked prefill. A FULL-prompt hit still needs the last
+        position's logits: its one re-consumed token would write into
+        the final mounted page, so that page is copy-on-write'd to a
+        private copy first (the recomputed KV bytes are identical — the
+        chunked prefill is deterministic and position-local — so the
+        copy diverges only once decode appends past the prompt)."""
+        admitted = []
+        ps = self.d.page_size
+        tok_bytes = self.d.kv_page_bytes // ps
+        for slot in range(self.d.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            rid, ids = self._queue[0]
+            L = len(ids)
+            total = self._pages_for(L + self.max_new)
+            if total > self.d.max_pages:
+                break
+            keys = self.cache.block_keys(ids)
+            hits = self.cache.match(keys)
+            # pick the largest mounted span the pool can cover: mounted
+            # hit pages are excluded from eviction, so on a tight pool
+            # a full-span mount can be self-blocking (the parked hit
+            # pages ARE the reclaimable ones — e.g. a full-prompt hit
+            # whose CoW page cannot be allocated). Degrading the span
+            # turns the excess hits back into evictable parked pages,
+            # so any request the cache-less engine could admit
+            # eventually admits here too (n_hit=0 needs exactly the
+            # cache-less page count).
+            chosen = None
+            for n_hit in range(len(hits), -1, -1):
+                start = n_hit * ps
+                # full hit: re-consume the last token (n_hit > 0 guard:
+                # an EMPTY prompt trivially satisfies start >= L with
+                # nothing mounted — it prefills like any other miss)
+                cow = n_hit > 0 and start >= L
+                if cow:
+                    start = L - 1
+                need_new = total - n_hit + (1 if cow else 0)
+                if need_new <= len(self._free) + self.cache.evictable(
+                        exclude=keys[:n_hit]):
+                    chosen = (n_hit, start, cow, need_new)
+                    break
+            if chosen is None:
+                break                    # head-of-line: wait for pages
+            n_hit, start, cow, need_new = chosen
+            hits = hits[:n_hit]
+            self._queue.pop(0)
+            self.cache.mount(keys[:n_hit])
+            if len(self._free) < need_new:
+                freed = self.cache.evict(need_new - len(self._free))
+                self.stats.prefix_evictions += len(freed)
+                self._free.extend(freed)
+            privates = [self._free.pop() for _ in range(need_new)]
+            shared = list(hits)
+            if cow:
+                dst = privates.pop()
+                self.d.copy_page(shared[-1], dst)
+                self.cache.release_page(shared[-1])
+                self.stats.prefix_cow += 1
+                shared_set = set(shared[:-1])
+                shared[-1] = dst
+            else:
+                shared_set = set(shared)
+            pages = shared + privates    # block order: prefix first
+            self._slot_req[slot] = rid
+            self._slot_pages[slot] = pages
+            self._slot_shared[slot] = shared_set
+            self._cache_meta[rid] = (start, keys, n_hit)
+            self.stats.prefix_hits += n_hit
+            self.stats.prefix_misses += len(keys) - n_hit
+            self.stats.prefix_tokens_saved += start
+            self.stats.prefix_bytes_saved += start * tok_bytes
+            admitted.append((slot, rid, ids, pages))
+        return admitted
+
+    def _extra_prefill(self, admitted):
+        pass                                 # SpeculativeEngine: draft
+
+    def _after_admit(self, slot, prompt_len):
+        pass                                 # SpeculativeEngine: _dlens
+
+    def _retire(self, slot):
+        shared = self._slot_shared[slot]
+        for pid in self._slot_pages[slot]:
+            if pid in shared:
+                # drop this request's reference only: the cache still
+                # owns the page (parked at refcount 0, reclaimed by
+                # eviction alone) — so a shared page is freed exactly
+                # once, by whoever finally unmaps it
+                self.cache.release_page(pid)
+            else:
+                self._free.append(pid)
+        self._slot_shared[slot] = set()
+        self._slot_req[slot] = None
+        self._slot_pages[slot] = []
+        self._lens[slot] = 0
+        self._tokens[slot] = 0
+        self._table_cache = None
+        self.stats.completed += 1
+
+    def page_ledger(self):
+        """Auditable snapshot of page ownership: every allocatable page
+        sits in exactly one of {free list, slot-held}, cache refcounts
+        equal the number of slots mounting each shared page, and parked
+        (refcount-0) cached pages are held by nobody. The
+        MEM-PAGE-REFCOUNT lint (`analysis.memory.audit_page_ledger`)
+        consumes this — double-frees, leaks and refcount drift all
+        surface as findings."""
+        return {
+            "num_pages": self.d.num_pages,
+            "scratch": self.d.num_pages - 1,
+            "free": list(self._free),
+            "slots": {s: list(p)
+                      for s, p in enumerate(self._slot_pages) if p},
+            "shared": {s: sorted(sh)
+                       for s, sh in enumerate(self._slot_shared) if sh},
+            "cache": self.cache.ledger() if self.cache else {},
+        }
+
+    def audit_pages(self):
+        """Run the MEM-PAGE-REFCOUNT audit over the live ledger; returns
+        the findings (empty = every page owned exactly once)."""
+        from ..analysis.memory import audit_page_ledger
+        return audit_page_ledger(self.page_ledger())
+
+    def _table(self, pages_per_slot, decoder):
+        """Page table with inactive/unused entries routed to the reserved
+        scratch page (their masked, discarded KV writes must never land
+        in allocatable pages)."""
+        t = np.full((decoder.max_batch, decoder.max_pages),
+                    decoder.num_pages - 1, np.int32)
+        for s, pg in enumerate(pages_per_slot):
+            if pg:
+                t[s, :len(pg)] = pg
+        return t
+
+    def step(self):
+        """Admit + one decode tick. Returns number of active slots."""
+        self._admit()
+        active = [s for s in range(self.d.max_batch)
+                  if self._slot_req[s] is not None]
+        if not active:
+            return 0
+        if self._table_cache is None:        # slots changed since last tick
+            self._table_cache = self._table(self._slot_pages, self.d)
+        nxt = np.asarray(self.d.decode(self._tokens, self._lens,
+                                       self._table_cache,
+                                       kids=self._kids))
+        self.steps += 1
+        self.stats.ticks += 1
+        self.stats.decode_syncs += 1
+        self.stats.occupancy.append(len(active) / self.d.max_batch)
+        for s in active:
+            rid = self._slot_req[s]
+            tok = int(nxt[s])
+            self._outputs[rid].append(tok)
+            self.stats.tokens += 1
+            self._lens[s] += 1
+            self._tokens[s] = tok
+            done = (self.eos is not None and tok == self.eos) or \
+                len(self._outputs[rid]) >= self.max_new
+            if done:
+                self._retire(s)
+        return len(active)
+
+    def run(self, step_times=None):
+        """Drain the queue; returns {request_id: generated token list}.
+        `step_times`, if given, receives wall seconds per host sync —
+        per decode tick on the per-tick path (k_max=1), per K-tick
+        horizon on the multi-step path (use `self.stats` for per-token
+        percentiles either way)."""
+        if self.k_max <= 1:
+            return self._run_per_tick(step_times)
+        return self._run_multi(step_times)
+
+    def _run_per_tick(self, step_times=None):
+        """Legacy loop: one compiled tick, one host sync per token."""
+        while self._queue or any(r is not None for r in self._slot_req):
+            t0 = time.perf_counter()
+            before = self.stats.tokens
+            before_p = self.stats.prefill_syncs
+            self.step()
+            dt = time.perf_counter() - t0
+            if step_times is not None:
+                step_times.append(dt)
+            n = self.stats.tokens - before
+            # token_time_s is the STEADY-STATE decode latency: a sync
+            # that contained a prefill is dominated by it (orders of
+            # magnitude more work than a tick) and would turn p99 into
+            # a prefill number — keep it out of the percentiles
+            if n and self.stats.prefill_syncs == before_p:
+                self.stats.token_time_s.extend([dt / n] * n)
+        return dict(self._outputs)
+
+    def _budget_left(self, slot):
+        """Tokens this slot may still emit (host view, excludes ticks
+        already dispatched but not yet processed)."""
+        return self.max_new - len(self._outputs[self._slot_req[slot]])
+
+    def _horizon(self, slots, inflight):
+        """Largest power-of-two tick count ≤ k_max that fits every
+        dispatchable slot's remaining budget (powers of two bound the
+        decode_multi compile count, like the prefill buckets)."""
+        rem = min(self._budget_left(s) - inflight[s] for s in slots)
+        k = 1
+        while k * 2 <= min(rem, self.k_max):
+            k *= 2
+        return k
+
+    def _merge_carry(self, carry, admitted):
+        """Device-resident decode state for the next horizon. The carry
+        never round-trips through the host: newly admitted slots are
+        scattered into the in-flight arrays with device ops."""
+        S = self.d.max_batch
+        if carry is None:
+            done = np.array([r is None for r in self._slot_req])
+            rem = np.array([self._budget_left(s) if self._slot_req[s]
+                            is not None else 0 for s in range(S)],
+                           np.int32)
+            return (jnp.asarray(self._tokens), jnp.asarray(self._lens),
+                    jnp.asarray(done), jnp.asarray(rem))
+        if not admitted:
+            return carry
+        tokens, lens, done, rem = carry
+        idx = jnp.asarray(admitted, jnp.int32)
+        tokens = tokens.at[idx].set(jnp.asarray(self._tokens[admitted]))
+        lens = lens.at[idx].set(jnp.asarray(self._lens[admitted]))
+        done = done.at[idx].set(False)
+        rem = rem.at[idx].set(jnp.asarray(
+            [self._budget_left(s) for s in admitted], jnp.int32))
+        return tokens, lens, done, rem
+
+    def _process_block(self, meta, inflight, step_times,
+                       prefilled_since=False):
+        """Fetch + bookkeep one finished horizon. Called AFTER the next
+        horizon is dispatched, so the device→host wait overlaps it."""
+        block_d, done_before_d, k, rids, t0, had_prefill = meta
+        block = np.asarray(block_d)
+        done_before = np.asarray(done_before_d)
+        self.stats.decode_syncs += 1
+        emitted = 0
+        for s, rid in rids.items():
+            inflight[s] = max(0, inflight[s] - k)
+            if self._slot_req[s] != rid:
+                continue
+            for j in range(k):
+                if done_before[j, s]:
+                    break
+                tok = int(block[j, s])
+                self._outputs[rid].append(tok)
+                self.stats.tokens += 1
+                emitted += 1
+                self._lens[s] += 1
+                self._tokens[s] = tok
+                if (self.eos is not None and tok == self.eos) or \
+                        len(self._outputs[rid]) >= self.max_new:
+                    self._retire(s)
+                    break
+        dt = time.perf_counter() - t0
+        if step_times is not None:
+            step_times.append(dt)
+        # steady-state decode latency only: the block's dt window spans
+        # its dispatch iteration AND the next iteration up to this
+        # call, so a prefill in either (had_prefill at dispatch,
+        # prefilled_since at processing) would make p99 a prefill
+        # number — exclude such blocks from the percentiles (see
+        # _run_per_tick)
+        if emitted and not had_prefill and not prefilled_since:
+            self.stats.token_time_s.extend([dt / emitted] * emitted)
+
+    def _run_multi(self, step_times=None):
+        """Horizon-scheduled drain: dispatch a K-tick device-resident
+        block, then process the PREVIOUS block while the new one runs.
+        Retirement is one horizon delayed — a slot that finishes inside
+        block N stays frozen on device through block N+1 (done mask
+        carried on device; its K/V writes route to the scratch page)
+        and its pages are freed exactly once, when block N's results
+        land on the host. Prefix-cache interplay inherits the same
+        discipline: a retiring slot's shared pages are DECREF'd at
+        block-processing time (parked, not reused), and eviction
+        reclaims them only at a later admission — whose prefill writes
+        are device-ordered after every in-flight horizon, so a fused
+        horizon can never read a page that was re-written under it."""
+        S = self.d.max_batch
+        pending = None               # the in-flight horizon's meta
+        carry = None                 # device (tokens, lens, done, rem)
+        inflight = [0] * S           # dispatched-not-yet-processed ticks
+        while (self._queue or pending is not None
+               or any(r is not None for r in self._slot_req)):
+            t0 = time.perf_counter()
+            before_p = self.stats.prefill_syncs
+            admitted = self._admit()
+            # a prefill ran iff the sync counter moved — NOT iff any
+            # request entered decode: a round whose every admission
+            # finishes AT prefill (EOS on the first token) returns an
+            # empty `admitted` but still paid a prefill forward, which
+            # must stay out of the steady-state token percentiles
+            # (same delta discipline as _run_per_tick)
+            prefilled = self.stats.prefill_syncs != before_p
+            for s in admitted:
+                # a freshly admitted slot starts from a clean device
+                # carry (_merge_carry), so ticks still in flight for
+                # the slot's PREVIOUS request must not gate its
+                # dispatch. Unreachable today (a fresh budget
+                # max_new-1 always exceeds the stale count, which is
+                # bounded by the retired request's remaining budget
+                # minus the processed block), but reset defensively:
+                # the rid check skips the old block's tokens and the
+                # max(0, ...) clamp absorbs the double subtraction.
+                inflight[s] = 0
+            carry = self._merge_carry(carry, admitted)
+            # invariant: for a live non-admitted slot, the device-side
+            # `remaining` equals budget_left - inflight exactly (both
+            # count init budget minus dispatched ticks), so a slot
+            # excluded here is always already frozen on device — its
+            # ticks in another slot's block are filler, never lost
+            # tokens
+            disp = [s for s in range(S) if self._slot_req[s] is not None
+                    and self._budget_left(s) - inflight[s] > 0]
+            meta = None
+            if disp:
+                k = self._horizon(disp, inflight)
+                if self._table_cache is None:
+                    self._table_cache = self._table(self._slot_pages,
+                                                    self.d)
+                tokens_d, lens_d, done_d, rem_d = carry
+                out = self.d.decode_multi(
+                    tokens_d, lens_d, self._table_cache, k,
+                    kids=self._kids, done=done_d, remaining=rem_d,
+                    eos=self.eos)
+                carry = (out.tokens, out.lens, out.done, out.remaining)
+                self.steps += k
+                self.stats.ticks += k
+                self.stats.occupancy.append(len(disp) / S)
+                for s in disp:
+                    inflight[s] += k
+                meta = (out.tokens_block, out.done_before, k,
+                        {s: self._slot_req[s] for s in disp}, t0,
+                        prefilled)
+            if pending is not None:
+                self._process_block(pending, inflight, step_times,
+                                    prefilled_since=prefilled)
+            pending = meta
+        return dict(self._outputs)
+
+
+class SpeculativeEngine(ContinuousBatchingEngine):
+    """Speculative decoding over the paged engine: a small DRAFT model
+    proposes k tokens with k cheap decode ticks; the TARGET model scores
+    all of them in ONE verify forward. Greedy configs accept the longest
+    matching prefix (+ the target's token at the first mismatch) —
+    output is EXACTLY the target's greedy decode; sampled configs (same
+    temperature/top-k/top-p on both decoders) use rejection-sampling
+    acceptance (_spec_accept), so emitted tokens are distributed exactly
+    as target-only sampling. Either way: up to k-times fewer target
+    forwards. Paged KV makes rollback free: `lens` is the source of
+    truth, rejected positions are simply overwritten.
+
+    Acceptance is capped at k-1 drafts so the draft cache (which holds
+    proposals d1..d_{k-1}) never falls behind; when all k drafts match,
+    the capped path still emits exactly d1..dk.
+    """
+
+    def __init__(self, decoder, draft_decoder, eos_token_id=None,
+                 max_new_tokens=64, k=4):
+        if decoder.sampling != draft_decoder.sampling:
+            raise ValueError(
+                "speculative decoding needs the SAME sampling config on "
+                "target and draft (acceptance compares their masked "
+                f"distributions): {decoder.sampling} vs "
+                f"{draft_decoder.sampling}")
+        if draft_decoder.max_batch != decoder.max_batch or \
+                draft_decoder.page_size != decoder.page_size:
+            raise ValueError("draft/target max_batch and page_size must match")
+        # k_max=1: the verify cadence IS this engine's horizon — each
+        # step() already moves a k-token window; the draft's ticks are
+        # device-resident via decode_multi below. (No prefix_cache:
+        # verify windows WRITE up to k positions past the accepted
+        # length, which would dirty mounted shared pages — chunked
+        # admission for the twin pools is an open item.)
+        super().__init__(decoder, eos_token_id, max_new_tokens, k_max=1)
+        self.draft = draft_decoder
+        self.k = int(k)
+        self._draft_free = list(range(draft_decoder.num_pages - 2, -1, -1))
+        self._draft_pages = [[] for _ in range(decoder.max_batch)]
+        self._dlens = np.zeros(decoder.max_batch, np.int32)
+        self.target_calls = 0
+
+    def submit(self, prompt_ids):
+        """Same as the base, with a +k margin: a verify window can write
+        up to k positions past the final accepted length."""
+        ids = np.asarray(prompt_ids._value if isinstance(prompt_ids, Tensor)
+                         else prompt_ids).reshape(-1)
+        if len(ids) == 0:
+            raise ValueError(
+                "prompt must contain at least one token (prefill "
+                "samples the first generated token after the prompt's "
+                "last position — an empty prompt has none)")
+        total = len(ids) + self.max_new + self.k
+        need = self._pages_for(total)
+        limit = min(self.d.max_pages, self.draft.max_pages,
+                    self.d.num_pages - 1, self.draft.num_pages - 1)
+        if need > limit:
+            raise ValueError(
+                f"request needs {need} pages (prompt {len(ids)} + max_new "
+                f"{self.max_new} + speculation margin {self.k}) but the "
+                f"pools allow {limit}")
+        if total > min(self.d.cfg.max_seq_len, self.draft.cfg.max_seq_len):
+            raise ValueError(
+                f"prompt {len(ids)} + max_new {self.max_new} + margin "
+                f"{self.k} exceeds max_seq_len "
+                f"{min(self.d.cfg.max_seq_len, self.draft.cfg.max_seq_len)}")
+        return self._register_request([int(t) for t in ids])
+
+    def _gather_admissions(self):
+        admitted = []
+        for slot in range(self.d.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            rid, ids = self._queue[0]
+            # +k margin: a verify window may write up to k positions past
+            # the final accepted length
+            need = self._pages_for(len(ids) + self.max_new + self.k)
+            if need > len(self._free) or need > len(self._draft_free) \
+                    or need > self.d.max_pages \
+                    or need > self.draft.max_pages:
+                break
+            self._queue.pop(0)
+            pages = [self._free.pop() for _ in range(need)]
+            dpages = [self._draft_free.pop() for _ in range(need)]
+            self._slot_req[slot] = rid
+            self._slot_pages[slot] = pages
+            self._draft_pages[slot] = dpages
+            admitted.append((slot, rid, ids, pages))
+        return admitted
+
+    def _extra_prefill(self, admitted):
+        self.draft.prefill_batch(           # draft's guesses discarded
+            [(ids, self._draft_pages[slot])
+             for slot, _, ids, _ in admitted],
+            kids=[rid for _, rid, _, _ in admitted])
+
+    def _after_admit(self, slot, prompt_len):
+        self._dlens[slot] = prompt_len
+
+    def _retire(self, slot):
+        self._draft_free.extend(self._draft_pages[slot])
+        self._draft_pages[slot] = []
+        self._dlens[slot] = 0
+        super()._retire(slot)
+
+    def step(self):
+        self._admit()
+        active = [s for s in range(self.d.max_batch)
+                  if self._slot_req[s] is not None]
+        if not active:
+            return 0
+        k = self.k
+        if self._table_cache is None:        # slots changed since last tick
+            self._table_cache = (self._table(self._slot_pages, self.d),
+                                 self._table(self._draft_pages, self.draft))
+        ttable, dtable = self._table_cache
+
+        sampled = self.d.sampling is not None
+
+        # draft proposes k tokens: K DEVICE-RESIDENT ticks in ONE
+        # compiled loop (decode_multi) — the proposal chain feeds back
+        # on device, so the k cheap ticks cost one dispatch + one fetch
+        # instead of k host round-trips
+        qrows = None
+        out = self.draft.decode_multi(self._tokens, self._dlens, dtable,
+                                      k, kids=self._kids,
+                                      return_logits=sampled)
+        proposals = np.asarray(out.tokens_block).T.astype(np.int32)
+        if sampled and k > 1:
+            # the k-th draft's distribution is never judged (acceptance
+            # is capped at k-1): skip its transfer
+            qp = self.draft._probs_of(out.logits_block[:k - 1])
+            qrows = np.moveaxis(qp, 0, 1)          # [S, k-1, V]
+        self.stats.ticks += k
+        self.stats.decode_syncs += 1
+
+        # target verifies [cur, d1..dk] in one forward
+        window = np.concatenate(
+            [self._tokens[:, None], proposals[:, :k]], axis=1)  # [S, k+1]
+        if sampled:
+            tgt, prows = self.d.verify(window, self._lens, ttable,
+                                       return_probs=True)
+        else:
+            tgt = self.d.verify(window, self._lens, ttable)     # [S, k+1]
+        self.target_calls += 1
+        self.steps += 1
+        self.stats.ticks += 1
+        self.stats.decode_syncs += 1
+        self.stats.occupancy.append(len(active) / self.d.max_batch)
+
+        for s in active:
+            rid = self._slot_req[s]
+            if sampled:
+                rng = np.random.default_rng(
+                    (self.d.seed * 1000003 + self.target_calls) * 4093 + s)
+                a, tok = _spec_accept(
+                    prows[s, :k],
+                    qrows[s] if qrows is not None else
+                    np.zeros((0, prows.shape[-1])),
+                    proposals[s, :k - 1], rng)
+                emitted = [int(t) for t in proposals[s, :a]] + [tok]
+            else:
+                a = 0
+                while a < k - 1 and proposals[s, a] == tgt[s, a]:
+                    a += 1
+                emitted = [int(t) for t in proposals[s, :a]] + \
+                    [int(tgt[s, a])]
+            L = int(self._lens[s])
+            self._lens[s] = L + a + 1
+            self._dlens[s] = L + a + 1
+            self._tokens[s] = emitted[-1]
+            done = False
+            for t in emitted:
+                self._outputs[rid].append(t)
+                self.stats.tokens += 1
+                if (self.eos is not None and t == self.eos) or \
+                        len(self._outputs[rid]) >= self.max_new:
+                    done = True      # tokens speculated past the stop
+                    break            # point are simply never appended
+            if done:
+                self._retire(s)
+        return len(active)

@@ -1,0 +1,229 @@
+"""Content-addressed prefix cache over the paged KV pool.
+
+Real serving fleets overwhelmingly share prompt prefixes (system
+prompts, few-shot templates); the Gemma-on-TPU serving comparison
+(PAPERS.md, arxiv 2605.25645) attributes a large share of its TPU
+serving win to page-level prefix reuse, and the paged KV pool
+(`serving.decoder.PagedGPTDecoder`) already gives the page-granular
+indirection the Ragged Paged Attention design assumes (arxiv
+2604.15464).  This module adds the missing piece: a host-side,
+content-addressed index over that pool so requests sharing a prefix
+skip prefill for the shared span entirely.
+
+Design (vLLM-style hash-block caching, TPU-native pool):
+
+- **Chain keys.**  A prompt is split into full `page_size`-token
+  blocks; block ``j``'s key is ``H(key_{j-1} || tokens_j)`` with the
+  root key salted by a model/sampling-invariant decoder fingerprint.
+  Position and full prefix content are therefore implicit in the key —
+  two requests map to the same page iff their ENTIRE token prefix up to
+  that block matches (and was produced by an equivalent decoder
+  config), so a mounted page's KV bytes are exactly the bytes the
+  request's own prefill would have written (prefill is deterministic
+  and per-position computations are batch-independent).
+- **Refcounts.**  ``refs`` counts live requests mounting a page.  The
+  cache itself holds pages beyond ``refs == 0``: they park in an LRU
+  and are reclaimed (evicted back to the engine's free list) only
+  under pool pressure.  A page is never freed while referenced, and
+  freed exactly once — the engine's page ledger is auditable
+  (`analysis.memory.audit_page_ledger`, rule MEM-PAGE-REFCOUNT).
+- **Copy-on-write.**  The cache never hands out writable shared pages;
+  the ENGINE copies a page before the first divergent-token write
+  lands in it (the full-hit branch of
+  `ContinuousBatchingEngine._gather_admissions_cached`, via
+  `PagedGPTDecoder.copy_page`) and releases its reference on the
+  original.  The cache only tracks the refcounts that make the "is
+  this page shared" question answerable.
+- **Eviction.**  LRU over parked (refcount-0) entries.  Keys chain, so
+  an evicted block's parked descendants are unreachable (a lookup must
+  match block 0..j-1 before j) and are evicted in the same sweep —
+  no stranded pages.
+"""
+import collections
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+@dataclass
+class _Entry:
+    key: bytes
+    page: int
+    parent: bytes = None         # chain parent key (None for block 0)
+    refs: int = 0                # live requests mounting this page
+    children: set = field(default_factory=set)
+
+
+class PrefixCache:
+    """Content-addressed, refcounted page index: chain key -> page id.
+
+    `page_size` is the token-block granularity (one KV page).  `salt`
+    folds the decoder's model/sampling-invariant fingerprint into the
+    root key so two decoders with different weights or quantization
+    never alias.  `capacity` bounds the number of cached pages
+    (None = bounded only by the pool; 0 = caching disabled — every
+    lookup misses and inserts are refused, which is the exact
+    "caching off" twin the equivalence tests compare against)."""
+
+    def __init__(self, page_size, salt=b"", capacity=None):
+        self.page_size = int(page_size)
+        self.salt = salt if isinstance(salt, bytes) else str(salt).encode()
+        self.capacity = capacity
+        self._entries = {}               # key -> _Entry
+        self._by_page = {}               # page id -> key
+        self._lru = collections.OrderedDict()   # key -> None (refs == 0)
+
+    # ------------------------------------------------------------ keys
+
+    def block_keys(self, tokens):
+        """Chain keys of every FULL `page_size`-token block of `tokens`
+        (a trailing partial block is never cacheable — its page will
+        keep growing)."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(toks) // self.page_size
+        keys, prev = [], self.salt
+        for b in range(n):
+            block = toks[b * self.page_size:(b + 1) * self.page_size]
+            h = hashlib.blake2b(digest_size=16)
+            h.update(prev)
+            h.update(block.tobytes())
+            prev = h.digest()
+            keys.append(prev)
+        return keys
+
+    # ---------------------------------------------------------- lookup
+
+    def match(self, keys):
+        """Page ids of the longest cached run of `keys` from block 0
+        (peek only — no refcount change)."""
+        pages = []
+        for k in keys:
+            e = self._entries.get(k)
+            if e is None:
+                break
+            pages.append(e.page)
+        return pages
+
+    def mount(self, keys):
+        """Incref every entry in `keys` (a request is now holding its
+        page); revives parked entries out of the LRU."""
+        for k in keys:
+            e = self._entries[k]
+            e.refs += 1
+            self._lru.pop(k, None)
+
+    # ---------------------------------------------------------- insert
+
+    def insert(self, key, page, parent=None):
+        """Register a freshly prefilled full-block page under `key`
+        with one reference (the inserting request).  Returns False —
+        and takes no ownership — when the key is already cached (a
+        same-batch duplicate computed its own copy; it keeps the page
+        private) or the capacity bound refuses new entries.
+
+        Caller contract: only insert a child under a `parent` the
+        caller currently HOLDS (mounted or inserted this admission) —
+        the engine stops publishing a chain at the first refused
+        insert.  Otherwise a still-referenced child could sit under a
+        refcount-0 parent, and the eviction cascade (which relies on
+        child-referenced => every-ancestor-referenced) would trip its
+        refcount guard."""
+        if key in self._entries:
+            return False
+        if self.capacity is not None and len(self._entries) >= self.capacity:
+            # full: insert() never evicts (freed pages belong to the
+            # ENGINE's free list; only admission-time evict() may
+            # reclaim) — the block simply stays private to its request
+            return False
+        e = _Entry(key=key, page=int(page), parent=parent, refs=1)
+        self._entries[key] = e
+        self._by_page[int(page)] = key
+        if parent is not None and parent in self._entries:
+            self._entries[parent].children.add(key)
+        return True
+
+    # --------------------------------------------------------- release
+
+    def release_page(self, page):
+        """One request stopped referencing `page` (retirement or CoW).
+        At refcount 0 the page PARKS in the LRU — still cached, still
+        owned by the cache — instead of returning to the free list;
+        only eviction frees it (exactly once)."""
+        key = self._by_page[int(page)]
+        e = self._entries[key]
+        if e.refs <= 0:
+            raise RuntimeError(
+                f"refcount underflow on page {page} (double release)")
+        e.refs -= 1
+        if e.refs == 0:
+            self._lru[key] = None       # most-recently parked = last out
+
+    def is_cached_page(self, page):
+        return int(page) in self._by_page
+
+    def refs_of_page(self, page):
+        return self._entries[self._by_page[int(page)]].refs
+
+    # -------------------------------------------------------- eviction
+
+    def evictable(self, exclude=()):
+        """How many parked pages could be reclaimed right now (the
+        admission head-of-line check adds this to the free list before
+        deciding to wait). `exclude` keys are about to be mounted —
+        their whole ancestor chain is also in the hit set, so excluding
+        the hits themselves suffices."""
+        ex = set(exclude)
+        return sum(1 for k in self._lru if k not in ex)
+
+    def evict(self, n, exclude=()):
+        """Reclaim at least `n` parked pages (LRU-first), cascading to
+        each victim's parked descendants (their chain keys are
+        unreachable once an ancestor is gone).  Returns the freed page
+        ids — the caller (engine) owns them again."""
+        ex = set(exclude)
+        freed = []
+        while len(freed) < n:
+            victim = next((k for k in self._lru if k not in ex), None)
+            if victim is None:
+                break
+            freed.extend(self._evict_subtree(victim))
+        return freed
+
+    def _evict_subtree(self, key):
+        freed = []
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            e = self._entries.pop(k, None)
+            if e is None:
+                continue
+            if e.refs:
+                raise RuntimeError(
+                    f"evicting page {e.page} with refcount {e.refs}")
+            stack.extend(e.children)
+            self._lru.pop(k, None)
+            del self._by_page[e.page]
+            if e.parent is not None and e.parent in self._entries:
+                self._entries[e.parent].children.discard(k)
+            freed.append(e.page)
+        return freed
+
+    # ------------------------------------------------------------ view
+
+    @property
+    def n_pages(self):
+        """Pages the cache currently owns or tracks (mounted + parked)."""
+        return len(self._entries)
+
+    @property
+    def n_parked(self):
+        return len(self._lru)
+
+    def ledger(self):
+        """{page id: {"refs": r, "parked": bool}} — the audit view the
+        MEM-PAGE-REFCOUNT lint consumes via the engine's page ledger."""
+        return {e.page: {"refs": e.refs, "parked": e.refs == 0}
+                for e in self._entries.values()}

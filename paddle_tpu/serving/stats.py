@@ -1,0 +1,124 @@
+"""Serving telemetry: per-engine `ServeStats` and the process-wide
+engine registry behind `debug.serving_stats()`.
+
+Counters are lifetime totals; every latency/occupancy distribution is a
+bounded sliding window (deque maxlen) so a long-lived engine's
+telemetry stays O(1) memory and O(window) to summarize.
+"""
+import collections
+import weakref
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["ServeStats", "serving_stats"]
+
+
+# every live engine, for debug.serving_stats() (mirrors the prefetcher
+# registry in io/prefetch.py: observability without plumbing handles)
+_ENGINES = weakref.WeakSet()
+
+
+# sample window of the per-token / queue-wait / occupancy percentiles:
+# counters run forever, distributions cover the most recent samples so
+# a long-lived engine's telemetry stays O(1) memory and O(window) to
+# summarize
+_STATS_WINDOW = 4096
+
+
+def _window():
+    return collections.deque(maxlen=_STATS_WINDOW)
+
+
+@dataclass
+class ServeStats:
+    """Serving telemetry of one engine: how often the host interposes
+    on the decode loop and what the client observes. `decode_syncs` is
+    the number under optimization — the per-tick engine pays one host
+    sync per generated token; the multi-step engine one per K.
+    Counters are lifetime totals; the latency/occupancy distributions
+    are bounded sliding windows (last `_STATS_WINDOW` samples).
+
+    The `prefix_*` counters are the prefix-cache ledger (block = one KV
+    page of tokens): `prefix_hits`/`prefix_misses` count block lookups
+    at admission, `prefix_tokens_saved` the prompt positions whose
+    prefill was skipped entirely (pages mounted host-side),
+    `prefix_bytes_saved` the KV bytes those positions would have
+    written, `prefix_cow` copy-on-write page copies (a request about to
+    write into a page it mounted shared), `prefix_evictions` refcount-0
+    pages reclaimed from the cache under pool pressure."""
+    engine: str = ""
+    k_max: int = 1
+    requests: int = 0            # submitted
+    completed: int = 0           # retired with output
+    tokens: int = 0              # generated tokens (prefill's included)
+    ticks: int = 0               # device decode ticks dispatched
+    decode_syncs: int = 0        # host fetches of decode results
+    prefill_syncs: int = 0       # host-blocking prefill rounds
+    prefix_hits: int = 0         # cached full blocks mounted at admission
+    prefix_misses: int = 0       # cacheable blocks that had to prefill
+    prefix_evictions: int = 0    # refcount-0 pages evicted under pressure
+    prefix_cow: int = 0          # copy-on-write page copies
+    prefix_tokens_saved: int = 0  # prompt positions whose prefill was skipped
+    prefix_bytes_saved: int = 0  # KV bytes not recomputed (mounted pages)
+    queue_wait_s: collections.deque = field(      # submit -> admit
+        default_factory=_window)
+    occupancy: collections.deque = field(         # active/slots per block
+        default_factory=_window)
+    ttft_s: collections.deque = field(            # submit -> first token
+        default_factory=_window)
+    token_time_s: collections.deque = field(
+        # wall per token, steady-state decode syncs only (syncs that
+        # contained a prefill are excluded, or p99 becomes a prefill
+        # number)
+        default_factory=_window)
+
+    @property
+    def host_syncs_per_token(self):
+        return self.decode_syncs / self.tokens if self.tokens else 0.0
+
+    @property
+    def prefix_hit_rate(self):
+        """Fraction of cacheable prompt blocks served from the cache."""
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
+
+    def summary(self):
+        d = {"engine": self.engine, "k_max": self.k_max,
+             "requests": self.requests, "completed": self.completed,
+             "tokens": self.tokens, "ticks": self.ticks,
+             "decode_syncs": self.decode_syncs,
+             "prefill_syncs": self.prefill_syncs,
+             "host_syncs_per_token": round(self.host_syncs_per_token, 4)}
+        if self.prefix_hits or self.prefix_misses:
+            d["prefix_hit_rate"] = round(self.prefix_hit_rate, 4)
+            d["prefix_hits"] = self.prefix_hits
+            d["prefix_misses"] = self.prefix_misses
+            d["prefix_evictions"] = self.prefix_evictions
+            d["prefix_cow"] = self.prefix_cow
+            d["prefix_tokens_saved"] = self.prefix_tokens_saved
+            d["prefix_bytes_saved"] = self.prefix_bytes_saved
+        if self.occupancy:
+            d["mean_slot_occupancy"] = round(
+                float(np.mean(self.occupancy)), 4)
+        if self.queue_wait_s:
+            d["queue_wait_p50_ms"] = round(
+                float(np.percentile(self.queue_wait_s, 50)) * 1e3, 3)
+        if self.ttft_s:
+            d["ttft_p50_ms"] = round(
+                float(np.percentile(self.ttft_s, 50)) * 1e3, 3)
+        if self.token_time_s:
+            tot = float(np.sum(self.token_time_s))
+            d["tokens_per_sec"] = round(len(self.token_time_s) / tot, 1) \
+                if tot else 0.0
+            d["token_p50_ms"] = round(
+                float(np.percentile(self.token_time_s, 50)) * 1e3, 3)
+            d["token_p99_ms"] = round(
+                float(np.percentile(self.token_time_s, 99)) * 1e3, 3)
+        return d
+
+
+def serving_stats():
+    """ServeStats summaries of every live engine (debug.serving_stats
+    front door)."""
+    return [e.stats.summary() for e in list(_ENGINES)]

@@ -658,3 +658,70 @@ def test_training_rule_catches_undonated_carry():
     assert hits and hits[0].severity == Severity.ERROR
     assert any("not donated" in h.message for h in hits)
     assert not report.metrics["training"]["carry_donated"]
+
+
+# ----------------------------------------------------- page refcounts
+
+
+def _consistent_ledger():
+    """8-page pool, scratch=7: pages 0-1 free, slot 0 holds [2, 3]
+    with 2 cache-shared (refs 1), slot 1 holds [4, 5], page 6 parked
+    (refcount 0) in the cache."""
+    return {"num_pages": 8, "scratch": 7, "free": [0, 1],
+            "slots": {0: [2, 3], 1: [4, 5]},
+            "shared": {0: [2]},
+            "cache": {2: {"refs": 1, "parked": False},
+                      6: {"refs": 0, "parked": True}}}
+
+
+def test_page_refcount_rule_clean_on_consistent_ledger():
+    """MEM-PAGE-REFCOUNT stays silent when every allocatable page is
+    owned exactly once (free XOR slot-held XOR parked), and is scoped:
+    without extra["page_ledger"] the analyzer never fires."""
+    pm = PassManager(["page-refcount"])
+    prog = LoweredProgram("", name="ledger")
+    ctx = AnalysisContext(name="ledger",
+                          extra={"page_ledger": _consistent_ledger()})
+    report = pm.run(prog, ctx)
+    assert report.by_rule("MEM-PAGE-REFCOUNT") == [], str(report)
+    m = report.metrics["page-refcount"]
+    assert m["checked"] and m["n_pages"] == 8
+    assert m["n_cached"] == 2 and m["n_parked"] == 1
+    assert m["refcount_total"] == 1
+    # scope: no ledger -> not this analyzer's business
+    report2 = pm.run(prog, AnalysisContext(name="ledger"))
+    assert report2.metrics["page-refcount"] == {"checked": False}
+
+
+@pytest.mark.parametrize("mutate, expect", [
+    # double free: a page returned to the pool twice
+    (lambda lg: lg["free"].append(0), "twice in the free list"),
+    # double free: freed while a slot still holds it
+    (lambda lg: lg["free"].append(3), "both free and held"),
+    # double free: evicted page returned to free without unmapping
+    (lambda lg: lg["free"].append(6), "both free and cache-tracked"),
+    # leak: a held page vanishes from every ledger column
+    (lambda lg: lg["slots"][1].remove(5), "leak"),
+    # refcount drift: cache thinks two holders, only one slot mounts it
+    (lambda lg: lg["cache"][2].update(refs=2), "refcount drift"),
+    # aliasing: two slots hold one page with no covering refcount
+    (lambda lg: lg["slots"][1].append(3), "unaccounted aliasing"),
+    # shared-marked page the cache never tracked
+    (lambda lg: lg["shared"][0].append(3), "does not track"),
+    # reference dropped without decref: slot still maps a parked page
+    (lambda lg: lg["slots"][1].append(6), "reference dropped"),
+])
+def test_page_refcount_rule_catches_planted_defects(mutate, expect):
+    """Each corruption of the shared-pool ledger — double free, leak,
+    refcount drift, unaccounted aliasing — is an ERROR (the
+    prove-the-auditor half of the refcounted prefix cache)."""
+    lg = _consistent_ledger()
+    mutate(lg)
+    pm = PassManager(["page-refcount"])
+    report = pm.run(LoweredProgram("", name="ledger"),
+                    AnalysisContext(name="ledger",
+                                    extra={"page_ledger": lg}))
+    hits = report.by_rule("MEM-PAGE-REFCOUNT")
+    assert hits and all(h.severity == Severity.ERROR for h in hits)
+    assert any(expect in h.message for h in hits), \
+        (expect, [h.message for h in hits])

@@ -110,3 +110,24 @@ def test_gpt_decode_program_is_device_resident(pass_manager):
     assert m["n_host_transfers"] == 0
     assert m["n_device_loops"] >= 1
     assert m["n_cache_args"] == 2          # k_pages + v_pages
+
+
+def test_gpt_decode_prefix_program_is_audited_and_device_resident(
+        pass_manager):
+    """The committed gpt_decode_prefix capture (chunked prefix-cache
+    prefill) has zero host transfers, a donated KV pool, and its
+    committed page LEDGER — snapshotted from a real shared-prefix
+    workload with a full-hit CoW — audits clean under
+    MEM-PAGE-REFCOUNT (every shared page owned exactly once)."""
+    program, ctx, _ = lowered_program("gpt_decode_prefix")
+    report = pass_manager.run(program, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.by_rule("MEM-PAGE-REFCOUNT") == []
+    m = report.metrics["serving"]
+    assert m["checked"] and m["cache_donated"]
+    assert m["n_host_transfers"] == 0
+    pr = report.metrics["page-refcount"]
+    assert pr["checked"] and pr["n_cached"] >= 1
+    assert pr["refcount_total"] == 0          # drained workload: parked
+    # the ledger really came from a workload that exercised sharing
+    assert ctx.extra["page_ledger"]["cache"]

@@ -310,3 +310,67 @@ def test_debug_memory_report_front_doors(capsys):
     assert "per-device peak" in out
     assert est.peak_bytes > 0
     assert est.top and est.top[0].device_bytes > 0
+
+
+def test_propagation_respects_contracted_dot_dims():
+    """Sharding propagation fidelity (first slice): a dot_general whose
+    operands are sharded ONLY on the contracted dim must not hand that
+    shard count to its output (GSPMD all-reduces the partials; the
+    result is replicated over that mesh axis). Sharding on a free/batch
+    dim still propagates, elementwise chains keep dim knowledge alive,
+    and without per-dim info the legacy max-operand heuristic holds."""
+    from paddle_tpu.analysis.memory import propagate_shard_counts
+
+    def f(x, w):
+        y = x @ w                 # contract dim 1 of x with dim 0 of w
+        return (y + 1.0) @ w.T    # elementwise, then contract again
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 16)), jnp.zeros((16, 32))).jaxpr
+    dot_out = jx.eqns[0].outvars[0]
+    final = jx.outvars[0]
+
+    # legacy (no dim info): the old blind max — unchanged behavior
+    legacy = propagate_shard_counts(jx, arg_counts=[4, 4])
+    assert legacy[dot_out] == 4
+
+    # contracted-dim sharding (Megatron row-parallel): output replicated
+    tp = propagate_shard_counts(jx, arg_counts=[4, 4],
+                                arg_dims=[(1, 4), (4, 1)])
+    assert tp[dot_out] == 1
+
+    # batch/free-dim sharding (dp): output inherits it — through the
+    # elementwise add AND the second matmul (dim 0 stays free)
+    dp = propagate_shard_counts(jx, arg_counts=[4, 1],
+                                arg_dims=[(4, 1), (1, 1)])
+    assert dp[dot_out] == 4 and dp[final] == 4
+
+    # no axis identity in per-dim counts: lhs and rhs free dims sharded
+    # 4-way could be the SAME mesh axis, so the 16-way cross product is
+    # capped at the most-sharded operand (overestimates memory — the
+    # safe direction) instead of claiming shards no mesh has
+    capped = propagate_shard_counts(jx, arg_counts=[4, 4],
+                                    arg_dims=[(4, 1), (1, 4)])
+    assert capped[dot_out] == 4
+
+    # the liveness walk prices with the same rules: a contracted-dim-
+    # sharded dot no longer undercounts its output per device
+    def g(x, w):
+        return x @ w
+
+    traced = jax.jit(g).trace(jnp.zeros((64, 64), jnp.float32),
+                              jnp.zeros((64, 64), jnp.float32))
+    infos_tp = [
+        ArgInfo(name="x", role="batch", shape=(64, 64), dtype="float32",
+                bytes=64 * 64 * 4, shard_count=4, dim_shards=(1, 4)),
+        ArgInfo(name="w", role="param", shape=(64, 64), dtype="float32",
+                bytes=64 * 64 * 4, shard_count=4, dim_shards=(4, 1))]
+    infos_blind = [
+        ArgInfo(name="x", role="batch", shape=(64, 64), dtype="float32",
+                bytes=64 * 64 * 4, shard_count=4),
+        ArgInfo(name="w", role="param", shape=(64, 64), dtype="float32",
+                bytes=64 * 64 * 4, shard_count=4)]
+    est_tp = estimate_jaxpr_memory(traced.jaxpr, arg_infos=infos_tp)
+    est_blind = estimate_jaxpr_memory(traced.jaxpr,
+                                      arg_infos=infos_blind)
+    # blind: output priced at 1/4 (inherited); dim-aware: full size
+    assert est_tp.peak_bytes >= est_blind.peak_bytes + 3 * (64 * 64)

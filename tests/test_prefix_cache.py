@@ -1,0 +1,419 @@
+"""Prefix-cache subsystem over the paged KV pool: content-addressed
+page sharing (hash chain -> page), refcounts, copy-on-write, LRU
+eviction, and the byte-identical cache-on/off engine equivalence."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPT, generation, gpt_tiny
+from paddle_tpu.serving import (ContinuousBatchingEngine, PagedGPTDecoder,
+                                PrefixCache)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    from paddle_tpu.distributed import build_mesh
+    build_mesh(dp=1)
+    cfg = gpt_tiny(max_seq_len=128, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _golden_greedy(model, ids, n_new):
+    out = generation.generate(model, np.asarray([ids], np.int32),
+                              max_new_tokens=n_new, temperature=0.0)
+    return [int(t) for t in np.asarray(out._value)[0, len(ids):]]
+
+
+def _engine(model, capacity=None, num_pages=32, max_new=6, k_max=1,
+            dec_kw=None, **eng_kw):
+    dec = PagedGPTDecoder(model, num_pages=num_pages, page_size=16,
+                          max_batch=2, **(dec_kw or {}))
+    cache = PrefixCache(16, salt=dec.cache_fingerprint(),
+                        capacity=capacity)
+    eng = ContinuousBatchingEngine(dec, max_new_tokens=max_new,
+                                   k_max=k_max, prefix_cache=cache,
+                                   **eng_kw)
+    return dec, eng
+
+
+def _pages_balanced(eng):
+    """Every allocatable page is free or parked in the cache after a
+    drain, and the ownership ledger audits clean."""
+    assert eng.audit_pages() == [], \
+        "\n".join(str(f) for f in eng.audit_pages())
+    return len(eng._free) + eng.cache.n_parked == eng.d.num_pages - 1
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_block_keys_chain_position_and_salt():
+    c = PrefixCache(4, salt=b"m1")
+    a = c.block_keys([1, 2, 3, 4, 5, 6, 7, 8, 9])   # 2 full blocks
+    assert len(a) == 2
+    # same block content at a different chain position -> different key
+    b = c.block_keys([5, 6, 7, 8, 5, 6, 7, 8])
+    assert a[1] != b[1] and b[0] != b[1]
+    # chain prefix property: shared first block, divergent second
+    d = c.block_keys([1, 2, 3, 4, 9, 9, 9, 9])
+    assert d[0] == a[0] and d[1] != a[1]
+    # a different decoder fingerprint never aliases
+    assert PrefixCache(4, salt=b"m2").block_keys([1, 2, 3, 4])[0] != a[0]
+    # partial trailing block is not cacheable
+    assert len(c.block_keys([1, 2, 3])) == 0
+
+
+def test_refcount_park_evict_and_cascade():
+    c = PrefixCache(4, salt=b"s")
+    k = c.block_keys(list(range(12)))                # 3 chained blocks
+    assert c.match(k) == []
+    c.insert(k[0], 10)
+    c.insert(k[1], 11, parent=k[0])
+    c.insert(k[2], 12, parent=k[1])
+    assert c.match(k) == [10, 11, 12]
+    assert c.n_parked == 0 and c.refs_of_page(10) == 1
+    # a second request mounts all three
+    c.mount(k)
+    assert c.refs_of_page(11) == 2
+    # releases park at refcount 0 (NOT freed)
+    for p in (10, 11, 12):
+        c.release_page(p)
+        c.release_page(p)
+    assert c.n_parked == 3 and c.evictable() == 3
+    # double release underflows loudly
+    with pytest.raises(RuntimeError, match="double release"):
+        c.release_page(10)
+    # evicting the chain head cascades to its (unreachable) descendants
+    freed = c.evict(1)
+    assert sorted(freed) == [10, 11, 12]
+    assert c.n_pages == 0 and c.match(k) == []
+
+
+def test_capacity_zero_disables_caching():
+    c = PrefixCache(4, salt=b"s", capacity=0)
+    k = c.block_keys(list(range(8)))
+    assert c.insert(k[0], 3) is False
+    assert c.match(k) == [] and c.evictable() == 0
+
+
+def test_duplicate_insert_refused():
+    c = PrefixCache(4, salt=b"s")
+    k = c.block_keys([1, 2, 3, 4])[0]
+    assert c.insert(k, 5) is True
+    # a same-batch duplicate computed its own copy: the cache keeps the
+    # first page, the second stays private to its request
+    assert c.insert(k, 6) is False
+    assert c.match([k]) == [5]
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_cached_admission_skips_prefill_and_matches_golden(tiny_model):
+    """Requests sharing a block-aligned prefix: the later request mounts
+    the cached pages host-side and prefills only its suffix — output
+    still byte-equal to its isolated golden greedy decode."""
+    base = list(range(1, 33))              # two full shareable blocks
+    p1, p2 = base + [44, 45, 46], base + [61, 62]
+    dec, eng = _engine(tiny_model)
+    r1 = eng.submit(np.asarray(p1, np.int32))
+    o1 = eng.run()[r1]
+    draws_before = dec._draws
+    r2 = eng.submit(np.asarray(p2, np.int32))
+    o2 = eng.run()[r2]
+    assert o1 == _golden_greedy(tiny_model, p1, 6)
+    assert o2 == _golden_greedy(tiny_model, p2, 6)
+    s = eng.stats
+    assert s.prefix_hits == 2 and s.prefix_tokens_saved == 32
+    assert s.prefix_hit_rate > 0
+    assert s.prefix_bytes_saved == 32 * dec.kv_page_bytes // 16
+    # the second request's prefill really was suffix-only: one chunked
+    # dispatch, no full-length bucket
+    assert dec._draws - draws_before <= 1 + eng.stats.ticks
+    assert _pages_balanced(eng)
+
+
+def test_chunked_prefill_start0_matches_flash_engine(tiny_model):
+    """The chunked (page-table) prefill body at start=0 produces the
+    same greedy streams as the classic flash-prefill engine — the
+    cross-implementation agreement the cache relies on when a miss
+    computes a block another request later mounts."""
+    prompts = [[3, 141, 59, 26, 535], [897, 11, 4, 18, 200, 7, 9], [31]]
+    dec_a = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                            max_batch=2)
+    flash = ContinuousBatchingEngine(dec_a, max_new_tokens=6)
+    _, chunked = _engine(tiny_model, capacity=0)
+    outs = {}
+    for label, eng in (("flash", flash), ("chunked", chunked)):
+        rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+        res = eng.run()
+        outs[label] = [res[r] for r in rids]
+    assert outs["flash"] == outs["chunked"]
+    for p, o in zip(prompts, outs["chunked"]):
+        assert o == _golden_greedy(tiny_model, p, 6), p
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cache_on_off_byte_identical_under_churn(tiny_model, seed):
+    """THE acceptance bar: with caching enabled, token streams are
+    byte-identical to the cache-off engine under randomized admission
+    churn (more requests than slots, shared Zipf-ish prefixes, EOS
+    retirement, sampled config, multi-step horizons), and both pools
+    reclaim every page."""
+    rng = np.random.RandomState(200 + seed)
+    V = tiny_model.cfg.vocab_size
+    templates = [list(rng.randint(0, V, 32).astype(int))
+                 for _ in range(2)]
+    # guaranteed sharers across the two waves (a same-batch pair both
+    # MISS — insertion happens after the batched prefill — so the
+    # second sharer must arrive later to exercise hits on every seed),
+    # plus random mixes of template cuts and private suffixes
+    prompts = [templates[0] + [1, 2]]
+    for _ in range(3):
+        t = templates[int(rng.randint(0, 2))]
+        cut = int(rng.choice([0, 16, 32]))      # share 0, 1 or 2 blocks
+        suffix = list(rng.randint(0, V, rng.randint(1, 8)).astype(int))
+        prompts.append(t[:cut] + suffix)
+    prompts.append(templates[0] + [3])          # lands in wave 2
+    eos = int(rng.randint(0, V))
+    max_new = int(rng.randint(3, 12))
+    dec_kw = dict(temperature=0.8, top_k=40, seed=11)
+    outs = {}
+    for label, capacity in (("on", None), ("off", 0)):
+        _, eng = _engine(tiny_model, capacity=capacity, num_pages=48,
+                         max_new=max_new, k_max=4, dec_kw=dec_kw,
+                         eos_token_id=eos)
+        # two waves: the second wave's prompts can hit pages the first
+        # wave inserted (cross-run reuse, the serving steady state)
+        rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts[:3]]
+        eng.run()
+        rids += [eng.submit(np.asarray(p, np.int32)) for p in prompts[3:]]
+        res = eng.run()
+        outs[label] = [res[r] for r in rids]
+        assert _pages_balanced(eng)
+        if capacity is None:
+            assert eng.stats.prefix_hits > 0, "workload never hit"
+    assert outs["on"] == outs["off"], (seed, eos, max_new)
+
+
+def test_full_prompt_hit_triggers_cow(tiny_model):
+    """A prompt whose EVERY block is cached still needs its last
+    position's logits: the engine re-consumes one token, and because
+    that write lands in a mounted shared page it copy-on-writes the
+    page first. Output unchanged, original page stays cached, the copy
+    is private (freed to the pool at retirement)."""
+    prompt = list(range(1, 33))            # exactly two pages
+    dec, eng = _engine(tiny_model)
+    r1 = eng.submit(np.asarray(prompt, np.int32))
+    o1 = eng.run()[r1]
+    assert eng.stats.prefix_cow == 0
+    r2 = eng.submit(np.asarray(prompt, np.int32))
+    o2 = eng.run()[r2]
+    golden = _golden_greedy(tiny_model, prompt, 6)
+    assert o1 == golden and o2 == golden
+    s = eng.stats
+    assert s.prefix_cow == 1
+    assert s.prefix_tokens_saved == 31     # L-1: one token re-consumed
+    # both blocks still cached (parked), CoW copy back in the pool
+    assert eng.cache.n_pages == 2
+    assert _pages_balanced(eng)
+
+
+def test_eviction_under_pool_pressure(tiny_model):
+    """A pool too small to keep old prefixes cached: admission evicts
+    parked refcount-0 pages (never referenced ones), correctness
+    holds, and the audit stays clean throughout."""
+    rng = np.random.RandomState(5)
+    V = tiny_model.cfg.vocab_size
+    # pool: 10 allocatable pages; each request needs 3 (33+6 tokens)
+    # and parks 2 cached blocks forever -> request 5 must evict
+    dec, eng = _engine(tiny_model, num_pages=11, max_new=6)
+    goldens = []
+    for i in range(5):
+        p = list(rng.randint(0, V, 33).astype(int))   # 2 cacheable blocks
+        rid = eng.submit(np.asarray(p, np.int32))
+        out = eng.run()[rid]
+        goldens.append((p, out))
+        assert eng.audit_pages() == []
+    assert eng.stats.prefix_evictions > 0
+    for p, out in goldens:
+        assert out == _golden_greedy(tiny_model, p, 6)
+    assert _pages_balanced(eng)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_refcount_fuzz_every_page_freed_exactly_once(tiny_model, seed):
+    """Randomized mixed workload (shared/unshared, full hits, waves,
+    eviction pressure): after every drain the ledger audits clean and
+    at the end free+parked covers the whole allocatable pool — every
+    shared page freed exactly once, none leaked."""
+    rng = np.random.RandomState(300 + seed)
+    V = tiny_model.cfg.vocab_size
+    base = list(rng.randint(0, V, 32).astype(int))
+    dec, eng = _engine(tiny_model, num_pages=20,
+                       max_new=int(rng.randint(2, 6)))
+    for wave in range(4):
+        n = int(rng.randint(1, 4))
+        for _ in range(n):
+            kind = rng.randint(0, 3)
+            if kind == 0:                      # exact full-hit candidate
+                p = base
+            elif kind == 1:                    # shared prefix + suffix
+                p = base[:16] + list(
+                    rng.randint(0, V, rng.randint(1, 10)).astype(int))
+            else:                              # unrelated
+                p = list(rng.randint(0, V,
+                                     rng.randint(1, 34)).astype(int))
+            eng.submit(np.asarray(p, np.int32))
+        eng.run()
+        assert eng.audit_pages() == [], wave
+    assert _pages_balanced(eng)
+    total_refs = sum(e.refs for e in eng.cache._entries.values())
+    assert total_refs == 0
+
+
+def test_multi_step_horizon_with_cache_matches_per_tick(tiny_model):
+    """Prefix cache x fused K-tick horizons: identical streams and
+    clean ledgers at k_max=1 and k_max=8 (one-horizon-delayed
+    retirement decrefs shared pages exactly once)."""
+    base = list(range(40, 72))
+    prompts = [base + [7, 8], base + [9], base[:16] + [4, 5, 6], base]
+    outs = {}
+    for k in (1, 8):
+        _, eng = _engine(tiny_model, num_pages=48, max_new=18, k_max=k)
+        rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+        res = eng.run()
+        outs[k] = [res[r] for r in rids]
+        assert _pages_balanced(eng)
+        assert eng.stats.prefix_hits > 0
+    assert outs[1] == outs[8]
+
+
+def test_serve_stats_prefix_counters_and_ttft(tiny_model):
+    """summary() surfaces the prefix ledger + TTFT once caching is on
+    (and omits the prefix block when it never engaged)."""
+    from paddle_tpu import debug
+    _, eng = _engine(tiny_model, k_max=2)
+    base = list(range(1, 33))
+    eng.submit(np.asarray(base + [5, 6], np.int32))
+    eng.run()
+    eng.submit(np.asarray(base + [9], np.int32))
+    eng.run()
+    s = eng.stats.summary()
+    assert s["prefix_hits"] == 2 and s["prefix_misses"] == 2
+    assert s["prefix_hit_rate"] == 0.5
+    assert s["prefix_tokens_saved"] == 32
+    assert s["prefix_bytes_saved"] > 0
+    assert s["ttft_p50_ms"] > 0
+    assert [d["prefix_hit_rate"] for d in debug.serving_stats()
+            if d.get("prefix_hits")], "front door missing prefix stats"
+    # no cache -> no prefix block in the summary
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    plain = ContinuousBatchingEngine(dec, max_new_tokens=3)
+    plain.submit(np.asarray([3, 141, 59], np.int32))
+    plain.run()
+    assert "prefix_hit_rate" not in plain.stats.summary()
+    assert plain.stats.summary()["ttft_p50_ms"] > 0
+
+
+def test_serve_stats_sliding_window_wraparound():
+    """The latency/occupancy distributions are bounded deques: past
+    maxlen they keep ONLY the most recent window (the summary's p50/p99
+    cover recent traffic, not the process lifetime), while counters
+    keep counting."""
+    from paddle_tpu.serving import _STATS_WINDOW, ServeStats
+    s = ServeStats(engine="t")
+    for i in range(_STATS_WINDOW + 500):
+        s.token_time_s.append(1.0 if i < 500 else 1e-3)
+        s.tokens += 1
+        s.decode_syncs += 1
+    assert len(s.token_time_s) == _STATS_WINDOW
+    d = s.summary()
+    # the early 1.0 s outliers wrapped out of the window entirely
+    assert d["token_p99_ms"] == pytest.approx(1.0, abs=1e-6)
+    assert d["token_p50_ms"] == pytest.approx(1.0, abs=1e-6)
+    assert s.tokens == _STATS_WINDOW + 500        # lifetime counter
+    assert d["host_syncs_per_token"] == 1.0
+    # queue-wait / occupancy / ttft windows share the bound
+    for dq in (s.queue_wait_s, s.occupancy, s.ttft_s):
+        dq.extend(range(_STATS_WINDOW + 10))
+        assert len(dq) == _STATS_WINDOW and dq[0] == 10
+
+
+def test_same_batch_duplicate_stops_chain_publishing(tiny_model):
+    """Review regression: two prompts sharing block X admitted in ONE
+    batch both miss; the slot that loses the X insert race must NOT
+    publish its deeper block Y under a parent it doesn't hold —
+    otherwise X can park (refs 0) while Y is still referenced and the
+    eviction cascade trips its refcount guard mid-serve."""
+    X = list(range(1, 17))
+    Y = list(range(17, 33))
+    p1 = X + [40]                        # one cacheable block
+    p2 = X + Y + [41]                    # two: Y chains under X
+    dec, eng = _engine(tiny_model, num_pages=16, max_new=3)
+    for p in (p1, p2):
+        eng.submit(np.asarray(p, np.int32))
+    eng.run()                            # same admission batch
+    keys = eng.cache.block_keys(p2)
+    # X cached by the race winner; Y NOT published by the loser
+    assert len(eng.cache.match(keys)) == 1
+    assert eng.cache.n_pages == 1
+    # pressure that evicts X must not raise (no referenced orphans)
+    rng = np.random.RandomState(3)
+    for _ in range(4):
+        rid = eng.submit(np.asarray(
+            rng.randint(0, tiny_model.cfg.vocab_size, 33).astype(int),
+            np.int32))
+        eng.run()
+        assert eng.audit_pages() == []
+    assert _pages_balanced(eng)
+
+
+def test_full_hit_on_tight_pool_degrades_instead_of_deadlocking(
+        tiny_model):
+    """Review regression: a full-prompt hit on a pool with no spare
+    page for the CoW copy must degrade its mounted span (its own
+    parked hit pages become evictable) rather than busy-looping the
+    head-of-line check forever."""
+    prompt = list(range(1, 33))          # exactly two pages
+    # 3 allocatable pages: pages_for(32+4)=3 passes submit(), but a
+    # full hit would need total - n_hit + 1 = 2 with only 1 free page
+    # and both parked pages excluded as hits
+    dec, eng = _engine(tiny_model, num_pages=4, max_new=4)
+    golden = _golden_greedy(tiny_model, prompt, 4)
+    r1 = eng.submit(np.asarray(prompt, np.int32))
+    assert eng.run()[r1] == golden
+    r2 = eng.submit(np.asarray(prompt, np.int32))
+    assert eng.run()[r2] == golden       # pre-fix: infinite loop here
+    # the degraded admission still used what it could afford
+    assert eng.stats.prefix_hits >= 1
+    assert _pages_balanced(eng)
+
+
+def test_empty_prompt_rejected_at_submit(tiny_model):
+    """Review regression: an empty prompt used to crash the cached
+    engine's admission (the degenerate start >= L == 0 case entered
+    the CoW branch with nothing mounted) and produced pool-state-
+    dependent garbage on the cache-less one (there is no last prompt
+    position to sample after). submit() now rejects it up front on
+    every engine — validation-before-accounting, so stats don't
+    move."""
+    from paddle_tpu.serving import SpeculativeEngine
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    plain = ContinuousBatchingEngine(dec, max_new_tokens=4)
+    _, cached = _engine(tiny_model, max_new=4)
+    draft = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                            max_batch=2)
+    spec = SpeculativeEngine(
+        PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                        max_batch=2), draft, max_new_tokens=4)
+    for eng in (plain, cached, spec):
+        with pytest.raises(ValueError, match="at least one token"):
+            eng.submit(np.asarray([], np.int32))
+        assert eng.stats.requests == 0
+    assert _pages_balanced(cached)

@@ -239,3 +239,43 @@ class TestTrainHorizon:
         rt = roofline_step_time(6 * 1.3e9 * 6 * 1024, 1.3e9 * 12,
                                 chip=chip)
         assert train_horizon(rt.step_s, host_sync_s=4e-4) == 1
+
+
+class TestPrefillTTFT:
+    """prefill_ttft_s: the TTFT pricing that discounts cached-prefix
+    prefill (the cost_model half of the prefix cache)."""
+
+    def test_monotone_decreasing_in_hit_rate(self):
+        from paddle_tpu.cost_model import prefill_ttft_s
+        chip = CHIP_SPECS["v5e"]
+        vals = [prefill_ttft_s(512, 2e9, cached_frac=f, chip=chip,
+                               host_sync_s=1e-4)
+                for f in (0.0, 0.25, 0.5, 0.75, 1.0)]
+        assert all(a > b for a, b in zip(vals, vals[1:]))
+
+    def test_full_hit_collapses_to_the_sync_floor(self):
+        from paddle_tpu.cost_model import prefill_ttft_s
+        chip = CHIP_SPECS["v5e"]
+        full = prefill_ttft_s(512, 2e9, cached_frac=1.0, chip=chip,
+                              host_sync_s=1e-4)
+        assert full == pytest.approx(1e-4)
+        # and the discount is linear in the uncached span
+        half = prefill_ttft_s(512, 2e9, cached_frac=0.5, chip=chip,
+                              host_sync_s=1e-4)
+        none = prefill_ttft_s(512, 2e9, cached_frac=0.0, chip=chip,
+                              host_sync_s=1e-4)
+        assert (none - full) == pytest.approx(2 * (half - full))
+
+    def test_fraction_clamps_and_default_sync(self):
+        from paddle_tpu.cost_model import (measured_host_sync_s,
+                                           prefill_ttft_s)
+        chip = CHIP_SPECS["v5e"]
+        assert prefill_ttft_s(512, 2e9, cached_frac=7.0, chip=chip,
+                              host_sync_s=1e-4) == pytest.approx(1e-4)
+        lo = prefill_ttft_s(512, 2e9, cached_frac=-3.0, chip=chip,
+                            host_sync_s=1e-4)
+        assert lo == pytest.approx(
+            prefill_ttft_s(512, 2e9, chip=chip, host_sync_s=1e-4))
+        # host_sync_s=None uses the process-cached measurement
+        got = prefill_ttft_s(16, 1e6, cached_frac=1.0, chip=chip)
+        assert got == pytest.approx(measured_host_sync_s())

@@ -1,11 +1,18 @@
 """Transformer MT seq2seq — reference PaddleNLP transformer recipe
 (models/transformer.py): overfit a copy task, greedy decode runs."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models import CrossEntropyCriterion, TransformerModel
 
 
+# `slow`: ~10 s standalone but 200+ s at position ~995 of the full
+# sweep (the documented late-suite eager-dispatch/GC cliff — ROADMAP
+# "tier-1 wall-clock health"). The eager 8-step training loop over
+# millions of live objects is the single worst budget-eater; the
+# config/decode coverage below stays in tier-1. Run with -m slow.
+@pytest.mark.slow
 def test_transformer_seq2seq_overfits_copy():
     paddle.seed(0)
     m = TransformerModel(50, 50, max_length=20, num_encoder_layers=1,
