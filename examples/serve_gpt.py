@@ -42,7 +42,11 @@ def main():
                           temperature=0.8, top_p=0.95, seed=0, quant=quant)
     # k_max defaults to cost_model.decode_horizon's priced K: blocks of
     # K decode ticks run device-resident (one compiled lax.scan), the
-    # host syncing only at block boundaries for admission/retirement
+    # host syncing only at block boundaries for admission/retirement.
+    # Admission is RAGGED by default: prompts stream into those same
+    # horizons as token-budgeted chunks (serving.RaggedScheduler), so
+    # a long prompt never stalls the other slots behind a blocking
+    # prefill dispatch (docs/serving.md "Ragged scheduling").
     eng = ContinuousBatchingEngine(dec, max_new_tokens=16)
 
     prompts = ["the quick brown fox", "tpu chips compile fast",
@@ -62,6 +66,8 @@ def main():
           f"{s['tokens']} tokens, K={s['k_max']} multi-step horizons, "
           f"{s['host_syncs_per_token']:.3f} host syncs/token "
           f"(per-tick engine pays ~1), "
+          f"{s.get('prefill_chunks', 0)} ragged prompt chunks / "
+          f"{s['prefill_syncs']} blocking prefill syncs, "
           f"p50 {s.get('token_p50_ms', 0)} ms/token")
 
 

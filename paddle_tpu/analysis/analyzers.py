@@ -15,7 +15,8 @@ from .pass_manager import Analyzer, register_analyzer
 
 __all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
            "GraphShapeAnalyzer", "CollectiveAnalyzer", "ServingAnalyzer",
-           "TrainingAnalyzer", "COLLECTIVE_OPS", "MXU_OPS"]
+           "PrefillStallAnalyzer", "TrainingAnalyzer", "COLLECTIVE_OPS",
+           "MXU_OPS"]
 
 MXU_OPS = ("dot_general", "convolution")
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
@@ -296,6 +297,68 @@ class ServingAnalyzer(Analyzer):
                         "n_device_loops": program.count("while"),
                         "cache_donated": not undonated,
                         "n_cache_args": len(cache)}
+        return findings
+
+
+@register_analyzer
+class PrefillStallAnalyzer(Analyzer):
+    """SERVE-PREFILL-STALL: no host-blocking prefill dispatch on the
+    decode critical path. Runs only when `ctx.extra["serve_schedule"]`
+    carries an engine scheduling trace
+    (`ContinuousBatchingEngine.serve_schedule()` — the MEM-PAGE-REFCOUNT
+    ledger pattern applied to scheduling decisions): each event is
+    either a "prefill_sync" (a blocking prefill dispatch, recording how
+    many decode slots sat stalled behind it) or a "horizon" (one ragged
+    mixed K-tick dispatch with its decode/prefill row mix). A
+    prefill_sync with `decode_active > 0` is the stall the ragged
+    scheduler exists to eliminate — one long prompt freezing every
+    decoding slot for a whole monolithic prefill — and is an ERROR.
+    The committed `gpt_decode_ragged` PROGRAM config re-audits a trace
+    captured from a real long-prompt-mid-stream workload on every CI
+    run; planted-defect tests corrupt a trace to prove detection.
+    Metrics pin the chunked-admission shape (mixed horizons present,
+    zero stalls) through the committed manifests."""
+    name = "prefill-stall"
+
+    def run(self, program, ctx):
+        events = ctx.extra.get("serve_schedule")
+        if not events:
+            self.metrics = {"checked": False}
+            return []
+        findings = []
+        n_stall = n_prefill_sync = n_mixed = n_horizon = 0
+        chunk_rows = 0
+        for ev in events:
+            kind = ev.get("kind")
+            if kind == "prefill_sync":
+                n_prefill_sync += 1
+                active = int(ev.get("decode_active", 0))
+                if active > 0:
+                    n_stall += 1
+                    findings.append(Finding(
+                        "SERVE-PREFILL-STALL", Severity.ERROR,
+                        f"host-blocking prefill dispatch ({ev.get('rows', '?')} "
+                        f"row(s)) on the decode critical path stalled "
+                        f"{active} running decode slot(s) — one long "
+                        "prompt freezes every decoding slot for its "
+                        "whole prefill",
+                        suggested_fix="admit prompts as token-budgeted "
+                        "chunks inside the decode horizon "
+                        "(ContinuousBatchingEngine ragged scheduling / "
+                        "serving.RaggedScheduler) instead of a "
+                        "monolithic prefill sync"))
+            elif kind == "horizon":
+                n_horizon += 1
+                if ev.get("prefill_rows"):
+                    n_mixed += 1
+                    chunk_rows += int(ev["prefill_rows"])
+        self.metrics = {"checked": True,
+                        "n_events": len(events),
+                        "n_prefill_syncs": n_prefill_sync,
+                        "n_stalled_prefill_syncs": n_stall,
+                        "n_horizons": n_horizon,
+                        "n_mixed_horizons": n_mixed,
+                        "n_prefill_rows": chunk_rows}
         return findings
 
 

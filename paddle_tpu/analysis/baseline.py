@@ -20,6 +20,14 @@ __all__ = ["BASELINE_CONFIGS", "PROGRAM_CONFIGS", "build_config",
 _CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
 _TUNING_CACHE = {}   # name -> AutotuneReport (autotune.autotune_layer)
 
+# the ragged paged attention's by-design reorders (one body behind
+# decode ticks, chunked prefill and the mixed horizon — see
+# ops/ragged_paged_attention.py): the page-gather layout move
+# [n,MP,ps,H,D] -> per-page [MP][n,H,ps,D] and the q/out head-major
+# flip. Shared by every serving PROGRAM config.
+RAGGED_ATTENTION_TRANSPOSES = (r"dims = \[1, 0, 3, 2, 4\]",
+                               r"dims = \[0, 2, 1, 3\]")
+
 
 def _fresh():
     import paddle_tpu as paddle
@@ -143,10 +151,10 @@ def _gpt_decode():
     program = dec.analysis_program(k=4)
     ctx = AnalysisContext(
         name="gpt_decode",
-        # paged attention's per-head score reorder rides with the dense
-        # model's by-design attention transposes
+        # the ragged attention's gather/head reorders ride with the
+        # dense model's by-design attention transposes
         allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
-        + (r"dims = \[0, 3, 1, 2\]",),
+        + RAGGED_ATTENTION_TRANSPOSES,
         expect_collectives=False,
         extra={"serving_decode": True})
     return program, ctx, PagedGPTDecoder._decode_multi_step
@@ -221,16 +229,54 @@ def _gpt_decode_prefix():
     program = dec.analysis_program(prefix_w=16)
     ctx = AnalysisContext(
         name="gpt_decode_prefix",
-        # the chunked body's per-head attention reorders ride with the
+        # the chunked body's ragged-attention reorders ride with the
         # dense model's by-design attention transposes (same exemptions
-        # as gpt_decode's paged gather)
+        # as gpt_decode — one shared body)
         allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
-        + (r"dims = \[0, 3, 1, 2\]", r"dims = \[0, 2, 3, 1\]",
-           r"dims = \[0, 1, 3, 2\]"),
+        + RAGGED_ATTENTION_TRANSPOSES,
         expect_collectives=False,
         extra={"serving_decode": True,
                "page_ledger": eng.page_ledger()})
     return program, ctx, PagedGPTDecoder._prefill_suffix_step
+
+
+def _gpt_decode_ragged():
+    """The RAGGED serving config: the mixed chunked-prefill + decode
+    horizon program (`PagedGPTDecoder._ragged_multi_step`, K=4 ticks at
+    chunk width w=8) captured via `analysis_program(ragged=(4, 8))`,
+    plus a SCHEDULING TRACE committed from a real
+    long-prompt-arrives-mid-stream workload (a short request decoding
+    while a 40-token prompt streams into the same horizons as chunks).
+    Gated by SERVE-HOST-SYNC-DECODE (zero host transfers inside the
+    fused mixed scan, donated KV pool, a real device loop) and by
+    SERVE-PREFILL-STALL (the trace must contain NO host-blocking
+    prefill dispatch while decode slots run — the stall the ragged
+    scheduler deletes)."""
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2)
+    eng = ContinuousBatchingEngine(dec, max_new_tokens=6, k_max=4,
+                                   chunk_tokens=8)
+    eng.submit(np.arange(1, 6, dtype=np.int32))          # short, decodes
+    eng.submit(np.arange(1, 41, dtype=np.int32))         # long, chunks in
+    eng.run()
+    program = dec.analysis_program(ragged=(4, 8))
+    ctx = AnalysisContext(
+        name="gpt_decode_ragged",
+        # the ragged page-scan attention's gather/head reorders ride
+        # with the dense model's by-design attention transposes
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + RAGGED_ATTENTION_TRANSPOSES,
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "serve_schedule": eng.serve_schedule()})
+    return program, ctx, PagedGPTDecoder._ragged_multi_step
 
 
 # configs whose builder yields a READY LoweredProgram (serving decode
@@ -241,6 +287,7 @@ def _gpt_decode_prefix():
 PROGRAM_CONFIGS = {
     "gpt_decode": _gpt_decode,       # fused multi-step serving decode
     "gpt_decode_prefix": _gpt_decode_prefix,   # chunked prefix-cache prefill
+    "gpt_decode_ragged": _gpt_decode_ragged,   # mixed chunked-prefill+decode
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
 

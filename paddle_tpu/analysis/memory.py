@@ -282,6 +282,72 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     return peak, peak_idx, top
 
 
+def _reshape_dim_shards(in_shape, in_dims, out_shape):
+    """Per-dim shard counts across a reshape, or None when the mapping
+    isn't clean. Contiguous dim groups with equal element products map
+    onto each other (the standard reshape factorization); a group's
+    shard factor (the product of its INPUT dims' factors) lands on the
+    first output dim of its group — the most-major position, where a
+    row-major split stays contiguous — when divisibility holds.
+    Any group whose factor does not divide its target dim returns None
+    (the caller falls back to the conservative max-operand cap) — as
+    does a group whose factor sits on a MINOR input dim (a non-unit
+    dim more major than it in the group, or two sharded dims): a
+    row-major merge turns minor-dim sharding into a STRIDED pattern of
+    the merged dim, so pinning the factor to the group's major output
+    dim would silently migrate shard knowledge to the wrong dimension
+    — an anti-conservative per-device underestimate, the exact failure
+    the conservative cap exists to prevent."""
+    n, m = len(in_shape), len(out_shape)
+    out = []
+    i = j = 0
+    while i < n and j < m:
+        gi, gj = [i], [j]
+        pi, pj = int(in_shape[i]), int(out_shape[j])
+        i += 1
+        j += 1
+        while pi != pj:
+            if pi < pj:
+                if i >= n:
+                    return None
+                pi *= int(in_shape[i])
+                gi.append(i)
+                i += 1
+            else:
+                if j >= m:
+                    return None
+                pj *= int(out_shape[j])
+                gj.append(j)
+                j += 1
+        factor = 1
+        seen_nonunit = False
+        for g in gi:                         # major -> minor
+            f = int(in_dims[g])
+            if f > 1:
+                if seen_nonunit:             # factor on a minor dim:
+                    return None              # strided, unrepresentable
+                factor = f
+            if int(in_shape[g]) > 1:
+                seen_nonunit = True
+        group = [1] * len(gj)
+        if factor > 1:
+            if int(out_shape[gj[0]]) % factor:
+                return None
+            group[0] = factor
+        out.extend(group)
+    # trailing size-1 dims on either side carry no sharding
+    while i < n:
+        if int(in_shape[i]) != 1 or int(in_dims[i]) != 1:
+            return None
+        i += 1
+    while j < m:
+        if int(out_shape[j]) != 1:
+            return None
+        out.append(1)
+        j += 1
+    return tuple(out)
+
+
 def _eqn_out_shard(eqn, in_counts, in_dims):
     """Shard propagation for one eqn's outputs: (total_count, per-dim
     counts or None). The default heuristic — a result is at best as
@@ -294,6 +360,11 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
       so a tensor-parallel intermediate stops inheriting
       max(operand counts) blindly. Output dims follow the dot layout
       (batch, lhs free, rhs free).
+    * `reshape` tracks split/merge dims: a sharded dim's factor follows
+      its contiguous factor group into the output when divisibility
+      holds (`_reshape_dim_shards`), falling back to the conservative
+      cap otherwise — so dp/tp knowledge survives the [B, S, H·D] <->
+      [B·S, H, D] style reshapes between attention matmuls.
     * shape-preserving ops (elementwise chains) inherit the matching
       operand's dim vector, `transpose` permutes it — so dim knowledge
       survives between matmuls instead of dying at the first add/ln.
@@ -329,6 +400,15 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
             if perm is not None and len(perm) == len(in_dims[0]):
                 dims = tuple(in_dims[0][p] for p in perm)
                 return max(in_counts) if in_counts else 1, dims
+        if name == "reshape" and in_dims and in_dims[0] is not None:
+            ivs = [v for v in eqn.invars if _is_var(v)]
+            in_shape = tuple(getattr(ivs[0].aval, "shape", ()))
+            if len(in_dims[0]) == len(in_shape):
+                dims = _reshape_dim_shards(
+                    in_shape, in_dims[0],
+                    tuple(getattr(eqn.outvars[0].aval, "shape", ())))
+                if dims is not None:
+                    return max(in_counts) if in_counts else 1, dims
         out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
         best, best_dims = (max(in_counts) if in_counts else 1), None
         for cnt, dims, v in zip(in_counts, in_dims,

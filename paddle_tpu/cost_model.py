@@ -32,6 +32,7 @@ __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
            "eqn_flops", "jaxpr_flops", "RooflineTime",
            "roofline_step_time", "decode_tick_roofline_s",
+           "ragged_tick_roofline_s", "ragged_chunk_tokens",
            "decode_horizon", "train_horizon", "measured_host_sync_s",
            "prefill_ttft_s"]
 
@@ -244,8 +245,50 @@ def decode_tick_roofline_s(step_hbm_bytes, chip=None):
     return step_hbm_bytes / chip.hbm_bw
 
 
+def ragged_tick_roofline_s(step_hbm_bytes, chunk_tokens=0,
+                           flops_per_token=0.0, chip=None,
+                           mxu_efficiency=0.65):
+    """Analytic floor of ONE MIXED (ragged) tick: the decode rows keep
+    the tick HBM-bound (every weight byte + the batch's KV prefix, the
+    `decode_tick_roofline_s` leg), and the prefill-chunk rows add
+    `chunk_tokens` of prompt compute at `flops_per_token` (2x params
+    for a GPT block stack). The tick cannot beat the slower leg —
+    max(HBM, chunk compute) — which is exactly why chunking works:
+    while the chunk's compute fits under the HBM leg, prompt tokens
+    stream into the pool at ZERO marginal tick time."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    hbm = step_hbm_bytes / chip.hbm_bw
+    compute = (max(float(chunk_tokens), 0.0) *
+               max(float(flops_per_token), 0.0) /
+               (chip.peak_flops * mxu_efficiency))
+    return max(hbm, compute)
+
+
+def ragged_chunk_tokens(step_hbm_bytes, flops_per_token, chip=None,
+                        mxu_efficiency=0.65, cap=256, floor=8):
+    """Default per-tick prefill-chunk budget W for the ragged
+    scheduler: the largest power of two whose compute leg hides under
+    the decode tick's HBM leg (the chunk rides 'free' inside the
+    HBM-bound tick — `ragged_tick_roofline_s(b, W, f) ==
+    decode_tick_roofline_s(b)`), clamped to [floor, cap]. `cap` bounds
+    per-tick latency jitter for the decode rows sharing the tick;
+    `floor` keeps progress on prompts even for models whose tick is
+    compute-tight."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    hbm = step_hbm_bytes / chip.hbm_bw
+    per_tok = (max(float(flops_per_token), 0.0) /
+               (chip.peak_flops * mxu_efficiency))
+    if per_tok <= 0:
+        return int(cap)
+    w = int(floor)
+    while w * 2 <= int(cap) and (w * 2) * per_tok <= hbm:
+        w *= 2
+    return w
+
+
 def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
-                   k_cap=32, sync_overhead_frac=0.10):
+                   k_cap=32, sync_overhead_frac=0.10,
+                   chunk_tokens=0, flops_per_token=0.0):
     """Best multi-step decode horizon K — how many device-resident
     ticks to fuse per host sync (serving.ContinuousBatchingEngine's
     default k_max).
@@ -259,11 +302,22 @@ def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
     for a bounded compile count). Small models on fast chips price to
     the cap — the tick is so short that ANY host interposition
     dominates; models whose tick dwarfs the sync cost price K=1, where
-    the fused loop gains nothing."""
+    the fused loop gains nothing.
+
+    The RAGGED extension: with `chunk_tokens`/`flops_per_token` the
+    tick is priced as a MIXED tick (`ragged_tick_roofline_s` — decode
+    HBM leg plus the prefill chunk's compute leg), so a scheduler that
+    admits prompt chunks into the horizon amortizes the same sync cost
+    over its slightly longer ticks (a compute-heavy chunk budget prices
+    a smaller K)."""
     import math
     if host_sync_s is None:
         host_sync_s = measured_host_sync_s()
-    t = decode_tick_roofline_s(step_hbm_bytes, chip=chip)
+    if chunk_tokens:
+        t = ragged_tick_roofline_s(step_hbm_bytes, chunk_tokens,
+                                   flops_per_token, chip=chip)
+    else:
+        t = decode_tick_roofline_s(step_hbm_bytes, chip=chip)
     if t <= 0:
         return int(k_cap)
     k = math.ceil(host_sync_s / (sync_overhead_frac * t))

@@ -7,6 +7,7 @@ the optimizer update, with params/optimizer state donated (updated in-place
 in HBM) and every tensor sharded per the GSPMD plan. XLA overlaps the
 collectives with compute on ICI.
 """
+import weakref
 from functools import partial
 
 import numpy as np
@@ -25,6 +26,29 @@ __all__ = ["Trainer", "LossBuffer", "shard_batch", "make_compute_loss",
 
 # consts key carrying the step counter that salts in-step RNG draws
 _RNG_STEP = "__rng_step__"
+
+# every live Trainer, so long-running harnesses (the tier-1 conftest's
+# module-boundary GC hook) can trim per-signature compiled-step memos
+# without plumbing handles — the ServeStats/_ENGINES registry pattern
+_LIVE_TRAINERS = weakref.WeakSet()
+
+
+def clear_compiled_step_memos():
+    """Drop every live Trainer's per-signature compiled-program memos
+    (`_placed_steps`/`_placed_multis`/`_batch_shardings`). The memos
+    pin compiled executables (megabytes each, plus their jaxpr/HLO
+    object graphs); a test-suite module that finished with its
+    trainers no longer needs them, and anything still live simply
+    recompiles on its next step. Returns the number of entries
+    dropped. Used by tests/conftest.py at module boundaries (ROADMAP
+    'tier-1 wall-clock health')."""
+    n = 0
+    for tr in list(_LIVE_TRAINERS):
+        for memo in (tr._placed_steps, tr._placed_multis,
+                     tr._batch_shardings):
+            n += len(memo)
+            memo.clear()
+    return n
 
 
 def make_compute_loss(model, loss_fn):
@@ -211,6 +235,7 @@ class Trainer:
         # fused multi-step programs, keyed by the STACKED batch signature
         # (which encodes the horizon length N in the leading dim)
         self._placed_multis = {}
+        _LIVE_TRAINERS.add(self)
 
     def _mesh_place(self, tree):
         """Replicate any single-device leaf onto the full mesh. A state

@@ -1,0 +1,240 @@
+"""Ragged paged attention: ONE attention primitive for mixed
+chunked-prefill + decode batches over the paged KV pool.
+
+TPU-native port of the Ragged Paged Attention design (arxiv 2604.15464):
+every batch row carries (already-cached length, new-token count) —
+a decode row is new_len=1, a prefill chunk new_len=W — so a single
+kernel invocation serves both kinds of row with no length-bucketed
+dispatch. Query j of row i sits at absolute position ``start[i] + j``
+and attends causally over the row's own pages (kpos <= qpos); rows are
+fully independent, so a token's attention output does not depend on the
+window width W, the batch composition, or whether it was computed as a
+decode tick or inside a prefill chunk — the schedule-independence the
+serving engine's byte-identical equivalence tests pin.
+
+Shapes:
+  q               : (n, W, H, D)   new-token queries (row-local window)
+  k_pages/v_pages : (P, page_size, H, D)  one layer's page pool
+  page_table      : (n, max_pages) int32 page ids per row
+  start           : (n,)           already-cached length per row
+
+Math: an online-softmax (flash) accumulation over the row's pages, in
+f32. The jnp reference (`use_kernel=False`, the CPU/production-default
+path) runs EXACTLY the same per-page update as the Pallas kernel via a
+`lax.scan` over pages — same operation order, same masking, same
+epsilon — so interpret-mode Pallas is bit-identical to the reference
+(test-pinned, the w4_matmul discipline). The kernel keeps the page pool
+in HBM and streams ONE page of K/V per grid step through VMEM via the
+scalar-prefetched page table (the `paged_attention` scalar-prefetch
+pattern), with online-softmax state in VMEM scratch across the page
+steps.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._fallback import kernel_fallback
+
+__all__ = ["ragged_paged_attention"]
+
+# softmax-denominator floor shared by reference and kernel: a row whose
+# every key is masked (possible only for padded queries past true_len —
+# row-local garbage by design) divides by this instead of 0
+_DENOM_EPS = 1e-30
+_MASK = -1e30
+
+
+def _page_update(m, s, acc, logits, v, kpos, qpos):
+    """ONE page's online-softmax update — the shared math of the jnp
+    reference and the Pallas kernel (they call this same function, so
+    the two paths cannot drift; bit-identity rides on it).
+
+    m/s/acc: running max [..., W, 1], denominator [..., W, 1], value
+    accumulator [..., W, D]. logits [..., W, ps] this page's scores
+    (q*scale @ k^T), v [..., ps, D] this page's values, kpos [ps] the
+    page's absolute key positions, qpos [..., W] the queries' absolute
+    positions. Causal: a query attends to kpos <= qpos only."""
+    mask = kpos[..., None, :] <= qpos[..., :, None]       # [..., W, ps]
+    logits = jnp.where(mask, logits, _MASK)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.exp(logits - m_new)
+    corr = jnp.exp(m - m_new)
+    s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jax.lax.dot_general(
+        p, v, (((p.ndim - 1,), (v.ndim - 2,)),
+               (tuple(range(p.ndim - 2)), tuple(range(v.ndim - 2)))),
+        preferred_element_type=jnp.float32)
+    return m_new, s_new, acc_new
+
+
+# page counts up to this unroll the reference's page loop into straight
+# line code (XLA fuses across pages; a lax.scan pays while-loop overhead
+# per page — measurable on CPU where the decode tick is host-bound).
+# Unrolled and scanned variants run the IDENTICAL op sequence, so both
+# stay bit-identical to the kernel's grid walk.
+_UNROLL_PAGES = 32
+
+
+def _ragged_ref(q, k_pages, v_pages, page_table, start, scale):
+    """jnp reference: the kernel's page loop as an unrolled loop (small
+    tables) or a lax.scan — the same per-page update in the same order
+    either way (see _page_update)."""
+    n, W, H, D = q.shape
+    ps = k_pages.shape[1]
+    MP = page_table.shape[1]
+    safe = jnp.maximum(page_table, 0)
+    # [n, MP, ps, H, D] -> per-page [MP][n, H, ps, D]
+    kg = jnp.moveaxis(k_pages[safe], (1, 3), (0, 2))
+    vg = jnp.moveaxis(v_pages[safe], (1, 3), (0, 2))
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [n,H,W,D]
+    qpos = (start[:, None] + jnp.arange(W))[:, None, :]         # [n,1,W]
+
+    def page_step(carry, inputs):
+        m, s, acc = carry
+        j, kj, vj = inputs                     # [n, H, ps, D]
+        logits = jax.lax.dot_general(
+            qf, kj.astype(jnp.float32),
+            (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)          # [n, H, W, ps]
+        kpos = j * ps + jnp.arange(ps)
+        return _page_update(m, s, acc, logits, vj.astype(jnp.float32),
+                            kpos, qpos), None
+
+    carry = (jnp.full((n, H, W, 1), _MASK, jnp.float32),
+             jnp.zeros((n, H, W, 1), jnp.float32),
+             jnp.zeros((n, H, W, D), jnp.float32))
+    if MP <= _UNROLL_PAGES:
+        for j in range(MP):
+            carry, _ = page_step(carry, (j, kg[j], vg[j]))
+    else:
+        carry, _ = jax.lax.scan(page_step, carry,
+                                (jnp.arange(MP), kg, vg))
+    m, s, acc = carry
+    out = acc / jnp.maximum(s, _DENOM_EPS)               # [n, H, W, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)     # [n, W, H, D]
+
+
+# the reference always executes COMPILED, even when the caller is
+# eager: op-by-op dispatch rounds a hair differently from XLA's fused
+# lowering, and the bit-identity contract with the interpret-mode
+# kernel (which runs compiled) is pinned at the compiled semantics.
+# Inside a jitted caller (the decoder's programs) this inlines away.
+_ragged_ref_jit = jax.jit(_ragged_ref, static_argnames=("scale",))
+
+
+def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, s_scr, acc_scr, *, scale, page_size,
+                   max_pages):
+    """Grid (n, H, max_pages): one page of K/V in VMEM per step, online
+    softmax in scratch — the scalar-prefetched page_table drives the
+    K/V BlockSpec index maps, so the pool never leaves HBM whole."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        s_scr[...] = jnp.zeros_like(s_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale        # [W, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)                # [ps, D]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    kpos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1)[0]                     # [ps]
+    W = q.shape[0]
+    qpos = start_ref[b] + jax.lax.broadcasted_iota(
+        jnp.int32, (W, 1), 0)[:, 0]                          # [W]
+    m_new, s_new, acc_new = _page_update(
+        m_scr[...], s_scr[...], acc_scr[...], logits, v, kpos, qpos)
+    m_scr[...] = m_new
+    s_scr[...] = s_new
+    acc_scr[...] = acc_new
+
+    @pl.when(j == max_pages - 1)
+    def _emit():
+        out = acc_scr[...] / jnp.maximum(s_scr[...], _DENOM_EPS)
+        o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+
+
+def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
+                        interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, W, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+
+    def page_map(bi, hi, j, pt, st):
+        return (jnp.maximum(pt[bi, j], 0), 0, hi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_table, start
+        grid=(n, H, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, W, 1, D),
+                         lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, D), page_map),
+            pl.BlockSpec((1, page_size, 1, D), page_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, W, 1, D), lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((W, 1), jnp.float32),
+            pltpu.VMEM((W, 1), jnp.float32),
+            pltpu.VMEM((W, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, scale=scale,
+                          page_size=page_size, max_pages=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, W, H, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), start.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
+                           scale=None, use_kernel=False, interpret=None):
+    """Causal attention of ragged new-token windows over paged KV.
+
+    q (n, W, H, D): row i's new tokens at positions start[i]..start[i]+
+    W-1 (pad the window past the row's true new_len — padded queries
+    produce row-local garbage the caller discards, exactly like padded
+    positions in the chunked prefill). Decode rows are simply W=1 (or a
+    width-W window with one real query). Returns (n, W, H, D)."""
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    start = jnp.asarray(start, jnp.int32)
+    if q.shape[1] == 1:
+        # degenerate single-query windows (the all-decode batch) tickle
+        # a different XLA CPU matvec lowering in the reference program
+        # than in the interpret-mode kernel (observed: last-ulp drift at
+        # W=1, bit-identical at W>=2). Pad with one discarded zero query
+        # on BOTH paths — queries are row-local, so row 0's math is
+        # unchanged and the two paths stay bit-identical everywhere.
+        q2 = jnp.concatenate([q, jnp.zeros_like(q)], axis=1)
+        return ragged_paged_attention(q2, k_pages, v_pages, page_table,
+                                      start, scale=scale,
+                                      use_kernel=use_kernel,
+                                      interpret=interpret)[:, :1]
+    if not use_kernel:
+        return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
+                               scale=float(scale))
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    try:
+        return _ragged_kernel_call(q, k_pages, v_pages, page_table,
+                                   start, scale, interpret)
+    except Exception as e:
+        kernel_fallback("ragged_paged_attention", e)
+        return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
+                               scale=float(scale))

@@ -49,13 +49,15 @@ The engine applies to GPT-family models (uniform pre-LN blocks); weights
 are extracted once into stacked per-layer arrays and the model object is
 no longer needed — pair with jit.load-style artifacts for serving.
 """
-from .decoder import (MultiDecodeOut, PagedGPTDecoder, _ln, _mm,
-                      _mm_heads, _quantize_w, _sample_tokens,
+from .decoder import (MultiDecodeOut, PagedGPTDecoder, RaggedMultiOut,
+                      _ln, _mm, _mm_heads, _quantize_w, _sample_tokens,
                       _spec_accept)
 from .engine import ContinuousBatchingEngine, SpeculativeEngine
 from .prefix_cache import PrefixCache
+from .scheduler import RaggedScheduler
 from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
 
 __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "SpeculativeEngine", "ServeStats", "serving_stats",
-           "PrefixCache", "MultiDecodeOut"]
+           "PrefixCache", "MultiDecodeOut", "RaggedMultiOut",
+           "RaggedScheduler"]

@@ -3,14 +3,36 @@ prefill / verify programs over page pools (see package docstring in
 `paddle_tpu/serving/__init__.py` for the architecture notes)."""
 import collections
 import functools
-import math
+import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedGPTDecoder", "MultiDecodeOut", "_spec_accept",
-           "_sample_tokens", "_ln", "_mm", "_mm_heads", "_quantize_w"]
+__all__ = ["PagedGPTDecoder", "MultiDecodeOut", "RaggedMultiOut",
+           "_spec_accept", "_sample_tokens", "_ln", "_mm", "_mm_heads",
+           "_quantize_w"]
+
+# every live decoder, so the tier-1 conftest's module-boundary GC hook
+# can trim compiled-program memos (the Trainer._LIVE_TRAINERS pattern)
+_LIVE_DECODERS = weakref.WeakSet()
+
+
+def clear_compiled_memos():
+    """Drop every live decoder's lazily built compiled-program memos
+    (fused multi/ragged loops, chunked prefill, verify, CoW copy). A
+    finished test module's decoders no longer need them; anything
+    still live recompiles on its next call. Returns entries dropped."""
+    n = 0
+    for dec in list(_LIVE_DECODERS):
+        for memo in (dec._multis, dec._raggeds):
+            n += len(memo)
+            memo.clear()
+        for attr in ("_verify", "_probs", "_suffix_prefill", "_copy"):
+            if getattr(dec, attr) is not None:
+                n += 1
+                setattr(dec, attr, None)
+    return n
 
 
 # decode_multi's result bundle: device arrays — the engine feeds
@@ -19,6 +41,14 @@ __all__ = ["PagedGPTDecoder", "MultiDecodeOut", "_spec_accept",
 MultiDecodeOut = collections.namedtuple(
     "MultiDecodeOut", ["tokens_block", "done_before", "tokens", "lens",
                        "done", "remaining", "logits_block"])
+
+# ragged_multi's result bundle: like MultiDecodeOut plus the device-
+# resident prompt-suffix carry (pend/pend_n) and the per-tick `emitted`
+# mask (False for filler ticks of frozen slots AND for mid-prefill
+# ticks, which consume prompt chunks without producing a token)
+RaggedMultiOut = collections.namedtuple(
+    "RaggedMultiOut", ["tokens_block", "emitted", "tokens", "lens",
+                       "done", "remaining", "pend", "pend_n"])
 
 
 def _ln(x, w, b):
@@ -217,11 +247,12 @@ class PagedGPTDecoder:
 
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
         self._multis = {}     # (k, return_logits) -> jitted fused loop
+        self._raggeds = {}    # (k, w) -> jitted mixed ragged horizon
         self._verify = None   # jitted lazily (speculative decoding only)
         self._probs = None    # jitted lazily (sampled speculation)
-        self._prefills = {}   # padded length -> jitted prefill
         self._suffix_prefill = None   # jitted lazily (chunked prefill)
         self._copy = None     # jitted lazily (copy-on-write page copy)
+        _LIVE_DECODERS.add(self)
 
     def _probs_of(self, logits):
         """softmax over the decoder's sampling mask (the distribution its
@@ -309,9 +340,12 @@ class PagedGPTDecoder:
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
             kp = kp.at[pids, offs].set(k.astype(kp.dtype))
             vp = vp.at[pids, offs].set(v.astype(vp.dtype))
-            from ..ops.paged_attention import paged_attention
-            attn = paged_attention(q[:, None], kp, vp, table, lens + 1,
-                                   use_kernel=self.use_kernel)  # [S,1,H,D]
+            # the ONE ragged kernel behind every serving path (decode is
+            # the W=1 row kind): causal over kpos <= lens, i.e. the
+            # slot's prefix plus the key written just above
+            from ..ops.ragged_paged_attention import ragged_paged_attention
+            attn = ragged_paged_attention(q[:, None], kp, vp, table, lens,
+                                          use_kernel=self.use_kernel)
             x = x + _mm(attn.reshape(S, H * D), wl["proj_w"], wl["proj_b"],
                         quant)
             y = _ln(x, wl["ln2_w"], wl["ln2_b"])
@@ -410,20 +444,21 @@ class PagedGPTDecoder:
         return ret
 
     def _windowed_layer(self, pos, pids, offs, table):
-        """ONE gather-attention transformer layer shared by the verify
-        window (`_verify_step`) and the chunked prefill
-        (`_prefill_suffix_step`): write each position's K/V at (pids,
-        offs) — callers route out-of-range/padded positions to the
-        scratch page — gather the row's pages, attend with
-        per-position causality (kpos <= pos), then residual proj +
+        """ONE ragged-attention transformer layer shared by the verify
+        window (`_verify_step`), the chunked prefill
+        (`_prefill_suffix_step`) and every tick of the mixed ragged
+        horizon (`_ragged_multi_step`): write each position's K/V at
+        (pids, offs) — callers route out-of-range/padded positions to
+        the scratch page — attend over the row's pages with
+        per-position causality (kpos <= pos) through the shared
+        `ops.ragged_paged_attention` primitive, then residual proj +
         FFN. A single body means a masking or scratch-routing fix can
-        never diverge the two programs (the byte-identical
-        cache-on/off guarantee rides on the chunked prefill computing
-        exactly what the cached pages hold)."""
-        cfg, ps = self.cfg, self.page_size
+        never diverge the programs (the byte-identical cache-on/off and
+        ragged-vs-per-tick guarantees ride on every path computing
+        exactly the same per-position bytes)."""
+        cfg = self.cfg
         H, D = cfg.num_heads, cfg.head_dim
         n, W = pos.shape
-        MP = table.shape[1]
         quant = self.quant
 
         def layer(x, wkv):
@@ -434,17 +469,12 @@ class PagedGPTDecoder:
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             kp = kp.at[pids, offs].set(k.astype(kp.dtype))
             vp = vp.at[pids, offs].set(v.astype(vp.dtype))
-            # gather each row's pages and attend with per-row causality
-            kg = kp[table].reshape(n, MP * ps, H, D)            # [n, T, H, D]
-            vg = vp[table].reshape(n, MP * ps, H, D)
-            scale = 1.0 / float(np.sqrt(D))
-            s = jnp.einsum("swhd,sthd->shwt", q.astype(jnp.float32),
-                           kg.astype(jnp.float32)) * scale
-            kpos = jnp.arange(MP * ps)[None, None, None, :]
-            s = jnp.where(kpos <= pos[:, None, :, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("shwt,sthd->swhd", p,
-                              vg.astype(jnp.float32)).astype(x.dtype)
+            # pos rows are contiguous windows (start + arange(W)), so
+            # the row's first entry IS its cached length
+            from ..ops.ragged_paged_attention import ragged_paged_attention
+            attn = ragged_paged_attention(
+                q, kp, vp, table, pos[:, 0],
+                use_kernel=self.use_kernel).astype(x.dtype)
             o = _mm(attn.reshape(n * W, H * D), wl["proj_w"],
                     wl["proj_b"], quant).reshape(n, W, -1)
             x = x + o
@@ -503,29 +533,31 @@ class PagedGPTDecoder:
             return np.asarray(out), self._probs_of(logits)
         return np.asarray(out)
 
-    def _prefill_suffix_step(self, weights, k_pages, v_pages, ids, start,
-                             true_len, table, kids):
-        """Chunked prefill: consume the UNCACHED suffix of each prompt
-        in one forward, attending against the paged prefix (the
-        prefix-cache mounts cached pages into `table` host-side; a
-        `start=0` row is simply a full, uncached prompt).
+    def _ragged_forward(self, weights, k_pages, v_pages, ids, start,
+                        true_len, table, kids, frozen=None):
+        """The shared RAGGED chunk forward: consume each row's [W]-wide
+        window of new tokens at positions start..true_len-1, attending
+        against the row's paged prefix. ids [n, W] window tokens
+        (zero-padded), start [n] positions already in the pages (cached
+        prefix + previously consumed chunks; = the decode position for
+        a decode row), true_len [n] position count after this window,
+        table [n, max_pages], kids [n] sampling key ids, `frozen` [n]
+        routes EVERY write of a frozen row to scratch (the fused
+        horizon's done mask).
 
-        ids [n, W] suffix tokens (zero-padded), start [n] first position
-        to compute (= cached-prefix length), true_len [n] full prompt
-        length, table [n, max_pages] page rows, kids [n] sampling key
-        ids.  K/V is written at positions start..true_len-1 — padded
-        positions route to the reserved scratch page, so real pages hold
-        ONLY real prompt KV (full blocks become content-addressable
-        cache entries).  Per-position computations are independent of
-        the padded width W and the batch rows (matmuls are row-local,
-        attention reduces over the fixed [max_pages*page_size] gather),
-        so a block's bytes are identical whether its request computed it
-        alone, in a batch, or mounted it from another request's prefill
-        — the property the byte-identical cache-on/off equivalence
-        tests pin.  The layer body is `_windowed_layer`, shared with
-        `_verify_step`.  Returns (first generated token [n] — sampled
-        at position true_len-1 with the standard (seed, kid, position)
-        key — k_pages, v_pages)."""
+        K/V is written at positions start..true_len-1 — padded
+        positions (pos >= true_len) and table overflow route to the
+        reserved scratch page, so real pages hold ONLY real KV (full
+        blocks become content-addressable cache entries). Per-position
+        computations are independent of the padded width W and the
+        batch rows (matmuls are row-local, attention reduces over the
+        row's own page gather), so a position's bytes are identical
+        whether it was computed alone, in a batch, as a decode tick
+        (W=1 window) or inside any chunking of its prompt — the
+        property every byte-identical equivalence test pins. The layer
+        body is `_windowed_layer`, shared with `_verify_step`. Returns
+        (next token [n] — sampled at position true_len-1 with the
+        standard (seed, kid, position) key — k_pages, v_pages)."""
         cfg, ps = self.cfg, self.page_size
         n, W = ids.shape
         pos = start[:, None] + jnp.arange(W)[None, :]           # [n, W]
@@ -533,9 +565,11 @@ class PagedGPTDecoder:
              self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
              ).astype(k_pages.dtype)                            # [n, W, h]
         MP = table.shape[1]
-        # scratch-route every write that isn't a real prompt position:
-        # the padded tail (pos >= true_len) and table overflow
+        # scratch-route every write that isn't a real position: the
+        # padded tail (pos >= true_len), table overflow, frozen rows
         in_range = (pos < true_len[:, None]) & (pos < MP * ps)
+        if frozen is not None:
+            in_range = in_range & ~frozen[:, None]
         pids = jnp.take_along_axis(table, jnp.minimum(pos // ps, MP - 1),
                                    axis=1)                      # [n, W]
         pids = jnp.where(in_range, pids, self.num_pages - 1)
@@ -552,76 +586,90 @@ class PagedGPTDecoder:
             self.lm_head.astype(jnp.float32)
         keys = None
         if self.sampling is not None:
-            # same (seed, kid, position) key walk as decode and the
-            # flash prefill: the prompt's last token sits at true_len-1,
-            # whatever span of it was cache-mounted
+            # same (seed, kid, position) key walk as decode: the
+            # window's last token sits at true_len-1, whatever span of
+            # the prompt was cache-mounted or chunked before it
             keys = self._pos_keys(kids, true_len - 1)
         return _sample_tokens(logits, self.sampling, keys), \
             k_pages, v_pages
 
-    def _prefill_fn(self, Lp, n):
-        """Per-(length-bucket, batch-bucket) compiled prefill: n padded
-        sequences at once. Writes prompt KV into each sequence's pages
-        and returns the n first tokens."""
-        cfg, ps = self.cfg, self.page_size
-        H, D = cfg.num_heads, cfg.head_dim
-        n_pg = Lp // ps
-        quant = self.quant
+    def _prefill_suffix_step(self, weights, k_pages, v_pages, ids, start,
+                             true_len, table, kids):
+        """Chunked prefill: consume the UNCACHED suffix of each prompt
+        in one forward, attending against the paged prefix (the
+        prefix-cache mounts cached pages into `table` host-side; a
+        `start=0` row is simply a full, uncached prompt). The body is
+        `_ragged_forward` — the same program shape as a decode tick,
+        which is its W=1 special case."""
+        return self._ragged_forward(weights, k_pages, v_pages, ids,
+                                    start, true_len, table, kids)
 
-        def run(weights, k_pages, v_pages, ids, true_len, page_ids, kids):
-            x = (self.wte[ids] + self.wpe[jnp.arange(Lp)][None]
-                 ).astype(k_pages.dtype)                     # [n, Lp, h]
+    def _ragged_multi_step(self, weights, k_pages, v_pages, tokens, lens,
+                           table, kids, done, remaining, eos, pend,
+                           pend_n, *, k, w):
+        """K MIXED ragged ticks inside ONE compiled program: every tick
+        serves decode rows and prefill-chunk rows together through the
+        same `_ragged_forward` body (Ragged Paged Attention, arxiv
+        2604.15464) — so a prompt streams into the KV pool w tokens per
+        tick WITHOUT a separate host-blocking prefill dispatch, and
+        running decode slots keep emitting a token per tick alongside
+        it.
 
-            def layer(x, wkv):
-                wl, kp, vp = wkv
-                y = _ln(x, wl["ln1_w"], wl["ln1_b"])
-                qkv = _mm_heads(y.reshape(n * Lp, -1), wl["qkv_w"],
-                                wl["qkv_b"], quant).reshape(n, Lp, 3, H, D)
-                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-                # Pallas flash kernel when backend/tiling allow, jnp
-                # reference otherwise (one shared gate + fallback).
-                # Padded-key masking is unnecessary: causal rows < true_len
-                # never see cols >= true_len, padded rows' garbage stays
-                # row-local, and only row true_len-1 feeds the logits.
-                from ..ops.attention import flash_raw_or_reference
-                attn = flash_raw_or_reference(
-                    q, k, v, causal=True, scale=1.0 / math.sqrt(D))
-                x = x + _mm(attn.reshape(n * Lp, H * D).astype(x.dtype),
-                            wl["proj_w"], wl["proj_b"],
-                            quant).reshape(n, Lp, -1)
-                y = _ln(x, wl["ln2_w"], wl["ln2_b"])
-                h = jax.nn.gelu(
-                    _mm(y.reshape(n * Lp, -1), wl["fc1_w"], wl["fc1_b"],
-                        quant), approximate=True)
-                x = x + _mm(h, wl["fc2_w"], wl["fc2_b"],
-                            quant).reshape(n, Lp, -1)
-                # page writes: static page count, dynamic page ids; the
-                # requests' page sets are disjoint (scratch excepted)
-                kpg = k.reshape(n, n_pg, ps, H, D).astype(kp.dtype)
-                vpg = v.reshape(n, n_pg, ps, H, D).astype(vp.dtype)
-                kp = kp.at[page_ids].set(kpg)
-                vp = vp.at[page_ids].set(vpg)
-                return x, (kp, vp)
+        Carry per slot: `tokens` [S] last emitted token, `lens` [S]
+        positions consumed so far (mounted prefix + chunks + decode
+        appends), `done`/`remaining` as in `_decode_multi_step`, and
+        the device-resident prompt suffix `pend` [S, P] with its length
+        `pend_n` [S] (P static = the pool's token capacity). A tick's
+        window for slot s is its next min(pend_n, w) suffix tokens
+        while prefilling (new_len up to w), or its one sampled token
+        once decoding (new_len=1) — the ragged row kinds of the paper.
+        A prefill row emits nothing until the tick that consumes its
+        last suffix token, which samples the first generated token at
+        position true_len-1 with the standard (seed, kid, position)
+        key — exactly the token the host-blocking chunked prefill
+        would have produced, so streams are byte-identical across
+        schedules. Frozen slots' writes route to scratch as in the
+        decode-only loop.
 
-            x, (k_pages, v_pages) = jax.lax.scan(
-                layer, x, (weights, k_pages, v_pages))
-            x = _ln(x, self.ln_f_w, self.ln_f_b)
-            last = jnp.take_along_axis(
-                x, (true_len - 1)[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0]                                # [n, h]
-            logits = last.astype(jnp.float32) @ \
-                self.lm_head.astype(jnp.float32)
-            keys = None
-            if self.sampling is not None:
-                # same (seed, kid, position) key walk as decode: the
-                # prompt's last token sits at true_len-1, so the first
-                # generated token draws with that position — whatever
-                # chunk/bucket the request was prefilled in
-                keys = self._pos_keys(kids, true_len - 1)
-            return _sample_tokens(logits, self.sampling, keys), \
-                k_pages, v_pages
+        Returns (block [k, S] tokens, emitted [k, S] — True where the
+        tick really produced a token (False for filler AND mid-prefill
+        ticks) — final tokens/lens/done/remaining/pend/pend_n,
+        k_pages, v_pages)."""
+        S = tokens.shape[0]
+        P = pend.shape[1]
 
-        return jax.jit(run, donate_argnums=(1, 2))
+        def tick(carry, _):
+            tokens, lens, done, remaining, pend, pend_n, kp, vp = carry
+            is_pf = pend_n > 0
+            new_len = jnp.where(is_pf, jnp.minimum(pend_n, w), 1)
+            window = jnp.concatenate(
+                [tokens[:, None],
+                 jnp.zeros((S, w - 1), jnp.int32)], axis=1) \
+                if w > 1 else tokens[:, None]
+            ids = jnp.where(is_pf[:, None], pend[:, :w], window)
+            true = lens + new_len
+            nxt, kp, vp = self._ragged_forward(
+                weights, kp, vp, ids, lens, true, table, kids,
+                frozen=done)
+            emit = ~done & (pend_n <= w)       # decode row, or the
+            nxt = jnp.where(emit, nxt, tokens)  # chunk finishing prefill
+            rem = jnp.where(emit, remaining - 1, remaining)
+            new_done = done | (emit & ((nxt == eos) | (rem <= 0)))
+            new_lens = jnp.where(done, lens, lens + new_len)
+            pend = jnp.concatenate(
+                [pend[:, w:], jnp.zeros((S, min(w, P)), pend.dtype)],
+                axis=1)[:, :P]
+            pend_n = jnp.maximum(pend_n - w, 0)
+            return (nxt, new_lens, new_done, rem, pend, pend_n, kp, vp), \
+                (nxt, emit)
+
+        carry = (tokens, lens, done, remaining, pend, pend_n,
+                 k_pages, v_pages)
+        carry, outs = jax.lax.scan(tick, carry, jnp.arange(k))
+        tokens, lens, done, remaining, pend, pend_n, k_pages, v_pages = \
+            carry
+        return (outs[0], outs[1], tokens, lens, done, remaining, pend,
+                pend_n, k_pages, v_pages)
 
     # -- host-side API -----------------------------------------------------
 
@@ -633,52 +681,20 @@ class PagedGPTDecoder:
                                   kids=None if kid is None else [kid])[0]
 
     def prefill_batch(self, requests, kids=None):
-        """Prefill several prompts, batching same-length-bucket groups
-        into single forwards. requests: [(ids, page_ids), ...]; returns
-        the first generated token per request (in order). `kids` are
-        the per-request sampling key ids (see _pos_keys; the engine
-        passes request ids — default: the request's index in this
-        call)."""
-        ps = self.page_size
-        results = [None] * len(requests)
-        if kids is None:
-            kids = list(range(len(requests)))
-        groups = {}
-        for i, (ids, page_ids) in enumerate(requests):
-            ids = np.asarray(ids, np.int32)
-            Lp = max(ps, ps * (2 ** math.ceil(
-                math.log2(max(1, (len(ids) + ps - 1) // ps)))))
-            groups.setdefault(Lp, []).append((i, ids, page_ids))
-        for Lp, group in groups.items():
-            n_pg = Lp // ps
-            while group:
-                # batch-bucket to powers of two (bounded compile count)
-                nb = 1
-                while nb * 2 <= len(group) and nb * 2 <= self.max_batch:
-                    nb *= 2
-                chunk, group = group[:nb], group[nb:]
-                pad = np.zeros((nb, Lp), np.int32)
-                tl = np.ones(nb, np.int32)
-                pg = np.full((nb, n_pg), self.num_pages - 1, np.int32)
-                kd = np.zeros(nb, np.int32)
-                for r, (i, ids, page_ids) in enumerate(chunk):
-                    pad[r, :len(ids)] = ids
-                    tl[r] = len(ids)
-                    kd[r] = kids[i]
-                    k = min(len(page_ids), n_pg)
-                    pg[r, :k] = page_ids[:k]   # rest stays on scratch
-                key = (Lp, nb)
-                if key not in self._prefills:
-                    self._prefills[key] = self._prefill_fn(Lp, nb)
-                self._draws += 1
-                nxt, self.k_pages, self.v_pages = self._prefills[key](
-                    self.weights, self.k_pages, self.v_pages,
-                    jnp.asarray(pad), jnp.asarray(tl), jnp.asarray(pg),
-                    jnp.asarray(kd))
-                nxt = np.asarray(nxt)
-                for r, (i, _, _) in enumerate(chunk):
-                    results[i] = int(nxt[r])
-        return results
+        """Prefill several prompts in full. requests: [(ids, page_ids),
+        ...]; returns the first generated token per request (in order).
+        `kids` are the per-request sampling key ids (see _pos_keys; the
+        engine passes request ids — default: the request's index in
+        this call).
+
+        A thin wrapper over the chunked ragged body at start=0: the
+        separate flash-attention length-bucketed prefill is GONE — ALL
+        prefill runs through the same per-position program family as
+        decode and the verify window (`_ragged_forward`), so a prompt's
+        KV bytes are identical across every admission path (flash vs
+        chunked drift is structurally impossible)."""
+        return self.prefill_suffix_batch(
+            [(ids, 0, pages) for ids, pages in requests], kids=kids)
 
     def prefill_suffix_batch(self, requests, kids=None):
         """Chunked prefill over page-table rows (the prefix-cache
@@ -783,7 +799,8 @@ class PagedGPTDecoder:
                  self.quant or "", probes)
         return repr(parts).encode()
 
-    def analysis_program(self, donate=True, k=None, prefix_w=None):
+    def analysis_program(self, donate=True, k=None, prefix_w=None,
+                         ragged=None):
         """Graph Doctor view of the compiled decode program: one fresh
         trace with per-argument role capture — weights/embeddings are
         `param` (read-only across steps, NOT donated: that's correct
@@ -799,16 +816,43 @@ class PagedGPTDecoder:
         CHUNKED prefill program (`_prefill_suffix_step`, suffix bucket
         W=prefix_w) is traced — the prefix-cache admission path, gated
         by the same serving rules plus the MEM-PAGE-REFCOUNT ledger
-        audit (`gpt_decode_prefix` PROGRAM config). `donate=False`
-        traces the defective variant the planted-defect tests lint."""
+        audit (`gpt_decode_prefix` PROGRAM config). With
+        `ragged=(k, w)` the MIXED ragged horizon program
+        (`_ragged_multi_step`: K ticks serving decode rows and
+        w-token prefill-chunk rows in one scan) is traced — the
+        `gpt_decode_ragged` PROGRAM config gates it with
+        SERVE-HOST-SYNC-DECODE and (via an engine schedule trace on
+        the context) SERVE-PREFILL-STALL. `donate=False` traces the
+        defective variant the planted-defect tests lint."""
         from ..analysis.lowering import LoweredProgram, tree_arg_infos
 
         S = self.max_batch
         kids = jnp.arange(S, dtype=jnp.int32)
         table = jnp.zeros((S, self.max_pages), jnp.int32)
-        if k and prefix_w:
-            raise ValueError("pass k= or prefix_w=, not both")
-        if prefix_w:
+        if sum(map(bool, (k, prefix_w, ragged))) > 1:
+            raise ValueError("pass only one of k=, prefix_w=, ragged=")
+        if ragged:
+            rk, rw = map(int, ragged)
+            P = self.pend_capacity
+            tokens = jnp.zeros((S,), jnp.int32)
+            lens = jnp.zeros((S,), jnp.int32)
+            done = jnp.zeros((S,), bool)
+            remaining = jnp.full((S,), rk, jnp.int32)
+            eos = jnp.asarray(-1, jnp.int32)
+            pend = jnp.zeros((S, P), jnp.int32)
+            pend_n = jnp.zeros((S,), jnp.int32)
+            inputs = [("tokens", tokens), ("lens", lens),
+                      ("table", table), ("kids", kids), ("done", done),
+                      ("remaining", remaining), ("eos", eos),
+                      ("pend", pend), ("pend_n", pend_n)]
+            fn = jax.jit(functools.partial(self._ragged_multi_step,
+                                           k=rk, w=rw),
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids, done, remaining,
+                              eos, pend, pend_n)
+            name = f"ragged_multi_k{rk}_w{rw}"
+        elif prefix_w:
             W = int(prefix_w)
             ids = jnp.zeros((S, W), jnp.int32)
             start = jnp.zeros((S,), jnp.int32)
@@ -946,3 +990,50 @@ class PagedGPTDecoder:
         self.k_pages, self.v_pages = out[6], out[7]
         return MultiDecodeOut(out[0], out[1], out[2], out[3], out[4],
                               out[5], out[8] if return_logits else None)
+
+    @property
+    def pend_capacity(self):
+        """Static width of the ragged horizon's device-resident prompt
+        suffix buffer: the pool's per-sequence token capacity (ONE
+        compiled shape — no per-prompt-length buckets)."""
+        return self.max_pages * self.page_size
+
+    def ragged_multi(self, tokens, lens, table, k, w, pend, pend_n,
+                     kids=None, done=None, remaining=None, eos=None):
+        """Run `k` MIXED ragged ticks device-resident (see
+        `_ragged_multi_step`): decode rows and prefill-chunk rows serve
+        together, w suffix tokens per prefilling slot per tick, ONE
+        dispatch, zero intermediate host syncs. Jitted per (k, w); the
+        engine buckets k to powers of two and w to the scheduler's
+        chunk budget (or 1 on pure-decode horizons), so the compile
+        count stays bounded.
+
+        All inputs/outputs may stay on device; `pend` [S, P] /
+        `pend_n` [S] are the carried prompt suffixes
+        (P = `pend_capacity`). Returns a RaggedMultiOut."""
+        k, w = int(k), int(w)
+        S = self.max_batch
+        key = (k, w)
+        fn = self._raggeds.get(key)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(self._ragged_multi_step, k=k, w=w),
+                donate_argnums=(1, 2))
+            self._raggeds[key] = fn
+        if done is None:
+            done = np.zeros(S, bool)
+        if remaining is None:
+            remaining = np.full(S, np.iinfo(np.int32).max // 2, np.int32)
+        self._draws += k             # dispatch telemetry, not key state
+        out = fn(self.weights, self.k_pages, self.v_pages,
+                 jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(lens, jnp.int32),
+                 jnp.asarray(table, jnp.int32),
+                 jnp.asarray(self._kids_or_default(kids)),
+                 jnp.asarray(done, bool),
+                 jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(-1 if eos is None else int(eos), jnp.int32),
+                 jnp.asarray(pend, jnp.int32),
+                 jnp.asarray(pend_n, jnp.int32))
+        self.k_pages, self.v_pages = out[8], out[9]
+        return RaggedMultiOut(*out[:8])

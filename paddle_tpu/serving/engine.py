@@ -1,5 +1,7 @@
 """Continuous-batching engines over the paged decoder: slot scheduling,
-horizon-fused decode, prefix-cache admission, speculative decoding."""
+horizon-fused decode, ragged chunked-prefill admission, prefix-cache
+admission, speculative decoding."""
+import collections
 import time
 
 import jax.numpy as jnp
@@ -11,6 +13,10 @@ from .stats import _ENGINES, ServeStats
 
 __all__ = ["ContinuousBatchingEngine", "SpeculativeEngine"]
 
+# bounded schedule-event window: the SERVE-PREFILL-STALL audit reads
+# the most recent scheduling decisions, not the process lifetime
+_SCHED_WINDOW = 4096
+
 
 class ContinuousBatchingEngine:
     """Slot-based continuous batching: requests are admitted into free
@@ -18,17 +24,29 @@ class ContinuousBatchingEngine:
     runs one compiled step for ALL active slots, finished sequences free
     their pages.
 
-    By default `run()` schedules in HORIZONS: blocks of
-    `k = min(k_max, smallest remaining budget)` device-resident decode
-    ticks (`PagedGPTDecoder.decode_multi`), with the host syncing only
-    at block boundaries for admission/retirement/output append, and each
-    block's fetch overlapped against the NEXT block's dispatch
-    (one-horizon-delayed retirement: a slot finishing inside block N
-    stays frozen on device through block N+1 — its writes route to the
-    scratch page — and its pages are freed exactly once, when block N is
-    processed). `k_max` defaults to `cost_model.decode_horizon`'s priced
-    answer; `k_max=1` selects the legacy per-tick loop (`step()` is the
-    per-tick API either way).
+    By default `run()` schedules RAGGED horizons (Ragged Paged
+    Attention, arxiv 2604.15464): blocks of k device-resident ticks
+    (`PagedGPTDecoder.ragged_multi`) in which decode rows emit a token
+    per tick while newly admitted prompts stream their uncached
+    suffixes in as token-budgeted CHUNKS — admission mounts
+    prefix-cache pages and allocates the table row host-side, then
+    hands the suffix to the device carry; there is NO host-blocking
+    prefill dispatch on the decode critical path, so one long prompt
+    costs running slots at most a few slightly-longer ticks instead of
+    a monolithic prefill stall (`serving.RaggedScheduler` owns the
+    chunk/horizon policy; the SERVE-PREFILL-STALL rule audits the
+    scheduling trace). The host syncs only at block boundaries for
+    admission/retirement/output append, and each block's fetch is
+    overlapped against the NEXT block's dispatch (one-horizon-delayed
+    retirement: a slot finishing inside block N stays frozen on device
+    through block N+1 — its writes route to the scratch page — and its
+    pages are freed exactly once, when block N is processed).
+    `ragged=False` keeps the dispatch-separate baseline (`_run_multi`:
+    blocking chunked prefill at admission + decode-only
+    `decode_multi` horizons — byte-identical streams, used as the
+    stall bench's before). `k_max` defaults to
+    `cost_model.decode_horizon`'s priced answer; `k_max=1` selects the
+    legacy per-tick loop (`step()` is the per-tick API either way).
 
     With `prefix_cache` (a `PrefixCache`) admission becomes
     content-addressed: each prompt's full token blocks are hashed
@@ -48,7 +66,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
                  max_new_tokens=64, k_max=None, host_sync_s=None,
-                 prefix_cache=None):
+                 prefix_cache=None, ragged=None, chunk_tokens=None,
+                 scheduler=None):
         if max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (the prefill forward always "
@@ -75,11 +94,7 @@ class ContinuousBatchingEngine:
         self._outputs = {}                   # req_id -> [generated ids]
         self._next_id = 0
         self.steps = 0
-        if k_max is None:
-            from ..cost_model import decode_horizon
-            k_max = decode_horizon(decoder.step_hbm_bytes(),
-                                   host_sync_s=host_sync_s)
-        self.k_max = max(1, int(k_max))
+        self.k_max = max(1, int(k_max)) if k_max is not None else None
         if prefix_cache is True:
             from .prefix_cache import PrefixCache
             prefix_cache = PrefixCache(decoder.page_size,
@@ -91,6 +106,37 @@ class ContinuousBatchingEngine:
                 f"decoder page_size {decoder.page_size}")
         self.cache = prefix_cache
         self._cache_meta = {}                # rid -> (start, keys, n_hit)
+        # RAGGED scheduling (default on the multi-step path): prompt
+        # suffixes stream into the SAME K-tick horizon as running
+        # decode slots, w tokens per tick, with NO host-blocking
+        # prefill dispatch on the decode critical path. ragged=False
+        # keeps the dispatch-separate baseline (_run_multi: blocking
+        # chunked prefill at admission + decode-only horizons).
+        if scheduler is None and ragged is not False and \
+                (self.k_max is None or self.k_max > 1 or ragged):
+            from .scheduler import RaggedScheduler
+            # k_max=None lets the SCHEDULER price K with the
+            # chunk-aware mixed-tick roofline (decode_horizon's
+            # chunk_tokens extension) — a compute-heavy chunk budget
+            # correctly prices a smaller K than pure decode would
+            scheduler = RaggedScheduler(decoder,
+                                        chunk_tokens=chunk_tokens,
+                                        k_max=self.k_max,
+                                        host_sync_s=host_sync_s)
+        self.scheduler = scheduler
+        if self.k_max is None:
+            if scheduler is not None:
+                self.k_max = scheduler.k_max
+            else:
+                # explicitly non-ragged baseline: price K on the PURE
+                # decode tick (no chunk compute leg, no scheduler)
+                from ..cost_model import decode_horizon
+                self.k_max = decode_horizon(decoder.step_hbm_bytes(),
+                                            host_sync_s=host_sync_s)
+        self.ragged = bool(self.k_max > 1 if ragged is None else ragged)
+        self._prompt_len = [0] * S           # admitted prompt length/slot
+        # scheduling-decision trace for the SERVE-PREFILL-STALL audit
+        self._sched_events = collections.deque(maxlen=_SCHED_WINDOW)
         self.stats = ServeStats(engine=type(self).__name__,
                                 k_max=self.k_max)
         self._submit_t = {}                  # rid -> submit wall time
@@ -136,31 +182,44 @@ class ContinuousBatchingEngine:
         return (n_tokens + self.d.page_size - 1) // self.d.page_size
 
     def _admit(self):
-        # gather every admittable request first: same-length-bucket
+        # gather every admittable request first: same-suffix-bucket
         # prompts then prefill as ONE batched forward (iteration-level
         # batching applies to prefill too, not just decode). Pages freed
         # by EOS-at-prefill become available from the NEXT step's pass.
         # Returns the slots that entered decode (the multi-step run loop
         # merges exactly those into its device carry).
+        active0 = sum(r is not None for r in self._slot_req)
         admitted = self._gather_admissions()
         if not admitted:
             return []
         now = time.perf_counter()
-        t0s = {}
         for _, rid, _, _ in admitted:
-            t0 = self._submit_t.pop(rid, None)
+            t0 = self._submit_t.get(rid)
             if t0 is not None:
                 self.stats.queue_wait_s.append(now - t0)
-                t0s[rid] = t0
         self._table_cache = None
         firsts = self._prefill_admitted(admitted)
         self.stats.prefill_syncs += 1
+        # the stall the ragged path exists to kill: this prefill
+        # dispatch BLOCKED the host while `active0` slots sat decoding
+        # (SERVE-PREFILL-STALL audits the trace)
+        self._sched_events.append(
+            {"kind": "prefill_sync", "decode_active": int(active0),
+             "rows": len(admitted)})
+        if active0:
+            self.stats.prefill_stall_syncs += 1
         self._extra_prefill(admitted)
         done_t = time.perf_counter()
         live = []
         for (slot, rid, ids, pages), first in zip(admitted, firsts):
-            if rid in t0s:
-                self.stats.ttft_s.append(done_t - t0s[rid])
+            # TTFT = submit -> FIRST TOKEN (the token exists right
+            # here, so the prefill-sync timestamp is exactly it; the
+            # ragged path stamps the same milestone at block
+            # processing, so chunked and legacy engines report
+            # comparable numbers)
+            t0 = self._submit_t.pop(rid, None)
+            if t0 is not None:
+                self.stats.ttft_s.append(done_t - t0)
             self._outputs[rid] = [first]
             self.stats.tokens += 1
             if (self.eos is not None and first == self.eos) \
@@ -191,25 +250,38 @@ class ContinuousBatchingEngine:
             reqs.append((ids[start:], start, pages))
         firsts = self.d.prefill_suffix_batch(
             reqs, kids=[rid for _, rid, _, _ in admitted])
-        # publish newly computed full blocks: content-addressable from
-        # now on (the cache takes one reference-managed view; the slot
-        # keeps holding the page until retirement decrefs it). A
-        # same-batch duplicate whose insert is refused keeps its copy
-        # private — two requests never alias a page they both wrote —
-        # and publishing STOPS at the first refusal: a deeper block
-        # would chain under a parent this request neither mounted nor
-        # inserted, breaking the every-ancestor-referenced invariant
-        # the eviction cascade relies on (a parked parent could then
-        # cascade into a still-referenced child).
         for slot, rid, ids, pages in admitted:
-            start, keys, n_hit = self._cache_meta.pop(rid)
-            for b in range(n_hit, len(keys)):
-                parent = keys[b - 1] if b else None
-                if not self.cache.insert(keys[b], pages[b],
-                                         parent=parent):
-                    break
-                self._slot_shared[slot].add(pages[b])
+            self._publish_blocks(rid, slot)
         return firsts
+
+    def _publish_blocks(self, rid, slot):
+        """Publish a request's freshly computed full blocks to the
+        prefix cache: content-addressable from now on (the cache takes
+        one reference-managed view; the slot keeps holding the page
+        until retirement decrefs it). Called once the blocks' bytes
+        are KNOWN-ordered before any future reader — at prefill-sync
+        time on the blocking path, at first-token block processing on
+        the ragged path (every later mount dispatches after the
+        horizon that wrote the pages). A same-batch duplicate whose
+        insert is refused keeps its copy private — two requests never
+        alias a page they both wrote — and publishing STOPS at the
+        first refusal: a deeper block would chain under a parent this
+        request neither mounted nor inserted, breaking the
+        every-ancestor-referenced invariant the eviction cascade
+        relies on (a parked parent could then cascade into a
+        still-referenced child)."""
+        if self.cache is None:
+            return
+        meta = self._cache_meta.pop(rid, None)
+        if meta is None:
+            return
+        _start, keys, n_hit = meta
+        pages = self._slot_pages[slot]
+        for b in range(n_hit, len(keys)):
+            parent = keys[b - 1] if b else None
+            if not self.cache.insert(keys[b], pages[b], parent=parent):
+                break
+            self._slot_shared[slot].add(pages[b])
 
     def _gather_admissions(self):
         if self.cache is not None:
@@ -331,6 +403,9 @@ class ContinuousBatchingEngine:
         self._slot_pages[slot] = []
         self._lens[slot] = 0
         self._tokens[slot] = 0
+        self._prompt_len[slot] = 0
+        if self.scheduler is not None:
+            self.scheduler.retire(slot)
         self._table_cache = None
         self.stats.completed += 1
 
@@ -399,17 +474,41 @@ class ContinuousBatchingEngine:
                 self._retire(s)
         return len(active)
 
-    def run(self, step_times=None):
+    def run(self, step_times=None, on_sync=None):
         """Drain the queue; returns {request_id: generated token list}.
         `step_times`, if given, receives wall seconds per host sync —
         per decode tick on the per-tick path (k_max=1), per K-tick
-        horizon on the multi-step path (use `self.stats` for per-token
-        percentiles either way)."""
+        horizon on the multi-step paths (use `self.stats` for
+        per-token percentiles either way). `on_sync(engine)`, if
+        given, is called after every processed host sync — outputs are
+        current at that point, and the callback may `submit()` new
+        requests (the long-prompt-arrives-mid-stream bench drives
+        arrival timing with it). The multi-step default is the RAGGED
+        loop (prompt chunks ride the decode horizon, no host-blocking
+        prefill); `ragged=False` keeps the dispatch-separate
+        baseline."""
+        if self.ragged:
+            # an EXPLICIT ragged=True is honored even at k_max=1 (the
+            # horizons are just one tick long): the user asked for
+            # no-stall admission, silently downgrading to the
+            # blocking-prefill per-tick loop would betray that
+            return self._run_ragged(step_times, on_sync)
         if self.k_max <= 1:
-            return self._run_per_tick(step_times)
-        return self._run_multi(step_times)
+            return self._run_per_tick(step_times, on_sync)
+        return self._run_multi(step_times, on_sync)
 
-    def _run_per_tick(self, step_times=None):
+    def serve_schedule(self):
+        """The recent scheduling-decision trace (bounded window): one
+        event per host-blocking prefill dispatch ("prefill_sync", with
+        the decode slots it stalled) and per ragged horizon
+        ("horizon", with its k/w and row mix). The
+        SERVE-PREFILL-STALL rule (`analysis.analyzers
+        .PrefillStallAnalyzer`) audits this — a prefill_sync with
+        decode_active > 0 is the stall the ragged path exists to
+        kill."""
+        return list(self._sched_events)
+
+    def _run_per_tick(self, step_times=None, on_sync=None):
         """Legacy loop: one compiled tick, one host sync per token."""
         while self._queue or any(r is not None for r in self._slot_req):
             t0 = time.perf_counter()
@@ -426,6 +525,8 @@ class ContinuousBatchingEngine:
             # a prefill number — keep it out of the percentiles
             if n and self.stats.prefill_syncs == before_p:
                 self.stats.token_time_s.extend([dt / n] * n)
+            if on_sync is not None:
+                on_sync(self)
         return dict(self._outputs)
 
     def _budget_left(self, slot):
@@ -504,7 +605,7 @@ class ContinuousBatchingEngine:
         if emitted and not had_prefill and not prefilled_since:
             self.stats.token_time_s.extend([dt / emitted] * emitted)
 
-    def _run_multi(self, step_times=None):
+    def _run_multi(self, step_times=None, on_sync=None):
         """Horizon-scheduled drain: dispatch a K-tick device-resident
         block, then process the PREVIOUS block while the new one runs.
         Retirement is one horizon delayed — a slot that finishes inside
@@ -576,6 +677,244 @@ class ContinuousBatchingEngine:
             if pending is not None:
                 self._process_block(pending, inflight, step_times,
                                     prefilled_since=prefilled)
+                if on_sync is not None:
+                    on_sync(self)
+            pending = meta
+        return dict(self._outputs)
+
+    # -- ragged scheduling (chunked prefill INSIDE the decode horizon) --
+
+    def _admit_ragged(self):
+        """Admission without a prefill dispatch: mount the prefix-cache
+        span (zero device work), allocate pages, hand the uncached
+        suffix to the SCHEDULER — the suffix streams into the horizon
+        w tokens per tick from the device-resident pend carry. Returns
+        [(slot, rid, suffix), ...] for the carry merge."""
+        admitted = self._gather_admissions()
+        if not admitted:
+            return []
+        now = time.perf_counter()
+        for _, rid, _, _ in admitted:
+            t0 = self._submit_t.get(rid)
+            if t0 is not None:
+                self.stats.queue_wait_s.append(now - t0)
+        self._table_cache = None
+        plans = []
+        for slot, rid, ids, pages in admitted:
+            start = self._cache_meta[rid][0] if self.cache is not None \
+                else 0
+            suffix = ids[start:]
+            self._outputs[rid] = []
+            self._lens[slot] = start
+            self._tokens[slot] = 0
+            self._kids[slot] = rid
+            self._prompt_len[slot] = len(ids)
+            self._after_admit(slot, len(ids))
+            self.scheduler.admit(slot, len(suffix))
+            self.stats.prefill_chunk_tokens += len(suffix)
+            plans.append((slot, rid, suffix))
+        return plans
+
+    def _first_token(self, rid, slot):
+        """A request's FIRST token just landed on the host: stamp TTFT
+        (submit -> first token — comparable across the legacy and
+        chunked paths, however many horizon boundaries the prefill
+        spanned) and publish its freshly computed cache blocks (their
+        writes are device-ordered before any future mount's reads)."""
+        t0 = self._submit_t.pop(rid, None)
+        if t0 is not None:
+            self.stats.ttft_s.append(time.perf_counter() - t0)
+        self._publish_blocks(rid, slot)
+        # prompt fully consumed; the emitted token is not consumed yet
+        self._lens[slot] = self._prompt_len[slot]
+
+    def _merge_carry_ragged(self, carry, plans):
+        """Device-resident mixed-horizon state: (tokens, lens, done,
+        remaining, pend, pend_n). Newly admitted slots scatter their
+        suffix into the pend buffer with device ops — the carry never
+        round-trips through the host."""
+        S = self.d.max_batch
+        P = self.d.pend_capacity
+        if carry is None:
+            done = np.array([r is None for r in self._slot_req])
+            rem = np.array([self._budget_left(s) if self._slot_req[s]
+                            is not None else 0 for s in range(S)],
+                           np.int32)
+            pend = np.zeros((S, P), np.int32)
+            pend_n = np.zeros(S, np.int32)
+            for slot, _rid, suffix in plans:
+                pend[slot, :len(suffix)] = suffix
+                pend_n[slot] = len(suffix)
+            return (jnp.asarray(self._tokens), jnp.asarray(self._lens),
+                    jnp.asarray(done), jnp.asarray(rem),
+                    jnp.asarray(pend), jnp.asarray(pend_n))
+        if not plans:
+            return carry
+        tokens, lens, done, rem, pend, pend_n = carry
+        idx = jnp.asarray([s for s, _, _ in plans], jnp.int32)
+        rows = np.zeros((len(plans), P), np.int32)
+        ns = np.zeros(len(plans), np.int32)
+        for r, (slot, _rid, suffix) in enumerate(plans):
+            rows[r, :len(suffix)] = suffix
+            ns[r] = len(suffix)
+        slots = [s for s, _, _ in plans]
+        tokens = tokens.at[idx].set(jnp.asarray(self._tokens[slots]))
+        lens = lens.at[idx].set(jnp.asarray(self._lens[slots]))
+        done = done.at[idx].set(False)
+        rem = rem.at[idx].set(jnp.asarray(
+            [self._budget_left(s) for s in slots], jnp.int32))
+        pend = pend.at[idx].set(jnp.asarray(rows))
+        pend_n = pend_n.at[idx].set(jnp.asarray(ns))
+        return tokens, lens, done, rem, pend, pend_n
+
+    def _process_ragged_block(self, meta, inflight, step_times):
+        """Fetch + bookkeep one finished mixed horizon (called AFTER
+        the next horizon is dispatched, so the device->host wait
+        overlaps it). The per-tick `emitted` mask separates real
+        tokens from filler ticks AND from mid-prefill chunk ticks; a
+        request's first emitted token triggers TTFT + cache
+        publishing. No percentile exclusions here: every sync on this
+        path is a decode-path sync by construction — chunk ticks are
+        budgeted small enough to ride inside it, and their cost
+        SHOULD show in the per-token tail (that honesty is what the
+        stall bench measures)."""
+        block_d, emitted_d, k, rids, emit_ticks, t0 = meta
+        block = np.asarray(block_d)
+        emitted = np.asarray(emitted_d)
+        self.stats.decode_syncs += 1
+        n_emitted = 0
+        for s, rid in rids.items():
+            if self._slot_req[s] != rid:
+                # stale block of a retired/re-admitted slot: its emit
+                # ticks were already DISCARDED by the inflight reset at
+                # re-admission — subtracting them again would understate
+                # the new request's in-flight emissions, and unlike
+                # _run_multi's harmless scheduling slack, here inflight
+                # feeds _table_width's correctness-critical position
+                # bound
+                continue
+            inflight[s] = max(0, inflight[s] - emit_ticks.get(s, 0))
+            for j in range(k):
+                if not emitted[j, s]:
+                    continue
+                tok = int(block[j, s])
+                if not self._outputs[rid]:
+                    self._first_token(rid, s)
+                else:
+                    self._lens[s] += 1
+                self._outputs[rid].append(tok)
+                self.stats.tokens += 1
+                n_emitted += 1
+                self._tokens[s] = tok
+                if (self.eos is not None and tok == self.eos) or \
+                        len(self._outputs[rid]) >= self.max_new:
+                    self._retire(s)
+                    break
+        dt = time.perf_counter() - t0
+        if step_times is not None:
+            step_times.append(dt)
+        if n_emitted:
+            self.stats.token_time_s.extend([dt / n_emitted] * n_emitted)
+
+    def _table_width(self, live, plan, inflight):
+        """Page-table columns this horizon can actually touch: the max
+        over live slots of the position bound it may read or write,
+        bucketed to a power of two (bounded compile count). Trailing
+        table entries hold only causally-masked pages — an EXACT
+        no-op in the ragged attention's online softmax (masked logits
+        underflow to p = 0.0 and never move the running max), so
+        slicing them off is bitwise-identical while making early
+        chunk ticks of a long prompt pay a SHORT gather instead of
+        the pool-capacity one (on TPU the kernel streams one page per
+        grid step anyway; on CPU the reference's gather width is the
+        mixed tick's dominant cost)."""
+        ps = self.d.page_size
+        bound = 1
+        for s, rid in live.items():
+            if self.scheduler.prefilling(s):
+                # suffix_left was already decremented by plan():
+                # positions consumed after this horizon, plus k emitted
+                # tokens if the prompt finishes inside it
+                pos = (self._prompt_len[s]
+                       - self.scheduler.suffix_left(s) + plan.k + 1)
+            else:
+                # NOT host _lens: it lags at the cached start until the
+                # first token is PROCESSED, while the device may already
+                # sit at prompt_len + in-flight emissions
+                pos = (self._prompt_len[s]
+                       + len(self._outputs.get(rid, ()))
+                       + inflight[s] + plan.k + 2)
+            bound = max(bound, pos)
+        need = min(self.d.max_pages, (bound + ps - 1) // ps + 1)
+        width = 1
+        while width < need:
+            width *= 2
+        return min(width, self.d.max_pages)
+
+    def _run_ragged(self, step_times=None, on_sync=None):
+        """Mixed-horizon drain: every scheduling round admits queued
+        prompts STRAIGHT into the device carry (prefix-cache mount +
+        page allocation only — no prefill dispatch, no prefill sync),
+        then dispatches one `ragged_multi` block of k ticks in which
+        decode rows emit a token per tick while prefilling rows
+        consume w prompt tokens per tick, and processes the PREVIOUS
+        block while the new one runs. One long prompt therefore
+        costs every other slot at most ceil(suffix/w) slightly-longer
+        ticks instead of one monolithic prefill stall — the
+        throughput-under-load lever the ROADMAP names. Retirement
+        keeps the one-horizon-delayed discipline of `_run_multi`
+        (pages freed exactly once, at block-processing time; shared
+        pages decref'd there, reusable only by later admissions whose
+        writes are device-ordered after every in-flight horizon)."""
+        S = self.d.max_batch
+        sched = self.scheduler
+        pending = None               # the in-flight horizon's meta
+        carry = None                 # (tokens, lens, done, rem, pend, pend_n)
+        inflight = [0] * S           # in-flight EMISSION ticks per slot
+        while (self._queue or pending is not None
+               or any(r is not None for r in self._slot_req)):
+            t0 = time.perf_counter()
+            plans = self._admit_ragged()
+            for slot, _, _ in plans:
+                # fresh request in a recycled slot: stale in-flight
+                # ticks belong to the PREVIOUS request (the rid check
+                # skips its tokens) and must not gate this one
+                inflight[slot] = 0
+            carry = self._merge_carry_ragged(carry, plans)
+            live = {s: self._slot_req[s] for s in range(S)
+                    if self._slot_req[s] is not None}
+            meta = None
+            plan = sched.plan(live,
+                              {s: self._budget_left(s) for s in live},
+                              inflight) if live else None
+            if plan is not None:
+                if self._table_cache is None:
+                    self._table_cache = self._table(self._slot_pages,
+                                                    self.d)
+                tokens_d, lens_d, done_d, rem_d, pend_d, pend_n_d = carry
+                width = self._table_width(live, plan, inflight)
+                out = self.d.ragged_multi(
+                    tokens_d, lens_d, self._table_cache[:, :width],
+                    plan.k, plan.w, pend_d, pend_n_d, kids=self._kids,
+                    done=done_d, remaining=rem_d, eos=self.eos)
+                carry = (out.tokens, out.lens, out.done, out.remaining,
+                         out.pend, out.pend_n)
+                self.steps += plan.k
+                self.stats.ticks += plan.k
+                self.stats.prefill_chunks += plan.n_chunks
+                self.stats.occupancy.append(len(live) / S)
+                for s, e in plan.emit_ticks.items():
+                    inflight[s] += e
+                self._sched_events.append(
+                    {"kind": "horizon", "k": plan.k, "w": plan.w,
+                     "decode_rows": len(live) - plan.prefill_rows,
+                     "prefill_rows": plan.prefill_rows})
+                meta = (out.tokens_block, out.emitted, plan.k,
+                        dict(live), plan.emit_ticks, t0)
+            if pending is not None:
+                self._process_ragged_block(pending, inflight, step_times)
+                if on_sync is not None:
+                    on_sync(self)
             pending = meta
         return dict(self._outputs)
 

@@ -55,6 +55,10 @@ class ServeStats:
     ticks: int = 0               # device decode ticks dispatched
     decode_syncs: int = 0        # host fetches of decode results
     prefill_syncs: int = 0       # host-blocking prefill rounds
+    prefill_stall_syncs: int = 0  # blocking prefills with decode slots
+    # live at dispatch time — the stall the ragged path eliminates
+    prefill_chunks: int = 0      # prompt chunks consumed inside horizons
+    prefill_chunk_tokens: int = 0  # prompt tokens streamed via chunks
     prefix_hits: int = 0         # cached full blocks mounted at admission
     prefix_misses: int = 0       # cacheable blocks that had to prefill
     prefix_evictions: int = 0    # refcount-0 pages evicted under pressure
@@ -90,6 +94,11 @@ class ServeStats:
              "decode_syncs": self.decode_syncs,
              "prefill_syncs": self.prefill_syncs,
              "host_syncs_per_token": round(self.host_syncs_per_token, 4)}
+        if self.prefill_stall_syncs:
+            d["prefill_stall_syncs"] = self.prefill_stall_syncs
+        if self.prefill_chunks:
+            d["prefill_chunks"] = self.prefill_chunks
+            d["prefill_chunk_tokens"] = self.prefill_chunk_tokens
         if self.prefix_hits or self.prefix_misses:
             d["prefix_hit_rate"] = round(self.prefix_hit_rate, 4)
             d["prefix_hits"] = self.prefix_hits

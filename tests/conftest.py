@@ -128,14 +128,45 @@ if _GC_TUNE:
     _gc.set_threshold(50_000, 25, 25)
 
 
+def _trim_compiled_memos():
+    """Per-module compiled-step cache retention (ROADMAP 'tier-1
+    wall-clock health'): live Trainer / PagedGPTDecoder instances keep
+    per-signature compiled-program memos (`_placed_steps`,
+    `_placed_multis`, fused decode loops, ...) that pin executables +
+    their jaxpr/HLO object graphs long after the module that built
+    them finished. Clearing them at module boundaries — right before
+    the collect+freeze below — lets the collector reclaim those
+    graphs instead of freezing them into permanent, process-lifetime
+    RSS. Anything genuinely still live just recompiles on its next
+    step; in practice trainers/decoders are module-scoped at most."""
+    import sys
+    for name, fn in (("paddle_tpu.distributed.trainer",
+                      "clear_compiled_step_memos"),
+                     ("paddle_tpu.serving.decoder",
+                      "clear_compiled_memos")):
+        mod = sys.modules.get(name)      # only if already imported —
+        if mod is None:                  # never force the import here
+            continue
+        try:
+            getattr(mod, fn)()
+        except Exception:
+            pass                         # keep the suite usable mid-bootstrap
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _refreeze_gc():
     """Re-freeze at module boundaries: anything the previous module left
     permanently cached (in-process compiled executables, baseline
     lowerings, dataset caches) stops being re-walked by every later
-    module's collections. Freezing survivors is safe — a frozen object
-    that later becomes garbage is simply reclaimed at process exit."""
+    module's collections. Before freezing, trim the compiled-step
+    memos of surviving trainers/decoders and collect — frozen objects
+    are excluded from every later collection, so garbage frozen here
+    would otherwise live (and pay RSS) until process exit. Freezing
+    true survivors stays safe as before."""
     if _GC_TUNE:
+        if not os.environ.get("PADDLE_TPU_NO_MEMO_TRIM"):   # A/B knob
+            _trim_compiled_memos()
+            _gc.collect()
         _gc.freeze()
     yield
 

@@ -565,6 +565,100 @@ def test_serving_rule_catches_host_callback_in_fused_loop():
     assert report2.metrics["serving"]["n_host_transfers"] == 0
 
 
+def test_prefill_stall_rule_audits_schedule_trace():
+    """SERVE-PREFILL-STALL planted defect: a scheduling trace whose
+    prompts all streamed in as horizon chunks (or whose only blocking
+    prefill found an idle batch) audits clean; a host-blocking prefill
+    dispatched while decode slots were live is the stall and an ERROR.
+    Without extra["serve_schedule"] the rule never fires."""
+    program = lower_callable(lambda x: x + 1.0,
+                             jnp.zeros((2,), jnp.float32), name="decode")
+    pm = PassManager(["prefill-stall"])
+    clean = [
+        {"kind": "horizon", "k": 4, "w": 8, "decode_rows": 1,
+         "prefill_rows": 1},
+        {"kind": "horizon", "k": 8, "w": 1, "decode_rows": 2,
+         "prefill_rows": 0},
+        # a blocking prefill into an EMPTY batch stalls nobody — the
+        # cold-start case every engine pays once
+        {"kind": "prefill_sync", "decode_active": 0, "rows": 2},
+    ]
+    report = pm.run(program, AnalysisContext(
+        name="s", extra={"serve_schedule": clean}))
+    assert report.by_rule("SERVE-PREFILL-STALL") == []
+    m = report.metrics["prefill-stall"]
+    assert m["checked"] and m["n_mixed_horizons"] == 1
+    assert m["n_stalled_prefill_syncs"] == 0
+
+    planted = clean + [{"kind": "prefill_sync", "decode_active": 3,
+                        "rows": 1}]
+    report2 = pm.run(program, AnalysisContext(
+        name="s", extra={"serve_schedule": planted}))
+    hits = report2.by_rule("SERVE-PREFILL-STALL")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "3 running decode slot" in hits[0].message
+    assert report2.metrics["prefill-stall"]["n_stalled_prefill_syncs"] == 1
+
+    # scope: no trace on the context -> not this rule's business
+    report3 = pm.run(program, AnalysisContext(name="s"))
+    assert report3.by_rule("SERVE-PREFILL-STALL") == []
+    assert report3.metrics["prefill-stall"] == {"checked": False}
+
+
+def test_prefill_stall_traces_from_real_engines():
+    """The engines emit the traces the rule audits: the dispatch-
+    separate baseline admitting a prompt while another slot decodes
+    logs a stalled prefill_sync (the rule fires on its trace); the
+    ragged engine's trace for the same workload has chunked horizons
+    and audits clean."""
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+
+    paddle.seed(3)
+    build_mesh(dp=1)
+    model = GPT(gpt_tiny(max_seq_len=64, dtype="float32", remat=False))
+    model.eval()
+    pm = PassManager(["prefill-stall"])
+    program = lower_callable(lambda x: x + 1.0,
+                             jnp.zeros((2,), jnp.float32), name="decode")
+
+    # the canonical stall, staged deterministically on the blocking
+    # path: one slot is mid-decode when a long prompt arrives and its
+    # whole prefill dispatches as ONE blocking forward
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2)
+    base = ContinuousBatchingEngine(dec, max_new_tokens=10, k_max=1)
+    base.submit(np.asarray([1, 2, 3], np.int32))
+    base.step()
+    base.step()                      # slot 0 decoding
+    base.submit(np.asarray(list(range(1, 25)), np.int32))
+    base.step()                      # blocking prefill, decode live
+    report = pm.run(program, AnalysisContext(
+        name="s", extra={"serve_schedule": base.serve_schedule()}))
+    assert report.by_rule("SERVE-PREFILL-STALL"), \
+        base.serve_schedule()
+    assert base.stats.prefill_stall_syncs >= 1
+
+    def run(ragged):
+        dec = PagedGPTDecoder(model, num_pages=16, page_size=16,
+                              max_batch=2)
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=10, k_max=4,
+                                       ragged=ragged, chunk_tokens=8)
+        for p in ([1, 2, 3], list(range(1, 25)), [7, 8]):
+            eng.submit(np.asarray(p, np.int32))
+        eng.run()
+        return eng
+
+    ragged = run(ragged=True)
+    report2 = pm.run(program, AnalysisContext(
+        name="s", extra={"serve_schedule": ragged.serve_schedule()}))
+    assert report2.by_rule("SERVE-PREFILL-STALL") == [], \
+        ragged.serve_schedule()
+    m = report2.metrics["prefill-stall"]
+    assert m["n_prefill_syncs"] == 0 and m["n_mixed_horizons"] >= 1
+    assert ragged.stats.prefill_syncs == 0
+    assert ragged.stats.prefill_stall_syncs == 0
+
+
 # ---------------------------------------------- fused multi-step training
 
 

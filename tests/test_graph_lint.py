@@ -112,6 +112,30 @@ def test_gpt_decode_program_is_device_resident(pass_manager):
     assert m["n_cache_args"] == 2          # k_pages + v_pages
 
 
+def test_gpt_decode_ragged_program_is_stall_free_and_device_resident(
+        pass_manager):
+    """The committed gpt_decode_ragged capture (mixed chunked-prefill +
+    decode horizon) has zero host transfers, a donated KV pool, a real
+    device loop — and its committed SCHEDULING TRACE (from a real
+    long-prompt-arrives-mid-stream workload) audits clean under
+    SERVE-PREFILL-STALL: prompts streamed in as horizon chunks, no
+    host-blocking prefill ever sat on the decode critical path."""
+    program, ctx, _ = lowered_program("gpt_decode_ragged")
+    report = pass_manager.run(program, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.by_rule("SERVE-PREFILL-STALL") == []
+    m = report.metrics["serving"]
+    assert m["checked"] and m["cache_donated"]
+    assert m["n_host_transfers"] == 0
+    assert m["n_device_loops"] >= 1
+    ps = report.metrics["prefill-stall"]
+    assert ps["checked"]
+    assert ps["n_prefill_syncs"] == 0           # nothing host-blocking
+    assert ps["n_stalled_prefill_syncs"] == 0
+    # the trace really came from a workload that mixed row kinds
+    assert ps["n_mixed_horizons"] >= 1 and ps["n_prefill_rows"] >= 1
+
+
 def test_gpt_decode_prefix_program_is_audited_and_device_resident(
         pass_manager):
     """The committed gpt_decode_prefix capture (chunked prefix-cache

@@ -374,3 +374,75 @@ def test_propagation_respects_contracted_dot_dims():
                                       arg_infos=infos_blind)
     # blind: output priced at 1/4 (inherited); dim-aware: full size
     assert est_tp.peak_bytes >= est_blind.peak_bytes + 3 * (64 * 64)
+
+
+def test_propagation_tracks_reshape_split_and_merge():
+    """Sharding propagation fidelity (reshape slice): a sharded dim's
+    factor follows its factor group through splits and merges when
+    divisibility holds, and falls back to the conservative cap (count
+    kept, dims dropped) when it doesn't — so dp/tp knowledge survives
+    the [B, S, H*D] <-> [B*S, H, D] reshapes between attention
+    matmuls instead of dying at the first reshape."""
+    from paddle_tpu.analysis.memory import (_reshape_dim_shards,
+                                            propagate_shard_counts)
+
+    # --- unit: the factor walk itself
+    # split: (32, 16) -> (8, 4, 16), dim0 sharded 4: 4 | 8 -> lands
+    # on the group's major dim
+    assert _reshape_dim_shards((32, 16), (4, 1), (8, 4, 16)) == \
+        (4, 1, 1)
+    # merge: (8, 4, 16) -> (32, 16) carries the MAJOR dim's factor
+    assert _reshape_dim_shards((8, 4, 16), (4, 1, 1), (32, 16)) == \
+        (4, 1)
+    # a factor on a MINOR dim of a merge group is a STRIDED pattern of
+    # the merged dim — pinning it to the major output dim would move
+    # shard knowledge to the wrong dimension (an anti-conservative
+    # memory underestimate): bail to the cap instead. Same for two
+    # sharded dims in one group (nested blocks, also strided).
+    assert _reshape_dim_shards((4, 8, 16), (1, 4, 1), (32, 16)) is None
+    assert _reshape_dim_shards((8, 4, 16), (2, 2, 1), (32, 16)) is None
+    # non-divisible split: 4-way factor cannot land on a size-2 major
+    # dim -> None (caller keeps the conservative cap)
+    assert _reshape_dim_shards((6, 16), (4, 1), (2, 3, 16)) is None
+    # trailing singleton dims carry nothing
+    assert _reshape_dim_shards((32, 16), (4, 1), (32, 16, 1)) == \
+        (4, 1, 1)
+
+    # --- through a jaxpr: split -> elementwise -> merge -> contract
+    def f(x, w):
+        y = x.reshape(8, 4, 16)          # split the dp dim
+        y = y + 1.0                      # dim knowledge must survive
+        z = y.reshape(32, 16)            # merge it back
+        return z @ w                     # contract the LAST dim
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((32, 16)), jnp.zeros((16, 8))).jaxpr
+    final = jx.outvars[0]
+    # dp on dim 0: factor rides split+merge and survives the dot
+    # (dim 0 is a free dim of the contraction)
+    dp = propagate_shard_counts(jx, arg_counts=[4, 1],
+                                arg_dims=[(4, 1), (1, 1)])
+    assert dp[final] == 4
+    # sharding on the CONTRACTED dim (dim 1): rides the reshapes, then
+    # correctly DROPS at the dot — the dim-aware answer the blind max
+    # heuristic can't give
+    tp = propagate_shard_counts(jx, arg_counts=[4, 4],
+                                arg_dims=[(1, 4), (4, 1)])
+    assert tp[final] == 1
+
+    # --- conservative fallback: a non-divisible split keeps the COUNT
+    # (max-operand cap) but drops dim knowledge, so the later dot
+    # inherits blindly instead of wrongly dropping
+    def g(x, w):
+        y = x.reshape(2, 3, 16)
+        z = y.reshape(6, 16)
+        return z @ w
+
+    jx2 = jax.make_jaxpr(g)(jnp.zeros((6, 16)), jnp.zeros((16, 8))).jaxpr
+    split_out = jx2.eqns[0].outvars[0]
+    # dim 0 (size 6) sharded 4 ways cannot split into (2, 3): the walk
+    # bails, dim knowledge is dropped — and with dims unknown even the
+    # later contraction inherits blindly (never wrongly drops)
+    cons = propagate_shard_counts(jx2, arg_counts=[4, 1],
+                                  arg_dims=[(4, 1), (1, 1)])
+    assert cons[split_out] == 4          # count kept (safe direction)
+    assert cons[jx2.outvars[0]] == 4     # blind inherit at the dot

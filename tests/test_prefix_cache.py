@@ -222,6 +222,26 @@ def test_full_prompt_hit_triggers_cow(tiny_model):
     assert _pages_balanced(eng)
 
 
+def test_full_prompt_hit_cow_through_ragged_horizon(tiny_model):
+    """Full-prompt hit on the RAGGED path (k_max>1): admission mounts
+    every block, CoWs the last page, and streams the single
+    re-consumed token through the horizon as a 1-token chunk — output
+    golden, ledger clean, no blocking prefill sync."""
+    prompt = list(range(1, 33))            # exactly two pages
+    dec, eng = _engine(tiny_model, k_max=4)
+    golden = _golden_greedy(tiny_model, prompt, 6)
+    r1 = eng.submit(np.asarray(prompt, np.int32))
+    assert eng.run()[r1] == golden
+    r2 = eng.submit(np.asarray(prompt, np.int32))
+    assert eng.run()[r2] == golden
+    s = eng.stats
+    assert s.prefix_cow == 1
+    assert s.prefix_tokens_saved == 31     # L-1: one token re-consumed
+    assert s.prefill_syncs == 0            # ragged: chunks only
+    assert s.prefill_chunk_tokens == len(prompt) + 1
+    assert _pages_balanced(eng)
+
+
 def test_eviction_under_pool_pressure(tiny_model):
     """A pool too small to keep old prefixes cached: admission evicts
     parked refcount-0 pages (never referenced ones), correctness

@@ -1,0 +1,152 @@
+"""The ragged paged attention primitive (ops/ragged_paged_attention):
+semantics against a direct-softmax oracle, and the jnp reference
+pinned BIT-IDENTICAL to the interpret-mode Pallas kernel — including
+every degenerate row shape the serving engine can produce (all-decode,
+all-prefill, single row, page-exact chunks, zero-length suffixes)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.ragged_paged_attention import ragged_paged_attention
+
+
+def _pool(rng, P, ps, H, D):
+    kp = jnp.asarray(rng.randn(P, ps, H, D).astype(np.float32))
+    vp = jnp.asarray(rng.randn(P, ps, H, D).astype(np.float32))
+    return kp, vp
+
+
+def _oracle(q, kp, vp, table, start, scale=None):
+    """Direct masked softmax per (row, query, head) — the semantics the
+    online-softmax accumulation must reproduce."""
+    q, kp, vp, table = map(np.asarray, (q, kp, vp, table))
+    n, W, H, D = q.shape
+    ps = kp.shape[1]
+    MP = table.shape[1]
+    scale = scale or 1.0 / np.sqrt(D)
+    kg = kp[np.maximum(table, 0)].reshape(n, MP * ps, H, D)
+    vg = vp[np.maximum(table, 0)].reshape(n, MP * ps, H, D)
+    out = np.zeros_like(q)
+    for i in range(n):
+        for w in range(W):
+            pos = int(start[i]) + w
+            for h in range(H):
+                s = (q[i, w, h] * scale) @ kg[i, :, h].T
+                s[np.arange(MP * ps) > pos] = -1e30
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[i, w, h] = p @ vg[i, :, h]
+    return out
+
+
+def _both(q, kp, vp, table, start):
+    ref = np.asarray(ragged_paged_attention(q, kp, vp, table, start))
+    ker = np.asarray(ragged_paged_attention(q, kp, vp, table, start,
+                                            use_kernel=True))
+    return ref, ker
+
+
+def test_matches_direct_softmax_oracle():
+    rng = np.random.RandomState(0)
+    n, W, H, D, P, ps, MP = 3, 4, 2, 8, 12, 4, 6
+    kp, vp = _pool(rng, P, ps, H, D)
+    q = jnp.asarray(rng.randn(n, W, H, D).astype(np.float32))
+    table = jnp.asarray(rng.randint(0, P, (n, MP)).astype(np.int32))
+    start = jnp.asarray([0, 5, 13], jnp.int32)
+    ref, ker = _both(q, kp, vp, table, start)
+    np.testing.assert_allclose(
+        ref, _oracle(q, kp, vp, table, start), atol=1e-5)
+    assert np.array_equal(ref, ker), "kernel != reference bit-for-bit"
+
+
+# Degenerate row shapes, each pinned ref == interpret-kernel BIT-FOR-BIT
+# (the serving equivalence guarantees ride on the two paths never
+# diverging): all-decode (every row W=1 — the pure decode tick),
+# all-prefill (every row a full W chunk), a single row, a chunk exactly
+# filling a page (W == page_size, page-aligned start), and a
+# zero-length uncached suffix (full prefix hit: the row's queries are
+# ALL padding — row-local garbage, but identical garbage on both
+# paths).
+@pytest.mark.parametrize("case", ["all_decode", "all_prefill",
+                                  "single_row", "page_exact",
+                                  "zero_suffix"])
+def test_degenerate_shapes_bit_identical(case):
+    import zlib
+    # crc32, not hash(): PYTHONHASHSEED would randomize the data per
+    # process and make any failure unreproducible
+    rng = np.random.RandomState(zlib.crc32(case.encode()) % (2 ** 31))
+    H, D, P, ps, MP = 2, 8, 10, 4, 5
+    kp, vp = _pool(rng, P, ps, H, D)
+
+    if case == "all_decode":
+        n, W = 4, 1
+        start = [3, 0, 11, 7]
+    elif case == "all_prefill":
+        n, W = 3, 8
+        start = [0, 4, 8]
+    elif case == "single_row":
+        n, W = 1, 4
+        start = [6]
+    elif case == "page_exact":
+        n, W = 2, ps                 # chunk exactly fills one page
+        start = [0, ps]              # page-aligned starts
+    else:                            # zero_suffix: full prefix hit —
+        n, W = 2, 4                  # row 1's window is pure padding
+        start = [2, 17]
+    q = jnp.asarray(rng.randn(n, W, H, D).astype(np.float32))
+    table = jnp.asarray(rng.randint(0, P, (n, MP)).astype(np.int32))
+    start = jnp.asarray(start, jnp.int32)
+    ref, ker = _both(q, kp, vp, table, start)
+    assert np.array_equal(ref, ker), case
+    assert np.isfinite(ref).all(), case
+    # real (non-padding) queries also match the direct-softmax oracle
+    oracle = _oracle(q, kp, vp, table, start)
+    valid = np.asarray(start)[:, None] + np.arange(W)[None, :] < MP * ps
+    np.testing.assert_allclose(np.where(valid[..., None, None], ref, 0),
+                               np.where(valid[..., None, None], oracle,
+                                        0), atol=1e-5)
+
+
+def test_decode_row_equals_chunk_row_per_position():
+    """Schedule independence, the property the engine equivalences ride
+    on: position p computed as a W=1 decode window equals position p
+    computed inside a wider chunk window, bit for bit (queries are
+    row-local; the page loop is identical)."""
+    rng = np.random.RandomState(7)
+    H, D, P, ps, MP = 2, 8, 10, 4, 5
+    kp, vp = _pool(rng, P, ps, H, D)
+    table = jnp.asarray(rng.randint(0, P, (1, MP)).astype(np.int32))
+    W = 4
+    qw = jnp.asarray(rng.randn(1, W, H, D).astype(np.float32))
+    start = 6
+    chunk = np.asarray(ragged_paged_attention(
+        qw, kp, vp, table, jnp.asarray([start], jnp.int32)))
+    for j in range(W):
+        one = np.asarray(ragged_paged_attention(
+            qw[:, j:j + 1], kp, vp, table,
+            jnp.asarray([start + j], jnp.int32)))
+        assert np.array_equal(one[0, 0], chunk[0, j]), j
+
+
+def test_kernel_scalar_prefetch_routes_pages():
+    """The kernel reads pages THROUGH the prefetched table: permuting
+    the pool while permuting the table identically leaves the output
+    unchanged (the page indirection really is honored)."""
+    rng = np.random.RandomState(9)
+    H, D, P, ps, MP = 2, 8, 8, 4, 4
+    kp, vp = _pool(rng, P, ps, H, D)
+    q = jnp.asarray(rng.randn(2, 2, H, D).astype(np.float32))
+    table = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7]], jnp.int32)
+    start = jnp.asarray([5, 9], jnp.int32)
+    base = np.asarray(ragged_paged_attention(q, kp, vp, table, start,
+                                             use_kernel=True))
+    perm = np.asarray([3, 5, 7, 1, 0, 2, 4, 6])
+    inv = np.argsort(perm)
+    kp2 = jnp.asarray(np.asarray(kp)[perm])
+    vp2 = jnp.asarray(np.asarray(vp)[perm])
+    table2 = jnp.asarray(inv[np.asarray(table)].astype(np.int32))
+    moved = np.asarray(ragged_paged_attention(q, kp2, vp2, table2, start,
+                                              use_kernel=True))
+    np.testing.assert_array_equal(base, moved)

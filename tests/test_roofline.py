@@ -171,6 +171,76 @@ class TestDecodeHorizon:
         assert 1e-6 <= s < 1.0
         assert measured_host_sync_s() == s        # memoized
 
+
+class TestRaggedTick:
+    """cost_model.ragged_tick_roofline_s / ragged_chunk_tokens /
+    the chunk-aware decode_horizon: pricing mixed chunked-prefill +
+    decode ticks."""
+
+    def test_mixed_tick_is_max_of_legs(self):
+        from paddle_tpu.cost_model import (chip_spec,
+                                           decode_tick_roofline_s,
+                                           ragged_tick_roofline_s)
+        chip = chip_spec("v5e")
+        b = int(1e-3 * chip.hbm_bw)          # 1 ms HBM leg
+        # no chunk: exactly the decode tick roofline
+        assert ragged_tick_roofline_s(b, 0, 0, chip=chip) == \
+            decode_tick_roofline_s(b, chip=chip)
+        # a chunk hiding under the HBM leg adds NOTHING (why chunked
+        # prefill rides 'free' in an HBM-bound tick)
+        f = 2.6e9
+        per_tok = f / (chip.peak_flops * 0.65)
+        w_free = int(0.5e-3 / per_tok)
+        assert ragged_tick_roofline_s(b, w_free, f, chip=chip) == \
+            decode_tick_roofline_s(b, chip=chip)
+        # past the crossover the tick goes compute-bound, linear in W
+        w_heavy = int(4e-3 / per_tok)
+        t = ragged_tick_roofline_s(b, w_heavy, f, chip=chip)
+        assert t == pytest.approx(w_heavy * per_tok)
+        assert ragged_tick_roofline_s(b, 2 * w_heavy, f, chip=chip) == \
+            pytest.approx(2 * t)
+
+    def test_chunk_budget_hides_under_hbm_leg(self):
+        from paddle_tpu.cost_model import (chip_spec,
+                                           decode_tick_roofline_s,
+                                           ragged_chunk_tokens,
+                                           ragged_tick_roofline_s)
+        chip = chip_spec("v5e")
+        b = int(1e-3 * chip.hbm_bw)
+        f = 2.6e9                             # ~1.3B prompt token
+        w = ragged_chunk_tokens(b, f, chip=chip, cap=1 << 14)
+        assert w & (w - 1) == 0               # power of two
+        # the budgeted chunk is free; doubling it would not be
+        assert ragged_tick_roofline_s(b, w, f, chip=chip) == \
+            decode_tick_roofline_s(b, chip=chip)
+        assert ragged_tick_roofline_s(b, 2 * w, f, chip=chip) > \
+            decode_tick_roofline_s(b, chip=chip)
+
+    def test_chunk_budget_clamps(self):
+        from paddle_tpu.cost_model import ragged_chunk_tokens
+        # zero flops (degenerate): everything hides -> the cap
+        assert ragged_chunk_tokens(10**9, 0.0, chip="v5e", cap=256) == 256
+        # compute-tight model: floor keeps prompts progressing
+        assert ragged_chunk_tokens(10**3, 1e12, chip="v5e",
+                                   floor=8) == 8
+
+    def test_decode_horizon_is_chunk_aware(self):
+        """A mixed tick is never shorter than a pure decode tick, so
+        the priced K with a chunk budget is <= the pure-decode K —
+        and equal while the chunk hides under the HBM leg."""
+        from paddle_tpu.cost_model import chip_spec, decode_horizon
+        chip = chip_spec("v5e")
+        b = int(1e-3 * chip.hbm_bw)
+        f = 2.6e9
+        pure = decode_horizon(b, host_sync_s=1e-3, chip=chip)
+        free = decode_horizon(b, host_sync_s=1e-3, chip=chip,
+                              chunk_tokens=16, flops_per_token=f)
+        heavy = decode_horizon(b, host_sync_s=1e-3, chip=chip,
+                               chunk_tokens=1 << 16,
+                               flops_per_token=f)
+        assert free == pure == 10
+        assert heavy < pure
+
     def test_engine_defaults_to_priced_horizon(self):
         """ContinuousBatchingEngine with no k_max asks decode_horizon;
         on a CPU dev box the tiny decoder's tick roofline is far below

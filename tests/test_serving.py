@@ -71,19 +71,25 @@ def test_prefill_batches_same_bucket_admissions(tiny_model):
 
 
 def test_eos_at_prefill_finishes_immediately(tiny_model):
-    """A prompt whose first greedy token is EOS must not burn decode
-    ticks or hold a slot."""
-    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
-                          max_batch=1)
+    """A prompt whose first greedy token is EOS emits exactly [eos] and
+    frees its pages. On the legacy per-tick path it never occupies a
+    decode slot (zero ticks); on the ragged path its prompt rides the
+    horizon — the EOS freezes the slot ON DEVICE, so later ticks are
+    filler and no token past the EOS ever reaches the output."""
     prompt = [3, 141, 59]
     eos = _golden_greedy(tiny_model, prompt, 1)[0]
-    eng = ContinuousBatchingEngine(dec, eos_token_id=eos,
-                                   max_new_tokens=16)
-    rid = eng.submit(np.asarray(prompt, np.int32))
-    outs = eng.run()
-    assert outs[rid] == [eos]
-    assert eng.steps == 0
-    assert len(eng._free) == dec.num_pages - 1
+    for k_max in (1, 8):
+        dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                              max_batch=1)
+        eng = ContinuousBatchingEngine(dec, eos_token_id=eos,
+                                       max_new_tokens=16, k_max=k_max)
+        rid = eng.submit(np.asarray(prompt, np.int32))
+        outs = eng.run()
+        assert outs[rid] == [eos]
+        assert eng.stats.tokens == 1
+        if k_max == 1:
+            assert eng.steps == 0
+        assert len(eng._free) == dec.num_pages - 1
 
 
 def test_engine_rejects_oversized_request(tiny_model):
@@ -636,8 +642,15 @@ def test_serve_stats_front_door(tiny_model):
     assert summaries, debug.serving_stats()
     s = summaries[-1]
     assert s["completed"] == 1 and s["tokens"] == 9
-    assert s["prefill_syncs"] == 1
-    assert 0 < s["host_syncs_per_token"] <= 1 / 4 + 1e-9
+    # ragged scheduling: the prompt streamed into the horizon as
+    # chunks — ZERO host-blocking prefill syncs on the decode path
+    assert s["prefill_syncs"] == 0
+    assert s["prefill_chunks"] >= 1
+    assert s["prefill_chunk_tokens"] == 3
+    # total host syncs no worse than the legacy split (1 prefill +
+    # ceil(8/4) decode): the first-token horizon replaced the prefill
+    assert s["decode_syncs"] + s["prefill_syncs"] <= 3
+    assert 0 < s["host_syncs_per_token"] <= 1 / 3 + 1e-9
     assert s["tokens_per_sec"] > 0
     assert s["token_p50_ms"] <= s["token_p99_ms"]
     assert 0 < s["mean_slot_occupancy"] <= 1
@@ -648,6 +661,146 @@ def test_serve_stats_front_door(tiny_model):
     assert not [s for s in debug.serving_stats()
                 if s["engine"] == "ContinuousBatchingEngine"
                 and s["k_max"] == 4 and s["requests"] == 1]
+
+
+# --------------------------------------------------------------------------
+# Ragged serving: mixed chunked-prefill + decode horizons
+# --------------------------------------------------------------------------
+
+def _stream(model, prompts, max_new, eos=None, dec_kw=None, **eng_kw):
+    dec = PagedGPTDecoder(model, num_pages=48, page_size=16,
+                          max_batch=2, **(dec_kw or {}))
+    eng = ContinuousBatchingEngine(dec, eos_token_id=eos,
+                                   max_new_tokens=max_new, **eng_kw)
+    rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+    res = eng.run()
+    assert len(eng._free) == dec.num_pages - 1, "page leak"
+    return [res[r] for r in rids], eng
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_ragged_streams_byte_identical_under_churn(tiny_model, seed):
+    """THE ragged acceptance bar: under randomized admission churn
+    (sampled config + EOS retirement + more requests than slots,
+    prompts long enough to chunk), the ragged engine's per-request
+    streams are byte-identical to the per-tick engine's AND to the
+    dispatch-separate (blocking-prefill) baseline's at k_max in
+    {4, 8} — chunking a prompt across horizon boundaries must not
+    shift a single draw (keys are (seed, request id, position);
+    per-position math is window-independent)."""
+    rng = np.random.RandomState(400 + seed)
+    V = tiny_model.cfg.vocab_size
+    prompts = [list(rng.randint(0, V, rng.randint(1, 40)).astype(int))
+               for _ in range(4)]
+    eos = int(rng.randint(0, V))
+    max_new = int(rng.randint(3, 14))
+    dec_kw = dict(temperature=0.8, top_k=40, seed=11)
+    base, _ = _stream(tiny_model, prompts, max_new, eos, dec_kw, k_max=1)
+    for k_max in (4, 8):
+        blocking, _ = _stream(tiny_model, prompts, max_new, eos, dec_kw,
+                              k_max=k_max, ragged=False)
+        assert blocking == base, (seed, k_max, "blocking")
+        ragged, eng = _stream(tiny_model, prompts, max_new, eos, dec_kw,
+                              k_max=k_max, chunk_tokens=8)
+        assert ragged == base, (seed, k_max, "ragged")
+        assert eng.stats.prefill_syncs == 0
+        assert eng.stats.prefill_chunk_tokens > 0
+
+
+def test_ragged_greedy_matches_dense_golden(tiny_model):
+    """A long prompt split over several chunk ticks emits exactly the
+    dense model's greedy continuation, while a short prompt decodes
+    alongside it in the same horizons (mixed rows end to end)."""
+    long_p = list(range(1, 41))              # ceil(40/8) = 5 chunks
+    short_p = [3, 141, 59]
+    outs, eng = _stream(tiny_model, [long_p, short_p], 8, k_max=4,
+                        chunk_tokens=8)
+    assert outs[0] == _golden_greedy(tiny_model, long_p, 8)
+    assert outs[1] == _golden_greedy(tiny_model, short_p, 8)
+    s = eng.stats
+    assert s.prefill_syncs == 0 and s.prefill_stall_syncs == 0
+    assert s.prefill_chunks >= 5
+    assert s.prefill_chunk_tokens == len(long_p) + len(short_p)
+    # the trace really interleaved prefill rows with decode rows
+    assert any(ev["kind"] == "horizon" and ev["prefill_rows"]
+               and ev["decode_rows"] for ev in eng.serve_schedule())
+
+
+def test_ragged_ttft_measures_submit_to_first_token(tiny_model):
+    """Regression (TTFT window): chunked admission spreads one
+    request's prefill over several horizon boundaries — ttft_s must
+    stamp ONCE per request at its first token (there is no prefill
+    sync to stamp at), so chunked and legacy engines report comparable
+    TTFT."""
+    prompts = [list(range(1, 41)), [5, 6, 7]]
+    outs, eng = _stream(tiny_model, prompts, 4, k_max=4, chunk_tokens=8)
+    s = eng.stats
+    assert len(s.ttft_s) == len(prompts)     # exactly one stamp each
+    assert all(t > 0 for t in s.ttft_s)
+    assert s.prefill_syncs == 0
+    assert not eng._submit_t                 # drained at first tokens
+    assert s.summary()["ttft_p50_ms"] > 0
+    # legacy engine, same workload: also one stamp per request, taken
+    # at the same milestone (its first token exists at prefill-sync
+    # time) — the two engines' TTFT windows are comparable
+    outs2, eng2 = _stream(tiny_model, prompts, 4, k_max=1)
+    assert len(eng2.stats.ttft_s) == len(prompts)
+    assert not eng2._submit_t
+    assert outs2 == outs
+
+
+def test_explicit_ragged_honored_at_k_max_one(tiny_model):
+    """Review regression: ContinuousBatchingEngine(ragged=True) must
+    engage chunked no-stall admission even when k_max prices to 1 (big
+    models legitimately price K=1) — silently downgrading to the
+    blocking per-tick loop would betray the explicit opt-in."""
+    prompts = [list(range(1, 41)), [5, 6, 7]]
+    outs, eng = _stream(tiny_model, prompts, 5, k_max=1, ragged=True,
+                        chunk_tokens=8)
+    assert eng.ragged and eng.scheduler is not None
+    assert eng.stats.prefill_syncs == 0          # no blocking prefill
+    assert eng.stats.prefill_chunks >= 5
+    # same streams as the default per-tick engine
+    tick, _ = _stream(tiny_model, prompts, 5, k_max=1)
+    assert outs == tick
+
+
+def test_scheduler_chunk_budget_never_exceeded(tiny_model):
+    """Review regression: a non-power-of-two chunk_tokens must bound
+    the dispatched width from BELOW (normalized down to pow2) — plan()
+    buckets widths to powers of two, and rounding UP would exceed the
+    per-tick token budget the parameter exists to cap."""
+    from paddle_tpu.serving import RaggedScheduler
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2)
+    sched = RaggedScheduler(dec, chunk_tokens=6)
+    assert sched.chunk_tokens == 4
+    sched.admit(0, 40)
+    plan = sched.plan({0: 0}, {0: 8}, [0, 0])
+    assert plan.w <= 4
+
+
+def test_no_live_references_to_deleted_prefill_buckets():
+    """The flash length-bucketed prefill is deleted (ALL prefill runs
+    through the ragged body): no live source may still reference the
+    old entry points (CHANGES.md history exempt)."""
+    import pathlib
+    import re as _re
+    root = pathlib.Path(__file__).resolve().parent.parent
+    # built by concatenation so this test file doesn't match itself
+    dead = ["_prefill" + "_fn", "_prefill" + "s"]
+    offenders = []
+    files = [root / "bench.py"]
+    for sub in ("paddle_tpu", "examples", "tests", "docs"):
+        files.extend((root / sub).rglob("*"))
+    for p in files:
+        if p.suffix not in (".py", ".md") or "__pycache__" in str(p):
+            continue
+        text = p.read_text(errors="ignore")
+        for name in dead:
+            if _re.search(rf"(?<![\w.]){_re.escape(name)}\b", text):
+                offenders.append(f"{p.relative_to(root)}: {name}")
+    assert offenders == [], offenders
 
 
 @pytest.mark.parametrize("seed", range(5))
