@@ -772,6 +772,108 @@ def run_ragged_stall(gen=48, long_prompt=448, chunk=16, k_max=2):
     return row
 
 
+def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
+    """Concurrent-slot capacity at a fixed per-token p99: bf16 vs int8
+    KV pool.  Decode is HBM-bound, so at a per-token latency SLO the
+    admissible slot count is set by how many KV byte-streams fit under
+    the tick budget: slots = (p99·BW − weight_bytes) / ctx·kv_bytes_tok.
+    The SLO is anchored at the BF16 pool's tick with `p99_batch` slots
+    at avg_ctx = max_seq/2 (the KV-bound operating point — each slot's
+    prefix, not the weights, dominates the stream), so the bf16 column
+    reads back ~p99_batch and the int8 column shows the capacity the
+    halved KV stream buys under the SAME SLO.  Priced on the v5e chip
+    spec (`PagedGPTDecoder.step_hbm_bytes(batch=...)` — deterministic,
+    CPU-runnable); the measured half runs both pools through a real
+    tiny-GPT engine for tokens/s (CPU numbers carry dispatch overhead,
+    the committed evidence is the SLOTS ratio like the other serving
+    scenarios' ratios)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.cost_model import chip_spec
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+    from paddle_tpu.serving.decoder import pool_token_bytes
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    # the PRICED half needs only shapes: the decoder's own byte model
+    # (serving.decoder.pool_token_bytes — the ONE definition behind
+    # step_hbm_bytes/kv_token_bytes) applied to the big config, so the
+    # bench prices exactly what the decoder would report without
+    # building a 1.3B model on the host
+    cfg_big = getattr(gpt_mod, model_scale)(max_seq_len=2048)
+    cfg = gpt_tiny(max_seq_len=128, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    chip = chip_spec()
+    avg_ctx = cfg_big.max_seq_len // 2
+    w_bytes = cfg_big.num_params() * 2   # bf16 weights (the a8w8/w4a16
+    # weight legs compose orthogonally; the KV pool is this scenario)
+    kv16 = cfg_big.num_layers * avg_ctx * pool_token_bytes(cfg_big)
+    kv8 = cfg_big.num_layers * avg_ctx * pool_token_bytes(
+        cfg_big, kv_quant="int8")
+    # the fixed SLO: the bf16 pool's tick with p99_batch slots. Slots
+    # are recovered in INTEGER byte arithmetic (a float divide/multiply
+    # round-trip through p99_s can floor the bf16 column to
+    # p99_batch-1 and silently flatter the ratio); p99_s is reporting
+    # only.
+    budget_bytes = w_bytes + p99_batch * kv16
+    p99_s = budget_bytes / chip.hbm_bw
+    slots = {"bf16": (budget_bytes - w_bytes) // kv16,
+             "int8": (budget_bytes - w_bytes) // kv8}
+    assert slots["bf16"] == p99_batch
+    ratio = slots["int8"] / max(slots["bf16"], 1)
+    dec16 = PagedGPTDecoder(model, num_pages=32, page_size=16,
+                            max_batch=2)
+    dec8 = PagedGPTDecoder(model, num_pages=32, page_size=16,
+                           max_batch=2, kv_quant="int8")
+
+    # measured half: both pools through a real engine (tiny GPT, CPU)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, 12).astype(np.int32)
+               for _ in range(4)]
+    tok_s = {}
+    for name, dec in (("bf16", dec16), ("int8", dec8)):
+        def run_once():
+            eng = ContinuousBatchingEngine(dec, max_new_tokens=gen,
+                                           k_max=8)
+            for p in prompts:
+                eng.submit(p)
+            t0 = time.time()
+            outs = eng.run()
+            dt = time.time() - t0
+            return sum(len(v) for v in outs.values()) / dt, eng
+        run_once()                       # warm the compiles
+        tok_s[name], _ = run_once()
+    row = {"slots_bf16": slots["bf16"], "slots_int8": slots["int8"],
+           "slots_ratio": round(ratio, 2),
+           "p99_budget_ms": round(p99_s * 1e3, 3),
+           "avg_ctx": avg_ctx, "model": model_scale,
+           # KV bytes one context token costs across ALL layers (the
+           # ServeStats.kv_bytes_per_token view at cfg_big shapes)
+           "kv_bytes_per_token_bf16": kv16 // avg_ctx,
+           "kv_bytes_per_token_int8": kv8 // avg_ctx,
+           # measured on the tiny-GPT engines only — keep tiny-scale
+           # stats (pool bytes, resident slots) OUT of this row: every
+           # other field describes cfg_big shapes, and mixing scales
+           # invites misreading (debug.serving_stats() has them live)
+           "measured_tok_s_bf16": round(tok_s["bf16"], 1),
+           "measured_tok_s_int8": round(tok_s["int8"], 1)}
+    log(f"decode_capacity[{model_scale}]: {slots['bf16']} -> "
+        f"{slots['int8']} slots ({ratio:.2f}x) at p99 "
+        f"{p99_s*1e3:.2f} ms, avg_ctx={avg_ctx} (KV "
+        f"{row['kv_bytes_per_token_bf16']} -> "
+        f"{row['kv_bytes_per_token_int8']} B/token; measured tiny-GPT "
+        f"{tok_s['bf16']:.0f} vs {tok_s['int8']:.0f} tok/s on this host)")
+    print(json.dumps({"metric": "gpt_decode_capacity",
+                      "value": slots["int8"], "unit": "slots",
+                      **row}), flush=True)
+    return row
+
+
 def run_train_multi(steps=48, n=None):
     """Multi-step TRAINING throughput: the per-step Trainer.step loop vs
     the fused `step_multi` scan (N steps, one dispatch, losses drained at
@@ -1270,6 +1372,12 @@ def main():
                 extras["speculative"] = run_speculative()
         except Exception as e:
             _record_failure(extras, "speculative_error", "speculative", e)
+    if only in (None, "decode", "capacity"):
+        try:
+            with _alarm(600, "decode_capacity"):
+                extras["decode_capacity"] = run_decode_capacity()
+        except Exception as e:
+            _record_failure(extras, "decode_capacity_error", "capacity", e)
     if only in (None, "decode", "prefix"):
         try:
             with _alarm(600, "prefix_cache"):

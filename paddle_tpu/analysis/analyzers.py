@@ -15,8 +15,8 @@ from .pass_manager import Analyzer, register_analyzer
 
 __all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
            "GraphShapeAnalyzer", "CollectiveAnalyzer", "ServingAnalyzer",
-           "PrefillStallAnalyzer", "TrainingAnalyzer", "COLLECTIVE_OPS",
-           "MXU_OPS"]
+           "PrefillStallAnalyzer", "TrainingAnalyzer", "KvQuantAnalyzer",
+           "COLLECTIVE_OPS", "MXU_OPS"]
 
 MXU_OPS = ("dot_general", "convolution")
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
@@ -115,6 +115,88 @@ class DtypeAnalyzer(Analyzer):
                     "keep f32 only on the accumulation output"))
         self.metrics = {"n_mxu_ops": len(mxu), "n_f32_mxu_ops": n_f32,
                         "policy_dtype": ctx.policy_dtype}
+        return findings
+
+
+@register_analyzer
+class KvQuantAnalyzer(Analyzer):
+    """Quantized-KV-pool discipline for serving programs (context
+    extra["kv_quant"] set, e.g. the `gpt_decode_kv8` PROGRAM config).
+    Two rules:
+
+    DTYPE-KV-SCALE-WIDTH — every floating cache argument (the per-token
+    scale planes riding next to the int8 page bytes) must be exactly
+    f32: f64 doubles the metadata byte stream and has no TPU path, and
+    a sub-f32 plane quantizes the scales themselves (the write-time
+    amax discipline prices 4 bytes/token/layer per plane, no more, no
+    less).
+
+    DTYPE-KV-DEQUANT-HBM — the dequantized pool must never materialize
+    in HBM: the whole point of the int8 pool is that the decode tick
+    streams int8 bytes + scale planes, with dequant happening on the
+    page-sized working set inside the attention body
+    (`ops.ragged_paged_attention._page_update`). A stablehlo `convert`
+    whose int8 operand is at least one full pool tensor
+    (extra["kv_pool_block_elems"] elements, the per-layer
+    [P, ps, H, D] block) re-inflates the stream to bf16/f32 width and
+    erases the capacity win before the first page is read."""
+    name = "kv-quant"
+
+    def run(self, program, ctx):
+        quant = ctx.extra.get("kv_quant")
+        if not quant:
+            self.metrics = {"checked": False}
+            return []
+        findings = []
+        from .memory import kv_cache_infos
+        cache = kv_cache_infos(getattr(program, "arg_infos", None) or [])
+        n_scales = n_bad_scales = 0
+        for info in cache:
+            dt = str(info.dtype)
+            if "float" not in dt:            # ("bfloat16" matches too)
+                continue                     # int8 page bytes
+            n_scales += 1
+            if dt not in ("float32", "f32"):
+                n_bad_scales += 1
+                findings.append(Finding(
+                    "DTYPE-KV-SCALE-WIDTH", Severity.ERROR,
+                    f"KV scale plane {info.name} is {dt}, not float32 "
+                    "— f64 doubles the per-token metadata bytes (and "
+                    "has no TPU path); narrower floats quantize the "
+                    "scales themselves",
+                    suggested_fix="store write-time scales as f32 "
+                    "(serving.decoder._quantize_kv does)"))
+        thresh = int(ctx.extra.get("kv_pool_block_elems") or 0)
+        n_dequant = 0
+        if thresh:
+            from .lowering import tensor_type_bytes
+            for op in program.ops_named("convert"):
+                src = (op.operand_types or [""])[0]
+                if not re.search(r"(?:^|x)i8>?\s*$", src):
+                    continue
+                dst = (op.result_types or [""])[0]
+                if not re.search(r"(?:^|x)(f32|f64|bf16|f16)>?\s*$", dst):
+                    continue
+                # i8 itemsize is 1, so bytes == element count
+                if tensor_type_bytes(src) >= thresh:
+                    n_dequant += 1
+                    findings.append(Finding(
+                        "DTYPE-KV-DEQUANT-HBM", Severity.ERROR,
+                        f"full-pool dequantization materialized in HBM "
+                        f"({tensor_type_bytes(src)} int8 elements "
+                        "converted to a wide float tensor) — the int8 "
+                        "pool's halved byte stream is erased before "
+                        "the attention reads a single page",
+                        op=op.line,
+                        suggested_fix="dequantize inside the shared "
+                        "per-page update "
+                        "(ops.ragged_paged_attention._page_update); "
+                        "the pool must stay int8 end to end"))
+        self.metrics = {"checked": True, "kv_quant": quant,
+                        "n_cache_args": len(cache),
+                        "n_scale_planes": n_scales,
+                        "n_bad_scale_planes": n_bad_scales,
+                        "n_pool_dequants": n_dequant}
         return findings
 
 

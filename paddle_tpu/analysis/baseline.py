@@ -279,6 +279,57 @@ def _gpt_decode_ragged():
     return program, ctx, PagedGPTDecoder._ragged_multi_step
 
 
+def _gpt_decode_kv8():
+    """The INT8-KV serving config: the fused K=4 decode loop over an
+    int8 KV pool with per-token f32 scale planes (`kv_quant="int8"` —
+    the pool's byte stream behind the decode roofline halves, which is
+    what `step_hbm_bytes`/`decode_horizon` re-price). Gated by the
+    serving rules gpt_decode carries (SERVE-HOST-SYNC-DECODE: zero host
+    transfers, donated pool — now FOUR cache leaves: pages + scale
+    planes for K and V), by the new kv-quant rules
+    (DTYPE-KV-SCALE-WIDTH: scale planes exactly f32;
+    DTYPE-KV-DEQUANT-HBM: no full-pool dequantization materialized in
+    HBM — dequant stays inside the shared per-page attention update),
+    and by MEM-PAGE-REFCOUNT over a page ledger committed from a real
+    shared-prefix int8 workload including a full-hit copy-on-write
+    (CoW moves page bytes AND scale rows together)."""
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedGPTDecoder, PrefixCache)
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2,
+                          kv_quant="int8")
+    eng = ContinuousBatchingEngine(
+        dec, max_new_tokens=4, k_max=2,
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+    base = list(range(1, 17))            # one full shareable block
+    for tail in ([21, 22, 23], []):      # miss+insert, then a FULL hit
+        eng.submit(np.asarray(base + tail, np.int32))
+        eng.run()
+    program = dec.analysis_program(k=4)
+    ctx = AnalysisContext(
+        name="gpt_decode_kv8",
+        # the shared ragged-attention reorders, plus the int8 pool's
+        # per-page scale-plane gather layout move [n,MP,ps]->[MP,n,ps]
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + RAGGED_ATTENTION_TRANSPOSES + (r"dims = \[1, 0, 2\]",),
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "kv_quant": "int8",
+               # one per-layer [P, ps, H, D] pool tensor: a convert of
+               # this many int8 elements to a wide float IS the
+               # dequantized pool landing in HBM
+               "kv_pool_block_elems": (dec.num_pages * dec.page_size *
+                                       cfg.num_heads * cfg.head_dim),
+               "page_ledger": eng.page_ledger()})
+    return program, ctx, PagedGPTDecoder._decode_multi_step
+
+
 # configs whose builder yields a READY LoweredProgram (serving decode
 # loops and other non-Layer captures): builder() ->
 # (LoweredProgram, AnalysisContext, source_fn). They ride the same
@@ -288,6 +339,7 @@ PROGRAM_CONFIGS = {
     "gpt_decode": _gpt_decode,       # fused multi-step serving decode
     "gpt_decode_prefix": _gpt_decode_prefix,   # chunked prefix-cache prefill
     "gpt_decode_ragged": _gpt_decode_ragged,   # mixed chunked-prefill+decode
+    "gpt_decode_kv8": _gpt_decode_kv8,         # int8 KV pool decode loop
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
 

@@ -692,6 +692,49 @@ def audit_page_ledger(ledger):
     return findings
 
 
+def audit_kv_scale_planes(decoder, pages):
+    """MEM-PAGE-REFCOUNT scale-plane consistency audit of an int8 KV
+    pool: for every page in `pages` (slot-held or cache-tracked), any
+    position holding nonzero quantized bytes must carry a nonzero
+    write-time scale.  The write path stores bytes and scale together
+    (`serving.decoder._kv_set`) and the floor scale is positive even
+    for an all-zero vector, so a written position ALWAYS has scale > 0
+    — a zero scale under live bytes means some copy path (typically a
+    copy-on-write that moved page bytes but not the scale plane) split
+    the two, and the page dequantizes to garbage.  Reads the pool from
+    device; audit-time only, never on the serving hot path.  Returns
+    Finding list (empty = consistent)."""
+    import numpy as np
+    findings = []
+    k_pool, v_pool = decoder.k_pages, decoder.v_pages
+    if not isinstance(k_pool, tuple):
+        return findings                  # unquantized pool: nothing to check
+    for name, (page_arr, scale_arr) in (("k", k_pool), ("v", v_pool)):
+        pg = np.asarray(page_arr)
+        sc = np.asarray(scale_arr)
+        for p in pages:
+            # [L, ps]: does any head/dim byte live at (layer, position)?
+            wrote = np.abs(pg[:, p].astype(np.int32)).max(
+                axis=(-2, -1)) > 0
+            orphan = wrote & (sc[:, p] <= 0.0)
+            if orphan.any():
+                ls, ps_ = np.nonzero(orphan)
+                findings.append(Finding(
+                    "MEM-PAGE-REFCOUNT", Severity.ERROR,
+                    f"{name}-page {p} holds quantized bytes without "
+                    f"write-time scales at (layer, pos) "
+                    f"{list(zip(ls.tolist(), ps_.tolist()))[:4]}"
+                    f"{'...' if orphan.sum() > 4 else ''} — a copy "
+                    "moved the page bytes but not the scale plane; "
+                    "the page dequantizes to garbage",
+                    analyzer="page-refcount",
+                    suggested_fix="copy pages through "
+                    "PagedGPTDecoder.copy_page (it tree-maps bytes "
+                    "AND scale rows together); never copy pool leaves "
+                    "individually"))
+    return findings
+
+
 @register_analyzer
 class PageRefcountAnalyzer(Analyzer):
     """MEM-PAGE-REFCOUNT: ownership audit of the shared (prefix-cached)

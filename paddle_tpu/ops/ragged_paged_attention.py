@@ -14,7 +14,12 @@ serving engine's byte-identical equivalence tests pin.
 
 Shapes:
   q               : (n, W, H, D)   new-token queries (row-local window)
-  k_pages/v_pages : (P, page_size, H, D)  one layer's page pool
+  k_pages/v_pages : (P, page_size, H, D)  one layer's page pool, OR an
+                    int8 pool as the tuple (pages int8, scales f32
+                    (P, page_size)) — per-token write-time scales (the
+                    serving decoder's kv_quant="int8" layout); dequant
+                    happens inside the shared per-page update, so the
+                    dequantized pool never materializes in HBM
   page_table      : (n, max_pages) int32 page ids per row
   start           : (n,)           already-cached length per row
 
@@ -46,7 +51,8 @@ _DENOM_EPS = 1e-30
 _MASK = -1e30
 
 
-def _page_update(m, s, acc, logits, v, kpos, qpos):
+def _page_update(m, s, acc, logits, v, kpos, qpos, k_scale=None,
+                 v_scale=None):
     """ONE page's online-softmax update — the shared math of the jnp
     reference and the Pallas kernel (they call this same function, so
     the two paths cannot drift; bit-identity rides on it).
@@ -55,7 +61,19 @@ def _page_update(m, s, acc, logits, v, kpos, qpos):
     accumulator [..., W, D]. logits [..., W, ps] this page's scores
     (q*scale @ k^T), v [..., ps, D] this page's values, kpos [ps] the
     page's absolute key positions, qpos [..., W] the queries' absolute
-    positions. Causal: a query attends to kpos <= qpos only."""
+    positions. Causal: a query attends to kpos <= qpos only.
+
+    k_scale/v_scale (optional): this page's per-token dequant scales,
+    broadcastable to [..., ps] — the int8 KV pool's write-time scales.
+    Applied HERE, so the reference and the kernel share one dequant
+    exactly like they share the softmax math: logits computed from raw
+    int8 keys pick up the key scale (q·(k_q·s) == (q·k_q)·s), values
+    dequantize before the accumulator dot, and the dequantized pool
+    never exists outside this page-sized working set."""
+    if k_scale is not None:
+        logits = logits * k_scale[..., None, :]
+    if v_scale is not None:
+        v = v * v_scale[..., :, None]
     mask = kpos[..., None, :] <= qpos[..., :, None]       # [..., W, ps]
     logits = jnp.where(mask, logits, _MASK)
     m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
@@ -77,10 +95,13 @@ def _page_update(m, s, acc, logits, v, kpos, qpos):
 _UNROLL_PAGES = 32
 
 
-def _ragged_ref(q, k_pages, v_pages, page_table, start, scale):
+def _ragged_ref(q, k_pages, v_pages, page_table, start, scale,
+                k_scale=None, v_scale=None):
     """jnp reference: the kernel's page loop as an unrolled loop (small
     tables) or a lax.scan — the same per-page update in the same order
-    either way (see _page_update)."""
+    either way (see _page_update). With an int8 pool, `k_scale`/
+    `v_scale` [P, ps] carry the per-token write-time scales; the gather
+    stays int8 and only one page dequantizes per step."""
     n, W, H, D = q.shape
     ps = k_pages.shape[1]
     MP = page_table.shape[1]
@@ -88,29 +109,41 @@ def _ragged_ref(q, k_pages, v_pages, page_table, start, scale):
     # [n, MP, ps, H, D] -> per-page [MP][n, H, ps, D]
     kg = jnp.moveaxis(k_pages[safe], (1, 3), (0, 2))
     vg = jnp.moveaxis(v_pages[safe], (1, 3), (0, 2))
+    quantized = k_scale is not None
+    if quantized:
+        # [n, MP, ps] -> per-page [MP][n, ps]
+        ksg = jnp.moveaxis(k_scale[safe], 1, 0)
+        vsg = jnp.moveaxis(v_scale[safe], 1, 0)
     qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [n,H,W,D]
     qpos = (start[:, None] + jnp.arange(W))[:, None, :]         # [n,1,W]
 
     def page_step(carry, inputs):
         m, s, acc = carry
-        j, kj, vj = inputs                     # [n, H, ps, D]
+        if quantized:
+            j, kj, vj, ksj, vsj = inputs
+        else:
+            j, kj, vj = inputs                 # [n, H, ps, D]
         logits = jax.lax.dot_general(
             qf, kj.astype(jnp.float32),
             (((3,), (3,)), ((0, 1), (0, 1))),
             preferred_element_type=jnp.float32)          # [n, H, W, ps]
         kpos = j * ps + jnp.arange(ps)
-        return _page_update(m, s, acc, logits, vj.astype(jnp.float32),
-                            kpos, qpos), None
+        return _page_update(
+            m, s, acc, logits, vj.astype(jnp.float32), kpos, qpos,
+            # [n, ps] -> [n, 1, ps]: broadcast over the head axis
+            k_scale=ksj[:, None] if quantized else None,
+            v_scale=vsj[:, None] if quantized else None), None
 
     carry = (jnp.full((n, H, W, 1), _MASK, jnp.float32),
              jnp.zeros((n, H, W, 1), jnp.float32),
              jnp.zeros((n, H, W, D), jnp.float32))
+    pages = (kg, vg) + ((ksg, vsg) if quantized else ())
     if MP <= _UNROLL_PAGES:
         for j in range(MP):
-            carry, _ = page_step(carry, (j, kg[j], vg[j]))
+            carry, _ = page_step(carry, (j,) + tuple(x[j] for x in pages))
     else:
         carry, _ = jax.lax.scan(page_step, carry,
-                                (jnp.arange(MP), kg, vg))
+                                (jnp.arange(MP),) + pages)
     m, s, acc = carry
     out = acc / jnp.maximum(s, _DENOM_EPS)               # [n, H, W, D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)     # [n, W, H, D]
@@ -124,14 +157,20 @@ def _ragged_ref(q, k_pages, v_pages, page_table, start, scale):
 _ragged_ref_jit = jax.jit(_ragged_ref, static_argnames=("scale",))
 
 
-def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, s_scr, acc_scr, *, scale, page_size,
-                   max_pages):
+def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, page_size, max_pages, quantized):
     """Grid (n, H, max_pages): one page of K/V in VMEM per step, online
     softmax in scratch — the scalar-prefetched page_table drives the
-    K/V BlockSpec index maps, so the pool never leaves HBM whole."""
+    K/V BlockSpec index maps, so the pool never leaves HBM whole. With
+    an int8 pool two more page-indexed refs carry the [ps] per-token
+    scales; dequant runs inside `_page_update`, on the one VMEM-resident
+    page — the f32 pool never exists."""
     from jax.experimental import pallas as pl
 
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, s_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, s_scr, acc_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -152,7 +191,9 @@ def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
     qpos = start_ref[b] + jax.lax.broadcasted_iota(
         jnp.int32, (W, 1), 0)[:, 0]                          # [W]
     m_new, s_new, acc_new = _page_update(
-        m_scr[...], s_scr[...], acc_scr[...], logits, v, kpos, qpos)
+        m_scr[...], s_scr[...], acc_scr[...], logits, v, kpos, qpos,
+        k_scale=ks_ref[0, :] if quantized else None,
+        v_scale=vs_ref[0, :] if quantized else None)
     m_scr[...] = m_new
     s_scr[...] = s_new
     acc_scr[...] = acc_new
@@ -164,26 +205,36 @@ def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
-                        interpret):
+                        interpret, k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, W, H, D = q.shape
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
+    quantized = k_scale is not None
 
     def page_map(bi, hi, j, pt, st):
         return (jnp.maximum(pt[bi, j], 0), 0, hi, 0)
 
+    def scale_map(bi, hi, j, pt, st):
+        return (jnp.maximum(pt[bi, j], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, W, 1, D),
+                     lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
+        pl.BlockSpec((1, page_size, 1, D), page_map),
+        pl.BlockSpec((1, page_size, 1, D), page_map),
+    ]
+    operands = (q, k_pages, v_pages)
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size), scale_map),
+                     pl.BlockSpec((1, page_size), scale_map)]
+        operands += (k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, start
         grid=(n, H, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, W, 1, D),
-                         lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
-            pl.BlockSpec((1, page_size, 1, D), page_map),
-            pl.BlockSpec((1, page_size, 1, D), page_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, W, 1, D), lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
         scratch_shapes=[
@@ -194,12 +245,13 @@ def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
     )
     return pl.pallas_call(
         functools.partial(_ragged_kernel, scale=scale,
-                          page_size=page_size, max_pages=max_pages),
+                          page_size=page_size, max_pages=max_pages,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, W, H, D), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), start.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
@@ -210,7 +262,11 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
     W-1 (pad the window past the row's true new_len — padded queries
     produce row-local garbage the caller discards, exactly like padded
     positions in the chunked prefill). Decode rows are simply W=1 (or a
-    width-W window with one real query). Returns (n, W, H, D)."""
+    width-W window with one real query). Returns (n, W, H, D).
+
+    `k_pages`/`v_pages` may each be an int8 pool tuple (pages int8,
+    scales f32 [P, ps]) — the serving decoder's kv_quant="int8" layout.
+    Both paths dequantize per page inside `_page_update`."""
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     start = jnp.asarray(start, jnp.int32)
@@ -226,15 +282,22 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
                                       start, scale=scale,
                                       use_kernel=use_kernel,
                                       interpret=interpret)[:, :1]
+    ks = vs = None
+    if isinstance(k_pages, tuple):
+        k_pages, ks = k_pages
+        v_pages, vs = v_pages
     if not use_kernel:
         return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
-                               scale=float(scale))
+                               scale=float(scale), k_scale=ks,
+                               v_scale=vs)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     try:
         return _ragged_kernel_call(q, k_pages, v_pages, page_table,
-                                   start, scale, interpret)
+                                   start, scale, interpret,
+                                   k_scale=ks, v_scale=vs)
     except Exception as e:
         kernel_fallback("ragged_paged_attention", e)
         return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
-                               scale=float(scale))
+                               scale=float(scale), k_scale=ks,
+                               v_scale=vs)

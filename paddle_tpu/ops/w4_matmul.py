@@ -64,32 +64,56 @@ def _w4_kernel(x_ref, p_ref, s_ref, o_ref, *, K):
         x, wf, preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
-def w4_matmul(x, packed, scale, K, block_n=256):
+def w4_matmul(x, packed, scale, K, block_n=256, block_s=512):
     """x [..., K] @ int4-packed weight -> [..., N]; dequant happens
-    per-tile in VMEM (Pallas), never in HBM. Falls back to the jnp
-    reference off-TPU or when the shape doesn't tile."""
+    per-tile in VMEM (Pallas), never in HBM.
+
+    Every shape tiles: an unaligned N first tries a SMALLER block (the
+    largest power-of-two divisor of N >= 64 — the decoder's head-major
+    384s and 128s tile exactly, no copies) and only a genuinely odd N
+    (vocab projections like 50257) pads up to the block — an int8
+    weight copy that is still far cheaper than the old silent fallback,
+    which materialized the ENTIRE dequantized f32 weight in HBM. S
+    tiles over a grid dimension in `block_s` rows (long prefill rows no
+    longer bail at S > 4096; the weight tile streams once per S tile).
+    The jnp reference remains the correctness twin and the fallback for
+    odd-K packings and kernel failures."""
     from jax.experimental import pallas as pl
 
     lead = x.shape[:-1]
     xf = x.reshape(-1, K)
     S = xf.shape[0]
     K2, N = packed.shape
-    if N % block_n or K % 2 or S > 4096:
+    if K % 2:
         return _w4_ref(xf, packed, scale, K).reshape(*lead, N)
     try:
+        if N % block_n:
+            b = N & -N                   # largest pow2 divisor of N
+            if b >= 64:
+                block_n = min(b, block_n)
+        Np = -(-N // block_n) * block_n
+        pk, sc = packed, scale
+        if Np != N:
+            # zero-padded columns: nibble byte 0 dequantizes to -8 * a
+            # zero scale = 0, and the columns are sliced off anyway
+            pk = jnp.pad(packed, ((0, 0), (0, Np - N)))
+            sc = jnp.pad(scale, (0, Np - N))
+        bs = min(block_s, S)
+        Sp = -(-S // bs) * bs
+        xp = jnp.pad(xf, ((0, Sp - S), (0, 0))) if Sp != S else xf
         out = pl.pallas_call(
             functools.partial(_w4_kernel, K=K),
-            grid=(N // block_n,),
+            grid=(Sp // bs, Np // block_n),
             in_specs=[
-                pl.BlockSpec((S, K), lambda i: (0, 0)),
-                pl.BlockSpec((K2, block_n), lambda i: (0, i)),
-                pl.BlockSpec((block_n,), lambda i: (i,)),
+                pl.BlockSpec((bs, K), lambda i, j: (i, 0)),
+                pl.BlockSpec((K2, block_n), lambda i, j: (0, j)),
+                pl.BlockSpec((block_n,), lambda i, j: (j,)),
             ],
-            out_specs=pl.BlockSpec((S, block_n), lambda i: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((S, N), x.dtype),
+            out_specs=pl.BlockSpec((bs, block_n), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((Sp, Np), x.dtype),
             interpret=jax.default_backend() == "cpu",
-        )(xf, packed, scale)
-        return out.reshape(*lead, N)
+        )(xp, pk, sc)
+        return out[:S, :N].reshape(*lead, N)
     except Exception as e:
         kernel_fallback("w4_matmul", e)
         return _w4_ref(xf, packed, scale, K).reshape(*lead, N)

@@ -50,7 +50,8 @@ are extracted once into stacked per-layer arrays and the model object is
 no longer needed — pair with jit.load-style artifacts for serving.
 """
 from .decoder import (MultiDecodeOut, PagedGPTDecoder, RaggedMultiOut,
-                      _ln, _mm, _mm_heads, _quantize_w, _sample_tokens,
+                      _kv_set, _ln, _mm, _mm_heads, _quantize_kv,
+                      _quantize_w, _sample_tokens,
                       _spec_accept)
 from .engine import ContinuousBatchingEngine, SpeculativeEngine
 from .prefix_cache import PrefixCache

@@ -11,7 +11,7 @@ import numpy as np
 
 __all__ = ["PagedGPTDecoder", "MultiDecodeOut", "RaggedMultiOut",
            "_spec_accept", "_sample_tokens", "_ln", "_mm", "_mm_heads",
-           "_quantize_w"]
+           "_quantize_w", "_quantize_kv", "_kv_set"]
 
 # every live decoder, so the tier-1 conftest's module-boundary GC hook
 # can trim compiled-program memos (the Trainer._LIVE_TRAINERS pattern)
@@ -65,6 +65,56 @@ def _quantize_w(w):
     from ..quantization import quantize_weight
     q, scale = quantize_weight(w, axis=0)
     return q, scale.reshape(-1)
+
+
+def _quantize_kv(val):
+    """Write-time per-token int8 quantization of K (or V) vectors: one
+    symmetric scale per TOKEN from the token's own [H, D] amax
+    (scale = amax/127, floored so an all-zero vector stays
+    representable). The scale depends only on the token's values —
+    which are position-local (row-local matmuls, per-position
+    embeddings) — so a token's stored bytes depend only on (request,
+    position), never on batch composition, chunk schedule or page
+    assignment: the byte-identical-stream discipline survives
+    quantization unchanged. val [..., H, D] -> (int8 [..., H, D],
+    f32 scale [...])."""
+    v32 = val.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v32), axis=(-2, -1))
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(v32 / scale[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def pool_token_bytes(cfg, kv_quant=None, itemsize=2):
+    """KV bytes one context token costs PER LAYER under a pool layout
+    (K and V together; int8 pools pay 1 B/elem payload + one 4 B f32
+    write-time scale per plane). THE byte model behind
+    `PagedGPTDecoder.kv_token_bytes` / `step_hbm_bytes` and the
+    capacity bench (`bench.run_decode_capacity`) — one definition, so
+    the bench can price big-model shapes without building the model
+    and can never drift from what the decoder reports."""
+    per_tensor = cfg.num_heads * cfg.head_dim * \
+        (1 if kv_quant else itemsize)
+    if kv_quant:
+        per_tensor += 4              # one f32 write-time scale/token
+    return int(2 * per_tensor)
+
+
+def _kv_set(pool, pids, offs, val):
+    """Write `val` [..., H, D] at (pids, offs) of ONE layer's page pool
+    — the single KV write primitive behind every serving path (decode
+    ticks, chunked suffix prefill, the verify window, ragged horizons;
+    scratch routing is the caller's pids). A plain pool stores the
+    cast value; an int8 pool (pages, scales) quantizes from the
+    token's own amax (`_quantize_kv`) and stores bytes + scale
+    together, so no write site can ever drift from the others."""
+    if isinstance(pool, tuple):
+        pages, scales = pool
+        q, s = _quantize_kv(val)
+        return (pages.at[pids, offs].set(q),
+                scales.at[pids, offs].set(s))
+    return pool.at[pids, offs].set(val.astype(pool.dtype))
 
 
 def _spec_accept(p_rows, q_rows, drafts, rng):
@@ -153,9 +203,9 @@ class PagedGPTDecoder:
     """Stacked-weight GPT decode executor over paged KV pools."""
 
     def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
-                 max_pages_per_seq=None, quant=None, use_kernel=False,
-                 dtype=None, temperature=0.0, top_k=0, top_p=1.0, seed=0,
-                 mesh=None):
+                 max_pages_per_seq=None, quant=None, kv_quant=None,
+                 use_kernel=False, dtype=None, temperature=0.0, top_k=0,
+                 top_p=1.0, seed=0, mesh=None):
         cfg = model.cfg
         self.cfg = cfg
         self.page_size = page_size
@@ -164,8 +214,10 @@ class PagedGPTDecoder:
         self.max_pages = max_pages_per_seq or \
             (cfg.max_seq_len + page_size - 1) // page_size
         self.quant = quant
+        self.kv_quant = kv_quant
         self.use_kernel = use_kernel
         assert quant in (None, "a8w8", "w4a16"), quant
+        assert kv_quant in (None, "int8"), kv_quant
         # temperature 0 = greedy (reference decode convention)
         self.sampling = None if not temperature else \
             (float(temperature), int(top_k), float(top_p))
@@ -229,8 +281,26 @@ class PagedGPTDecoder:
             state.get("lm_head.weight", state["wte.weight"].T))
 
         H, D = cfg.num_heads, cfg.head_dim
-        self.k_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
-        self.v_pages = jnp.zeros((L, num_pages, page_size, H, D), dtype)
+        # activations/embeddings compute at this width whatever the
+        # pool stores (the int8 pool dequantizes inside the attention
+        # body, never in HBM)
+        self.compute_dtype = dtype
+        if kv_quant:
+            # int8 pages + one f32 write-time scale per (layer, token)
+            # for each of K and V: 4 bytes/token/layer of metadata per
+            # plane next to the H*D int8 payload — the KV byte stream
+            # behind the decode roofline halves vs bf16
+            self.k_pages = (
+                jnp.zeros((L, num_pages, page_size, H, D), jnp.int8),
+                jnp.zeros((L, num_pages, page_size), jnp.float32))
+            self.v_pages = (
+                jnp.zeros((L, num_pages, page_size, H, D), jnp.int8),
+                jnp.zeros((L, num_pages, page_size), jnp.float32))
+        else:
+            self.k_pages = jnp.zeros((L, num_pages, page_size, H, D),
+                                     dtype)
+            self.v_pages = jnp.zeros((L, num_pages, page_size, H, D),
+                                     dtype)
 
         # tensor-parallel serving: shard the 3h/ffn/head dims of the
         # stacked weights and the HEAD dim of the KV pages over 'tp';
@@ -312,8 +382,18 @@ class PagedGPTDecoder:
             # than fail — logits are [S, V] and small at decode batch
             self.lm_head = put(self.lm_head, None, None)
         # KV pages: heads sharded — each tp shard holds its heads' pages
-        self.k_pages = put(self.k_pages, None, None, None, "tp", None)
-        self.v_pages = put(self.v_pages, None, None, None, "tp", None)
+        # (int8 pools shard the byte payload the same way; the per-token
+        # scale planes have no head axis and replicate — their amax
+        # reduces over ALL heads, a tiny per-layer collective GSPMD
+        # inserts at the write)
+        def put_pool(pool):
+            if isinstance(pool, tuple):
+                return (put(pool[0], None, None, None, "tp", None),
+                        put(pool[1], None, None, None))
+            return put(pool, None, None, None, "tp", None)
+
+        self.k_pages = put_pool(self.k_pages)
+        self.v_pages = put_pool(self.v_pages)
 
     # -- compiled programs -------------------------------------------------
 
@@ -330,7 +410,7 @@ class PagedGPTDecoder:
         S = tokens.shape[0]
         x = (self.wte[tokens] +
              self.wpe[jnp.clip(lens, 0, cfg.max_seq_len - 1)]
-             ).astype(k_pages.dtype)                           # [S, h]
+             ).astype(self.compute_dtype)                      # [S, h]
         quant = self.quant
 
         def layer(x, wkv):
@@ -338,8 +418,8 @@ class PagedGPTDecoder:
             y = _ln(x, wl["ln1_w"], wl["ln1_b"])
             qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)  # [S,3,H,D]
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
-            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            kp = _kv_set(kp, pids, offs, k)
+            vp = _kv_set(vp, pids, offs, v)
             # the ONE ragged kernel behind every serving path (decode is
             # the W=1 row kind): causal over kpos <= lens, i.e. the
             # slot's prefix plus the key written just above
@@ -467,8 +547,8 @@ class PagedGPTDecoder:
             qkv = _mm_heads(y.reshape(n * W, -1), wl["qkv_w"],
                             wl["qkv_b"], quant).reshape(n, W, 3, H, D)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kp = kp.at[pids, offs].set(k.astype(kp.dtype))
-            vp = vp.at[pids, offs].set(v.astype(vp.dtype))
+            kp = _kv_set(kp, pids, offs, k)
+            vp = _kv_set(vp, pids, offs, v)
             # pos rows are contiguous windows (start + arange(W)), so
             # the row's first entry IS its cached length
             from ..ops.ragged_paged_attention import ragged_paged_attention
@@ -500,7 +580,7 @@ class PagedGPTDecoder:
         pos = lens[:, None] + jnp.arange(W)[None, :]            # [S, W]
         x = (self.wte[tokens] +
              self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
-             ).astype(self.k_pages.dtype)                       # [S, W, h]
+             ).astype(self.compute_dtype)                       # [S, W, h]
         MP = table.shape[1]
         # margin guard: window positions past the table's capacity (the
         # engine admits with a +k margin, so only pathological callers
@@ -563,7 +643,7 @@ class PagedGPTDecoder:
         pos = start[:, None] + jnp.arange(W)[None, :]           # [n, W]
         x = (self.wte[ids] +
              self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
-             ).astype(k_pages.dtype)                            # [n, W, h]
+             ).astype(self.compute_dtype)                       # [n, W, h]
         MP = table.shape[1]
         # scratch-route every write that isn't a real position: the
         # padded tail (pos >= true_len), table overflow, frozen rows
@@ -756,21 +836,84 @@ class PagedGPTDecoder:
         pages stay immutable for their whole cached life."""
         if self._copy is None:
             def cp(kp, vp, s, d):
-                return (kp.at[:, d].set(kp[:, s]),
-                        vp.at[:, d].set(vp[:, s]))
+                # tree_map: an int8 pool's page BYTES and its scale
+                # plane rows move together — a copy that left the
+                # scales behind would dequantize the private page with
+                # the zero-initialized scales (garbage tokens; the
+                # MEM-PAGE-REFCOUNT scale audit exists to catch it)
+                def one(a):
+                    return a.at[:, d].set(a[:, s])
+                return (jax.tree_util.tree_map(one, kp),
+                        jax.tree_util.tree_map(one, vp))
             self._copy = jax.jit(cp, donate_argnums=(0, 1))
         self.k_pages, self.v_pages = self._copy(
             self.k_pages, self.v_pages,
             jnp.asarray(int(src), jnp.int32),
             jnp.asarray(int(dst), jnp.int32))
 
+    def pool_state(self):
+        """Checkpointable KV-pool state: the page arrays (and, for an
+        int8 pool, their scale planes) plus the quant config that
+        produced them. `load_pool_state` refuses a mismatched config —
+        int8 bytes interpreted as bf16 (or the reverse) would decode
+        garbage tokens with no error anywhere downstream."""
+        return {"kv_quant": self.kv_quant or "",
+                "k_pages": self.k_pages, "v_pages": self.v_pages}
+
+    def load_pool_state(self, state):
+        """Restore a `pool_state()` snapshot into this decoder's pool.
+        The stored quant config, leaf dtypes and shapes must all match
+        this decoder's pool layout exactly."""
+        quant = state.get("kv_quant", "") or None
+        if quant != self.kv_quant:
+            raise ValueError(
+                f"KV pool quant config mismatch: this decoder stores "
+                f"{self.kv_quant or 'unquantized (' + str(jnp.dtype(self.compute_dtype)) + ')'} "
+                f"pages but the checkpointed pool was written "
+                f"{quant or 'unquantized'} — reinterpreting the bytes "
+                "would decode garbage tokens; rebuild the decoder with "
+                f"kv_quant={quant!r} or re-prefill from tokens")
+        for name in ("k_pages", "v_pages"):
+            have = getattr(self, name)
+            want = state[name]
+            h_leaves = jax.tree_util.tree_leaves(have)
+            w_leaves = jax.tree_util.tree_leaves(want)
+            if len(h_leaves) != len(w_leaves) or any(
+                    hl.shape != wl.shape or
+                    jnp.dtype(hl.dtype) != jnp.dtype(wl.dtype)
+                    for hl, wl in zip(h_leaves, w_leaves)):
+                raise ValueError(
+                    f"KV pool state mismatch on {name}: expected "
+                    f"{[(tuple(l.shape), str(l.dtype)) for l in h_leaves]}, "
+                    f"got "
+                    f"{[(tuple(getattr(l, 'shape', ())), str(getattr(l, 'dtype', '?'))) for l in w_leaves]}")
+        self.k_pages = jax.tree_util.tree_map(jnp.asarray,
+                                              state["k_pages"])
+        self.v_pages = jax.tree_util.tree_map(jnp.asarray,
+                                              state["v_pages"])
+
+    @property
+    def _pool_itemsize(self):
+        """Bytes one stored K (or V) element costs in the pool."""
+        leaf = self.k_pages[0] if isinstance(self.k_pages, tuple) \
+            else self.k_pages
+        return jnp.dtype(leaf.dtype).itemsize
+
+    @property
+    def kv_token_bytes(self):
+        """KV bytes ONE token costs per layer (K and V together,
+        scale-plane metadata included for the int8 pool) — the unit of
+        every KV byte count this decoder reports (`kv_page_bytes`,
+        `step_hbm_bytes`, ServeStats.kv_bytes_per_token)."""
+        return pool_token_bytes(self.cfg, kv_quant=self.kv_quant,
+                                itemsize=self._pool_itemsize)
+
     @property
     def kv_page_bytes(self):
-        """KV bytes one page holds across all layers (K and V) — the
-        prefix cache's bytes-saved unit."""
-        cfg = self.cfg
-        return int(2 * cfg.num_layers * self.page_size * cfg.num_heads *
-                   cfg.head_dim * jnp.dtype(self.k_pages.dtype).itemsize)
+        """KV bytes one page holds across all layers (K and V, scale
+        planes included) — the prefix cache's bytes-saved unit."""
+        return int(self.cfg.num_layers * self.page_size *
+                   self.kv_token_bytes)
 
     def cache_fingerprint(self):
         """Model/sampling-invariant identity of this decoder's KV bytes
@@ -793,10 +936,12 @@ class PagedGPTDecoder:
         probes += (probe(self.wte), probe(self.wpe),
                    probe(self.lm_head), probe(self.ln_f_w),
                    probe(self.ln_f_b))
+        pool_leaf = self.k_pages[0] if isinstance(self.k_pages, tuple) \
+            else self.k_pages
         parts = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                  cfg.head_dim, cfg.vocab_size, cfg.max_seq_len,
-                 self.page_size, str(jnp.dtype(self.k_pages.dtype)),
-                 self.quant or "", probes)
+                 self.page_size, str(jnp.dtype(pool_leaf.dtype)),
+                 self.quant or "", self.kv_quant or "", probes)
         return repr(parts).encode()
 
     def analysis_program(self, donate=True, k=None, prefix_w=None,
@@ -902,13 +1047,18 @@ class PagedGPTDecoder:
                               jaxpr=traced.jaxpr, name=name,
                               arg_infos=infos)
 
-    def step_hbm_bytes(self, avg_ctx=None):
+    def step_hbm_bytes(self, avg_ctx=None, batch=None):
         """HBM bytes ONE decode tick moves: every weight byte plus each
         slot's KV prefix at `avg_ctx` (default: half the model's max
         sequence). The numerator of the decode tick roofline —
         `cost_model.decode_horizon` prices the default multi-step K
         from it; bench.decode_roofline_tok_s is the tok/s view of the
-        same bytes model."""
+        same bytes model. An int8 pool reports its TRUE byte stream
+        (int8 payload + the f32 per-token scale planes), so the horizon
+        K, the ragged chunk budget and the capacity bench all re-price
+        automatically when the pool quantizes. `batch` overrides the
+        slot count (bench.run_decode_capacity sweeps it to find the
+        max slots under a fixed per-token p99)."""
         cfg = self.cfg
         n = cfg.num_params()
         per = {"a8w8": 1.0, "w4a16": 0.5}.get(self.quant)
@@ -920,9 +1070,9 @@ class PagedGPTDecoder:
             w_bytes = n * 2
         if avg_ctx is None:
             avg_ctx = max(cfg.max_seq_len // 2, 1)
-        kv = (self.max_batch * cfg.num_layers * 2 * avg_ctx *
-              cfg.num_heads * cfg.head_dim *
-              jnp.dtype(self.k_pages.dtype).itemsize)
+        if batch is None:
+            batch = self.max_batch
+        kv = batch * cfg.num_layers * avg_ctx * self.kv_token_bytes
         return int(w_bytes + kv)
 
     def _kids_or_default(self, kids):

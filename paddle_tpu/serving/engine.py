@@ -137,8 +137,12 @@ class ContinuousBatchingEngine:
         self._prompt_len = [0] * S           # admitted prompt length/slot
         # scheduling-decision trace for the SERVE-PREFILL-STALL audit
         self._sched_events = collections.deque(maxlen=_SCHED_WINDOW)
-        self.stats = ServeStats(engine=type(self).__name__,
-                                k_max=self.k_max)
+        self.stats = ServeStats(
+            engine=type(self).__name__, k_max=self.k_max,
+            # num_pages - 1: the reserved scratch page never holds a
+            # sequence's KV — capacity counts allocatable pages only
+            kv_pool_bytes=(decoder.num_pages - 1) * decoder.kv_page_bytes,
+            kv_bytes_per_token=decoder.kv_page_bytes // decoder.page_size)
         self._submit_t = {}                  # rid -> submit wall time
         _ENGINES.add(self)
 
@@ -180,6 +184,15 @@ class ContinuousBatchingEngine:
 
     def _pages_for(self, n_tokens):
         return (n_tokens + self.d.page_size - 1) // self.d.page_size
+
+    def _note_resident(self):
+        """Update stats.max_resident_slots from the ONE definition of
+        resident — slots currently holding a request (`_slot_req`) —
+        so the peak is comparable across the per-tick, fused and
+        ragged loops (and any dispatch site added later)."""
+        n = sum(r is not None for r in self._slot_req)
+        self.stats.max_resident_slots = max(
+            self.stats.max_resident_slots, n)
 
     def _admit(self):
         # gather every admittable request first: same-suffix-bucket
@@ -430,9 +443,20 @@ class ContinuousBatchingEngine:
 
     def audit_pages(self):
         """Run the MEM-PAGE-REFCOUNT audit over the live ledger; returns
-        the findings (empty = every page owned exactly once)."""
-        from ..analysis.memory import audit_page_ledger
-        return audit_page_ledger(self.page_ledger())
+        the findings (empty = every page owned exactly once). With an
+        int8 KV pool the audit additionally cross-checks the scale
+        planes: every held page position carrying quantized bytes must
+        carry its write-time scale (a CoW/copy path that moved page
+        bytes without the scales dequantizes the copy to garbage)."""
+        from ..analysis.memory import (audit_kv_scale_planes,
+                                       audit_page_ledger)
+        findings = audit_page_ledger(self.page_ledger())
+        if self.d.kv_quant:
+            held = {p for pg in self._slot_pages for p in pg}
+            if self.cache is not None:
+                held |= set(self.cache.pages())
+            findings += audit_kv_scale_planes(self.d, sorted(held))
+        return findings
 
     def _table(self, pages_per_slot, decoder):
         """Page table with inactive/unused entries routed to the reserved
@@ -461,6 +485,7 @@ class ContinuousBatchingEngine:
         self.stats.ticks += 1
         self.stats.decode_syncs += 1
         self.stats.occupancy.append(len(active) / self.d.max_batch)
+        self._note_resident()
         for s in active:
             rid = self._slot_req[s]
             tok = int(nxt[s])
@@ -669,6 +694,7 @@ class ContinuousBatchingEngine:
                 self.steps += k
                 self.stats.ticks += k
                 self.stats.occupancy.append(len(disp) / S)
+                self._note_resident()
                 for s in disp:
                     inflight[s] += k
                 meta = (out.tokens_block, out.done_before, k,
@@ -903,6 +929,7 @@ class ContinuousBatchingEngine:
                 self.stats.ticks += plan.k
                 self.stats.prefill_chunks += plan.n_chunks
                 self.stats.occupancy.append(len(live) / S)
+                self._note_resident()
                 for s, e in plan.emit_ticks.items():
                     inflight[s] += e
                 self._sched_events.append(
@@ -947,6 +974,16 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         if draft_decoder.max_batch != decoder.max_batch or \
                 draft_decoder.page_size != decoder.page_size:
             raise ValueError("draft/target max_batch and page_size must match")
+        if decoder.kv_quant or draft_decoder.kv_quant:
+            # out of scope for the int8 pool (docs/serving.md): verify
+            # windows write up to k positions past the accepted length,
+            # and the twin-pool rollback discipline for quantized
+            # bytes+scales is unproven — refuse rather than risk a
+            # silent drift between the pools
+            raise ValueError(
+                "SpeculativeEngine does not support int8 KV pools "
+                "(kv_quant): use ContinuousBatchingEngine, or plain "
+                "bf16 pools for speculation")
         # k_max=1: the verify cadence IS this engine's horizon — each
         # step() already moves a k-token window; the draft's ticks are
         # device-resident via decode_multi below. (No prefix_cache:
@@ -1068,6 +1105,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self.stats.ticks += 1
         self.stats.decode_syncs += 1
         self.stats.occupancy.append(len(active) / self.d.max_batch)
+        self._note_resident()
 
         for s in active:
             rid = self._slot_req[s]

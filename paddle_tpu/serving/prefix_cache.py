@@ -222,6 +222,11 @@ class PrefixCache:
     def n_parked(self):
         return len(self._lru)
 
+    def pages(self):
+        """Page ids the cache currently tracks (mounted + parked) — the
+        engine's audit walks these next to the slot-held pages."""
+        return list(self._by_page)
+
     def ledger(self):
         """{page id: {"refs": r, "parked": bool}} — the audit view the
         MEM-PAGE-REFCOUNT lint consumes via the engine's page ledger."""

@@ -65,6 +65,13 @@ class ServeStats:
     prefix_cow: int = 0          # copy-on-write page copies
     prefix_tokens_saved: int = 0  # prompt positions whose prefill was skipped
     prefix_bytes_saved: int = 0  # KV bytes not recomputed (mounted pages)
+    # capacity ledger (set once at engine construction from the
+    # decoder's pool layout; scale-plane metadata included for int8
+    # pools): the observable side of the KV-quant capacity claim —
+    # halve kv_bytes_per_token and the same pool feeds ~2x the slots
+    kv_pool_bytes: int = 0       # whole paged pool, all layers
+    kv_bytes_per_token: int = 0  # KV bytes one context token costs
+    max_resident_slots: int = 0  # peak concurrently-occupied slots
     queue_wait_s: collections.deque = field(      # submit -> admit
         default_factory=_window)
     occupancy: collections.deque = field(         # active/slots per block
@@ -107,6 +114,11 @@ class ServeStats:
             d["prefix_cow"] = self.prefix_cow
             d["prefix_tokens_saved"] = self.prefix_tokens_saved
             d["prefix_bytes_saved"] = self.prefix_bytes_saved
+        if self.kv_pool_bytes:
+            d["kv_pool_bytes"] = self.kv_pool_bytes
+            d["kv_bytes_per_token"] = self.kv_bytes_per_token
+        if self.max_resident_slots:
+            d["max_resident_slots"] = self.max_resident_slots
         if self.occupancy:
             d["mean_slot_occupancy"] = round(
                 float(np.mean(self.occupancy)), 4)

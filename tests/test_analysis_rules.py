@@ -819,3 +819,139 @@ def test_page_refcount_rule_catches_planted_defects(mutate, expect):
     assert hits and all(h.severity == Severity.ERROR for h in hits)
     assert any(expect in h.message for h in hits), \
         (expect, [h.message for h in hits])
+
+
+# ------------------------------------------------------- kv-quant rules
+
+def _kv8_decoder(num_pages=8):
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import PagedGPTDecoder
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = GPT(gpt_tiny(max_seq_len=64, dtype="float32", remat=False))
+    model.eval()
+    return PagedGPTDecoder(model, num_pages=num_pages, page_size=16,
+                           max_batch=2, kv_quant="int8")
+
+
+def _kv8_ctx(dec):
+    cfg = dec.cfg
+    return AnalysisContext(
+        name="decode_kv8",
+        extra={"serving_decode": True, "kv_quant": "int8",
+               "kv_pool_block_elems": (dec.num_pages * dec.page_size *
+                                       cfg.num_heads * cfg.head_dim)})
+
+
+def test_kv_quant_rule_catches_dequantized_pool_in_hbm():
+    """DTYPE-KV-DEQUANT-HBM planted defect: a decode step that
+    dequantizes the WHOLE int8 pool up front (convert + scale multiply
+    at full pool shape) re-materializes the bf16-width byte stream the
+    int8 pool exists to delete. The real capture — dequant inside the
+    shared per-page attention update — stays clean."""
+    dec = _kv8_decoder()
+    ctx = _kv8_ctx(dec)
+    pm = PassManager(["kv-quant"])
+
+    good = dec.analysis_program(k=2)
+    report = pm.run(good, ctx)
+    assert report.by_rule("DTYPE-KV-DEQUANT-HBM") == []
+    assert report.by_rule("DTYPE-KV-SCALE-WIDTH") == []
+    m = report.metrics["kv-quant"]
+    assert m["checked"] and m["n_pool_dequants"] == 0
+    assert m["n_scale_planes"] == 2          # K and V planes
+
+    def bad_step(weights, k_pages, v_pages, tokens, lens, table, kids):
+        (kq, ks), (vq, vs) = k_pages, v_pages
+        kf = kq.astype(jnp.float32) * ks[..., None, None]  # FULL pool
+        vf = vq.astype(jnp.float32) * vs[..., None, None]  # in HBM
+        return dec._decode_step(weights, kf, vf, tokens, lens, table,
+                                kids)
+
+    from paddle_tpu.analysis.lowering import tree_arg_infos
+    S = dec.max_batch
+    args = (dec.weights, dec.k_pages, dec.v_pages,
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S, dec.max_pages), jnp.int32),
+            jnp.arange(S, dtype=jnp.int32))
+    traced = jax.jit(bad_step).trace(*args)   # donation is irrelevant
+    # to this rule (arg_infos below still mark the cache donated)
+    infos = tree_arg_infos(dec.weights, "param")
+    infos += tree_arg_infos(dec.k_pages, "cache", prefix="k_pages",
+                            donated=True)
+    infos += tree_arg_infos(dec.v_pages, "cache", prefix="v_pages",
+                            donated=True)
+    bad = LoweredProgram(traced.lower().as_text(), jaxpr=traced.jaxpr,
+                         name="bad_dequant", arg_infos=infos)
+    report2 = pm.run(bad, ctx)
+    hits = report2.by_rule("DTYPE-KV-DEQUANT-HBM")
+    assert hits and all(h.severity == Severity.ERROR for h in hits)
+    assert report2.metrics["kv-quant"]["n_pool_dequants"] >= 2  # K and V
+
+    # scope: without extra["kv_quant"] the rule never fires
+    report3 = pm.run(bad, AnalysisContext(name="decode"))
+    assert report3.by_rule("DTYPE-KV-DEQUANT-HBM") == []
+    assert report3.metrics["kv-quant"] == {"checked": False}
+
+
+def test_kv_quant_rule_catches_non_f32_scale_plane():
+    """DTYPE-KV-SCALE-WIDTH planted defect: a scale plane stored at any
+    width other than f32 (f64 doubles the metadata stream; bf16
+    quantizes the scales themselves) is an ERROR on the cache args."""
+    dec = _kv8_decoder()
+    ctx = _kv8_ctx(dec)
+    pm = PassManager(["kv-quant"])
+    # corrupt the live pool: K scale plane left bf16 (f64 is spelled
+    # the same way in the rule — any non-f32 floating cache leaf)
+    kq, ks = dec.k_pages
+    dec.k_pages = (kq, ks.astype(jnp.bfloat16))
+    bad = dec.analysis_program(k=2)
+    report = pm.run(bad, ctx)
+    hits = report.by_rule("DTYPE-KV-SCALE-WIDTH")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "bfloat16" in hits[0].message
+    assert report.metrics["kv-quant"]["n_bad_scale_planes"] == 1
+
+
+def test_page_refcount_audit_catches_cow_without_scales():
+    """MEM-PAGE-REFCOUNT scale audit planted defect: a copy-on-write
+    that moves a page's int8 BYTES but not its scale plane leaves the
+    private copy dequantizing against zero scales (garbage tokens).
+    The engine's audit_pages() cross-checks bytes against scales on
+    every held page; the healthy CoW (copy_page tree-maps bytes AND
+    scales) audits clean. Audited MID-RUN (run(on_sync=...)): after
+    the drain the CoW'd page is back on the free list and out of the
+    audit's held set — exactly when the garbage has already been
+    served."""
+    from paddle_tpu.serving import ContinuousBatchingEngine, PrefixCache
+
+    def run_workload(break_cow):
+        dec = _kv8_decoder(num_pages=16)
+        if break_cow:
+            def bytes_only_copy(src, dst):
+                (kq, ks), (vq, vs) = dec.k_pages, dec.v_pages
+                kq = kq.at[:, dst].set(kq[:, src])
+                vq = vq.at[:, dst].set(vq[:, src])
+                dec.k_pages = (kq, ks)       # scales left behind
+                dec.v_pages = (vq, vs)
+            dec.copy_page = bytes_only_copy
+        eng = ContinuousBatchingEngine(
+            dec, max_new_tokens=2, k_max=2,
+            prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+        base = list(range(1, 17))            # one full shareable block
+        hits = []
+        for tail in ([21, 22], []):          # insert, then a FULL hit
+            eng.submit(np.asarray(base + tail, np.int32))
+            eng.run(on_sync=lambda e: hits.extend(e.audit_pages()))
+        return eng, hits
+
+    clean, clean_hits = run_workload(break_cow=False)
+    assert clean.stats.prefix_cow >= 1       # the CoW really happened
+    assert clean_hits == []
+    assert clean.audit_pages() == []         # drained state clean too
+
+    broken, broken_hits = run_workload(break_cow=True)
+    assert broken.stats.prefix_cow >= 1
+    assert broken_hits
+    assert all(h.severity == Severity.ERROR for h in broken_hits)
+    assert any("scale plane" in h.message for h in broken_hits)

@@ -155,3 +155,31 @@ def test_gpt_decode_prefix_program_is_audited_and_device_resident(
     assert pr["refcount_total"] == 0          # drained workload: parked
     # the ledger really came from a workload that exercised sharing
     assert ctx.extra["page_ledger"]["cache"]
+
+
+def test_gpt_decode_kv8_program_is_device_resident_and_quant_clean(
+        pass_manager):
+    """The committed gpt_decode_kv8 capture (fused K-tick decode loop
+    over an int8 KV pool) keeps the serving bar — zero host transfers,
+    donated pool (now FOUR cache leaves: pages + scale planes), a real
+    device loop — AND the kv-quant bar: f32 scale planes, no
+    dequantized-pool materialization in HBM, and a page ledger from a
+    real shared-prefix int8 workload (incl. full-hit CoW) auditing
+    clean under MEM-PAGE-REFCOUNT."""
+    program, ctx, _ = lowered_program("gpt_decode_kv8")
+    report = pass_manager.run(program, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.by_rule("DTYPE-KV-SCALE-WIDTH") == []
+    assert report.by_rule("DTYPE-KV-DEQUANT-HBM") == []
+    assert report.by_rule("MEM-PAGE-REFCOUNT") == []
+    m = report.metrics["serving"]
+    assert m["checked"] and m["cache_donated"]
+    assert m["n_host_transfers"] == 0
+    assert m["n_device_loops"] >= 1
+    assert m["n_cache_args"] == 4      # k/v pages + k/v scale planes
+    q = report.metrics["kv-quant"]
+    assert q["checked"] and q["kv_quant"] == "int8"
+    assert q["n_scale_planes"] == 2 and q["n_bad_scale_planes"] == 0
+    assert q["n_pool_dequants"] == 0
+    pr = report.metrics["page-refcount"]
+    assert pr["checked"] and pr["n_cached"] >= 1

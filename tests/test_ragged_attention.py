@@ -150,3 +150,44 @@ def test_kernel_scalar_prefetch_routes_pages():
     moved = np.asarray(ragged_paged_attention(q, kp2, vp2, table2, start,
                                               use_kernel=True))
     np.testing.assert_array_equal(base, moved)
+
+
+def test_int8_pool_kernel_bit_identical_and_tracks_oracle():
+    """An int8 pool ((pages, per-token scales) tuples): the interpret
+    Pallas kernel — scale planes riding their own page-indexed
+    BlockSpecs — is BIT-IDENTICAL to the jnp reference (dequant shared
+    inside _page_update), and both track the dense oracle run on the
+    dequantized pool to f32 accumulation tolerance."""
+    rng = np.random.RandomState(11)
+    P, ps, H, D, n, W, MP = 12, 8, 2, 16, 3, 4, 6
+    kq = jnp.asarray(rng.randint(-127, 128, (P, ps, H, D))
+                     .astype(np.int8))
+    vq = jnp.asarray(rng.randint(-127, 128, (P, ps, H, D))
+                     .astype(np.int8))
+    ks = jnp.asarray((rng.rand(P, ps) * 0.05 + 1e-3).astype(np.float32))
+    vs = jnp.asarray((rng.rand(P, ps) * 0.05 + 1e-3).astype(np.float32))
+    q = jnp.asarray(rng.randn(n, W, H, D).astype(np.float32))
+    table = jnp.asarray(rng.randint(0, P, (n, MP)).astype(np.int32))
+    start = jnp.asarray(rng.randint(0, MP * ps - W, n).astype(np.int32))
+
+    ref = ragged_paged_attention(q, (kq, ks), (vq, vs), table, start,
+                                 use_kernel=False)
+    ker = ragged_paged_attention(q, (kq, ks), (vq, vs), table, start,
+                                 use_kernel=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(ker))
+
+    # semantics: == attention over the explicitly dequantized pool
+    kf = np.asarray(kq, np.float32) * np.asarray(ks)[..., None, None]
+    vf = np.asarray(vq, np.float32) * np.asarray(vs)[..., None, None]
+    want = _oracle(q, jnp.asarray(kf), jnp.asarray(vf), table, start)
+    np.testing.assert_allclose(np.asarray(ref), want, rtol=2e-5,
+                               atol=2e-5)
+
+    # W=1 decode rows (the padded degenerate path) carry tuples too
+    r1 = ragged_paged_attention(q[:, :1], (kq, ks), (vq, vs), table,
+                                start, use_kernel=False)
+    k1 = ragged_paged_attention(q[:, :1], (kq, ks), (vq, vs), table,
+                                start, use_kernel=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(k1))
+    np.testing.assert_array_equal(np.asarray(r1),
+                                  np.asarray(ref)[:, :1])

@@ -349,3 +349,84 @@ class TestPrefillTTFT:
         # host_sync_s=None uses the process-cached measurement
         got = prefill_ttft_s(16, 1e6, cached_frac=1.0, chip=chip)
         assert got == pytest.approx(measured_host_sync_s())
+
+
+class TestKvQuantRoofline:
+    """The int8 KV pool's repricing through cost_model: feeding
+    `decode_horizon` / `ragged_chunk_tokens` the int8-pool byte count
+    (int8 payload + 4B/token/layer scale planes) moves the priced
+    knobs the way the capacity claim needs."""
+
+    # a 1.3B-ish decode tick at long context and a BIG batch (the
+    # KV-bound regime the pool quantization targets): weights 2.6 GB,
+    # 80 slots' KV legs per the serving byte model (bf16 2B/elem vs
+    # int8 1B + 4B/token/layer scale planes per plane)
+    W_BYTES = int(2.6e9)
+    KV16 = 80 * 24 * 1024 * 2 * 2048 * 2         # S*L*(H*D)*2*ctx*2B
+    KV8 = 80 * 24 * 2048 * 2 * (1024 + 4)        # S*L*ctx*2*(H*D+4)
+
+    def test_horizon_k_strictly_increases_with_int8_pool_bytes(self):
+        """The int8 byte stream shortens the tick, so the engine must
+        fuse MORE ticks per host sync to keep the sync share under the
+        bar: decode_horizon strictly increases when step_hbm_bytes is
+        fed the int8-pool byte count."""
+        from paddle_tpu.cost_model import chip_spec, decode_horizon
+        chip = chip_spec("v5e")
+        b16 = self.W_BYTES + self.KV16
+        b8 = self.W_BYTES + self.KV8
+        assert (b16 - self.W_BYTES) / (b8 - self.W_BYTES) >= 1.7
+        h = b16 / chip.hbm_bw                    # one bf16 tick's cost
+        k16 = decode_horizon(b16, host_sync_s=h, chip=chip)
+        k8 = decode_horizon(b8, host_sync_s=h, chip=chip)
+        assert k8 > k16, (k8, k16)
+        # and the tok/s view: the priced tick itself strictly shrinks
+        from paddle_tpu.cost_model import decode_tick_roofline_s
+        assert decode_tick_roofline_s(b8, chip=chip) < \
+            decode_tick_roofline_s(b16, chip=chip)
+
+    def test_chunk_budget_recovers_at_the_capacity_operating_point(self):
+        """ragged_chunk_tokens prices the prompt tokens that hide under
+        the tick's HBM leg, so per-SLOT-COUNT the shorter int8 tick
+        hides fewer (the capacity win arrives as ~2x slots and a larger
+        K, not a wider chunk at fixed batch). At the capacity operating
+        point — the int8 pool serving the ~2x slots the fixed per-token
+        p99 admits — the tick's byte stream is back at (slightly above,
+        by the scale planes) the bf16 level, and the chunk budget
+        strictly increases past the fixed-batch int8 budget, back to
+        the bf16 one."""
+        from paddle_tpu.cost_model import chip_spec, ragged_chunk_tokens
+        chip = chip_spec("v5e")
+        f = 2.6e9                                # flops per prompt token
+        b16 = self.W_BYTES + self.KV16
+        b8 = self.W_BYTES + self.KV8
+        w16 = ragged_chunk_tokens(b16, f, chip=chip, cap=1 << 14)
+        w8 = ragged_chunk_tokens(b8, f, chip=chip, cap=1 << 14)
+        assert w8 < w16                          # fixed batch: shorter tick
+        b8_cap = self.W_BYTES + 2 * self.KV8     # ~2x admitted slots
+        assert b8_cap > b16                      # scale planes: strictly
+        w8_cap = ragged_chunk_tokens(b8_cap, f, chip=chip, cap=1 << 14)
+        assert w8_cap > w8
+        assert w8_cap >= w16
+
+    def test_decoder_reports_the_true_int8_stream(self):
+        """step_hbm_bytes on a real decoder pair: the int8 pool's KV
+        leg is int8 payload + 8B/token/layer of f32 scales (K and V),
+        priced exactly — not an optimistic 2x."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import build_mesh
+        from paddle_tpu.models import GPT, gpt_tiny
+        from paddle_tpu.serving import PagedGPTDecoder
+        paddle.seed(0)
+        build_mesh(dp=1)
+        model = GPT(gpt_tiny(max_seq_len=64, dtype="float32",
+                             remat=False))
+        model.eval()
+        cfg = model.cfg
+        d8 = PagedGPTDecoder(model, num_pages=8, page_size=16,
+                             max_batch=2, kv_quant="int8")
+        hd = cfg.num_heads * cfg.head_dim
+        assert d8.kv_token_bytes == 2 * (hd + 4)
+        ctx = 32
+        got = d8.step_hbm_bytes(avg_ctx=ctx)
+        want_kv = 2 * cfg.num_layers * ctx * 2 * (hd + 4)
+        assert got - d8.step_hbm_bytes(avg_ctx=ctx, batch=0) == want_kv

@@ -111,3 +111,84 @@ def test_quantized_linear_w4_layer():
     got = m(x).numpy()
     rel = np.abs(got - fp).mean() / (np.abs(fp).mean() + 1e-9)
     assert rel < 0.3, rel
+
+
+def test_kernel_covers_unaligned_n_and_long_s():
+    """Previously-fallback shapes stay on the Pallas path: N not a
+    multiple of block_n (vocab projections) pads to the block and
+    S > 4096 (long prefill rows) tiles over the grid — kernel pinned
+    == jnp reference on both, and on their combination."""
+    rng = np.random.RandomState(3)
+    for S, K, N, bn in ((4100, 32, 300, 256),   # both at once
+                        (3, 64, 50, 32),        # N % block_n != 0
+                        (4200, 32, 64, 64)):    # S > 4096 alone
+        x = jnp.asarray(rng.randn(S, K).astype("float32"))
+        w = rng.randn(K, N).astype("float32")
+        packed, scale = quantize_w4(w)
+        got = w4_matmul(x, packed, scale, K, block_n=bn)
+        ref = _w4_ref(x, packed, scale, K)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{(S, K, N, bn)}")
+
+
+def test_quantize_w4_odd_k_roundtrip():
+    """Odd in-dim: the packer zero-pads the last nibble (value 8 ==
+    dequant 0) and the unpack slices back to exactly K rows — the
+    round-trip reproduces quantize_weight's int4 grid bit-for-bit and
+    the matmul ignores the phantom row."""
+    from paddle_tpu.ops.w4_matmul import _unpack_w4
+    from paddle_tpu.quantization import quantize_weight
+    rng = np.random.RandomState(5)
+    K, N = 9, 12                                 # odd K
+    w = rng.randn(K, N).astype("float32")
+    packed, scale = quantize_w4(w)
+    assert packed.shape == ((K + 1) // 2, N)
+    q = np.asarray(_unpack_w4(packed, K))
+    assert q.shape == (K, N)
+    q_ref, s_ref = quantize_weight(w, axis=0, bits=4)
+    np.testing.assert_array_equal(q, np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(scale),
+                               np.asarray(s_ref).reshape(-1))
+    x = jnp.asarray(rng.randn(3, K).astype("float32"))
+    got = np.asarray(w4_matmul(x, packed, scale, K))
+    want = np.asarray(x) @ (q * np.asarray(scale))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_model_w4_swaps_nested_sublayers():
+    """quantize_model(weight_bits=4) walks NESTED containers: every
+    Linear above the width floor swaps for QuantizedLinearW4 wherever
+    it sits (sub-Layer of a sub-Layer included), smaller ones stay."""
+    from paddle_tpu.quantization import QuantizedLinearW4, quantize_model
+
+    class Block(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(64, 128)
+            self.tiny = paddle.nn.Linear(64, 8)   # under the floor
+
+        def forward(self, x):
+            return self.fc(x) + 0 * self.tiny(x).sum()
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.stem = paddle.nn.Linear(32, 64)
+            self.block = Block()
+
+        def forward(self, x):
+            return self.block(self.stem(x))
+
+    paddle.seed(0)
+    net = Net()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 32).astype("float32"))
+    fp = net(x).numpy()
+    quantize_model(net, min_out_features=16, weight_bits=4)
+    assert isinstance(net.stem, QuantizedLinearW4)
+    assert isinstance(net.block.fc, QuantizedLinearW4)      # nested swap
+    assert type(net.block.tiny) is paddle.nn.Linear        # floor kept
+    got = net(x).numpy()
+    rel = np.abs(got - fp).mean() / (np.abs(fp).mean() + 1e-9)
+    assert rel < 0.3, rel
