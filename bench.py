@@ -715,10 +715,10 @@ def run_ragged_stall(gen=48, long_prompt=448, chunk=16, k_max=2):
     dec = PagedGPTDecoder(model, num_pages=pages + 2,
                           page_size=page_size, max_batch=2)
 
-    def scenario(ragged):
+    def scenario(ragged, trace=None):
         eng = ContinuousBatchingEngine(dec, max_new_tokens=gen,
                                        k_max=k_max, ragged=ragged,
-                                       chunk_tokens=chunk)
+                                       chunk_tokens=chunk, trace=trace)
         rid = eng.submit(streamer)
         state = {"submit_t": None, "events": []}
 
@@ -769,6 +769,33 @@ def run_ragged_stall(gen=48, long_prompt=448, chunk=16, k_max=2):
     print(json.dumps({"metric": "gpt_decode_stall_p99_ms",
                       "value": ragged["p99_ms"], "unit": "ms",
                       **row}), flush=True)
+    # PADDLE_TPU_BENCH_TRACE=/path.json: replay the ragged scenario
+    # once more with a flight recorder attached (AFTER the measured
+    # runs — the committed ratio stays untraced) and export the
+    # chrome-trace timeline + the roofline-drift ledger. On CPU the
+    # drift ratio is dominated by the host gap (predictions price the
+    # target chip); on-chip this line is the mispricing detector
+    # (docs/observability.md).
+    trace_path = os.environ.get("PADDLE_TPU_BENCH_TRACE")
+    if trace_path:
+        from paddle_tpu.serving import FlightRecorder, export_chrome_trace
+        rec = FlightRecorder()
+        scenario(True, trace=rec)
+        export_chrome_trace(trace_path, recorders=rec)
+        drift = rec.drift_report()
+        # worst departure in EITHER direction (the analyzer's
+        # worst_ratio convention): overpriced shapes must not read as
+        # near-clean just because their ratio sits below 1
+        worst = max((max(d["ratio"], 1.0 / d["ratio"])
+                     for d in drift if d["ratio"] > 0), default=0.0)
+        log(f"ragged_stall: flight trace -> {trace_path} "
+            f"({len(rec.events)} events, worst drift {worst:.1f}x)")
+        print(json.dumps({"metric": "serving_roofline_drift",
+                          "value": round(worst, 2),
+                          "unit": "measured_over_predicted",
+                          "shapes": len(drift),
+                          "trace_events": len(rec.events),
+                          "path": trace_path}), flush=True)
     return row
 
 

@@ -31,7 +31,23 @@ def build_tokenizer():
 
 
 def main():
-    quant = sys.argv[1] if len(sys.argv) > 1 else None
+    argv = sys.argv[1:]
+    args, trace_path = [], None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--trace="):
+            trace_path = a.split("=", 1)[1]
+        elif a == "--trace":
+            if i + 1 >= len(argv):
+                sys.exit("usage: serve_gpt.py [a8w8|w4a16] "
+                         "[--trace PATH | --trace=PATH]")
+            trace_path = argv[i + 1]
+            i += 1
+        else:
+            args.append(a)
+        i += 1
+    quant = args[0] if args else None
     paddle.seed(0)
     build_mesh(dp=1)
     tok, vocab_size = build_tokenizer()
@@ -47,7 +63,12 @@ def main():
     # horizons as token-budgeted chunks (serving.RaggedScheduler), so
     # a long prompt never stalls the other slots behind a blocking
     # prefill dispatch (docs/serving.md "Ragged scheduling").
-    eng = ContinuousBatchingEngine(dec, max_new_tokens=16)
+    # --trace=/path.json attaches the flight recorder: per-request
+    # lifecycle spans + per-horizon tick records with roofline drift,
+    # exported as one Perfetto-viewable chrome trace
+    # (docs/observability.md)
+    eng = ContinuousBatchingEngine(dec, max_new_tokens=16,
+                                   trace=bool(trace_path))
 
     prompts = ["the quick brown fox", "tpu chips compile fast",
                "the lazy dog"]
@@ -69,6 +90,14 @@ def main():
           f"{s.get('prefill_chunks', 0)} ragged prompt chunks / "
           f"{s['prefill_syncs']} blocking prefill syncs, "
           f"p50 {s.get('token_p50_ms', 0)} ms/token")
+    if trace_path:
+        from paddle_tpu.serving import export_chrome_trace
+        export_chrome_trace(trace_path, recorders=eng.trace)
+        drift = eng.trace.drift_report()
+        print(f"flight trace -> {trace_path} "
+              f"({len(eng.trace.events)} events, "
+              f"{sum(d['drifting'] for d in drift)} drifting shapes; "
+              "load in Perfetto / chrome://tracing)")
 
 
 if __name__ == "__main__":

@@ -16,7 +16,7 @@ from .pass_manager import Analyzer, register_analyzer
 __all__ = ["LayoutAnalyzer", "DtypeAnalyzer", "HostTransferAnalyzer",
            "GraphShapeAnalyzer", "CollectiveAnalyzer", "ServingAnalyzer",
            "PrefillStallAnalyzer", "TrainingAnalyzer", "KvQuantAnalyzer",
-           "COLLECTIVE_OPS", "MXU_OPS"]
+           "RooflineDriftAnalyzer", "COLLECTIVE_OPS", "MXU_OPS"]
 
 MXU_OPS = ("dot_general", "convolution")
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "all_to_all",
@@ -441,6 +441,84 @@ class PrefillStallAnalyzer(Analyzer):
                         "n_horizons": n_horizon,
                         "n_mixed_horizons": n_mixed,
                         "n_prefill_rows": chunk_rows}
+        return findings
+
+
+@register_analyzer
+class RooflineDriftAnalyzer(Analyzer):
+    """ROOFLINE-DRIFT: the scheduler's priced tick time must track the
+    measured one. Runs only when `ctx.extra["roofline_drift"]` carries
+    a flight-recorder drift report
+    (`serving.trace.FlightRecorder.drift_report()` — the
+    serve_schedule/page_ledger pattern applied to timing): one entry
+    per dispatch shape with its rolling mean predicted and measured
+    horizon seconds. A shape whose measured/predicted ratio exceeds
+    `ctx.extra["drift_factor"]` (default 3.0) is MISPRICED — the
+    roofline's max(compute, HBM, wire) no longer describes the
+    dispatch, so every schedule priced from it (horizon K, chunk
+    budget W, capacity slots) silently errs; an ERROR. A ratio below
+    1/factor (overpriced — the model leaves real capacity on the
+    table) is a WARNING. Shapes with fewer than
+    `ctx.extra["drift_min_samples"]` (default 3) samples are skipped:
+    a single cold tick is noise, not drift. Planted-defect tests feed
+    a deliberately mispriced dispatch; on-chip runs audit the real
+    recorder (CPU dev boxes drift by construction — the prediction
+    prices the target chip — so CI uses planted reports, not live CPU
+    timings)."""
+    name = "roofline-drift"
+
+    def run(self, program, ctx):
+        report = ctx.extra.get("roofline_drift")
+        if not report:
+            self.metrics = {"checked": False}
+            return []
+        factor = float(ctx.extra.get("drift_factor") or 3.0)
+        raw_min = ctx.extra.get("drift_min_samples")
+        # None check, not truthiness: an explicit 0 means "audit every
+        # shape, cold single ticks included"
+        min_n = 3 if raw_min is None else int(raw_min)
+        findings = []
+        n_checked = n_over = n_under = 0
+        worst = 1.0
+        for entry in report:
+            pred = float(entry.get("predicted_s") or 0.0)
+            meas = float(entry.get("measured_s") or 0.0)
+            n = int(entry.get("n") or 0)
+            if pred <= 0 or n < min_n:
+                continue
+            n_checked += 1
+            ratio = meas / pred
+            shape = "x".join(str(s) for s in (entry.get("shape") or []))
+            worst = max(worst, ratio, 1.0 / ratio if ratio > 0 else 1.0)
+            if ratio > factor:
+                n_over += 1
+                findings.append(Finding(
+                    "ROOFLINE-DRIFT", Severity.ERROR,
+                    f"dispatch shape [{shape}] measured {meas * 1e3:.3f} "
+                    f"ms vs priced {pred * 1e3:.3f} ms — {ratio:.1f}x "
+                    f"over the roofline (factor {factor:g}, n={n}): the "
+                    "cost model underprices this shape, so every "
+                    "schedule derived from it (horizon K, chunk budget, "
+                    "capacity) errs silently",
+                    suggested_fix="re-fit the pricing inputs for this "
+                    "shape (step_hbm_bytes / flops_per_token / "
+                    "measured_host_sync_s, chip spec) or exclude the "
+                    "pollution source from the measured window"))
+            elif ratio < 1.0 / factor:
+                n_under += 1
+                under = 1.0 / ratio if ratio > 0 else float("inf")
+                findings.append(Finding(
+                    "ROOFLINE-DRIFT", Severity.WARNING,
+                    f"dispatch shape [{shape}] measured {meas * 1e3:.3f} "
+                    f"ms vs priced {pred * 1e3:.3f} ms — "
+                    f"{under:.1f}x UNDER the roofline (n={n}): "
+                    "the model overprices this shape and leaves "
+                    "schedulable capacity unused"))
+        self.metrics = {"checked": True, "n_shapes": len(report),
+                        "n_checked": n_checked, "n_over": n_over,
+                        "n_under": n_under,
+                        "worst_ratio": round(worst, 3),
+                        "factor": factor}
         return findings
 
 

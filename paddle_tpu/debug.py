@@ -14,7 +14,7 @@ from .framework.core import Tensor, apply_op
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
            "input_pipeline_stats", "memory_report", "autotune",
-           "serving_stats"]
+           "serving_stats", "serving_report"]
 
 
 def serving_stats():
@@ -30,6 +30,60 @@ def serving_stats():
     it. Returns one summary dict per engine."""
     from .serving import serving_stats as _stats
     return _stats()
+
+
+def serving_report(drift_factor=None, print_report=False):
+    """Deep serving observability, one dict per live engine (sorted by
+    engine name/id like `serving_stats`): the `ServeStats` summary,
+    the recent scheduling-decision trace summarized (horizons,
+    prefill syncs, stalls), and — when the engine carries a flight
+    recorder (`ContinuousBatchingEngine(trace=...)`) — the rolling
+    roofline-drift ledger per dispatch shape with its mispriced
+    shapes flagged (`serving.trace.FlightRecorder.drift_report`; the
+    CI face of the same data is the `ROOFLINE-DRIFT` Graph Doctor
+    rule). When `host_syncs_per_token` says the host interposes too
+    often, this report says WHICH horizon shapes and WHY: drift > 1
+    means ticks run slower than priced (the scheduler fuses too few
+    ticks per sync), drift < 1 that the model overprices and leaves
+    capacity scheduled idle."""
+    from .serving.stats import live_engines
+    report = []
+    for eng in live_engines():
+        entry = {"stats": eng.stats.summary()}
+        events = eng.serve_schedule() if hasattr(eng, "serve_schedule") \
+            else []
+        if events:
+            entry["schedule"] = {
+                "horizons": sum(ev.get("kind") == "horizon"
+                                for ev in events),
+                "prefill_syncs": sum(ev.get("kind") == "prefill_sync"
+                                     for ev in events),
+                "stalled_prefill_syncs": sum(
+                    ev.get("kind") == "prefill_sync"
+                    and ev.get("decode_active", 0) > 0 for ev in events),
+            }
+        rec = getattr(eng, "trace", None)
+        if rec is not None:
+            drift = rec.drift_report(factor=drift_factor)
+            entry["drift"] = drift
+            entry["drifting_shapes"] = [d["shape"] for d in drift
+                                        if d["drifting"]]
+            entry["trace_events"] = len(rec.events)
+        report.append(entry)
+    if print_report:
+        for entry in report:
+            s = entry["stats"]
+            print(f"== {s['engine']}#{s['engine_id']} ==")
+            for key in ("stats", "schedule"):
+                if key in entry:
+                    print(f"  {key}: {entry[key]}")
+            for d in entry.get("drift", ()):
+                flag = "  << DRIFTING" if d["drifting"] else ""
+                print(f"  drift {d['shape']}: predicted "
+                      f"{d['predicted_s'] * 1e3:.3f} ms measured "
+                      f"{d['measured_s'] * 1e3:.3f} ms ratio "
+                      f"{d['ratio']:.2f} (n={d['n']}){flag}")
+    return report
 
 
 def autotune(target, *example_inputs, batch=None, hbm_budget=None,

@@ -7,6 +7,7 @@ the optimizer update, with params/optimizer state donated (updated in-place
 in HBM) and every tensor sharded per the GSPMD plan. XLA overlaps the
 collectives with compute on ICI.
 """
+import time
 import weakref
 from functools import partial
 
@@ -235,7 +236,44 @@ class Trainer:
         # fused multi-step programs, keyed by the STACKED batch signature
         # (which encodes the horizon length N in the leading dim)
         self._placed_multis = {}
+        # FLIGHT RECORDER (serving.trace.FlightRecorder, shared schema
+        # with the serving engines): off by default — attach_recorder
+        # turns step_multi horizons into tick records with predicted
+        # vs measured drift accounting. Every hook is a dead
+        # `if self.recorder is not None` branch.
+        self.recorder = None
+        self._rec_predicted_step_s = None
+        self._rec_last_t = None
         _LIVE_TRAINERS.add(self)
+
+    def attach_recorder(self, recorder, predicted_step_s=None):
+        """Attach a `serving.trace.FlightRecorder` (or True for a
+        default one): every `step_multi` horizon records a "train"
+        tick — N steps, measured dispatch-to-dispatch wall seconds,
+        and (when `predicted_step_s` is given, normally
+        `cost_model.roofline_step_time(...).step_s`) the roofline-
+        predicted horizon cost, feeding the same drift ledger the
+        serving engines use (`ROOFLINE-DRIFT` /
+        `debug.serving_report`). Returns the recorder."""
+        if recorder is True:
+            from ..serving.trace import FlightRecorder
+            recorder = FlightRecorder()
+        self.recorder = recorder
+        self._rec_predicted_step_s = predicted_step_s
+        self._rec_last_t = None
+        if recorder is not None:
+            recorder.meta.update(engine="Trainer",
+                                 donate=bool(self._donate))
+        return recorder
+
+    def mark_recorder_idle(self):
+        """Tell the recorder the loop is about to do non-training host
+        work (eval pass, checkpoint save, data stall): the next
+        horizon's dispatch-to-dispatch gap would book that pause as
+        horizon time, so it is measured from the dispatch call instead
+        and kept OUT of the drift ledger — the trainer's rendering of
+        the serving engines' polluted-window exclusion."""
+        self._rec_last_t = None
 
     def _mesh_place(self, tree):
         """Replicate any single-device leaf onto the full mesh. A state
@@ -510,6 +548,12 @@ class Trainer:
             if sched is not None:
                 for _ in range(int(n)):
                     sched.step()
+        t0 = time.perf_counter() if self.recorder is not None else None
+        # a signature never dispatched before will compile inside this
+        # window — a pollution source the drift ledger must skip, like
+        # the first horizon (the memo is the compile's proxy: first
+        # call per signature pays the XLA compile)
+        warm_sig = sig in self._placed_multis
         fn = self._placed_multi(sig, horizon_sh)
         (self.params, self.opt_state, self.gt_state, self.consts,
          losses) = fn(
@@ -518,6 +562,28 @@ class Trainer:
         # horizon-aware step accounting: state()/load_state round-trip
         # the TRUE device step count, not the host dispatch count
         self._host_step += int(n)
+        if self.recorder is not None:
+            # dispatch is NON-blocking, so this call's own wall time is
+            # not the horizon's: in a steady-state loop the dispatch-to-
+            # dispatch gap is (the next dispatch blocks on the donated
+            # carry), so measure that. The FIRST horizon after attach or
+            # mark_recorder_idle() has no previous dispatch — its call
+            # wall is recorded but kept out of the drift ledger (cold
+            # compiles and host pauses are pollution, the same
+            # exclusion the serving engines apply to prefill windows)
+            now = time.perf_counter()
+            steady = self._rec_last_t is not None and warm_sig
+            # the tick's chrome slice must span the window it measured:
+            # steady ticks start at the PREVIOUS dispatch, not this one
+            start = self._rec_last_t if self._rec_last_t is not None \
+                else t0
+            measured = now - start
+            self._rec_last_t = now
+            pred = self._rec_predicted_step_s
+            self.recorder.tick(
+                "train", ("train", int(n)), measured, ts=start,
+                predicted_s=(pred * int(n)) if pred else None,
+                drift=steady, k=int(n), decode_rows=0, prefill_rows=0)
         return losses
 
     def lower_step(self, batch, lr=0.0):

@@ -4,6 +4,8 @@ TPU-first: Model.fit compiles one whole train step (forward+loss+grads+update)
 with jax.jit via the functional optimizer path, donating params/opt-state so
 updates are in-place in HBM. Eager fallback keeps paddle debugging UX.
 """
+import time
+
 import numpy as np
 
 import jax
@@ -29,6 +31,11 @@ class Model:
         self.stop_training = False
         self._compiled_step = None
         self._compiled_multi = None
+        # optional serving.trace.FlightRecorder: fit(multi_step=N)
+        # horizons record "train" ticks on it (dead branch when None —
+        # the Trainer.attach_recorder discipline, hapi rendering)
+        self.flight_recorder = None
+        self._rec_last_t = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -170,9 +177,23 @@ class Model:
         in_vals = [self._leaf_value(x) for x in inputs_stack]
         lab_vals = [self._leaf_value(x) for x in labels_stack]
         lrs = jnp.asarray(np.asarray(lrs, np.float32))
+        rec = self.flight_recorder
+        t0 = time.perf_counter() if rec is not None else None
         self._params, self._opt_state, self._buffers, losses = \
             self._compiled_multi(self._params, self._buffers,
                                  self._opt_state, lrs, in_vals, lab_vals)
+        if rec is not None:
+            # same measurement discipline as Trainer.step_multi:
+            # dispatch is non-blocking, so steady-state horizon wall is
+            # the dispatch-to-dispatch gap (first horizon: call wall),
+            # and the tick's ts anchors at the window's START
+            now = time.perf_counter()
+            start = self._rec_last_t if self._rec_last_t is not None \
+                else t0
+            self._rec_last_t = now
+            n = int(lrs.shape[0])
+            rec.tick("train", ("fit", n), now - start, ts=start, k=n,
+                     decode_rows=0, prefill_rows=0)
         return losses
 
     @staticmethod
@@ -350,6 +371,11 @@ class Model:
         m-step scan compile for the tail."""
         logs = {}
         horizon = []        # [(step_idx, inputs, labels), ...]
+        # fresh epoch: the gap back to the previous epoch's last
+        # dispatch spans eval/checkpoint/callback host work, not a
+        # horizon — the next tick measures its own call wall instead
+        # (the Trainer.mark_recorder_idle discipline)
+        self._rec_last_t = None
 
         def log_loss(fallback=None):
             if loss_buf is not None:

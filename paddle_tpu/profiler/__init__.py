@@ -264,15 +264,27 @@ class Profiler:
             out.append(f"device trace dir: {self.log_dir}\n")
         return "".join(o for o in out if o)
 
+    def timeline_events(self):
+        """The host timeline (RecordEvent regions, step marks) as
+        chrome-trace event dicts, ts-sorted so the (0, 0) track is
+        monotonic (a nested region is APPENDED at its end time, so raw
+        timeline order is end-time order — a child's later start would
+        precede its parent's earlier one). Timestamps are raw
+        perf_counter microseconds, the same base
+        `serving.trace.FlightRecorder` stamps — `serving.trace
+        .export_chrome_trace` merges both onto one timeline."""
+        return sorted(
+            ({"name": n, "ph": "X", "ts": t0 * 1e6, "dur": d * 1e6,
+              "pid": 0, "tid": 0} for n, t0, d in self._timeline),
+            key=lambda e: e["ts"])
+
     def export(self, path=None, format="json"):
         """Writes the host timeline as a chrome-trace JSON (load with
-        json.load / chrome://tracing); returns the path."""
+        load_profiler_result / chrome://tracing); returns the path."""
         path = path or os.path.join(self.log_dir, "host_trace.json")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        events = [{"name": n, "ph": "X", "ts": t0 * 1e6, "dur": d * 1e6,
-                   "pid": 0, "tid": 0} for n, t0, d in self._timeline]
         with open(path, "w") as f:
-            json.dump({"traceEvents": events}, f)
+            json.dump({"traceEvents": self.timeline_events()}, f)
         return path
 
     def __enter__(self):

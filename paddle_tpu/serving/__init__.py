@@ -57,8 +57,11 @@ from .engine import ContinuousBatchingEngine, SpeculativeEngine
 from .prefix_cache import PrefixCache
 from .scheduler import RaggedScheduler
 from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
+from .trace import (FlightRecorder, export_chrome_trace,
+                    validate_chrome_trace)
 
 __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "SpeculativeEngine", "ServeStats", "serving_stats",
            "PrefixCache", "MultiDecodeOut", "RaggedMultiOut",
-           "RaggedScheduler"]
+           "RaggedScheduler", "FlightRecorder", "export_chrome_trace",
+           "validate_chrome_trace"]

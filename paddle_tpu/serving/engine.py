@@ -67,7 +67,7 @@ class ContinuousBatchingEngine:
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
                  max_new_tokens=64, k_max=None, host_sync_s=None,
                  prefix_cache=None, ragged=None, chunk_tokens=None,
-                 scheduler=None):
+                 scheduler=None, trace=None):
         if max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (the prefill forward always "
@@ -144,7 +144,84 @@ class ContinuousBatchingEngine:
             kv_pool_bytes=(decoder.num_pages - 1) * decoder.kv_page_bytes,
             kv_bytes_per_token=decoder.kv_page_bytes // decoder.page_size)
         self._submit_t = {}                  # rid -> submit wall time
+        # FLIGHT RECORDER (serving.trace.FlightRecorder): off by
+        # default; every hook below is a dead `if self.trace is not
+        # None` branch, so the untraced engine does zero trace work
+        # per tick (test-pinned). trace=True builds a default recorder.
+        if trace is True:
+            from .trace import FlightRecorder
+            trace = FlightRecorder()
+        self.trace = trace or None
+        self._trace_price = None         # (hbm, flops/token, sync_s)
+        self._trace_pool_mark = (0, 0)   # (cow, evictions) marks
+        self._trace_warm = set()         # dispatch shapes already compiled
+        if self.trace is not None:
+            self.trace.meta.update(
+                engine=type(self).__name__, k_max=self.k_max,
+                ragged=self.ragged, page_size=decoder.page_size,
+                kv_quant=decoder.kv_quant or "none")
         _ENGINES.add(self)
+
+    # ------------------------------------------------- flight recorder
+
+    def _price_horizon(self, k, w, prefill_rows):
+        """Roofline-PREDICTED wall cost of one dispatched horizon: k
+        mixed ticks (`cost_model.ragged_tick_roofline_s` — the decode
+        HBM leg plus the chunk rows' compute leg) plus ONE host sync.
+        The tick records pair this with the measured wall time; the
+        drift accounting (`FlightRecorder.drift_report` /
+        ROOFLINE-DRIFT) is the predicted-vs-measured ledger. Called
+        only with tracing on."""
+        from ..cost_model import (measured_host_sync_s,
+                                  ragged_tick_roofline_s)
+        if self._trace_price is None:
+            sched = self.scheduler
+            fpt = (sched.flops_per_token if sched is not None
+                   else 2.0 * self.d.cfg.num_params())
+            self._trace_price = (self.d.step_hbm_bytes(), fpt,
+                                 measured_host_sync_s())
+        hbm, fpt, sync = self._trace_price
+        tick = ragged_tick_roofline_s(hbm, w * prefill_rows, fpt)
+        return k * tick + sync
+
+    def _trace_pool_delta(self):
+        """Pool events since the previous tick record (CoW copies,
+        evictions), folded into each tick so the trace shows WHICH
+        horizon paid for cache churn. Called only with tracing on."""
+        cow, ev = self.stats.prefix_cow, self.stats.prefix_evictions
+        d = {"cow": cow - self._trace_pool_mark[0],
+             "evictions": ev - self._trace_pool_mark[1]}
+        self._trace_pool_mark = (cow, ev)
+        return d
+
+    def _trace_shape_warm(self, key):
+        """First dispatch of a compiled-program shape pays its XLA
+        compile inside the measured window — its tick is recorded but
+        kept OUT of the drift ledger (one compile sample would inflate
+        the rolling mean for hundreds of steady ticks). Called only
+        with tracing on."""
+        warm = key in self._trace_warm
+        self._trace_warm.add(key)
+        return warm
+
+    def _trace_admits(self, admitted, now):
+        """Admit events with the prefix-cache mount detail (cached
+        span, hit blocks) — the span segment between a request's
+        submit and first_token marks. Called only with tracing on."""
+        for slot, rid, ids, _pages in admitted:
+            meta = self._cache_meta.get(rid)
+            self.trace.record(
+                "admit", ts=now, rid=rid, slot=slot,
+                prompt_tokens=len(ids),
+                cached_tokens=int(meta[0]) if meta else 0,
+                hit_blocks=int(meta[2]) if meta else 0)
+
+    def _trace_progress(self, rid):
+        """Per-N-token progress mark (N = recorder.progress_every).
+        Called only with tracing on, from the token-processing loops."""
+        n = len(self._outputs[rid])
+        if n % self.trace.progress_every == 0:
+            self.trace.record("progress", rid=rid, tokens=n)
 
     def submit(self, prompt_ids):
         ids = [int(t) for t in np.asarray(
@@ -180,6 +257,9 @@ class ContinuousBatchingEngine:
         self._submit_t[rid] = time.perf_counter()
         self.stats.requests += 1
         self._queue.append((rid, ids))
+        if self.trace is not None:
+            self.trace.record("submit", ts=self._submit_t[rid], rid=rid,
+                              prompt_tokens=len(ids))
         return rid
 
     def _pages_for(self, n_tokens):
@@ -210,6 +290,8 @@ class ContinuousBatchingEngine:
             t0 = self._submit_t.get(rid)
             if t0 is not None:
                 self.stats.queue_wait_s.append(now - t0)
+        if self.trace is not None:
+            self._trace_admits(admitted, now)
         self._table_cache = None
         firsts = self._prefill_admitted(admitted)
         self.stats.prefill_syncs += 1
@@ -234,6 +316,8 @@ class ContinuousBatchingEngine:
             if t0 is not None:
                 self.stats.ttft_s.append(done_t - t0)
             self._outputs[rid] = [first]
+            if self.trace is not None:
+                self.trace.record("first_token", ts=done_t, rid=rid)
             self.stats.tokens += 1
             if (self.eos is not None and first == self.eos) \
                     or self.max_new <= 1:
@@ -401,6 +485,11 @@ class ContinuousBatchingEngine:
         pass                                 # SpeculativeEngine: _dlens
 
     def _retire(self, slot):
+        if self.trace is not None:
+            rid = self._slot_req[slot]
+            self.trace.record(
+                "retire", rid=rid,
+                tokens=len(self._outputs.get(rid, ())))
         shared = self._slot_shared[slot]
         for pid in self._slot_pages[slot]:
             if pid in shared:
@@ -491,6 +580,8 @@ class ContinuousBatchingEngine:
             tok = int(nxt[s])
             self._outputs[rid].append(tok)
             self.stats.tokens += 1
+            if self.trace is not None:
+                self._trace_progress(rid)
             self._lens[s] += 1
             self._tokens[s] = tok
             done = (self.eos is not None and tok == self.eos) or \
@@ -539,11 +630,25 @@ class ContinuousBatchingEngine:
             t0 = time.perf_counter()
             before = self.stats.tokens
             before_p = self.stats.prefill_syncs
-            self.step()
+            active = self.step()
             dt = time.perf_counter() - t0
             if step_times is not None:
                 step_times.append(dt)
             n = self.stats.tokens - before
+            if self.trace is not None and active:
+                # a step that contained a blocking prefill is not a
+                # decode tick: price it as None so the drift ledger
+                # stays a tick-roofline comparison (same exclusion as
+                # token_time_s below)
+                clean = self.stats.prefill_syncs == before_p
+                warm = self._trace_shape_warm(("tick",))
+                self.trace.tick(
+                    "serve", ("tick", 1, 1), dt, ts=t0,
+                    predicted_s=(self._price_horizon(1, 1, 0)
+                                 if clean else None),
+                    drift=clean and warm, k=1, w=1,
+                    decode_rows=active, prefill_rows=0, tokens=n,
+                    pool=self._trace_pool_delta())
             # token_time_s is the STEADY-STATE decode latency: a sync
             # that contained a prefill is dominated by it (orders of
             # magnitude more work than a tick) and would turn p99 into
@@ -593,7 +698,7 @@ class ContinuousBatchingEngine:
         return tokens, lens, done, rem
 
     def _process_block(self, meta, inflight, step_times,
-                       prefilled_since=False):
+                       prefilled_since=False, trace_ev=None):
         """Fetch + bookkeep one finished horizon. Called AFTER the next
         horizon is dispatched, so the device→host wait overlaps it."""
         block_d, done_before_d, k, rids, t0, had_prefill = meta
@@ -612,6 +717,8 @@ class ContinuousBatchingEngine:
                 self._outputs[rid].append(tok)
                 self.stats.tokens += 1
                 emitted += 1
+                if self.trace is not None:
+                    self._trace_progress(rid)
                 self._lens[s] += 1
                 self._tokens[s] = tok
                 if (self.eos is not None and tok == self.eos) or \
@@ -621,6 +728,18 @@ class ContinuousBatchingEngine:
         dt = time.perf_counter() - t0
         if step_times is not None:
             step_times.append(dt)
+        if trace_ev is not None:
+            # a window containing a prefill, the shape's first
+            # (compiling) dispatch, or another shape's compile landing
+            # inside this still-open window, is excluded from the
+            # drift ledger (same pollution rule as the token
+            # percentiles)
+            self.trace.tick_complete(
+                trace_ev, dt, tokens=emitted,
+                drift=(not (had_prefill or prefilled_since)
+                       and trace_ev.get("warm_shape", True)
+                       and not trace_ev.get("compiled_in_window")),
+                pool=self._trace_pool_delta())
         # steady-state decode latency only: the block's dt window spans
         # its dispatch iteration AND the next iteration up to this
         # call, so a prefill in either (had_prefill at dispatch,
@@ -645,6 +764,7 @@ class ContinuousBatchingEngine:
         horizon can never read a page that was re-written under it."""
         S = self.d.max_batch
         pending = None               # the in-flight horizon's meta
+        pending_ev = None            # its open tick record (trace on)
         carry = None                 # device (tokens, lens, done, rem)
         inflight = [0] * S           # dispatched-not-yet-processed ticks
         while (self._queue or pending is not None
@@ -680,6 +800,7 @@ class ContinuousBatchingEngine:
             disp = [s for s in range(S) if self._slot_req[s] is not None
                     and self._budget_left(s) - inflight[s] > 0]
             meta = None
+            meta_ev = None
             if disp:
                 k = self._horizon(disp, inflight)
                 if self._table_cache is None:
@@ -700,12 +821,26 @@ class ContinuousBatchingEngine:
                 meta = (out.tokens_block, out.done_before, k,
                         {s: self._slot_req[s] for s in disp}, t0,
                         prefilled)
+                if self.trace is not None:
+                    meta_ev = self.trace.tick_dispatch(
+                        "serve", ("decode", k, 1), ts=t0,
+                        predicted_s=self._price_horizon(k, 1, 0), k=k,
+                        w=1, decode_rows=len(disp), prefill_rows=0,
+                        warm_shape=self._trace_shape_warm(("decode", k)))
+                    if pending_ev is not None and \
+                            not meta_ev["warm_shape"]:
+                        # THIS dispatch's compile ran inside the
+                        # PENDING tick's still-open measured window
+                        # (processing closes after the next dispatch)
+                        pending_ev["compiled_in_window"] = True
             if pending is not None:
                 self._process_block(pending, inflight, step_times,
-                                    prefilled_since=prefilled)
+                                    prefilled_since=prefilled,
+                                    trace_ev=pending_ev)
                 if on_sync is not None:
                     on_sync(self)
             pending = meta
+            pending_ev = meta_ev
         return dict(self._outputs)
 
     # -- ragged scheduling (chunked prefill INSIDE the decode horizon) --
@@ -724,6 +859,8 @@ class ContinuousBatchingEngine:
             t0 = self._submit_t.get(rid)
             if t0 is not None:
                 self.stats.queue_wait_s.append(now - t0)
+        if self.trace is not None:
+            self._trace_admits(admitted, now)
         self._table_cache = None
         plans = []
         for slot, rid, ids, pages in admitted:
@@ -750,6 +887,8 @@ class ContinuousBatchingEngine:
         t0 = self._submit_t.pop(rid, None)
         if t0 is not None:
             self.stats.ttft_s.append(time.perf_counter() - t0)
+        if self.trace is not None:
+            self.trace.record("first_token", rid=rid)
         self._publish_blocks(rid, slot)
         # prompt fully consumed; the emitted token is not consumed yet
         self._lens[slot] = self._prompt_len[slot]
@@ -793,7 +932,8 @@ class ContinuousBatchingEngine:
         pend_n = pend_n.at[idx].set(jnp.asarray(ns))
         return tokens, lens, done, rem, pend, pend_n
 
-    def _process_ragged_block(self, meta, inflight, step_times):
+    def _process_ragged_block(self, meta, inflight, step_times,
+                              trace_ev=None):
         """Fetch + bookkeep one finished mixed horizon (called AFTER
         the next horizon is dispatched, so the device->host wait
         overlaps it). The per-tick `emitted` mask separates real
@@ -831,6 +971,8 @@ class ContinuousBatchingEngine:
                 self._outputs[rid].append(tok)
                 self.stats.tokens += 1
                 n_emitted += 1
+                if self.trace is not None:
+                    self._trace_progress(rid)
                 self._tokens[s] = tok
                 if (self.eos is not None and tok == self.eos) or \
                         len(self._outputs[rid]) >= self.max_new:
@@ -839,6 +981,17 @@ class ContinuousBatchingEngine:
         dt = time.perf_counter() - t0
         if step_times is not None:
             step_times.append(dt)
+        if trace_ev is not None:
+            # a compiling dispatch (this shape's first, or another
+            # shape's compile landing inside this still-open window)
+            # stays out of the drift ledger; steady ragged windows ARE
+            # the honest tick (chunk cost included by design — see
+            # token_time_s above)
+            self.trace.tick_complete(
+                trace_ev, dt, tokens=n_emitted,
+                drift=(trace_ev.get("warm_shape", True)
+                       and not trace_ev.get("compiled_in_window")),
+                pool=self._trace_pool_delta())
         if n_emitted:
             self.stats.token_time_s.extend([dt / n_emitted] * n_emitted)
 
@@ -895,6 +1048,7 @@ class ContinuousBatchingEngine:
         S = self.d.max_batch
         sched = self.scheduler
         pending = None               # the in-flight horizon's meta
+        pending_ev = None            # its open tick record (trace on)
         carry = None                 # (tokens, lens, done, rem, pend, pend_n)
         inflight = [0] * S           # in-flight EMISSION ticks per slot
         while (self._queue or pending is not None
@@ -910,6 +1064,7 @@ class ContinuousBatchingEngine:
             live = {s: self._slot_req[s] for s in range(S)
                     if self._slot_req[s] is not None}
             meta = None
+            meta_ev = None
             plan = sched.plan(live,
                               {s: self._budget_left(s) for s in live},
                               inflight) if live else None
@@ -938,11 +1093,30 @@ class ContinuousBatchingEngine:
                      "prefill_rows": plan.prefill_rows})
                 meta = (out.tokens_block, out.emitted, plan.k,
                         dict(live), plan.emit_ticks, t0)
+                if self.trace is not None:
+                    meta_ev = self.trace.tick_dispatch(
+                        "serve", ("ragged", plan.k, plan.w), ts=t0,
+                        predicted_s=self._price_horizon(
+                            plan.k, plan.w, plan.prefill_rows),
+                        k=plan.k, w=plan.w,
+                        decode_rows=len(live) - plan.prefill_rows,
+                        prefill_rows=plan.prefill_rows,
+                        # the jit key is (k, w, table width): a fresh
+                        # combination compiles inside this window
+                        warm_shape=self._trace_shape_warm(
+                            ("ragged", plan.k, plan.w, width)))
+                    if pending_ev is not None and \
+                            not meta_ev["warm_shape"]:
+                        # see _run_multi: the compile lands in the
+                        # pending tick's still-open window
+                        pending_ev["compiled_in_window"] = True
             if pending is not None:
-                self._process_ragged_block(pending, inflight, step_times)
+                self._process_ragged_block(pending, inflight, step_times,
+                                           trace_ev=pending_ev)
                 if on_sync is not None:
                     on_sync(self)
             pending = meta
+            pending_ev = meta_ev
         return dict(self._outputs)
 
 
@@ -964,7 +1138,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
     """
 
     def __init__(self, decoder, draft_decoder, eos_token_id=None,
-                 max_new_tokens=64, k=4):
+                 max_new_tokens=64, k=4, trace=None):
         if decoder.sampling != draft_decoder.sampling:
             raise ValueError(
                 "speculative decoding needs the SAME sampling config on "
@@ -990,7 +1164,8 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         # verify windows WRITE up to k positions past the accepted
         # length, which would dirty mounted shared pages — chunked
         # admission for the twin pools is an open item.)
-        super().__init__(decoder, eos_token_id, max_new_tokens, k_max=1)
+        super().__init__(decoder, eos_token_id, max_new_tokens, k_max=1,
+                         trace=trace)
         self.draft = draft_decoder
         self.k = int(k)
         self._draft_free = list(range(draft_decoder.num_pages - 2, -1, -1))
@@ -1132,6 +1307,8 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             for t in emitted:
                 self._outputs[rid].append(t)
                 self.stats.tokens += 1
+                if self.trace is not None:
+                    self._trace_progress(rid)
                 if (self.eos is not None and t == self.eos) or \
                         len(self._outputs[rid]) >= self.max_new:
                     done = True      # tokens speculated past the stop
@@ -1139,3 +1316,24 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             if done:
                 self._retire(s)
         return len(active)
+
+    def _price_horizon(self, k, w, prefill_rows):
+        """One SPEC step's roofline price, overriding the plain decode
+        tick: k device-resident draft ticks (draft pool HBM leg) + one
+        (k+1)-position verify forward over the target (HBM vs window
+        compute) + the step's TWO host syncs (draft fetch, verify
+        fetch). Without this the per-tick loop would price a spec step
+        as one target tick and the drift ledger would flag a correctly
+        performing engine ~k-fold 'underpriced'."""
+        from ..cost_model import (decode_tick_roofline_s,
+                                  measured_host_sync_s,
+                                  ragged_tick_roofline_s)
+        if self._trace_price is None:
+            self._trace_price = (self.d.step_hbm_bytes(),
+                                 2.0 * self.d.cfg.num_params(),
+                                 measured_host_sync_s())
+            self._trace_draft_hbm = self.draft.step_hbm_bytes()
+        hbm, fpt, sync = self._trace_price
+        draft = self.k * decode_tick_roofline_s(self._trace_draft_hbm)
+        verify = ragged_tick_roofline_s(hbm, self.k + 1, fpt)
+        return draft + verify + 2 * sync

@@ -6,12 +6,19 @@ bounded sliding window (deque maxlen) so a long-lived engine's
 telemetry stays O(1) memory and O(window) to summarize.
 """
 import collections
+import itertools
 import weakref
 from dataclasses import dataclass, field
 
 import numpy as np
 
 __all__ = ["ServeStats", "serving_stats"]
+
+# monotone per-process id: ServeStats instances (and therefore engines)
+# get a stable creation-order identity, so `serving_stats()` output is
+# deterministically ordered across runs (the WeakSet iterates in hash
+# order, which is not)
+_STATS_SEQ = itertools.count()
 
 
 # every live engine, for debug.serving_stats() (mirrors the prefetcher
@@ -48,6 +55,7 @@ class ServeStats:
     write into a page it mounted shared), `prefix_evictions` refcount-0
     pages reclaimed from the cache under pool pressure."""
     engine: str = ""
+    engine_id: int = -1          # creation order (set in __post_init__)
     k_max: int = 1
     requests: int = 0            # submitted
     completed: int = 0           # retired with output
@@ -84,6 +92,10 @@ class ServeStats:
         # number)
         default_factory=_window)
 
+    def __post_init__(self):
+        if self.engine_id < 0:
+            self.engine_id = next(_STATS_SEQ)
+
     @property
     def host_syncs_per_token(self):
         return self.decode_syncs / self.tokens if self.tokens else 0.0
@@ -95,7 +107,8 @@ class ServeStats:
         return self.prefix_hits / n if n else 0.0
 
     def summary(self):
-        d = {"engine": self.engine, "k_max": self.k_max,
+        d = {"engine": self.engine, "engine_id": self.engine_id,
+             "k_max": self.k_max,
              "requests": self.requests, "completed": self.completed,
              "tokens": self.tokens, "ticks": self.ticks,
              "decode_syncs": self.decode_syncs,
@@ -122,12 +135,19 @@ class ServeStats:
         if self.occupancy:
             d["mean_slot_occupancy"] = round(
                 float(np.mean(self.occupancy)), 4)
+        # queue wait and TTFT report p50 AND p99: tail TTFT is the
+        # latency-tier SLO number (a mean-friendly p50 hides exactly
+        # the admission stalls an SLO class must bound)
         if self.queue_wait_s:
             d["queue_wait_p50_ms"] = round(
                 float(np.percentile(self.queue_wait_s, 50)) * 1e3, 3)
+            d["queue_wait_p99_ms"] = round(
+                float(np.percentile(self.queue_wait_s, 99)) * 1e3, 3)
         if self.ttft_s:
             d["ttft_p50_ms"] = round(
                 float(np.percentile(self.ttft_s, 50)) * 1e3, 3)
+            d["ttft_p99_ms"] = round(
+                float(np.percentile(self.ttft_s, 99)) * 1e3, 3)
         if self.token_time_s:
             tot = float(np.sum(self.token_time_s))
             d["tokens_per_sec"] = round(len(self.token_time_s) / tot, 1) \
@@ -139,7 +159,17 @@ class ServeStats:
         return d
 
 
+def live_engines():
+    """Every live engine, deterministically ordered by (engine name,
+    creation id) — THE ordering contract for serving telemetry
+    front doors (`serving_stats`, `debug.serving_report`): the WeakSet
+    iterates in hash order, which would make logs and doctests flap
+    across runs."""
+    return sorted(_ENGINES,
+                  key=lambda e: (e.stats.engine, e.stats.engine_id))
+
+
 def serving_stats():
     """ServeStats summaries of every live engine (debug.serving_stats
-    front door)."""
-    return [e.stats.summary() for e in list(_ENGINES)]
+    front door), deterministically ordered (`live_engines`)."""
+    return [e.stats.summary() for e in live_engines()]

@@ -565,6 +565,84 @@ def test_serving_rule_catches_host_callback_in_fused_loop():
     assert report2.metrics["serving"]["n_host_transfers"] == 0
 
 
+def test_roofline_drift_rule_planted_mispricing():
+    """ROOFLINE-DRIFT planted defect: a drift report whose measured
+    horizon times track the priced roofline audits clean; a
+    deliberately MISPRICED dispatch shape (measured 10x the priced
+    max(compute, HBM, wire)) is the silent-scheduling-error class and
+    an ERROR; an overpriced shape (capacity left idle) is a WARNING.
+    Without extra["roofline_drift"] the rule never fires."""
+    program = lower_callable(lambda x: x + 1.0,
+                             jnp.zeros((2,), jnp.float32), name="decode")
+    pm = PassManager(["roofline-drift"])
+
+    def entry(shape, pred, meas, n=8):
+        return {"shape": list(shape), "n": n, "predicted_s": pred,
+                "measured_s": meas, "ratio": meas / pred}
+
+    clean = [entry(("ragged", 8, 16), 1e-3, 1.4e-3),
+             entry(("decode", 8, 1), 8e-4, 9e-4),
+             # under the sample floor: one cold tick is noise
+             entry(("ragged", 1, 1), 1e-3, 99.0, n=1)]
+    report = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": clean}))
+    assert report.by_rule("ROOFLINE-DRIFT") == []
+    m = report.metrics["roofline-drift"]
+    assert m["checked"] and m["n_checked"] == 2 and m["n_over"] == 0
+
+    planted = clean + [entry(("ragged", 8, 64), 1e-3, 1e-2)]
+    report2 = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": planted}))
+    hits = report2.by_rule("ROOFLINE-DRIFT")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "ragged" in hits[0].message and "10.0x over" in hits[0].message
+    assert report2.metrics["roofline-drift"]["n_over"] == 1
+
+    # overpriced: schedulable capacity left on the table -> WARNING
+    over = [entry(("train", 4), 1e-2, 1e-3)]
+    report3 = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": over}))
+    hits3 = report3.by_rule("ROOFLINE-DRIFT")
+    assert hits3 and hits3[0].severity == Severity.WARNING
+    assert "UNDER" in hits3[0].message
+
+    # the factor is configurable: the same mispriced shape passes a
+    # loose factor
+    report4 = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": planted, "drift_factor": 20}))
+    assert report4.by_rule("ROOFLINE-DRIFT") == []
+
+    # scope: no drift report on the context -> not this rule's business
+    report5 = pm.run(program, AnalysisContext(name="s"))
+    assert report5.by_rule("ROOFLINE-DRIFT") == []
+    assert report5.metrics["roofline-drift"] == {"checked": False}
+
+
+def test_roofline_drift_fires_on_live_recorder_ledger():
+    """The rule consumes exactly what the flight recorder emits: a
+    FlightRecorder fed a mispriced dispatch (tick_complete measured far
+    over predicted_s) produces a drift_report() the analyzer flags,
+    red→green once the pricing is fixed."""
+    from paddle_tpu.serving import FlightRecorder
+    program = lower_callable(lambda x: x + 1.0,
+                             jnp.zeros((2,), jnp.float32), name="decode")
+    pm = PassManager(["roofline-drift"])
+
+    def ledger(pred):
+        rec = FlightRecorder()
+        for _ in range(4):
+            rec.tick("serve", ("ragged", 4, 8), measured_s=4e-3,
+                     predicted_s=pred, k=4, w=8)
+        return rec.drift_report()
+
+    bad = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": ledger(1e-4)}))
+    assert bad.by_rule("ROOFLINE-DRIFT"), "mispriced ledger not caught"
+    good = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": ledger(3e-3)}))
+    assert good.by_rule("ROOFLINE-DRIFT") == []
+
+
 def test_prefill_stall_rule_audits_schedule_trace():
     """SERVE-PREFILL-STALL planted defect: a scheduling trace whose
     prompts all streamed in as horizon chunks (or whose only blocking

@@ -6,6 +6,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler as prof_mod
@@ -63,3 +64,53 @@ def test_profiler_op_hook_removed_after_stop():
     assert core._op_profiler is p
     p.stop()
     assert core._op_profiler is None
+
+
+def test_chrome_trace_round_trip_preserves_nesting(tmp_path):
+    """export_chrome_tracing -> load_profiler_result round-trip:
+    RecordEvent region names, timestamps and NESTING survive — a child
+    region's exported interval sits inside its parent's, the exported
+    events are ts-sorted per track (the Perfetto render contract the
+    serving.trace schema gate checks), and durations match what the
+    profiler measured."""
+    import time as _time
+
+    from paddle_tpu.profiler import export_chrome_tracing, load_profiler_result
+    from paddle_tpu.serving import validate_chrome_trace
+
+    paths = []
+    handler = export_chrome_tracing(str(tmp_path))
+    p = Profiler(timer_only=True,
+                 on_trace_ready=lambda prof: paths.append(handler(prof)))
+    p.start()
+    for _ in range(2):
+        with RecordEvent("outer"):
+            _time.sleep(0.002)
+            with RecordEvent("inner"):
+                _time.sleep(0.002)
+            _time.sleep(0.001)
+        p.step()
+    p.stop()
+
+    assert paths, "on_trace_ready never exported"
+    loaded = load_profiler_result(paths[-1])
+    events = loaded["traceEvents"]
+    assert validate_chrome_trace(loaded) == []
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert len(by_name["outer"]) == 2 and len(by_name["inner"]) == 2
+    assert "step#0" in by_name and "step#1" in by_name
+    # ts-sorted on the single host track
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    # nesting: each inner interval is CONTAINED in one outer interval
+    for inner in by_name["inner"]:
+        assert any(o["ts"] <= inner["ts"] and
+                   inner["ts"] + inner["dur"] <= o["ts"] + o["dur"]
+                   for o in by_name["outer"]), (inner, by_name["outer"])
+    # measured durations survive the round-trip (us vs the stats table)
+    stat = p._event_stats["inner"]
+    total_us = sum(e["dur"] for e in by_name["inner"])
+    assert total_us == pytest.approx(stat.total * 1e6, rel=1e-6)
+    assert all(e["dur"] >= 2000 for e in by_name["inner"])   # >= sleep
