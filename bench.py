@@ -799,6 +799,141 @@ def run_ragged_stall(gen=48, long_prompt=448, chunk=16, k_max=2):
     return row
 
 
+def run_ragged_pad(gen=40, long_prompt=224, chunk=16, k_max=2,
+                   streamers=15):
+    """Mixed-horizon PACKED-vs-DENSE layout A/B: pad fraction, CPU
+    wall-clock and compiled-variant count of the same workload run
+    through the packed [total_new_tokens] token-stream dispatch and
+    the dense [S, w] window twin (`packed=False`). The workload is the
+    packed layout's motivating shape: many decode rows sharing
+    horizons with one long chunking prompt — on the dense layout every
+    decode row pays w-1 padded window columns per mixed tick (S*w
+    dispatched for ~S-1+w real tokens), on the packed layout the tick
+    pays its pow2 total-token bucket. Two short odd-length prompts
+    arrive late so the dense path re-buckets on the (S, w) grid (extra
+    compiled variants) while the packed path's totals collapse into
+    existing buckets (w rides as a traced scalar).
+
+    Streams are byte-identical between the two engines (the layout
+    twin invariant, test-pinned); this scenario banks the THREE
+    layout claims: pad fraction drops >= 3x, wall-clock no worse,
+    compiled-variant count (jit cache entries) strictly lower."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedGPTDecoder
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    S = streamers + 1
+    cfg = gpt_tiny(hidden_size=256, num_layers=4, num_heads=8,
+                   max_seq_len=long_prompt + gen + 64, dtype="float32",
+                   remat=False)
+    model = GPT(cfg)
+    model.eval()
+    page_size = 32
+    rng = np.random.RandomState(0)
+    stream_ids = [rng.randint(0, cfg.vocab_size, 2).astype(np.int32)
+                  for _ in range(2 * streamers)]
+    long_ids = rng.randint(0, cfg.vocab_size, long_prompt).astype(np.int32)
+    odd_ids = [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (5, 3)]
+    per_seq = (long_prompt + gen) // page_size + 2
+    pages = S * ((8 + gen) // page_size + 2) + per_seq + 8
+
+    # ONE decoder shared by every run (the run_ragged_stall compile
+    # discipline: jit memos are per-instance, so only a shared
+    # instance lets the warm-up runs warm the measured runs)
+    dec = PagedGPTDecoder(model, num_pages=pages + 2,
+                          page_size=page_size, max_batch=S)
+
+    def scenario(packed):
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=gen,
+                                       k_max=k_max, ragged=True,
+                                       chunk_tokens=chunk, packed=packed)
+        # one slot stays FREE so later odd-length arrivals admit (and
+        # chunk) at different times — each distinct suffix cover is a
+        # fresh (S, w) bucket for the dense grid, while the packed
+        # totals keep collapsing into the same pow2 buckets
+        rids = [eng.submit(ids) for ids in stream_ids[:streamers - 1]]
+        state = {"sent": 0}
+
+        def on_sync(e):
+            n = len(e._outputs.get(rids[0], []))
+            # the long prompt lands mid-stream; the odd short prompts
+            # arrive later (staggered); a SECOND streamer wave keeps
+            # the batch full while the long prompt drains its decode
+            # budget (a near-empty batch pads both layouts alike — a
+            # production engine at load is the comparison that matters)
+            if state["sent"] == 0 and n >= gen // 4:
+                e.submit(long_ids)
+                state["sent"] = 1
+            elif state["sent"] == 1 and n >= 3 * gen // 4:
+                e.submit(odd_ids[0])
+                state["sent"] = 2
+            elif state["sent"] == 2 and n >= 3 * gen // 4 + 4:
+                e.submit(odd_ids[1])
+                state["sent"] = 3
+            elif state["sent"] == 3 and n >= gen - 2:
+                # wave 2 rides into the slots wave 1 frees (an
+                # overflow request would drain ALONE at the end —
+                # padding both layouts alike); sized so the admission's
+                # token total stays inside the mixed horizons' pow2
+                # bucket
+                for ids in stream_ids[streamers:2 * streamers - 4]:
+                    e.submit(ids)
+                state["sent"] = 4
+
+        t0 = time.perf_counter()
+        outs = eng.run(on_sync=on_sync)
+        wall = time.perf_counter() - t0
+        assert state["sent"] == 4 and len(outs) == 2 * streamers - 2
+        return ({"pad_fraction": round(eng.stats.pad_fraction, 4),
+                 "tokens_dispatched": eng.stats.tokens_dispatched,
+                 "tokens_padded": eng.stats.tokens_padded,
+                 "wall_s": round(wall, 3)}, outs)
+
+    def jit_entries(memos):
+        return sum(fn._cache_size() for memo in memos
+                   for fn in memo.values())
+
+    scenario(True)                       # warm every packed compile
+    scenario(False)                      # ... and every dense one
+    packed, outs_p = scenario(True)
+    dense, outs_d = scenario(False)
+    assert outs_p == outs_d, "packed/dense twin streams diverged"
+    # compiled-variant count per layout: the decoder memos are the jit
+    # objects, their internal cache entries count per-shape variants
+    # (table-width buckets included) — the (S, w) grid vs total-token
+    # buckets claim, measured
+    packed_entries = jit_entries([dec._packeds])
+    dense_entries = jit_entries([dec._raggeds])
+    drop = dense["pad_fraction"] / max(packed["pad_fraction"], 1e-9)
+    row = {"packed_pad_fraction": packed["pad_fraction"],
+           "dense_pad_fraction": dense["pad_fraction"],
+           "pad_drop_x": round(drop, 2),
+           "packed_tokens_dispatched": packed["tokens_dispatched"],
+           "dense_tokens_dispatched": dense["tokens_dispatched"],
+           "packed_wall_s": packed["wall_s"],
+           "dense_wall_s": dense["wall_s"],
+           "packed_jit_entries": packed_entries,
+           "dense_jit_entries": dense_entries,
+           "slots": S, "long_prompt": long_prompt,
+           "chunk_tokens": chunk, "k_max": k_max}
+    log(f"ragged_pad: pad fraction {dense['pad_fraction']:.3f} dense -> "
+        f"{packed['pad_fraction']:.3f} packed ({drop:.1f}x less padding; "
+        f"{dense['tokens_dispatched']} -> {packed['tokens_dispatched']} "
+        f"positions dispatched), wall {dense['wall_s']}s -> "
+        f"{packed['wall_s']}s, jit entries {dense_entries} -> "
+        f"{packed_entries}")
+    print(json.dumps({"metric": "gpt_ragged_pad_fraction",
+                      "value": packed["pad_fraction"],
+                      "unit": "padded/dispatched", **row}), flush=True)
+    return row
+
+
 def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
     """Concurrent-slot capacity at a fixed per-token p99: bf16 vs int8
     KV pool.  Decode is HBM-bound, so at a per-token latency SLO the
@@ -1417,6 +1552,11 @@ def main():
                 extras["ragged_stall"] = run_ragged_stall()
         except Exception as e:
             _record_failure(extras, "ragged_stall_error", "ragged", e)
+        try:
+            with _alarm(600, "ragged_pad"):
+                extras["ragged_pad"] = run_ragged_pad()
+        except Exception as e:
+            _record_failure(extras, "ragged_pad_error", "ragged", e)
     if not extras:
         result.pop("extras", None)
     print(json.dumps(result))

@@ -200,9 +200,11 @@ def _gpt_train_multi():
 
 
 def _gpt_decode_prefix():
-    """The PREFIX-CACHE serving config: the chunked suffix-prefill
-    program (`PagedGPTDecoder._prefill_suffix_step`, W=16 bucket)
-    captured via `analysis_program(prefix_w=16)`, plus a page LEDGER
+    """The PREFIX-CACHE serving config: the PACKED suffix-prefill
+    program (`PagedGPTDecoder._prefill_packed_step` — one flat token
+    stream for a whole admission batch, bucketed by total token count;
+    W=16 sizes the trace's bucket) captured via
+    `analysis_program(prefix_w=16)`, plus a page LEDGER
     committed from a real shared-prefix workload (two prompts sharing
     one full block through a `PrefixCache`, incl. a full-hit
     copy-on-write).  Gated by SERVE-HOST-SYNC-DECODE (zero host
@@ -237,14 +239,16 @@ def _gpt_decode_prefix():
         expect_collectives=False,
         extra={"serving_decode": True,
                "page_ledger": eng.page_ledger()})
-    return program, ctx, PagedGPTDecoder._prefill_suffix_step
+    return program, ctx, PagedGPTDecoder._prefill_packed_step
 
 
 def _gpt_decode_ragged():
-    """The RAGGED serving config: the mixed chunked-prefill + decode
-    horizon program (`PagedGPTDecoder._ragged_multi_step`, K=4 ticks at
-    chunk width w=8) captured via `analysis_program(ragged=(4, 8))`,
-    plus a SCHEDULING TRACE committed from a real
+    """The RAGGED serving config: the PACKED mixed chunked-prefill +
+    decode horizon program (`PagedGPTDecoder._packed_multi_step`, K=4
+    ticks over the flat [total_new_tokens] stream — the pow2 bucket of
+    one w=8 chunk row next to S-1 decode rows; the per-row chunk cap w
+    rides as a traced input) captured via `analysis_program(ragged=(4,
+    8))`, plus a SCHEDULING TRACE committed from a real
     long-prompt-arrives-mid-stream workload (a short request decoding
     while a 40-token prompt streams into the same horizons as chunks).
     Gated by SERVE-HOST-SYNC-DECODE (zero host transfers inside the
@@ -276,7 +280,7 @@ def _gpt_decode_ragged():
         expect_collectives=False,
         extra={"serving_decode": True,
                "serve_schedule": eng.serve_schedule()})
-    return program, ctx, PagedGPTDecoder._ragged_multi_step
+    return program, ctx, PagedGPTDecoder._packed_multi_step
 
 
 def _gpt_decode_kv8():

@@ -348,6 +348,13 @@ def _reshape_dim_shards(in_shape, in_dims, out_shape):
     return tuple(out)
 
 
+# the reduce family whose output drops shard factors on reduced dims
+# (argmax/argmin carry `axes` params exactly like lax.reduce_* eqns)
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin"})
+
+
 def _eqn_out_shard(eqn, in_counts, in_dims):
     """Shard propagation for one eqn's outputs: (total_count, per-dim
     counts or None). The default heuristic — a result is at best as
@@ -360,6 +367,11 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
       so a tensor-parallel intermediate stops inheriting
       max(operand counts) blindly. Output dims follow the dot layout
       (batch, lhs free, rhs free).
+    * the reduce family (`reduce_sum`/`reduce_max`/... and
+      `argmax`/`argmin`) drops shard factors on REDUCED dims — a
+      reduction over a sharded axis all-reduces the per-shard partials
+      (reduce_sum is a contraction against ones), so the output is
+      replicated over that mesh axis; kept dims thread through.
     * `reshape` tracks split/merge dims: a sharded dim's factor follows
       its contiguous factor group into the output when divisibility
       holds (`_reshape_dim_shards`), falling back to the conservative
@@ -395,6 +407,25 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
             if total > cap:
                 return cap, None
             return max(total, 1), dims
+        if name in _REDUCE_PRIMS and in_dims and in_dims[0] is not None:
+            axes = eqn.params.get("axes")
+            if axes is not None:
+                ld = in_dims[0]
+                # a reduced dim's shard factor does NOT survive: GSPMD
+                # all-reduces the per-shard partials over that mesh
+                # axis and the result is replicated on it (the exact
+                # dot_general contracted-dim rule, applied to the
+                # reduce family — reduce_sum IS a contraction against
+                # ones). Kept dims thread through unchanged.
+                dims = tuple(d for i, d in enumerate(ld)
+                             if i not in set(axes))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:       # no axis identity: never claim
+                    return cap, None  # finer sharding than any input
+                return max(total, 1), dims
         if name == "transpose" and in_dims and in_dims[0] is not None:
             perm = eqn.params.get("permutation")
             if perm is not None and len(perm) == len(in_dims[0]):

@@ -245,20 +245,23 @@ def decode_tick_roofline_s(step_hbm_bytes, chip=None):
     return step_hbm_bytes / chip.hbm_bw
 
 
-def ragged_tick_roofline_s(step_hbm_bytes, chunk_tokens=0,
+def ragged_tick_roofline_s(step_hbm_bytes, new_tokens=0,
                            flops_per_token=0.0, chip=None,
                            mxu_efficiency=0.65):
-    """Analytic floor of ONE MIXED (ragged) tick: the decode rows keep
-    the tick HBM-bound (every weight byte + the batch's KV prefix, the
-    `decode_tick_roofline_s` leg), and the prefill-chunk rows add
-    `chunk_tokens` of prompt compute at `flops_per_token` (2x params
-    for a GPT block stack). The tick cannot beat the slower leg —
-    max(HBM, chunk compute) — which is exactly why chunking works:
-    while the chunk's compute fits under the HBM leg, prompt tokens
-    stream into the pool at ZERO marginal tick time."""
+    """Analytic floor of ONE MIXED (ragged) tick, priced on its TOTAL
+    new-token count — the packed layout's dispatch unit (pay for
+    tokens, not windows): the decode rows keep the tick HBM-bound
+    (every weight byte + the batch's KV prefix, the
+    `decode_tick_roofline_s` leg), and the tick's `new_tokens` new
+    positions (one per decode row + the prefill rows' chunk shares)
+    add compute at `flops_per_token` (2x params for a GPT block
+    stack). The tick cannot beat the slower leg — max(HBM, token
+    compute) — which is exactly why chunking works: while the token
+    total's compute fits under the HBM leg, prompt tokens stream into
+    the pool at ZERO marginal tick time."""
     chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
     hbm = step_hbm_bytes / chip.hbm_bw
-    compute = (max(float(chunk_tokens), 0.0) *
+    compute = (max(float(new_tokens), 0.0) *
                max(float(flops_per_token), 0.0) /
                (chip.peak_flops * mxu_efficiency))
     return max(hbm, compute)
@@ -266,14 +269,17 @@ def ragged_tick_roofline_s(step_hbm_bytes, chunk_tokens=0,
 
 def ragged_chunk_tokens(step_hbm_bytes, flops_per_token, chip=None,
                         mxu_efficiency=0.65, cap=256, floor=8):
-    """Default per-tick prefill-chunk budget W for the ragged
-    scheduler: the largest power of two whose compute leg hides under
-    the decode tick's HBM leg (the chunk rides 'free' inside the
-    HBM-bound tick — `ragged_tick_roofline_s(b, W, f) ==
-    decode_tick_roofline_s(b)`), clamped to [floor, cap]. `cap` bounds
-    per-tick latency jitter for the decode rows sharing the tick;
-    `floor` keeps progress on prompts even for models whose tick is
-    compute-tight."""
+    """Default per-tick new-token budget for the ragged scheduler: the
+    largest power of two whose compute leg hides under the decode
+    tick's HBM leg (those tokens ride 'free' inside the HBM-bound tick
+    — `ragged_tick_roofline_s(b, W, f) == decode_tick_roofline_s(b)`),
+    clamped to [floor, cap]. The scheduler uses it as the per-slot
+    chunk cap, and the PACKED dispatch buckets (`HorizonPlan.
+    t_tokens`, pow2 totals) inherit the same hide-under-HBM logic:
+    a packed tick whose total stays under this budget adds no
+    marginal tick time. `cap` bounds per-tick latency jitter for the
+    decode rows sharing the tick; `floor` keeps progress on prompts
+    even for models whose tick is compute-tight."""
     chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
     hbm = step_hbm_bytes / chip.hbm_bw
     per_tok = (max(float(flops_per_token), 0.0) /

@@ -69,12 +69,26 @@ def serving_report(drift_factor=None, print_report=False):
             entry["drifting_shapes"] = [d["shape"] for d in drift
                                         if d["drifting"]]
             entry["trace_events"] = len(rec.events)
+            # pad ledger over the recorder's tick window: how much of
+            # the dispatched token layout was padding (the packed
+            # ragged layout's before/after evidence — the lifetime
+            # view lives in stats.pad_fraction; this is the recent-
+            # horizon view the tick records carry)
+            ticks = [ev for ev in rec.events if ev["kind"] == "tick"
+                     and ev.get("tokens_dispatched")]
+            disp = sum(ev["tokens_dispatched"] for ev in ticks)
+            if disp:
+                padded = sum(ev.get("tokens_padded") or 0
+                             for ev in ticks)
+                entry["pad"] = {
+                    "tokens_dispatched": disp, "tokens_padded": padded,
+                    "pad_fraction": round(padded / disp, 4)}
         report.append(entry)
     if print_report:
         for entry in report:
             s = entry["stats"]
             print(f"== {s['engine']}#{s['engine_id']} ==")
-            for key in ("stats", "schedule"):
+            for key in ("stats", "schedule", "pad"):
                 if key in entry:
                     print(f"  {key}: {entry[key]}")
             for d in entry.get("drift", ()):

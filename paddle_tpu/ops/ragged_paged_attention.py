@@ -42,7 +42,7 @@ import numpy as np
 
 from ._fallback import kernel_fallback
 
-__all__ = ["ragged_paged_attention"]
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_packed"]
 
 # softmax-denominator floor shared by reference and kernel: a row whose
 # every key is masked (possible only for padded queries past true_len —
@@ -252,6 +252,126 @@ def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
         interpret=interpret,
     )(page_table.astype(jnp.int32), start.astype(jnp.int32),
       *operands)
+
+
+def _packed_kernel_call(q2, k_pages, v_pages, page_table, row_ids, pos,
+                        scale, interpret, k_scale=None, v_scale=None):
+    """Pallas call for the PACKED layout: grid (T, H, max_pages) — one
+    token's one page per step. The page/scale BlockSpec index maps
+    indirect through TWO scalar-prefetched vectors: `row_ids[t]` picks
+    the token's page-table ROW, `page_table[row, j]` the page — so the
+    [n, max_pages] table never gets gathered to a [T, max_pages] copy
+    in HBM; the indirection lives entirely in the prefetched scalars.
+    The kernel BODY is `_ragged_kernel` itself (pos plays the dense
+    path's start role; the rid prefetch is consumed only by the index
+    maps), so the per-page math cannot drift from the dense kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, W, H, D = q2.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    quantized = k_scale is not None
+
+    def page_map(ti, hi, j, pt, rid, ps_):
+        return (jnp.maximum(pt[rid[ti], j], 0), 0, hi, 0)
+
+    def scale_map(ti, hi, j, pt, rid, ps_):
+        return (jnp.maximum(pt[rid[ti], j], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, W, 1, D),
+                     lambda ti, hi, j, pt, rid, ps_: (ti, 0, hi, 0)),
+        pl.BlockSpec((1, page_size, 1, D), page_map),
+        pl.BlockSpec((1, page_size, 1, D), page_map),
+    ]
+    operands = (q2, k_pages, v_pages)
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size), scale_map),
+                     pl.BlockSpec((1, page_size), scale_map)]
+        operands += (k_scale, v_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,   # page_table, row_ids, pos
+        grid=(T, H, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, W, 1, D), lambda ti, hi, j, pt, rid, ps_: (ti, 0, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((W, 1), jnp.float32),
+            pltpu.VMEM((W, 1), jnp.float32),
+            pltpu.VMEM((W, D), jnp.float32),
+        ],
+    )
+
+    def body(pt_ref, rid_ref, pos_ref, *args):
+        # rid_ref is consumed by the index maps only; the body is the
+        # dense kernel with `pos` in the start slot
+        return _ragged_kernel(pt_ref, pos_ref, *args, scale=scale,
+                              page_size=page_size, max_pages=max_pages,
+                              quantized=quantized)
+
+    return pl.pallas_call(
+        body, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, W, H, D), q2.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), row_ids.astype(jnp.int32),
+      pos.astype(jnp.int32), *operands)
+
+
+def ragged_paged_attention_packed(q, k_pages, v_pages, page_table,
+                                  row_ids, pos, scale=None,
+                                  use_kernel=False, interpret=None):
+    """PACKED-layout causal attention over paged KV: q [T, H, D] is a
+    flat stream of new tokens — token t belongs to batch row
+    `row_ids[t]` (its row in `page_table` [n, max_pages]) and sits at
+    absolute position `pos[t]`. No [n, W] window padding exists in the
+    layout at all: a pure-decode batch pays exactly n tokens, a mixed
+    batch pays exactly its token total (the Ragged Paged Attention
+    layout, arxiv 2604.15464 — pay for tokens, not windows).
+
+    Per-token math is EXACTLY the dense path's: each token runs the
+    same per-page `_page_update` walk over its row's pages that a W=1
+    window would (padded internally to the same 2-wide window the
+    dense W=1 path uses), so a token's output is bit-identical to the
+    dense `ragged_paged_attention` computing the same position inside
+    any window width — the packed/dense byte-identity the serving
+    engine's A/B twin pins. The Pallas kernel scalar-prefetches
+    `row_ids` and `pos` next to the page table and resolves
+    `page_table[row_ids[t], j]` inside the BlockSpec index maps (see
+    `_packed_kernel_call`). int8 pools pass as (pages, scales) tuples
+    exactly like the dense entry point. Returns [T, H, D]."""
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    row_ids = jnp.asarray(row_ids, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    ks = vs = None
+    if isinstance(k_pages, tuple):
+        k_pages, ks = k_pages
+        v_pages, vs = v_pages
+    # the same 2-wide padding the dense W=1 path uses (degenerate
+    # matvec lowering drifts a ulp at W=1): one zero query per token,
+    # discarded — bit-identity with the dense path rides on both
+    # layouts running the identical W=2 program shape per position
+    q2 = jnp.stack([q, jnp.zeros_like(q)], axis=1)      # [T, 2, H, D]
+    if not use_kernel:
+        # reference: per-token table rows via one gather; the page walk
+        # is the dense reference's (`_page_update` via _ragged_ref)
+        table_tok = page_table[row_ids]                 # [T, max_pages]
+        return _ragged_ref_jit(q2, k_pages, v_pages, table_tok, pos,
+                               scale=float(scale), k_scale=ks,
+                               v_scale=vs)[:, 0]
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    try:
+        return _packed_kernel_call(q2, k_pages, v_pages, page_table,
+                                   row_ids, pos, scale, interpret,
+                                   k_scale=ks, v_scale=vs)[:, 0]
+    except Exception as e:
+        kernel_fallback("ragged_paged_attention_packed", e)
+        table_tok = page_table[row_ids]
+        return _ragged_ref_jit(q2, k_pages, v_pages, table_tok, pos,
+                               scale=float(scale), k_scale=ks,
+                               v_scale=vs)[:, 0]
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, start,

@@ -20,7 +20,12 @@ decoding).  TPU-native design, split across this package:
   sampled tokens feed back on device, per-slot done masks freeze
   finished slots — so the engine syncs the host once per K tokens
   instead of once per token (cf. Ragged Paged Attention, arXiv
-  2604.15464; T3's overlap analysis, arXiv 2401.16677).
+  2604.15464; T3's overlap analysis, arXiv 2401.16677).  Mixed
+  horizons and the chunked prefill dispatch the PACKED
+  [total_new_tokens] token-stream layout by default (per-token row
+  ids, pow2 total-token buckets — docs/serving.md "Packed ragged
+  layout"); `packed=False` keeps the dense [S, w] window twin for
+  byte-identity A/B.
 - `engine.py` — `ContinuousBatchingEngine.run()` schedules horizons of
   `k = min(K_max, smallest remaining budget)` ticks and overlaps each
   block's host fetch with the NEXT block's dispatch (one-horizon-

@@ -25,7 +25,8 @@ def clear_compiled_memos():
     still live recompiles on its next call. Returns entries dropped."""
     n = 0
     for dec in list(_LIVE_DECODERS):
-        for memo in (dec._multis, dec._raggeds):
+        for memo in (dec._multis, dec._raggeds, dec._packeds,
+                     dec._packed_prefills):
             n += len(memo)
             memo.clear()
         for attr in ("_verify", "_probs", "_suffix_prefill", "_copy"):
@@ -43,12 +44,29 @@ MultiDecodeOut = collections.namedtuple(
                        "done", "remaining", "logits_block"])
 
 # ragged_multi's result bundle: like MultiDecodeOut plus the device-
-# resident prompt-suffix carry (pend/pend_n) and the per-tick `emitted`
+# resident prompt-suffix carry (pend/pend_n), the per-tick `emitted`
 # mask (False for filler ticks of frozen slots AND for mid-prefill
-# ticks, which consume prompt chunks without producing a token)
+# ticks, which consume prompt chunks without producing a token), and
+# `real` [k] — the REAL token positions each tick consumed (live rows'
+# new_len summed; frozen rows 0). The engine's pad-fraction ledger is
+# dispatched-minus-real: the device is the one source of truth for how
+# much of a padded dispatch was actual work (EOS can freeze a slot
+# mid-horizon, which no host-side plan can predict).
 RaggedMultiOut = collections.namedtuple(
-    "RaggedMultiOut", ["tokens_block", "emitted", "tokens", "lens",
-                       "done", "remaining", "pend", "pend_n"])
+    "RaggedMultiOut", ["tokens_block", "emitted", "real", "tokens",
+                       "lens", "done", "remaining", "pend", "pend_n"])
+
+
+def pow2_at_least(n):
+    """Smallest power of two >= max(n, 1) — THE bucket-rounding rule
+    shared by the packed dispatch (scheduler `t_tokens`, the decoder's
+    default buckets, the packed prefill): one definition, so the
+    scheduler's bucket and the decoder's coverage guarantee can never
+    diverge on an off-by-one."""
+    p = 1
+    while p < max(int(n), 1):
+        p *= 2
+    return p
 
 
 def _ln(x, w, b):
@@ -205,7 +223,7 @@ class PagedGPTDecoder:
     def __init__(self, model, num_pages=128, page_size=16, max_batch=8,
                  max_pages_per_seq=None, quant=None, kv_quant=None,
                  use_kernel=False, dtype=None, temperature=0.0, top_k=0,
-                 top_p=1.0, seed=0, mesh=None):
+                 top_p=1.0, seed=0, mesh=None, packed=True):
         cfg = model.cfg
         self.cfg = cfg
         self.page_size = page_size
@@ -216,6 +234,14 @@ class PagedGPTDecoder:
         self.quant = quant
         self.kv_quant = kv_quant
         self.use_kernel = use_kernel
+        # PACKED token-stream layout (default): ragged horizons and
+        # chunked prefill dispatch flat [total_new_tokens] streams with
+        # per-token row ids instead of dense [S, w] windows — decode
+        # rows pay one token per tick, not w. packed=False keeps the
+        # dense window layout end to end: the A/B twin the
+        # byte-identity tests (and the pad-fraction bench) compare
+        # against.
+        self.packed = bool(packed)
         assert quant in (None, "a8w8", "w4a16"), quant
         assert kv_quant in (None, "int8"), kv_quant
         # temperature 0 = greedy (reference decode convention)
@@ -318,6 +344,11 @@ class PagedGPTDecoder:
         self._decode = jax.jit(self._decode_step, donate_argnums=(1, 2))
         self._multis = {}     # (k, return_logits) -> jitted fused loop
         self._raggeds = {}    # (k, w) -> jitted mixed ragged horizon
+        self._packeds = {}    # (k, t) -> jitted PACKED mixed horizon
+        # (w rides as a traced scalar — per-dispatch width changes
+        # never compile a new program; dispatches bucket by total
+        # token count t alone)
+        self._packed_prefills = {}   # t -> jitted packed prefill
         self._verify = None   # jitted lazily (speculative decoding only)
         self._probs = None    # jitted lazily (sampled speculation)
         self._suffix_prefill = None   # jitted lazily (chunked prefill)
@@ -736,20 +767,200 @@ class PagedGPTDecoder:
             rem = jnp.where(emit, remaining - 1, remaining)
             new_done = done | (emit & ((nxt == eos) | (rem <= 0)))
             new_lens = jnp.where(done, lens, lens + new_len)
+            # real positions this tick consumed (the pad-fraction
+            # ledger's numerator): live rows' new_len, frozen rows 0 —
+            # the dense tick dispatched S*w positions for these
+            real = jnp.sum(jnp.where(done, 0, new_len)).astype(jnp.int32)
             pend = jnp.concatenate(
                 [pend[:, w:], jnp.zeros((S, min(w, P)), pend.dtype)],
                 axis=1)[:, :P]
             pend_n = jnp.maximum(pend_n - w, 0)
             return (nxt, new_lens, new_done, rem, pend, pend_n, kp, vp), \
-                (nxt, emit)
+                (nxt, emit, real)
 
         carry = (tokens, lens, done, remaining, pend, pend_n,
                  k_pages, v_pages)
         carry, outs = jax.lax.scan(tick, carry, jnp.arange(k))
         tokens, lens, done, remaining, pend, pend_n, k_pages, v_pages = \
             carry
-        return (outs[0], outs[1], tokens, lens, done, remaining, pend,
-                pend_n, k_pages, v_pages)
+        return (outs[0], outs[1], outs[2], tokens, lens, done, remaining,
+                pend, pend_n, k_pages, v_pages)
+
+    def _packed_layer(self, rows, pos, pids, offs, table):
+        """ONE transformer layer over the PACKED token stream: x is
+        [T, h] flat new tokens (token t of batch row `rows[t]` at
+        absolute position `pos[t]`); K/V writes land at (pids, offs) —
+        the caller routes padded/frozen/overflow tokens to scratch —
+        and attention runs through the packed ragged primitive
+        (`ops.ragged_paged_attention_packed`), which resolves each
+        token's pages via its row id. Per-token math is the dense
+        `_windowed_layer`'s exactly (row-local matmuls, the same
+        per-page attention walk), so a real position's bytes are
+        bit-identical packed vs dense — the A/B-twin guarantee."""
+        cfg = self.cfg
+        H, D = cfg.num_heads, cfg.head_dim
+        T = rows.shape[0]
+        quant = self.quant
+
+        def layer(x, wkv):
+            wl, kp, vp = wkv
+            y = _ln(x, wl["ln1_w"], wl["ln1_b"])
+            qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"],
+                            quant)                       # [T, 3, H, D]
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp = _kv_set(kp, pids, offs, k)
+            vp = _kv_set(vp, pids, offs, v)
+            from ..ops.ragged_paged_attention import \
+                ragged_paged_attention_packed
+            attn = ragged_paged_attention_packed(
+                q, kp, vp, table, rows, pos,
+                use_kernel=self.use_kernel).astype(x.dtype)
+            x = x + _mm(attn.reshape(T, H * D), wl["proj_w"],
+                        wl["proj_b"], quant)
+            y = _ln(x, wl["ln2_w"], wl["ln2_b"])
+            h = jax.nn.gelu(_mm(y, wl["fc1_w"], wl["fc1_b"], quant),
+                            approximate=True)
+            x = x + _mm(h, wl["fc2_w"], wl["fc2_b"], quant)
+            return x, (kp, vp)
+
+        return layer
+
+    def _packed_forward(self, weights, k_pages, v_pages, ptok, pos, rows,
+                        write_ok, table, last_idx, sample_pos, kids,
+                        live):
+        """The shared PACKED forward: consume the flat token stream
+        `ptok` [T] (token t = row `rows[t]`, position `pos[t]`),
+        writing real tokens' K/V into the pages (`write_ok` [T] False
+        routes to scratch: padded tail, frozen rows, table overflow)
+        and attending each token over its own row's pages. `last_idx`
+        [S] indexes each row's LAST stream token (garbage for rows with
+        no tokens — masked by `live`), whose hidden state prices the
+        row's logits; `sample_pos` [S] is the sampling position
+        (true_len - 1, the standard (seed, kid, position) key walk).
+        Returns (next [S], k_pages, v_pages) — exactly what the dense
+        `_ragged_forward` returns, from exactly the same per-position
+        bytes."""
+        cfg, ps = self.cfg, self.page_size
+        MP = table.shape[1]
+        x = (self.wte[ptok] +
+             self.wpe[jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+             ).astype(self.compute_dtype)                 # [T, h]
+        pids = jnp.take_along_axis(
+            table[rows], jnp.minimum(pos // ps, MP - 1)[:, None],
+            axis=1)[:, 0]                                 # [T]
+        pids = jnp.where(write_ok, pids, self.num_pages - 1)
+        offs = pos % ps
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            self._packed_layer(rows, pos, pids, offs, table), x,
+            (weights, k_pages, v_pages))
+        x = _ln(x, self.ln_f_w, self.ln_f_b)
+        last = x[jnp.clip(last_idx, 0, x.shape[0] - 1)]   # [S, h]
+        last = jnp.where(live[:, None], last, 0.0)
+        logits = last.astype(jnp.float32) @ \
+            self.lm_head.astype(jnp.float32)
+        keys = None
+        if self.sampling is not None:
+            keys = self._pos_keys(kids, sample_pos)
+        return _sample_tokens(logits, self.sampling, keys), \
+            k_pages, v_pages
+
+    def _packed_multi_step(self, weights, k_pages, v_pages, tokens, lens,
+                           table, kids, done, remaining, eos, pend,
+                           pend_n, w, *, k, t):
+        """K MIXED ticks over the PACKED [t] token stream — the
+        tentpole layout (Ragged Paged Attention, arxiv 2604.15464): a
+        tick's stream concatenates every live row's new tokens (decode
+        rows exactly ONE token, prefilling rows their next min(pend_n,
+        w) suffix tokens, frozen rows NOTHING), so nobody pays window
+        padding — the dense twin (`_ragged_multi_step`) dispatches
+        S*w positions per tick, this dispatches at most t, bucketed by
+        total token count alone. `w` is a TRACED scalar (the per-row
+        chunk cap): per-dispatch width changes recompile nothing; the
+        jit key is (k, t) — fewer compiled variants than the dense
+        (k, w) grid by construction. The layout (cumsum + searchsorted
+        over per-row token counts) is built on device each tick from
+        the carry, so the program stays one host-sync-free lax.scan
+        (SERVE-HOST-SYNC-DECODE gates it like the dense twin).
+
+        Every per-row rule is the dense tick's verbatim: same emit
+        condition, same (seed, kid, true_len-1) sampling keys, same
+        freeze/budget updates, same scratch routing — and per-position
+        math rides the shared packed primitive — so streams and pool
+        bytes are byte-identical to the dense twin and the per-tick
+        engine (test-pinned). Returns the RaggedMultiOut tuple layout
+        (tokens_block [k, S], emitted [k, S], real [k], finals...)."""
+        S = tokens.shape[0]
+        P = pend.shape[1]
+        MP = table.shape[1]
+        ps = self.page_size
+
+        def tick(carry, _):
+            tokens, lens, done, remaining, pend, pend_n, kp, vp = carry
+            is_pf = pend_n > 0
+            # per-row stream share: decode 1, prefill min(pend_n, w),
+            # frozen 0 (the packed layout simply skips frozen rows —
+            # the dense twin computes their scratch-routed windows)
+            nl = jnp.where(done, 0,
+                           jnp.where(is_pf, jnp.minimum(pend_n, w), 1))
+            csum = jnp.cumsum(nl)
+            total = csum[-1]
+            starts = csum - nl
+            ti = jnp.arange(t)
+            rows = jnp.clip(
+                jnp.searchsorted(csum, ti, side="right"), 0, S - 1
+            ).astype(jnp.int32)
+            within = (ti - starts[rows]).astype(jnp.int32)
+            valid = ti < total
+            pos = lens[rows] + within                     # [t]
+            ptok = jnp.where(
+                is_pf[rows], pend[rows, jnp.clip(within, 0, P - 1)],
+                tokens[rows])
+            ptok = jnp.where(valid, ptok, 0)
+            write_ok = valid & ~done[rows] & (pos < MP * ps)
+            true = lens + nl                              # [S]
+            last_idx = jnp.clip(csum - 1, 0, t - 1)
+            live = ~done & (nl > 0)
+            nxt, kp, vp = self._packed_forward(
+                weights, kp, vp, ptok, pos, rows, write_ok, table,
+                last_idx, true - 1, kids, live)
+            emit = ~done & (pend_n <= w)
+            nxt = jnp.where(emit, nxt, tokens)
+            rem = jnp.where(emit, remaining - 1, remaining)
+            new_done = done | (emit & ((nxt == eos) | (rem <= 0)))
+            new_lens = jnp.where(done, lens, lens + nl)
+            real = total.astype(jnp.int32)
+            # shift each row's suffix by the DYNAMIC w (a gather — the
+            # dense twin's static concatenate+slice can't take a traced
+            # width); over-shift past pend_n clears like the dense path
+            idx = jnp.arange(P)[None, :] + w
+            pend = jnp.where(idx < P,
+                             pend[jnp.arange(S)[:, None],
+                                  jnp.clip(idx, 0, P - 1)], 0)
+            pend_n = jnp.maximum(pend_n - w, 0)
+            return (nxt, new_lens, new_done, rem, pend, pend_n, kp, vp), \
+                (nxt, emit, real)
+
+        carry = (tokens, lens, done, remaining, pend, pend_n,
+                 k_pages, v_pages)
+        carry, outs = jax.lax.scan(tick, carry, jnp.arange(k))
+        tokens, lens, done, remaining, pend, pend_n, k_pages, v_pages = \
+            carry
+        return (outs[0], outs[1], outs[2], tokens, lens, done, remaining,
+                pend, pend_n, k_pages, v_pages)
+
+    def _prefill_packed_step(self, weights, k_pages, v_pages, ptok, pos,
+                             rows, write_ok, table, last_idx, sample_pos,
+                             kids, live):
+        """PACKED chunked prefill: one forward over the flat suffix
+        stream of a whole admission batch — mixed suffix lengths share
+        ONE compiled program per total-token bucket instead of one per
+        (suffix-width, batch) pair (`prefill_suffix_batch` builds the
+        layout host-side). The body is `_packed_forward`, the same
+        program family as the packed horizon tick."""
+        return self._packed_forward(weights, k_pages, v_pages, ptok,
+                                    pos, rows, write_ok, table,
+                                    last_idx, sample_pos, kids, live)
 
     # -- host-side API -----------------------------------------------------
 
@@ -776,17 +987,28 @@ class PagedGPTDecoder:
         return self.prefill_suffix_batch(
             [(ids, 0, pages) for ids, pages in requests], kids=kids)
 
-    def prefill_suffix_batch(self, requests, kids=None):
+    def prefill_suffix_batch(self, requests, kids=None, packed=None):
         """Chunked prefill over page-table rows (the prefix-cache
-        admission path; see `_prefill_suffix_step`). requests:
-        [(suffix_ids, start, pages), ...] — `pages` is the sequence's
-        page list in block order (cached prefix pages mounted by the
-        engine + freshly allocated suffix pages), `start` the cached
-        prefix length (0 = nothing cached: the suffix IS the prompt).
-        Suffix lengths bucket to powers of two and batches to powers of
-        two like `prefill_batch`, bounding the compile count; one
-        jitted program (`_suffix_prefill`) specializes per bucket.
-        Returns the first generated token per request (in order)."""
+        admission path). requests: [(suffix_ids, start, pages), ...] —
+        `pages` is the sequence's page list in block order (cached
+        prefix pages mounted by the engine + freshly allocated suffix
+        pages), `start` the cached prefix length (0 = nothing cached:
+        the suffix IS the prompt).
+
+        PACKED (the default): each group of up to max_batch requests
+        dispatches ONE flat [total_tokens] stream
+        (`_prefill_packed_step`) bucketed by total token count (pow2)
+        — mixed suffix lengths share one compiled program instead of
+        one per (suffix-width, batch) pair, and nobody pays
+        pad-to-longest window columns. `packed=False` keeps the dense
+        window twin (`_prefill_suffix_step`, per-(W, nb) pow2 buckets)
+        — byte-identical first tokens (per-position math is layout-
+        independent, test-pinned). Returns the first generated token
+        per request (in order)."""
+        if packed is None:
+            packed = self.packed
+        if packed:
+            return self._prefill_packed_batch(requests, kids=kids)
         results = [None] * len(requests)
         if kids is None:
             kids = list(range(len(requests)))
@@ -827,6 +1049,61 @@ class PagedGPTDecoder:
                 nxt = np.asarray(nxt)
                 for r, (i, _, _, _) in enumerate(chunk):
                     results[i] = int(nxt[r])
+        return results
+
+    def _prefill_packed_batch(self, requests, kids=None):
+        """PACKED prefill dispatch (see `prefill_suffix_batch`): the
+        layout — flat tokens, per-token row ids and positions — is
+        built host-side (all lengths are known here), bucketed to a
+        pow2 total-token count, and jitted once per bucket
+        (`_packed_prefills`)."""
+        results = [None] * len(requests)
+        if kids is None:
+            kids = list(range(len(requests)))
+        S, MP, ps = self.max_batch, self.max_pages, self.page_size
+        todo = list(enumerate(requests))
+        while todo:
+            chunk, todo = todo[:S], todo[S:]
+            t = pow2_at_least(sum(len(np.asarray(ids).reshape(-1))
+                                  for _, (ids, _, _) in chunk))
+            ptok = np.zeros(t, np.int32)
+            pos = np.zeros(t, np.int32)
+            rows = np.zeros(t, np.int32)
+            ok = np.zeros(t, bool)
+            last_idx = np.zeros(S, np.int32)
+            spos = np.zeros(S, np.int32)
+            live = np.zeros(S, bool)
+            tbl = np.full((S, MP), self.num_pages - 1, np.int32)
+            kd = np.zeros(S, np.int32)
+            cur = 0
+            for r, (i, (ids, start, pages)) in enumerate(chunk):
+                ids = np.asarray(ids, np.int32).reshape(-1)
+                n = len(ids)
+                ptok[cur:cur + n] = ids
+                pos[cur:cur + n] = int(start) + np.arange(n)
+                rows[cur:cur + n] = r
+                ok[cur:cur + n] = pos[cur:cur + n] < MP * ps
+                last_idx[r] = max(cur + n - 1, 0)
+                spos[r] = int(start) + n - 1
+                live[r] = n > 0
+                m = min(len(pages), MP)
+                tbl[r, :m] = pages[:m]       # rest stays on scratch
+                kd[r] = kids[i]
+                cur += n
+            fn = self._packed_prefills.get(t)
+            if fn is None:
+                fn = jax.jit(self._prefill_packed_step,
+                             donate_argnums=(1, 2))
+                self._packed_prefills[t] = fn
+            self._draws += 1
+            nxt, self.k_pages, self.v_pages = fn(
+                self.weights, self.k_pages, self.v_pages,
+                jnp.asarray(ptok), jnp.asarray(pos), jnp.asarray(rows),
+                jnp.asarray(ok), jnp.asarray(tbl), jnp.asarray(last_idx),
+                jnp.asarray(spos), jnp.asarray(kd), jnp.asarray(live))
+            nxt = np.asarray(nxt)
+            for r, (i, _) in enumerate(chunk):
+                results[i] = int(nxt[r])
         return results
 
     def copy_page(self, src, dst):
@@ -958,14 +1235,18 @@ class PagedGPTDecoder:
         device-resident ticks in one lax.scan) is traced instead of the
         single tick — the SERVE-HOST-SYNC-DECODE rule checks it for
         host transfers and kept cache donation. With `prefix_w` the
-        CHUNKED prefill program (`_prefill_suffix_step`, suffix bucket
-        W=prefix_w) is traced — the prefix-cache admission path, gated
-        by the same serving rules plus the MEM-PAGE-REFCOUNT ledger
-        audit (`gpt_decode_prefix` PROGRAM config). With
-        `ragged=(k, w)` the MIXED ragged horizon program
-        (`_ragged_multi_step`: K ticks serving decode rows and
-        w-token prefill-chunk rows in one scan) is traced — the
-        `gpt_decode_ragged` PROGRAM config gates it with
+        chunked-prefill program is traced — PACKED by default
+        (`_prefill_packed_step`, one flat stream at total-token bucket
+        S*prefix_w; a `packed=False` decoder traces the dense
+        `_prefill_suffix_step` window twin) — the prefix-cache
+        admission path, gated by the same serving rules plus the
+        MEM-PAGE-REFCOUNT ledger audit (`gpt_decode_prefix` PROGRAM
+        config). With `ragged=(k, w)` the MIXED ragged horizon program
+        is traced — PACKED by default (`_packed_multi_step`: K ticks
+        over the flat [t] token stream, t = the pow2 bucket of one
+        w-wide chunk row next to S-1 decode rows, w a traced input;
+        `packed=False` traces the dense `_ragged_multi_step` twin) —
+        the `gpt_decode_ragged` PROGRAM config gates it with
         SERVE-HOST-SYNC-DECODE and (via an engine schedule trace on
         the context) SERVE-PREFILL-STALL. `donate=False` traces the
         defective variant the planted-defect tests lint."""
@@ -990,26 +1271,68 @@ class PagedGPTDecoder:
                       ("table", table), ("kids", kids), ("done", done),
                       ("remaining", remaining), ("eos", eos),
                       ("pend", pend), ("pend_n", pend_n)]
-            fn = jax.jit(functools.partial(self._ragged_multi_step,
-                                           k=rk, w=rw),
-                         donate_argnums=(1, 2) if donate else ())
-            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
-                              tokens, lens, table, kids, done, remaining,
-                              eos, pend, pend_n)
-            name = f"ragged_multi_k{rk}_w{rw}"
+            if self.packed:
+                # the PACKED horizon program: t = the pow2 total-token
+                # bucket of one full-chunk prefill row riding next to
+                # S-1 decode rows (the canonical mixed tick); w is a
+                # TRACED input, not part of the program identity
+                t = pow2_at_least(S - 1 + rw)
+                w_in = jnp.asarray(rw, jnp.int32)
+                inputs.append(("w", w_in))
+                fn = jax.jit(functools.partial(self._packed_multi_step,
+                                               k=rk, t=t),
+                             donate_argnums=(1, 2) if donate else ())
+                traced = fn.trace(self.weights, self.k_pages,
+                                  self.v_pages, tokens, lens, table,
+                                  kids, done, remaining, eos, pend,
+                                  pend_n, w_in)
+                name = f"ragged_packed_k{rk}_t{t}"
+            else:
+                fn = jax.jit(functools.partial(self._ragged_multi_step,
+                                               k=rk, w=rw),
+                             donate_argnums=(1, 2) if donate else ())
+                traced = fn.trace(self.weights, self.k_pages,
+                                  self.v_pages, tokens, lens, table,
+                                  kids, done, remaining, eos, pend,
+                                  pend_n)
+                name = f"ragged_multi_k{rk}_w{rw}"
         elif prefix_w:
             W = int(prefix_w)
-            ids = jnp.zeros((S, W), jnp.int32)
-            start = jnp.zeros((S,), jnp.int32)
-            true_len = jnp.ones((S,), jnp.int32)
-            inputs = [("ids", ids), ("start", start),
-                      ("true_len", true_len), ("table", table),
-                      ("kids", kids)]
-            fn = jax.jit(self._prefill_suffix_step,
-                         donate_argnums=(1, 2) if donate else ())
-            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
-                              ids, start, true_len, table, kids)
-            name = f"prefill_suffix_w{W}"
+            if self.packed:
+                # the PACKED prefill program: one flat stream covering
+                # a full admission batch at suffix bucket W — the
+                # total-token bucket S*W replaces the (W, nb) grid
+                t = pow2_at_least(S * W)
+                ptok = jnp.zeros((t,), jnp.int32)
+                pos = jnp.zeros((t,), jnp.int32)
+                rows = jnp.zeros((t,), jnp.int32)
+                ok = jnp.zeros((t,), bool)
+                last_idx = jnp.zeros((S,), jnp.int32)
+                spos = jnp.zeros((S,), jnp.int32)
+                live = jnp.ones((S,), bool)
+                inputs = [("ptok", ptok), ("pos", pos), ("rows", rows),
+                          ("write_ok", ok), ("table", table),
+                          ("last_idx", last_idx), ("sample_pos", spos),
+                          ("kids", kids), ("live", live)]
+                fn = jax.jit(self._prefill_packed_step,
+                             donate_argnums=(1, 2) if donate else ())
+                traced = fn.trace(self.weights, self.k_pages,
+                                  self.v_pages, ptok, pos, rows, ok,
+                                  table, last_idx, spos, kids, live)
+                name = f"prefill_packed_t{t}"
+            else:
+                ids = jnp.zeros((S, W), jnp.int32)
+                start = jnp.zeros((S,), jnp.int32)
+                true_len = jnp.ones((S,), jnp.int32)
+                inputs = [("ids", ids), ("start", start),
+                          ("true_len", true_len), ("table", table),
+                          ("kids", kids)]
+                fn = jax.jit(self._prefill_suffix_step,
+                             donate_argnums=(1, 2) if donate else ())
+                traced = fn.trace(self.weights, self.k_pages,
+                                  self.v_pages, ids, start, true_len,
+                                  table, kids)
+                name = f"prefill_suffix_w{W}"
         elif k:
             tokens = jnp.zeros((S,), jnp.int32)
             lens = jnp.zeros((S,), jnp.int32)
@@ -1149,41 +1472,77 @@ class PagedGPTDecoder:
         return self.max_pages * self.page_size
 
     def ragged_multi(self, tokens, lens, table, k, w, pend, pend_n,
-                     kids=None, done=None, remaining=None, eos=None):
-        """Run `k` MIXED ragged ticks device-resident (see
-        `_ragged_multi_step`): decode rows and prefill-chunk rows serve
-        together, w suffix tokens per prefilling slot per tick, ONE
-        dispatch, zero intermediate host syncs. Jitted per (k, w); the
-        engine buckets k to powers of two and w to the scheduler's
-        chunk budget (or 1 on pure-decode horizons), so the compile
-        count stays bounded.
+                     kids=None, done=None, remaining=None, eos=None,
+                     packed=None, t_tokens=None):
+        """Run `k` MIXED ragged ticks device-resident: decode rows and
+        prefill-chunk rows serve together, up to w suffix tokens per
+        prefilling slot per tick, ONE dispatch, zero intermediate host
+        syncs.
+
+        PACKED (the default, `packed=None` -> the decoder's `packed`
+        flag): each tick dispatches the flat [t_tokens] token stream
+        (`_packed_multi_step`) — decode rows pay ONE token, not a
+        w-wide window — jitted per (k, t_tokens) with w riding as a
+        traced scalar, so dispatches bucket by TOTAL token count
+        (pow2; the scheduler's `HorizonPlan.t_tokens` prices it) and
+        per-dispatch width changes never compile a new variant.
+        `t_tokens` must cover the largest per-tick total (live rows +
+        chunk shares; defaults to the dense-equivalent S*w bound when
+        the caller doesn't supply the tight bucket). `packed=False`
+        dispatches the dense [S, w] window twin (`_ragged_multi_step`,
+        jitted per (k, w)) — byte-identical streams, kept for A/B
+        pad-fraction evidence.
 
         All inputs/outputs may stay on device; `pend` [S, P] /
         `pend_n` [S] are the carried prompt suffixes
         (P = `pend_capacity`). Returns a RaggedMultiOut."""
         k, w = int(k), int(w)
         S = self.max_batch
-        key = (k, w)
-        fn = self._raggeds.get(key)
-        if fn is None:
-            fn = jax.jit(
-                functools.partial(self._ragged_multi_step, k=k, w=w),
-                donate_argnums=(1, 2))
-            self._raggeds[key] = fn
+        if packed is None:
+            packed = self.packed
         if done is None:
             done = np.zeros(S, bool)
         if remaining is None:
             remaining = np.full(S, np.iinfo(np.int32).max // 2, np.int32)
         self._draws += k             # dispatch telemetry, not key state
-        out = fn(self.weights, self.k_pages, self.v_pages,
-                 jnp.asarray(tokens, jnp.int32),
-                 jnp.asarray(lens, jnp.int32),
-                 jnp.asarray(table, jnp.int32),
-                 jnp.asarray(self._kids_or_default(kids)),
-                 jnp.asarray(done, bool),
-                 jnp.asarray(remaining, jnp.int32),
-                 jnp.asarray(-1 if eos is None else int(eos), jnp.int32),
-                 jnp.asarray(pend, jnp.int32),
-                 jnp.asarray(pend_n, jnp.int32))
-        self.k_pages, self.v_pages = out[8], out[9]
-        return RaggedMultiOut(*out[:8])
+        args = (jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(table, jnp.int32),
+                jnp.asarray(self._kids_or_default(kids)),
+                jnp.asarray(done, bool),
+                jnp.asarray(remaining, jnp.int32),
+                jnp.asarray(-1 if eos is None else int(eos), jnp.int32),
+                jnp.asarray(pend, jnp.int32),
+                jnp.asarray(pend_n, jnp.int32))
+        if packed:
+            if t_tokens is None:
+                # safe default: the dense-equivalent total (callers
+                # that know the live mix pass the tight pow2 bucket)
+                t_tokens = pow2_at_least(S * max(w, 1))
+            t = max(int(t_tokens), 1)
+            if t < S:
+                # every live slot owns at least one stream share; a
+                # bucket below S could silently drop rows' tokens
+                raise ValueError(
+                    f"t_tokens {t} < max_batch {S}: the packed bucket "
+                    "must cover at least one token per slot")
+            key = (k, t)
+            fn = self._packeds.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(self._packed_multi_step, k=k, t=t),
+                    donate_argnums=(1, 2))
+                self._packeds[key] = fn
+            out = fn(self.weights, self.k_pages, self.v_pages,
+                     *args, jnp.asarray(w, jnp.int32))
+        else:
+            key = (k, w)
+            fn = self._raggeds.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(self._ragged_multi_step, k=k, w=w),
+                    donate_argnums=(1, 2))
+                self._raggeds[key] = fn
+            out = fn(self.weights, self.k_pages, self.v_pages, *args)
+        self.k_pages, self.v_pages = out[9], out[10]
+        return RaggedMultiOut(*out[:9])

@@ -67,7 +67,7 @@ class ContinuousBatchingEngine:
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
                  max_new_tokens=64, k_max=None, host_sync_s=None,
                  prefix_cache=None, ragged=None, chunk_tokens=None,
-                 scheduler=None, trace=None):
+                 scheduler=None, trace=None, packed=None):
         if max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (the prefill forward always "
@@ -134,6 +134,13 @@ class ContinuousBatchingEngine:
                 self.k_max = decode_horizon(decoder.step_hbm_bytes(),
                                             host_sync_s=host_sync_s)
         self.ragged = bool(self.k_max > 1 if ragged is None else ragged)
+        # PACKED token-stream dispatch for the ragged horizons (default:
+        # the decoder's layout flag): every tick pays its total token
+        # count, bucketed pow2 (`HorizonPlan.t_tokens`) — not the dense
+        # [S, w] window grid. packed=False selects the dense A/B twin
+        # on THIS engine regardless of the decoder default (the
+        # pad-fraction bench runs both off one decoder).
+        self.packed = bool(decoder.packed if packed is None else packed)
         self._prompt_len = [0] * S           # admitted prompt length/slot
         # scheduling-decision trace for the SERVE-PREFILL-STALL audit
         self._sched_events = collections.deque(maxlen=_SCHED_WINDOW)
@@ -158,18 +165,21 @@ class ContinuousBatchingEngine:
         if self.trace is not None:
             self.trace.meta.update(
                 engine=type(self).__name__, k_max=self.k_max,
-                ragged=self.ragged, page_size=decoder.page_size,
+                ragged=self.ragged, packed=self.packed,
+                page_size=decoder.page_size,
                 kv_quant=decoder.kv_quant or "none")
         _ENGINES.add(self)
 
     # ------------------------------------------------- flight recorder
 
-    def _price_horizon(self, k, w, prefill_rows):
+    def _price_horizon(self, k, w, prefill_rows, decode_rows=0):
         """Roofline-PREDICTED wall cost of one dispatched horizon: k
-        mixed ticks (`cost_model.ragged_tick_roofline_s` — the decode
-        HBM leg plus the chunk rows' compute leg) plus ONE host sync.
-        The tick records pair this with the measured wall time; the
-        drift accounting (`FlightRecorder.drift_report` /
+        mixed ticks (`cost_model.ragged_tick_roofline_s` priced on the
+        tick's TOTAL new-token count — the decode HBM leg plus the
+        compute leg of every new token, chunk rows at w each plus one
+        per decode row; the packed layout's dispatch unit) plus ONE
+        host sync. The tick records pair this with the measured wall
+        time; the drift accounting (`FlightRecorder.drift_report` /
         ROOFLINE-DRIFT) is the predicted-vs-measured ledger. Called
         only with tracing on."""
         from ..cost_model import (measured_host_sync_s,
@@ -181,7 +191,8 @@ class ContinuousBatchingEngine:
             self._trace_price = (self.d.step_hbm_bytes(), fpt,
                                  measured_host_sync_s())
         hbm, fpt, sync = self._trace_price
-        tick = ragged_tick_roofline_s(hbm, w * prefill_rows, fpt)
+        tick = ragged_tick_roofline_s(hbm, w * prefill_rows + decode_rows,
+                                      fpt)
         return k * tick + sync
 
     def _trace_pool_delta(self):
@@ -338,15 +349,20 @@ class ContinuousBatchingEngine:
         work — and only positions start..L-1 compute). Freshly computed
         full blocks are published to the cache afterwards."""
         if self.cache is None:
-            return self.d.prefill_batch(
-                [(ids, pages) for _, _, ids, pages in admitted],
-                kids=[rid for _, rid, _, _ in admitted])
+            # packed=self.packed: the engine-level layout choice covers
+            # the admission prefill too — a packed=False engine is the
+            # dense twin END TO END, whatever the decoder's default
+            return self.d.prefill_suffix_batch(
+                [(ids, 0, pages) for _, _, ids, pages in admitted],
+                kids=[rid for _, rid, _, _ in admitted],
+                packed=self.packed)
         reqs = []
         for _, rid, ids, pages in admitted:
             start = self._cache_meta[rid][0]
             reqs.append((ids[start:], start, pages))
         firsts = self.d.prefill_suffix_batch(
-            reqs, kids=[rid for _, rid, _, _ in admitted])
+            reqs, kids=[rid for _, rid, _, _ in admitted],
+            packed=self.packed)
         for slot, rid, ids, pages in admitted:
             self._publish_blocks(rid, slot)
         return firsts
@@ -573,6 +589,10 @@ class ContinuousBatchingEngine:
         self.steps += 1
         self.stats.ticks += 1
         self.stats.decode_syncs += 1
+        # pad ledger: the tick computed every batch row (one position
+        # each); only the active rows' positions were real work
+        self.stats.tokens_dispatched += self.d.max_batch
+        self.stats.tokens_padded += self.d.max_batch - len(active)
         self.stats.occupancy.append(len(active) / self.d.max_batch)
         self._note_resident()
         for s in active:
@@ -644,10 +664,13 @@ class ContinuousBatchingEngine:
                 warm = self._trace_shape_warm(("tick",))
                 self.trace.tick(
                     "serve", ("tick", 1, 1), dt, ts=t0,
-                    predicted_s=(self._price_horizon(1, 1, 0)
+                    predicted_s=(self._price_horizon(
+                        1, 1, 0, decode_rows=active)
                                  if clean else None),
                     drift=clean and warm, k=1, w=1,
                     decode_rows=active, prefill_rows=0, tokens=n,
+                    tokens_dispatched=self.d.max_batch,
+                    tokens_padded=self.d.max_batch - active,
                     pool=self._trace_pool_delta())
             # token_time_s is the STEADY-STATE decode latency: a sync
             # that contained a prefill is dominated by it (orders of
@@ -705,6 +728,13 @@ class ContinuousBatchingEngine:
         block = np.asarray(block_d)
         done_before = np.asarray(done_before_d)
         self.stats.decode_syncs += 1
+        # pad ledger: the fused loop computed k*S positions; frozen
+        # rows' ticks (done_before True) were filler — the device mask
+        # is the one exact source (EOS freezes mid-horizon)
+        disp_toks = k * self.d.max_batch
+        pad_toks = int(done_before.sum())
+        self.stats.tokens_dispatched += disp_toks
+        self.stats.tokens_padded += pad_toks
         emitted = 0
         for s, rid in rids.items():
             inflight[s] = max(0, inflight[s] - k)
@@ -736,6 +766,7 @@ class ContinuousBatchingEngine:
             # percentiles)
             self.trace.tick_complete(
                 trace_ev, dt, tokens=emitted,
+                tokens_dispatched=disp_toks, tokens_padded=pad_toks,
                 drift=(not (had_prefill or prefilled_since)
                        and trace_ev.get("warm_shape", True)
                        and not trace_ev.get("compiled_in_window")),
@@ -824,8 +855,9 @@ class ContinuousBatchingEngine:
                 if self.trace is not None:
                     meta_ev = self.trace.tick_dispatch(
                         "serve", ("decode", k, 1), ts=t0,
-                        predicted_s=self._price_horizon(k, 1, 0), k=k,
-                        w=1, decode_rows=len(disp), prefill_rows=0,
+                        predicted_s=self._price_horizon(
+                            k, 1, 0, decode_rows=len(disp)),
+                        k=k, w=1, decode_rows=len(disp), prefill_rows=0,
                         warm_shape=self._trace_shape_warm(("decode", k)))
                     if pending_ev is not None and \
                             not meta_ev["warm_shape"]:
@@ -944,9 +976,17 @@ class ContinuousBatchingEngine:
         budgeted small enough to ride inside it, and their cost
         SHOULD show in the per-token tail (that honesty is what the
         stall bench measures)."""
-        block_d, emitted_d, k, rids, emit_ticks, t0 = meta
+        block_d, emitted_d, real_d, disp_toks, k, rids, emit_ticks, t0 = \
+            meta
         block = np.asarray(block_d)
         emitted = np.asarray(emitted_d)
+        # pad ledger: dispatched is the horizon's layout cost (k * the
+        # packed t_tokens bucket, or k*S*w dense); real is the device's
+        # per-tick consumed-position count — exact even when EOS froze
+        # a slot mid-horizon
+        pad_toks = disp_toks - int(np.asarray(real_d).sum())
+        self.stats.tokens_dispatched += disp_toks
+        self.stats.tokens_padded += pad_toks
         self.stats.decode_syncs += 1
         n_emitted = 0
         for s, rid in rids.items():
@@ -989,6 +1029,7 @@ class ContinuousBatchingEngine:
             # token_time_s above)
             self.trace.tick_complete(
                 trace_ev, dt, tokens=n_emitted,
+                tokens_dispatched=disp_toks, tokens_padded=pad_toks,
                 drift=(trace_ev.get("warm_shape", True)
                        and not trace_ev.get("compiled_in_window")),
                 pool=self._trace_pool_delta())
@@ -1074,10 +1115,19 @@ class ContinuousBatchingEngine:
                                                     self.d)
                 tokens_d, lens_d, done_d, rem_d, pend_d, pend_n_d = carry
                 width = self._table_width(live, plan, inflight)
+                t_tokens = plan.t_tokens
+                if self.packed and t_tokens is None:
+                    # a custom scheduler may build HorizonPlan without
+                    # t_tokens: fall back to the dense-equivalent
+                    # bucket here so the dispatch and the pad ledger
+                    # below price the SAME layout
+                    from .decoder import pow2_at_least
+                    t_tokens = pow2_at_least(S * max(plan.w, 1))
                 out = self.d.ragged_multi(
                     tokens_d, lens_d, self._table_cache[:, :width],
                     plan.k, plan.w, pend_d, pend_n_d, kids=self._kids,
-                    done=done_d, remaining=rem_d, eos=self.eos)
+                    done=done_d, remaining=rem_d, eos=self.eos,
+                    packed=self.packed, t_tokens=t_tokens)
                 carry = (out.tokens, out.lens, out.done, out.remaining,
                          out.pend, out.pend_n)
                 self.steps += plan.k
@@ -1087,24 +1137,35 @@ class ContinuousBatchingEngine:
                 self._note_resident()
                 for s, e in plan.emit_ticks.items():
                     inflight[s] += e
+                # layout cost of this dispatch: the packed path pays
+                # the total-token bucket per tick, the dense twin the
+                # full [S, w] window grid
+                disp_toks = plan.k * (t_tokens if self.packed
+                                      else S * plan.w)
                 self._sched_events.append(
                     {"kind": "horizon", "k": plan.k, "w": plan.w,
+                     "t_tokens": t_tokens if self.packed else None,
                      "decode_rows": len(live) - plan.prefill_rows,
                      "prefill_rows": plan.prefill_rows})
-                meta = (out.tokens_block, out.emitted, plan.k,
-                        dict(live), plan.emit_ticks, t0)
+                meta = (out.tokens_block, out.emitted, out.real,
+                        disp_toks, plan.k, dict(live), plan.emit_ticks,
+                        t0)
                 if self.trace is not None:
+                    shape = (("packed", plan.k, t_tokens)
+                             if self.packed
+                             else ("ragged", plan.k, plan.w))
                     meta_ev = self.trace.tick_dispatch(
-                        "serve", ("ragged", plan.k, plan.w), ts=t0,
+                        "serve", shape, ts=t0,
                         predicted_s=self._price_horizon(
-                            plan.k, plan.w, plan.prefill_rows),
+                            plan.k, plan.w, plan.prefill_rows,
+                            decode_rows=len(live) - plan.prefill_rows),
                         k=plan.k, w=plan.w,
                         decode_rows=len(live) - plan.prefill_rows,
                         prefill_rows=plan.prefill_rows,
-                        # the jit key is (k, w, table width): a fresh
-                        # combination compiles inside this window
+                        # the jit key is (k, w-or-t, table width): a
+                        # fresh combination compiles inside this window
                         warm_shape=self._trace_shape_warm(
-                            ("ragged", plan.k, plan.w, width)))
+                            shape + (width,)))
                     if pending_ev is not None and \
                             not meta_ev["warm_shape"]:
                         # see _run_multi: the compile lands in the
@@ -1279,6 +1340,13 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self.steps += 1
         self.stats.ticks += 1
         self.stats.decode_syncs += 1
+        # pad ledger: one spec step computes k draft positions plus a
+        # (k+1)-wide verify window per batch row; rows with no request
+        # were padding (speculated-then-rejected drafts are real work,
+        # not padding — they're the engine's gamble, not the layout's)
+        S_all = self.d.max_batch
+        self.stats.tokens_dispatched += S_all * (2 * k + 1)
+        self.stats.tokens_padded += (S_all - len(active)) * (2 * k + 1)
         self.stats.occupancy.append(len(active) / self.d.max_batch)
         self._note_resident()
 
@@ -1317,7 +1385,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 self._retire(s)
         return len(active)
 
-    def _price_horizon(self, k, w, prefill_rows):
+    def _price_horizon(self, k, w, prefill_rows, decode_rows=0):
         """One SPEC step's roofline price, overriding the plain decode
         tick: k device-resident draft ticks (draft pool HBM leg) + one
         (k+1)-position verify forward over the target (HBM vs window

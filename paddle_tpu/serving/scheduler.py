@@ -40,18 +40,28 @@ __all__ = ["RaggedScheduler", "HorizonPlan"]
 class HorizonPlan:
     """One horizon's dispatch decision: `k` ticks at chunk width `w`,
     with `emit_ticks[slot]` = how many of the k ticks can emit a token
-    for that slot (k minus its leading chunk-consuming ticks) and
+    for that slot (k minus its leading chunk-consuming ticks),
     `n_chunks` = prompt chunks consumed across all slots (the
-    ServeStats ledger)."""
+    ServeStats ledger), and `t_tokens` = the PACKED dispatch bucket:
+    the smallest power of two covering the horizon's largest per-tick
+    token total (live decode rows pay 1, prefilling rows min(left, w);
+    tick 0 is the max — per-row shares only shrink as prompts drain),
+    floored at the slot count so pure-decode horizons always dispatch
+    one stable [S] bucket. The packed engine's jit key is (k,
+    t_tokens); the dense twin's is (k, w) — total-token bucketing is
+    what collapses the 2-D (S, w) dispatch grid."""
 
-    __slots__ = ("k", "w", "emit_ticks", "n_chunks", "prefill_rows")
+    __slots__ = ("k", "w", "emit_ticks", "n_chunks", "prefill_rows",
+                 "t_tokens")
 
-    def __init__(self, k, w, emit_ticks, n_chunks, prefill_rows):
+    def __init__(self, k, w, emit_ticks, n_chunks, prefill_rows,
+                 t_tokens=None):
         self.k = k
         self.w = w
         self.emit_ticks = emit_ticks
         self.n_chunks = n_chunks
         self.prefill_rows = prefill_rows
+        self.t_tokens = t_tokens
 
 
 class RaggedScheduler:
@@ -161,6 +171,15 @@ class RaggedScheduler:
         k = 1
         while k * 2 <= min(min(avail.values()), k_limit):
             k *= 2
+        # PACKED dispatch bucket: tick 0's token total is the horizon
+        # max (per-row shares only shrink as prompts drain to decode),
+        # floored at the slot count — pure-decode horizons then always
+        # dispatch the one [S] bucket the dense twin's [S, 1] layout
+        # costs, instead of churning variants with the live count
+        from .decoder import pow2_at_least
+        total = sum(min(int(self._pf_left[s]), w) if self._pf_left[s]
+                    else 1 for s in live)
+        t_tokens = pow2_at_least(max(total, self.d.max_batch))
         emit_ticks, n_chunks, prefill_rows = {}, 0, 0
         for s in live:
             stall = self.stall_ticks(s, w)
@@ -175,4 +194,5 @@ class RaggedScheduler:
                 prefill_rows += 1
                 n_chunks += min(math.ceil(left / w), k)
                 self._pf_left[s] = max(0, left - k * w)
-        return HorizonPlan(k, w, emit_ticks, n_chunks, prefill_rows)
+        return HorizonPlan(k, w, emit_ticks, n_chunks, prefill_rows,
+                           t_tokens=t_tokens)

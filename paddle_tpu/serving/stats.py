@@ -67,6 +67,17 @@ class ServeStats:
     # live at dispatch time — the stall the ragged path eliminates
     prefill_chunks: int = 0      # prompt chunks consumed inside horizons
     prefill_chunk_tokens: int = 0  # prompt tokens streamed via chunks
+    # pad ledger (lifetime counters, every engine's HORIZON/TICK
+    # dispatch paths — per-tick, fused, ragged, speculative): how many
+    # token POSITIONS the dispatched layouts computed vs how many of
+    # them were padding (window columns of decode rows on the dense
+    # [S, w] layout, frozen/empty rows' filler, packed-bucket slack).
+    # Blocking-path prefill dispatches (ragged=False admission) are
+    # NOT in the ledger — the ragged default has none. pad_fraction =
+    # padded/dispatched is the packed-ragged-layout headline: pay for
+    # tokens, not windows.
+    tokens_dispatched: int = 0   # token positions computed by dispatches
+    tokens_padded: int = 0       # of those, padding (discarded work)
     prefix_hits: int = 0         # cached full blocks mounted at admission
     prefix_misses: int = 0       # cacheable blocks that had to prefill
     prefix_evictions: int = 0    # refcount-0 pages evicted under pressure
@@ -106,6 +117,12 @@ class ServeStats:
         n = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / n if n else 0.0
 
+    @property
+    def pad_fraction(self):
+        """Fraction of dispatched token positions that were padding."""
+        return self.tokens_padded / self.tokens_dispatched \
+            if self.tokens_dispatched else 0.0
+
     def summary(self):
         d = {"engine": self.engine, "engine_id": self.engine_id,
              "k_max": self.k_max,
@@ -119,6 +136,10 @@ class ServeStats:
         if self.prefill_chunks:
             d["prefill_chunks"] = self.prefill_chunks
             d["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        if self.tokens_dispatched:
+            d["tokens_dispatched"] = self.tokens_dispatched
+            d["tokens_padded"] = self.tokens_padded
+            d["pad_fraction"] = round(self.pad_fraction, 4)
         if self.prefix_hits or self.prefix_misses:
             d["prefix_hit_rate"] = round(self.prefix_hit_rate, 4)
             d["prefix_hits"] = self.prefix_hits

@@ -164,7 +164,11 @@ def test_tick_records_price_and_measure(tiny_model):
     assert ticks
     for ev in ticks:
         assert ev["track"] == "serve"
-        assert ev["shape"][0] == "ragged"
+        # the default engine dispatches the PACKED token-stream layout
+        # (shape keyed by the total-token bucket); ragged=False /
+        # packed=False twins key by the dense (k, w) grid
+        assert ev["shape"][0] == "packed"
+        assert ev["tokens_dispatched"] >= ev["tokens_padded"] >= 0
         assert ev["measured_s"] > 0
         assert ev["predicted_s"] > 0
         assert ev["k"] >= 1 and ev["w"] >= 1
@@ -219,6 +223,14 @@ def test_serving_report_front_door(tiny_model):
     assert entry["schedule"]["stalled_prefill_syncs"] == 0
     assert entry["drift"] and entry["drifting_shapes"]
     assert entry["trace_events"] == len(rec.events)
+    # the pad ledger rides the tick records into the report: the
+    # before/after evidence for the packed ragged layout comes from
+    # our own tracer
+    assert entry["pad"]["tokens_dispatched"] > 0
+    assert entry["pad"]["pad_fraction"] == pytest.approx(
+        entry["pad"]["tokens_padded"] / entry["pad"]["tokens_dispatched"],
+        abs=1e-4)
+    assert entry["stats"]["pad_fraction"] >= 0
     ids = [e["stats"]["engine_id"] for e in report]
     names = [e["stats"]["engine"] for e in report]
     assert sorted(zip(names, ids)) == list(zip(names, ids))
@@ -369,7 +381,7 @@ def test_chrome_export_merges_recorder_and_profiler(tiny_model, tmp_path):
     assert "client_batch" in names                  # profiler region
     assert any(n.startswith("req0:") for n in names)      # spans
     assert any(n.startswith("req0:decode") for n in names)
-    assert any(n.startswith("tick ragged") for n in names)  # ticks
+    assert any(n.startswith("tick packed") for n in names)  # ticks
     # spans and profiler region share the clock: the client_batch
     # region must CONTAIN the first request's decode span
     region = next(e for e in data["traceEvents"]

@@ -446,3 +446,58 @@ def test_propagation_tracks_reshape_split_and_merge():
                                   arg_dims=[(4, 1), (1, 1)])
     assert cons[split_out] == 4          # count kept (safe direction)
     assert cons[jx2.outvars[0]] == 4     # blind inherit at the dot
+
+
+def test_propagation_drops_reduced_dims():
+    """Sharding propagation fidelity (reduce slice): a reduction over a
+    SHARDED dim must not hand that shard count to its output — GSPMD
+    all-reduces the per-shard partials (reduce_sum is a contraction
+    against ones) and the result is replicated over that mesh axis.
+    Kept dims thread through; argmax follows the same rule; without
+    per-dim info the legacy max-operand heuristic holds."""
+    from paddle_tpu.analysis.memory import propagate_shard_counts
+
+    def f(x):
+        s = jnp.sum(x, axis=1)        # reduce dim 1
+        m = jnp.max(x, axis=0)        # reduce dim 0
+        a = jnp.argmax(x, axis=1)     # argmax family: same axes param
+        return s + 1.0, m, a          # elementwise keeps dim knowledge
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 16))).jaxpr
+    eqns = {e.primitive.name: e for e in jx.eqns}
+    s_out = eqns["reduce_sum"].outvars[0]
+    m_out = eqns["reduce_max"].outvars[0]
+    a_out = eqns["argmax"].outvars[0]
+    final_sum = jx.outvars[0]
+
+    # legacy (no dim info): blind max-operand inherit — unchanged
+    legacy = propagate_shard_counts(jx, arg_counts=[4])
+    assert legacy[s_out] == 4 and legacy[a_out] == 4
+
+    # sharded on dim 1: reducing dim 1 drops the factor (sum AND
+    # argmax); reducing dim 0 keeps it; the elementwise chain after
+    # the sum stays replicated (dim knowledge survives the reduce)
+    tp = propagate_shard_counts(jx, arg_counts=[4], arg_dims=[(1, 4)])
+    assert tp[s_out] == 1 and tp[a_out] == 1
+    assert tp[m_out] == 4
+    assert tp[final_sum] == 1
+
+    # sharded on dim 0: the mirror case
+    dp = propagate_shard_counts(jx, arg_counts=[4], arg_dims=[(4, 1)])
+    assert dp[s_out] == 4 and dp[a_out] == 4
+    assert dp[m_out] == 1
+
+    # full reduction to scalar: every factor drops
+    def g(x):
+        return jnp.sum(x)
+
+    jx2 = jax.make_jaxpr(g)(jnp.zeros((8, 16))).jaxpr
+    full = propagate_shard_counts(jx2, arg_counts=[4],
+                                  arg_dims=[(4, 1)])
+    assert full[jx2.outvars[0]] == 1
+
+    # no axis identity: a dim-factor product exceeding the most-
+    # sharded operand is capped (the dot_general rule, shared)
+    capped = propagate_shard_counts(jx, arg_counts=[2],
+                                    arg_dims=[(2, 4)])
+    assert capped[m_out] <= 2

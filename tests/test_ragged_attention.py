@@ -9,7 +9,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.ops.ragged_paged_attention import ragged_paged_attention
+from paddle_tpu.ops.ragged_paged_attention import (
+    ragged_paged_attention, ragged_paged_attention_packed)
 
 
 def _pool(rng, P, ps, H, D):
@@ -191,3 +192,138 @@ def test_int8_pool_kernel_bit_identical_and_tracks_oracle():
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(k1))
     np.testing.assert_array_equal(np.asarray(r1),
                                   np.asarray(ref)[:, :1])
+
+
+# --------------------------------------------------------------------------
+# Packed layout: flat [total_new_tokens] streams with per-token row ids
+# --------------------------------------------------------------------------
+
+def _pack(layout):
+    """[(row, start, n_tokens), ...] -> (rows, pos) flat vectors."""
+    rows, pos = [], []
+    for r, start, n in layout:
+        rows.extend([r] * n)
+        pos.extend(start + j for j in range(n))
+    return np.asarray(rows, np.int32), np.asarray(pos, np.int32)
+
+
+def _pools(case_seed, P, ps, H, D, pool):
+    rng = np.random.RandomState(case_seed)
+    if pool == "int8":
+        kp = (jnp.asarray(rng.randint(-127, 128, (P, ps, H, D))
+                          .astype(np.int8)),
+              jnp.asarray((rng.rand(P, ps) * 0.05 + 1e-3)
+                          .astype(np.float32)))
+        vp = (jnp.asarray(rng.randint(-127, 128, (P, ps, H, D))
+                          .astype(np.int8)),
+              jnp.asarray((rng.rand(P, ps) * 0.05 + 1e-3)
+                          .astype(np.float32)))
+    else:                                     # bf16 pool
+        kp = jnp.asarray(rng.randn(P, ps, H, D)).astype(jnp.bfloat16)
+        vp = jnp.asarray(rng.randn(P, ps, H, D)).astype(jnp.bfloat16)
+    return kp, vp
+
+
+# every degenerate stream shape the packed serving path can produce,
+# each pinned packed-kernel == packed-reference BIT-FOR-BIT on a bf16
+# AND an int8 pool, and packed == dense per position (the A/B-twin
+# guarantee: the same position computed inside any dense window is the
+# same bytes): a single token (T=1 — the one-live-slot tick), pure
+# decode (every row one token), pure prefill (one row's whole chunk),
+# a chunk exactly filling a page, and a stream exactly at its pow2
+# bucket boundary with zero padding slack.
+@pytest.mark.parametrize("pool", ["bf16", "int8"])
+@pytest.mark.parametrize("case", ["single_token", "all_decode",
+                                  "all_prefill", "page_exact",
+                                  "bucket_boundary"])
+def test_packed_degenerate_shapes_bit_identical(case, pool):
+    import zlib
+    rng = np.random.RandomState(zlib.crc32(case.encode()) % (2 ** 31))
+    H, D, P, ps, MP = 2, 8, 10, 4, 5
+    kp, vp = _pools(zlib.crc32((case + pool).encode()) % (2 ** 31),
+                    P, ps, H, D, pool)
+    n = 3
+    table = jnp.asarray(rng.randint(0, P, (n, MP)).astype(np.int32))
+    if case == "single_token":
+        layout = [(1, 7, 1)]
+    elif case == "all_decode":
+        layout = [(0, 3, 1), (1, 0, 1), (2, 11, 1)]
+    elif case == "all_prefill":
+        layout = [(1, 0, 8)]
+    elif case == "page_exact":
+        layout = [(0, 0, ps), (2, ps, ps)]    # page-aligned full pages
+    else:                                     # bucket_boundary: T = 8
+        layout = [(0, 2, 4), (1, 6, 3), (2, 9, 1)]   # exactly pow2
+    rows, pos = _pack(layout)
+    q = jnp.asarray(rng.randn(len(rows), H, D).astype(np.float32))
+    if pool == "bf16":
+        q = q.astype(jnp.bfloat16)
+
+    ref = np.asarray(ragged_paged_attention_packed(
+        q, kp, vp, table, rows, pos).astype(jnp.float32))
+    ker = np.asarray(ragged_paged_attention_packed(
+        q, kp, vp, table, rows, pos, use_kernel=True,
+        interpret=True).astype(jnp.float32))
+    assert np.array_equal(ref, ker), (case, pool)
+    assert np.isfinite(ref).all(), (case, pool)
+
+    # packed == dense per position: each (row, start, n) block computed
+    # as ONE dense window must reproduce the packed stream's bytes
+    t0 = 0
+    for r, start, cnt in layout:
+        qw = q[t0:t0 + cnt][None]             # [1, cnt, H, D]
+        dense = np.asarray(ragged_paged_attention(
+            qw, kp, vp, table[r:r + 1],
+            jnp.asarray([start], jnp.int32)).astype(jnp.float32))[0]
+        assert np.array_equal(dense, ref[t0:t0 + cnt]), (case, pool, r)
+        t0 += cnt
+
+
+def test_packed_kernel_scalar_prefetch_routes_rows_and_pages():
+    """The packed kernel resolves pages through TWO prefetched
+    indirections (row_ids -> table row -> page): permuting the pool
+    with an inverse-permuted table, and renumbering the table rows
+    with matching row_ids, both leave the output unchanged."""
+    rng = np.random.RandomState(13)
+    H, D, P, ps, MP = 2, 8, 8, 4, 4
+    kp = jnp.asarray(rng.randn(P, ps, H, D).astype(np.float32))
+    vp = jnp.asarray(rng.randn(P, ps, H, D).astype(np.float32))
+    table = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7]], jnp.int32)
+    rows = jnp.asarray([0, 1, 1], jnp.int32)
+    pos = jnp.asarray([5, 9, 10], jnp.int32)
+    q = jnp.asarray(rng.randn(3, H, D).astype(np.float32))
+    base = np.asarray(ragged_paged_attention_packed(
+        q, kp, vp, table, rows, pos, use_kernel=True))
+    # pool permutation behind the table
+    perm = np.asarray([3, 5, 7, 1, 0, 2, 4, 6])
+    inv = np.argsort(perm)
+    moved = np.asarray(ragged_paged_attention_packed(
+        q, jnp.asarray(np.asarray(kp)[perm]),
+        jnp.asarray(np.asarray(vp)[perm]),
+        jnp.asarray(inv[np.asarray(table)].astype(np.int32)),
+        rows, pos, use_kernel=True))
+    np.testing.assert_array_equal(base, moved)
+    # table-row renumbering behind row_ids
+    swapped = np.asarray(ragged_paged_attention_packed(
+        q, kp, vp, jnp.asarray(np.asarray(table)[::-1].copy()),
+        jnp.asarray([1, 0, 0], jnp.int32), pos, use_kernel=True))
+    np.testing.assert_array_equal(base, swapped)
+
+
+def test_packed_attention_int8_tracks_dense_oracle():
+    """int8 (pages, scales) pools flow through the packed entry point
+    unchanged: packed output == the dense int8 path per position."""
+    rng = np.random.RandomState(17)
+    H, D, P, ps, MP = 2, 16, 12, 8, 6
+    kp, vp = _pools(17, P, ps, H, D, "int8")
+    table = jnp.asarray(rng.randint(0, P, (2, MP)).astype(np.int32))
+    rows, pos = _pack([(0, 4, 3), (1, 20, 1)])
+    q = jnp.asarray(rng.randn(len(rows), H, D).astype(np.float32))
+    packed = np.asarray(ragged_paged_attention_packed(
+        q, kp, vp, table, rows, pos))
+    dense0 = np.asarray(ragged_paged_attention(
+        q[:3][None], kp, vp, table[:1], jnp.asarray([4], jnp.int32)))[0]
+    dense1 = np.asarray(ragged_paged_attention(
+        q[3:][None], kp, vp, table[1:], jnp.asarray([20], jnp.int32)))[0]
+    assert np.array_equal(packed[:3], dense0)
+    assert np.array_equal(packed[3:], dense1)
