@@ -32,7 +32,7 @@ def build_tokenizer():
 
 def main():
     argv = sys.argv[1:]
-    args, trace_path = [], None
+    args, trace_path, cache_dir = [], None, None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -41,8 +41,18 @@ def main():
         elif a == "--trace":
             if i + 1 >= len(argv):
                 sys.exit("usage: serve_gpt.py [a8w8|w4a16] "
-                         "[--trace PATH | --trace=PATH]")
+                         "[--trace PATH | --trace=PATH] "
+                         "[--cache-dir DIR | --cache-dir=DIR]")
             trace_path = argv[i + 1]
+            i += 1
+        elif a.startswith("--cache-dir="):
+            cache_dir = a.split("=", 1)[1]
+        elif a == "--cache-dir":
+            if i + 1 >= len(argv):
+                sys.exit("usage: serve_gpt.py [a8w8|w4a16] "
+                         "[--trace PATH | --trace=PATH] "
+                         "[--cache-dir DIR | --cache-dir=DIR]")
+            cache_dir = argv[i + 1]
             i += 1
         else:
             args.append(a)
@@ -67,14 +77,42 @@ def main():
     # lifecycle spans + per-horizon tick records with roofline drift,
     # exported as one Perfetto-viewable chrome trace
     # (docs/observability.md)
+    # --cache-dir=DIR: TIERED prefix cache that OUTLIVES the engine
+    # (docs/serving.md "Tiered KV"): first run prefills the shared
+    # system-prompt block, saves pool + chain index + host-tier
+    # entries keyed by the decoder fingerprint; a second run of this
+    # script warm-starts — the shared block mounts host-side with
+    # ZERO prefill compute, and the TTFT line below shows it. A
+    # fingerprint-mismatched decoder (different weights/quant) refuses
+    # the saved cache with a clear error.
+    cache = None
+    warm = False
+    if cache_dir:
+        from paddle_tpu.serving import HostKVTier, PrefixCache
+        if os.path.exists(os.path.join(cache_dir, "index.json")):
+            cache = PrefixCache.load(cache_dir, dec, tier=HostKVTier())
+            warm = True
+            print(f"warm start: loaded {cache.n_pages} cached page(s) "
+                  f"+ {cache.tier.n_entries if cache.tier else 0} "
+                  f"host-tier entr(ies) from {cache_dir}")
+        else:
+            cache = PrefixCache(dec.page_size,
+                                salt=dec.cache_fingerprint(),
+                                tier=HostKVTier())
     eng = ContinuousBatchingEngine(dec, max_new_tokens=16,
-                                   trace=bool(trace_path))
+                                   trace=bool(trace_path),
+                                   prefix_cache=cache)
 
+    # one shared SYSTEM prompt padded to a full 16-token page — the
+    # cacheable block every request mounts (partial trailing blocks
+    # are never cacheable)
+    system = (tok.encode("the quick brown fox jumps over the lazy dog")
+              * 4)[:dec.page_size]
     prompts = ["the quick brown fox", "tpu chips compile fast",
                "the lazy dog"]
     rids = {}
     for p in prompts:
-        ids = np.asarray(tok.encode(p), np.int32) % 256
+        ids = np.asarray(system + tok.encode(p), np.int32) % 256
         rids[eng.submit(ids)] = p
     outs = eng.run()
     for rid, p in rids.items():
@@ -90,6 +128,16 @@ def main():
           f"{s.get('prefill_chunks', 0)} ragged prompt chunks / "
           f"{s['prefill_syncs']} blocking prefill syncs, "
           f"p50 {s.get('token_p50_ms', 0)} ms/token")
+    if cache is not None:
+        print(f"prefix cache ({'warm' if warm else 'cold'}): "
+              f"{s.get('prefix_hits', 0)} block hits, "
+              f"{s.get('prefix_tokens_saved', 0)} prompt tokens never "
+              f"prefilled, ttft p50 {s.get('ttft_p50_ms', 0)} ms"
+              + (f", {s.get('tier_restores', 0)} host-tier restores"
+                 if s.get('tier_restores') else ""))
+        eng.cache.save(cache_dir)
+        print(f"cache saved -> {cache_dir} (rerun for a warm start; "
+              "a different model/quant config will refuse it)")
     if trace_path:
         from paddle_tpu.serving import export_chrome_trace
         export_chrome_trace(trace_path, recorders=eng.trace)

@@ -204,30 +204,47 @@ def _gpt_decode_prefix():
     program (`PagedGPTDecoder._prefill_packed_step` — one flat token
     stream for a whole admission batch, bucketed by total token count;
     W=16 sizes the trace's bucket) captured via
-    `analysis_program(prefix_w=16)`, plus a page LEDGER
-    committed from a real shared-prefix workload (two prompts sharing
-    one full block through a `PrefixCache`, incl. a full-hit
-    copy-on-write).  Gated by SERVE-HOST-SYNC-DECODE (zero host
-    transfers, donated KV pool — the chunked prefill is part of the
-    serving hot path) and by MEM-PAGE-REFCOUNT (the ledger audit:
-    refcounted sharing frees every page exactly once)."""
+    `analysis_program(prefix_w=16)`, plus a page LEDGER committed from
+    a real TIERED shared-prefix workload: a full-hit copy-on-write, a
+    pool-pressure eviction that SPILLS to a `HostKVTier`, and a
+    host-only chain RESTORED back into the pool — so the committed
+    ledger carries host-tier rows (a restored entry with its
+    device-twin backref and a host-only spilled entry) next to the
+    parked/shared device rows.  Gated by SERVE-HOST-SYNC-DECODE (zero
+    host transfers, donated KV pool — the chunked prefill is part of
+    the serving hot path) and by MEM-PAGE-REFCOUNT (the ledger audit:
+    refcounted sharing frees every page exactly once, and a host
+    entry's device twin is never on the free list)."""
     import numpy as np
     paddle = _fresh()
     from paddle_tpu.models import GPT, gpt_tiny
     from paddle_tpu.models import gpt as gpt_mod
     from paddle_tpu.serving import (ContinuousBatchingEngine,
-                                    PagedGPTDecoder, PrefixCache)
+                                    HostKVTier, PagedGPTDecoder,
+                                    PrefixCache)
     cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
     model = GPT(cfg)
     model.eval()
-    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2)
+    # 3 allocatable pages: each request needs 2, each base block parks
+    # 1 — the third distinct base forces an eviction (spill), and
+    # re-referencing the first base restores its host-only chain
+    dec = PagedGPTDecoder(model, num_pages=4, page_size=16, max_batch=2)
     eng = ContinuousBatchingEngine(
-        dec, max_new_tokens=4, k_max=2,
-        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
-    base = list(range(1, 17))            # one full shareable block
-    for tail in ([21, 22, 23], []):      # miss+insert, then a FULL hit
-        eng.submit(np.asarray(base + tail, np.int32))
+        dec, max_new_tokens=4, k_max=2, tier_policy="restore",
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint(),
+                                 tier=HostKVTier()))
+    b1 = list(range(1, 17))              # full shareable blocks
+    b2 = list(range(31, 47))
+    b3 = list(range(51, 67))
+    for prompt in (b1 + [21, 22, 23],    # miss + insert
+                   b1,                   # FULL hit -> copy-on-write
+                   b2 + [24],            # second template parks
+                   b3 + [25],            # pressure: evicts+SPILLS b1
+                   b1 + [26]):           # host-only chain -> RESTORE
+        eng.submit(np.asarray(prompt, np.int32))
         eng.run()
+    assert eng.stats.tier_spills and eng.stats.tier_restores, \
+        "tiered ledger workload lost its spill/restore shape"
     program = dec.analysis_program(prefix_w=16)
     ctx = AnalysisContext(
         name="gpt_decode_prefix",

@@ -354,6 +354,13 @@ _REDUCE_PRIMS = frozenset({
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
     "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin"})
 
+# the scatter family (x.at[idx].set/add/... lowerings): output shape ==
+# operand shape, and the operand's dim sharding threads EXCEPT on the
+# dynamically indexed dims
+_SCATTER_PRIMS = frozenset({
+    "scatter", "scatter-add", "scatter-mul", "scatter-min",
+    "scatter-max"})
+
 
 def _eqn_out_shard(eqn, in_counts, in_dims):
     """Shard propagation for one eqn's outputs: (total_count, per-dim
@@ -424,6 +431,28 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
                     total *= int(d)
                 cap = max(in_counts) if in_counts else 1
                 if total > cap:       # no axis identity: never claim
+                    return cap, None  # finer sharding than any input
+                return max(total, 1), dims
+        if name in _SCATTER_PRIMS and in_dims and in_dims[0] is not None:
+            dn = eqn.params.get("dimension_numbers")
+            if dn is not None:
+                ld = in_dims[0]          # operand: output shape == its
+                # dims addressed by the scatter indices lose their
+                # factor: updates land at DYNAMIC positions along those
+                # dims, so GSPMD cannot keep a static split without
+                # resharding — the result is at best replicated on that
+                # mesh axis (the dot/reduce contracted-dim rule applied
+                # to indexed dims). Window dims thread from the operand.
+                upd = set(getattr(dn, "scatter_dims_to_operand_dims",
+                                  ()) or ()) | \
+                    set(getattr(dn, "inserted_window_dims", ()) or ())
+                dims = tuple(1 if i in upd else int(d)
+                             for i, d in enumerate(ld))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:      # no axis identity: never claim
                     return cap, None  # finer sharding than any input
                 return max(total, 1), dims
         if name == "transpose" and in_dims and in_dims[0] is not None:
@@ -720,6 +749,35 @@ def audit_page_ledger(ledger):
         if p not in owned:
             bad(f"page {p} is unreachable: not free, not slot-held, "
                 "not cached (leak)")
+
+    # host-tier rows (tiered KV, serving.kv_tier): a spilled entry is
+    # keyed by chain key and owns NO device page — unless it was
+    # restored, in which case its device-twin backref must point at a
+    # live cache-tracked page. A twin on the free list means the
+    # unmount bookkeeping was dropped: a reader could mount the host
+    # entry's "device copy" while the free list hands the same page to
+    # a prefill (the spill-tier double-free).
+    host = {str(k): dict(e)
+            for k, e in (ledger.get("host") or {}).items()}
+    free_set = set(free)
+    for key, e in host.items():
+        p = e.get("page")
+        if p is None:
+            continue
+        p = int(p)
+        if p in free_set:
+            bad(f"host entry {key[:12]} is both host-resident and "
+                f"device-free: its device twin (page {p}) sits on the "
+                "free list — the unmount/spill bookkeeping dropped the "
+                "backref and a later prefill would overwrite a page "
+                "the tier still advertises as mounted",
+                fix="clear the tier's device-twin backref "
+                "(HostKVTier.note_unmounted) in the same eviction that "
+                "frees the page")
+        elif p not in cache:
+            bad(f"host entry {key[:12]} records device twin page {p} "
+                "but the cache does not track that page (stale "
+                "restore backref)")
     return findings
 
 
@@ -784,6 +842,7 @@ class PageRefcountAnalyzer(Analyzer):
             self.metrics = {"checked": False}
             return []
         cache = ledger.get("cache") or {}
+        host = ledger.get("host") or {}
         self.metrics = {
             "checked": True,
             "n_pages": int(ledger.get("num_pages", 0)),
@@ -795,5 +854,10 @@ class PageRefcountAnalyzer(Analyzer):
                             if not e.get("refs")),
             "refcount_total": sum(int(e.get("refs", 0))
                                   for e in cache.values()),
+            # tiered-KV host rows: spilled entries + their bytes (the
+            # warm set that survived the HBM cliff)
+            "n_host": len(host),
+            "host_bytes": sum(int(e.get("bytes", 0))
+                              for e in host.values()),
         }
         return audit_page_ledger(ledger)

@@ -34,7 +34,7 @@ __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "roofline_step_time", "decode_tick_roofline_s",
            "ragged_tick_roofline_s", "ragged_chunk_tokens",
            "decode_horizon", "train_horizon", "measured_host_sync_s",
-           "prefill_ttft_s"]
+           "prefill_ttft_s", "kv_restore_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -53,13 +53,24 @@ class ChipSpec:
     hbm_bytes: int         # HBM capacity per chip
     ici_bw: float          # aggregate ICI bytes/s per chip (one dir)
     dcn_bw: float          # per-chip share of host DCN bytes/s
+    # host<->chip wire (PCIe DMA) bytes/s per chip — the H2D leg the
+    # tiered-KV restore pricing (`kv_restore_s`) divides by: a page
+    # spilled to pinned host RAM re-mounts at this bandwidth, vs
+    # recomputing its span at the MXU roofline. Approximate public
+    # figures (PCIe gen3/gen4-class hosts); they feed the RELATIVE
+    # restore-vs-recompute decision, not accounting.
+    host_bw: float = 1.6e10
 
 
 CHIP_SPECS = {
-    "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30, 300e9, 3.1e9),
-    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30, 200e9, 3.1e9),
-    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30, 600e9, 3.1e9),
-    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 << 30, 448e9, 3.1e9),
+    "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30, 300e9, 3.1e9,
+                   host_bw=1.6e10),
+    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30, 200e9, 3.1e9,
+                    host_bw=1.6e10),
+    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30, 600e9, 3.1e9,
+                    host_bw=3.2e10),
+    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 << 30, 448e9, 3.1e9,
+                    host_bw=3.2e10),
 }
 
 
@@ -351,6 +362,20 @@ def prefill_ttft_s(prompt_tokens, flops_per_token, cached_frac=0.0,
     compute = (uncached * max(float(flops_per_token), 0.0)
                / (chip.peak_flops * mxu_efficiency))
     return compute + host_sync_s
+
+
+def kv_restore_s(restore_bytes, chip=None):
+    """Analytic floor of re-mounting spilled KV pages from pinned host
+    RAM: bytes over the host<->chip wire (`ChipSpec.host_bw` — the PCIe
+    DMA leg). The tiered-KV admission compares this against the
+    recompute price of the same span (`prefill_ttft_s` with no sync
+    floor: the ragged path has no extra sync either way) and restores
+    only when the wire beats the prefill — big-model pages win (KV
+    bytes/token are fixed but recompute FLOPs grow with params), tiny
+    models recompute (serving.kv_tier owns the decision; ServeStats
+    tier_restores/tier_recomputes make it observable)."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    return max(float(restore_bytes), 0.0) / chip.host_bw
 
 
 def train_horizon(step_s, host_sync_s=None, n_cap=32,

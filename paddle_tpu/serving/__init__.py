@@ -40,9 +40,19 @@ decoding).  TPU-native design, split across this package:
   prompt / few-shot prefix skip prefill for the shared span entirely
   (the Gemma-on-TPU serving comparison, PAPERS.md, leans on exactly
   this page-level reuse).
+- `kv_tier.py` — the memory hierarchy BEHIND the prefix cache:
+  refcount-0 pages evicted under pool pressure spill their bytes to a
+  capacity-bounded pinned-host-RAM LRU (`HostKVTier`; int8 pools spill
+  quantized — half the host bytes), and admissions whose chain
+  continues onto host entries restore via H2D only when
+  `cost_model.kv_restore_s` beats the span's prefill recompute.
+  `PrefixCache.save(dir)`/`load(dir, decoder)` persist the cache
+  across engine restarts, keyed by `cache_fingerprint()` (mismatch
+  refuses).  docs/serving.md "Tiered KV".
 - `stats.py` — per-engine `ServeStats` (host syncs/token, prefix-cache
-  hit/evict/bytes-saved counters, TTFT/queue-wait/occupancy windows)
-  behind `debug.serving_stats()`.
+  hit/evict/bytes-saved counters, tiered-KV spill/restore/recompute
+  counters, TTFT/queue-wait/occupancy windows) behind
+  `debug.serving_stats()`.
 
 quant="a8w8": per-(layer, out-channel) int8 weights with dynamic
 per-row int8 activations — matmuls run int8xint8->int32 on the MXU
@@ -59,6 +69,7 @@ from .decoder import (MultiDecodeOut, PagedGPTDecoder, RaggedMultiOut,
                       _quantize_w, _sample_tokens,
                       _spec_accept)
 from .engine import ContinuousBatchingEngine, SpeculativeEngine
+from .kv_tier import HostKVTier, restore_beats_recompute
 from .prefix_cache import PrefixCache
 from .scheduler import RaggedScheduler
 from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
@@ -67,6 +78,7 @@ from .trace import (FlightRecorder, export_chrome_trace,
 
 __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "SpeculativeEngine", "ServeStats", "serving_stats",
-           "PrefixCache", "MultiDecodeOut", "RaggedMultiOut",
+           "PrefixCache", "HostKVTier", "restore_beats_recompute",
+           "MultiDecodeOut", "RaggedMultiOut",
            "RaggedScheduler", "FlightRecorder", "export_chrome_trace",
            "validate_chrome_trace"]

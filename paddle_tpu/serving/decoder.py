@@ -11,7 +11,9 @@ import numpy as np
 
 __all__ = ["PagedGPTDecoder", "MultiDecodeOut", "RaggedMultiOut",
            "_spec_accept", "_sample_tokens", "_ln", "_mm", "_mm_heads",
-           "_quantize_w", "_quantize_kv", "_kv_set"]
+           "_quantize_w", "_quantize_kv", "_kv_set", "INT4_GROUP",
+           "_quantize_kv_int4", "_dequantize_kv_int4", "_pack_int4",
+           "_unpack_int4"]
 
 # every live decoder, so the tier-1 conftest's module-boundary GC hook
 # can trim compiled-program memos (the Trainer._LIVE_TRAINERS pattern)
@@ -29,7 +31,8 @@ def clear_compiled_memos():
                      dec._packed_prefills):
             n += len(memo)
             memo.clear()
-        for attr in ("_verify", "_probs", "_suffix_prefill", "_copy"):
+        for attr in ("_verify", "_probs", "_suffix_prefill", "_copy",
+                     "_mount"):
             if getattr(dec, attr) is not None:
                 n += 1
                 setattr(dec, attr, None)
@@ -104,18 +107,114 @@ def _quantize_kv(val):
     return q, scale.astype(jnp.float32)
 
 
+# int4 KV quantization group: one f32 scale per GROUP of flattened
+# head*dim elements (per-token scales, as int8, would leave int4's
+# narrow range too coarse across heads with very different magnitudes;
+# per-group recovers most of the accuracy at 4/GROUP bytes/elem of
+# metadata). Pricing + primitive land now (pool_token_bytes /
+# _quantize_kv_int4); pool wiring is the named follow-up.
+INT4_GROUP = 32
+
+
+def _pack_int4(q):
+    """Pack int4 values (int8 in [-8, 7], even last dim) into uint8
+    nibble pairs: element 2i rides the LOW nibble of byte i, 2i+1 the
+    high — the same layout ops/w4_matmul unpacks, so an int4 pool can
+    later share its in-kernel dequant idiom."""
+    lo = (q[..., 0::2].astype(jnp.uint8)) & 0xF
+    hi = (q[..., 1::2].astype(jnp.uint8)) & 0xF
+    return lo | (hi << 4)
+
+
+def _unpack_int4(packed):
+    """Inverse of `_pack_int4`: uint8 nibble pairs -> int8 values in
+    [-8, 7] (sign-extended), last dim doubled."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (packed.shape[-1] * 2,))
+
+
+def _quantize_kv_int4(val, group=INT4_GROUP):
+    """Write-time int4 KV quantization with PER-GROUP scales: the
+    token's [H, D] vector flattens to H*D elements, each `group`-run
+    shares one symmetric f32 scale from its own amax (floored like
+    `_quantize_kv`), values clip to [-7, 7] and pack two-per-byte
+    (`_pack_int4`). Like the int8 path, the scales depend only on the
+    token's own values, so stored bytes stay a pure function of
+    (request, position) — the byte-identical-stream discipline carries
+    over unchanged when the pool wiring lands (this PR lands the
+    pricing leg + primitive; `pool_token_bytes(kv_quant="int4")`
+    prices it today). val [..., H, D] -> (packed uint8
+    [..., ceil(ceil(H*D/group)*group / 2)] — H*D zero-padded up to a
+    whole number of groups and an even nibble count — f32 scales
+    [..., ceil(H*D/group)])."""
+    v32 = val.astype(jnp.float32)
+    hd = v32.shape[-2] * v32.shape[-1]
+    group = min(int(group), hd)
+    flat = v32.reshape(v32.shape[:-2] + (hd,))
+    n_groups = (hd + group - 1) // group      # ceil, like the pricing
+    pad = n_groups * group - hd
+    if pad:
+        # zero-pad the tail group (zeros quantize to 0 under any
+        # scale, so padding never moves a real element's scale and
+        # stored bytes stay a pure function of the token's values)
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(flat.shape[:-1] + (pad,), jnp.float32)],
+            axis=-1)
+    g = flat.reshape(flat.shape[:-1] + (n_groups, group))
+    amax = jnp.max(jnp.abs(g), axis=-1)
+    scale = jnp.maximum(amax / 7.0, 1e-8)
+    q = jnp.clip(jnp.round(g / scale[..., None]), -7, 7).astype(jnp.int8)
+    q = q.reshape(flat.shape)
+    if q.shape[-1] % 2:                       # nibble pairs need even
+        q = jnp.concatenate(
+            [q, jnp.zeros(q.shape[:-1] + (1,), jnp.int8)], axis=-1)
+    return _pack_int4(q), scale.astype(jnp.float32)
+
+
+def _dequantize_kv_int4(packed, scale, heads_shape, group=INT4_GROUP):
+    """Inverse of `_quantize_kv_int4` up to quantization error:
+    unpack nibbles, multiply each group by its scale, reshape back to
+    [..., H, D] (`heads_shape` = (H, D))."""
+    q = _unpack_int4(packed).astype(jnp.float32)
+    hd = int(heads_shape[0]) * int(heads_shape[1])
+    group = min(int(group), hd)
+    n_groups = scale.shape[-1]
+    q = q[..., :n_groups * group]             # drop the pack-parity pad
+    g = q.reshape(q.shape[:-1] + (n_groups, group)) * scale[..., None]
+    flat = g.reshape(q.shape[:-1] + (n_groups * group,))[..., :hd]
+    return flat.reshape(q.shape[:-1] + tuple(heads_shape))
+
+
 def pool_token_bytes(cfg, kv_quant=None, itemsize=2):
     """KV bytes one context token costs PER LAYER under a pool layout
-    (K and V together; int8 pools pay 1 B/elem payload + one 4 B f32
-    write-time scale per plane). THE byte model behind
+    (K and V together). int8 pools pay 1 B/elem payload + one 4 B f32
+    write-time scale per plane; int4 pools pay 0.5 B/elem packed
+    nibbles + one f32 scale per `INT4_GROUP` elements (per-group
+    scales — see `_quantize_kv_int4`). THE byte model behind
     `PagedGPTDecoder.kv_token_bytes` / `step_hbm_bytes` and the
     capacity bench (`bench.run_decode_capacity`) — one definition, so
     the bench can price big-model shapes without building the model
     and can never drift from what the decoder reports."""
-    per_tensor = cfg.num_heads * cfg.head_dim * \
-        (1 if kv_quant else itemsize)
-    if kv_quant:
-        per_tensor += 4              # one f32 write-time scale/token
+    if kv_quant not in (None, "int8", "int4"):
+        raise ValueError(
+            f"kv_quant must be None, 'int8' or 'int4', got {kv_quant!r} "
+            "(an unquantized pool is kv_quant=None priced at `itemsize` "
+            "bytes/elem — there is no 'bf16' spelling)")
+    hd = cfg.num_heads * cfg.head_dim
+    if kv_quant == "int4":
+        group = min(INT4_GROUP, hd)
+        n_groups = (hd + group - 1) // group
+        # stored payload is ceil-padded to whole groups and an even
+        # nibble count (`_quantize_kv_int4`) — price the stored bytes
+        per_tensor = (n_groups * group + 1) // 2 + 4 * n_groups
+    elif kv_quant == "int8":
+        per_tensor = hd + 4          # one f32 write-time scale/token
+    else:
+        per_tensor = hd * itemsize
     return int(2 * per_tensor)
 
 
@@ -353,6 +452,12 @@ class PagedGPTDecoder:
         self._probs = None    # jitted lazily (sampled speculation)
         self._suffix_prefill = None   # jitted lazily (chunked prefill)
         self._copy = None     # jitted lazily (copy-on-write page copy)
+        self._mount = None    # jitted lazily (host-tier page restore)
+        # engines serving over this pool (weak): load_pool_state
+        # refuses while any of them holds live refcounted pages —
+        # swapping pool bytes under a live PrefixCache ledger would
+        # silently orphan it
+        self._engines = weakref.WeakSet()
         _LIVE_DECODERS.add(self)
 
     def _probs_of(self, logits):
@@ -1128,6 +1233,46 @@ class PagedGPTDecoder:
             jnp.asarray(int(src), jnp.int32),
             jnp.asarray(int(dst), jnp.int32))
 
+    def fetch_page_payload(self, page):
+        """D2H copy of ONE page's bytes across every layer — the
+        host-tier SPILL primitive: ``{"k": (leaves...), "v": (...)}``
+        with each leaf the pool leaf sliced at the page ([L, ps, H, D]
+        bytes; int8 pools also carry their [L, ps] f32 scale rows, so
+        the spill is already quantized — half the host bytes). The
+        inverse is `mount_page_payload`; the round trip is lossless,
+        which is what lets a restored page keep the byte-identical-
+        stream invariant."""
+        p = int(page)
+
+        def grab(pool):
+            leaves = pool if isinstance(pool, tuple) else (pool,)
+            return tuple(np.asarray(leaf[:, p]) for leaf in leaves)
+
+        return {"k": grab(self.k_pages), "v": grab(self.v_pages)}
+
+    def mount_page_payload(self, page, payload):
+        """H2D restore of a spilled page (`fetch_page_payload`'s
+        inverse): scatter the payload leaves into page `page` of every
+        pool leaf. One jitted donated update, dispatched WITHOUT
+        blocking — jax's functional pool threading orders every later
+        horizon after this write (the restored pool IS its input), so
+        the H2D overlaps whatever the host does next and no reader can
+        observe a half-mounted page."""
+        if self._mount is None:
+            def mnt(kp, vp, pid, kvals, vvals):
+                def setp(pool, vals):
+                    leaves = pool if isinstance(pool, tuple) else (pool,)
+                    out = [leaf.at[:, pid].set(v)
+                           for leaf, v in zip(leaves, vals)]
+                    return tuple(out) if isinstance(pool, tuple) \
+                        else out[0]
+                return setp(kp, kvals), setp(vp, vvals)
+            self._mount = jax.jit(mnt, donate_argnums=(0, 1))
+        self.k_pages, self.v_pages = self._mount(
+            self.k_pages, self.v_pages, jnp.asarray(int(page), jnp.int32),
+            tuple(jnp.asarray(x) for x in payload["k"]),
+            tuple(jnp.asarray(x) for x in payload["v"]))
+
     def pool_state(self):
         """Checkpointable KV-pool state: the page arrays (and, for an
         int8 pool, their scale planes) plus the quant config that
@@ -1140,7 +1285,28 @@ class PagedGPTDecoder:
     def load_pool_state(self, state):
         """Restore a `pool_state()` snapshot into this decoder's pool.
         The stored quant config, leaf dtypes and shapes must all match
-        this decoder's pool layout exactly."""
+        this decoder's pool layout exactly — and no attached engine may
+        hold pages over the pool: swapping the bytes under a slot
+        table, a referenced PrefixCache entry, OR a parked one would
+        orphan the page ledger with no error anywhere downstream (a
+        parked entry outlives a drain, and its next hit would mount
+        the checkpoint's bytes as if they were the chain key's
+        write-time KV). Rebuild the decoder+cache pair instead —
+        `PrefixCache.load` does exactly that."""
+        for eng in list(self._engines):
+            held = sum(len(p) for p in getattr(eng, "_slot_pages", ()))
+            cache = getattr(eng, "cache", None)
+            tracked = len(cache._entries) if cache is not None else 0
+            if held or tracked:
+                raise RuntimeError(
+                    f"cannot load pool state: a live "
+                    f"{type(eng).__name__} holds {held} slot page(s) "
+                    f"and its prefix cache tracks {tracked} page(s) "
+                    "over this pool — swapping the page bytes now "
+                    "would orphan its ledger (a parked entry's next "
+                    "hit would mount checkpoint bytes under the old "
+                    "chain key); drain the engine and rebuild the "
+                    "decoder+cache pair (PrefixCache.load) instead")
         quant = state.get("kv_quant", "") or None
         if quant != self.kv_quant:
             raise ValueError(
@@ -1164,10 +1330,13 @@ class PagedGPTDecoder:
                     f"{[(tuple(l.shape), str(l.dtype)) for l in h_leaves]}, "
                     f"got "
                     f"{[(tuple(getattr(l, 'shape', ())), str(getattr(l, 'dtype', '?'))) for l in w_leaves]}")
-        self.k_pages = jax.tree_util.tree_map(jnp.asarray,
-                                              state["k_pages"])
-        self.v_pages = jax.tree_util.tree_map(jnp.asarray,
-                                              state["v_pages"])
+        # jnp.array (copy), NOT jnp.asarray: a host numpy leaf can be
+        # zero-copied into the CPU backend, and the decode programs
+        # DONATE the pool — XLA must own the bytes it recycles
+        self.k_pages = jax.tree_util.tree_map(
+            lambda l: jnp.array(l), state["k_pages"])
+        self.v_pages = jax.tree_util.tree_map(
+            lambda l: jnp.array(l), state["v_pages"])
 
     @property
     def _pool_itemsize(self):
@@ -1370,7 +1539,7 @@ class PagedGPTDecoder:
                               jaxpr=traced.jaxpr, name=name,
                               arg_infos=infos)
 
-    def step_hbm_bytes(self, avg_ctx=None, batch=None):
+    def step_hbm_bytes(self, avg_ctx=None, batch=None, kv_quant="pool"):
         """HBM bytes ONE decode tick moves: every weight byte plus each
         slot's KV prefix at `avg_ctx` (default: half the model's max
         sequence). The numerator of the decode tick roofline —
@@ -1381,7 +1550,12 @@ class PagedGPTDecoder:
         K, the ragged chunk budget and the capacity bench all re-price
         automatically when the pool quantizes. `batch` overrides the
         slot count (bench.run_decode_capacity sweeps it to find the
-        max slots under a fixed per-token p99)."""
+        max slots under a fixed per-token p99). `kv_quant` overrides
+        the pool's quant mode for WHAT-IF pricing — e.g.
+        ``kv_quant="int4"`` prices the per-group-scale int4 pool
+        (packed nibbles + f32 group scales, `pool_token_bytes`) before
+        the pool wiring lands, so capacity planning can already rank
+        bf16 vs int8 vs int4 streams."""
         cfg = self.cfg
         n = cfg.num_params()
         per = {"a8w8": 1.0, "w4a16": 0.5}.get(self.quant)
@@ -1395,7 +1569,18 @@ class PagedGPTDecoder:
             avg_ctx = max(cfg.max_seq_len // 2, 1)
         if batch is None:
             batch = self.max_batch
-        kv = batch * cfg.num_layers * avg_ctx * self.kv_token_bytes
+        if kv_quant == "pool":
+            tok_bytes = self.kv_token_bytes
+        else:
+            # what-if override: an UNQUANTIZED what-if must price the
+            # compute dtype's width, not the live pool's leaf itemsize
+            # (on an int8 pool that is 1 byte, which would rank the
+            # "unquantized" stream CHEAPER than int8 — backwards)
+            itemsize = self._pool_itemsize if self.kv_quant is None \
+                else jnp.dtype(self.compute_dtype).itemsize
+            tok_bytes = pool_token_bytes(cfg, kv_quant=kv_quant,
+                                         itemsize=itemsize)
+        kv = batch * cfg.num_layers * avg_ctx * tok_bytes
         return int(w_bytes + kv)
 
     def _kids_or_default(self, kids):

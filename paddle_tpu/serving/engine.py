@@ -3,6 +3,7 @@ horizon-fused decode, ragged chunked-prefill admission, prefix-cache
 admission, speculative decoding."""
 import collections
 import time
+import weakref
 
 import jax.numpy as jnp
 import numpy as np
@@ -67,7 +68,8 @@ class ContinuousBatchingEngine:
     def __init__(self, decoder: PagedGPTDecoder, eos_token_id=None,
                  max_new_tokens=64, k_max=None, host_sync_s=None,
                  prefix_cache=None, ragged=None, chunk_tokens=None,
-                 scheduler=None, trace=None, packed=None):
+                 scheduler=None, trace=None, packed=None,
+                 host_tier=None, tier_policy="auto"):
         if max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (the prefill forward always "
@@ -105,6 +107,65 @@ class ContinuousBatchingEngine:
                 f"prefix cache page_size {prefix_cache.page_size} != "
                 f"decoder page_size {decoder.page_size}")
         self.cache = prefix_cache
+        # TIERED KV (serving.kv_tier): a host-RAM spill tier behind the
+        # prefix cache — refcount-0 pages evicted under pool pressure
+        # demote their bytes to a capacity-bounded host LRU instead of
+        # vanishing, and admissions whose chain continues onto host
+        # entries restore them via H2D when the wire beats the prefill
+        # recompute (tier_policy: "auto" = cost_model-priced per
+        # admission; "restore"/"recompute" pin the decision — the CPU
+        # bench pins "restore" since tiny-model recompute always wins
+        # the pricing there). host_tier=True builds a default
+        # HostKVTier; a PrefixCache constructed with tier= works too.
+        # identity checks, not truthiness: an EMPTY HostKVTier is falsy
+        # (__len__ == 0) but very much "tier on"
+        if host_tier is not None and host_tier is not False:
+            if self.cache is None:
+                raise ValueError(
+                    "host_tier needs a prefix_cache: the tier is keyed "
+                    "by the cache's chain keys (pass prefix_cache=True "
+                    "for a default cache)")
+            if self.cache.tier is None:
+                from .kv_tier import HostKVTier
+                self.cache.tier = HostKVTier() if host_tier is True \
+                    else host_tier
+            elif host_tier is not True and \
+                    host_tier is not self.cache.tier:
+                # a loaded cache may arrive with a WARM tier — silently
+                # replacing it would drop the persisted host entries
+                raise ValueError(
+                    "prefix_cache already carries a host tier — pass "
+                    "host_tier=True to keep it, or attach your tier "
+                    "to the cache (PrefixCache.load(tier=...)) "
+                    "instead")
+        self.tier = self.cache.tier if self.cache is not None else None
+        if tier_policy not in ("auto", "restore", "recompute"):
+            raise ValueError(f"tier_policy must be auto/restore/"
+                             f"recompute, got {tier_policy!r}")
+        self.tier_policy = tier_policy
+        self._restore_s_pending = 0.0    # priced H2D awaiting a horizon
+        if self.cache is not None:
+            # a PRELOADED cache (PrefixCache.load) already owns pages:
+            # they are parked cache property, not free pool — and bind
+            # the decoder so cache.save() can read the pool later
+            if self.cache.n_pages:
+                # a populated cache's pages live in the pool of the
+                # decoder it is bound to (PrefixCache.load and every
+                # engine bind one) — with any OTHER decoder (even
+                # same-weights: its pool does not hold these pages)
+                # the chain keys would still hit and mount garbage KV
+                # with no error anywhere
+                bound = self.cache._decoder and self.cache._decoder()
+                if bound is not decoder:
+                    raise ValueError(
+                        "prefix_cache holds pages for a different "
+                        "decoder — pass the decoder the cache was "
+                        "loaded onto, or PrefixCache.load the save "
+                        "dir onto THIS decoder")
+                owned = set(self.cache.pages())
+                self._free = [p for p in self._free if p not in owned]
+            self.cache._decoder = weakref.ref(decoder)
+        decoder._engines.add(self)
         self._cache_meta = {}                # rid -> (start, keys, n_hit)
         # RAGGED scheduling (default on the multi-step path): prompt
         # suffixes stream into the SAME K-tick horizon as running
@@ -150,6 +211,11 @@ class ContinuousBatchingEngine:
             # sequence's KV — capacity counts allocatable pages only
             kv_pool_bytes=(decoder.num_pages - 1) * decoder.kv_page_bytes,
             kv_bytes_per_token=decoder.kv_page_bytes // decoder.page_size)
+        if self.tier is not None:
+            # a warm-started tier (PrefixCache.load) already holds
+            # resident bytes — the gauge must not read 0 until the
+            # first spill/restore happens to refresh it
+            self.stats.host_tier_bytes = self.tier.bytes_used
         self._submit_t = {}                  # rid -> submit wall time
         # FLIGHT RECORDER (serving.trace.FlightRecorder): off by
         # default; every hook below is a dead `if self.trace is not
@@ -233,6 +299,157 @@ class ContinuousBatchingEngine:
         n = len(self._outputs[rid])
         if n % self.trace.progress_every == 0:
             self.trace.record("progress", rid=rid, tokens=n)
+
+    # ------------------------------------------------------- tiered KV
+
+    def _flops_per_token(self):
+        """Matmul FLOPs one prompt token costs (the 2x-params GPT rule;
+        the scheduler already holds it on ragged engines)."""
+        if self.scheduler is not None and \
+                hasattr(self.scheduler, "flops_per_token"):
+            return self.scheduler.flops_per_token
+        return 2.0 * self.d.cfg.num_params()
+
+    def _spill_page(self, key, page):
+        """Eviction hook (`PrefixCache.evict(spill=...)`): demote one
+        parked page to the host tier before its device page returns to
+        the free list. A page whose key already has a host twin (it
+        was itself restored, or a recompute refreshed the entry) needs
+        NO D2H — the host payload is still the exact write-time bytes,
+        only the device-twin backref clears."""
+        tier = self.tier
+        if tier is None:
+            return
+        if key in tier:
+            tier.note_unmounted(key)
+            self.stats.host_tier_bytes = tier.bytes_used
+            return
+        if self.d.kv_page_bytes > tier.capacity_bytes:
+            # put() would refuse a payload this size anyway — skip the
+            # blocking per-page D2H (the capacity-0 tier-off twin would
+            # otherwise pay a device sync on every pool-pressure
+            # eviction for nothing)
+            return
+        payload = self.d.fetch_page_payload(page)
+        if tier.put(key, payload):
+            self.stats.tier_spills += 1
+            if self.trace is not None:
+                self.trace.record("spill", page=int(page),
+                                  bytes=tier.entry_bytes(key))
+        self.stats.host_tier_bytes = tier.bytes_used
+
+    def _tier_plan(self, keys, n_dev):
+        """How far the chain continues onto the HOST tier past the
+        device-resident run, and whether to restore it: (n_tier,
+        restore, hold). Policy "auto" prices `cost_model.kv_restore_s`
+        (PCIe leg) against the span's prefill recompute
+        (`kv_tier.restore_beats_recompute` — one formula for engine
+        and tests); a losing wire RECOMPUTES and merely refreshes the
+        host entries' recency (their bytes stay valid — write-time
+        determinism), keeping the hot set warm for a bigger model or
+        a longer span. On a restore decision, `hold` pins the span's
+        (key, payload, bytes) triples HERE: this same admission's
+        evictions may spill NEW entries into the tier and LRU-evict
+        the very entries the plan selected — holding the payload
+        objects makes the restore immune to that churn (and touches
+        their recency, which the about-to-be-hot entries deserve
+        anyway)."""
+        tier = self.tier
+        if tier is None:
+            return 0, False, None
+        n_tier = 0
+        while n_dev + n_tier < len(keys) and \
+                keys[n_dev + n_tier] in tier:
+            n_tier += 1
+        if not n_tier:
+            return 0, False, None
+        restore = self.tier_policy == "restore"
+        if self.tier_policy == "auto":
+            from .kv_tier import restore_beats_recompute
+            span = keys[n_dev:n_dev + n_tier]
+            restore = restore_beats_recompute(
+                sum(tier.entry_bytes(k) for k in span),
+                n_tier * self.d.page_size, self._flops_per_token())
+        hold = None
+        if restore:
+            hold = [(k, tier.get(k), tier.entry_bytes(k))
+                    for k in keys[n_dev:n_dev + n_tier]]
+        return n_tier, restore, hold
+
+    def _tier_recompute(self, keys, lo, n):
+        """Host blocks keys[lo:lo+n] will be RE-PREFILLED (the wire
+        lost the pricing, or a restore span degraded under pool
+        pressure): refresh the entries' recency — the recomputed bytes
+        equal the spilled ones by write-time determinism, so the
+        payload stays valid and the hot set must not age out — and
+        count the decision. Called only once the admission COMMITS
+        (counting at plan time would inflate tier_recomputes on every
+        head-of-line retry)."""
+        for i in range(n):
+            self.tier.touch(keys[lo + i])
+        self.stats.tier_recomputes += n
+
+    def _tier_restore(self, keys, n_dev, pages, hold, rid):
+        """Re-mount `len(pages)` host-resident blocks (keys[n_dev:],
+        payloads pinned in `hold` at plan time — tier churn between
+        plan and restore cannot invalidate them) into freshly
+        allocated device pages: H2D scatter per page (dispatched async
+        — jax's pool threading orders every later horizon after the
+        writes), cache insert under the held parent chain, device-twin
+        backref for the ledger audit. Returns [(page, inserted)] — a
+        capacity-refused insert leaves that page (and the rest of the
+        chain, publish-stop rule) private to the request: bytes still
+        correct, just not shareable. The priced H2D is handed to the
+        horizon pricing (`note_restore`) and, with tracing on,
+        recorded as an ("h2d_restore",) tick whose
+        predicted-vs-measured feeds the drift ledger."""
+        tier = self.tier
+        tot_bytes = sum(nbytes for _, _, nbytes in hold[:len(pages)])
+        t0 = time.perf_counter()
+        out = []
+        stop = False
+        for i, pid in enumerate(pages):
+            key, payload, _ = hold[i]
+            self.d.mount_page_payload(pid, payload)
+            ok = False
+            if not stop:
+                parent = keys[n_dev + i - 1] if (n_dev + i) else None
+                ok = self.cache.insert(key, pid, parent=parent)
+                if ok:
+                    tier.note_mounted(key, pid)
+                else:
+                    stop = True
+            out.append((pid, ok))
+        dt = time.perf_counter() - t0
+        from ..cost_model import kv_restore_s
+        pred = kv_restore_s(tot_bytes)
+        self.stats.tier_restores += len(pages)
+        self.stats.host_tier_bytes = tier.bytes_used
+        self._note_restore(pred)
+        if self.trace is not None:
+            self.trace.tick(
+                "serve", ("h2d_restore",), dt, predicted_s=pred,
+                drift=self._trace_shape_warm(("h2d_restore",)),
+                rid=rid, blocks=len(pages), bytes=tot_bytes)
+        return out
+
+    def _note_restore(self, seconds):
+        if self.scheduler is not None and \
+                hasattr(self.scheduler, "note_restore"):
+            self.scheduler.note_restore(seconds)
+        else:
+            self._restore_s_pending += float(seconds)
+
+    def _take_restore_s(self):
+        """Pending restore H2D price, drained once per dispatched
+        horizon (the mount lands inside exactly one measured window —
+        the next dispatch's — so its price belongs to that window's
+        prediction)."""
+        if self.scheduler is not None and \
+                hasattr(self.scheduler, "take_restore_s"):
+            return self.scheduler.take_restore_s()
+        s, self._restore_s_pending = self._restore_s_pending, 0.0
+        return s
 
     def submit(self, prompt_ids):
         ids = [int(t) for t in np.asarray(
@@ -424,7 +641,17 @@ class ContinuousBatchingEngine:
         the final mounted page, so that page is copy-on-write'd to a
         private copy first (the recomputed KV bytes are identical — the
         chunked prefill is deterministic and position-local — so the
-        copy diverges only once decode appends past the prompt)."""
+        copy diverges only once decode appends past the prompt).
+
+        With a host tier, the chain may CONTINUE past the device run
+        onto host-resident entries (`_tier_plan`): a priced winner
+        RESTORES them into freshly allocated device pages (counted in
+        need_new — a restored block costs a device page exactly like a
+        computed one; the admission head-of-line check therefore
+        accounts in-flight restores) and those blocks join the hit
+        span; a priced loser recomputes them as ordinary misses. Pool
+        eviction during either path spills through `_spill_page`, so
+        pressure demotes instead of destroys."""
         admitted = []
         ps = self.d.page_size
         tok_bytes = self.d.kv_page_bytes // ps
@@ -438,6 +665,9 @@ class ContinuousBatchingEngine:
                 break
             keys = self.cache.block_keys(ids)
             hits = self.cache.match(keys)
+            n_dev = len(hits)
+            n_tier, do_restore, hold = self._tier_plan(keys, n_dev)
+            span = n_dev + (n_tier if do_restore else 0)
             # pick the largest mounted span the pool can cover: mounted
             # hit pages are excluded from eviction, so on a tight pool
             # a full-span mount can be self-blocking (the parked hit
@@ -446,9 +676,11 @@ class ContinuousBatchingEngine:
             # turns the excess hits back into evictable parked pages,
             # so any request the cache-less engine could admit
             # eventually admits here too (n_hit=0 needs exactly the
-            # cache-less page count).
+            # cache-less page count). Restored blocks degrade FIRST
+            # (deepest-span-off): they are the ones that COST free
+            # pages.
             chosen = None
-            for n_hit in range(len(hits), -1, -1):
+            for n_hit in range(span, -1, -1):
                 start = n_hit * ps
                 # full hit: re-consume the last token (n_hit > 0 guard:
                 # an EMPTY prompt trivially satisfies start >= L with
@@ -456,37 +688,68 @@ class ContinuousBatchingEngine:
                 cow = n_hit > 0 and start >= L
                 if cow:
                     start = L - 1
-                need_new = total - n_hit + (1 if cow else 0)
+                n_rest = max(0, n_hit - n_dev)
+                need_new = total - n_hit + (1 if cow else 0) + n_rest
                 if need_new <= len(self._free) + self.cache.evictable(
                         exclude=keys[:n_hit]):
-                    chosen = (n_hit, start, cow, need_new)
+                    chosen = (n_hit, start, cow, need_new, n_rest)
                     break
             if chosen is None:
                 break                    # head-of-line: wait for pages
-            n_hit, start, cow, need_new = chosen
-            hits = hits[:n_hit]
+            n_hit, start, cow, need_new, n_rest = chosen
+            hits = hits[:n_hit - n_rest]
             self._queue.pop(0)
-            self.cache.mount(keys[:n_hit])
+            if n_tier:
+                # recompute-decided host blocks — plus any restore
+                # span DEGRADED away by the head-of-line loop — are
+                # re-prefilled: count + recency-refresh them (only now
+                # that the admission commits)
+                lo = max(n_hit, n_dev)
+                n_recomp = n_dev + n_tier - lo
+                if n_recomp:
+                    self._tier_recompute(keys, lo, n_recomp)
+            self.cache.mount(keys[:len(hits)])
             if len(self._free) < need_new:
-                freed = self.cache.evict(need_new - len(self._free))
+                freed = self.cache.evict(need_new - len(self._free),
+                                         spill=self._spill_page)
                 self.stats.prefix_evictions += len(freed)
                 self._free.extend(freed)
             privates = [self._free.pop() for _ in range(need_new)]
+            keys_meta = keys
+            inserted = {}
+            if n_rest:
+                rest_pages = [privates.pop() for _ in range(n_rest)]
+                inserted = dict(self._tier_restore(
+                    keys, len(hits), rest_pages, hold, rid))
+                if not all(inserted.values()):
+                    # a capacity-refused restore insert breaks the held
+                    # chain: publishing deeper blocks would chain under
+                    # an unheld parent (the eviction-cascade invariant)
+                    # — stop publishing for this request entirely
+                    keys_meta = keys[:len(hits)]
+                hits = hits + rest_pages
             shared = list(hits)
+            shared_set = set(shared[:n_hit - n_rest]) | \
+                {p for p, ok in inserted.items() if ok}
             if cow:
-                dst = privates.pop()
-                self.d.copy_page(shared[-1], dst)
-                self.cache.release_page(shared[-1])
-                self.stats.prefix_cow += 1
-                shared_set = set(shared[:-1])
-                shared[-1] = dst
-            else:
-                shared_set = set(shared)
+                last = shared[-1]
+                if last in shared_set:
+                    dst = privates.pop()
+                    self.d.copy_page(last, dst)
+                    self.cache.release_page(last)
+                    self.stats.prefix_cow += 1
+                    shared_set.discard(last)
+                    shared[-1] = dst
+                else:
+                    # the final block is a restore whose cache insert
+                    # was refused: the page is ALREADY private — no
+                    # copy needed, return the spare CoW page
+                    self._free.append(privates.pop())
             pages = shared + privates    # block order: prefix first
             self._slot_req[slot] = rid
             self._slot_pages[slot] = pages
             self._slot_shared[slot] = shared_set
-            self._cache_meta[rid] = (start, keys, n_hit)
+            self._cache_meta[rid] = (start, keys_meta, n_hit)
             self.stats.prefix_hits += n_hit
             self.stats.prefix_misses += len(keys) - n_hit
             self.stats.prefix_tokens_saved += start
@@ -544,6 +807,12 @@ class ContinuousBatchingEngine:
             "shared": {s: sorted(sh)
                        for s, sh in enumerate(self._slot_shared) if sh},
             "cache": self.cache.ledger() if self.cache else {},
+            # host-tier rows (tiered KV): spilled entries by chain key,
+            # with the device-twin backref of restored entries — the
+            # audit cross-checks a twin against the free list (a key
+            # both host-resident-with-a-device-twin and device-free is
+            # a dropped unmount)
+            "host": self.tier.ledger() if self.tier is not None else {},
         }
 
     def audit_pages(self):
@@ -655,6 +924,10 @@ class ContinuousBatchingEngine:
             if step_times is not None:
                 step_times.append(dt)
             n = self.stats.tokens - before
+            # tiered-KV: drain any restore price — on this blocking
+            # path a restore always rides a prefill-polluted window,
+            # which the drift ledger excludes anyway
+            self._take_restore_s()
             if self.trace is not None and active:
                 # a step that contained a blocking prefill is not a
                 # decode tick: price it as None so the drift ledger
@@ -852,11 +1125,16 @@ class ContinuousBatchingEngine:
                 meta = (out.tokens_block, out.done_before, k,
                         {s: self._slot_req[s] for s in disp}, t0,
                         prefilled)
+                # tiered-KV: the H2D of any restore dispatched this
+                # round lands inside THIS horizon's measured window —
+                # its price rides the prediction (drained even when
+                # untraced so it can't accumulate)
+                restore_s = self._take_restore_s()
                 if self.trace is not None:
                     meta_ev = self.trace.tick_dispatch(
                         "serve", ("decode", k, 1), ts=t0,
                         predicted_s=self._price_horizon(
-                            k, 1, 0, decode_rows=len(disp)),
+                            k, 1, 0, decode_rows=len(disp)) + restore_s,
                         k=k, w=1, decode_rows=len(disp), prefill_rows=0,
                         warm_shape=self._trace_shape_warm(("decode", k)))
                     if pending_ev is not None and \
@@ -1150,6 +1428,11 @@ class ContinuousBatchingEngine:
                 meta = (out.tokens_block, out.emitted, out.real,
                         disp_toks, plan.k, dict(live), plan.emit_ticks,
                         t0)
+                # tiered-KV: restores dispatched at this round's
+                # admission are functionally ordered before this
+                # horizon's reads — their priced H2D belongs to this
+                # window's prediction (drained even when untraced)
+                restore_s = self._take_restore_s()
                 if self.trace is not None:
                     shape = (("packed", plan.k, t_tokens)
                              if self.packed
@@ -1158,7 +1441,8 @@ class ContinuousBatchingEngine:
                         "serve", shape, ts=t0,
                         predicted_s=self._price_horizon(
                             plan.k, plan.w, plan.prefill_rows,
-                            decode_rows=len(live) - plan.prefill_rows),
+                            decode_rows=len(live) - plan.prefill_rows)
+                        + restore_s,
                         k=plan.k, w=plan.w,
                         decode_rows=len(live) - plan.prefill_rows,
                         prefill_rows=plan.prefill_rows,

@@ -68,10 +68,19 @@ class PrefixCache:
     lookup misses and inserts are refused, which is the exact
     "caching off" twin the equivalence tests compare against)."""
 
-    def __init__(self, page_size, salt=b"", capacity=None):
+    def __init__(self, page_size, salt=b"", capacity=None, tier=None):
         self.page_size = int(page_size)
         self.salt = salt if isinstance(salt, bytes) else str(salt).encode()
         self.capacity = capacity
+        # optional HOST spill tier (serving.kv_tier.HostKVTier): pages
+        # evicted under pool pressure spill their bytes to pinned host
+        # RAM instead of vanishing, and admissions whose chain
+        # continues onto host entries may restore them (the engine owns
+        # the spill/restore I/O and the pricing; the cache only chains
+        # the keys). None = the single-level cache of PR 8.
+        self.tier = tier
+        self._decoder = None             # weakref set by the engine —
+        # save() reads the pool through it when no decoder is passed
         self._entries = {}               # key -> _Entry
         self._by_page = {}               # page id -> key
         self._lru = collections.OrderedDict()   # key -> None (refs == 0)
@@ -178,21 +187,25 @@ class PrefixCache:
         ex = set(exclude)
         return sum(1 for k in self._lru if k not in ex)
 
-    def evict(self, n, exclude=()):
+    def evict(self, n, exclude=(), spill=None):
         """Reclaim at least `n` parked pages (LRU-first), cascading to
         each victim's parked descendants (their chain keys are
         unreachable once an ancestor is gone).  Returns the freed page
-        ids — the caller (engine) owns them again."""
+        ids — the caller (engine) owns them again.  `spill(key, page)`,
+        if given, runs for every victim BEFORE its page is unmapped —
+        the host-tier hook: the engine copies the page's bytes (and
+        scale planes) to `self.tier` there, so eviction demotes instead
+        of destroys."""
         ex = set(exclude)
         freed = []
         while len(freed) < n:
             victim = next((k for k in self._lru if k not in ex), None)
             if victim is None:
                 break
-            freed.extend(self._evict_subtree(victim))
+            freed.extend(self._evict_subtree(victim, spill=spill))
         return freed
 
-    def _evict_subtree(self, key):
+    def _evict_subtree(self, key, spill=None):
         freed = []
         stack = [key]
         while stack:
@@ -203,6 +216,11 @@ class PrefixCache:
             if e.refs:
                 raise RuntimeError(
                     f"evicting page {e.page} with refcount {e.refs}")
+            if spill is not None:
+                # the page is still mapped here: the D2H copy reads
+                # bytes written by prefills device-ordered before any
+                # parked state (nobody writes a parked page)
+                spill(k, e.page)
             stack.extend(e.children)
             self._lru.pop(k, None)
             del self._by_page[e.page]
@@ -232,3 +250,186 @@ class PrefixCache:
         MEM-PAGE-REFCOUNT lint consumes via the engine's page ledger."""
         return {e.page: {"refs": e.refs, "parked": e.refs == 0}
                 for e in self._entries.values()}
+
+    # ------------------------------------------------------ persistence
+
+    def _fingerprint_hex(self, decoder):
+        return hashlib.blake2b(decoder.cache_fingerprint(),
+                               digest_size=16).hexdigest()
+
+    def save(self, path, decoder=None):
+        """Persist the cache so it outlives the engine: the decoder's
+        pool arrays (through the `pool_state` seam — quant config
+        included), the chain index (key -> page, parents, LRU order),
+        and every host-tier entry's payload, keyed by a digest of
+        `decoder.cache_fingerprint()`. `load()` on a decoder with a
+        different fingerprint REFUSES (same contract as the
+        quant-config check in `load_pool_state`): the cached bytes are
+        only valid for the exact weights/arch/pool config that wrote
+        them.
+
+        `decoder` defaults to the engine-bound one (the engine attaches
+        itself at construction). Every entry must be parked (refs 0) —
+        drain the engine first; saving under live requests would
+        snapshot pages about to diverge."""
+        import json
+        import os
+        dec = decoder
+        if dec is None and self._decoder is not None:
+            dec = self._decoder()
+        if dec is None:
+            raise ValueError(
+                "PrefixCache.save needs the decoder whose pool holds "
+                "the cached pages — pass decoder=, or attach the cache "
+                "to an engine first")
+        live = sum(1 for e in self._entries.values() if e.refs)
+        if live:
+            raise RuntimeError(
+                f"cannot save a prefix cache with {live} live-"
+                "referenced page(s) — drain the engine (run() to "
+                "completion) so every entry is parked first")
+        os.makedirs(path, exist_ok=True)
+        state = dec.pool_state()
+        arrays, meta = {}, {}
+
+        def add(name, arr):
+            arr = np.asarray(arr)
+            # raw-byte view: npz can't serialize ml_dtypes (bf16)
+            # leaves directly; shape+dtype live in the JSON index
+            arrays[name] = np.frombuffer(arr.tobytes(), np.uint8)
+            meta[name] = {"shape": list(arr.shape),
+                          "dtype": str(arr.dtype)}
+
+        for pool in ("k_pages", "v_pages"):
+            leaves = state[pool] if isinstance(state[pool], tuple) \
+                else (state[pool],)
+            for i, leaf in enumerate(leaves):
+                add(f"{pool}.{i}", leaf)
+        entries = []                     # LRU order: oldest first, so a
+        for k in self._lru:              # loaded cache evicts in the
+            e = self._entries[k]         # same sequence
+            entries.append([k.hex(), int(e.page),
+                            e.parent.hex() if e.parent else None])
+        host = []
+        if self.tier is not None:
+            for j, (k, te) in enumerate(self.tier.items()):
+                leaves = {"k": len(te.payload["k"]),
+                          "v": len(te.payload["v"])}
+                for part in ("k", "v"):
+                    for i, leaf in enumerate(te.payload[part]):
+                        add(f"host.{j}.{part}.{i}", leaf)
+                host.append([k.hex(), leaves])
+        index = {"fingerprint": self._fingerprint_hex(dec),
+                 "page_size": self.page_size,
+                 "kv_quant": state["kv_quant"],
+                 # the chain keys were computed under THIS salt — a
+                 # load that rebound a different salt would hash every
+                 # warm prompt to keys that never match the saved
+                 # entries (0 hits, silently)
+                 "salt": self.salt.hex(),
+                 # bounds round-trip too: reloading a bounded cache /
+                 # tier under DEFAULT bounds could silently LRU-drop
+                 # part of the persisted warm set during the refill
+                 "capacity": self.capacity,
+                 "tier_capacity_bytes": (self.tier.capacity_bytes
+                                         if self.tier is not None
+                                         else None),
+                 "entries": entries, "host": host, "arrays": meta}
+        np.savez(os.path.join(path, "kv_pool.npz"), **arrays)
+        with open(os.path.join(path, "index.json"), "w") as f:
+            json.dump(index, f)
+        return path
+
+    @classmethod
+    def load(cls, path, decoder, tier=None, capacity=None):
+        """Rebuild a saved cache onto `decoder`: refuses on fingerprint
+        mismatch (different weights, architecture, page size, pool
+        dtype or quant config than the decoder that wrote it — mounted
+        pages would hold another model's KV), then restores the pool
+        through `load_pool_state` (which re-checks quant config and
+        shapes, and refuses while any attached engine holds live
+        pages), re-parks every entry in its saved LRU order, and
+        refills the host tier (`tier`, or a fresh `HostKVTier` when
+        the save carried host entries). Returns the cache — hand it to
+        `ContinuousBatchingEngine(prefix_cache=...)`, whose free list
+        excludes the cache-owned pages."""
+        import json
+        import os
+        with open(os.path.join(path, "index.json")) as f:
+            index = json.load(f)
+        salt = index.get("salt")
+        me = cls(decoder.page_size,
+                 # saved salt wins: the persisted chain keys were
+                 # hashed under it (pre-salt saves were all written by
+                 # fingerprint-salted caches, so the fallback matches)
+                 salt=(bytes.fromhex(salt) if salt is not None
+                       else decoder.cache_fingerprint()),
+                 capacity=(index.get("capacity") if capacity is None
+                           else capacity),
+                 tier=tier)
+        want = index["fingerprint"]
+        have = me._fingerprint_hex(decoder)
+        if want != have:
+            raise ValueError(
+                f"cached KV at {path!r} was written by a decoder with "
+                f"fingerprint {want} but this decoder is {have} — "
+                "different weights/architecture/pool config would "
+                "mount garbage KV; delete the cache dir or rebuild "
+                "the matching decoder")
+        data = np.load(os.path.join(path, "kv_pool.npz"))
+        meta = index["arrays"]
+
+        def get(name):
+            m = meta[name]
+            # .copy(): frombuffer views are read-only and may be
+            # ZERO-copied into device buffers by the CPU backend —
+            # which the decode programs then DONATE (XLA recycling
+            # memory it doesn't own). A writable owned copy keeps the
+            # loaded pool safely donatable.
+            return np.frombuffer(
+                data[name].tobytes(), np.dtype(m["dtype"])
+            ).reshape(m["shape"]).copy()
+
+        def pool(name):
+            leaves = tuple(get(f"{name}.{i}")
+                           for i in range(len([k for k in meta
+                                               if k.startswith(name + ".")
+                                               ])))
+            return leaves if len(leaves) > 1 else leaves[0]
+
+        decoder.load_pool_state({"kv_quant": index["kv_quant"],
+                                 "k_pages": pool("k_pages"),
+                                 "v_pages": pool("v_pages")})
+        # bind the decoder the pool was just loaded onto: the engine
+        # refuses to adopt this cache with any OTHER decoder (same
+        # weights or not — its pool does not hold these pages), and
+        # save() can read the pool with no engine attached
+        import weakref
+        me._decoder = weakref.ref(decoder)
+        for key_hex, page, parent_hex in index["entries"]:
+            k = bytes.fromhex(key_hex)
+            parent = bytes.fromhex(parent_hex) if parent_hex else None
+            e = _Entry(key=k, page=int(page), parent=parent, refs=0)
+            me._entries[k] = e
+            me._by_page[int(page)] = k
+            me._lru[k] = None
+        # children links in a SECOND pass: the saved LRU order can park
+        # a child before its parent (the child's holder retired first),
+        # and a link dropped here would break the eviction cascade —
+        # the parent would evict without cascading to its (now
+        # unreachable) descendant, stranding a device page
+        for e in me._entries.values():
+            if e.parent is not None and e.parent in me._entries:
+                me._entries[e.parent].children.add(e.key)
+        if index["host"]:
+            if me.tier is None:
+                from .kv_tier import HostKVTier
+                cap = index.get("tier_capacity_bytes")
+                me.tier = HostKVTier() if cap is None else \
+                    HostKVTier(capacity_bytes=cap)
+            for j, (key_hex, leaves) in enumerate(index["host"]):
+                payload = {part: tuple(get(f"host.{j}.{part}.{i}")
+                                       for i in range(leaves[part]))
+                           for part in ("k", "v")}
+                me.tier.put(bytes.fromhex(key_hex), payload)
+        return me

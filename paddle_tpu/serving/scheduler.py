@@ -96,6 +96,25 @@ class RaggedScheduler:
                                    flops_per_token=self.flops_per_token)
         self.k_max = max(1, int(k_max))
         self._pf_left = np.zeros(decoder.max_batch, np.int64)
+        self._restore_s = 0.0       # in-flight tiered-KV H2D (seconds)
+
+    # ------------------------------------------------- tiered-KV restores
+
+    def note_restore(self, seconds):
+        """Admission just dispatched a host-tier page restore priced at
+        `seconds` of H2D (`cost_model.kv_restore_s`). The mount is
+        functionally ordered before the NEXT horizon's reads, so that
+        horizon's wall time carries the wire cost — `take_restore_s`
+        hands the accumulated price to the engine's horizon pricing so
+        the drift ledger compares like with like instead of flagging a
+        correctly restoring engine as mispriced."""
+        self._restore_s += float(seconds)
+
+    def take_restore_s(self):
+        """Drain the pending restore price (called once per dispatched
+        horizon — the H2D lands inside exactly one measured window)."""
+        s, self._restore_s = self._restore_s, 0.0
+        return s
 
     # ------------------------------------------------------ accounting
 

@@ -84,6 +84,18 @@ class ServeStats:
     prefix_cow: int = 0          # copy-on-write page copies
     prefix_tokens_saved: int = 0  # prompt positions whose prefill was skipped
     prefix_bytes_saved: int = 0  # KV bytes not recomputed (mounted pages)
+    # tiered-KV ledger (serving.kv_tier): the host-RAM spill tier
+    # behind the prefix cache. Counters are lifetime; host_tier_bytes
+    # is a gauge (current host residency). tier_restores/tier_
+    # recomputes make the priced restore-vs-recompute decision
+    # OBSERVABLE: blocks found host-resident at admission either
+    # re-mounted over the wire (restore) or re-prefilled because the
+    # MXU beat the PCIe leg (recompute — the host entry is refreshed,
+    # its bytes stay valid by write-time determinism).
+    tier_spills: int = 0         # pages demoted to the host tier
+    tier_restores: int = 0       # host blocks re-mounted via H2D
+    tier_recomputes: int = 0     # host blocks re-prefilled (wire lost)
+    host_tier_bytes: int = 0     # current host-tier residency (gauge)
     # capacity ledger (set once at engine construction from the
     # decoder's pool layout; scale-plane metadata included for int8
     # pools): the observable side of the KV-quant capacity claim —
@@ -148,6 +160,12 @@ class ServeStats:
             d["prefix_cow"] = self.prefix_cow
             d["prefix_tokens_saved"] = self.prefix_tokens_saved
             d["prefix_bytes_saved"] = self.prefix_bytes_saved
+        if self.tier_spills or self.tier_restores or \
+                self.tier_recomputes or self.host_tier_bytes:
+            d["tier_spills"] = self.tier_spills
+            d["tier_restores"] = self.tier_restores
+            d["tier_recomputes"] = self.tier_recomputes
+            d["host_tier_bytes"] = self.host_tier_bytes
         if self.kv_pool_bytes:
             d["kv_pool_bytes"] = self.kv_pool_bytes
             d["kv_bytes_per_token"] = self.kv_bytes_per_token

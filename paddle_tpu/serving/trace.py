@@ -18,7 +18,11 @@ the way it was and what one request experienced:
   Doctor's `ROOFLINE-DRIFT` rule and `debug.serving_report()`: a
   shape whose measured tick departs from the priced
   max(compute, HBM, wire) by more than a configurable factor is a
-  mispriced schedule, surfaced instead of silently absorbed.
+  mispriced schedule, surfaced instead of silently absorbed.  The
+  tiered-KV path rides the same machinery: "spill" events mark pages
+  demoted to the host tier, and restores record ("h2d_restore",)
+  ticks whose predicted (`cost_model.kv_restore_s`) vs measured H2D
+  feeds this ledger (docs/observability.md).
 
 Non-perturbation is a hard contract: the recorder only ever touches
 host-side values the engine already fetched (never a device array),

@@ -838,12 +838,16 @@ def test_training_rule_catches_undonated_carry():
 def _consistent_ledger():
     """8-page pool, scratch=7: pages 0-1 free, slot 0 holds [2, 3]
     with 2 cache-shared (refs 1), slot 1 holds [4, 5], page 6 parked
-    (refcount 0) in the cache."""
+    (refcount 0) in the cache. Host-tier rows (tiered KV): one
+    host-only spilled entry, and one restored entry whose device twin
+    is the parked page 6."""
     return {"num_pages": 8, "scratch": 7, "free": [0, 1],
             "slots": {0: [2, 3], 1: [4, 5]},
             "shared": {0: [2]},
             "cache": {2: {"refs": 1, "parked": False},
-                      6: {"refs": 0, "parked": True}}}
+                      6: {"refs": 0, "parked": True}},
+            "host": {"aa01": {"bytes": 4096, "page": None},
+                     "bb02": {"bytes": 4096, "page": 6}}}
 
 
 def test_page_refcount_rule_clean_on_consistent_ledger():
@@ -860,6 +864,7 @@ def test_page_refcount_rule_clean_on_consistent_ledger():
     assert m["checked"] and m["n_pages"] == 8
     assert m["n_cached"] == 2 and m["n_parked"] == 1
     assert m["refcount_total"] == 1
+    assert m["n_host"] == 2 and m["host_bytes"] == 8192
     # scope: no ledger -> not this analyzer's business
     report2 = pm.run(prog, AnalysisContext(name="ledger"))
     assert report2.metrics["page-refcount"] == {"checked": False}
@@ -882,6 +887,18 @@ def test_page_refcount_rule_clean_on_consistent_ledger():
     (lambda lg: lg["shared"][0].append(3), "does not track"),
     # reference dropped without decref: slot still maps a parked page
     (lambda lg: lg["slots"][1].append(6), "reference dropped"),
+    # tiered KV: a host entry's device twin sits on the free list —
+    # the eviction freed the page but dropped the tier's unmount
+    # bookkeeping (a later prefill would overwrite an "advertised"
+    # mounted twin)
+    (lambda lg: lg["host"].update(
+        cc03={"bytes": 4096, "page": 1}),
+     "both host-resident and device-free"),
+    # tiered KV: a host entry's twin backref points at a page the
+    # cache no longer tracks (stale restore backref)
+    (lambda lg: lg["host"].update(
+        dd04={"bytes": 4096, "page": 3}),
+     "stale restore backref"),
 ])
 def test_page_refcount_rule_catches_planted_defects(mutate, expect):
     """Each corruption of the shared-pool ledger — double free, leak,

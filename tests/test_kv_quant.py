@@ -320,3 +320,120 @@ def test_int8_kernel_path_matches_jnp_through_engine(tiny_model):
         rid = eng.submit(np.asarray(prompt, np.int32))
         outs[kernel] = eng.run()[rid]
     assert outs[False] == outs[True]
+
+
+# -------------------------------------------------------- int4 groundwork
+
+
+def test_int4_pack_unpack_round_trip():
+    """The nibble layout is exactly invertible for every int4 value
+    (pricing + primitive land now; pool wiring is the named
+    follow-up)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.decoder import _pack_int4, _unpack_int4
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randint(-8, 8, (5, 3, 64)).astype(np.int8))
+    packed = _pack_int4(q)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == (5, 3, 32)        # two values per byte
+    assert (np.asarray(_unpack_int4(packed)) == np.asarray(q)).all()
+
+
+def test_int4_per_group_quantize_dequantize_error_bounded():
+    """`_quantize_kv_int4` round-trips within half a quantization step
+    PER GROUP (each group's step is its own amax/7 — the per-group
+    scales are the whole point: one outlier head no longer flattens
+    every other group's resolution), and the scales depend only on the
+    token's own values (the write-time determinism rule int8 already
+    obeys)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.decoder import (INT4_GROUP,
+                                            _dequantize_kv_int4,
+                                            _quantize_kv_int4)
+    rng = np.random.RandomState(1)
+    v = jnp.asarray(rng.randn(6, 4, 32).astype(np.float32))  # [.., H, D]
+    packed, scales = _quantize_kv_int4(v)
+    assert packed.shape == (6, 64) and scales.shape == (6, 128 // INT4_GROUP)
+    dv = np.asarray(_dequantize_kv_int4(packed, scales, (4, 32)))
+    err = np.abs(dv - np.asarray(v)).reshape(6, -1, INT4_GROUP)
+    half_step = np.asarray(scales)[..., None] / 2 + 1e-6
+    assert (err <= half_step).all()
+    # determinism: same token values -> same bytes, batch-independent
+    p2, s2 = _quantize_kv_int4(v[2:3])
+    assert (np.asarray(p2) == np.asarray(packed[2:3])).all()
+    assert (np.asarray(s2) == np.asarray(scales[2:3])).all()
+
+
+def test_int4_quantize_handles_ragged_group_and_odd_widths():
+    """H*D need not be a multiple of INT4_GROUP (nor even): the tail
+    group zero-pads (ceil groups, exactly what the pricing leg
+    charges) and an odd nibble count pads one spare nibble before
+    packing — the round-trip still lands within half a step and the
+    shapes match `pool_token_bytes`'s ceil arithmetic."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.decoder import (INT4_GROUP,
+                                            _dequantize_kv_int4,
+                                            _quantize_kv_int4)
+    rng = np.random.RandomState(2)
+    # 3 heads x 16 dim = 48 elems: > INT4_GROUP but not a multiple
+    v = jnp.asarray(rng.randn(4, 3, 16).astype(np.float32))
+    packed, scales = _quantize_kv_int4(v)
+    assert scales.shape == (4, (48 + INT4_GROUP - 1) // INT4_GROUP)
+    dv = np.asarray(_dequantize_kv_int4(packed, scales, (3, 16)))
+    assert dv.shape == (4, 3, 16)
+    step = np.repeat(np.asarray(scales), INT4_GROUP,
+                     axis=-1)[..., :48].reshape(4, 3, 16)
+    assert (np.abs(dv - np.asarray(v)) <= step / 2 + 1e-6).all()
+    # odd H*D: 1 head x 7 dim -> one spare nibble, still exact shapes
+    v7 = jnp.asarray(rng.randn(2, 1, 7).astype(np.float32))
+    p7, s7 = _quantize_kv_int4(v7)
+    d7 = np.asarray(_dequantize_kv_int4(p7, s7, (1, 7)))
+    assert d7.shape == (2, 1, 7)
+    assert (np.abs(d7 - np.asarray(v7)) <=
+            np.asarray(s7)[..., None] / 2 + 1e-6).all()
+
+
+def test_pool_token_bytes_rejects_unknown_quant(tiny_model):
+    """An unrecognized kv_quant string must REFUSE, not silently price
+    as int8 — `step_hbm_bytes(kv_quant="bf16")` would otherwise report
+    the int8 stream for the 'unquantized' what-if and invert capacity
+    comparisons."""
+    from paddle_tpu.serving.decoder import pool_token_bytes
+    with pytest.raises(ValueError, match="kv_quant"):
+        pool_token_bytes(tiny_model.cfg, kv_quant="bf16")
+    dec = PagedGPTDecoder(tiny_model, num_pages=16, page_size=16,
+                          max_batch=2)
+    with pytest.raises(ValueError, match="kv_quant"):
+        dec.step_hbm_bytes(avg_ctx=64, kv_quant="bf16")
+
+
+def test_int4_pricing_leg(tiny_model):
+    """`pool_token_bytes` / `kv_token_bytes` / `step_hbm_bytes` learn
+    the int4 column: packed nibbles + per-group f32 scales land under
+    the int8 stream, which lands under bf16/f32 — and the what-if
+    `step_hbm_bytes(kv_quant=...)` override prices the hierarchy
+    without building a pool, so `decode_horizon` K is monotone in the
+    quant mode."""
+    from paddle_tpu.cost_model import decode_horizon
+    from paddle_tpu.serving.decoder import INT4_GROUP, pool_token_bytes
+    cfg = tiny_model.cfg
+    hd = cfg.num_heads * cfg.head_dim
+    b4 = pool_token_bytes(cfg, kv_quant="int4")
+    b8 = pool_token_bytes(cfg, kv_quant="int8")
+    b16 = pool_token_bytes(cfg, itemsize=2)
+    assert b4 < b8 < b16
+    n_groups = (hd + INT4_GROUP - 1) // INT4_GROUP
+    assert b4 == 2 * ((n_groups * INT4_GROUP + 1) // 2 + 4 * n_groups)
+    dec = PagedGPTDecoder(tiny_model, num_pages=16, page_size=16,
+                          max_batch=2)
+    full = dec.step_hbm_bytes(avg_ctx=64)
+    w8 = dec.step_hbm_bytes(avg_ctx=64, kv_quant="int8")
+    w4 = dec.step_hbm_bytes(avg_ctx=64, kv_quant="int4")
+    assert w4 < w8 < full
+    # fewer KV bytes -> same sync amortizes over MORE fused ticks
+    sync = 1e-3
+    assert decode_horizon(w4, host_sync_s=sync) >= \
+        decode_horizon(full, host_sync_s=sync)

@@ -501,3 +501,53 @@ def test_propagation_drops_reduced_dims():
     capped = propagate_shard_counts(jx, arg_counts=[2],
                                     arg_dims=[(2, 4)])
     assert capped[m_out] <= 2
+
+
+def test_propagation_drops_scattered_dims():
+    """Sharding propagation fidelity (scatter slice): a scatter's
+    output has the OPERAND's shape, and the operand's dim sharding
+    threads through — EXCEPT on the dynamically indexed dims
+    (scatter_dims_to_operand_dims / inserted_window_dims): updates
+    land at runtime positions along those dims, so GSPMD cannot keep
+    a static split without resharding and the result is at best
+    replicated on that mesh axis (the dot/reduce contracted-dim rule
+    applied to indexed dims). Capped at the most-sharded operand, as
+    everywhere."""
+    from paddle_tpu.analysis.memory import (_eqn_out_shard,
+                                            propagate_shard_counts)
+
+    def f(x, i, u):
+        return x.at[i].set(u), x.at[i].add(u)
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 4)),
+                           jnp.zeros((3,), jnp.int32),
+                           jnp.zeros((3, 4))).jaxpr
+    eqns = {e.primitive.name: e for e in jx.eqns}
+    assert "scatter" in eqns and "scatter-add" in eqns
+
+    # --- unit: indexed dim 0 drops its factor, window dim 1 threads
+    for name in ("scatter", "scatter-add"):
+        cnt, dims = _eqn_out_shard(eqns[name], [8, 1, 1],
+                                   [(2, 4), None, None])
+        assert cnt == 4 and dims == (1, 4), name
+        # operand sharded ONLY on the indexed dim: everything drops
+        cnt0, dims0 = _eqn_out_shard(eqns[name], [4, 1, 1],
+                                     [(4, 1), None, None])
+        assert cnt0 == 1 and dims0 == (1, 1), name
+        # cap: kept-dim factor above the most-sharded operand bails to
+        # the blind cap (never claim finer sharding than any input)
+        cntc, dimsc = _eqn_out_shard(eqns[name], [2, 1, 1],
+                                     [(1, 4), None, None])
+        assert cntc == 2 and dimsc is None, name
+        # legacy (no dim info): blind max-operand inherit — unchanged
+        cntl, _ = _eqn_out_shard(eqns[name], [8, 1, 1],
+                                 [None, None, None])
+        assert cntl == 8, name
+
+    # --- through the jaxpr: dp on the batch dim survives the update
+    # (window dim), tp on the indexed dim drops
+    counts = propagate_shard_counts(jx, arg_counts=[8, 1, 1],
+                                    arg_dims=[(2, 4), None, None])
+    set_out = eqns["scatter"].outvars[0]
+    add_out = eqns["scatter-add"].outvars[0]
+    assert counts[set_out] == 4 and counts[add_out] == 4
