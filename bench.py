@@ -906,12 +906,20 @@ def run_ragged_stall(gen=48, long_prompt=448, chunk=16, k_max=2):
         # near-clean just because their ratio sits below 1
         worst = max((max(d["ratio"], 1.0 / d["ratio"])
                      for d in drift if d["ratio"] > 0), default=0.0)
+        # drifting shapes whose measured tick sits INSIDE the serial
+        # sum of the priced legs are a SERIALIZED schedule, not a
+        # mispriced leg (the ROOFLINE-DRIFT verdict split — the fix is
+        # the schedule pass / COLL-SERIALIZED, not re-fitting inputs)
+        n_serialized = sum(1 for d in drift
+                           if d.get("verdict") == "serialized")
         log(f"ragged_stall: flight trace -> {trace_path} "
-            f"({len(rec.events)} events, worst drift {worst:.1f}x)")
+            f"({len(rec.events)} events, worst drift {worst:.1f}x, "
+            f"{n_serialized} serialized shape(s))")
         print(json.dumps({"metric": "serving_roofline_drift",
                           "value": round(worst, 2),
                           "unit": "measured_over_predicted",
                           "shapes": len(drift),
+                          "serialized_shapes": n_serialized,
                           "trace_events": len(rec.events),
                           "path": trace_path}), flush=True)
     return row

@@ -25,6 +25,7 @@ from .pass_manager import (AnalysisContext, Analyzer,  # noqa: F401
 from . import analyzers  # noqa: F401  (registers the graph passes)
 from . import memory as _memory  # noqa: F401  (registers the memory pass)
 from . import sharding as _sharding  # noqa: F401  (registers sharding pass)
+from . import schedule as _schedule  # noqa: F401 (registers schedule pass)
 from .analyzers import COLLECTIVE_OPS, MXU_OPS  # noqa: F401
 from .ast_lint import lint_function  # noqa: F401
 from .lowering import ArgInfo, sharding_shard_count  # noqa: F401
@@ -34,9 +35,13 @@ from .manifest import (build_manifest, load_manifest,  # noqa: F401
                        manifest_drift, memory_manifest_path,
                        write_memory_manifest,
                        build_tuning_manifest, load_tuning_manifest,
-                       tuning_manifest_path, write_tuning_manifest)
+                       tuning_manifest_path, write_tuning_manifest,
+                       build_schedule_manifest, load_schedule_manifest,
+                       schedule_manifest_path, write_schedule_manifest)
 from .memory import (MemoryEstimate, audit_page_ledger,  # noqa: F401
                      estimate_jaxpr_memory, propagate_shard_counts)
+from .schedule import (ScheduleEstimate, ScheduleNode,  # noqa: F401
+                       estimate_schedule)
 from .remat_advisor import (REMAT_POLICIES, RematWhatIf,  # noqa: F401
                             advise_remat, replay_remat)
 from .autotune import (AutotuneReport, CandidateEstimate,  # noqa: F401
@@ -54,8 +59,11 @@ __all__ = [
     "memory_manifest_path", "write_memory_manifest",
     "build_tuning_manifest", "load_tuning_manifest",
     "tuning_manifest_path", "write_tuning_manifest",
+    "build_schedule_manifest", "load_schedule_manifest",
+    "schedule_manifest_path", "write_schedule_manifest",
     "MemoryEstimate", "estimate_jaxpr_memory", "propagate_shard_counts",
     "audit_page_ledger",
+    "ScheduleEstimate", "ScheduleNode", "estimate_schedule",
     "REMAT_POLICIES", "RematWhatIf", "advise_remat", "replay_remat",
     "AutotuneReport", "CandidateEstimate", "autotune", "autotune_layer",
     "rank_gpt_candidates",

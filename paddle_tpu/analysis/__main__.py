@@ -10,9 +10,11 @@ drift against committed lint AND memory manifests, and with
 HBM breakdown (peak, args/transient split, top live tensors);
 ``--autotune`` prints the remat advisor's what-if table (per-policy
 peak, recompute FLOPs, roofline step time — tuning_manifests/*.json
-pins it); ``--check`` regenerates every committed manifest in-memory
-(lint, memory AND tuning) and fails on any drift — the CI answer to
-stale manifests.
+pins it); ``--schedule`` prints the overlap-aware schedule breakdown
+(critical path, wire-hiding fraction, COLL-SERIALIZED evidence —
+schedule_manifests/*.json pins it); ``--check`` regenerates every
+committed manifest in-memory (lint, memory, tuning AND schedule) and
+fails on any drift — the CI answer to stale manifests.
 
 Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
 (the CI gate), 2 usage problems.
@@ -49,11 +51,11 @@ def _build_spec(spec):
 
 
 def _run_spec(spec, write, as_json, no_manifest, show_memory,
-              show_autotune=False):
+              show_autotune=False, show_schedule=False):
     from . import (PassManager, load_manifest, load_memory_manifest,
                    write_manifest, write_memory_manifest,
-                   write_tuning_manifest)
-    from .baseline import BASELINE_CONFIGS
+                   write_schedule_manifest, write_tuning_manifest)
+    from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
 
     pm = PassManager()
     program, ctx, fwd, built = _build_spec(spec)
@@ -71,6 +73,10 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
         msg = (f"wrote {ctx.name} manifests "
                f"({sum(data['op_counts'].values())} pinned ops, "
                f"{mem['per_device_peak_bytes']} peak bytes")
+        if spec in SCHEDULE_CONFIGS:
+            sch = write_schedule_manifest(ctx.name, report)
+            msg += (f", overlap step {sch['overlap_step_us']} us "
+                    f"(frac {sch['overlap_frac']})")
         if spec in BASELINE_CONFIGS:
             tun = write_tuning_manifest(ctx.name, _tuning_report(spec))
             msg += f", best remat={tun['best']}"
@@ -87,6 +93,8 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
                                          for k, v in sorted(gs.items())))
         if show_memory:
             _print_memory(report)
+        if show_schedule:
+            _print_schedule(report)
         if show_autotune:
             from .baseline import PROGRAM_CONFIGS
             if spec in PROGRAM_CONFIGS:
@@ -135,16 +143,33 @@ def _print_memory(report):
               "reshard(s)")
 
 
+def _print_schedule(report):
+    sch = report.metrics.get("schedule", {})
+    if not sch.get("available"):
+        print("   schedule: no jaxpr available")
+        return
+    print(f"   schedule: overlap step {sch['overlap_step_us']} us "
+          f"(roofline max {sch['ideal_step_us']}, serial "
+          f"{sch['serial_step_us']}) — overlap_frac "
+          f"{sch['overlap_frac']}, {sch['n_collectives']} "
+          f"collective(s), {sch['n_serialized_collectives']} "
+          "serialized")
+    for n in sch.get("critical_path", [])[:8]:
+        print(f"     {n['cost_us']:>10.2f} us {n['stream']:<10} "
+              f"{n['source']}")
+
+
 def _check_manifests(names):
-    """Regenerate every manifest in-memory (lint, memory AND tuning)
-    and diff against the committed files. Returns the number of
-    drifting/missing manifests (the --check CI mode: stale manifests
+    """Regenerate every manifest in-memory (lint, memory, tuning AND
+    schedule) and diff against the committed files. Returns the number
+    of drifting/missing manifests (the --check CI mode: stale manifests
     fail instead of silently re-baselining)."""
     from . import (PassManager, build_manifest, build_memory_manifest,
-                   build_tuning_manifest, load_manifest,
-                   load_memory_manifest, load_tuning_manifest,
+                   build_schedule_manifest, build_tuning_manifest,
+                   load_manifest, load_memory_manifest,
+                   load_schedule_manifest, load_tuning_manifest,
                    manifest_drift)
-    from .baseline import BASELINE_CONFIGS
+    from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
 
     pm = PassManager()
     n_bad = 0
@@ -158,6 +183,10 @@ def _check_manifests(names):
                                load_manifest(name), path="lint")
         drift += manifest_drift(build_memory_manifest(name, report),
                                 load_memory_manifest(name), path="memory")
+        if name in SCHEDULE_CONFIGS:
+            drift += manifest_drift(
+                build_schedule_manifest(name, report),
+                load_schedule_manifest(name), path="schedule")
         if name in BASELINE_CONFIGS:
             drift += manifest_drift(
                 build_tuning_manifest(name, _tuning_report(name)),
@@ -196,6 +225,10 @@ def main(argv=None):
     parser.add_argument("--memory", action="store_true",
                         help="print the per-device HBM breakdown "
                              "(peak, args/transient, top live tensors)")
+    parser.add_argument("--schedule", action="store_true",
+                        help="print the overlap-aware schedule "
+                             "breakdown (critical path, wire-hiding "
+                             "fraction, serialized collectives)")
     parser.add_argument("--autotune", action="store_true",
                         help="print the remat advisor's what-if table "
                              "(per-policy peak, recompute FLOPs, "
@@ -229,7 +262,8 @@ def main(argv=None):
     for name in names:
         report = _run_spec(name, args.write_manifests, args.json,
                            args.no_manifest_check, args.memory,
-                           show_autotune=args.autotune)
+                           show_autotune=args.autotune,
+                           show_schedule=args.schedule)
         sev = report.max_severity
         if sev is not None and (worst is None or sev > worst):
             worst = sev

@@ -221,7 +221,7 @@ def _state_bytes(arg_infos):
 
 
 def _price(whatif, state_b, batch_b, params_b, items, unit, chip,
-           ici_b=0, dcn_b=0, accum=1, batch_shard=1):
+           ici_b=0, dcn_b=0, accum=1, batch_shard=1, overlap_frac=1.0):
     """Roofline-price one replayed policy, PER DEVICE: the replayed
     peak and byte counts are already per-device (shard-count division),
     so the compute leg divides the batch-proportional FLOPs by the
@@ -230,8 +230,17 @@ def _price(whatif, state_b, batch_b, params_b, items, unit, chip,
     and is priced once). Throughput stays GLOBAL items per step. With
     grad accumulation the fwd/bwd repeats `accum` times before one
     epilogue, and a float32 params-shaped gradient accumulator joins
-    the peak."""
-    from ..cost_model import roofline_step_time
+    the peak.
+
+    `overlap_frac` is the schedule pass's wire-hiding fraction
+    (`analysis.schedule.estimate_schedule(...).overlap_frac`): the
+    step is priced through `roofline_step_time_overlap`, so a program
+    whose lowered schedule SERIALIZES its collectives ranks by the
+    time it will actually run at, not the full-overlap floor. With no
+    wire (every single-device candidate, including the gpt_1p3b probe
+    grid) the price is bit-identical to the old max() — rankings
+    can't move."""
+    from ..cost_model import roofline_step_time_overlap
     opt_flops = 12 * max(params_b // 2, 1)   # ~12 flops/param epilogue
     micro_flops = max(whatif.step_flops + whatif.recompute_flops
                       - opt_flops, 0) // max(batch_shard, 1)
@@ -242,8 +251,9 @@ def _price(whatif, state_b, batch_b, params_b, items, unit, chip,
     peak = whatif.peak_bytes
     if accum > 1:
         peak += 2 * params_b      # f32 grad accumulator (params are bf16)
-    rt = roofline_step_time(flops, hbm, ici_b * accum, dcn_b * accum,
-                            chip=chip)
+    rt = roofline_step_time_overlap(flops, hbm, ici_b * accum,
+                                    dcn_b * accum, chip=chip,
+                                    overlap_frac=overlap_frac)
     return peak, flops, rt, accum * items / max(rt.step_s, 1e-12)
 
 
@@ -287,11 +297,24 @@ def autotune(trainer, batch, hbm_budget=None, batch_sizes=None,
         state_b, batch_b, params_b, bshard = _state_bytes(
             program.arg_infos)
         ici_b, dcn_b = _wire_bytes(program, getattr(trainer, "mesh", None))
+        # overlap-aware wire leg: a program WITH collectives prices at
+        # the schedule pass's hiding fraction (a serialized psum can't
+        # hide behind the MXU); wire-free candidates skip the DAG walk
+        # — their price is bit-identical either way
+        overlap_frac = 1.0
+        if ici_b or dcn_b:
+            from .schedule import estimate_schedule
+            mesh = getattr(trainer, "mesh", None)
+            overlap_frac = estimate_schedule(
+                program, chip=chip,
+                mesh_axes=(dict(mesh.shape) if mesh is not None
+                           else None)).overlap_frac
         for w in advise_remat(program, policies=policies,
                               segments=segments):
             peak, flops, rt, thr = _price(
                 w, state_b, batch_b, params_b, items, unit, chip,
-                ici_b, dcn_b, batch_shard=bshard)
+                ici_b, dcn_b, batch_shard=bshard,
+                overlap_frac=overlap_frac)
             candidates.append(CandidateEstimate(
                 batch=bs, policy=w.policy, accum=1, peak_bytes=peak,
                 feasible=peak <= budget, step_s=rt.step_s,

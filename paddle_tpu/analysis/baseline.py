@@ -14,8 +14,9 @@ import jax.numpy as jnp
 
 from .pass_manager import AnalysisContext
 
-__all__ = ["BASELINE_CONFIGS", "PROGRAM_CONFIGS", "build_config",
-           "lowered_program", "forward_fn", "tuning_report"]
+__all__ = ["BASELINE_CONFIGS", "PROGRAM_CONFIGS", "SCHEDULE_CONFIGS",
+           "build_config", "lowered_program", "forward_fn",
+           "tuning_report"]
 
 _CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
 _TUNING_CACHE = {}   # name -> AutotuneReport (autotune.autotune_layer)
@@ -363,6 +364,14 @@ PROGRAM_CONFIGS = {
     "gpt_decode_kv8": _gpt_decode_kv8,         # int8 KV pool decode loop
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
+
+# configs whose schedule manifest is committed (schedule_manifests/):
+# the five BASELINE model forwards plus the fused train scan — the
+# programs whose step time the overlap-aware roofline prices. The
+# serving decode captures are excluded: a decode tick is one
+# HBM-bound stream with no collective to overlap, so the schedule
+# estimate adds nothing the memory manifests don't already pin.
+SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",)
 
 
 def build_config(name):

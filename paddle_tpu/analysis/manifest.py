@@ -22,11 +22,15 @@ __all__ = ["manifest_dir", "manifest_path", "load_manifest",
            "write_memory_manifest", "manifest_drift",
            "tuning_manifest_dir", "tuning_manifest_path",
            "load_tuning_manifest", "build_tuning_manifest",
-           "write_tuning_manifest"]
+           "write_tuning_manifest",
+           "schedule_manifest_dir", "schedule_manifest_path",
+           "load_schedule_manifest", "build_schedule_manifest",
+           "write_schedule_manifest"]
 
 _SCHEMA = 1
 _MEMORY_SCHEMA = 1
 _TUNING_SCHEMA = 1
+_SCHEDULE_SCHEMA = 1
 
 
 def manifest_dir():
@@ -200,6 +204,66 @@ def write_tuning_manifest(name, report):
     os.makedirs(tuning_manifest_dir(), exist_ok=True)
     data = build_tuning_manifest(name, report)
     with open(tuning_manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+# -------------------------------------------------------------- schedule
+
+
+def schedule_manifest_dir():
+    """Repo-root schedule_manifests/ (next to tuning_manifests/)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "schedule_manifests")
+
+
+def schedule_manifest_path(name):
+    return os.path.join(schedule_manifest_dir(), f"{name}.json")
+
+
+def load_schedule_manifest(name):
+    """The committed schedule manifest dict, or None when absent."""
+    try:
+        with open(schedule_manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_schedule_manifest(name, report):
+    """Schedule manifest dict from one pass-manager run
+    (analysis/schedule.py metrics): the overlap-aware/serial/roofline
+    step-time bracket, the wire-hiding fraction, and the critical-path
+    attribution. Deterministic — node pricing runs over the cached CPU
+    trace against the FIXED v5e spec (the tuning-manifest discipline),
+    so a TPU and a CPU checkout agree byte-for-byte."""
+    sch = report.metrics.get("schedule", {})
+    return {
+        "schema": _SCHEDULE_SCHEMA,
+        "model": name,
+        "chip": "v5e",
+        "n_nodes": sch.get("n_nodes", 0),
+        "n_collectives": sch.get("n_collectives", 0),
+        "n_serialized_collectives": sch.get(
+            "n_serialized_collectives", 0),
+        "wire": {"ici_bytes": sch.get("wire_ici_bytes", 0),
+                 "dcn_bytes": sch.get("wire_dcn_bytes", 0)},
+        "ideal_step_us": sch.get("ideal_step_us", 0),
+        "overlap_step_us": sch.get("overlap_step_us", 0),
+        "serial_step_us": sch.get("serial_step_us", 0),
+        "overlap_frac": sch.get("overlap_frac", 1.0),
+        "critical_path": sch.get("critical_path", []),
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_schedule_manifest(name, report):
+    os.makedirs(schedule_manifest_dir(), exist_ok=True)
+    data = build_schedule_manifest(name, report)
+    with open(schedule_manifest_path(name), "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
     return data

@@ -384,6 +384,13 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
       holds (`_reshape_dim_shards`), falling back to the conservative
       cap otherwise — so dp/tp knowledge survives the [B, S, H·D] <->
       [B·S, H, D] style reshapes between attention matmuls.
+    * `gather` / `dynamic_slice` drop shard factors on DYNAMICALLY
+      indexed dims (start_index_map / runtime slice starts): rows read
+      from dynamic positions admit no static split, so the result is
+      at best replicated on that mesh axis — while dims taken whole
+      (full slice size, not index-addressed) thread their factor, the
+      exact mirror of the scatter rule's write side. Capped at the
+      most-sharded operand like every slice above.
     * shape-preserving ops (elementwise chains) inherit the matching
       operand's dim vector, `transpose` permutes it — so dim knowledge
       survives between matmuls instead of dying at the first add/ln.
@@ -433,6 +440,67 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
                 if total > cap:       # no axis identity: never claim
                     return cap, None  # finer sharding than any input
                 return max(total, 1), dims
+        if name == "dynamic_slice" and in_dims and \
+                in_dims[0] is not None:
+            ss = eqn.params.get("slice_sizes")
+            ivs0 = [v for v in eqn.invars if _is_var(v)]
+            in_shape = tuple(getattr(ivs0[0].aval, "shape", ()))
+            if ss is not None and len(ss) == len(in_dims[0]) == \
+                    len(in_shape):
+                ld = in_dims[0]
+                # a dim sliced at a DYNAMIC start loses its factor —
+                # the start index is a runtime value, so GSPMD cannot
+                # keep a static split over the sliced span without
+                # resharding (the scatter indexed-dim rule, read side);
+                # a dim taken WHOLE (slice size == operand dim) is
+                # statically the identity and threads its factor
+                dims = tuple(int(d) if int(ss[i]) == int(in_shape[i])
+                             else 1 for i, d in enumerate(ld))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:       # no axis identity: never claim
+                    return cap, None  # finer sharding than any input
+                return max(total, 1), dims
+        if name == "gather" and in_dims and in_dims[0] is not None:
+            dn = eqn.params.get("dimension_numbers")
+            ss = eqn.params.get("slice_sizes")
+            ivs0 = [v for v in eqn.invars if _is_var(v)]
+            in_shape = tuple(getattr(ivs0[0].aval, "shape", ()))
+            out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+            if dn is not None and ss is not None and \
+                    len(in_dims[0]) == len(in_shape) == len(ss):
+                ld = in_dims[0]
+                dropped = set(getattr(dn, "collapsed_slice_dims",
+                                      ()) or ()) | \
+                    set(getattr(dn, "operand_batching_dims", ()) or ())
+                offset = tuple(getattr(dn, "offset_dims", ()) or ())
+                kept = [d for d in range(len(ld)) if d not in dropped]
+                if len(offset) == len(kept):
+                    indexed = set(getattr(dn, "start_index_map",
+                                          ()) or ())
+                    # offset output dims map in order onto the
+                    # non-collapsed operand dims: a dim addressed by
+                    # the gather indices (start_index_map) or sliced
+                    # below full size loses its factor — rows land at
+                    # DYNAMIC positions, no static split survives (the
+                    # scatter rule's read side); whole untouched dims
+                    # thread. Batch dims (from the indices operand)
+                    # stay at 1 — conservative, the safe direction.
+                    dims = [1] * len(out_shape)
+                    for pos, d in zip(offset, kept):
+                        if 0 <= pos < len(dims) and d not in indexed \
+                                and int(ss[d]) == int(in_shape[d]):
+                            dims[pos] = int(ld[d])
+                    dims = tuple(dims)
+                    total = 1
+                    for d in dims:
+                        total *= int(d)
+                    cap = max(in_counts) if in_counts else 1
+                    if total > cap:   # no axis identity: never claim
+                        return cap, None
+                    return max(total, 1), dims
         if name in _SCATTER_PRIMS and in_dims and in_dims[0] is not None:
             dn = eqn.params.get("dimension_numbers")
             if dn is not None:

@@ -81,6 +81,10 @@ class AnalysisContext:
     replicated_bytes_threshold: int = 1 << 20
     # regexes for by-design mid-program reshards (MoE all_to_all dispatch)
     allowed_resharding: tuple = ()
+    # COLL-SERIALIZED bar: a critical-path collective must have at
+    # least this fraction of its wire time coverable by
+    # concurrently-schedulable compute (analysis/schedule.py)
+    schedule_hide_frac: float = 0.5
     # free-form knobs for user analyzers
     extra: dict = field(default_factory=dict)
 

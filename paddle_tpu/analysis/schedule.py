@@ -1,0 +1,544 @@
+"""Schedule Doctor — overlap-aware critical-path analysis of one
+lowered program, and the COLL-SERIALIZED lint.
+
+`cost_model.roofline_step_time` prices a step as max(compute, HBM,
+wire): the analytic floor that assumes XLA fully overlaps the compute
+stream with the collective stream.  The LOWERED program often cannot
+overlap them — a tensor-parallel psum that consumes the block's only
+matmul has nothing to hide behind, and the step runs at the SERIAL sum
+instead (the gap T3 closes by decomposing collectives into per-chunk
+ops interleaved with the matmuls that produce them, arxiv 2401.16677).
+This pass makes that gap measurable before a chip sees the program
+(compiler-level schedule verification after TPU-MLIR, arxiv
+2210.15016):
+
+1. build the operand/result dependency DAG over the jaxpr, recursing
+   into scan/while/pjit sub-jaxprs the way `memory.py`'s liveness walk
+   does (a scan body's nodes are priced once and scaled by the trip
+   count; source lines survive, so a scan-body collective attributes
+   to the line that wrote it);
+2. price every node with the existing legs — `cost_model.eqn_flops`
+   for compute, operand+result bytes for the HBM stream (each compute
+   node costs max(flops leg, HBM leg): its own tiny roofline), and
+   `collective_wire_bytes`/`collective_wire_split` for collectives
+   (group sizes from the analysis context's mesh axes; DCN-spanning
+   hops priced at DCN bandwidth);
+3. run a two-resource list schedule — ONE compute stream, ONE
+   collective stream, critical-path-rank priority — which yields the
+   critical path with per-op attribution, an overlap-aware predicted
+   step time bracketed by construction
+   (max(compute, wire) <= overlap <= compute + wire), and the fraction
+   of wire time the schedule actually hides.
+
+The COLL-SERIALIZED rule fires (ERROR) when a collective sits on the
+critical path and the compute that COULD run concurrently (neither its
+ancestor nor its descendant) cannot hide at least
+`ctx.schedule_hide_frac` of its wire time — the exact program shape
+the ROADMAP's decomposed-collective work must fix, caught statically.
+
+`ScheduleEstimate.overlap_frac` feeds `autotune._price`
+(`cost_model.roofline_step_time_overlap`), and the serial/overlap pair
+feeds the flight recorder's predicted-tick band so the ROOFLINE-DRIFT
+ledger can tell a mispriced leg from a serialized schedule.
+"""
+import heapq
+from dataclasses import dataclass, field
+
+from .findings import Finding, Severity
+# ONE set of jaxpr-walk helpers, shared with the memory pass (the two
+# passes must agree on what a var/sub-jaxpr/byte/op-label is — a fix
+# to either walk reaches both)
+from .memory import _aval_bytes, _is_var, _sub_jaxprs
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["ScheduleNode", "ScheduleEstimate", "estimate_schedule",
+           "ScheduleAnalyzer", "COLLECTIVE_PRIMS"]
+
+# jaxpr primitives that lower to a collective on the wire (the jaxpr
+# vocabulary of analyzers.COLLECTIVE_OPS; cost_model._COLLECTIVE_ALIASES
+# maps them onto the ring formulas)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "psum_scatter",
+    "pbroadcast", "all_gather", "all_gather_invariant", "all_to_all",
+    "reduce_scatter", "pgather"})
+
+# sub-jaxpr-carrying primitives whose body repeats: scan multiplies its
+# body cost by the trip count; while bodies price ONE iteration (the
+# trip count is dynamic — decode loops carry their own k elsewhere)
+_ATTRIBUTION_MIN_S = 1e-12
+
+
+def _eqn_source(eqn):
+    """`prim @ file.py:line` label — the per-op attribution unit (same
+    rendering as memory.py's peak attribution, so the two passes agree
+    on what an op is called; memory's variant appends an eqn index the
+    flattened DAG doesn't have, so the fallback here is the bare
+    primitive name)."""
+    prim = eqn.primitive.name
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            import os
+            return (f"{prim} @ {os.path.basename(frame.file_name)}:"
+                    f"{frame.start_line}")
+    except Exception:
+        pass
+    return prim
+
+
+@dataclass
+class ScheduleNode:
+    """One schedulable op of the flattened program DAG."""
+    idx: int
+    op: str                      # primitive name
+    source: str                  # "psum @ gpt.py:123"
+    stream: str                  # "compute" | "collective"
+    cost_s: float                # duration on its stream (trip-scaled)
+    flops: int = 0
+    hbm_bytes: int = 0
+    wire_bytes: int = 0          # ici + dcn (collectives only)
+    dcn_bytes: int = 0           # the DCN share of wire_bytes
+    preds: set = field(default_factory=set)
+    start_s: float = 0.0
+    end_s: float = 0.0
+    critical: bool = False
+
+    def to_dict(self):
+        d = {"op": self.op, "source": self.source, "stream": self.stream,
+             "cost_us": round(self.cost_s * 1e6, 3)}
+        if self.wire_bytes:
+            d["wire_bytes"] = self.wire_bytes
+        return d
+
+
+@dataclass
+class ScheduleEstimate:
+    """Two-stream schedule of one lowered program.
+
+    The three step times bracket by construction:
+      ``ideal_step_s``   = max(compute_s, wire_s) — streams fully
+                           overlapped, today's roofline max();
+      ``overlap_step_s`` = the list schedule's makespan under the real
+                           dependencies (clamped into the bracket);
+      ``serial_step_s``  = compute_s + wire_s — nothing overlaps.
+    ``overlap_frac`` is the fraction of wire time the schedule hides
+    under compute (1.0 when there is no wire): the knob
+    `cost_model.roofline_step_time_overlap` consumes."""
+    n_nodes: int = 0
+    n_collectives: int = 0
+    flops: int = 0
+    hbm_bytes: int = 0
+    wire_ici_bytes: int = 0
+    wire_dcn_bytes: int = 0
+    compute_s: float = 0.0       # compute-stream busy time
+    wire_s: float = 0.0          # collective-stream busy time
+    overlap_step_s: float = 0.0
+    chip: str = "v5e"
+    critical_path: list = field(default_factory=list)   # ScheduleNodes
+    serialized: list = field(default_factory=list)
+    # [(node, hideable_s, hidden_frac)] — COLL-SERIALIZED evidence
+
+    @property
+    def ideal_step_s(self):
+        return max(self.compute_s, self.wire_s)
+
+    @property
+    def serial_step_s(self):
+        return self.compute_s + self.wire_s
+
+    @property
+    def hidden_wire_s(self):
+        return self.serial_step_s - self.overlap_step_s
+
+    @property
+    def exposed_wire_s(self):
+        return self.wire_s - self.hidden_wire_s
+
+    @property
+    def overlap_frac(self):
+        """Fraction of wire time the schedule hides under compute —
+        1.0 with no wire at all (nothing to hide: the overlap-aware
+        price collapses to the roofline max)."""
+        if self.wire_s <= 0:
+            return 1.0
+        return max(0.0, min(1.0, self.hidden_wire_s / self.wire_s))
+
+    def to_dict(self):
+        return {"n_nodes": self.n_nodes,
+                "n_collectives": self.n_collectives,
+                "flops": self.flops, "hbm_bytes": self.hbm_bytes,
+                "wire_ici_bytes": self.wire_ici_bytes,
+                "wire_dcn_bytes": self.wire_dcn_bytes,
+                "compute_us": round(self.compute_s * 1e6, 3),
+                "wire_us": round(self.wire_s * 1e6, 3),
+                "ideal_step_us": round(self.ideal_step_s * 1e6, 3),
+                "overlap_step_us": round(self.overlap_step_s * 1e6, 3),
+                "serial_step_us": round(self.serial_step_s * 1e6, 3),
+                "overlap_frac": round(self.overlap_frac, 4),
+                "n_serialized_collectives": len(self.serialized),
+                "critical_path": [n.to_dict()
+                                  for n in self.critical_path]}
+
+    def __str__(self):
+        lines = [f"step: overlap {self.overlap_step_s * 1e6:.1f} us "
+                 f"(roofline max {self.ideal_step_s * 1e6:.1f}, serial "
+                 f"{self.serial_step_s * 1e6:.1f}) — "
+                 f"{self.overlap_frac:.0%} of "
+                 f"{self.wire_s * 1e6:.1f} us wire hidden, "
+                 f"{self.n_collectives} collective(s) / "
+                 f"{self.n_nodes} node(s)"]
+        for n in self.critical_path[:16]:
+            mark = "  << SERIALIZED" if any(
+                s[0] is n for s in self.serialized) else ""
+            lines.append(f"  {n.cost_s * 1e6:>10.2f} us "
+                         f"{n.stream:<10} {n.source}{mark}")
+        return "\n".join(lines)
+
+
+def _collective_axes(eqn):
+    """Named mesh axes of one collective eqn ('axes' on psum & friends,
+    'axis_name' on ppermute/all_gather/all_to_all). Positional axes
+    (ints) carry no name and are skipped — their size is baked into
+    the aval and the group can't be recovered without the trace."""
+    axes = eqn.params.get("axes", None)
+    if axes is None:
+        axes = eqn.params.get("axis_name", None)
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _collective_group(eqn, mesh_axes):
+    """Participant count of one collective: the product of its named
+    axes' sizes (ctx.mesh_axes), or the explicit axis_index_groups row
+    length when present. 1 = degenerate (XLA folds it to a copy)."""
+    groups = eqn.params.get("axis_index_groups")
+    if groups:
+        try:
+            return max(len(groups[0]), 1)
+        except (TypeError, IndexError):
+            pass
+    n = 1
+    for a in _collective_axes(eqn):
+        n *= int((mesh_axes or {}).get(a, 1))
+    return n
+
+
+def _walk(jx, nodes, entry, scale, ctx):
+    """Flatten one (sub-)jaxpr into `nodes`. `entry` is the pred-id set
+    every node with a free (invar/const) operand inherits — for a
+    sub-jaxpr, the producers of the carrying eqn's operands, so the
+    region hammocks between its operands and its consumers. Returns the
+    producer-id sets of the jaxpr's outvars (the region's sinks)."""
+    chip, mxu_eff, mesh_axes, hosts = (ctx["chip"], ctx["mxu_eff"],
+                                       ctx["mesh_axes"], ctx["hosts"])
+    from ..cost_model import (collective_wire_split, eqn_flops)
+    producers = {}
+
+    def prods(v):
+        return producers.get(v, entry)
+
+    for eqn in jx.eqns:
+        preds = set()
+        for v in eqn.invars:
+            if _is_var(v):
+                preds |= prods(v)
+        name = eqn.primitive.name
+        subs = _sub_jaxprs(eqn)
+        if subs and name not in COLLECTIVE_PRIMS:
+            trip = int(eqn.params.get("length", 1)) if name == "scan" \
+                else 1
+            if name == "cond" and len(subs) > 1:
+                # mutually exclusive branches: exactly ONE executes, so
+                # price the most expensive (the eqn_flops rule) — a
+                # summed walk would inflate compute_s AND overcount the
+                # untaken branch as COLL-SERIALIZED-hideable compute
+                def branch_cost(sj):
+                    tmp = []
+                    _walk(sj, tmp, frozenset(), 1, ctx)
+                    return sum(n.cost_s for n in tmp)
+                subs = [max(subs, key=branch_cost)]
+            sinks = set()
+            for sj in subs:
+                sinks |= _walk(sj, nodes, frozenset(preds),
+                               scale * trip, ctx)
+            out = frozenset(sinks or preds)
+            for v in eqn.outvars:
+                producers[v] = out
+            continue
+        out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        in_bytes = sum(_aval_bytes(v.aval) for v in eqn.invars
+                       if _is_var(v))
+        idx = len(nodes)
+        if name in COLLECTIVE_PRIMS:
+            group = _collective_group(eqn, mesh_axes)
+            payload = max(in_bytes, out_bytes)
+            h = max((hosts.get(a, 1) for a in _collective_axes(eqn)),
+                    default=1)
+            split = collective_wire_split(name, payload, group,
+                                          host_count=h)
+            cost = (split["ici"] / chip.ici_bw
+                    + split["dcn"] / chip.dcn_bw) * scale
+            node = ScheduleNode(
+                idx=idx, op=name, source=_eqn_source(eqn),
+                # a degenerate group's collective folds to a copy: it
+                # has no wire leg and must not occupy (or ever flag)
+                # the collective stream
+                stream="collective" if cost > 0 else "compute",
+                cost_s=cost,
+                hbm_bytes=(in_bytes + out_bytes) * scale,
+                wire_bytes=(split["ici"] + split["dcn"]) * scale,
+                dcn_bytes=split["dcn"] * scale,
+                preds=set(preds))
+        else:
+            flops = eqn_flops(eqn)
+            cost = max(flops / (chip.peak_flops * mxu_eff),
+                       (in_bytes + out_bytes) / chip.hbm_bw) * scale
+            node = ScheduleNode(
+                idx=idx, op=name, source=_eqn_source(eqn),
+                stream="compute", cost_s=cost, flops=flops * scale,
+                hbm_bytes=(in_bytes + out_bytes) * scale,
+                preds=set(preds))
+        nodes.append(node)
+        me = frozenset((idx,))
+        for v in eqn.outvars:
+            producers[v] = me
+    sinks = set()
+    for v in jx.outvars:
+        if _is_var(v):
+            sinks |= prods(v)
+    return sinks
+
+
+def _list_schedule(nodes):
+    """Two-resource list schedule: each node starts at
+    max(stream free, preds' ends); ready nodes are picked by
+    downstream-WIRE release first (a compute node whose chain feeds a
+    collective goes ahead of an equal-stream chain that doesn't —
+    releasing wire early is free for the compute stream's busy time
+    and lets the collective stream run concurrently, exactly what a
+    latency-hiding scheduler does), then critical-path rank (longest
+    downward path). Deterministic (wire-release, rank, then index).
+    Returns the makespan."""
+    succs = [[] for _ in nodes]
+    n_preds = [0] * len(nodes)
+    for n in nodes:
+        n_preds[n.idx] = len(n.preds)
+        for p in n.preds:
+            succs[p].append(n.idx)
+    # downward ranks via reverse topological order (nodes are appended
+    # in a valid topological order by construction)
+    rank = [0.0] * len(nodes)
+    wire_down = [0.0] * len(nodes)
+    for n in reversed(nodes):
+        down = max((rank[s] for s in succs[n.idx]), default=0.0)
+        rank[n.idx] = n.cost_s + down
+        own_wire = n.cost_s if n.stream == "collective" else 0.0
+        wire_down[n.idx] = own_wire + max(
+            (wire_down[s] for s in succs[n.idx]), default=0.0)
+
+    def key(i):
+        return (-wire_down[i], -rank[i], i)
+
+    free = {"compute": 0.0, "collective": 0.0}
+    ready = [key(n.idx) for n in nodes if not n.preds]
+    heapq.heapify(ready)
+    remaining = [n_preds[i] for i in range(len(nodes))]
+    makespan = 0.0
+    while ready:
+        i = heapq.heappop(ready)[2]
+        n = nodes[i]
+        earliest = max((nodes[p].end_s for p in n.preds), default=0.0)
+        n.start_s = max(free[n.stream], earliest)
+        n.end_s = n.start_s + n.cost_s
+        free[n.stream] = n.end_s
+        makespan = max(makespan, n.end_s)
+        for s in succs[i]:
+            remaining[s] -= 1
+            if remaining[s] == 0:
+                heapq.heappush(ready, key(s))
+    return makespan
+
+
+def _critical_path(nodes):
+    """Walk back from the last-finishing node: the chain of nodes whose
+    end time gates each successor's start (preferring a dependency
+    pred; falling back to the same-stream neighbor that the stream
+    waited on). Marks and returns the path in program order."""
+    if not nodes:
+        return []
+    by_stream_end = {}
+    for n in nodes:
+        by_stream_end.setdefault(n.stream, []).append(n)
+    for ns in by_stream_end.values():
+        ns.sort(key=lambda n: n.end_s)
+    last = max(nodes, key=lambda n: (n.end_s, n.idx))
+    path = []
+    cur = last
+    eps = 1e-15
+    while cur is not None:
+        cur.critical = True
+        path.append(cur)
+        if cur.start_s <= eps:
+            break
+        nxt = None
+        for p in cur.preds:
+            if abs(nodes[p].end_s - cur.start_s) <= eps:
+                nxt = nodes[p]
+                break
+        if nxt is None:
+            # the stream (not a dependency) gated this start: the
+            # previous node on the same stream ended exactly here
+            import bisect
+            ns = by_stream_end[cur.stream]
+            k = bisect.bisect_right([n.end_s for n in ns],
+                                    cur.start_s + eps) - 1
+            while k >= 0 and (ns[k] is cur or ns[k].end_s > cur.start_s
+                              + eps):
+                k -= 1
+            nxt = ns[k] if k >= 0 and \
+                abs(ns[k].end_s - cur.start_s) <= eps else None
+        if nxt is None:
+            # a pred ended earlier but is still the binding constraint
+            # (float drift): take the latest-ending pred
+            nxt = max((nodes[p] for p in cur.preds),
+                      key=lambda n: n.end_s, default=None)
+        cur = nxt
+    path.reverse()
+    return path
+
+
+def _ancestor_masks(nodes):
+    """Per-node ancestor sets as int bitmasks (node idx -> bit)."""
+    masks = [0] * len(nodes)
+    for n in nodes:                      # topological order
+        m = 0
+        for p in n.preds:
+            m |= masks[p] | (1 << p)
+        masks[n.idx] = m
+    return masks
+
+
+def estimate_schedule(program, mesh_axes=None, axis_host_counts=None,
+                      chip="v5e", mxu_efficiency=0.65, hide_frac=0.5,
+                      top_k=24):
+    """Overlap-aware schedule estimate of one lowered program (a
+    `LoweredProgram` or anything with `.jaxpr`, or a closed jaxpr).
+
+    `mesh_axes` sizes the collective groups ({axis: size}; the pass
+    manager defaults it to the live mesh), `axis_host_counts` marks
+    DCN-spanning axes ({axis: hosts}). `chip` defaults to the fixed
+    v5e spec so committed manifests are deterministic. `hide_frac` is
+    the COLL-SERIALIZED bar: a critical-path collective whose
+    concurrently-schedulable compute covers less than this fraction of
+    its wire time is serialized."""
+    from ..cost_model import chip_spec
+    jx = getattr(program, "jaxpr", program)
+    jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
+    chip = chip if hasattr(chip, "peak_flops") else chip_spec(chip)
+    ctx = {"chip": chip, "mxu_eff": float(mxu_efficiency),
+           "mesh_axes": dict(mesh_axes or {}),
+           "hosts": dict(axis_host_counts or {})}
+    nodes = []
+    _walk(jx, nodes, frozenset(), 1, ctx)
+    est = ScheduleEstimate(n_nodes=len(nodes), chip=chip.name)
+    if not nodes:
+        return est
+    for n in nodes:
+        if n.stream == "collective":
+            est.n_collectives += 1
+            est.wire_s += n.cost_s
+        else:
+            est.compute_s += n.cost_s
+        est.flops += n.flops
+        est.hbm_bytes += n.hbm_bytes
+        est.wire_ici_bytes += n.wire_bytes - n.dcn_bytes
+        est.wire_dcn_bytes += n.dcn_bytes
+    makespan = _list_schedule(nodes)
+    # the bracket holds for any work-conserving schedule; clamping
+    # makes it definitional, so float drift can never leak out of
+    # [max, sum] into the manifests or the autotuner
+    est.overlap_step_s = min(max(makespan, est.ideal_step_s),
+                             est.serial_step_s)
+    path = _critical_path(nodes)
+    est.critical_path = [n for n in path
+                         if n.cost_s >= _ATTRIBUTION_MIN_S]
+    est.critical_path.sort(key=lambda n: -n.cost_s)
+    est.critical_path = est.critical_path[:top_k]
+    # COLL-SERIALIZED evidence: for each critical-path collective, the
+    # compute neither upstream nor downstream of it — the work a
+    # latency-hiding schedule COULD run during the wire transfer
+    crit_colls = [n for n in path
+                  if n.stream == "collective" and n.wire_bytes > 0]
+    if crit_colls:
+        masks = _ancestor_masks(nodes)
+        for c in crit_colls:
+            cbit = 1 << c.idx
+            hideable = sum(
+                n.cost_s for n in nodes
+                if n.stream == "compute"
+                and not (masks[c.idx] >> n.idx) & 1      # not ancestor
+                and not masks[n.idx] & cbit)             # not descendant
+            frac = hideable / c.cost_s if c.cost_s > 0 else 1.0
+            if frac < hide_frac:
+                est.serialized.append((c, hideable, frac))
+    return est
+
+
+@register_analyzer
+class ScheduleAnalyzer(Analyzer):
+    """Overlap-aware schedule pass + the COLL-SERIALIZED rule.
+
+    Findings:
+      COLL-SERIALIZED  ERROR  a collective sits on the two-stream
+                              schedule's critical path with less
+                              concurrently-schedulable compute than
+                              `ctx.schedule_hide_frac` of its wire
+                              time — the lowered program SERIALIZES
+                              the wire behind the MXU, so the real
+                              step runs at the serial sum while every
+                              roofline consumer (autotuner horizon,
+                              capacity pricing) still believes the
+                              max().
+
+    Metrics feed schedule_manifests/<config>.json (overlap/serial/ideal
+    step time, overlap fraction, critical-path attribution) for the
+    five BASELINE configs and the fused gpt_train_multi capture; the
+    pricing chip is pinned to v5e like the tuning manifests, so a CPU
+    and a TPU checkout agree byte-for-byte."""
+    name = "schedule"
+
+    def run(self, program, ctx):
+        if getattr(program, "jaxpr", None) is None:
+            self.metrics = {"available": False}
+            return []
+        est = estimate_schedule(
+            program, mesh_axes=ctx.mesh_axes,
+            axis_host_counts=ctx.extra.get("axis_host_counts"),
+            hide_frac=ctx.schedule_hide_frac,
+            chip=ctx.extra.get("schedule_chip", "v5e"))
+        self.metrics = {"available": True, **est.to_dict()}
+        findings = []
+        for node, hideable, frac in est.serialized:
+            findings.append(Finding(
+                "COLL-SERIALIZED", Severity.ERROR,
+                f"{node.source} ({node.wire_bytes} wire bytes, "
+                f"{node.cost_s * 1e6:.2f} us) sits on the critical "
+                f"path with only {hideable * 1e6:.2f} us of "
+                f"concurrently-schedulable compute "
+                f"({frac:.0%} of its wire time, bar "
+                f"{ctx.schedule_hide_frac:.0%}) — the schedule "
+                "serializes the wire behind the MXU and the step runs "
+                "toward the serial sum "
+                f"({est.serial_step_s * 1e6:.1f} us) instead of the "
+                f"roofline max ({est.ideal_step_s * 1e6:.1f} us)",
+                op=node.source,
+                suggested_fix="decompose the collective into per-chunk "
+                "ops interleaved with the matmuls that produce them "
+                "(shard_map + ppermute ring), or reorder independent "
+                "compute next to it so the latency-hiding scheduler "
+                "has something to overlap"))
+        return findings

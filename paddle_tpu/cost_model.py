@@ -31,10 +31,11 @@ import numpy as np
 __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "axis_host_count", "ChipSpec", "chip_spec", "CHIP_SPECS",
            "eqn_flops", "jaxpr_flops", "RooflineTime",
-           "roofline_step_time", "decode_tick_roofline_s",
-           "ragged_tick_roofline_s", "ragged_chunk_tokens",
-           "decode_horizon", "train_horizon", "measured_host_sync_s",
-           "prefill_ttft_s", "kv_restore_s"]
+           "roofline_step_time", "OverlapRooflineTime",
+           "roofline_step_time_overlap", "decode_tick_roofline_s",
+           "ragged_tick_legs", "ragged_tick_roofline_s",
+           "ragged_chunk_tokens", "decode_horizon", "train_horizon",
+           "measured_host_sync_s", "prefill_ttft_s", "kv_restore_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -211,6 +212,65 @@ def roofline_step_time(flops, hbm_bytes, ici_bytes=0, dcn_bytes=0,
     return RooflineTime(compute_s=compute, hbm_s=hbm, wire_s=wire)
 
 
+@dataclass
+class OverlapRooflineTime:
+    """Overlap-AWARE step-time breakdown: the chip streams (compute,
+    HBM) still overlap into max(compute, hbm), but only
+    ``overlap_frac`` of the wire time hides under them — the rest is
+    EXPOSED and adds serially (the two-stream schedule model of
+    analysis/schedule.py, after T3's compute/collective split, arxiv
+    2401.16677).  ``overlap_frac=1`` collapses to `RooflineTime`'s
+    max(); ``overlap_frac=0`` is the fully serialized
+    max(compute, hbm) + wire.  step_s is bracketed by construction:
+    max(compute, hbm, wire) <= step_s <= max(compute, hbm) + wire."""
+    compute_s: float
+    hbm_s: float
+    wire_s: float
+    overlap_frac: float = 1.0
+
+    @property
+    def chip_s(self):
+        return max(self.compute_s, self.hbm_s)
+
+    @property
+    def exposed_wire_s(self):
+        return (1.0 - self.overlap_frac) * self.wire_s
+
+    @property
+    def step_s(self):
+        hidden = self.overlap_frac * self.wire_s
+        return max(self.chip_s, hidden) + self.exposed_wire_s
+
+    @property
+    def bound(self):
+        floor = max((self.compute_s, "compute"), (self.hbm_s, "hbm"),
+                    (self.wire_s, "wire"))
+        if self.step_s > floor[0] * (1 + 1e-12) and \
+                self.exposed_wire_s > 0:
+            return "wire-serialized"
+        return floor[1]
+
+
+def roofline_step_time_overlap(flops, hbm_bytes, ici_bytes=0,
+                               dcn_bytes=0, overlap_frac=1.0,
+                               chip=None, mxu_efficiency=0.65):
+    """Overlap-aware analytic step time: the same three legs as
+    `roofline_step_time`, with the wire leg only ``overlap_frac``
+    hidden behind the chip streams.  `analysis/schedule.py`'s
+    two-stream list schedule supplies the fraction from the real
+    dependency DAG (`ScheduleEstimate.overlap_frac`); with no
+    collectives (or frac 1.0) this is EXACTLY `roofline_step_time` —
+    which is why re-pricing single-device candidates through it leaves
+    the autotuner's ranking untouched."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    frac = min(max(float(overlap_frac), 0.0), 1.0)
+    return OverlapRooflineTime(
+        compute_s=flops / (chip.peak_flops * mxu_efficiency),
+        hbm_s=hbm_bytes / chip.hbm_bw,
+        wire_s=ici_bytes / chip.ici_bw + dcn_bytes / chip.dcn_bw,
+        overlap_frac=frac)
+
+
 # ------------------------------------------------------- decode horizon
 
 # Fallback python-dispatch + device->host-fetch cost of one decode sync
@@ -256,6 +316,23 @@ def decode_tick_roofline_s(step_hbm_bytes, chip=None):
     return step_hbm_bytes / chip.hbm_bw
 
 
+def ragged_tick_legs(step_hbm_bytes, new_tokens=0, flops_per_token=0.0,
+                     chip=None, mxu_efficiency=0.65):
+    """(hbm_s, compute_s) legs of one mixed tick — the pair behind
+    `ragged_tick_roofline_s`'s max().  Exposed so the flight-recorder
+    pricing can record BOTH the overlapped prediction (max of the
+    legs) and the serial one (their sum): the ROOFLINE-DRIFT ledger
+    compares the measured tick against the band, telling a mispriced
+    leg (measured outside even the serial sum) from a serialized
+    schedule (measured at the sum while priced at the max)."""
+    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
+    hbm = step_hbm_bytes / chip.hbm_bw
+    compute = (max(float(new_tokens), 0.0) *
+               max(float(flops_per_token), 0.0) /
+               (chip.peak_flops * mxu_efficiency))
+    return hbm, compute
+
+
 def ragged_tick_roofline_s(step_hbm_bytes, new_tokens=0,
                            flops_per_token=0.0, chip=None,
                            mxu_efficiency=0.65):
@@ -270,11 +347,9 @@ def ragged_tick_roofline_s(step_hbm_bytes, new_tokens=0,
     compute) — which is exactly why chunking works: while the token
     total's compute fits under the HBM leg, prompt tokens stream into
     the pool at ZERO marginal tick time."""
-    chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
-    hbm = step_hbm_bytes / chip.hbm_bw
-    compute = (max(float(new_tokens), 0.0) *
-               max(float(flops_per_token), 0.0) /
-               (chip.peak_flops * mxu_efficiency))
+    hbm, compute = ragged_tick_legs(step_hbm_bytes, new_tokens,
+                                    flops_per_token, chip=chip,
+                                    mxu_efficiency=mxu_efficiency)
     return max(hbm, compute)
 
 

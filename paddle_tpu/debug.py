@@ -13,8 +13,8 @@ from .framework.core import Tensor, apply_op
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
-           "input_pipeline_stats", "memory_report", "autotune",
-           "serving_stats", "serving_report"]
+           "input_pipeline_stats", "memory_report", "schedule_report",
+           "autotune", "serving_stats", "serving_report"]
 
 
 def serving_stats():
@@ -176,6 +176,58 @@ def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
                                 arg_infos=program.arg_infos, top_k=top_k)
     if print_report:
         print(f"== memory report: {program.name} ==")
+        print(est)
+    return est
+
+
+def schedule_report(target, *example_inputs, batch=None, lr=0.0,
+                    hide_frac=0.5, chip="v5e", print_report=True):
+    """Overlap-aware schedule report: the two-stream (compute vs
+    collective) critical path of the lowered program, before a chip
+    sees it.
+
+    `target` may be a `distributed.Trainer` (pass the training
+    `batch=`; the report covers the SAME specialized step `step()`
+    dispatches — real shardings, real collectives), an `nn.Layer`
+    (pass example inputs), or any jittable callable. Returns the
+    `analysis.ScheduleEstimate`: the bracketed step time (roofline
+    max <= overlap-aware <= serial sum), the fraction of collective
+    wire time the schedule hides under compute, the critical path
+    with per-op source attribution, and the COLL-SERIALIZED evidence
+    — collectives the lowered program cannot overlap with anything
+    (`hide_frac` is the bar). The same estimate feeds
+    `debug.autotune`'s step pricing and the schedule manifests the
+    `lint_schedule` gate pins."""
+    from .analysis import estimate_schedule
+    from .analysis.lowering import lower_callable, lower_layer
+    from .nn.layer_base import Layer
+
+    if hasattr(target, "analysis_program"):
+        if hasattr(target, "step"):                # Trainer-shaped
+            if batch is None:
+                raise ValueError(
+                    "schedule_report(trainer) needs batch=...")
+            program = target.analysis_program(batch, lr=lr)
+        else:            # decoder-shaped (PagedGPTDecoder)
+            program = target.analysis_program()
+    elif isinstance(target, Layer):
+        args = [x._value if isinstance(x, Tensor) else x
+                for x in example_inputs]
+        program = lower_layer(target, *args)
+    else:
+        args = [x._value if isinstance(x, Tensor) else x
+                for x in example_inputs]
+        program = lower_callable(target, *args)
+    mesh_axes = None
+    try:
+        from .distributed import mesh_axis_sizes
+        mesh_axes = mesh_axis_sizes()
+    except Exception:
+        pass
+    est = estimate_schedule(program, mesh_axes=mesh_axes,
+                            hide_frac=hide_frac, chip=chip)
+    if print_report:
+        print(f"== schedule report: {program.name} ==")
         print(est)
     return est
 

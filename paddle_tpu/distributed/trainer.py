@@ -243,23 +243,32 @@ class Trainer:
         # `if self.recorder is not None` branch.
         self.recorder = None
         self._rec_predicted_step_s = None
+        self._rec_predicted_serial_s = None
         self._rec_last_t = None
         _LIVE_TRAINERS.add(self)
 
-    def attach_recorder(self, recorder, predicted_step_s=None):
+    def attach_recorder(self, recorder, predicted_step_s=None,
+                        predicted_serial_step_s=None):
         """Attach a `serving.trace.FlightRecorder` (or True for a
         default one): every `step_multi` horizon records a "train"
         tick — N steps, measured dispatch-to-dispatch wall seconds,
         and (when `predicted_step_s` is given, normally
-        `cost_model.roofline_step_time(...).step_s`) the roofline-
-        predicted horizon cost, feeding the same drift ledger the
-        serving engines use (`ROOFLINE-DRIFT` /
-        `debug.serving_report`). Returns the recorder."""
+        `cost_model.roofline_step_time(...).step_s` or the schedule
+        pass's overlap-aware `overlap_step_s`) the roofline-predicted
+        horizon cost, feeding the same drift ledger the serving
+        engines use (`ROOFLINE-DRIFT` / `debug.serving_report`).
+        `predicted_serial_step_s` (normally the schedule pass's
+        `serial_step_s` — the compute+wire sum with nothing
+        overlapped) stamps the serial band next to it, so an
+        over-drifting shape gets the serialized-vs-mispriced verdict
+        instead of a blanket "re-fit the legs". Returns the
+        recorder."""
         if recorder is True:
             from ..serving.trace import FlightRecorder
             recorder = FlightRecorder()
         self.recorder = recorder
         self._rec_predicted_step_s = predicted_step_s
+        self._rec_predicted_serial_s = predicted_serial_step_s
         self._rec_last_t = None
         if recorder is not None:
             recorder.meta.update(engine="Trainer",
@@ -580,9 +589,11 @@ class Trainer:
             measured = now - start
             self._rec_last_t = now
             pred = self._rec_predicted_step_s
+            serial = self._rec_predicted_serial_s
             self.recorder.tick(
                 "train", ("train", int(n)), measured, ts=start,
                 predicted_s=(pred * int(n)) if pred else None,
+                predicted_serial_s=(serial * int(n)) if serial else None,
                 drift=steady, k=int(n), decode_rows=0, prefill_rows=0)
         return losses
 

@@ -28,7 +28,7 @@ def clear_compiled_memos():
     n = 0
     for dec in list(_LIVE_DECODERS):
         for memo in (dec._multis, dec._raggeds, dec._packeds,
-                     dec._packed_prefills):
+                     dec._packed_prefills, dec._mount_multi):
             n += len(memo)
             memo.clear()
         for attr in ("_verify", "_probs", "_suffix_prefill", "_copy",
@@ -453,6 +453,7 @@ class PagedGPTDecoder:
         self._suffix_prefill = None   # jitted lazily (chunked prefill)
         self._copy = None     # jitted lazily (copy-on-write page copy)
         self._mount = None    # jitted lazily (host-tier page restore)
+        self._mount_multi = {}   # span length -> jitted batched restore
         # engines serving over this pool (weak): load_pool_state
         # refuses while any of them holds live refcounted pages —
         # swapping pool bytes under a live PrefixCache ledger would
@@ -1249,6 +1250,60 @@ class PagedGPTDecoder:
             return tuple(np.asarray(leaf[:, p]) for leaf in leaves)
 
         return {"k": grab(self.k_pages), "v": grab(self.v_pages)}
+
+    def fetch_page_payloads(self, pages):
+        """D2H copy of a WHOLE eviction wave in one stacked transfer
+        per pool leaf (`fetch_page_payload` batched): the pool leaf is
+        gathered at all `pages` on device ([L, n, ps, ...]) and fetched
+        once, then split host-side into the per-page payload dicts the
+        host tier stores. Per-page D2H paid one blocking round trip per
+        victim — a pressure wave of n evictions cost n syncs for bytes
+        the device could have streamed together."""
+        idx = jnp.asarray([int(p) for p in pages], jnp.int32)
+
+        def grab(pool):
+            leaves = pool if isinstance(pool, tuple) else (pool,)
+            return [np.asarray(leaf[:, idx]) for leaf in leaves]
+
+        k_stk, v_stk = grab(self.k_pages), grab(self.v_pages)
+        return [{"k": tuple(leaf[:, i] for leaf in k_stk),
+                 "v": tuple(leaf[:, i] for leaf in v_stk)}
+                for i in range(len(pages))]
+
+    def mount_page_payloads(self, pages, payloads):
+        """H2D restore of a WHOLE restored span in one donated jitted
+        scatter (`mount_page_payload` batched, jitted per span length):
+        every pool leaf takes its [L, n, ps, ...] stacked values at the
+        n page ids in one `.at[:, pids].set`. Like the single-page
+        mount, the dispatch does not block — jax's functional pool
+        threading orders every later horizon after the writes — but an
+        n-block restore now pays ONE dispatch instead of n."""
+        n = len(pages)
+        if n == 1:
+            return self.mount_page_payload(pages[0], payloads[0])
+        fn = self._mount_multi.get(n)
+        if fn is None:
+            def mnt(kp, vp, pids, kvals, vvals):
+                def setp(pool, vals):
+                    leaves = pool if isinstance(pool, tuple) else (pool,)
+                    out = [leaf.at[:, pids].set(v)
+                           for leaf, v in zip(leaves, vals)]
+                    return tuple(out) if isinstance(pool, tuple) \
+                        else out[0]
+                return setp(kp, kvals), setp(vp, vvals)
+            fn = self._mount_multi[n] = jax.jit(mnt,
+                                                donate_argnums=(0, 1))
+
+        def stack(part):
+            n_leaves = len(payloads[0][part])
+            return tuple(jnp.asarray(np.stack(
+                [np.asarray(p[part][i]) for p in payloads], axis=1))
+                for i in range(n_leaves))
+
+        self.k_pages, self.v_pages = fn(
+            self.k_pages, self.v_pages,
+            jnp.asarray([int(p) for p in pages], jnp.int32),
+            stack("k"), stack("v"))
 
     def mount_page_payload(self, page, payload):
         """H2D restore of a spilled page (`fetch_page_payload`'s

@@ -238,18 +238,22 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------- flight recorder
 
-    def _price_horizon(self, k, w, prefill_rows, decode_rows=0):
+    def _price_horizon(self, k, w, prefill_rows, decode_rows=0,
+                       serial=False):
         """Roofline-PREDICTED wall cost of one dispatched horizon: k
-        mixed ticks (`cost_model.ragged_tick_roofline_s` priced on the
+        mixed ticks (`cost_model.ragged_tick_legs` priced on the
         tick's TOTAL new-token count — the decode HBM leg plus the
         compute leg of every new token, chunk rows at w each plus one
         per decode row; the packed layout's dispatch unit) plus ONE
         host sync. The tick records pair this with the measured wall
         time; the drift accounting (`FlightRecorder.drift_report` /
-        ROOFLINE-DRIFT) is the predicted-vs-measured ledger. Called
-        only with tracing on."""
-        from ..cost_model import (measured_host_sync_s,
-                                  ragged_tick_roofline_s)
+        ROOFLINE-DRIFT) is the predicted-vs-measured ledger.
+        `serial=True` prices the SERIAL sum of the legs instead of
+        their overlapped max — the ticks stamp both, so the ledger's
+        verdict can tell a mispriced leg (measured outside even the
+        sum) from a serialized schedule (measured at the sum).
+        Called only with tracing on."""
+        from ..cost_model import measured_host_sync_s, ragged_tick_legs
         if self._trace_price is None:
             sched = self.scheduler
             fpt = (sched.flops_per_token if sched is not None
@@ -257,8 +261,9 @@ class ContinuousBatchingEngine:
             self._trace_price = (self.d.step_hbm_bytes(), fpt,
                                  measured_host_sync_s())
         hbm, fpt, sync = self._trace_price
-        tick = ragged_tick_roofline_s(hbm, w * prefill_rows + decode_rows,
-                                      fpt)
+        hbm_s, compute_s = ragged_tick_legs(
+            hbm, w * prefill_rows + decode_rows, fpt)
+        tick = (hbm_s + compute_s) if serial else max(hbm_s, compute_s)
         return k * tick + sync
 
     def _trace_pool_delta(self):
@@ -310,33 +315,47 @@ class ContinuousBatchingEngine:
             return self.scheduler.flops_per_token
         return 2.0 * self.d.cfg.num_params()
 
-    def _spill_page(self, key, page):
-        """Eviction hook (`PrefixCache.evict(spill=...)`): demote one
-        parked page to the host tier before its device page returns to
-        the free list. A page whose key already has a host twin (it
-        was itself restored, or a recompute refreshed the entry) needs
-        NO D2H — the host payload is still the exact write-time bytes,
-        only the device-twin backref clears."""
+    def _spill_wave(self, need, exclude=()):
+        """Reclaim at least `need` parked pages, demoting the wave to
+        the host tier with ONE stacked D2H (`PrefixCache.evict` walks
+        the victims while their bytes are still mapped; the transfer
+        itself is deferred until the walk ends — the freed pages are
+        not handed out, let alone written, before this method returns,
+        so the batched read still sees the exact write-time bytes).
+        A page whose key already has a host twin (it was itself
+        restored, or a recompute refreshed the entry) needs NO D2H —
+        the host payload is still valid, only the device-twin backref
+        clears. Returns the freed page ids."""
         tier = self.tier
-        if tier is None:
-            return
-        if key in tier:
-            tier.note_unmounted(key)
+        pending = []                     # (key, page): victims to D2H
+
+        def note(key, page):
+            if tier is None:
+                return
+            if key in tier:
+                tier.note_unmounted(key)
+                self.stats.host_tier_bytes = tier.bytes_used
+                return
+            if self.d.kv_page_bytes > tier.capacity_bytes:
+                # put() would refuse a payload this size anyway — skip
+                # the D2H entirely (the capacity-0 tier-off twin must
+                # not pay a device sync on every pool-pressure
+                # eviction for nothing)
+                return
+            pending.append((key, page))
+
+        freed = self.cache.evict(need, exclude=exclude, spill=note)
+        if pending:
+            payloads = self.d.fetch_page_payloads(
+                [p for _, p in pending])
+            for (key, page), payload in zip(pending, payloads):
+                if tier.put(key, payload):
+                    self.stats.tier_spills += 1
+                    if self.trace is not None:
+                        self.trace.record("spill", page=int(page),
+                                          bytes=tier.entry_bytes(key))
             self.stats.host_tier_bytes = tier.bytes_used
-            return
-        if self.d.kv_page_bytes > tier.capacity_bytes:
-            # put() would refuse a payload this size anyway — skip the
-            # blocking per-page D2H (the capacity-0 tier-off twin would
-            # otherwise pay a device sync on every pool-pressure
-            # eviction for nothing)
-            return
-        payload = self.d.fetch_page_payload(page)
-        if tier.put(key, payload):
-            self.stats.tier_spills += 1
-            if self.trace is not None:
-                self.trace.record("spill", page=int(page),
-                                  bytes=tier.entry_bytes(key))
-        self.stats.host_tier_bytes = tier.bytes_used
+        return freed
 
     def _tier_plan(self, keys, n_dev):
         """How far the chain continues onto the HOST tier past the
@@ -393,24 +412,28 @@ class ContinuousBatchingEngine:
         """Re-mount `len(pages)` host-resident blocks (keys[n_dev:],
         payloads pinned in `hold` at plan time — tier churn between
         plan and restore cannot invalidate them) into freshly
-        allocated device pages: H2D scatter per page (dispatched async
-        — jax's pool threading orders every later horizon after the
-        writes), cache insert under the held parent chain, device-twin
-        backref for the ledger audit. Returns [(page, inserted)] — a
+        allocated device pages: ONE batched H2D scatter for the whole
+        span (`mount_page_payloads`, dispatched async — jax's pool
+        threading orders every later horizon after the writes; a
+        per-page mount paid one dispatch per block), then cache insert
+        under the held parent chain and the device-twin backref for
+        the ledger audit. Returns [(page, inserted)] — a
         capacity-refused insert leaves that page (and the rest of the
         chain, publish-stop rule) private to the request: bytes still
         correct, just not shareable. The priced H2D is handed to the
         horizon pricing (`note_restore`) and, with tracing on,
         recorded as an ("h2d_restore",) tick whose
-        predicted-vs-measured feeds the drift ledger."""
+        predicted-vs-measured — now the price of the batched transfer
+        — feeds the drift ledger."""
         tier = self.tier
         tot_bytes = sum(nbytes for _, _, nbytes in hold[:len(pages)])
         t0 = time.perf_counter()
+        self.d.mount_page_payloads(
+            list(pages), [hold[i][1] for i in range(len(pages))])
         out = []
         stop = False
         for i, pid in enumerate(pages):
-            key, payload, _ = hold[i]
-            self.d.mount_page_payload(pid, payload)
+            key = hold[i][0]
             ok = False
             if not stop:
                 parent = keys[n_dev + i - 1] if (n_dev + i) else None
@@ -650,8 +673,9 @@ class ContinuousBatchingEngine:
         computed one; the admission head-of-line check therefore
         accounts in-flight restores) and those blocks join the hit
         span; a priced loser recomputes them as ordinary misses. Pool
-        eviction during either path spills through `_spill_page`, so
-        pressure demotes instead of destroys."""
+        eviction during either path spills through `_spill_wave` (one
+        stacked D2H per wave), so pressure demotes instead of
+        destroys."""
         admitted = []
         ps = self.d.page_size
         tok_bytes = self.d.kv_page_bytes // ps
@@ -710,8 +734,7 @@ class ContinuousBatchingEngine:
                     self._tier_recompute(keys, lo, n_recomp)
             self.cache.mount(keys[:len(hits)])
             if len(self._free) < need_new:
-                freed = self.cache.evict(need_new - len(self._free),
-                                         spill=self._spill_page)
+                freed = self._spill_wave(need_new - len(self._free))
                 self.stats.prefix_evictions += len(freed)
                 self._free.extend(freed)
             privates = [self._free.pop() for _ in range(need_new)]
@@ -940,6 +963,9 @@ class ContinuousBatchingEngine:
                     predicted_s=(self._price_horizon(
                         1, 1, 0, decode_rows=active)
                                  if clean else None),
+                    predicted_serial_s=(self._price_horizon(
+                        1, 1, 0, decode_rows=active, serial=True)
+                                 if clean else None),
                     drift=clean and warm, k=1, w=1,
                     decode_rows=active, prefill_rows=0, tokens=n,
                     tokens_dispatched=self.d.max_batch,
@@ -1135,6 +1161,9 @@ class ContinuousBatchingEngine:
                         "serve", ("decode", k, 1), ts=t0,
                         predicted_s=self._price_horizon(
                             k, 1, 0, decode_rows=len(disp)) + restore_s,
+                        predicted_serial_s=self._price_horizon(
+                            k, 1, 0, decode_rows=len(disp), serial=True)
+                        + restore_s,
                         k=k, w=1, decode_rows=len(disp), prefill_rows=0,
                         warm_shape=self._trace_shape_warm(("decode", k)))
                     if pending_ev is not None and \
@@ -1443,6 +1472,10 @@ class ContinuousBatchingEngine:
                             plan.k, plan.w, plan.prefill_rows,
                             decode_rows=len(live) - plan.prefill_rows)
                         + restore_s,
+                        predicted_serial_s=self._price_horizon(
+                            plan.k, plan.w, plan.prefill_rows,
+                            decode_rows=len(live) - plan.prefill_rows,
+                            serial=True) + restore_s,
                         k=plan.k, w=plan.w,
                         decode_rows=len(live) - plan.prefill_rows,
                         prefill_rows=plan.prefill_rows,
@@ -1669,17 +1702,20 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 self._retire(s)
         return len(active)
 
-    def _price_horizon(self, k, w, prefill_rows, decode_rows=0):
+    def _price_horizon(self, k, w, prefill_rows, decode_rows=0,
+                       serial=False):
         """One SPEC step's roofline price, overriding the plain decode
         tick: k device-resident draft ticks (draft pool HBM leg) + one
         (k+1)-position verify forward over the target (HBM vs window
         compute) + the step's TWO host syncs (draft fetch, verify
         fetch). Without this the per-tick loop would price a spec step
         as one target tick and the drift ledger would flag a correctly
-        performing engine ~k-fold 'underpriced'."""
+        performing engine ~k-fold 'underpriced'. `serial=True` sums
+        the verify legs instead of taking their max (the
+        serialized-vs-mispriced verdict band, like the base engine)."""
         from ..cost_model import (decode_tick_roofline_s,
                                   measured_host_sync_s,
-                                  ragged_tick_roofline_s)
+                                  ragged_tick_legs)
         if self._trace_price is None:
             self._trace_price = (self.d.step_hbm_bytes(),
                                  2.0 * self.d.cfg.num_params(),
@@ -1687,5 +1723,6 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             self._trace_draft_hbm = self.draft.step_hbm_bytes()
         hbm, fpt, sync = self._trace_price
         draft = self.k * decode_tick_roofline_s(self._trace_draft_hbm)
-        verify = ragged_tick_roofline_s(hbm, self.k + 1, fpt)
+        hbm_s, compute_s = ragged_tick_legs(hbm, self.k + 1, fpt)
+        verify = (hbm_s + compute_s) if serial else max(hbm_s, compute_s)
         return draft + verify + 2 * sync

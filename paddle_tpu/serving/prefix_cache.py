@@ -193,9 +193,12 @@ class PrefixCache:
         unreachable once an ancestor is gone).  Returns the freed page
         ids — the caller (engine) owns them again.  `spill(key, page)`,
         if given, runs for every victim BEFORE its page is unmapped —
-        the host-tier hook: the engine copies the page's bytes (and
-        scale planes) to `self.tier` there, so eviction demotes instead
-        of destroys."""
+        the host-tier hook.  The engine's hook (`_spill_wave.note`)
+        only RECORDS the victims here and performs ONE batched D2H
+        after evict() returns; that is safe because the engine defers
+        handing out (and a fortiori writing) the freed pages until the
+        batched fetch has completed — a caller that recycles freed
+        pages before reading their bytes would corrupt the spill."""
         ex = set(exclude)
         freed = []
         while len(freed) < n:
@@ -217,9 +220,11 @@ class PrefixCache:
                 raise RuntimeError(
                     f"evicting page {e.page} with refcount {e.refs}")
             if spill is not None:
-                # the page is still mapped here: the D2H copy reads
-                # bytes written by prefills device-ordered before any
-                # parked state (nobody writes a parked page)
+                # the page's bytes are still valid here AND until the
+                # caller reuses the freed ids: nobody writes a parked
+                # page, so the hook may read now or batch the read
+                # after the walk (the engine's _spill_wave does the
+                # latter) — as long as it reads before reuse
                 spill(k, e.page)
             stack.extend(e.children)
             self._lru.pop(k, None)

@@ -116,7 +116,10 @@ class FlightRecorder:
         `drift=False` keeps the record but skips the ledger — for
         windows the caller knows are polluted (a prefill landed inside
         the measured span), mirroring the engines' token-percentile
-        exclusions."""
+        exclusions.  A `predicted_serial_s` field on the record (the
+        SERIAL sum of the priced legs, vs `predicted_s`'s overlapped
+        max) rides into the window: `drift_report` uses the band to
+        tell a mispriced leg from a serialized schedule."""
         ev["measured_s"] = float(measured_s)
         ev.update(fields)
         pred = ev.get("predicted_s")
@@ -126,7 +129,9 @@ class FlightRecorder:
             if win is None:
                 win = self._drift[key] = collections.deque(
                     maxlen=self.drift_window)
-            win.append((float(pred), float(measured_s)))
+            serial = ev.get("predicted_serial_s")
+            win.append((float(pred), float(measured_s),
+                        float(serial) if serial else None))
         return ev
 
     def tick(self, track, shape, measured_s, predicted_s=None, ts=None,
@@ -142,26 +147,52 @@ class FlightRecorder:
 
     def drift_report(self, factor=None):
         """Rolling predicted-vs-measured accounting per dispatch
-        shape: [{shape, n, predicted_s, measured_s, ratio, drifting}].
+        shape: [{shape, n, predicted_s, measured_s, ratio, drifting
+        [, predicted_serial_s, serial_ratio, verdict]}].
         `ratio` is mean(measured)/mean(predicted) over the shape's
         window; `drifting` marks shapes whose ratio departs from 1 by
         more than `factor` (default: the recorder's drift_factor) in
         either direction — the `ROOFLINE-DRIFT` analyzer consumes
-        exactly this list via context extra["roofline_drift"]."""
+        exactly this list via context extra["roofline_drift"].
+
+        When the ticks also carried `predicted_serial_s` (the serial
+        sum of the priced legs — engines and the Trainer stamp it next
+        to the overlapped `predicted_s`), an over-drifting shape gets a
+        VERDICT: "serialized" when the measured mean still sits within
+        `factor` of the serial prediction (the legs are priced right —
+        the schedule just never overlapped them; the fix is
+        COLL-SERIALIZED's, not a re-fit), else "mispriced" (the
+        measured time escapes even the serial sum — some pricing INPUT
+        is wrong). Under-drifting shapes stay "overpriced"."""
         factor = self.drift_factor if factor is None else float(factor)
         out = []
         for key in sorted(self._drift, key=str):
             win = self._drift[key]
             if not win:
                 continue
-            pred = sum(p for p, _ in win) / len(win)
-            meas = sum(m for _, m in win) / len(win)
+            pred = sum(s[0] for s in win) / len(win)
+            meas = sum(s[1] for s in win) / len(win)
             ratio = meas / pred if pred > 0 else float("inf")
-            out.append({"shape": list(key), "n": len(win),
-                        "predicted_s": pred, "measured_s": meas,
-                        "ratio": ratio,
-                        "drifting": bool(ratio > factor
-                                         or ratio < 1.0 / factor)})
+            drifting = bool(ratio > factor or ratio < 1.0 / factor)
+            entry = {"shape": list(key), "n": len(win),
+                     "predicted_s": pred, "measured_s": meas,
+                     "ratio": ratio, "drifting": drifting}
+            serials = [s[2] for s in win
+                       if len(s) > 2 and s[2] is not None]
+            if serials:
+                serial = sum(serials) / len(serials)
+                entry["predicted_serial_s"] = serial
+                entry["serial_ratio"] = (meas / serial if serial > 0
+                                         else float("inf"))
+            if drifting:
+                if ratio < 1.0:
+                    entry["verdict"] = "overpriced"
+                elif entry.get("serial_ratio") is not None and \
+                        entry["serial_ratio"] <= factor:
+                    entry["verdict"] = "serialized"
+                else:
+                    entry["verdict"] = "mispriced"
+            out.append(entry)
         return out
 
     def summary(self):

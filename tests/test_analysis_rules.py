@@ -1050,3 +1050,232 @@ def test_page_refcount_audit_catches_cow_without_scales():
     assert broken_hits
     assert all(h.severity == Severity.ERROR for h in broken_hits)
     assert any("scale plane" in h.message for h in broken_hits)
+
+
+# ------------------------------------------------------ schedule doctor
+
+
+def _sched_program(fn, *args, axes=(("tp", 8),)):
+    """LoweredProgram over a jaxpr traced under a named-axis env (the
+    schedule pass consumes the jaxpr only; the HLO text stays empty)."""
+    jx = jax.make_jaxpr(fn, axis_env=list(axes))(*args)
+    return LoweredProgram("", jaxpr=jx,
+                          name=getattr(fn, "__name__", "sched"))
+
+
+def test_coll_serialized_rule_planted_defect_and_overlappable_twin():
+    """COLL-SERIALIZED planted defect: a psum whose ONLY compute is its
+    own producer (psum-after-dot, nothing else in flight) sits on the
+    critical path with zero concurrently-schedulable compute — ERROR.
+    The overlappable twin (an independent dot big enough to hide the
+    wire) stays silent, and its schedule estimate prices the step at
+    the roofline max while the serialized one prices toward the serial
+    sum — bracketed either way."""
+    from paddle_tpu.analysis import estimate_schedule
+
+    def serialized(x, w):
+        return jax.lax.psum(x @ w, "tp")
+
+    def overlappable(x, w, w2):
+        y = jax.lax.psum(x @ w, "tp")
+        z = (x @ w2).sum()            # independent: schedulable DURING
+        return y, z                   # the psum's wire time
+
+    x = jnp.zeros((128, 256), jnp.float32)
+    w = jnp.zeros((256, 128), jnp.float32)
+    w2 = jnp.zeros((256, 2048), jnp.float32)
+    pm = PassManager(["schedule"])
+
+    bad = pm.run(_sched_program(serialized, x, w),
+                 AnalysisContext(name="ser", mesh_axes={"tp": 8}))
+    hits = bad.by_rule("COLL-SERIALIZED")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "psum" in hits[0].message and "serial" in hits[0].message
+    m = bad.metrics["schedule"]
+    assert m["n_collectives"] == 1
+    assert m["n_serialized_collectives"] == 1
+    # nothing overlaps: the overlap-aware step sits at the serial sum
+    assert m["overlap_step_us"] == m["serial_step_us"]
+    assert m["overlap_frac"] == 0.0
+
+    good = pm.run(_sched_program(overlappable, x, w, w2),
+                  AnalysisContext(name="ov", mesh_axes={"tp": 8}))
+    assert good.by_rule("COLL-SERIALIZED") == []
+    mg = good.metrics["schedule"]
+    assert mg["n_collectives"] == 1
+    assert mg["overlap_frac"] == 1.0
+    assert mg["overlap_step_us"] == mg["ideal_step_us"]
+
+    # the bracket is definitional on BOTH programs
+    for est in (estimate_schedule(_sched_program(serialized, x, w),
+                                  mesh_axes={"tp": 8}),
+                estimate_schedule(_sched_program(overlappable, x, w, w2),
+                                  mesh_axes={"tp": 8})):
+        assert est.ideal_step_s <= est.overlap_step_s \
+            <= est.serial_step_s + 1e-18
+
+
+def test_coll_serialized_threshold_and_degenerate_group():
+    """The hide bar is a context knob: compute covering 30% of the wire
+    flags at the default 50% bar but passes a 20% bar. A degenerate
+    1-participant psum has no wire leg at all — never a collective
+    stream node, never a finding."""
+
+    def partial(x, w, w2):
+        y = jax.lax.psum(x @ w, "tp")     # wire >> the small free dot
+        z = (x[:8] @ w2).sum()
+        return y, z
+
+    x = jnp.zeros((256, 64), jnp.float32)
+    w = jnp.zeros((64, 1024), jnp.float32)
+    w2 = jnp.zeros((64, 32), jnp.float32)
+    pm = PassManager(["schedule"])
+    program = _sched_program(partial, x, w, w2)
+
+    strict = pm.run(program, AnalysisContext(name="p",
+                                             mesh_axes={"tp": 8}))
+    assert strict.by_rule("COLL-SERIALIZED")
+    loose = pm.run(program, AnalysisContext(
+        name="p", mesh_axes={"tp": 8}, schedule_hide_frac=0.001))
+    assert loose.by_rule("COLL-SERIALIZED") == []
+
+    def degenerate(x, w):
+        return jax.lax.psum(x @ w, "one")
+
+    deg = pm.run(_sched_program(degenerate, x, w, axes=(("one", 1),)),
+                 AnalysisContext(name="d", mesh_axes={"one": 1}))
+    assert deg.by_rule("COLL-SERIALIZED") == []
+    assert deg.metrics["schedule"]["n_collectives"] == 0
+    assert deg.metrics["schedule"]["overlap_frac"] == 1.0
+
+
+def test_coll_serialized_scan_body_collective_attributed_to_source():
+    """A collective INSIDE a scan body is still found (the DAG walk
+    recurses like the memory pass's liveness walk), its cost scales
+    with the trip count, and the finding attributes it to the source
+    line of the psum call — not to the scan eqn that hides it."""
+    from paddle_tpu.analysis import estimate_schedule
+
+    def body(c, xs):
+        y = c @ xs
+        y = jax.lax.psum(y, "tp")     # <-- the line the rule must name
+        return y, y.sum()
+    psum_line = body.__code__.co_firstlineno + 2
+
+    def f(c0, xs):
+        return jax.lax.scan(body, c0, xs)
+
+    c0 = jnp.zeros((64, 64), jnp.float32)
+    xs = jnp.zeros((6, 64, 64), jnp.float32)
+    pm = PassManager(["schedule"])
+    report = pm.run(_sched_program(f, c0, xs),
+                    AnalysisContext(name="scan", mesh_axes={"tp": 8}))
+    hits = report.by_rule("COLL-SERIALIZED")
+    assert hits, "scan-body collective not found"
+    assert f"test_analysis_rules.py:{psum_line}" in hits[0].op, \
+        (hits[0].op, psum_line)
+    # trip scaling: the same body over 12 steps prices exactly 2x wire
+    est6 = estimate_schedule(_sched_program(f, c0, xs),
+                             mesh_axes={"tp": 8})
+    est12 = estimate_schedule(
+        _sched_program(f, c0, jnp.zeros((12, 64, 64), jnp.float32)),
+        mesh_axes={"tp": 8})
+    assert est12.wire_s == pytest.approx(2 * est6.wire_s)
+
+
+def test_roofline_drift_verdict_splits_serialized_from_mispriced():
+    """The drift ledger's serialized-vs-mispriced verdict: ticks that
+    carry predicted_serial_s (engines/Trainer stamp the serial sum of
+    the priced legs next to the overlapped max) let the analyzer tell
+    a schedule that SERIALIZED its streams (measured inside the serial
+    sum — fix the schedule, not the pricing inputs) from a genuinely
+    mispriced leg (measured outside even the sum). Ticks without the
+    serial band keep the legacy re-fit message."""
+    from paddle_tpu.serving import FlightRecorder
+    program = lower_callable(lambda x: x + 1.0,
+                             jnp.zeros((2,), jnp.float32), name="decode")
+    pm = PassManager(["roofline-drift"])
+
+    def ledger(meas, serial):
+        rec = FlightRecorder()
+        for _ in range(4):
+            rec.tick("serve", ("ragged", 4, 8), measured_s=meas,
+                     predicted_s=1e-4, predicted_serial_s=serial)
+        return rec.drift_report()
+
+    # measured 10x the overlapped price but INSIDE the serial sum
+    serialized = ledger(1e-3, 1.1e-3)
+    assert serialized[0]["verdict"] == "serialized"
+    rep = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": serialized}))
+    hits = rep.by_rule("ROOFLINE-DRIFT")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "SERIALIZES" in hits[0].message
+    assert "COLL-SERIALIZED" in hits[0].suggested_fix
+    assert rep.metrics["roofline-drift"]["n_serialized"] == 1
+
+    # measured far outside even the serial sum: a real mispricing
+    mispriced = ledger(1e-2, 1.1e-3)
+    assert mispriced[0]["verdict"] == "mispriced"
+    rep2 = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": mispriced}))
+    hits2 = rep2.by_rule("ROOFLINE-DRIFT")
+    assert hits2 and "underprices" in hits2[0].message
+    assert rep2.metrics["roofline-drift"]["n_serialized"] == 0
+
+    # no serial band on the ticks: legacy message, no verdict claim
+    rec = FlightRecorder()
+    for _ in range(4):
+        rec.tick("serve", ("decode", 4, 1), measured_s=1e-3,
+                 predicted_s=1e-4)
+    legacy = rec.drift_report()
+    assert legacy[0]["verdict"] == "mispriced"
+    assert "predicted_serial_s" not in legacy[0]
+    rep3 = pm.run(program, AnalysisContext(
+        name="s", extra={"roofline_drift": legacy}))
+    assert rep3.by_rule("ROOFLINE-DRIFT")
+    assert "underprices" in rep3.by_rule("ROOFLINE-DRIFT")[0].message
+
+
+def test_schedule_prices_cond_at_its_most_expensive_branch():
+    """Mutually exclusive cond branches must not SUM (exactly one
+    executes — the eqn_flops rule): a cond over two dot branches
+    prices like one dot, not two, and an untaken branch's compute
+    never counts as COLL-SERIALIZED-hideable work next to a
+    serialized collective."""
+    from paddle_tpu.analysis import estimate_schedule
+
+    w = jnp.zeros((256, 256), jnp.float32)
+    x = jnp.zeros((256, 256), jnp.float32)
+
+    def one_dot(p, x, w):
+        return x @ w
+
+    def cond_dots(p, x, w):
+        return jax.lax.cond(p, lambda a: a @ w, lambda a: a @ w + 1.0,
+                            x)
+
+    e1 = estimate_schedule(_sched_program(one_dot, True, x, w))
+    e2 = estimate_schedule(_sched_program(cond_dots, True, x, w))
+    # exactly ONE branch's dot is priced (flops ~= one dot + the add's
+    # elementwise tail; the pre-fix sum counted both dots, ~2.7x the
+    # single-dot compute — now the heavier branch alone, < 2x)
+    assert e2.flops < 1.1 * e1.flops, (e2.flops, e1.flops)
+    assert e2.compute_s < 2.0 * e1.compute_s, (e2.compute_s,
+                                               e1.compute_s)
+
+    def serialized_with_cond(p, x, w, wc):
+        y = jax.lax.psum(x @ w, "tp")
+        z = jax.lax.cond(p, lambda a: (a @ wc).sum(),
+                         lambda a: ((a @ wc) * 2.0).sum(), x)
+        return y, z
+
+    wc = jnp.zeros((256, 2048), jnp.float32)
+    pm = PassManager(["schedule"])
+    rep = pm.run(_sched_program(serialized_with_cond, True, x, w, wc),
+                 AnalysisContext(name="c", mesh_axes={"tp": 8}))
+    # the taken branch's dot IS hideable (independent of the psum): no
+    # flag — but only ONE branch's worth of compute was credited
+    assert rep.by_rule("COLL-SERIALIZED") == []
+    m = rep.metrics["schedule"]
+    assert m["n_collectives"] == 1

@@ -551,3 +551,63 @@ def test_propagation_drops_scattered_dims():
     set_out = eqns["scatter"].outvars[0]
     add_out = eqns["scatter-add"].outvars[0]
     assert counts[set_out] == 4 and counts[add_out] == 4
+
+
+def test_propagation_drops_dynamically_indexed_dims():
+    """Sharding propagation fidelity (gather/dynamic_slice slice): a
+    dim read at DYNAMIC positions (gather's start_index_map, a
+    dynamic_slice start) loses its shard factor — rows come from
+    runtime positions, so GSPMD cannot keep a static split without
+    resharding (the scatter rule's read side) — while dims taken whole
+    (full slice size, never index-addressed) thread their factor.
+    Capped at the most-sharded operand, as everywhere; without per-dim
+    info the legacy max-operand heuristic holds."""
+    from paddle_tpu.analysis.memory import (_eqn_out_shard,
+                                            propagate_shard_counts)
+
+    def f(x, i):
+        g = x[i]                                    # gather rows
+        ds = jax.lax.dynamic_slice(
+            x, (i[0], 0), (2, 16))                  # dynamic rows
+        return g + 0.0, ds
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((32, 16)),
+                           jnp.zeros((4,), jnp.int32)).jaxpr
+    eqns = {e.primitive.name: e for e in jx.eqns}
+    assert "gather" in eqns and "dynamic_slice" in eqns
+
+    # --- unit: the indexed/sliced dim 0 drops its factor, the whole
+    # dim 1 threads
+    cnt, dims = _eqn_out_shard(eqns["gather"], [8, 1], [(2, 4), None])
+    assert cnt == 4 and dims == (1, 4)
+    nds = len([v for v in eqns["dynamic_slice"].invars
+               if type(v).__name__ != "Literal"])
+    cnt, dims = _eqn_out_shard(eqns["dynamic_slice"],
+                               [8] + [1] * (nds - 1),
+                               [(2, 4)] + [None] * (nds - 1))
+    assert cnt == 4 and dims == (1, 4)
+    # operand sharded ONLY on the dynamic dim: everything drops
+    cnt0, dims0 = _eqn_out_shard(eqns["gather"], [4, 1], [(4, 1), None])
+    assert cnt0 == 1 and dims0 == (1, 1)
+    cnt0, dims0 = _eqn_out_shard(eqns["dynamic_slice"],
+                                 [4] + [1] * (nds - 1),
+                                 [(4, 1)] + [None] * (nds - 1))
+    assert cnt0 == 1 and dims0 == (1, 1)
+    # cap: kept-dim factor above the most-sharded operand bails to the
+    # blind cap (never claim finer sharding than any input)
+    cntc, dimsc = _eqn_out_shard(eqns["gather"], [2, 1], [(1, 4), None])
+    assert cntc == 2 and dimsc is None
+    # legacy (no dim info): blind max-operand inherit — unchanged
+    cntl, _ = _eqn_out_shard(eqns["gather"], [8, 1], [None, None])
+    assert cntl == 8
+
+    # --- through the jaxpr: tp on the embedding dim survives the row
+    # gather (and the elementwise chain after it); the dynamic_slice
+    # output keeps it too, while the dynamically sliced batch dim's
+    # factor is gone from both
+    counts = propagate_shard_counts(jx, arg_counts=[8, 1],
+                                    arg_dims=[(2, 4), None])
+    g_out = eqns["gather"].outvars[0]
+    ds_out = eqns["dynamic_slice"].outvars[0]
+    assert counts[g_out] == 4 and counts[ds_out] == 4
+    assert counts[jx.outvars[0]] == 4

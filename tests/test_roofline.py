@@ -430,3 +430,97 @@ class TestKvQuantRoofline:
         got = d8.step_hbm_bytes(avg_ctx=ctx)
         want_kv = 2 * cfg.num_layers * ctx * 2 * (hd + 4)
         assert got - d8.step_hbm_bytes(avg_ctx=ctx, batch=0) == want_kv
+
+
+class TestOverlapRoofline:
+    """cost_model.roofline_step_time_overlap — the overlap-aware step
+    model the schedule pass, the autotuner's `_price` and the flight
+    recorder's serial band all share."""
+
+    def test_bracket_is_provable(self):
+        """max() <= overlap <= sum(), for every overlap fraction: the
+        acceptance pin. The chip streams (compute, HBM) stay
+        overlapped into their max; only the wire leg serializes."""
+        from paddle_tpu.cost_model import (roofline_step_time,
+                                           roofline_step_time_overlap)
+        cases = [(1e12, 1e9, 1e8, 0), (1e10, 5e9, 5e8, 5e8),
+                 (0, 1e9, 1e9, 0), (1e12, 1e6, 0, 0)]
+        for flops, hbm, ici, dcn in cases:
+            rt = roofline_step_time(flops, hbm, ici, dcn)
+            serial = max(rt.compute_s, rt.hbm_s) + rt.wire_s
+            for frac in (0.0, 0.25, 0.5, 0.75, 1.0):
+                o = roofline_step_time_overlap(flops, hbm, ici, dcn,
+                                               overlap_frac=frac)
+                assert rt.step_s <= o.step_s + 1e-18, (frac, flops)
+                assert o.step_s <= serial + 1e-18, (frac, flops)
+
+    def test_full_overlap_is_exactly_todays_max(self):
+        from paddle_tpu.cost_model import (roofline_step_time,
+                                           roofline_step_time_overlap)
+        rt = roofline_step_time(1e12, 2e9, 3e8, 1e7)
+        o = roofline_step_time_overlap(1e12, 2e9, 3e8, 1e7,
+                                       overlap_frac=1.0)
+        assert o.step_s == rt.step_s
+        assert o.bound == rt.bound
+
+    def test_zero_overlap_is_chip_plus_wire_and_monotone(self):
+        from paddle_tpu.cost_model import roofline_step_time_overlap
+        o0 = roofline_step_time_overlap(1e12, 1e9, 1e9,
+                                        overlap_frac=0.0)
+        assert o0.step_s == pytest.approx(o0.chip_s + o0.wire_s)
+        assert o0.bound == "wire-serialized"
+        prev = None
+        for frac in (0.0, 0.2, 0.4, 0.6, 0.8, 1.0):
+            s = roofline_step_time_overlap(1e12, 1e9, 1e9,
+                                           overlap_frac=frac).step_s
+            if prev is not None:
+                assert s <= prev + 1e-18    # more overlap never slower
+            prev = s
+        # out-of-range fractions clamp instead of extrapolating
+        lo = roofline_step_time_overlap(1e12, 1e9, 1e9,
+                                        overlap_frac=-3.0)
+        hi = roofline_step_time_overlap(1e12, 1e9, 1e9,
+                                        overlap_frac=7.0)
+        assert lo.overlap_frac == 0.0 and hi.overlap_frac == 1.0
+
+    def test_no_wire_is_invariant_in_frac(self):
+        """A wire-free program prices identically at EVERY fraction —
+        which is exactly why re-pricing the single-device gpt_1p3b
+        probe grid through the overlap model cannot move the
+        autotuner's bs6/dots pick (the slow grid test pins the pick
+        itself; this pins the invariance that protects it)."""
+        from paddle_tpu.cost_model import (roofline_step_time,
+                                           roofline_step_time_overlap)
+        rt = roofline_step_time(5e12, 3e9)
+        for frac in (0.0, 0.37, 1.0):
+            o = roofline_step_time_overlap(5e12, 3e9,
+                                           overlap_frac=frac)
+            assert o.step_s == rt.step_s
+            assert o.bound == rt.bound
+
+    def test_price_routes_through_overlap_model(self):
+        """autotune._price with wire legs prices at the overlap-aware
+        step: frac 1.0 reproduces the old max() exactly (same
+        RematWhatIf, same throughput), frac 0 prices slower — the
+        serialized candidate honestly loses the ranking."""
+        from paddle_tpu.analysis.autotune import _price
+        from paddle_tpu.analysis.remat_advisor import RematWhatIf
+        from paddle_tpu.cost_model import chip_spec
+        w = RematWhatIf(policy="none", peak_bytes=1 << 28,
+                        base_peak_bytes=1 << 28, saved_bytes=1 << 24,
+                        boundary_bytes=1 << 20, dropped_bytes=0,
+                        bump_bytes=0, recompute_flops=0,
+                        step_flops=10**13, segments=4)
+        chip = chip_spec("v5e")
+        args = (w, 1 << 26, 1 << 22, 1 << 26, 4096, "tokens/s", chip)
+        peak1, fl1, rt1, thr1 = _price(*args, ici_b=1 << 28,
+                                       overlap_frac=1.0)
+        peak0, fl0, rt0, thr0 = _price(*args, ici_b=1 << 28,
+                                       overlap_frac=0.0)
+        assert (peak1, fl1) == (peak0, fl0)
+        assert rt1.step_s == max(rt1.compute_s, rt1.hbm_s, rt1.wire_s)
+        assert rt0.step_s > rt1.step_s and thr0 < thr1
+        # no wire: the fraction is a no-op, bit-identical pricing
+        pa = _price(*args, overlap_frac=1.0)
+        pb = _price(*args, overlap_frac=0.123)
+        assert pa[2].step_s == pb[2].step_s and pa[3] == pb[3]

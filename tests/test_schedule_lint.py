@@ -1,0 +1,150 @@
+"""The lint-schedule CI gate: every SCHEDULE config's overlap-aware
+critical-path manifest (schedule_manifests/<config>.json — the
+bracketed step time, wire-hiding fraction and critical-path
+attribution, priced against the fixed v5e spec) must match the
+committed file, and the CLI's --check must cover schedule drift.
+
+Runs inside the standard tier-1 sweep; select alone with
+`-m lint_schedule`. Reports ride the per-process lowering cache in
+paddle_tpu.analysis.baseline (one trace per config)."""
+import pytest
+
+from paddle_tpu.analysis import (PassManager, build_schedule_manifest,
+                                 load_schedule_manifest, manifest_drift)
+from paddle_tpu.analysis.baseline import (SCHEDULE_CONFIGS,
+                                          lowered_program)
+
+pytestmark = pytest.mark.lint_schedule
+
+
+@pytest.fixture(scope="module")
+def pass_manager():
+    return PassManager(["schedule"])
+
+
+@pytest.mark.parametrize("name", sorted(SCHEDULE_CONFIGS))
+def test_schedule_manifest_is_committed_and_current(name, pass_manager):
+    committed = load_schedule_manifest(name)
+    assert committed is not None, (
+        f"schedule_manifests/{name}.json is not committed — run "
+        "python -m paddle_tpu.analysis --write-manifests")
+    program, ctx, _ = lowered_program(name)
+    report = pass_manager.run(program, ctx)
+    fresh = build_schedule_manifest(name, report)
+    drift = manifest_drift(fresh, committed)
+    assert drift == [], "\n".join(drift)
+
+
+@pytest.mark.parametrize("name", sorted(SCHEDULE_CONFIGS))
+def test_schedule_estimate_is_bracketed_and_clean(name, pass_manager):
+    """Structural pins that outlive re-baselining: the overlap-aware
+    step time sits inside [roofline max, serial sum]; the committed
+    single-device configs carry no collectives, so the bracket
+    COLLAPSES (nothing to overlap: overlap == max == sum, frac 1.0)
+    and COLL-SERIALIZED never fires on the committed state."""
+    program, ctx, _ = lowered_program(name)
+    report = pass_manager.run(program, ctx)
+    m = report.metrics["schedule"]
+    assert m["available"] and m["n_nodes"] > 0
+    assert m["ideal_step_us"] <= m["overlap_step_us"] \
+        <= m["serial_step_us"]
+    assert m["overlap_step_us"] > 0
+    # committed configs are single-device: the wire stream is empty
+    assert m["n_collectives"] == 0
+    assert m["overlap_frac"] == 1.0
+    assert m["ideal_step_us"] == m["serial_step_us"]
+    assert report.by_rule("COLL-SERIALIZED") == []
+    # the critical path attributes real ops with source lines
+    assert m["critical_path"], "empty critical path"
+    assert any(".py:" in n["source"] for n in m["critical_path"])
+
+
+def test_estimate_schedule_brackets_on_sharded_program():
+    """The bracket is definitional on a REAL collective-carrying
+    program too, and `roofline_step_time_overlap` priced at the
+    estimate's fraction lands exactly on the estimate's step time
+    when fed the schedule's own legs."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import LoweredProgram, estimate_schedule
+
+    def step(x, w1, w2):
+        h = jax.lax.psum(x @ w1, "tp")
+        return jax.lax.psum(h @ w2, "tp")
+
+    jx = jax.make_jaxpr(step, axis_env=[("tp", 4)])(
+        jnp.zeros((64, 128), jnp.float32),
+        jnp.zeros((128, 64), jnp.float32),
+        jnp.zeros((64, 64), jnp.float32))
+    est = estimate_schedule(LoweredProgram("", jaxpr=jx, name="tp"),
+                            mesh_axes={"tp": 4})
+    assert est.n_collectives == 2 and est.wire_s > 0
+    assert est.ideal_step_s <= est.overlap_step_s \
+        <= est.serial_step_s + 1e-18
+    assert 0.0 <= est.overlap_frac <= 1.0
+    # identity: overlap_step == max(compute, frac*wire) + (1-frac)*wire
+    frac = est.overlap_frac
+    rebuilt = max(est.compute_s, frac * est.wire_s) \
+        + (1 - frac) * est.wire_s
+    assert rebuilt == pytest.approx(est.overlap_step_s, rel=1e-9)
+
+
+def test_cli_check_covers_schedule_drift(monkeypatch, capsys):
+    """--check exits 1 when ONLY the schedule manifest is stale (lint,
+    memory and tuning current), proving the new family is inside the
+    CI gate."""
+    from paddle_tpu.analysis import __main__ as cli
+    from paddle_tpu.analysis import manifest as mf
+
+    assert cli.main(["gpt", "--check"]) == 0
+    capsys.readouterr()
+
+    real = mf.load_schedule_manifest
+
+    def stale(name):
+        data = real(name)
+        if data:
+            data = dict(data, overlap_step_us=-1.0)
+        return data
+    monkeypatch.setattr(mf, "load_schedule_manifest", stale)
+    # the package re-exports the symbol; patch the import site too
+    import paddle_tpu.analysis as pkg
+    monkeypatch.setattr(pkg, "load_schedule_manifest", stale)
+    assert cli.main(["gpt", "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "STALE" in out and "schedule" in out
+
+
+def test_cli_schedule_prints_breakdown(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["gpt", "--schedule"]) == 0
+    out = capsys.readouterr().out
+    assert "schedule: overlap step" in out
+    assert "overlap_frac" in out
+
+
+def test_debug_schedule_report_front_doors(capsys):
+    """debug.schedule_report covers the Layer and callable doors (the
+    Trainer door shares analysis_program with memory_report, pinned
+    there) and prints the bracketed step line."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import debug
+    from paddle_tpu.distributed import build_mesh
+
+    paddle.seed(0)
+    build_mesh(dp=1)
+    net = paddle.nn.Linear(16, 16)
+    est = debug.schedule_report(net, np.zeros((4, 16), np.float32))
+    out = capsys.readouterr().out
+    assert "schedule report" in out and "step: overlap" in out
+    assert est.ideal_step_s <= est.overlap_step_s <= est.serial_step_s
+    assert est.n_collectives == 0 and est.overlap_frac == 1.0
+
+    est2 = debug.schedule_report(
+        lambda x: (x @ x.T).sum(), jnp.zeros((8, 8), jnp.float32),
+        print_report=False)
+    assert est2.n_nodes > 0
+    assert est2.ideal_step_s <= est2.overlap_step_s \
+        <= est2.serial_step_s
