@@ -352,6 +352,80 @@ def _gpt_decode_kv8():
     return program, ctx, PagedGPTDecoder._decode_multi_step
 
 
+def _gpt_decode_mt():
+    """The MULTI-TENANT serving config (serving.tenancy): the PACKED
+    mixed horizon program WITH the multi-LoRA adapter gather —
+    `_packed_multi_step` over a decoder carrying an attached 2-adapter
+    bank, so the trace includes the per-token low-rank delta
+    (`_lora_delta`) and the `aids` input — captured via
+    `analysis_program(ragged=(4, 8))`, plus a page LEDGER and a
+    scheduling trace committed from a REAL preempting multi-tenant
+    workload: two throughput-tier requests on different adapters fill
+    both slots, a latency-tier request arrives mid-stream, preempts a
+    victim by page-spill (its blocks park in the prefix cache), and
+    the ledger is captured at a sync where the preemption has landed
+    and slots are live — so the committed ledger carries
+    `slot_adapters` rows (the MEM-PAGE-REFCOUNT cross-variant
+    aliasing check runs against real data) next to the parked victim
+    blocks. Gated by SERVE-HOST-SYNC-DECODE (zero host transfers in
+    the adapter-gather scan, donated KV pool), SERVE-PREFILL-STALL
+    (preemption must not reintroduce a blocking prefill), and
+    MEM-PAGE-REFCOUNT."""
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import (SLO_LATENCY, SLO_THROUGHPUT,
+                                    PagedGPTDecoder, PrefixCache,
+                                    TenantEngine, make_lora_bank)
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    # 6 allocatable pages: two 2-page throughput requests occupy both
+    # slots, the 3-page latency arrival can only be served by
+    # preempting a victim (slot exhaustion + page pressure)
+    dec = PagedGPTDecoder(model, num_pages=7, page_size=16, max_batch=2)
+    dec.attach_adapters(make_lora_bank(cfg, 2, rank=4, seed=5))
+    eng = TenantEngine(
+        dec, max_new_tokens=6, k_max=2,
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+    rng = np.random.RandomState(3)
+    V = cfg.vocab_size
+    lat_prompt = rng.randint(0, V, 36).astype(np.int32)
+    eng.submit(rng.randint(0, V, 20).astype(np.int32), tenant="batch",
+               slo=SLO_THROUGHPUT, adapter=1)
+    eng.submit(rng.randint(0, V, 20).astype(np.int32), tenant="batch",
+               slo=SLO_THROUGHPUT, adapter=2)
+    cap = {}
+
+    def on_sync(e):
+        if "lat" not in cap and e.stats.tokens >= 2:
+            cap["lat"] = e.submit(lat_prompt, tenant="chat",
+                                  slo=SLO_LATENCY)
+        if "ledger" not in cap and e.stats.preemptions and \
+                any(r is not None for r in e._slot_req):
+            cap["ledger"] = e.page_ledger()
+
+    eng.run(on_sync=on_sync)
+    assert eng.stats.preemptions and eng.stats.resumes, \
+        "multi-tenant ledger workload lost its preemption shape"
+    assert cap.get("ledger") and cap["ledger"]["slot_adapters"], \
+        "ledger capture missed the live multi-adapter window"
+    program = dec.analysis_program(ragged=(4, 8))
+    ctx = AnalysisContext(
+        name="gpt_decode_mt",
+        # the shared ragged-attention reorders ride with the dense
+        # model's by-design attention transposes (same body as
+        # gpt_decode_ragged; the adapter gather adds none)
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + RAGGED_ATTENTION_TRANSPOSES,
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "page_ledger": cap["ledger"],
+               "serve_schedule": eng.serve_schedule()})
+    return program, ctx, PagedGPTDecoder._packed_multi_step
+
+
 # configs whose builder yields a READY LoweredProgram (serving decode
 # loops and other non-Layer captures): builder() ->
 # (LoweredProgram, AnalysisContext, source_fn). They ride the same
@@ -362,16 +436,21 @@ PROGRAM_CONFIGS = {
     "gpt_decode_prefix": _gpt_decode_prefix,   # chunked prefix-cache prefill
     "gpt_decode_ragged": _gpt_decode_ragged,   # mixed chunked-prefill+decode
     "gpt_decode_kv8": _gpt_decode_kv8,         # int8 KV pool decode loop
+    "gpt_decode_mt": _gpt_decode_mt,           # multi-tenant + multi-LoRA
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
 }
 
 # configs whose schedule manifest is committed (schedule_manifests/):
 # the five BASELINE model forwards plus the fused train scan — the
-# programs whose step time the overlap-aware roofline prices. The
-# serving decode captures are excluded: a decode tick is one
-# HBM-bound stream with no collective to overlap, so the schedule
-# estimate adds nothing the memory manifests don't already pin.
-SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",)
+# programs whose step time the overlap-aware roofline prices — plus
+# gpt_decode_mt: the one serving capture with a schedule manifest (the
+# multi-tenant horizon is the program whose composition the tenancy
+# scheduler prices, so its critical-path/overlap numbers are pinned
+# even though a decode tick carries no collective to hide). The other
+# serving captures stay excluded: their schedule estimate adds
+# nothing the memory manifests don't already pin.
+SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",
+                                              "gpt_decode_mt")
 
 
 def build_config(name):

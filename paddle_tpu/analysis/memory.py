@@ -384,6 +384,13 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
       holds (`_reshape_dim_shards`), falling back to the conservative
       cap otherwise — so dp/tp knowledge survives the [B, S, H·D] <->
       [B·S, H, D] style reshapes between attention matmuls.
+    * `concatenate` / `pad` / `slice` thread factors through UNTOUCHED
+      dims and drop them on the structural ones: the concat dim (pieces
+      land at per-operand offsets), padded dims (offsets shift), and
+      statically under-sliced or strided dims (the kept span crosses
+      shard boundaries) — while a dim every operand agrees on, or one
+      taken whole at stride 1, keeps its factor. This is what lets
+      dp/tp knowledge survive KV-cache style concat-and-slice chains.
     * `gather` / `dynamic_slice` drop shard factors on DYNAMICALLY
       indexed dims (start_index_map / runtime slice starts): rows read
       from dynamic positions admit no static split, so the result is
@@ -462,6 +469,73 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
                 cap = max(in_counts) if in_counts else 1
                 if total > cap:       # no axis identity: never claim
                     return cap, None  # finer sharding than any input
+                return max(total, 1), dims
+        if name == "concatenate" and in_dims and \
+                all(d is not None for d in in_dims) and in_dims:
+            axis = eqn.params.get("dimension")
+            if axis is not None and all(len(d) == len(in_dims[0])
+                                        for d in in_dims):
+                # the concat dim loses its factor: pieces land at
+                # per-operand offsets, so no single static split of
+                # the merged dim covers them without resharding; a
+                # NON-concat dim threads only when every operand
+                # agrees on its factor (a mixed-factor dim would make
+                # the output's split operand-dependent)
+                dims = tuple(
+                    1 if (i == axis or len({int(d[i])
+                                            for d in in_dims}) != 1)
+                    else int(in_dims[0][i])
+                    for i in range(len(in_dims[0])))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:       # no axis identity: never claim
+                    return cap, None  # finer sharding than any input
+                return max(total, 1), dims
+        if name == "pad" and in_dims and in_dims[0] is not None:
+            pc = eqn.params.get("padding_config")
+            if pc is not None and len(pc) == len(in_dims[0]):
+                # a PADDED dim loses its factor: low/high/interior
+                # padding shifts element offsets, so the input's
+                # even split no longer lands on shard boundaries;
+                # untouched dims thread through
+                dims = tuple(
+                    1 if any(int(x) != 0 for x in pc[i])
+                    else int(d) for i, d in enumerate(in_dims[0]))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:
+                    return cap, None
+                return max(total, 1), dims
+        if name == "slice" and in_dims and in_dims[0] is not None:
+            starts = eqn.params.get("start_indices")
+            limits = eqn.params.get("limit_indices")
+            strides = eqn.params.get("strides")
+            ivs0 = [v for v in eqn.invars if _is_var(v)]
+            in_shape = tuple(getattr(ivs0[0].aval, "shape", ()))
+            if starts is not None and limits is not None and \
+                    len(starts) == len(in_dims[0]) == len(in_shape):
+                # a STATICALLY sliced dim (taken below full size, or
+                # strided) loses its factor — the kept span crosses
+                # shard boundaries at static but non-aligned offsets,
+                # which GSPMD resolves by resharding; a dim taken
+                # WHOLE at stride 1 is the identity and threads (the
+                # static mirror of the dynamic_slice rule above)
+                dims = tuple(
+                    int(d) if (int(starts[i]) == 0 and
+                               int(limits[i]) == int(in_shape[i]) and
+                               (strides is None or
+                                int(strides[i]) == 1))
+                    else 1 for i, d in enumerate(in_dims[0]))
+                total = 1
+                for d in dims:
+                    total *= int(d)
+                cap = max(in_counts) if in_counts else 1
+                if total > cap:
+                    return cap, None
                 return max(total, 1), dims
         if name == "gather" and in_dims and in_dims[0] is not None:
             dn = eqn.params.get("dimension_numbers")
@@ -766,6 +840,14 @@ def audit_page_ledger(ledger):
     for s, pages in slots.items():
         for p in pages:
             holders.setdefault(p, []).append(s)
+    # multi-LoRA rows (serving.tenancy): per-slot adapter salts — a
+    # page shared across slots whose salts DIFFER means one variant is
+    # reading another's KV bytes (the adapter's low-rank delta is part
+    # of every write, so cross-variant bytes are simply wrong). The
+    # engine prevents this by folding `adapter_salt` into the chain
+    # keys; the audit proves it held on the live ledger.
+    slot_adapters = {int(s): dict(e) for s, e in
+                     (ledger.get("slot_adapters") or {}).items()}
     for p, hs in holders.items():
         if len(hs) > 1 and (p not in cache
                             or int(cache[p].get("refs", 0)) < len(hs)):
@@ -773,6 +855,17 @@ def audit_page_ledger(ledger):
                 "covering cache refcount (unaccounted aliasing)",
                 fix="mount shared pages through the prefix cache so "
                 "refcounts track every holder")
+        if len(hs) > 1 and slot_adapters:
+            salts = {slot_adapters.get(s, {}).get("salt", "")
+                     for s in hs}
+            if len(salts) > 1:
+                bad(f"page {p} is shared by slots {sorted(hs)} with "
+                    f"DIFFERENT adapter fingerprints — a LoRA "
+                    "variant is aliasing another variant's KV bytes",
+                    fix="fold the request's adapter_salt into the "
+                    "prefix-cache chain keys (PrefixCache.block_keys"
+                    "(ids, extra_salt=...)) so cross-variant prompts "
+                    "never match the same entries")
     for p in seen:
         if p in holders:
             bad(f"page {p} is both free and held by slot(s) "

@@ -35,7 +35,8 @@ __all__ = ["CostModel", "collective_wire_bytes", "collective_wire_split",
            "roofline_step_time_overlap", "decode_tick_roofline_s",
            "ragged_tick_legs", "ragged_tick_roofline_s",
            "ragged_chunk_tokens", "decode_horizon", "train_horizon",
-           "measured_host_sync_s", "prefill_ttft_s", "kv_restore_s"]
+           "measured_host_sync_s", "prefill_ttft_s", "kv_restore_s",
+           "SLO_SYNC_FRAC", "slo_horizon", "slo_p99_target_s"]
 
 
 # ------------------------------------------------------------------ chips
@@ -414,6 +415,58 @@ def decode_horizon(step_hbm_bytes, host_sync_s=None, chip=None,
         return int(k_cap)
     k = math.ceil(host_sync_s / (sync_overhead_frac * t))
     return int(min(max(k, 1), int(k_cap)))
+
+
+# --------------------------------------------------------- SLO classes
+#
+# Per-class sync-overhead budgets for multi-tenant serving
+# (serving.tenancy): the LATENCY tier deliberately accepts a much
+# larger host-sync share — syncing more often is exactly what shortens
+# the queue-wait/TTFT tail, because admission (and preemption) can only
+# happen at horizon boundaries. The THROUGHPUT tier keeps the default
+# 10% amortization. Both classes price through the SAME mixed-tick
+# roofline (`ragged_tick_roofline_s` via `decode_horizon`), so the
+# per-class targets are roofline-DERIVED, not hand-tuned constants.
+
+SLO_SYNC_FRAC = {"latency": 0.5, "throughput": 0.10}
+
+
+def slo_horizon(step_hbm_bytes, slo, host_sync_s=None, chip=None,
+                k_cap=32, chunk_tokens=0, flops_per_token=0.0):
+    """Per-SLO-class decode horizon K: `decode_horizon` priced with the
+    class's sync-overhead budget (`SLO_SYNC_FRAC`). The latency tier's
+    smaller K bounds how long a newly arrived latency prompt can sit
+    in the queue before the next admission boundary; the throughput
+    tier amortizes the sync like the single-tenant engine."""
+    frac = SLO_SYNC_FRAC.get(slo)
+    if frac is None:
+        raise ValueError(f"unknown SLO class {slo!r}; known: "
+                         f"{sorted(SLO_SYNC_FRAC)}")
+    return decode_horizon(step_hbm_bytes, host_sync_s=host_sync_s,
+                          chip=chip, k_cap=k_cap,
+                          sync_overhead_frac=frac,
+                          chunk_tokens=chunk_tokens,
+                          flops_per_token=flops_per_token)
+
+
+def slo_p99_target_s(step_hbm_bytes, slo, host_sync_s=None, chip=None,
+                     k_cap=32, chunk_tokens=0, flops_per_token=0.0):
+    """Roofline-derived per-class p99 target for one horizon boundary:
+    the class's K ticks at the mixed-tick roofline plus one host sync
+    — the longest a request of that class should wait between two
+    scheduling opportunities on a correctly composed engine. The
+    multi-tenant bench reports measured per-class p99 NEXT to this
+    number (serving.tenancy.TenantEngine.tenancy_summary), so a
+    violated target points at composition, not at a hand-tuned
+    constant."""
+    if host_sync_s is None:
+        host_sync_s = measured_host_sync_s()
+    k = slo_horizon(step_hbm_bytes, slo, host_sync_s=host_sync_s,
+                    chip=chip, k_cap=k_cap, chunk_tokens=chunk_tokens,
+                    flops_per_token=flops_per_token)
+    tick = ragged_tick_roofline_s(step_hbm_bytes, chunk_tokens,
+                                  flops_per_token, chip=chip)
+    return k * tick + host_sync_s
 
 
 def prefill_ttft_s(prompt_tokens, flops_per_token, cached_frac=0.0,

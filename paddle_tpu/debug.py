@@ -50,6 +50,10 @@ def serving_report(drift_factor=None, print_report=False):
     report = []
     for eng in live_engines():
         entry = {"stats": eng.stats.summary()}
+        if hasattr(eng, "tenancy_summary"):
+            # multi-tenant engines: per-tenant ledgers, per-class p99
+            # vs roofline targets, fairness, preemption counts
+            entry["tenancy"] = eng.tenancy_summary()
         events = eng.serve_schedule() if hasattr(eng, "serve_schedule") \
             else []
         if events:

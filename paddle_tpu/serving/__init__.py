@@ -49,10 +49,22 @@ decoding).  TPU-native design, split across this package:
   `PrefixCache.save(dir)`/`load(dir, decoder)` persist the cache
   across engine restarts, keyed by `cache_fingerprint()` (mismatch
   refuses).  docs/serving.md "Tiered KV".
+- `tenancy.py` — multi-tenant serving over the same machinery:
+  per-request SLO classes (`TenantEngine`: latency-tier requests admit
+  ahead of the throughput backlog; `TenantScheduler` composes horizons
+  per class through `cost_model.slo_horizon`), preemption by
+  page-spill (a latency admission out of slots/pages parks a
+  throughput victim's KV blocks into the prefix cache — whence the
+  host tier — and the victim resumes byte-identically), and
+  multi-LoRA (per-token adapter gathers over shared base weights —
+  `PagedGPTDecoder.attach_adapters` — with per-adapter chain-key salts
+  so pages never alias across variants).  docs/serving.md
+  "Multi-tenant serving".
 - `stats.py` — per-engine `ServeStats` (host syncs/token, prefix-cache
   hit/evict/bytes-saved counters, tiered-KV spill/restore/recompute
-  counters, TTFT/queue-wait/occupancy windows) behind
-  `debug.serving_stats()`.
+  counters, tenancy preemption/resume counters, TTFT/queue-wait/
+  occupancy windows) behind `debug.serving_stats()`; per-tenant
+  `TenantStats` behind `TenantEngine.tenancy_summary()`.
 
 quant="a8w8": per-(layer, out-channel) int8 weights with dynamic
 per-row int8 activations — matmuls run int8xint8->int32 on the MXU
@@ -73,6 +85,8 @@ from .kv_tier import HostKVTier, restore_beats_recompute
 from .prefix_cache import PrefixCache
 from .scheduler import RaggedScheduler
 from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
+from .tenancy import (SLO_LATENCY, SLO_THROUGHPUT, TenantEngine,
+                      TenantScheduler, TenantStats, make_lora_bank)
 from .trace import (FlightRecorder, export_chrome_trace,
                     validate_chrome_trace)
 
@@ -81,4 +95,6 @@ __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "PrefixCache", "HostKVTier", "restore_beats_recompute",
            "MultiDecodeOut", "RaggedMultiOut",
            "RaggedScheduler", "FlightRecorder", "export_chrome_trace",
-           "validate_chrome_trace"]
+           "validate_chrome_trace",
+           "SLO_LATENCY", "SLO_THROUGHPUT", "TenantEngine",
+           "TenantScheduler", "TenantStats", "make_lora_bank"]

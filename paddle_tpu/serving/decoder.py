@@ -3,6 +3,7 @@ prefill / verify programs over page pools (see package docstring in
 `paddle_tpu/serving/__init__.py` for the architecture notes)."""
 import collections
 import functools
+import hashlib
 import weakref
 
 import jax
@@ -274,6 +275,29 @@ def _sample_tokens(logits, sampling, keys):
     return jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
 
 
+def _lora_delta(wl, y, aids):
+    """Per-token low-rank qkv delta over the SHARED base weights — the
+    multi-LoRA primitive (tenancy: dozens of fine-tuned variants batch
+    into one ragged horizon). `y` [T, h] are the flat post-ln1
+    activations (the qkv projection's input), `aids` [T] per-token
+    adapter ids (0 = base, an all-zero adapter), `wl["lora_A"]`
+    [n_a, h, r] / `wl["lora_B"]` [n_a, r, 3*H*D] this layer's stacked
+    adapter banks (alpha/r scaling folded into B at attach time).
+
+    The adapter is resolved by a per-TOKEN gather — exactly how the
+    packed layout resolves pages via `row_ids` — so each token's delta
+    is (y_t @ A_{a_t}) @ B_{a_t}: row-local math that never sees batch
+    composition. A mixed-adapter horizon therefore emits bit-identical
+    streams to per-adapter engines over the same bank (test-pinned),
+    and adapter 0's zero bank contributes an exact 0.0 to every
+    preactivation."""
+    A = wl["lora_A"][aids]                      # [T, h, r]
+    B = wl["lora_B"][aids]                      # [T, r, 3*H*D]
+    y32 = y.astype(jnp.float32)
+    z = jnp.einsum("th,thr->tr", y32, A)
+    return jnp.einsum("tr,trd->td", z, B)
+
+
 def _mm_heads(x, w, b, quant):
     """x [S, h] @ head-major qkv weight [h, 3, H, D] -> [S, 3, H, D]."""
     if not quant:
@@ -459,7 +483,98 @@ class PagedGPTDecoder:
         # swapping pool bytes under a live PrefixCache ledger would
         # silently orphan it
         self._engines = weakref.WeakSet()
+        # multi-LoRA (serving.tenancy): stacked low-rank adapter banks
+        # over the shared base weights, attached via attach_adapters.
+        # None = no adapters — every compiled program keeps its exact
+        # pre-tenancy signature and trace (the HLO regression pins).
+        self.lora = None
+        self.n_adapters = 0
+        self._adapter_salts = [b""]
         _LIVE_DECODERS.add(self)
+
+    # ---------------------------------------------------- multi-LoRA
+
+    def attach_adapters(self, adapters, alpha=None):
+        """Attach stacked low-rank (LoRA) adapter banks for multi-LoRA
+        serving: `adapters` is a list of per-adapter (A, B) pairs with
+        A [L, h, r] and B [L, r, 3, H, D] (or [L, r, 3*H*D]) — the
+        low-rank qkv delta of one fine-tuned variant over the SHARED
+        base weights. Adapter id 0 is reserved for the base model (an
+        exact all-zero bank); caller adapters are ids 1..n. Mixed
+        ranks zero-pad to the max (zero rows/cols contribute exact
+        0.0). `alpha` scales every delta by alpha/r, folded into B at
+        attach time (default: alpha == r, scale 1).
+
+        Rows gather the bank per TOKEN (`_lora_delta` — the packed
+        layout's row-id idiom applied to weights), so one ragged
+        horizon serves every variant through one compiled program; the
+        jit wrappers retrace automatically (the weights pytree gains
+        the bank leaves and an `aids` input). Per-adapter
+        `adapter_salt` fingerprints keep prefix-cache page sharing
+        sound across variants: pages never alias across differing
+        adapter banks (the MEM-PAGE-REFCOUNT ledger audit checks the
+        live engine's slot adapters)."""
+        cfg = self.cfg
+        L = cfg.num_layers
+        hd3 = 3 * cfg.num_heads * cfg.head_dim
+        h = cfg.hidden_size
+        ranks = []
+        pairs = []
+        for a, b in adapters:
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32).reshape(L, a.shape[-1], hd3)
+            if a.shape != (L, h, a.shape[-1]):
+                raise ValueError(
+                    f"adapter A must be [num_layers, hidden, r], got "
+                    f"{a.shape}")
+            ranks.append(a.shape[-1])
+            pairs.append((a, b))
+        R = max(ranks) if ranks else 1
+        n = len(pairs)
+        A = np.zeros((L, n + 1, h, R), np.float32)
+        B = np.zeros((L, n + 1, R, hd3), np.float32)
+        salts = [b""]
+        for i, ((a, b), r) in enumerate(zip(pairs, ranks), start=1):
+            scale = (float(alpha) / r) if alpha is not None else 1.0
+            A[:, i, :, :r] = a
+            B[:, i, :r, :] = b * scale
+            # CONTENT hash, not content sums: two structurally related
+            # fine-tunes (e.g. a row permutation) can share every sum,
+            # and colliding salts would alias their cache pages — the
+            # exact corruption the slot_adapters audit exists to catch
+            h = hashlib.blake2b(digest_size=16)
+            h.update(np.float32(scale).tobytes())
+            h.update(a.tobytes())
+            h.update(b.tobytes())
+            salts.append(h.digest())
+        self.lora = {"lora_A": jnp.asarray(A), "lora_B": jnp.asarray(B)}
+        self.n_adapters = n
+        self._adapter_salts = salts
+        return self
+
+    def adapter_salt(self, aid):
+        """Prefix-cache key salt of adapter `aid` (b"" for the base
+        model, id 0): KV bytes written under an adapter depend on its
+        bank, so chain keys must fold it in or pages would alias
+        across variants."""
+        return self._adapter_salts[int(aid)]
+
+    def _w(self):
+        """Weights pytree the compiled programs consume: the stacked
+        base weights, plus the LoRA banks when attached (the bank
+        leaves ride the per-layer lax.scan next to the base stacks;
+        `cache_fingerprint` keeps reading `self.weights` only — the
+        BASE identity — with adapters salted separately)."""
+        return {**self.weights, **self.lora} if self.lora else \
+            self.weights
+
+    def _aids_or_default(self, aids):
+        """[S] int32 adapter ids (None -> all base) — only consulted
+        when a bank is attached; without one the compiled programs
+        never see an aids input."""
+        if aids is None:
+            return np.zeros(self.max_batch, np.int32)
+        return np.asarray(aids, np.int32)
 
     def _probs_of(self, logits):
         """softmax over the decoder's sampling mask (the distribution its
@@ -535,13 +650,16 @@ class PagedGPTDecoder:
     # -- compiled programs -------------------------------------------------
 
     def _forward_tokens(self, weights, k_pages, v_pages, tokens, lens,
-                        table, pids, offs):
+                        table, pids, offs, aids=None):
         """Shared single-position forward over all slots: embed `tokens`
         at position `lens`, write K/V at (pids, offs) — callers route
         frozen slots' pids to the reserved scratch page — and attend
         over each slot's pages. Returns (logits [S, V], k_pages,
         v_pages). Both the per-tick step and every tick of the fused
-        multi-step scan run THIS body, so they cannot drift."""
+        multi-step scan run THIS body, so they cannot drift. `aids`
+        [S] selects each slot's LoRA adapter when a bank is attached
+        (None with no bank — the program shape is then exactly the
+        pre-tenancy one)."""
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.head_dim
         S = tokens.shape[0]
@@ -554,6 +672,9 @@ class PagedGPTDecoder:
             wl, kp, vp = wkv
             y = _ln(x, wl["ln1_w"], wl["ln1_b"])
             qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"], quant)  # [S,3,H,D]
+            if aids is not None:
+                qkv = qkv + _lora_delta(wl, y, aids).reshape(
+                    S, 3, H, D).astype(qkv.dtype)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
             kp = _kv_set(kp, pids, offs, k)
             vp = _kv_set(vp, pids, offs, v)
@@ -590,7 +711,7 @@ class PagedGPTDecoder:
             jax.random.fold_in(base, kid), p))(kids, pos)
 
     def _decode_step(self, weights, k_pages, v_pages, tokens, lens, table,
-                     kids):
+                     kids, aids=None):
         """tokens [S], lens [S] (tokens already counted, i.e. position of
         the incoming token), table [S, max_pages], kids [S] (sampling
         key ids, see _pos_keys) -> (next [S], logits [S, V], k_pages,
@@ -600,7 +721,8 @@ class PagedGPTDecoder:
                                    axis=1)[:, 0]                # [S]
         offs = lens % ps
         logits, k_pages, v_pages = self._forward_tokens(
-            weights, k_pages, v_pages, tokens, lens, table, pids, offs)
+            weights, k_pages, v_pages, tokens, lens, table, pids, offs,
+            aids=aids)
         keys = None
         if self.sampling is not None:
             keys = self._pos_keys(kids, lens)
@@ -608,8 +730,8 @@ class PagedGPTDecoder:
         return nxt, logits, k_pages, v_pages
 
     def _decode_multi_step(self, weights, k_pages, v_pages, tokens, lens,
-                           table, kids, done, remaining, eos, *, k,
-                           return_logits=False):
+                           table, kids, done, remaining, eos, aids=None,
+                           *, k, return_logits=False):
         """K fused decode ticks inside ONE compiled program (lax.scan):
         each tick's sampled token feeds the next tick on device, so the
         host syncs once per K tokens instead of once per token.
@@ -639,7 +761,8 @@ class PagedGPTDecoder:
             pids = jnp.where(done, scratch, pids)
             offs = lens % ps
             logits, kp, vp = self._forward_tokens(
-                weights, kp, vp, tokens, lens, table, pids, offs)
+                weights, kp, vp, tokens, lens, table, pids, offs,
+                aids=aids)
             keys = None
             if self.sampling is not None:
                 keys = self._pos_keys(kids, lens)
@@ -660,7 +783,7 @@ class PagedGPTDecoder:
             ret += (outs[2],)
         return ret
 
-    def _windowed_layer(self, pos, pids, offs, table):
+    def _windowed_layer(self, pos, pids, offs, table, aids=None):
         """ONE ragged-attention transformer layer shared by the verify
         window (`_verify_step`), the chunked prefill
         (`_prefill_suffix_step`) and every tick of the mixed ragged
@@ -681,8 +804,15 @@ class PagedGPTDecoder:
         def layer(x, wkv):
             wl, kp, vp = wkv
             y = _ln(x, wl["ln1_w"], wl["ln1_b"])
-            qkv = _mm_heads(y.reshape(n * W, -1), wl["qkv_w"],
+            yf = y.reshape(n * W, -1)
+            qkv = _mm_heads(yf, wl["qkv_w"],
                             wl["qkv_b"], quant).reshape(n, W, 3, H, D)
+            if aids is not None:
+                # every window token of row i wears row i's adapter
+                aid_tok = jnp.broadcast_to(
+                    aids[:, None], (n, W)).reshape(-1)
+                qkv = qkv + _lora_delta(wl, yf, aid_tok).reshape(
+                    n, W, 3, H, D).astype(qkv.dtype)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             kp = _kv_set(kp, pids, offs, k)
             vp = _kv_set(vp, pids, offs, v)
@@ -751,7 +881,7 @@ class PagedGPTDecoder:
         return np.asarray(out)
 
     def _ragged_forward(self, weights, k_pages, v_pages, ids, start,
-                        true_len, table, kids, frozen=None):
+                        true_len, table, kids, frozen=None, aids=None):
         """The shared RAGGED chunk forward: consume each row's [W]-wide
         window of new tokens at positions start..true_len-1, attending
         against the row's paged prefix. ids [n, W] window tokens
@@ -793,7 +923,7 @@ class PagedGPTDecoder:
         offs = pos % ps
 
         x, (k_pages, v_pages) = jax.lax.scan(
-            self._windowed_layer(pos, pids, offs, table), x,
+            self._windowed_layer(pos, pids, offs, table, aids=aids), x,
             (weights, k_pages, v_pages))
         x = _ln(x, self.ln_f_w, self.ln_f_b)
         last = jnp.take_along_axis(
@@ -811,7 +941,7 @@ class PagedGPTDecoder:
             k_pages, v_pages
 
     def _prefill_suffix_step(self, weights, k_pages, v_pages, ids, start,
-                             true_len, table, kids):
+                             true_len, table, kids, aids=None):
         """Chunked prefill: consume the UNCACHED suffix of each prompt
         in one forward, attending against the paged prefix (the
         prefix-cache mounts cached pages into `table` host-side; a
@@ -819,11 +949,12 @@ class PagedGPTDecoder:
         `_ragged_forward` — the same program shape as a decode tick,
         which is its W=1 special case."""
         return self._ragged_forward(weights, k_pages, v_pages, ids,
-                                    start, true_len, table, kids)
+                                    start, true_len, table, kids,
+                                    aids=aids)
 
     def _ragged_multi_step(self, weights, k_pages, v_pages, tokens, lens,
                            table, kids, done, remaining, eos, pend,
-                           pend_n, *, k, w):
+                           pend_n, aids=None, *, k, w):
         """K MIXED ragged ticks inside ONE compiled program: every tick
         serves decode rows and prefill-chunk rows together through the
         same `_ragged_forward` body (Ragged Paged Attention, arxiv
@@ -867,7 +998,7 @@ class PagedGPTDecoder:
             true = lens + new_len
             nxt, kp, vp = self._ragged_forward(
                 weights, kp, vp, ids, lens, true, table, kids,
-                frozen=done)
+                frozen=done, aids=aids)
             emit = ~done & (pend_n <= w)       # decode row, or the
             nxt = jnp.where(emit, nxt, tokens)  # chunk finishing prefill
             rem = jnp.where(emit, remaining - 1, remaining)
@@ -892,7 +1023,7 @@ class PagedGPTDecoder:
         return (outs[0], outs[1], outs[2], tokens, lens, done, remaining,
                 pend, pend_n, k_pages, v_pages)
 
-    def _packed_layer(self, rows, pos, pids, offs, table):
+    def _packed_layer(self, rows, pos, pids, offs, table, aids=None):
         """ONE transformer layer over the PACKED token stream: x is
         [T, h] flat new tokens (token t of batch row `rows[t]` at
         absolute position `pos[t]`); K/V writes land at (pids, offs) —
@@ -913,6 +1044,11 @@ class PagedGPTDecoder:
             y = _ln(x, wl["ln1_w"], wl["ln1_b"])
             qkv = _mm_heads(y, wl["qkv_w"], wl["qkv_b"],
                             quant)                       # [T, 3, H, D]
+            if aids is not None:
+                # per-token adapter resolution via the row id — the
+                # same idiom the packed attention uses for pages
+                qkv = qkv + _lora_delta(wl, y, aids[rows]).reshape(
+                    T, 3, H, D).astype(qkv.dtype)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
             kp = _kv_set(kp, pids, offs, k)
             vp = _kv_set(vp, pids, offs, v)
@@ -933,7 +1069,7 @@ class PagedGPTDecoder:
 
     def _packed_forward(self, weights, k_pages, v_pages, ptok, pos, rows,
                         write_ok, table, last_idx, sample_pos, kids,
-                        live):
+                        live, aids=None):
         """The shared PACKED forward: consume the flat token stream
         `ptok` [T] (token t = row `rows[t]`, position `pos[t]`),
         writing real tokens' K/V into the pages (`write_ok` [T] False
@@ -958,8 +1094,8 @@ class PagedGPTDecoder:
         offs = pos % ps
 
         x, (k_pages, v_pages) = jax.lax.scan(
-            self._packed_layer(rows, pos, pids, offs, table), x,
-            (weights, k_pages, v_pages))
+            self._packed_layer(rows, pos, pids, offs, table, aids=aids),
+            x, (weights, k_pages, v_pages))
         x = _ln(x, self.ln_f_w, self.ln_f_b)
         last = x[jnp.clip(last_idx, 0, x.shape[0] - 1)]   # [S, h]
         last = jnp.where(live[:, None], last, 0.0)
@@ -973,7 +1109,7 @@ class PagedGPTDecoder:
 
     def _packed_multi_step(self, weights, k_pages, v_pages, tokens, lens,
                            table, kids, done, remaining, eos, pend,
-                           pend_n, w, *, k, t):
+                           pend_n, w, aids=None, *, k, t):
         """K MIXED ticks over the PACKED [t] token stream — the
         tentpole layout (Ragged Paged Attention, arxiv 2604.15464): a
         tick's stream concatenates every live row's new tokens (decode
@@ -1029,7 +1165,7 @@ class PagedGPTDecoder:
             live = ~done & (nl > 0)
             nxt, kp, vp = self._packed_forward(
                 weights, kp, vp, ptok, pos, rows, write_ok, table,
-                last_idx, true - 1, kids, live)
+                last_idx, true - 1, kids, live, aids=aids)
             emit = ~done & (pend_n <= w)
             nxt = jnp.where(emit, nxt, tokens)
             rem = jnp.where(emit, remaining - 1, remaining)
@@ -1057,7 +1193,7 @@ class PagedGPTDecoder:
 
     def _prefill_packed_step(self, weights, k_pages, v_pages, ptok, pos,
                              rows, write_ok, table, last_idx, sample_pos,
-                             kids, live):
+                             kids, live, aids=None):
         """PACKED chunked prefill: one forward over the flat suffix
         stream of a whole admission batch — mixed suffix lengths share
         ONE compiled program per total-token bucket instead of one per
@@ -1066,7 +1202,8 @@ class PagedGPTDecoder:
         program family as the packed horizon tick."""
         return self._packed_forward(weights, k_pages, v_pages, ptok,
                                     pos, rows, write_ok, table,
-                                    last_idx, sample_pos, kids, live)
+                                    last_idx, sample_pos, kids, live,
+                                    aids=aids)
 
     # -- host-side API -----------------------------------------------------
 
@@ -1093,7 +1230,8 @@ class PagedGPTDecoder:
         return self.prefill_suffix_batch(
             [(ids, 0, pages) for ids, pages in requests], kids=kids)
 
-    def prefill_suffix_batch(self, requests, kids=None, packed=None):
+    def prefill_suffix_batch(self, requests, kids=None, packed=None,
+                             aids=None):
         """Chunked prefill over page-table rows (the prefix-cache
         admission path). requests: [(suffix_ids, start, pages), ...] —
         `pages` is the sequence's page list in block order (cached
@@ -1114,10 +1252,13 @@ class PagedGPTDecoder:
         if packed is None:
             packed = self.packed
         if packed:
-            return self._prefill_packed_batch(requests, kids=kids)
+            return self._prefill_packed_batch(requests, kids=kids,
+                                              aids=aids)
         results = [None] * len(requests)
         if kids is None:
             kids = list(range(len(requests)))
+        if aids is None:
+            aids = [0] * len(requests)
         if self._suffix_prefill is None:
             self._suffix_prefill = jax.jit(self._prefill_suffix_step,
                                            donate_argnums=(1, 2))
@@ -1140,6 +1281,7 @@ class PagedGPTDecoder:
                 tl = np.ones(nb, np.int32)
                 tbl = np.full((nb, MP), self.num_pages - 1, np.int32)
                 kd = np.zeros(nb, np.int32)
+                ad = np.zeros(nb, np.int32)
                 for r, (i, ids, start, pages) in enumerate(chunk):
                     pad[r, :len(ids)] = ids
                     st[r] = start
@@ -1147,17 +1289,21 @@ class PagedGPTDecoder:
                     k = min(len(pages), MP)
                     tbl[r, :k] = pages[:k]     # rest stays on scratch
                     kd[r] = kids[i]
+                    ad[r] = aids[i]
                 self._draws += 1
+                call = (jnp.asarray(pad), jnp.asarray(st),
+                        jnp.asarray(tl), jnp.asarray(tbl),
+                        jnp.asarray(kd))
+                if self.lora is not None:
+                    call += (jnp.asarray(ad),)
                 nxt, self.k_pages, self.v_pages = self._suffix_prefill(
-                    self.weights, self.k_pages, self.v_pages,
-                    jnp.asarray(pad), jnp.asarray(st), jnp.asarray(tl),
-                    jnp.asarray(tbl), jnp.asarray(kd))
+                    self._w(), self.k_pages, self.v_pages, *call)
                 nxt = np.asarray(nxt)
                 for r, (i, _, _, _) in enumerate(chunk):
                     results[i] = int(nxt[r])
         return results
 
-    def _prefill_packed_batch(self, requests, kids=None):
+    def _prefill_packed_batch(self, requests, kids=None, aids=None):
         """PACKED prefill dispatch (see `prefill_suffix_batch`): the
         layout — flat tokens, per-token row ids and positions — is
         built host-side (all lengths are known here), bucketed to a
@@ -1166,6 +1312,8 @@ class PagedGPTDecoder:
         results = [None] * len(requests)
         if kids is None:
             kids = list(range(len(requests)))
+        if aids is None:
+            aids = [0] * len(requests)
         S, MP, ps = self.max_batch, self.max_pages, self.page_size
         todo = list(enumerate(requests))
         while todo:
@@ -1181,6 +1329,7 @@ class PagedGPTDecoder:
             live = np.zeros(S, bool)
             tbl = np.full((S, MP), self.num_pages - 1, np.int32)
             kd = np.zeros(S, np.int32)
+            ad = np.zeros(S, np.int32)
             cur = 0
             for r, (i, (ids, start, pages)) in enumerate(chunk):
                 ids = np.asarray(ids, np.int32).reshape(-1)
@@ -1195,6 +1344,7 @@ class PagedGPTDecoder:
                 m = min(len(pages), MP)
                 tbl[r, :m] = pages[:m]       # rest stays on scratch
                 kd[r] = kids[i]
+                ad[r] = aids[i]
                 cur += n
             fn = self._packed_prefills.get(t)
             if fn is None:
@@ -1202,11 +1352,14 @@ class PagedGPTDecoder:
                              donate_argnums=(1, 2))
                 self._packed_prefills[t] = fn
             self._draws += 1
+            call = (jnp.asarray(ptok), jnp.asarray(pos),
+                    jnp.asarray(rows), jnp.asarray(ok), jnp.asarray(tbl),
+                    jnp.asarray(last_idx), jnp.asarray(spos),
+                    jnp.asarray(kd), jnp.asarray(live))
+            if self.lora is not None:
+                call += (jnp.asarray(ad),)
             nxt, self.k_pages, self.v_pages = fn(
-                self.weights, self.k_pages, self.v_pages,
-                jnp.asarray(ptok), jnp.asarray(pos), jnp.asarray(rows),
-                jnp.asarray(ok), jnp.asarray(tbl), jnp.asarray(last_idx),
-                jnp.asarray(spos), jnp.asarray(kd), jnp.asarray(live))
+                self._w(), self.k_pages, self.v_pages, *call)
             nxt = np.asarray(nxt)
             for r, (i, _) in enumerate(chunk):
                 results[i] = int(nxt[r])
@@ -1477,8 +1630,15 @@ class PagedGPTDecoder:
         from ..analysis.lowering import LoweredProgram, tree_arg_infos
 
         S = self.max_batch
+        W_ALL = self._w()        # adapter banks ride along when attached
         kids = jnp.arange(S, dtype=jnp.int32)
         table = jnp.zeros((S, self.max_pages), jnp.int32)
+        # with a LoRA bank attached, every traced program additionally
+        # takes the per-slot adapter ids (the gpt_decode_mt PROGRAM
+        # config traces the adapter-gather horizon through this)
+        aid_in = (jnp.zeros((S,), jnp.int32)
+                  if self.lora is not None else None)
+        aid_tail = () if aid_in is None else (aid_in,)
         if sum(map(bool, (k, prefix_w, ragged))) > 1:
             raise ValueError("pass only one of k=, prefix_w=, ragged=")
         if ragged:
@@ -1495,6 +1655,8 @@ class PagedGPTDecoder:
                       ("table", table), ("kids", kids), ("done", done),
                       ("remaining", remaining), ("eos", eos),
                       ("pend", pend), ("pend_n", pend_n)]
+            if aid_in is not None:
+                inputs.append(("aids", aid_in))
             if self.packed:
                 # the PACKED horizon program: t = the pow2 total-token
                 # bucket of one full-chunk prefill row riding next to
@@ -1506,19 +1668,19 @@ class PagedGPTDecoder:
                 fn = jax.jit(functools.partial(self._packed_multi_step,
                                                k=rk, t=t),
                              donate_argnums=(1, 2) if donate else ())
-                traced = fn.trace(self.weights, self.k_pages,
+                traced = fn.trace(W_ALL, self.k_pages,
                                   self.v_pages, tokens, lens, table,
                                   kids, done, remaining, eos, pend,
-                                  pend_n, w_in)
+                                  pend_n, w_in, *aid_tail)
                 name = f"ragged_packed_k{rk}_t{t}"
             else:
                 fn = jax.jit(functools.partial(self._ragged_multi_step,
                                                k=rk, w=rw),
                              donate_argnums=(1, 2) if donate else ())
-                traced = fn.trace(self.weights, self.k_pages,
+                traced = fn.trace(W_ALL, self.k_pages,
                                   self.v_pages, tokens, lens, table,
                                   kids, done, remaining, eos, pend,
-                                  pend_n)
+                                  pend_n, *aid_tail)
                 name = f"ragged_multi_k{rk}_w{rw}"
         elif prefix_w:
             W = int(prefix_w)
@@ -1538,11 +1700,14 @@ class PagedGPTDecoder:
                           ("write_ok", ok), ("table", table),
                           ("last_idx", last_idx), ("sample_pos", spos),
                           ("kids", kids), ("live", live)]
+                if aid_in is not None:
+                    inputs.append(("aids", aid_in))
                 fn = jax.jit(self._prefill_packed_step,
                              donate_argnums=(1, 2) if donate else ())
-                traced = fn.trace(self.weights, self.k_pages,
+                traced = fn.trace(W_ALL, self.k_pages,
                                   self.v_pages, ptok, pos, rows, ok,
-                                  table, last_idx, spos, kids, live)
+                                  table, last_idx, spos, kids, live,
+                                  *aid_tail)
                 name = f"prefill_packed_t{t}"
             else:
                 ids = jnp.zeros((S, W), jnp.int32)
@@ -1551,11 +1716,13 @@ class PagedGPTDecoder:
                 inputs = [("ids", ids), ("start", start),
                           ("true_len", true_len), ("table", table),
                           ("kids", kids)]
+                if aid_in is not None:
+                    inputs.append(("aids", aid_in))
                 fn = jax.jit(self._prefill_suffix_step,
                              donate_argnums=(1, 2) if donate else ())
-                traced = fn.trace(self.weights, self.k_pages,
+                traced = fn.trace(W_ALL, self.k_pages,
                                   self.v_pages, ids, start, true_len,
-                                  table, kids)
+                                  table, kids, *aid_tail)
                 name = f"prefill_suffix_w{W}"
         elif k:
             tokens = jnp.zeros((S,), jnp.int32)
@@ -1566,24 +1733,28 @@ class PagedGPTDecoder:
             inputs = [("tokens", tokens), ("lens", lens),
                       ("table", table), ("kids", kids), ("done", done),
                       ("remaining", remaining), ("eos", eos)]
+            if aid_in is not None:
+                inputs.append(("aids", aid_in))
             fn = jax.jit(functools.partial(self._decode_multi_step,
                                            k=int(k)),
                          donate_argnums=(1, 2) if donate else ())
-            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+            traced = fn.trace(W_ALL, self.k_pages, self.v_pages,
                               tokens, lens, table, kids, done, remaining,
-                              eos)
+                              eos, *aid_tail)
             name = f"decode_multi_k{int(k)}"
         else:
             tokens = jnp.zeros((S,), jnp.int32)
             lens = jnp.zeros((S,), jnp.int32)
             inputs = [("tokens", tokens), ("lens", lens),
                       ("table", table), ("kids", kids)]
+            if aid_in is not None:
+                inputs.append(("aids", aid_in))
             fn = jax.jit(self._decode_step,
                          donate_argnums=(1, 2) if donate else ())
-            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
-                              tokens, lens, table, kids)
+            traced = fn.trace(W_ALL, self.k_pages, self.v_pages,
+                              tokens, lens, table, kids, *aid_tail)
             name = "decode_step"
-        infos = tree_arg_infos(self.weights, "param")
+        infos = tree_arg_infos(W_ALL, "param")
         infos += tree_arg_infos(self.k_pages, "cache", prefix="k_pages",
                                 donated=donate)
         infos += tree_arg_infos(self.v_pages, "cache", prefix="v_pages",
@@ -1643,25 +1814,32 @@ class PagedGPTDecoder:
             return np.arange(self.max_batch, dtype=np.int32)
         return np.asarray(kids, np.int32)
 
-    def decode(self, tokens, lens, table, kids=None, return_probs=False):
+    def decode(self, tokens, lens, table, kids=None, return_probs=False,
+               aids=None):
         """One decode step for all slots (greedy, or the configured
         sampling with deterministic per-(seed, kid, position) keys —
         kid defaults to the slot index; the engine passes request ids
         so a request's draws are scheduling-independent).
         return_probs additionally yields the [S, V] distribution the
-        token was drawn from (speculative acceptance needs it)."""
+        token was drawn from (speculative acceptance needs it). `aids`
+        [S] selects per-slot LoRA adapters when a bank is attached
+        (`attach_adapters`); without one it must stay None."""
         self._draws += 1
-        nxt, logits, self.k_pages, self.v_pages = self._decode(
-            self.weights, self.k_pages, self.v_pages,
-            jnp.asarray(tokens, jnp.int32), jnp.asarray(lens, jnp.int32),
-            jnp.asarray(table, jnp.int32),
-            jnp.asarray(self._kids_or_default(kids)))
+        args = (self._w(), self.k_pages, self.v_pages,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(table, jnp.int32),
+                jnp.asarray(self._kids_or_default(kids)))
+        if self.lora is not None:
+            args += (jnp.asarray(self._aids_or_default(aids)),)
+        nxt, logits, self.k_pages, self.v_pages = self._decode(*args)
         if return_probs:
             return nxt, self._probs_of(logits)
         return nxt
 
     def decode_multi(self, tokens, lens, table, k, kids=None, done=None,
-                     remaining=None, eos=None, return_logits=False):
+                     remaining=None, eos=None, return_logits=False,
+                     aids=None):
         """Run `k` decode ticks device-resident: ONE dispatch, zero
         intermediate host syncs (see `_decode_multi_step`). Jitted per
         (k, return_logits); the engine buckets k to powers of two so
@@ -1692,14 +1870,17 @@ class PagedGPTDecoder:
         if remaining is None:
             remaining = np.full(S, np.iinfo(np.int32).max // 2, np.int32)
         self._draws += k             # dispatch telemetry, not key state
-        out = fn(self.weights, self.k_pages, self.v_pages,
-                 jnp.asarray(tokens, jnp.int32),
-                 jnp.asarray(lens, jnp.int32),
-                 jnp.asarray(table, jnp.int32),
-                 jnp.asarray(self._kids_or_default(kids)),
-                 jnp.asarray(done, bool),
-                 jnp.asarray(remaining, jnp.int32),
-                 jnp.asarray(-1 if eos is None else int(eos), jnp.int32))
+        args = (self._w(), self.k_pages, self.v_pages,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(table, jnp.int32),
+                jnp.asarray(self._kids_or_default(kids)),
+                jnp.asarray(done, bool),
+                jnp.asarray(remaining, jnp.int32),
+                jnp.asarray(-1 if eos is None else int(eos), jnp.int32))
+        if self.lora is not None:
+            args += (jnp.asarray(self._aids_or_default(aids)),)
+        out = fn(*args)
         self.k_pages, self.v_pages = out[6], out[7]
         return MultiDecodeOut(out[0], out[1], out[2], out[3], out[4],
                               out[5], out[8] if return_logits else None)
@@ -1713,7 +1894,7 @@ class PagedGPTDecoder:
 
     def ragged_multi(self, tokens, lens, table, k, w, pend, pend_n,
                      kids=None, done=None, remaining=None, eos=None,
-                     packed=None, t_tokens=None):
+                     packed=None, t_tokens=None, aids=None):
         """Run `k` MIXED ragged ticks device-resident: decode rows and
         prefill-chunk rows serve together, up to w suffix tokens per
         prefilling slot per tick, ONE dispatch, zero intermediate host
@@ -1773,8 +1954,10 @@ class PagedGPTDecoder:
                     functools.partial(self._packed_multi_step, k=k, t=t),
                     donate_argnums=(1, 2))
                 self._packeds[key] = fn
-            out = fn(self.weights, self.k_pages, self.v_pages,
-                     *args, jnp.asarray(w, jnp.int32))
+            call = args + (jnp.asarray(w, jnp.int32),)
+            if self.lora is not None:
+                call += (jnp.asarray(self._aids_or_default(aids)),)
+            out = fn(self._w(), self.k_pages, self.v_pages, *call)
         else:
             key = (k, w)
             fn = self._raggeds.get(key)
@@ -1783,6 +1966,9 @@ class PagedGPTDecoder:
                     functools.partial(self._ragged_multi_step, k=k, w=w),
                     donate_argnums=(1, 2))
                 self._raggeds[key] = fn
-            out = fn(self.weights, self.k_pages, self.v_pages, *args)
+            call = args
+            if self.lora is not None:
+                call += (jnp.asarray(self._aids_or_default(aids)),)
+            out = fn(self._w(), self.k_pages, self.v_pages, *call)
         self.k_pages, self.v_pages = out[9], out[10]
         return RaggedMultiOut(*out[:9])

@@ -91,6 +91,22 @@ class ContinuousBatchingEngine:
         self._kids = np.zeros(S, np.int32)   # request id per slot: the
         # sampling key id, so a request's draws are independent of
         # which slot/batch/schedule served it
+        self._aids = np.zeros(S, np.int32)   # LoRA adapter id per slot
+        # (multi-LoRA: only consulted when the decoder carries a bank)
+        self._rid_adapter = {}               # rid -> adapter id (!= 0)
+        # per-slot admission generation: a block dispatched for an
+        # earlier occupancy of the slot must never book-keep against a
+        # later one — the rid check alone can't tell them apart once
+        # preemption (serving.tenancy) lets the SAME rid re-occupy a
+        # slot whose stale block is still in flight
+        self._slot_gen = [0] * S
+        # rid -> output length at (re-)admission: the "first token of
+        # this admission" mark. 0 for fresh requests (so the base
+        # engine's behavior is unchanged); a preempted request resumes
+        # with its generated prefix already in _outputs, and its first
+        # post-resume token must NOT restamp TTFT or republish from
+        # scratch
+        self._emit_base = {}
         self._table_cache = None             # rebuilt on admit/retire only
         self._queue = []                     # (req_id, ids)
         self._outputs = {}                   # req_id -> [generated ids]
@@ -474,7 +490,12 @@ class ContinuousBatchingEngine:
         s, self._restore_s_pending = self._restore_s_pending, 0.0
         return s
 
-    def submit(self, prompt_ids):
+    def submit(self, prompt_ids, adapter=None):
+        """Queue one prompt; returns its request id. `adapter` selects
+        a LoRA variant by id (1..n over an attached bank,
+        `PagedGPTDecoder.attach_adapters`; 0/None = base weights) —
+        requests of DIFFERENT adapters batch into the same ragged
+        horizons, resolved per token on device."""
         ids = [int(t) for t in np.asarray(
             prompt_ids._value if isinstance(prompt_ids, Tensor)
             else prompt_ids).reshape(-1)]
@@ -483,6 +504,7 @@ class ContinuousBatchingEngine:
                 "prompt must contain at least one token (prefill "
                 "samples the first generated token after the prompt's "
                 "last position — an empty prompt has none)")
+        aid = self._check_adapter(adapter)
         total = len(ids) + self.max_new
         need = self._pages_for(total)
         if need > min(self.d.max_pages, self.d.num_pages - 1):
@@ -496,25 +518,61 @@ class ContinuousBatchingEngine:
                 f"exceeds the model's max_seq_len "
                 f"{self.d.cfg.max_seq_len} (positions past it have no "
                 "embedding)")
-        return self._register_request(ids)
+        return self._register_request(ids, adapter=aid)
 
-    def _register_request(self, ids):
+    def _check_adapter(self, adapter):
+        aid = int(adapter or 0)
+        if aid and self.d.lora is None:
+            raise ValueError(
+                f"adapter {aid} requested but the decoder carries no "
+                "LoRA bank — attach one with "
+                "PagedGPTDecoder.attach_adapters")
+        if aid < 0 or aid > self.d.n_adapters:
+            raise ValueError(
+                f"adapter id {aid} out of range: the attached bank "
+                f"serves ids 0 (base) .. {self.d.n_adapters}")
+        return aid
+
+    def _register_request(self, ids, adapter=0, trace_fields=None):
         """Queue a VALIDATED request: rid allocation, queue-wait stamp,
         stats — one implementation for both engines' submit()s, and
         called only after validation so a rejected submission can't
-        skew stats.requests or leak a _submit_t entry."""
+        skew stats.requests or leak a _submit_t entry. `trace_fields`
+        ride into the trace's submit record (the tenancy engine stamps
+        tenant/slo there — the chrome exporter groups spans by it)."""
         rid = self._next_id
         self._next_id += 1
         self._submit_t[rid] = time.perf_counter()
         self.stats.requests += 1
+        if adapter:
+            self._rid_adapter[rid] = adapter
         self._queue.append((rid, ids))
         if self.trace is not None:
             self.trace.record("submit", ts=self._submit_t[rid], rid=rid,
-                              prompt_tokens=len(ids))
+                              prompt_tokens=len(ids),
+                              **(trace_fields or {}))
         return rid
+
+    def _request_max_new(self, rid):
+        """Tokens this request may still emit, for admission-time page
+        budgeting. A FRESH request may emit max_new; a resumed
+        (previously preempted) one already banked len(outputs) of
+        them, so its resume prompt (original + generated prefix) plus
+        the remainder needs exactly the original page total."""
+        return self.max_new - len(self._outputs.get(rid, ()))
 
     def _pages_for(self, n_tokens):
         return (n_tokens + self.d.page_size - 1) // self.d.page_size
+
+    def _note_queue_wait(self, rid, dt):
+        """Queue-wait stamp hook (submit -> admit); the tenancy engine
+        additionally banks it per tenant."""
+        self.stats.queue_wait_s.append(dt)
+
+    def _note_ttft(self, rid, dt):
+        """TTFT stamp hook (submit -> first token); the tenancy engine
+        additionally banks it per tenant."""
+        self.stats.ttft_s.append(dt)
 
     def _note_resident(self):
         """Update stats.max_resident_slots from the ONE definition of
@@ -540,7 +598,7 @@ class ContinuousBatchingEngine:
         for _, rid, _, _ in admitted:
             t0 = self._submit_t.get(rid)
             if t0 is not None:
-                self.stats.queue_wait_s.append(now - t0)
+                self._note_queue_wait(rid, now - t0)
         if self.trace is not None:
             self._trace_admits(admitted, now)
         self._table_cache = None
@@ -565,7 +623,7 @@ class ContinuousBatchingEngine:
             # comparable numbers)
             t0 = self._submit_t.pop(rid, None)
             if t0 is not None:
-                self.stats.ttft_s.append(done_t - t0)
+                self._note_ttft(rid, done_t - t0)
             self._outputs[rid] = [first]
             if self.trace is not None:
                 self.trace.record("first_token", ts=done_t, rid=rid)
@@ -595,14 +653,18 @@ class ContinuousBatchingEngine:
             return self.d.prefill_suffix_batch(
                 [(ids, 0, pages) for _, _, ids, pages in admitted],
                 kids=[rid for _, rid, _, _ in admitted],
-                packed=self.packed)
+                packed=self.packed,
+                aids=[self._rid_adapter.get(rid, 0)
+                      for _, rid, _, _ in admitted])
         reqs = []
         for _, rid, ids, pages in admitted:
             start = self._cache_meta[rid][0]
             reqs.append((ids[start:], start, pages))
         firsts = self.d.prefill_suffix_batch(
             reqs, kids=[rid for _, rid, _, _ in admitted],
-            packed=self.packed)
+            packed=self.packed,
+            aids=[self._rid_adapter.get(rid, 0)
+                  for _, rid, _, _ in admitted])
         for slot, rid, ids, pages in admitted:
             self._publish_blocks(rid, slot)
         return firsts
@@ -640,19 +702,51 @@ class ContinuousBatchingEngine:
         if self.cache is not None:
             return self._gather_admissions_cached()
         admitted = []
+        blocked = False
         for slot in range(self.d.max_batch):
+            if blocked:
+                break
             if self._slot_req[slot] is not None or not self._queue:
                 continue
-            rid, ids = self._queue[0]
-            need = self._pages_for(len(ids) + self.max_new)
-            if need > len(self._free) or need > self.d.max_pages:
-                break                        # head-of-line: wait for pages
-            self._queue.pop(0)
-            pages = [self._free.pop() for _ in range(need)]
-            self._slot_req[slot] = rid
-            self._slot_pages[slot] = pages
-            admitted.append((slot, rid, ids, pages))
+            while True:
+                rid, ids = self._queue[0]
+                need = self._pages_for(len(ids) +
+                                       self._request_max_new(rid))
+                if need > self.d.max_pages:
+                    blocked = True           # permanently oversized head
+                    break
+                if need > len(self._free):
+                    if self._admission_blocked(rid, need):
+                        blocked = True       # head-of-line: wait
+                        break
+                    # tenancy made room (a victim's pages freed):
+                    # replan THIS slot — advancing would strand the
+                    # latency head un-admitted for a whole horizon
+                    # after its victim was already interrupted
+                    continue
+                self._queue.pop(0)
+                pages = [self._free.pop() for _ in range(need)]
+                self._occupy(slot, rid)
+                self._slot_pages[slot] = pages
+                admitted.append((slot, rid, ids, pages))
+                break
         return admitted
+
+    def _occupy(self, slot, rid):
+        """Bind `rid` to `slot` (both admission paths): the request id,
+        its adapter id for the dispatch-side aids row, and the slot
+        generation stamp the stale-block check compares."""
+        self._slot_req[slot] = rid
+        self._aids[slot] = self._rid_adapter.get(rid, 0)
+        self._slot_gen[slot] += 1
+
+    def _admission_blocked(self, rid, need):
+        """The queue head can't get its pages: True = wait (the base
+        head-of-line discipline). The tenancy engine overrides this
+        with preemption by page-spill — parking a throughput victim's
+        KV in the prefix cache frees/parks enough pages that the
+        admission can replan (return False)."""
+        return True
 
     def _gather_admissions_cached(self):
         """Prefix-cache admission: hash the prompt's full blocks, mount
@@ -677,107 +771,121 @@ class ContinuousBatchingEngine:
         stacked D2H per wave), so pressure demotes instead of
         destroys."""
         admitted = []
+        blocked = False
         ps = self.d.page_size
         tok_bytes = self.d.kv_page_bytes // ps
         for slot in range(self.d.max_batch):
+            if blocked:
+                break
             if self._slot_req[slot] is not None or not self._queue:
                 continue
-            rid, ids = self._queue[0]
-            L = len(ids)
-            total = self._pages_for(L + self.max_new)
-            if total > self.d.max_pages:
-                break
-            keys = self.cache.block_keys(ids)
-            hits = self.cache.match(keys)
-            n_dev = len(hits)
-            n_tier, do_restore, hold = self._tier_plan(keys, n_dev)
-            span = n_dev + (n_tier if do_restore else 0)
-            # pick the largest mounted span the pool can cover: mounted
-            # hit pages are excluded from eviction, so on a tight pool
-            # a full-span mount can be self-blocking (the parked hit
-            # pages ARE the reclaimable ones — e.g. a full-prompt hit
-            # whose CoW page cannot be allocated). Degrading the span
-            # turns the excess hits back into evictable parked pages,
-            # so any request the cache-less engine could admit
-            # eventually admits here too (n_hit=0 needs exactly the
-            # cache-less page count). Restored blocks degrade FIRST
-            # (deepest-span-off): they are the ones that COST free
-            # pages.
-            chosen = None
-            for n_hit in range(span, -1, -1):
-                start = n_hit * ps
-                # full hit: re-consume the last token (n_hit > 0 guard:
-                # an EMPTY prompt trivially satisfies start >= L with
-                # nothing mounted — it prefills like any other miss)
-                cow = n_hit > 0 and start >= L
-                if cow:
-                    start = L - 1
-                n_rest = max(0, n_hit - n_dev)
-                need_new = total - n_hit + (1 if cow else 0) + n_rest
-                if need_new <= len(self._free) + self.cache.evictable(
-                        exclude=keys[:n_hit]):
-                    chosen = (n_hit, start, cow, need_new, n_rest)
+            while True:
+                rid, ids = self._queue[0]
+                L = len(ids)
+                total = self._pages_for(L + self._request_max_new(rid))
+                if total > self.d.max_pages:
+                    blocked = True       # permanently oversized head
                     break
-            if chosen is None:
-                break                    # head-of-line: wait for pages
-            n_hit, start, cow, need_new, n_rest = chosen
-            hits = hits[:n_hit - n_rest]
-            self._queue.pop(0)
-            if n_tier:
-                # recompute-decided host blocks — plus any restore
-                # span DEGRADED away by the head-of-line loop — are
-                # re-prefilled: count + recency-refresh them (only now
-                # that the admission commits)
-                lo = max(n_hit, n_dev)
-                n_recomp = n_dev + n_tier - lo
-                if n_recomp:
-                    self._tier_recompute(keys, lo, n_recomp)
-            self.cache.mount(keys[:len(hits)])
-            if len(self._free) < need_new:
-                freed = self._spill_wave(need_new - len(self._free))
-                self.stats.prefix_evictions += len(freed)
-                self._free.extend(freed)
-            privates = [self._free.pop() for _ in range(need_new)]
-            keys_meta = keys
-            inserted = {}
-            if n_rest:
-                rest_pages = [privates.pop() for _ in range(n_rest)]
-                inserted = dict(self._tier_restore(
-                    keys, len(hits), rest_pages, hold, rid))
-                if not all(inserted.values()):
-                    # a capacity-refused restore insert breaks the held
-                    # chain: publishing deeper blocks would chain under
-                    # an unheld parent (the eviction-cascade invariant)
-                    # — stop publishing for this request entirely
-                    keys_meta = keys[:len(hits)]
-                hits = hits + rest_pages
-            shared = list(hits)
-            shared_set = set(shared[:n_hit - n_rest]) | \
-                {p for p, ok in inserted.items() if ok}
-            if cow:
-                last = shared[-1]
-                if last in shared_set:
-                    dst = privates.pop()
-                    self.d.copy_page(last, dst)
-                    self.cache.release_page(last)
-                    self.stats.prefix_cow += 1
-                    shared_set.discard(last)
-                    shared[-1] = dst
-                else:
-                    # the final block is a restore whose cache insert
-                    # was refused: the page is ALREADY private — no
-                    # copy needed, return the spare CoW page
-                    self._free.append(privates.pop())
-            pages = shared + privates    # block order: prefix first
-            self._slot_req[slot] = rid
-            self._slot_pages[slot] = pages
-            self._slot_shared[slot] = shared_set
-            self._cache_meta[rid] = (start, keys_meta, n_hit)
-            self.stats.prefix_hits += n_hit
-            self.stats.prefix_misses += len(keys) - n_hit
-            self.stats.prefix_tokens_saved += start
-            self.stats.prefix_bytes_saved += start * tok_bytes
-            admitted.append((slot, rid, ids, pages))
+                keys = self.cache.block_keys(
+                    ids, extra_salt=self.d.adapter_salt(
+                        self._rid_adapter.get(rid, 0)))
+                hits = self.cache.match(keys)
+                n_dev = len(hits)
+                n_tier, do_restore, hold = self._tier_plan(keys, n_dev)
+                span = n_dev + (n_tier if do_restore else 0)
+                # pick the largest mounted span the pool can cover: mounted
+                # hit pages are excluded from eviction, so on a tight pool
+                # a full-span mount can be self-blocking (the parked hit
+                # pages ARE the reclaimable ones — e.g. a full-prompt hit
+                # whose CoW page cannot be allocated). Degrading the span
+                # turns the excess hits back into evictable parked pages,
+                # so any request the cache-less engine could admit
+                # eventually admits here too (n_hit=0 needs exactly the
+                # cache-less page count). Restored blocks degrade FIRST
+                # (deepest-span-off): they are the ones that COST free
+                # pages.
+                chosen = None
+                for n_hit in range(span, -1, -1):
+                    start = n_hit * ps
+                    # full hit: re-consume the last token (n_hit > 0 guard:
+                    # an EMPTY prompt trivially satisfies start >= L with
+                    # nothing mounted — it prefills like any other miss)
+                    cow = n_hit > 0 and start >= L
+                    if cow:
+                        start = L - 1
+                    n_rest = max(0, n_hit - n_dev)
+                    need_new = total - n_hit + (1 if cow else 0) + n_rest
+                    if need_new <= len(self._free) + self.cache.evictable(
+                            exclude=keys[:n_hit]):
+                        chosen = (n_hit, start, cow, need_new, n_rest)
+                        break
+                if chosen is None:
+                    if self._admission_blocked(rid, total):
+                        blocked = True   # head-of-line: wait for pages
+                        break
+                    # tenancy made room (a victim's pages parked/
+                    # freed): replan THIS slot — the cache contents
+                    # changed, so keys re-match from scratch
+                    continue
+                n_hit, start, cow, need_new, n_rest = chosen
+                hits = hits[:n_hit - n_rest]
+                self._queue.pop(0)
+                if n_tier:
+                    # recompute-decided host blocks — plus any restore
+                    # span DEGRADED away by the head-of-line loop — are
+                    # re-prefilled: count + recency-refresh them (only now
+                    # that the admission commits)
+                    lo = max(n_hit, n_dev)
+                    n_recomp = n_dev + n_tier - lo
+                    if n_recomp:
+                        self._tier_recompute(keys, lo, n_recomp)
+                self.cache.mount(keys[:len(hits)])
+                if len(self._free) < need_new:
+                    freed = self._spill_wave(need_new - len(self._free))
+                    self.stats.prefix_evictions += len(freed)
+                    self._free.extend(freed)
+                privates = [self._free.pop() for _ in range(need_new)]
+                keys_meta = keys
+                inserted = {}
+                if n_rest:
+                    rest_pages = [privates.pop() for _ in range(n_rest)]
+                    inserted = dict(self._tier_restore(
+                        keys, len(hits), rest_pages, hold, rid))
+                    if not all(inserted.values()):
+                        # a capacity-refused restore insert breaks the held
+                        # chain: publishing deeper blocks would chain under
+                        # an unheld parent (the eviction-cascade invariant)
+                        # — stop publishing for this request entirely
+                        keys_meta = keys[:len(hits)]
+                    hits = hits + rest_pages
+                shared = list(hits)
+                shared_set = set(shared[:n_hit - n_rest]) | \
+                    {p for p, ok in inserted.items() if ok}
+                if cow:
+                    last = shared[-1]
+                    if last in shared_set:
+                        dst = privates.pop()
+                        self.d.copy_page(last, dst)
+                        self.cache.release_page(last)
+                        self.stats.prefix_cow += 1
+                        shared_set.discard(last)
+                        shared[-1] = dst
+                    else:
+                        # the final block is a restore whose cache insert
+                        # was refused: the page is ALREADY private — no
+                        # copy needed, return the spare CoW page
+                        self._free.append(privates.pop())
+                pages = shared + privates    # block order: prefix first
+                self._occupy(slot, rid)
+                self._slot_pages[slot] = pages
+                self._slot_shared[slot] = shared_set
+                self._cache_meta[rid] = (start, keys_meta, n_hit)
+                self.stats.prefix_hits += n_hit
+                self.stats.prefix_misses += len(keys) - n_hit
+                self.stats.prefix_tokens_saved += start
+                self.stats.prefix_bytes_saved += start * tok_bytes
+                admitted.append((slot, rid, ids, pages))
+                break
         return admitted
 
     def _extra_prefill(self, admitted):
@@ -802,16 +910,28 @@ class ContinuousBatchingEngine:
                 self.cache.release_page(pid)
             else:
                 self._free.append(pid)
+        rid = self._slot_req[slot]
+        self._rid_adapter.pop(rid, None)
+        self._emit_base.pop(rid, None)
+        self._release_slot(slot)
+        self.stats.completed += 1
+
+    def _release_slot(self, slot):
+        """Clear every per-slot field — retirement AND preemption
+        (tenancy) share this one sequence, so a field added for one
+        can never go stale under the other (the generation bump, the
+        adapter id and the scheduler retire all ride here)."""
         self._slot_shared[slot] = set()
         self._slot_req[slot] = None
         self._slot_pages[slot] = []
+        self._slot_gen[slot] += 1
         self._lens[slot] = 0
         self._tokens[slot] = 0
+        self._aids[slot] = 0
         self._prompt_len[slot] = 0
         if self.scheduler is not None:
             self.scheduler.retire(slot)
         self._table_cache = None
-        self.stats.completed += 1
 
     def page_ledger(self):
         """Auditable snapshot of page ownership: every allocatable page
@@ -830,6 +950,17 @@ class ContinuousBatchingEngine:
             "shared": {s: sorted(sh)
                        for s, sh in enumerate(self._slot_shared) if sh},
             "cache": self.cache.ledger() if self.cache else {},
+            # multi-LoRA rows: each occupied slot's adapter id plus its
+            # cache-key salt (hex) — the audit's cross-variant aliasing
+            # check: a page shared by slots whose salts differ would
+            # mean one variant reads another's KV bytes
+            "slot_adapters": {
+                s: {"adapter": int(self._aids[s]),
+                    "salt": self.d.adapter_salt(
+                        int(self._aids[s])).hex()}
+                for s in range(self.d.max_batch)
+                if self._slot_req[s] is not None
+            } if self.d.lora is not None else {},
             # host-tier rows (tiered KV): spilled entries by chain key,
             # with the device-twin backref of restored entries — the
             # audit cross-checks a twin against the free list (a key
@@ -877,7 +1008,8 @@ class ContinuousBatchingEngine:
             self._table_cache = self._table(self._slot_pages, self.d)
         nxt = np.asarray(self.d.decode(self._tokens, self._lens,
                                        self._table_cache,
-                                       kids=self._kids))
+                                       kids=self._kids,
+                                       aids=self._aids))
         self.steps += 1
         self.stats.ticks += 1
         self.stats.decode_syncs += 1
@@ -1140,7 +1272,7 @@ class ContinuousBatchingEngine:
                 out = self.d.decode_multi(
                     tokens_d, lens_d, self._table_cache, k,
                     kids=self._kids, done=done_d, remaining=rem_d,
-                    eos=self.eos)
+                    eos=self.eos, aids=self._aids)
                 carry = (out.tokens, out.lens, out.done, out.remaining)
                 self.steps += k
                 self.stats.ticks += k
@@ -1197,7 +1329,7 @@ class ContinuousBatchingEngine:
         for _, rid, _, _ in admitted:
             t0 = self._submit_t.get(rid)
             if t0 is not None:
-                self.stats.queue_wait_s.append(now - t0)
+                self._note_queue_wait(rid, now - t0)
         if self.trace is not None:
             self._trace_admits(admitted, now)
         self._table_cache = None
@@ -1206,7 +1338,9 @@ class ContinuousBatchingEngine:
             start = self._cache_meta[rid][0] if self.cache is not None \
                 else 0
             suffix = ids[start:]
-            self._outputs[rid] = []
+            # setdefault: a RESUMED request (tenancy preemption) keeps
+            # its generated prefix — the continuation appends to it
+            self._outputs.setdefault(rid, [])
             self._lens[slot] = start
             self._tokens[slot] = 0
             self._kids[slot] = rid
@@ -1225,7 +1359,7 @@ class ContinuousBatchingEngine:
         writes are device-ordered before any future mount's reads)."""
         t0 = self._submit_t.pop(rid, None)
         if t0 is not None:
-            self.stats.ttft_s.append(time.perf_counter() - t0)
+            self._note_ttft(rid, time.perf_counter() - t0)
         if self.trace is not None:
             self.trace.record("first_token", rid=rid)
         self._publish_blocks(rid, slot)
@@ -1296,22 +1430,30 @@ class ContinuousBatchingEngine:
         self.stats.tokens_padded += pad_toks
         self.stats.decode_syncs += 1
         n_emitted = 0
-        for s, rid in rids.items():
-            if self._slot_req[s] != rid:
+        for s, (rid, gen) in rids.items():
+            if self._slot_req[s] != rid or self._slot_gen[s] != gen:
                 # stale block of a retired/re-admitted slot: its emit
                 # ticks were already DISCARDED by the inflight reset at
                 # re-admission — subtracting them again would understate
                 # the new request's in-flight emissions, and unlike
                 # _run_multi's harmless scheduling slack, here inflight
                 # feeds _table_width's correctness-critical position
-                # bound
+                # bound. The GENERATION stamp matters beyond the rid:
+                # preemption (tenancy) can resume the SAME rid into the
+                # same slot while its pre-preemption block is still in
+                # flight — those tokens are regenerated post-resume and
+                # must not double-append
                 continue
             inflight[s] = max(0, inflight[s] - emit_ticks.get(s, 0))
             for j in range(k):
                 if not emitted[j, s]:
                     continue
                 tok = int(block[j, s])
-                if not self._outputs[rid]:
+                if len(self._outputs[rid]) == self._emit_base.get(rid, 0):
+                    # first token of THIS admission: TTFT (fresh
+                    # requests only — a resume's _submit_t is long
+                    # popped), cache publishing, the lens jump to the
+                    # admitted prompt length
                     self._first_token(rid, s)
                 else:
                     self._lens[s] += 1
@@ -1367,9 +1509,14 @@ class ContinuousBatchingEngine:
             else:
                 # NOT host _lens: it lags at the cached start until the
                 # first token is PROCESSED, while the device may already
-                # sit at prompt_len + in-flight emissions
+                # sit at prompt_len + in-flight emissions. Outputs are
+                # counted from this ADMISSION's base: a resumed
+                # request's pre-preemption tokens are already inside
+                # _prompt_len (they are the resume prompt's tail) and
+                # must not widen the bound twice
                 pos = (self._prompt_len[s]
                        + len(self._outputs.get(rid, ()))
+                       - self._emit_base.get(rid, 0)
                        + inflight[s] + plan.k + 2)
             bound = max(bound, pos)
         need = min(self.d.max_pages, (bound + ps - 1) // ps + 1)
@@ -1434,7 +1581,8 @@ class ContinuousBatchingEngine:
                     tokens_d, lens_d, self._table_cache[:, :width],
                     plan.k, plan.w, pend_d, pend_n_d, kids=self._kids,
                     done=done_d, remaining=rem_d, eos=self.eos,
-                    packed=self.packed, t_tokens=t_tokens)
+                    packed=self.packed, t_tokens=t_tokens,
+                    aids=self._aids)
                 carry = (out.tokens, out.lens, out.done, out.remaining,
                          out.pend, out.pend_n)
                 self.steps += plan.k
@@ -1455,8 +1603,10 @@ class ContinuousBatchingEngine:
                      "decode_rows": len(live) - plan.prefill_rows,
                      "prefill_rows": plan.prefill_rows})
                 meta = (out.tokens_block, out.emitted, out.real,
-                        disp_toks, plan.k, dict(live), plan.emit_ticks,
-                        t0)
+                        disp_toks, plan.k,
+                        {s: (rid, self._slot_gen[s])
+                         for s, rid in live.items()},
+                        plan.emit_ticks, t0)
                 # tiered-KV: restores dispatched at this round's
                 # admission are functionally ordered before this
                 # horizon's reads — their priced H2D belongs to this
@@ -1526,6 +1676,13 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         if draft_decoder.max_batch != decoder.max_batch or \
                 draft_decoder.page_size != decoder.page_size:
             raise ValueError("draft/target max_batch and page_size must match")
+        if decoder.lora is not None or draft_decoder.lora is not None:
+            # verify() runs the base weights only — silently serving a
+            # LoRA request through it would emit base-model tokens
+            raise ValueError(
+                "SpeculativeEngine does not support LoRA adapter banks "
+                "(attach_adapters): the verify window does not gather "
+                "adapters — use ContinuousBatchingEngine/TenantEngine")
         if decoder.kv_quant or draft_decoder.kv_quant:
             # out of scope for the int8 pool (docs/serving.md): verify
             # windows write up to k positions past the accepted length,
@@ -1593,7 +1750,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             self._queue.pop(0)
             pages = [self._free.pop() for _ in range(need)]
             dpages = [self._draft_free.pop() for _ in range(need)]
-            self._slot_req[slot] = rid
+            self._occupy(slot, rid)
             self._slot_pages[slot] = pages
             self._draft_pages[slot] = dpages
             admitted.append((slot, rid, ids, pages))

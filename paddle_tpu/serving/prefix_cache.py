@@ -87,13 +87,18 @@ class PrefixCache:
 
     # ------------------------------------------------------------ keys
 
-    def block_keys(self, tokens):
+    def block_keys(self, tokens, extra_salt=b""):
         """Chain keys of every FULL `page_size`-token block of `tokens`
         (a trailing partial block is never cacheable — its page will
-        keep growing)."""
+        keep growing). `extra_salt` folds a per-REQUEST identity into
+        the root key on top of the cache's decoder salt — the
+        multi-LoRA engine passes the request's adapter fingerprint
+        (`PagedGPTDecoder.adapter_salt`), so two variants' KV pages
+        never alias even when their token prefixes match (the bytes
+        differ: the adapter's low-rank delta is part of the write)."""
         toks = np.asarray(tokens, np.int32).reshape(-1)
         n = len(toks) // self.page_size
-        keys, prev = [], self.salt
+        keys, prev = [], self.salt + extra_salt
         for b in range(n):
             block = toks[b * self.page_size:(b + 1) * self.page_size]
             h = hashlib.blake2b(digest_size=16)

@@ -148,14 +148,11 @@ class RaggedScheduler:
 
     # ---------------------------------------------------------- policy
 
-    def plan(self, live, budgets, inflight):
-        """Plan one horizon. `live` maps slot -> rid for occupied
-        slots, `budgets` slot -> tokens the slot may still emit (host
-        view, excluding in-flight emissions — see the engine's
-        `_budget_left`), `inflight` per-slot in-flight EMISSION ticks.
-        Returns a HorizonPlan, or None when no slot can make progress
-        (everything emittable is already in flight). Consumes the
-        planned chunk spans from the per-slot accounting.
+    def _compose(self, live):
+        """(w, k_limit) of the next horizon — the COMPOSITION half of
+        `plan`, split out so class-aware schedulers
+        (`tenancy.TenantScheduler`) can re-price it per SLO class
+        without touching the budget/inflight accounting below.
 
         Width policy: a mixed horizon's w is the smallest power of two
         covering the longest pending suffix, capped at the priced
@@ -177,6 +174,19 @@ class RaggedScheduler:
         else:
             w = 1
             k_limit = self.k_max
+        return w, k_limit
+
+    def plan(self, live, budgets, inflight):
+        """Plan one horizon. `live` maps slot -> rid for occupied
+        slots, `budgets` slot -> tokens the slot may still emit (host
+        view, excluding in-flight emissions — see the engine's
+        `_budget_left`), `inflight` per-slot in-flight EMISSION ticks.
+        Returns a HorizonPlan, or None when no slot can make progress
+        (everything emittable is already in flight). Consumes the
+        planned chunk spans from the per-slot accounting. Composition
+        (w, k_limit) comes from `_compose` — see its docstring for the
+        width/length policy; class-aware schedulers override it."""
+        w, k_limit = self._compose(live)
         avail = {}
         for s in live:
             # useful ticks = non-emitting chunk ticks + emittable ticks

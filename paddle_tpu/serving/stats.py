@@ -96,6 +96,15 @@ class ServeStats:
     tier_restores: int = 0       # host blocks re-mounted via H2D
     tier_recomputes: int = 0     # host blocks re-prefilled (wire lost)
     host_tier_bytes: int = 0     # current host-tier residency (gauge)
+    # tenancy ledger (serving.tenancy.TenantEngine): preemption by
+    # page-spill. A preemption parks the victim's full KV blocks in
+    # the prefix cache (whence pool pressure spills them through the
+    # host tier) and requeues the request; a resume re-admits it with
+    # its generated prefix as prompt — streams stay byte-identical
+    # preempt-on vs preempt-off (the (request, position) write-time
+    # discipline; fuzz-pinned in tests/test_tenancy.py).
+    preemptions: int = 0         # victims preempted by page-spill
+    resumes: int = 0             # preempted requests re-admitted
     # capacity ledger (set once at engine construction from the
     # decoder's pool layout; scale-plane metadata included for int8
     # pools): the observable side of the KV-quant capacity claim —
@@ -166,6 +175,9 @@ class ServeStats:
             d["tier_restores"] = self.tier_restores
             d["tier_recomputes"] = self.tier_recomputes
             d["host_tier_bytes"] = self.host_tier_bytes
+        if self.preemptions or self.resumes:
+            d["preemptions"] = self.preemptions
+            d["resumes"] = self.resumes
         if self.kv_pool_bytes:
             d["kv_pool_bytes"] = self.kv_pool_bytes
             d["kv_bytes_per_token"] = self.kv_bytes_per_token

@@ -211,33 +211,54 @@ class FlightRecorder:
     def chrome_events(self, pid=1, label="serving"):
         """Render this recorder's log as chrome-trace events: request
         spans as per-request "X" slices (tid = request id, one Perfetto
-        row per request), progress marks as instants, tick records as
-        "X" slices on a per-track scheduler row with predicted vs
-        measured in args. Timestamps are perf_counter microseconds —
-        the same base `profiler.Profiler.timeline_events()` uses, so
-        the merged export needs no re-alignment."""
+        row per request), progress/preempt/resume marks as instants,
+        tick records as "X" slices on a per-track scheduler row with
+        predicted vs measured in args. Timestamps are perf_counter
+        microseconds — the same base
+        `profiler.Profiler.timeline_events()` uses, so the merged
+        export needs no re-alignment.
+
+        TENANT grouping (serving.tenancy): requests whose submit
+        record carries a `tenant` field render under one pid PER
+        TENANT (pids after the tick row, sorted by tenant name), so a
+        multi-tenant trace reads as one Perfetto process per tenant;
+        untenanted requests keep the base `pid`."""
         out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                 "args": {"name": f"{label} requests",
                          **({"meta": dict(self.meta)} if self.meta
                             else {})}}]
         spans = {}                       # rid -> {milestone: ts}
         ticks = []
+        rid_tenant = {}                  # rid -> tenant (span grouping)
         for ev in self.events:
             kind = ev["kind"]
             if kind == "tick":
                 ticks.append(ev)
             elif "rid" in ev:
                 spans.setdefault(ev["rid"], []).append(ev)
+                if "tenant" in ev:
+                    rid_tenant.setdefault(ev["rid"], str(ev["tenant"]))
+        # tenant pids live PAST the tick row (pid + 1), so adding a
+        # tenant never renumbers the tick track
+        tenant_pid = {t: pid + 2 + i for i, t in
+                      enumerate(sorted(set(rid_tenant.values())))}
+        for t, tp in sorted(tenant_pid.items()):
+            out.append({"name": "process_name", "ph": "M", "pid": tp,
+                        "tid": 0,
+                        "args": {"name": f"{label} requests — "
+                                 f"tenant={t}"}})
         for rid, evs in sorted(spans.items()):
+            rpid = tenant_pid.get(rid_tenant.get(rid), pid)
             marks = {}
             for ev in evs:
                 marks.setdefault(ev["kind"], ev)
-                if ev["kind"] == "progress":
-                    out.append({"name": f"req{rid}:progress",
+                if ev["kind"] in ("progress", "preempt", "resume"):
+                    args = {k: v for k, v in ev.items()
+                            if k not in ("kind", "ts", "rid")}
+                    out.append({"name": f"req{rid}:{ev['kind']}",
                                 "ph": "i", "s": "t",
-                                "ts": ev["ts"] * 1e6, "pid": pid,
-                                "tid": int(rid),
-                                "args": {"tokens": ev.get("tokens")}})
+                                "ts": ev["ts"] * 1e6, "pid": rpid,
+                                "tid": int(rid), "args": args})
             for start, end, seg in self._SEGMENTS:
                 if start in marks and end in marks:
                     t0, t1 = marks[start]["ts"], marks[end]["ts"]
@@ -249,38 +270,63 @@ class FlightRecorder:
                     out.append({"name": f"req{rid}:{seg}", "ph": "X",
                                 "ts": t0 * 1e6,
                                 "dur": max(t1 * 1e6 - t0 * 1e6, 0.0),
-                                "pid": pid, "tid": int(rid),
+                                "pid": rpid, "tid": int(rid),
                                 "args": args})
-        tracks = {}                      # track -> [lane base, counter]
+        # MULTIPLE lanes per track: the engines close a tick's
+        # measured window AFTER the next horizon is dispatched
+        # (fetch-overlap), so consecutive slices genuinely overlap in
+        # time — chrome "X" slices on one tid must nest or abut, never
+        # partially overlap. Pipelined horizons alone need two lanes,
+        # but ONE-SHOT ticks landing between them (h2d_restore, a
+        # Trainer tick) can desync any fixed alternation — so lanes
+        # are assigned GREEDILY: each slice takes the first lane whose
+        # previous slice has ended, growing the lane set only when
+        # every lane is still busy (interval-graph coloring; in
+        # practice 2, occasionally 3). Lane tids are allocated per
+        # track as they appear — sorted tick processing keeps the
+        # assignment deterministic.
+        tracks = {}                      # track -> [lane_end_ts, ...]
+        track_base = {}                  # track -> first tid
         tick_pid = pid + 1
-        for ev in ticks:
+        next_tid = 0
+        # ts order, NOT recording order: a one-shot tick (h2d_restore)
+        # records mid-round, after the horizon record whose ts is the
+        # round START — greedy lane packing needs sorted starts
+        for ev in sorted(ticks, key=lambda e: e["ts"]):
             track = ev.get("track", "serve")
             if track not in tracks:
-                base = 2 * len(tracks)
-                tracks[track] = [base, 0]
-                # TWO lanes per track: the engines close a tick's
-                # measured window AFTER the next horizon is dispatched
-                # (fetch-overlap), so consecutive slices genuinely
-                # overlap in time — chrome "X" slices on one tid must
-                # nest or abut, never partially overlap, and at most
-                # two horizons are ever in flight, so alternating
-                # lanes renders the pipelining honestly
-                for lane in (0, 1):
-                    out.append({"name": "thread_name", "ph": "M",
-                                "pid": tick_pid, "tid": base + lane,
-                                "args": {"name": f"{label} {track} "
-                                         f"ticks/{lane}"}})
-            base, count = tracks[track]
-            tracks[track][1] += 1
+                tracks[track] = []
+                # reserve a generous tid block per track so a track
+                # growing a third lane never collides with the next
+                track_base[track] = next_tid
+                next_tid += 16
+            lanes = tracks[track]
+            ts = ev["ts"] * 1e6
+            dur = max(ev.get("measured_s") or 0.0, 0.0) * 1e6
+            lane = None
+            for li, lane_end in enumerate(lanes):
+                # same sub-µs tolerance as the validator's abut rule
+                if ts >= lane_end - 0.5:
+                    lane = li
+                    break
+            if lane is None:
+                lane = len(lanes)
+                lanes.append(0.0)
+                out.append({"name": "thread_name", "ph": "M",
+                            "pid": tick_pid,
+                            "tid": track_base[track] + lane,
+                            "args": {"name": f"{label} {track} "
+                                     f"ticks/{lane}"}})
+            lanes[lane] = max(lanes[lane], ts + dur)
             shape = ev.get("shape") or []
             # per-tick args carry the tick fields only: the constant
             # recorder meta rides the process_name metadata event once,
             # not 4096 times
             args = {k: v for k, v in ev.items() if k not in ("kind", "ts")}
             out.append({"name": "tick " + "x".join(str(s) for s in shape),
-                        "ph": "X", "ts": ev["ts"] * 1e6,
-                        "dur": max(ev.get("measured_s") or 0.0, 0.0) * 1e6,
-                        "pid": tick_pid, "tid": base + count % 2,
+                        "ph": "X", "ts": ts, "dur": dur,
+                        "pid": tick_pid,
+                        "tid": track_base[track] + lane,
                         "args": args})
         return out
 
@@ -296,8 +342,15 @@ def export_chrome_trace(path, recorders=(), profiler=None):
     events = []
     if isinstance(recorders, FlightRecorder):
         recorders = (recorders,)
-    for i, rec in enumerate(recorders):
-        events.extend(rec.chrome_events(pid=1 + 2 * i))
+    next_pid = 1
+    for rec in recorders:
+        evs = rec.chrome_events(pid=next_pid)
+        events.extend(evs)
+        # a recorder's pid footprint is variable now (tenant grouping
+        # adds one pid per tenant past the tick row) — the next
+        # recorder starts after the largest pid actually emitted
+        next_pid = 1 + max((int(e.get("pid", next_pid)) for e in evs),
+                           default=next_pid)
     if profiler is not None:
         events.extend(profiler.timeline_events())
     meta = [e for e in events if e.get("ph") == "M"]
@@ -320,7 +373,11 @@ def validate_chrome_trace(data):
     track ("X" slices must nest or abut — Perfetto infers depth from
     containment and renders partial overlap at wrong depths or drops
     it) — the properties that make Perfetto render slices instead of
-    silently mangling them. The tier-1 gate runs
+    silently mangling them. PREEMPTION instants (tenancy:
+    `req<id>:preempt` / `req<id>:resume` "i" events) must fall inside
+    their request row's overall span — a preempt stamped outside the
+    slices it supposedly interrupted is mis-attributed lifecycle
+    bookkeeping. The tier-1 gate runs
     this over a real mixed-ragged export; `data` may be the parsed
     dict or a path."""
     if isinstance(data, (str, os.PathLike)):
@@ -332,6 +389,19 @@ def validate_chrome_trace(data):
     events = data["traceEvents"]
     if not isinstance(events, list):
         return ["'traceEvents' is not a list"]
+    # pre-pass: each track's overall "X" span — preemption instants
+    # are checked against it below (they can sort before the slice
+    # that covers them, so a single pass can't judge containment)
+    span_lo, span_hi = {}, {}
+    for ev in events:
+        if isinstance(ev, dict) and ev.get("ph") == "X" and \
+                isinstance(ev.get("ts"), (int, float)) and \
+                isinstance(ev.get("dur"), (int, float)):
+            track = (ev.get("pid"), ev.get("tid"))
+            span_lo[track] = min(span_lo.get(track, ev["ts"]), ev["ts"])
+            span_hi[track] = max(span_hi.get(track,
+                                             ev["ts"] + ev["dur"]),
+                                 ev["ts"] + ev["dur"])
     last_ts = {}
     open_slices = {}                     # track -> stack of (end, name)
     for i, ev in enumerate(events):
@@ -351,6 +421,18 @@ def validate_chrome_trace(data):
                             "be a non-negative number")
             continue
         track = (ev.get("pid"), ev.get("tid"))
+        if ph == "i":
+            name = str(ev.get("name", ""))
+            if name.endswith(":preempt") or name.endswith(":resume"):
+                lo, hi = span_lo.get(track), span_hi.get(track)
+                # sub-µs tolerance, like the overlap rule below
+                if lo is None or ts < lo - 0.5 or ts > hi + 0.5:
+                    problems.append(
+                        f"event {i} ({name}): preemption instant at "
+                        f"ts={ts} lies outside its request row's span "
+                        f"[{lo}, {hi}] on track pid={track[0]} "
+                        f"tid={track[1]} — preempt/resume must happen "
+                        "inside the request's lifecycle")
         if ph == "X":
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:

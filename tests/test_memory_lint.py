@@ -611,3 +611,69 @@ def test_propagation_drops_dynamically_indexed_dims():
     ds_out = eqns["dynamic_slice"].outvars[0]
     assert counts[g_out] == 4 and counts[ds_out] == 4
     assert counts[jx.outvars[0]] == 4
+
+
+def test_propagation_threads_concat_pad_slice_dims():
+    """Sharding propagation fidelity (concatenate/pad/slice slice): the
+    structural reshape family threads shard factors through UNTOUCHED
+    dims and drops them on the structural ones — the concat dim
+    (pieces land at per-operand offsets), padded dims (offsets shift),
+    and statically under-sliced or strided dims (the kept span crosses
+    shard boundaries) — while a dim every operand agrees on, or one
+    taken whole at stride 1, keeps its factor. This is the KV-cache
+    idiom (concat new keys on the sequence dim, slice a window): dp/tp
+    on the batch/head dims must survive it."""
+    from paddle_tpu.analysis.memory import (_eqn_out_shard,
+                                            propagate_shard_counts)
+
+    def f(x, y):
+        c = jnp.concatenate([x, y], axis=1)     # grow the seq dim
+        p = jax.lax.pad(x, 0.0,
+                        ((0, 0, 0), (2, 2, 0)))  # pad the seq dim
+        sl = jax.lax.slice(c, (0, 0), (8, 4))   # seq window (partial)
+        whole = jax.lax.slice(x, (0, 0), (8, 16))   # identity slice
+        return c + 0.0, p, sl, whole
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 16)), jnp.zeros((8, 16))).jaxpr
+    eqns = {}
+    for e in jx.eqns:
+        eqns.setdefault(e.primitive.name, []).append(e)
+    cat = eqns["concatenate"][0]
+    pad = eqns["pad"][0]
+    sl_part, sl_whole = eqns["slice"][:2]
+
+    # --- unit: concat dim 1 drops its factor; batch dim 0 threads
+    # when every operand agrees
+    cnt, dims = _eqn_out_shard(cat, [8, 8], [(2, 4), (2, 4)])
+    assert cnt == 2 and dims == (2, 1)
+    # operands DISAGREE on the batch factor: that dim drops too
+    cnt, dims = _eqn_out_shard(cat, [4, 1], [(4, 1), (1, 1)])
+    assert cnt == 1 and dims == (1, 1)
+    # --- pad: the padded dim drops, the untouched one threads
+    cnt, dims = _eqn_out_shard(pad, [8, 1], [(2, 4), None])
+    assert cnt == 2 and dims == (2, 1)
+    cnt, dims = _eqn_out_shard(pad, [2, 1], [(2, 1), None])
+    assert cnt == 2 and dims == (2, 1)
+    # --- slice: a dim taken below full size drops; one taken whole at
+    # stride 1 threads
+    cnt, dims = _eqn_out_shard(sl_part, [8], [(2, 4)])
+    assert cnt == 2 and dims == (2, 1)
+    cnt, dims = _eqn_out_shard(sl_whole, [8], [(2, 4)])
+    assert cnt == 8 and dims == (2, 4)
+    # cap: a kept-dim product above the most-sharded operand bails to
+    # the blind cap (never claim finer sharding than any input)
+    cntc, dimsc = _eqn_out_shard(sl_whole, [2], [(2, 4)])
+    assert cntc == 2 and dimsc is None
+    # legacy (no dim info): blind max-operand inherit — unchanged
+    cntl, _ = _eqn_out_shard(cat, [8, 8], [None, None])
+    assert cntl == 8
+
+    # --- through the jaxpr: dp on the batch dim survives the whole
+    # concat -> slice chain (and the elementwise chain after it); the
+    # sharded SEQ dim's factor is gone from concat/pad outputs
+    counts = propagate_shard_counts(jx, arg_counts=[8, 8],
+                                    arg_dims=[(2, 4), (2, 4)])
+    assert counts[cat.outvars[0]] == 2
+    assert counts[pad.outvars[0]] == 2
+    assert counts[sl_part.outvars[0]] == 2
+    assert counts[jx.outvars[0]] == 2        # elementwise after concat

@@ -73,9 +73,17 @@ def test_ring_flash_matches_reference(causal):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_zigzag_flash_matches_reference():
     """Zigzag layout + flash kernel blocks: balanced compute AND O(L/sp)
-    memory — fwd and grads equal the dense reference."""
+    memory — fwd and grads equal the dense reference.
+
+    slow-marked (tier-1 wall-clock, PR 15 re-measure: 89 s of the
+    1566 s full sweep on the dev box — the 2nd-worst eager loop after
+    its zigzag-ring sibling below): grad-of-flash under an sp=4 mesh
+    is compile-bound. Tier-1 zigzag coverage stays with
+    test_zigzag_layout_roundtrip + test_gpt_zigzag_sp_equals_single_
+    device; the kernel-vs-reference grads run in `-m slow` sweeps."""
     build_mesh(sp=4)
     rng = np.random.RandomState(3)
     B, L, H, D = 2, 64, 2, 16          # Lh = 8 per shard
@@ -149,8 +157,14 @@ def test_gpt_ulysses_sp_mode():
     assert abs(losses["ring"] - losses["ulysses"]) < 1e-3, losses
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_reference():
-    """Zigzag (load-balanced) causal ring == plain attention, fwd + grad."""
+    """Zigzag (load-balanced) causal ring == plain attention, fwd + grad.
+
+    slow-marked (tier-1 wall-clock, PR 15 re-measure: 139 s of the
+    1566 s full sweep on the dev box — the WORST remaining eager
+    loop): grad-of-ring under an sp=4 mesh is compile-bound. See the
+    zigzag-flash note above for the coverage that stays tier-1."""
     from paddle_tpu.ops.ring_attention import ring_attention
 
     build_mesh(sp=4)

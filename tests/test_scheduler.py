@@ -1,0 +1,197 @@
+"""Direct unit coverage of `RaggedScheduler.plan` (and the tenancy
+scheduler's class-aware composition) — until now the scheduler was
+only exercised through engine integration tests. A duck-typed fake
+decoder keeps these pure-host and fast: the scheduler only reads
+`step_hbm_bytes()`, `cfg.num_params()` and `max_batch`."""
+import math
+
+import pytest
+
+from paddle_tpu.cost_model import ragged_chunk_tokens
+from paddle_tpu.serving import (SLO_LATENCY, SLO_THROUGHPUT,
+                                RaggedScheduler, TenantScheduler)
+
+
+class _FakeCfg:
+    def __init__(self, params=2_000_000):
+        self._p = params
+
+    def num_params(self):
+        return self._p
+
+
+class _FakeDec:
+    def __init__(self, max_batch=4, hbm=1 << 20, params=2_000_000):
+        self.max_batch = max_batch
+        self.cfg = _FakeCfg(params)
+        self._hbm = hbm
+
+    def step_hbm_bytes(self):
+        return self._hbm
+
+
+def _sched(max_batch=4, chunk_tokens=8, k_max=8, cls=RaggedScheduler):
+    return cls(_FakeDec(max_batch=max_batch), chunk_tokens=chunk_tokens,
+               k_max=k_max, host_sync_s=4e-4)
+
+
+# ----------------------------------------------------- construction
+
+
+def test_chunk_budget_normalizes_down_to_pow2_with_floor_one():
+    """The per-slot chunk budget is normalized DOWN to a power of two
+    (plan buckets widths pow2 — rounding UP would exceed the priced
+    per-tick budget), floored at 1."""
+    assert _sched(chunk_tokens=8).chunk_tokens == 8
+    assert _sched(chunk_tokens=13).chunk_tokens == 8
+    assert _sched(chunk_tokens=3).chunk_tokens == 2
+    assert _sched(chunk_tokens=1).chunk_tokens == 1
+    assert _sched(chunk_tokens=0).chunk_tokens == 1
+
+
+def test_priced_chunk_budget_respects_floor_and_cap():
+    """`cost_model.ragged_chunk_tokens`: a compute-tight model clamps
+    at the floor (progress on prompts is guaranteed), an HBM-dominated
+    one at the cap (per-tick latency jitter stays bounded)."""
+    # enormous per-token FLOPs: nothing hides under the HBM leg
+    assert ragged_chunk_tokens(1 << 20, 1e15) == 8
+    # free compute: the cap bounds the tick's token budget
+    assert ragged_chunk_tokens(1 << 30, 1.0) == 256
+    assert ragged_chunk_tokens(1 << 30, 0.0) == 256
+
+
+# ------------------------------------------------------------- plan
+
+
+def test_plan_empty_live_returns_none():
+    s = _sched()
+    assert s.plan({}, {}, [0] * 4) is None
+
+
+def test_plan_all_frozen_returns_none():
+    """Every emittable tick already in flight (or budget exhausted):
+    no horizon can make progress."""
+    s = _sched()
+    live = {0: 100, 1: 101}
+    # budget fully in flight on slot 0, exhausted on slot 1
+    assert s.plan(live, {0: 4, 1: 0}, {0: 4, 1: 0}) is None
+
+
+def test_plan_pure_decode_full_horizon():
+    s = _sched(k_max=8)
+    live = {0: 100, 1: 101}
+    plan = s.plan(live, {0: 16, 1: 16}, [0] * 4)
+    assert (plan.k, plan.w) == (8, 1)
+    assert plan.n_chunks == 0 and plan.prefill_rows == 0
+    assert plan.emit_ticks == {0: 8, 1: 8}
+    # packed bucket: pow2, floored at the slot count
+    assert plan.t_tokens == 4
+
+
+def test_plan_consumes_suffix_and_caps_emit_ticks_by_budget():
+    """A prefilling slot's chunk ticks don't emit; emit_ticks is
+    capped at budget - inflight so the device/host in-flight invariant
+    holds exactly."""
+    s = _sched(chunk_tokens=8, k_max=8)
+    s.admit(0, 20)                       # ceil(20/8) = 3 chunk ticks
+    live = {0: 100, 1: 101}
+    assert s.prefilling(0) and s.suffix_left(0) == 20
+    assert s.stall_ticks(0) == 2
+    plan = s.plan(live, {0: 16, 1: 4}, [0] * 4)
+    assert plan.w == 8
+    # k clamped to the chunk ticks the stream needs (pow2 below 3)
+    assert plan.k == 2
+    assert plan.prefill_rows == 1 and plan.n_chunks == 2
+    # slot 0: both ticks consume chunks, none emits; slot 1 emits both
+    assert plan.emit_ticks == {0: 0, 1: 2}
+    assert s.suffix_left(0) == 20 - 2 * 8
+    # slot 1 now fully in flight: its ticks are filler (emit 0), but
+    # slot 0's remaining chunk work still makes a horizon
+    plan2 = s.plan(live, {0: 16, 1: 4}, {0: 0, 1: 4})
+    assert plan2.emit_ticks[1] == 0
+    assert s.suffix_left(0) == 0
+
+
+def test_plan_width_covers_shortest_suffix_not_cap():
+    """A 5-token prompt must not inflate the whole batch to the cap:
+    w is the min-cover pow2 of the longest PENDING suffix."""
+    s = _sched(chunk_tokens=64, k_max=8)
+    s.admit(0, 5)
+    plan = s.plan({0: 100}, {0: 8}, [0] * 4)
+    assert plan.w == 8                   # pow2 >= 5, way below cap 64
+    assert plan.k == 1
+
+
+def test_plan_t_tokens_is_pow2_total_token_bucket():
+    s = _sched(chunk_tokens=8, k_max=8, max_batch=4)
+    s.admit(0, 16)
+    live = {0: 100, 1: 101, 2: 102}
+    plan = s.plan(live, {0: 8, 1: 8, 2: 8}, [0] * 4)
+    # tick 0 total: slot 0 pays min(16, 8)=8, slots 1-2 pay 1 each ->
+    # 10 -> pow2 16 (already >= the slot-count floor of 4)
+    assert plan.t_tokens == 16
+
+
+# --------------------------------------------------- TenantScheduler
+
+
+def test_latency_row_preempts_chunk_budget_vs_throughput_backlog():
+    """Single latency row vs a full throughput backlog: w sizes to the
+    LATENCY suffix (the longer throughput suffix no longer stretches
+    the drain) and k clamps to the ticks the latency stream needs."""
+    s = _sched(chunk_tokens=16, k_max=8, cls=TenantScheduler)
+    s.admit(0, 12)
+    s.set_slo(0, SLO_LATENCY)
+    s.admit(1, 120)                      # long throughput prompt
+    s.set_slo(1, SLO_THROUGHPUT)
+    live = {0: 100, 1: 101}
+    plan = s.plan(live, {0: 8, 1: 8}, [0] * 4)
+    assert plan.w == 16                  # min-cover of the 12-token
+    assert plan.k == 1                   # latency suffix, one tick
+    # the throughput row BACKFILLED the same tick with its own chunk
+    assert plan.prefill_rows == 2
+    assert s.suffix_left(0) == 0 and s.suffix_left(1) == 120 - 16
+
+
+def test_throughput_only_composition_falls_back_to_base():
+    base = _sched(chunk_tokens=8, k_max=8)
+    ten = _sched(chunk_tokens=8, k_max=8, cls=TenantScheduler)
+    for s in (base, ten):
+        s.admit(0, 20)
+    ten.set_slo(0, SLO_THROUGHPUT)
+    assert ten._compose({0: 100}) == base._compose({0: 100})
+
+
+def test_latency_queue_pressure_clamps_horizon():
+    """A latency request WAITING in the queue caps pure-decode
+    horizons at the roofline-derived latency K, so the next admission
+    boundary arrives within the class target."""
+    s = _sched(chunk_tokens=8, k_max=32, cls=TenantScheduler)
+    assert 1 <= s.k_latency <= s.k_max
+    live = {0: 100}
+    s.set_slo(0, SLO_THROUGHPUT)
+    w, k_limit = s._compose(live)
+    assert (w, k_limit) == (1, 32)
+    s.note_queue(True)
+    w, k_limit = s._compose(live)
+    assert k_limit == min(32, s.k_latency)
+    s.note_queue(False)
+    assert s._compose(live)[1] == 32
+
+
+def test_slo_targets_are_roofline_priced():
+    """Per-class p99 targets come from cost_model.slo_p99_target_s —
+    the latency class syncs more often, so its per-boundary target is
+    at or below the throughput class's."""
+    s = _sched(cls=TenantScheduler)
+    t = s.slo_targets_s
+    assert 0 < t[SLO_LATENCY] <= t[SLO_THROUGHPUT]
+
+
+def test_retire_clears_slo_and_suffix():
+    s = _sched(cls=TenantScheduler)
+    s.admit(2, 9)
+    s.set_slo(2, SLO_LATENCY)
+    s.retire(2)
+    assert not s.prefilling(2)
+    assert 2 not in s._slo
