@@ -23,6 +23,9 @@ from .pass_manager import (AnalysisContext, Analyzer,  # noqa: F401
                            PassManager, default_catalog, get_analyzer,
                            register_analyzer)
 from . import analyzers  # noqa: F401  (registers the graph passes)
+# propagation registers BEFORE memory/sharding: those passes consume
+# the fixed-point result it stashes on ctx.extra, so it must run first
+from . import propagation as _propagation  # noqa: F401
 from . import memory as _memory  # noqa: F401  (registers the memory pass)
 from . import sharding as _sharding  # noqa: F401  (registers sharding pass)
 from . import schedule as _schedule  # noqa: F401 (registers schedule pass)
@@ -37,9 +40,15 @@ from .manifest import (build_manifest, load_manifest,  # noqa: F401
                        build_tuning_manifest, load_tuning_manifest,
                        tuning_manifest_path, write_tuning_manifest,
                        build_schedule_manifest, load_schedule_manifest,
-                       schedule_manifest_path, write_schedule_manifest)
+                       schedule_manifest_path, write_schedule_manifest,
+                       build_propagation_manifest,
+                       load_propagation_manifest,
+                       propagation_manifest_path,
+                       write_propagation_manifest)
 from .memory import (MemoryEstimate, audit_page_ledger,  # noqa: F401
                      estimate_jaxpr_memory, propagate_shard_counts)
+from .propagation import (PropagationResult,  # noqa: F401
+                          propagate_shardings)
 from .schedule import (ScheduleEstimate, ScheduleNode,  # noqa: F401
                        estimate_schedule)
 from .remat_advisor import (REMAT_POLICIES, RematWhatIf,  # noqa: F401
@@ -61,7 +70,10 @@ __all__ = [
     "tuning_manifest_path", "write_tuning_manifest",
     "build_schedule_manifest", "load_schedule_manifest",
     "schedule_manifest_path", "write_schedule_manifest",
+    "build_propagation_manifest", "load_propagation_manifest",
+    "propagation_manifest_path", "write_propagation_manifest",
     "MemoryEstimate", "estimate_jaxpr_memory", "propagate_shard_counts",
+    "PropagationResult", "propagate_shardings",
     "audit_page_ledger",
     "ScheduleEstimate", "ScheduleNode", "estimate_schedule",
     "REMAT_POLICIES", "RematWhatIf", "advise_remat", "replay_remat",

@@ -12,9 +12,12 @@ HBM breakdown (peak, args/transient split, top live tensors);
 peak, recompute FLOPs, roofline step time — tuning_manifests/*.json
 pins it); ``--schedule`` prints the overlap-aware schedule breakdown
 (critical path, wire-hiding fraction, COLL-SERIALIZED evidence —
-schedule_manifests/*.json pins it); ``--check`` regenerates every
-committed manifest in-memory (lint, memory, tuning AND schedule) and
-fails on any drift — the CI answer to stale manifests.
+schedule_manifests/*.json pins it); ``--propagation`` prints the
+GSPMD fixed-point pass summary (exact/fallback coverage, XLA
+annotation agreement, divergences — propagation_manifests/*.json pins
+it); ``--check`` regenerates every committed manifest in-memory
+(lint, memory, tuning, schedule AND propagation) and fails on any
+drift — the CI answer to stale manifests.
 
 Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
 (the CI gate), 2 usage problems.
@@ -51,10 +54,12 @@ def _build_spec(spec):
 
 
 def _run_spec(spec, write, as_json, no_manifest, show_memory,
-              show_autotune=False, show_schedule=False):
+              show_autotune=False, show_schedule=False,
+              show_propagation=False):
     from . import (PassManager, load_manifest, load_memory_manifest,
                    write_manifest, write_memory_manifest,
-                   write_schedule_manifest, write_tuning_manifest)
+                   write_propagation_manifest, write_schedule_manifest,
+                   write_tuning_manifest)
     from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
 
     pm = PassManager()
@@ -70,9 +75,11 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
     if write:
         data = write_manifest(ctx.name, program, report)
         mem = write_memory_manifest(ctx.name, report)
+        prop = write_propagation_manifest(ctx.name, report)
         msg = (f"wrote {ctx.name} manifests "
                f"({sum(data['op_counts'].values())} pinned ops, "
-               f"{mem['per_device_peak_bytes']} peak bytes")
+               f"{mem['per_device_peak_bytes']} peak bytes, "
+               f"prop {prop['n_exact']}/{prop['n_vars']} exact")
         if spec in SCHEDULE_CONFIGS:
             sch = write_schedule_manifest(ctx.name, report)
             msg += (f", overlap step {sch['overlap_step_us']} us "
@@ -95,6 +102,8 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
             _print_memory(report)
         if show_schedule:
             _print_schedule(report)
+        if show_propagation:
+            _print_propagation(report)
         if show_autotune:
             from .baseline import PROGRAM_CONFIGS
             if spec in PROGRAM_CONFIGS:
@@ -159,14 +168,31 @@ def _print_schedule(report):
               f"{n['source']}")
 
 
+def _print_propagation(report):
+    prop = report.metrics.get("propagation", {})
+    if not prop.get("available"):
+        print("   propagation: no jaxpr available")
+        return
+    print(f"   propagation: {prop['n_exact']}/{prop['n_vars']} vars "
+          f"exact ({prop['n_fallback']} heuristic fallback), "
+          f"{prop['n_constraints']} constraint pin(s), converged in "
+          f"{prop['iterations']} sweep(s)")
+    print(f"     vs XLA: {prop['n_agree']}/{prop['n_annotated']} "
+          f"annotated vars agree (rate {prop['agreement_rate']}), "
+          f"{prop['n_diverge']} diverge, {prop['n_unmapped']} unmapped; "
+          f"{prop['n_divergences']} divergence lint(s), "
+          f"{prop['n_loop_carry_reshards']} loop-carry reshard(s)")
+
+
 def _check_manifests(names):
-    """Regenerate every manifest in-memory (lint, memory, tuning AND
-    schedule) and diff against the committed files. Returns the number
-    of drifting/missing manifests (the --check CI mode: stale manifests
-    fail instead of silently re-baselining)."""
+    """Regenerate every manifest in-memory (lint, memory, tuning,
+    schedule AND propagation) and diff against the committed files.
+    Returns the number of drifting/missing manifests (the --check CI
+    mode: stale manifests fail instead of silently re-baselining)."""
     from . import (PassManager, build_manifest, build_memory_manifest,
-                   build_schedule_manifest, build_tuning_manifest,
-                   load_manifest, load_memory_manifest,
+                   build_propagation_manifest, build_schedule_manifest,
+                   build_tuning_manifest, load_manifest,
+                   load_memory_manifest, load_propagation_manifest,
                    load_schedule_manifest, load_tuning_manifest,
                    manifest_drift)
     from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
@@ -183,6 +209,9 @@ def _check_manifests(names):
                                load_manifest(name), path="lint")
         drift += manifest_drift(build_memory_manifest(name, report),
                                 load_memory_manifest(name), path="memory")
+        drift += manifest_drift(build_propagation_manifest(name, report),
+                                load_propagation_manifest(name),
+                                path="propagation")
         if name in SCHEDULE_CONFIGS:
             drift += manifest_drift(
                 build_schedule_manifest(name, report),
@@ -216,8 +245,9 @@ def main(argv=None):
     parser.add_argument("--list", action="store_true",
                         help="list BASELINE configs and analyzers")
     parser.add_argument("--write-manifests", action="store_true",
-                        help="regenerate lint_manifests/<config>.json "
-                             "and memory_manifests/<config>.json")
+                        help="regenerate lint_manifests/, "
+                             "memory_manifests/ and "
+                             "propagation_manifests/<config>.json")
     parser.add_argument("--check", action="store_true",
                         help="regenerate all manifests in-memory and "
                              "exit non-zero on drift (CI staleness "
@@ -229,6 +259,10 @@ def main(argv=None):
                         help="print the overlap-aware schedule "
                              "breakdown (critical path, wire-hiding "
                              "fraction, serialized collectives)")
+    parser.add_argument("--propagation", action="store_true",
+                        help="print the GSPMD fixed-point propagation "
+                             "summary (exact/fallback coverage, XLA "
+                             "annotation agreement, divergences)")
     parser.add_argument("--autotune", action="store_true",
                         help="print the remat advisor's what-if table "
                              "(per-policy peak, recompute FLOPs, "
@@ -263,7 +297,8 @@ def main(argv=None):
         report = _run_spec(name, args.write_manifests, args.json,
                            args.no_manifest_check, args.memory,
                            show_autotune=args.autotune,
-                           show_schedule=args.schedule)
+                           show_schedule=args.schedule,
+                           show_propagation=args.propagation)
         sev = report.max_severity
         if sev is not None and (worst is None or sev > worst):
             worst = sev

@@ -9,7 +9,10 @@ This module replaces the brute force with static search:
   1. trace the trainer's REAL step once per candidate microbatch with
      remat disabled (CPU tracing, no compile, no device);
   2. replay every candidate remat policy over that trace
-     (remat_advisor.py): per-device peak + recompute FLOPs per policy;
+     (remat_advisor.py): per-device peak + recompute FLOPs per policy —
+     per-device division uses the fixed-point propagated shard counts
+     (analysis/propagation.py) where the lowering pinned per-dim specs,
+     the v1 max-operand heuristic elsewhere;
   3. price each (microbatch, policy) with the roofline step-time model
      (cost_model.roofline_step_time): max(compute, HBM, wire) seconds;
   4. prune everything over the HBM budget, rank the rest by predicted

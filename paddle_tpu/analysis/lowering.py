@@ -16,7 +16,8 @@ from dataclasses import dataclass, field
 
 __all__ = ["ArgInfo", "HloOp", "LoweredProgram", "lower_layer",
            "lower_callable", "tensor_type_bytes", "sharding_shard_count",
-           "sharding_dim_counts", "tree_arg_infos"]
+           "sharding_dim_counts", "tree_arg_infos",
+           "parse_hlo_sharding", "harvest_hlo_shardings"]
 
 _OP_RE = re.compile(r'"?stablehlo\.([a-zA-Z0-9_]+)"?')
 _TENSOR_RE = re.compile(r"tensor<([^>]*)>")
@@ -110,6 +111,92 @@ def sharding_dim_counts(sharding, ndim):
         for a in axes:
             dims[i] *= int(mesh.shape.get(a, 1))
     return tuple(dims)
+
+
+_MHLO_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_HLO_TILE_RE = re.compile(r"devices=\[([0-9,]+)\]")
+_HLO_SUBGROUP_RE = re.compile(r"last_tile_dims=\{([^}]*)\}")
+
+
+def parse_hlo_sharding(sharding_str, rank):
+    """Per-dim shard counts from an HLO sharding string over a
+    `rank`-dim value, or None when unknown/unrepresentable.
+
+    Handles the forms XLA emits in `mhlo.sharding` attrs:
+    `{replicated}` and `{maximal device=k}` (one full copy per device
+    -> all-ones), `{devices=[2,2]0,1,2,3}` (V1 explicit device list)
+    and `{devices=[2,2]<=[4]}` (V2 iota, incl. transposed
+    `<=[2,2]T(1,0)` reshapes — the device ASSIGNMENT is irrelevant to
+    per-dim counts, only the tile shape matters), with trailing
+    replication (`last_tile_dim_replicate`) or subgroup dims
+    (`last_tile_dims={...}`) stripped off the tile shape. `{manual}`
+    and sdy-dialect attrs return None (counted as unmapped by the
+    propagation cross-check)."""
+    if sharding_str is None or rank is None:
+        return None
+    body = sharding_str.strip()
+    if body.startswith("{") and body.endswith("}"):
+        body = body[1:-1].strip()
+    if body.startswith("replicated") or body.startswith("maximal"):
+        return (1,) * int(rank)
+    m = _HLO_TILE_RE.match(body)
+    if m is None:
+        return None
+    tile = [int(x) for x in m.group(1).split(",") if x]
+    sub = _HLO_SUBGROUP_RE.search(body)
+    if sub is not None:
+        k = len([p for p in sub.group(1).split(",") if p.strip()])
+        tile = tile[:len(tile) - k] if k else tile
+    elif "last_tile_dim_replicate" in body:
+        tile = tile[:-1]
+    if len(tile) != int(rank):
+        return None
+    return tuple(tile)
+
+
+def harvest_hlo_shardings(text):
+    """The per-tensor sharding annotations XLA actually lowered into a
+    StableHLO module: `{"args": {argno: raw_string}, "constraints":
+    [raw_string_or_None, ...]}`.
+
+    * entry args: `mhlo.sharding` attrs on the `@main` signature
+      (paren-balanced, so tensor types and nested attrs don't confuse
+      the split);
+    * constraints: every `stablehlo.custom_call @Sharding` — the
+      lowered form of a `sharding_constraint` eqn — in document order.
+      The propagation cross-check matches them to depth-first jaxpr
+      eqn order, which coincides for inlined bodies (scan/while lower
+      into the same function); constraints inside out-of-line private
+      funcs that XLA reordered are caught by the rank sanity check and
+      counted unmapped rather than mismatched.
+
+    Raw strings are returned unparsed (sdy attrs included) —
+    `parse_hlo_sharding` decides representability."""
+    args = {}
+    m = re.search(r"@main\s*\(", text)
+    if m is not None:
+        i, depth, start = m.end(), 1, m.end()
+        while i < len(text) and depth:
+            c = text[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            i += 1
+        sig = text[start:i - 1]
+        arg_marks = list(re.finditer(r"%arg(\d+):", sig))
+        for j, am in enumerate(arg_marks):
+            seg_end = (arg_marks[j + 1].start()
+                       if j + 1 < len(arg_marks) else len(sig))
+            sm = _MHLO_SHARDING_RE.search(sig[am.end():seg_end])
+            if sm is not None:
+                args[int(am.group(1))] = sm.group(1)
+    constraints = []
+    for line in text.splitlines():
+        if "custom_call" in line and "@Sharding" in line:
+            sm = _MHLO_SHARDING_RE.search(line)
+            constraints.append(sm.group(1) if sm is not None else None)
+    return {"args": args, "constraints": constraints}
 
 
 @dataclass
@@ -312,14 +399,23 @@ def _untensor(tree):
 
 
 def lower_callable(fn, *example_args, name="program", input_arg_ids=None,
-                   arg_infos=None):
-    """Trace `fn` once; return StableHLO + jaxpr as a LoweredProgram."""
+                   arg_infos=None, in_shardings=None):
+    """Trace `fn` once; return StableHLO + jaxpr as a LoweredProgram.
+    `in_shardings` (a per-arg tuple of sharding pytrees, None entries =
+    unspecified) threads into `jax.jit` so the lowered text carries real
+    `mhlo.sharding` annotations, and seeds the auto-built ArgInfos'
+    dim_shards — the propagation pass's cross-check needs both sides."""
     import jax
-    traced = jax.jit(fn).trace(*example_args)
+    jitted = (jax.jit(fn, in_shardings=in_shardings)
+              if in_shardings is not None else jax.jit(fn))
+    traced = jitted.trace(*example_args)
     if arg_infos is None:
         arg_infos = []
-        for i, a in enumerate(example_args):
-            arg_infos.extend(tree_arg_infos(a, "input", prefix=f"arg{i}"))
+        shardings = (in_shardings if in_shardings is not None
+                     else [None] * len(example_args))
+        for i, (a, sh) in enumerate(zip(example_args, shardings)):
+            arg_infos.extend(tree_arg_infos(a, "input", prefix=f"arg{i}",
+                                            shardings=sh))
     return LoweredProgram(traced.lower().as_text(), jaxpr=traced.jaxpr,
                           name=name, input_arg_ids=input_arg_ids,
                           arg_infos=arg_infos)

@@ -25,12 +25,16 @@ __all__ = ["manifest_dir", "manifest_path", "load_manifest",
            "write_tuning_manifest",
            "schedule_manifest_dir", "schedule_manifest_path",
            "load_schedule_manifest", "build_schedule_manifest",
-           "write_schedule_manifest"]
+           "write_schedule_manifest",
+           "propagation_manifest_dir", "propagation_manifest_path",
+           "load_propagation_manifest", "build_propagation_manifest",
+           "write_propagation_manifest"]
 
 _SCHEMA = 1
 _MEMORY_SCHEMA = 1
 _TUNING_SCHEMA = 1
 _SCHEDULE_SCHEMA = 1
+_PROPAGATION_SCHEMA = 1
 
 
 def manifest_dir():
@@ -264,6 +268,70 @@ def write_schedule_manifest(name, report):
     os.makedirs(schedule_manifest_dir(), exist_ok=True)
     data = build_schedule_manifest(name, report)
     with open(schedule_manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+# ----------------------------------------------------------- propagation
+
+
+def propagation_manifest_dir():
+    """Repo-root propagation_manifests/ (next to schedule_manifests/)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "propagation_manifests")
+
+
+def propagation_manifest_path(name):
+    return os.path.join(propagation_manifest_dir(), f"{name}.json")
+
+
+def load_propagation_manifest(name):
+    """The committed propagation manifest dict, or None when absent."""
+    try:
+        with open(propagation_manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_propagation_manifest(name, report):
+    """Propagation manifest dict from one pass-manager run
+    (analysis/propagation.py metrics): fixed-point coverage (exact vs
+    conservative-fallback vars), the XLA cross-check's agreement
+    counters, and the two lint feeds' counts. Deterministic — the
+    fixed point over one cached CPU trace converges to the same specs
+    on every machine, so a TPU and a CPU checkout agree
+    byte-for-byte."""
+    prop = report.metrics.get("propagation", {})
+    return {
+        "schema": _PROPAGATION_SCHEMA,
+        "model": name,
+        "n_vars": prop.get("n_vars", 0),
+        "n_exact": prop.get("n_exact", 0),
+        "n_fallback": prop.get("n_fallback", 0),
+        "n_constraints": prop.get("n_constraints", 0),
+        "annotations": {
+            "n_annotated": prop.get("n_annotated", 0),
+            "n_agree": prop.get("n_agree", 0),
+            "n_diverge": prop.get("n_diverge", 0),
+            "n_unmapped": prop.get("n_unmapped", 0),
+            "agreement_rate": prop.get("agreement_rate", 1.0),
+        },
+        "n_divergences": prop.get("n_divergences", 0),
+        "n_loop_carry_reshards": prop.get("n_loop_carry_reshards", 0),
+        "iterations": prop.get("iterations", 0),
+        "converged": prop.get("converged", True),
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_propagation_manifest(name, report):
+    os.makedirs(propagation_manifest_dir(), exist_ok=True)
+    data = build_propagation_manifest(name, report)
+    with open(propagation_manifest_path(name), "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
     return data

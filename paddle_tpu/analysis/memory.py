@@ -176,7 +176,8 @@ def _inner_transient(jx, widen, memo):
 
 
 def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
-          arg_infos=None, last_use_override=None, extra_after=None):
+          arg_infos=None, last_use_override=None, extra_after=None,
+          var_counts=None):
     """Liveness walk of one jaxpr. Returns (peak, peak_eqn_idx,
     top_buffers_at_peak).
 
@@ -185,7 +186,15 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     ending them at their last FORWARD use. `extra_after` ((idx, bytes))
     adds a flat byte bump to every program point past idx — the
     advisor's model of one segment's recompute working set during the
-    backward. Output vars are never truncated."""
+    backward. Output vars are never truncated.
+
+    `var_counts` ({var: shard_count}, typically
+    `propagation.PropagationResult.counts`) overrides the inline
+    forward propagation per var where present: the fixed-point pass
+    sees constraint pins and consumer-implied specs this single
+    forward sweep can't, so its counts are used when available and the
+    inline `_eqn_out_shard` result is the documented conservative
+    fallback for vars the pass left unknown."""
     last_use = {}
     for i, eqn in enumerate(jx.eqns):
         for v in eqn.invars:
@@ -260,12 +269,15 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
         for v in eqn.outvars:
             dimmap[v] = out_dims
             if v in last_use:
-                counts[v] = out_count
+                cnt = (var_counts[v]
+                       if var_counts is not None and v in var_counts
+                       else out_count)
+                counts[v] = cnt
                 gb = _aval_bytes(v.aval, widen_sub_f32=widen)
-                db = gb // max(out_count, 1)
+                db = gb // max(cnt, 1)
                 live[v] = (db, LiveBuffer(
                     op=eqn.primitive.name, name=_eqn_source(eqn, i),
-                    bytes=gb, device_bytes=db, shard_count=out_count))
+                    bytes=gb, device_bytes=db, shard_count=cnt))
                 cur += db
         extra = bump if i > bump_after else 0
         if cur + inner + extra > peak:
@@ -286,18 +298,23 @@ def _reshape_dim_shards(in_shape, in_dims, out_shape):
     """Per-dim shard counts across a reshape, or None when the mapping
     isn't clean. Contiguous dim groups with equal element products map
     onto each other (the standard reshape factorization); a group's
-    shard factor (the product of its INPUT dims' factors) lands on the
-    first output dim of its group — the most-major position, where a
-    row-major split stays contiguous — when divisibility holds.
-    Any group whose factor does not divide its target dim returns None
-    (the caller falls back to the conservative max-operand cap) — as
-    does a group whose factor sits on a MINOR input dim (a non-unit
-    dim more major than it in the group, or two sharded dims): a
+    shard factor is the product of the factors of its FULLY-SHARDED
+    major prefix (every dim before the first partially-sharded one
+    contributes — merging dims sharded whole keeps a contiguous
+    row-major split) plus at most one trailing partial factor, and is
+    peeled onto the group's output dims major-first, WHOLE DIMS at a
+    time: an output dim is either covered entirely by the split (its
+    full size divides the remaining factor) or carries the remainder
+    when that divides it — so a 4-way factor lands on (2, 2, ...) as
+    (2, 2) and on (8, ...) as (4,), while a peel that would make one
+    shard straddle a tile boundary (neither divides) returns None.
+    Also None: a factor on a MINOR input dim (a partially-sharded or
+    unsharded non-unit dim more major than it in the group) — a
     row-major merge turns minor-dim sharding into a STRIDED pattern of
-    the merged dim, so pinning the factor to the group's major output
-    dim would silently migrate shard knowledge to the wrong dimension
-    — an anti-conservative per-device underestimate, the exact failure
-    the conservative cap exists to prevent."""
+    the merged dim, so pinning the factor anywhere would silently
+    migrate shard knowledge to the wrong dimension — an
+    anti-conservative per-device underestimate, the exact failure the
+    conservative cap exists to prevent."""
     n, m = len(in_shape), len(out_shape)
     out = []
     i = j = 0
@@ -320,20 +337,36 @@ def _reshape_dim_shards(in_shape, in_dims, out_shape):
                 gj.append(j)
                 j += 1
         factor = 1
-        seen_nonunit = False
+        whole_prefix = True                  # fully-sharded so far?
         for g in gi:                         # major -> minor
             f = int(in_dims[g])
+            sh = int(in_shape[g])
             if f > 1:
-                if seen_nonunit:             # factor on a minor dim:
+                if not whole_prefix:         # factor on a minor dim:
                     return None              # strided, unrepresentable
-                factor = f
-            if int(in_shape[g]) > 1:
-                seen_nonunit = True
+                factor *= f
+                if f != sh:                  # partial split ends the
+                    whole_prefix = False     # mergeable prefix
+            elif sh > 1:
+                whole_prefix = False
         group = [1] * len(gj)
-        if factor > 1:
-            if int(out_shape[gj[0]]) % factor:
-                return None
-            group[0] = factor
+        f = factor
+        for pos, g in enumerate(gj):         # peel major-first
+            if f == 1:
+                break
+            od = int(out_shape[g])
+            if f >= od:
+                if f % od:
+                    return None              # shard straddles the tile
+                group[pos] = od
+                f //= od
+            else:
+                if od % f:
+                    return None
+                group[pos] = f
+                f = 1
+        if f != 1:
+            return None
         out.extend(group)
     # trailing size-1 dims on either side carry no sharding
     while i < n:
@@ -625,35 +658,26 @@ def _eqn_out_shard(eqn, in_counts, in_dims):
 
 
 def propagate_shard_counts(jx, arg_counts=None, arg_dims=None):
-    """{var: shard_count} over one jaxpr, using the same propagation
-    rules as the liveness walk (`_eqn_out_shard`: max-operand heuristic,
-    refined with per-dim counts where known — contracted `dot_general`
-    dims drop their sharding instead of leaking into the output). The
+    """{var: shard_count} over one jaxpr. Since v2 this is a thin
+    wrapper over the fixed-point pass (`propagation.propagate_shardings`
+    — forward AND backward sweeps, constraint-eqn seeding, scan/while/
+    pjit body recursion): where the fixed point pinned a concrete
+    per-dim spec, its product wins; everywhere else the count comes
+    from the same single forward sweep of `_eqn_out_shard` as v1
+    (max-operand heuristic with conservative caps) — on a program with
+    no mid-graph pins and no backward-reachable specs the two are
+    identical, so this stays the documented conservative fallback. The
     remat advisor prices dropped/saved residuals per device with it.
     `arg_dims` optionally seeds per-dim shard counts per invar (aligned
     with `arg_counts`; `lowering.ArgInfo.dim_shards` supplies them)."""
-    jx = jx.jaxpr if hasattr(jx, "jaxpr") else jx
-    counts = {}
-    dims = {}
-    for k, v in enumerate(jx.invars):
-        counts[v] = (arg_counts[k]
-                     if arg_counts and k < len(arg_counts) else 1)
-        dims[v] = (arg_dims[k]
-                   if arg_dims and k < len(arg_dims) else None)
-    for eqn in jx.eqns:
-        ivs = [v for v in eqn.invars if _is_var(v)]
-        out, out_dims = _eqn_out_shard(
-            eqn, [counts.get(v, 1) for v in ivs],
-            [dims.get(v) for v in ivs])
-        for v in eqn.outvars:
-            counts[v] = out
-            dims[v] = out_dims
-    return counts
+    from .propagation import propagate_shardings
+    return propagate_shardings(jx, arg_counts=arg_counts,
+                               arg_dims=arg_dims).counts
 
 
 def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
                           cpu_calibrated=False, last_use_override=None,
-                          extra_after=None):
+                          extra_after=None, var_counts=None):
     """Static per-device HBM estimate of one closed jaxpr.
 
     `arg_infos`: optional list of `lowering.ArgInfo` aligned with the
@@ -666,6 +690,12 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
     walk — the remat advisor's what-if replay (remat_advisor.py) re-runs
     the SAME walk with checkpointed intermediates dropped and one
     segment's recompute working set added past the fwd/bwd boundary.
+
+    `var_counts`: optional fixed-point shard counts
+    (`propagation.PropagationResult.counts`) overriding the walk's
+    inline forward propagation per var — the MemoryAnalyzer passes the
+    propagation pass's result so pricing sees mid-graph constraint pins;
+    without it the walk's own sweep is the conservative fallback.
     """
     jx = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
     infos = arg_infos or []
@@ -675,7 +705,8 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
     peak, peak_idx, top = _walk(
         jx, arg_counts=arg_counts, donated=donated, widen=cpu_calibrated,
         pin_invars=True, memo=memo, top_k=top_k, arg_infos=infos,
-        last_use_override=last_use_override, extra_after=extra_after)
+        last_use_override=last_use_override, extra_after=extra_after,
+        var_counts=var_counts)
 
     def _arg_db(k, v):
         cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
@@ -728,9 +759,15 @@ class MemoryAnalyzer(Analyzer):
         if getattr(program, "jaxpr", None) is None:
             self.metrics = {"available": False}
             return []
+        # the fixed-point pass ran just before this one (registration
+        # order) and stashed its result; result_for recomputes when the
+        # pass manager was bypassed or the program changed underneath
+        from .propagation import result_for
+        prop = result_for(program, ctx)
         est = estimate_jaxpr_memory(
             program.jaxpr, arg_infos=getattr(program, "arg_infos", None),
-            top_k=ctx.extra.get("memory_top_k", 8))
+            top_k=ctx.extra.get("memory_top_k", 8),
+            var_counts=prop.counts if prop is not None else None)
         self.metrics = {"available": True, **est.to_dict()}
         findings = []
         committed = (ctx.memory_manifest or {})

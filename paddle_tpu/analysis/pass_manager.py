@@ -36,11 +36,15 @@ def get_analyzer(name):
 
 
 def default_catalog():
-    """Registered analyzer names, registration-ordered."""
-    from . import analyzers as _a   # noqa: F401  (registers graph passes)
-    from . import memory as _m      # noqa: F401  (registers memory pass)
-    from . import sharding as _s    # noqa: F401  (registers sharding pass)
-    from . import ast_lint as _l    # noqa: F401  (registers source pass)
+    """Registered analyzer names, registration-ordered. propagation
+    imports BEFORE memory/sharding on purpose: those passes consume the
+    fixed-point result the PropagationAnalyzer stashes on ctx.extra, so
+    it must run (= register) first."""
+    from . import analyzers as _a     # noqa: F401  (registers graph passes)
+    from . import propagation as _p   # noqa: F401  (registers propagation)
+    from . import memory as _m        # noqa: F401  (registers memory pass)
+    from . import sharding as _s      # noqa: F401  (registers sharding pass)
+    from . import ast_lint as _l      # noqa: F401  (registers source pass)
     return list(_REGISTRY)
 
 

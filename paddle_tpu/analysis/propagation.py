@@ -1,0 +1,617 @@
+"""Sharding propagation v2 — a fixed-point GSPMD-style dataflow pass.
+
+The memory pass, remat advisor, autotuner and the SHARD-* lints all
+price tensors per device, which requires knowing each tensor's shard
+count. v1 was a single forward sweep seeded from ARG specs only
+(`memory._eqn_out_shard` applied eqn by eqn): intermediates whose
+sharding is pinned mid-program (`with_sharding_constraint`) or implied
+only by a CONSUMER (a dot whose other operand is sharded, a transpose
+feeding an annotated output) fell back to the max-operand guess.
+
+v2 runs the same per-primitive transfer rules to a FIXED POINT, in both
+directions, over the whole jaxpr including scan/while/pjit/cond bodies
+(the recursion mirrors `analysis/schedule.py`):
+
+* **Seeding.** Three sources, in decreasing authority: per-dim counts
+  from `ArgInfo.dim_shards` (what the caller committed to);
+  `sharding_constraint` equations, whose `sharding` param IS the spec
+  GSPMD will honor (their outputs are pinned — and the lowered
+  StableHLO's `mhlo.sharding` annotations cross-check both, see
+  `lowering.harvest_hlo_shardings`); and optional `out_dims`
+  (out_shardings). An arg known to be UNSHARDED (shard_count == 1 with
+  no dim vector) seeds as exactly replicated — `(1,) * rank` is a real
+  spec, not an unknown — which is what makes the committed
+  single-device configs fully exact under this pass.
+* **Fixed point.** A monotone lattice per var: unknown -> one concrete
+  per-dim count vector, first write wins, no downgrades. Forward
+  transfer is `memory._eqn_out_shard` (the rule list stays in ONE
+  place); backward transfer inverts the structural rules (transpose
+  permutation, reshape factor groups, dot_general batch/free dims,
+  same-shape elementwise) so a downstream pin reaches upstream
+  producers. Each sweep only fills unknowns, so the pass converges in
+  at most O(longest def-use chain) sweeps and is hard-capped at
+  `max_iters`.
+* **No backward transfer through `sharding_constraint`.** The
+  constraint REPLACES the spec; propagating it onto its input would
+  erase exactly the disagreement SHARD-PROP-DIVERGENCE exists to
+  report (the implicit reshard GSPMD inserts to honor the pin).
+* **Fallback.** Vars still unknown after the fixed point price through
+  the v1 heuristic unchanged (max-operand count, conservative caps):
+  under-counting shards OVERestimates per-device bytes, the safe
+  direction for every gate that consumes this pass.
+
+The result also carries the two lint feeds: `divergences` (propagated
+spec vs constraint/lowered annotation — SHARD-PROP-DIVERGENCE) and
+`loop_reshards` (scan/while body whose carry output spec mismatches its
+carry input — a per-iteration reshard inside the hot loop,
+SHARD-LOOP-CARRY-RESHARD). Cross-checking the static pass against the
+stage below it is the TPU-MLIR verification discipline (arxiv
+2210.15016); per-op true shardings as the basis for overlap pricing is
+the T3 prerequisite (arxiv 2401.16677).
+
+NOTE: this module must not import `.memory` at module scope — analyzer
+registration order (propagation before memory, so MemoryAnalyzer can
+consume the stashed result) is set by import order in
+`default_catalog`/`__init__`, and a top-level import here would flip
+it. All memory helpers are imported lazily inside functions.
+"""
+from dataclasses import dataclass, field
+
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["PropagationResult", "propagate_shardings",
+           "PropagationAnalyzer"]
+
+_MAX_ITERS = 64
+
+
+def _prod(dims):
+    total = 1
+    for d in dims:
+        total *= int(d)
+    return max(total, 1)
+
+
+def _rank(v):
+    return len(getattr(v.aval, "shape", ()) or ())
+
+
+def _unclosed(jx):
+    return jx.jaxpr if hasattr(jx, "jaxpr") else jx
+
+
+@dataclass
+class PropagationResult:
+    """Outcome of one fixed-point propagation over a jaxpr."""
+    dims: dict = field(default_factory=dict)    # var -> per-dim counts
+    counts: dict = field(default_factory=dict)  # var -> total shard count
+    divergences: list = field(default_factory=list)
+    loop_reshards: list = field(default_factory=list)
+    n_vars: int = 0              # all vars (args, consts, eqn outputs)
+    n_exact: int = 0             # vars with a concrete per-dim spec
+    n_constraints: int = 0       # sharding_constraint eqns seen
+    n_annotated: int = 0         # lowered-HLO annotations cross-checked
+    n_agree: int = 0             # annotations matching the static spec
+    n_diverge: int = 0           # annotations contradicting it
+    n_unmapped: int = 0          # annotations we could not parse/map
+    iterations: int = 0
+    converged: bool = True
+    jaxpr_id: int = 0            # id() of the analyzed jaxpr (reuse guard)
+
+    @property
+    def n_fallback(self):
+        return self.n_vars - self.n_exact
+
+    @property
+    def agreement_rate(self):
+        """Exact-match rate over lowered annotations; 1.0 by convention
+        when the module carries none (single-device programs)."""
+        if not self.n_annotated:
+            return 1.0
+        return self.n_agree / self.n_annotated
+
+    def summary(self):
+        return {
+            "n_vars": self.n_vars,
+            "n_exact": self.n_exact,
+            "n_fallback": self.n_fallback,
+            "n_constraints": self.n_constraints,
+            "n_annotated": self.n_annotated,
+            "n_agree": self.n_agree,
+            "n_diverge": self.n_diverge,
+            "n_unmapped": self.n_unmapped,
+            "agreement_rate": round(self.agreement_rate, 4),
+            "n_divergences": len(self.divergences),
+            "n_loop_carry_reshards": len(self.loop_reshards),
+            "iterations": self.iterations,
+            "converged": self.converged,
+        }
+
+
+def _constraint_dims(eqn):
+    """The per-dim counts a sharding_constraint eqn pins, or None when
+    the sharding object carries no NamedSharding mesh/spec."""
+    from .lowering import sharding_dim_counts
+    sharding = eqn.params.get("sharding")
+    return sharding_dim_counts(sharding, _rank(eqn.outvars[0]))
+
+
+def _set(dims, v, spec):
+    """Monotone write: fill an unknown var with a concrete spec (rank
+    checked); never overwrite. Returns True when something changed."""
+    from .memory import _is_var
+    if spec is None or not _is_var(v) or v in dims:
+        return False
+    if len(spec) != _rank(v):
+        return False
+    dims[v] = tuple(int(d) for d in spec)
+    return True
+
+
+def _link(dims, a, b, both=False):
+    """Copy a known spec across an equal-value boundary (call operand ->
+    body invar, body outvar -> call result). `both` also lifts the
+    inner spec back out — safe only where the two vars really alias the
+    same value (1:1 inlined calls, loop consts), NOT for loop carries
+    (the body sees the steady-state spec, the outer init may differ —
+    that difference is the SHARD-LOOP-CARRY-RESHARD signal)."""
+    from .memory import _is_var
+    changed = False
+    da = dims.get(a) if _is_var(a) else None
+    if da is not None:
+        changed |= _set(dims, b, da)
+    if both:
+        db = dims.get(b) if _is_var(b) else None
+        if db is not None:
+            changed |= _set(dims, a, db)
+    return changed
+
+
+def _sweep(jx, dims):
+    """One forward + one backward pass over a jaxpr (recursing into sub
+    jaxprs). The caller iterates to the global fixed point."""
+    changed = _forward_sweep(jx, dims)
+    changed |= _backward_sweep(jx, dims)
+    return changed
+
+
+def _forward_sweep(jx, dims):
+    from .memory import _eqn_out_shard, _is_var, _sub_jaxprs
+    changed = False
+    for eqn in jx.eqns:
+        name = eqn.primitive.name
+        if name == "sharding_constraint":
+            changed |= _set(dims, eqn.outvars[0], _constraint_dims(eqn))
+            continue
+        if _sub_jaxprs(eqn):
+            changed |= _propagate_sub(eqn, dims)
+            continue
+        ivs = [v for v in eqn.invars if _is_var(v)]
+        in_dims = [dims.get(v) for v in ivs]
+        in_counts = [_prod(d) if d is not None else 1 for d in in_dims]
+        out_count, out_dims = _eqn_out_shard(eqn, in_counts, in_dims)
+        if out_dims is not None:
+            for v in eqn.outvars:
+                changed |= _set(dims, v, out_dims)
+    return changed
+
+
+def _backward_sweep(jx, dims):
+    """Invert the structural transfer rules: a known OUTPUT spec fills
+    unknown inputs. Constraint eqns are never walked through (see module
+    docstring); sub-jaxpr eqns were handled by the forward recursion."""
+    from .memory import (_is_var, _reshape_dim_shards, _sub_jaxprs)
+    changed = False
+    for eqn in reversed(jx.eqns):
+        name = eqn.primitive.name
+        if name == "sharding_constraint" or _sub_jaxprs(eqn):
+            continue
+        if len(eqn.outvars) != 1:
+            continue
+        ov = eqn.outvars[0]
+        od = dims.get(ov)
+        if od is None:
+            continue
+        out_shape = tuple(getattr(ov.aval, "shape", ()))
+        if name == "transpose":
+            perm = eqn.params.get("permutation")
+            iv = eqn.invars[0]
+            if perm is not None and len(perm) == len(od):
+                ind = [1] * len(od)
+                for i, p in enumerate(perm):
+                    ind[int(p)] = int(od[i])
+                changed |= _set(dims, iv, ind)
+            continue
+        if name == "reshape":
+            iv = eqn.invars[0]
+            if _is_var(iv):
+                in_shape = tuple(getattr(iv.aval, "shape", ()))
+                try:
+                    d = _reshape_dim_shards(out_shape, od, in_shape)
+                except Exception:
+                    d = None
+                changed |= _set(dims, iv, d)
+            continue
+        if name == "dot_general":
+            changed |= _backward_dot(eqn, od, dims)
+            continue
+        # elementwise default: the output spec holds for every
+        # same-shaped operand (GSPMD propagates through elementwise ops
+        # unchanged in both directions)
+        for iv in eqn.invars:
+            if _is_var(iv) and \
+                    tuple(getattr(iv.aval, "shape", ())) == out_shape:
+                changed |= _set(dims, iv, od)
+    return changed
+
+
+def _backward_dot(eqn, od, dims):
+    """dot_general output layout is (batch, lhs free, rhs free): map
+    those factors back onto operand dims; contracted dims seed as
+    UNSHARDED (1) — conservative: if they were in fact sharded we
+    under-count shards, which overestimates per-device bytes."""
+    from .memory import _is_var
+    changed = False
+    try:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    except Exception:
+        return False
+    ivs = [v for v in eqn.invars if _is_var(v)]
+    if len(ivs) != 2:
+        return False
+    lhs, rhs = ivs
+    lrank, rrank = _rank(lhs), _rank(rhs)
+    lfree = [i for i in range(lrank) if i not in set(lc) | set(lb)]
+    rfree = [i for i in range(rrank) if i not in set(rc) | set(rb)]
+    nb = len(lb)
+    if len(od) != nb + len(lfree) + len(rfree):
+        return False
+    ld = [1] * lrank
+    for i, p in enumerate(lb):
+        ld[int(p)] = int(od[i])
+    for i, p in enumerate(lfree):
+        ld[int(p)] = int(od[nb + i])
+    changed |= _set(dims, lhs, ld)
+    rd = [1] * rrank
+    for i, p in enumerate(rb):
+        rd[int(p)] = int(od[i])
+    for i, p in enumerate(rfree):
+        rd[int(p)] = int(od[nb + len(lfree) + i])
+    changed |= _set(dims, rhs, rd)
+    return changed
+
+
+def _propagate_sub(eqn, dims):
+    """Map specs across a call boundary and sweep the body once. scan /
+    while get their loop-aware operand split; everything else (pjit,
+    remat, custom_vjp/jvp, cond) maps 1:1 where arity matches."""
+    from .memory import _is_var, _sub_jaxprs
+    name = eqn.primitive.name
+    changed = False
+    if name == "scan":
+        body = _unclosed(eqn.params["jaxpr"])
+        nc = int(eqn.params.get("num_consts", 0))
+        ncar = int(eqn.params.get("num_carry", 0))
+        ivs = list(eqn.invars)
+        for i in range(min(nc, len(ivs))):
+            changed |= _link(dims, ivs[i], body.invars[i], both=True)
+        for i in range(nc, min(nc + ncar, len(ivs))):
+            changed |= _link(dims, ivs[i], body.invars[i])
+        # xs operands carry a leading scan dim the body never sees; the
+        # split is only clean when that dim is unsharded
+        for i in range(nc + ncar, len(ivs)):
+            if not _is_var(ivs[i]):
+                continue
+            od = dims.get(ivs[i])
+            if od is not None and len(od) >= 1 and int(od[0]) == 1:
+                changed |= _set(dims, body.invars[i], od[1:])
+        changed |= _sweep(body, dims)
+        outs = list(eqn.outvars)
+        for i in range(min(ncar, len(outs))):
+            changed |= _link(dims, body.outvars[i], outs[i])
+        for i in range(ncar, len(outs)):
+            bd = dims.get(body.outvars[i]) \
+                if i < len(body.outvars) else None
+            if bd is not None:
+                changed |= _set(dims, outs[i], (1,) + tuple(bd))
+            od = dims.get(outs[i]) if _is_var(outs[i]) else None
+            if od is not None and len(od) >= 1 and int(od[0]) == 1 and \
+                    i < len(body.outvars):
+                changed |= _set(dims, body.outvars[i], od[1:])
+        return changed
+    if name == "while":
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        cond = _unclosed(eqn.params["cond_jaxpr"])
+        body = _unclosed(eqn.params["body_jaxpr"])
+        ivs = list(eqn.invars)
+        for i in range(min(cn, len(cond.invars))):
+            changed |= _link(dims, ivs[i], cond.invars[i], both=True)
+        for i in range(min(bn, len(body.invars))):
+            changed |= _link(dims, ivs[cn + i], body.invars[i],
+                             both=True)
+        ncar = len(ivs) - cn - bn
+        for i in range(ncar):
+            ov = ivs[cn + bn + i]
+            if bn + i < len(body.invars):
+                changed |= _link(dims, ov, body.invars[bn + i])
+            if cn + i < len(cond.invars):
+                changed |= _link(dims, ov, cond.invars[cn + i])
+        changed |= _sweep(cond, dims)
+        changed |= _sweep(body, dims)
+        for i in range(min(ncar, len(eqn.outvars), len(body.outvars))):
+            changed |= _link(dims, body.outvars[i], eqn.outvars[i])
+        return changed
+    if name == "cond":
+        branches = [_unclosed(b) for b in eqn.params.get("branches", ())]
+        ivs = list(eqn.invars)[1:]          # drop the predicate
+        for br in branches:
+            for ov, bv in zip(ivs, br.invars):
+                changed |= _link(dims, ov, bv)
+            changed |= _sweep(br, dims)
+        # an output spec is only known when every branch agrees
+        for i, ov in enumerate(eqn.outvars):
+            specs = [dims.get(br.outvars[i]) for br in branches
+                     if i < len(br.outvars)]
+            if specs and all(s is not None for s in specs) and \
+                    len({tuple(s) for s in specs}) == 1:
+                changed |= _set(dims, ov, specs[0])
+        return changed
+    # generic 1:1 call (pjit, remat, custom_jvp/vjp, checkpoint): map
+    # any sub-jaxpr whose arity matches the eqn exactly
+    for sub in _sub_jaxprs(eqn):
+        if len(sub.invars) == len(eqn.invars) and \
+                len(sub.outvars) == len(eqn.outvars):
+            for ov, bv in zip(eqn.invars, sub.invars):
+                changed |= _link(dims, ov, bv, both=True)
+            changed |= _sweep(sub, dims)
+            for bv, ov in zip(sub.outvars, eqn.outvars):
+                changed |= _link(dims, bv, ov, both=True)
+        else:
+            changed |= _sweep(sub, dims)
+    return changed
+
+
+def _report(jx, dims, res):
+    """Post-fixpoint walk: coverage counters, constraint divergences,
+    loop-carry reshards. Recursive over sub-jaxprs."""
+    from .memory import _eqn_source, _is_var, _sub_jaxprs
+    for v in list(jx.invars) + list(jx.constvars):
+        res.n_vars += 1
+        if v in dims:
+            res.n_exact += 1
+    for idx, eqn in enumerate(jx.eqns):
+        for v in eqn.outvars:
+            res.n_vars += 1
+            if v in dims:
+                res.n_exact += 1
+        name = eqn.primitive.name
+        if name == "sharding_constraint":
+            res.n_constraints += 1
+            want = _constraint_dims(eqn)
+            ivs = [v for v in eqn.invars if _is_var(v)]
+            got = dims.get(ivs[0]) if ivs else None
+            if want is not None and got is not None and \
+                    tuple(got) != tuple(want):
+                res.divergences.append({
+                    "source": _eqn_source(eqn, idx),
+                    "annotated": [int(d) for d in want],
+                    "propagated": [int(d) for d in got]})
+        elif name == "scan":
+            body = _unclosed(eqn.params["jaxpr"])
+            nc = int(eqn.params.get("num_consts", 0))
+            ncar = int(eqn.params.get("num_carry", 0))
+            for i in range(ncar):
+                if nc + i >= len(body.invars) or i >= len(body.outvars):
+                    continue
+                din = dims.get(body.invars[nc + i])
+                dout = dims.get(body.outvars[i])
+                if din is not None and dout is not None and \
+                        tuple(din) != tuple(dout):
+                    res.loop_reshards.append({
+                        "source": _eqn_source(eqn, idx), "carry": i,
+                        "in": [int(d) for d in din],
+                        "out": [int(d) for d in dout]})
+        elif name == "while":
+            body = _unclosed(eqn.params["body_jaxpr"])
+            bn = int(eqn.params.get("body_nconsts", 0))
+            for i in range(len(body.outvars)):
+                if bn + i >= len(body.invars):
+                    continue
+                din = dims.get(body.invars[bn + i])
+                dout = dims.get(body.outvars[i])
+                if din is not None and dout is not None and \
+                        tuple(din) != tuple(dout):
+                    res.loop_reshards.append({
+                        "source": _eqn_source(eqn, idx), "carry": i,
+                        "in": [int(d) for d in din],
+                        "out": [int(d) for d in dout]})
+        for sub in _sub_jaxprs(eqn):
+            _report(sub, dims, res)
+
+
+def _final_counts(jx, dims, arg_counts):
+    """{var: total shard count} over the TOP-LEVEL jaxpr: the product of
+    the fixed-point per-dim spec where known, the v1 forward heuristic
+    (`_eqn_out_shard` with conservative caps) where not — byte-for-byte
+    the old `propagate_shard_counts` on a program with no mid-graph
+    pins."""
+    from .memory import _eqn_out_shard, _is_var
+    counts = {}
+    for k, v in enumerate(jx.invars):
+        d = dims.get(v)
+        cnt = _prod(d) if d is not None else None
+        if arg_counts and k < len(arg_counts):
+            # per-dim counts carry no mesh-axis identity, so a dim-spec
+            # product can over-claim vs the arg's actual shard count —
+            # keep the v1 cap (min = fewer shards = per-device bytes
+            # OVERestimated, the safe direction)
+            cnt = arg_counts[k] if cnt is None else min(cnt, arg_counts[k])
+        counts[v] = cnt if cnt is not None else 1
+    for eqn in jx.eqns:
+        ivs = [v for v in eqn.invars if _is_var(v)]
+        in_counts = [counts.get(v, 1) for v in ivs]
+        out, _ = _eqn_out_shard(eqn, in_counts, [dims.get(v) for v in ivs])
+        # the same no-axis-identity cap v1 applied: an output never
+        # claims finer sharding than its most-sharded operand
+        cap = max(in_counts, default=1)
+        for v in eqn.outvars:
+            d = dims.get(v)
+            counts[v] = min(_prod(d), cap) if d is not None else out
+    return counts
+
+
+def _cross_check_hlo(text, jx, dims, res):
+    """Cross-check the static fixed point against what XLA actually
+    lowered: `mhlo.sharding` annotations on the module's entry args and
+    on `@Sharding` custom_calls (the lowered form of every
+    `sharding_constraint` eqn, matched in depth-first eqn order)."""
+    from .lowering import harvest_hlo_shardings, parse_hlo_sharding
+    from .memory import _is_var, _sub_jaxprs
+    harvested = harvest_hlo_shardings(text)
+    for n, raw in sorted(harvested["args"].items()):
+        if n >= len(jx.invars):
+            res.n_unmapped += 1
+            continue
+        v = jx.invars[n]
+        want = parse_hlo_sharding(raw, _rank(v))
+        if want is None:
+            res.n_unmapped += 1
+            continue
+        res.n_annotated += 1
+        got = dims.get(v)
+        if got is None:
+            # fallback var: conservative direction, neither agreement
+            # nor divergence — it drags the rate down, as it should
+            continue
+        if tuple(got) == tuple(want):
+            res.n_agree += 1
+        else:
+            res.n_diverge += 1
+            res.divergences.append({
+                "source": f"%arg{n}",
+                "annotated": [int(d) for d in want],
+                "propagated": [int(d) for d in got]})
+
+    ceqns = []
+
+    def _collect(sub_jx):
+        from .memory import _eqn_source
+        for idx, eqn in enumerate(sub_jx.eqns):
+            if eqn.primitive.name == "sharding_constraint":
+                ceqns.append((eqn, _eqn_source(eqn, idx)))
+            for sub in _sub_jaxprs(eqn):
+                _collect(sub)
+
+    _collect(jx)
+    anns = harvested["constraints"]
+    res.n_unmapped += abs(len(anns) - len(ceqns))
+    for raw, (eqn, src) in zip(anns, ceqns):
+        want = parse_hlo_sharding(raw, _rank(eqn.outvars[0]))
+        have = _constraint_dims(eqn)
+        if want is None or have is None:
+            res.n_unmapped += 1
+            continue
+        res.n_annotated += 1
+        if tuple(want) == tuple(have):
+            res.n_agree += 1
+        else:
+            res.n_diverge += 1
+            res.divergences.append({
+                "source": src,
+                "annotated": [int(d) for d in want],
+                "propagated": [int(d) for d in have]})
+
+
+def propagate_shardings(program_or_jaxpr, arg_infos=None, arg_counts=None,
+                        arg_dims=None, out_dims=None,
+                        max_iters=_MAX_ITERS):
+    """Run the fixed-point propagation over a LoweredProgram or (closed)
+    jaxpr. Returns a PropagationResult.
+
+    Seeds: `arg_dims` (or `arg_infos[k].dim_shards`) per invar, with
+    shard_count==1 args pinned to exactly-replicated; every
+    `sharding_constraint` eqn's output; optional `out_dims` per program
+    outvar (out_shardings). When the program carries StableHLO text the
+    lowered `mhlo.sharding` annotations are cross-checked into the
+    agreement counters and divergence list."""
+    program = program_or_jaxpr
+    jx = getattr(program, "jaxpr", None)
+    if jx is None:
+        jx = program
+        program = None
+    if arg_infos is None and program is not None:
+        arg_infos = getattr(program, "arg_infos", None)
+    jx = _unclosed(jx)
+    if arg_counts is None and arg_infos:
+        arg_counts = [i.shard_count for i in arg_infos]
+    if arg_dims is None and arg_infos:
+        arg_dims = [getattr(i, "dim_shards", None) for i in arg_infos]
+
+    dims = {}
+    for k, v in enumerate(jx.invars):
+        d = arg_dims[k] if arg_dims and k < len(arg_dims) else None
+        cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
+        if d is not None:
+            _set(dims, v, d)
+        elif cnt <= 1:
+            # unsharded is a concrete spec, not an unknown
+            _set(dims, v, (1,) * _rank(v))
+    for v in jx.constvars:
+        # baked constants are replicated onto every device
+        _set(dims, v, (1,) * _rank(v))
+    if out_dims:
+        for v, d in zip(jx.outvars, out_dims):
+            _set(dims, v, d)
+
+    iterations, converged = 0, False
+    while iterations < max_iters:
+        iterations += 1
+        if not _sweep(jx, dims):
+            converged = True
+            break
+
+    res = PropagationResult(dims=dims, iterations=iterations,
+                            converged=converged, jaxpr_id=id(jx))
+    _report(jx, dims, res)
+    res.counts = _final_counts(jx, dims, arg_counts)
+    text = getattr(program, "text", None) if program is not None else None
+    if text:
+        _cross_check_hlo(text, jx, dims, res)
+    return res
+
+
+def result_for(program, ctx=None):
+    """The propagation result for `program`: the one PropagationAnalyzer
+    stashed on `ctx.extra` when it matches this program's jaxpr,
+    computed on demand otherwise (the passes can run standalone)."""
+    jx = getattr(program, "jaxpr", None)
+    if jx is None:
+        return None
+    cached = (ctx.extra.get("propagation_result")
+              if ctx is not None and getattr(ctx, "extra", None) is not None
+              else None)
+    if cached is not None and cached.jaxpr_id == id(_unclosed(jx)):
+        return cached
+    return propagate_shardings(program)
+
+
+@register_analyzer
+class PropagationAnalyzer(Analyzer):
+    """Sharding-propagation pass: runs the fixed point once per program
+    and stashes the result on `ctx.extra["propagation_result"]` for the
+    memory and sharding passes (it registers BEFORE them — import order
+    in `default_catalog`). Emits no findings itself: the divergence and
+    loop-reshard lints live in `sharding.ShardingAnalyzer`, next to the
+    other SHARD-* rules. Metrics feed
+    propagation_manifests/<config>.json."""
+    name = "propagation"
+
+    def run(self, program, ctx):
+        if getattr(program, "jaxpr", None) is None:
+            self.metrics = {"available": False}
+            return []
+        res = propagate_shardings(program)
+        ctx.extra["propagation_result"] = res
+        self.metrics = {"available": True, **res.summary()}
+        return []

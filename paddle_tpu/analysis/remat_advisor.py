@@ -255,15 +255,22 @@ def _prepare_replay(program_or_jaxpr, arg_infos=None, boundary=None):
     defs, uses, _n = _collect(jx)
     if boundary is None:
         boundary = find_boundary(jx)
+    # fixed-point counts (analysis/propagation.py): per-dim specs where
+    # the lowering pinned them, v1 heuristic everywhere else — so the
+    # per-device residual pricing sees the same shards the memory pass
+    # prices
     counts = propagate_shard_counts(
-        jx, [i.shard_count for i in arg_infos] if arg_infos else None)
+        jx, [i.shard_count for i in arg_infos] if arg_infos else None,
+        arg_dims=([getattr(i, "dim_shards", None) for i in arg_infos]
+                  if arg_infos else None))
     residuals = []
     for v, d in defs.items():
         us = uses.get(v, [])
         if d <= boundary and us and max(us) > boundary:
             fwd = [u for u in us if u <= boundary]
             residuals.append((v, d, max(fwd) if fwd else d))
-    base = estimate_jaxpr_memory(jx, arg_infos=arg_infos, top_k=0)
+    base = estimate_jaxpr_memory(jx, arg_infos=arg_infos, top_k=0,
+                                 var_counts=counts)
     return _ReplayBase(
         jx=jx, arg_infos=arg_infos, defs=defs, uses=uses,
         boundary=boundary, counts=counts, residuals=residuals,
@@ -326,7 +333,8 @@ def replay_remat(program_or_jaxpr, policy, arg_infos=None, segments=1,
     est = estimate_jaxpr_memory(jx, arg_infos=base.arg_infos,
                                 top_k=top_k,
                                 last_use_override=overrides,
-                                extra_after=(boundary, bump))
+                                extra_after=(boundary, bump),
+                                var_counts=counts)
 
     recompute = 0
     if policy != "none":

@@ -22,9 +22,20 @@ Rules (docs/static_analysis.md):
   SHARD-UNKNOWN-PAYLOAD     a collective whose payload can't be sized
                             from the HLO types (symbolic dims) — the
                             wire accounting under-reports
+  SHARD-PROP-DIVERGENCE     the fixed-point propagation pass
+                            (analysis/propagation.py) disagrees with a
+                            sharding_constraint pin or a lowered
+                            mhlo.sharding annotation — GSPMD inserts an
+                            implicit reshard (or silent replication)
+                            the HBM/wire pricing missed
+  SHARD-LOOP-CARRY-RESHARD  a scan/while carry whose body OUTPUT spec
+                            mismatches its carry INPUT spec — a
+                            reshard on every loop iteration, inside
+                            the hot loop
 
 Metrics: replicated big-tensor count/bytes, per-role shard coverage,
-and the cost-model wire-byte total the memory manifest pins.
+the cost-model wire-byte total the memory manifest pins, and the
+propagation pass's divergence/agreement counters.
 """
 import re
 
@@ -147,6 +158,39 @@ class ShardingAnalyzer(Analyzer):
                 "manifest (python -m paddle_tpu.analysis --memory) and "
                 "regenerate if intentional"))
 
+        # propagation cross-check lints: the fixed-point pass
+        # (registered before this one) stashed its result on ctx
+        from .propagation import result_for
+        prop = result_for(program, ctx)
+        n_prop_div = n_loop_reshard = 0
+        if prop is not None:
+            for d in prop.divergences:
+                n_prop_div += 1
+                findings.append(Finding(
+                    "SHARD-PROP-DIVERGENCE", Severity.WARNING,
+                    f"static propagation says {d['propagated']} at "
+                    f"{d['source']} but the pinned/lowered sharding is "
+                    f"{d['annotated']} — GSPMD resolves the mismatch "
+                    "with an implicit reshard (or silent replication) "
+                    "the HBM/wire pricing missed",
+                    suggested_fix="align the producer's spec with the "
+                    "constraint (or fix the constraint): the upstream "
+                    "with_sharding_constraint / in_shardings and this "
+                    "pin must agree, or the move is priced on the "
+                    "step's critical path"))
+            for r in prop.loop_reshards:
+                n_loop_reshard += 1
+                findings.append(Finding(
+                    "SHARD-LOOP-CARRY-RESHARD", Severity.WARNING,
+                    f"loop carry #{r['carry']} at {r['source']} enters "
+                    f"the body as {r['in']} but leaves as {r['out']} — "
+                    "GSPMD reshards the carry on EVERY iteration, "
+                    "inside the hot loop",
+                    suggested_fix="make the body produce the carry in "
+                    "its input spec (move the with_sharding_constraint "
+                    "out of the loop, or constrain the carry init to "
+                    "the body's output spec)"))
+
         self.metrics = {
             "n_args": len(infos),
             "n_replicated_big": len(replicated),
@@ -154,6 +198,10 @@ class ShardingAnalyzer(Analyzer):
             "n_mid_program_reshards": n_reshards,
             "total_wire_bytes": total_wire,
             "sharded_by_role": self._role_coverage(infos),
+            "n_prop_divergences": n_prop_div,
+            "n_loop_carry_reshards": n_loop_reshard,
+            "prop_agreement_rate": (round(prop.agreement_rate, 4)
+                                    if prop is not None else None),
         }
         return findings
 

@@ -109,9 +109,15 @@ def test_zigzag_flash_matches_reference():
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_ulysses_matches_reference():
     """ops/ulysses.py — all-to-all head-resharding SP equals full attention
-    (fwd + grad) on the 8-device mesh."""
+    (fwd + grad) on the 8-device mesh.
+
+    `slow`: seq-256 fwd x2 + three grad traces under an sp=8 mesh —
+    36 s under full-suite load, the next-worst tier-1 entry after the
+    PR-15 zigzag marks (docs/performance.md wall-clock table). The
+    small fwd smoke below keeps ulysses tier-1-covered."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.distributed import build_mesh
@@ -130,6 +136,23 @@ def test_ulysses_matches_reference():
         ulysses_attention(q, k, v, mesh=mesh, causal=True) ** 2))(q)
     gr = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v, causal=True) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
+
+
+def test_ulysses_smoke_small():
+    """Tier-1 ulysses coverage after the reference test went `slow`: a
+    seq-64 causal forward against the dense reference — exercises the
+    all-to-all head reshard + attention path in a few seconds."""
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.ops.attention import mha_reference
+    from paddle_tpu.ops.ulysses import ulysses_attention
+    mesh = build_mesh(sp=8)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 64, 8, 16).astype(np.float32)) * 0.1
+    k = jnp.asarray(rng.randn(1, 64, 8, 16).astype(np.float32)) * 0.1
+    v = jnp.asarray(rng.randn(1, 64, 8, 16).astype(np.float32)) * 0.1
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
 @pytest.mark.slow
