@@ -33,10 +33,13 @@ def build_tokenizer():
 def main():
     argv = sys.argv[1:]
     args, trace_path, cache_dir = [], None, None
+    multi_tenant = False
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a.startswith("--trace="):
+        if a == "--multi-tenant":
+            multi_tenant = True
+        elif a.startswith("--trace="):
             trace_path = a.split("=", 1)[1]
         elif a == "--trace":
             if i + 1 >= len(argv):
@@ -99,9 +102,20 @@ def main():
             cache = PrefixCache(dec.page_size,
                                 salt=dec.cache_fingerprint(),
                                 tier=HostKVTier())
-    eng = ContinuousBatchingEngine(dec, max_new_tokens=16,
-                                   trace=bool(trace_path),
-                                   prefix_cache=cache)
+    # --multi-tenant: the SAME serving path through the TenantEngine —
+    # two tenants sharing the slots/page pool, an interactive one under
+    # the latency SLO (admits ahead, may preempt by page-spill) and a
+    # bulk one under throughput (backfills); the per-tenant ledgers and
+    # pooled SLO tails print below (docs/serving.md "Multi-tenant
+    # serving")
+    if multi_tenant:
+        from paddle_tpu.serving import TenantEngine
+        eng = TenantEngine(dec, max_new_tokens=16,
+                           trace=bool(trace_path), prefix_cache=cache)
+    else:
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=16,
+                                       trace=bool(trace_path),
+                                       prefix_cache=cache)
 
     # one shared SYSTEM prompt padded to a full 16-token page — the
     # cacheable block every request mounts (partial trailing blocks
@@ -111,9 +125,16 @@ def main():
     prompts = ["the quick brown fox", "tpu chips compile fast",
                "the lazy dog"]
     rids = {}
-    for p in prompts:
+    for k, p in enumerate(prompts):
         ids = np.asarray(system + tok.encode(p), np.int32) % 256
-        rids[eng.submit(ids)] = p
+        if multi_tenant:
+            # first prompt plays the interactive chat tenant; the rest
+            # are the batch tenant's backlog
+            tenant, slo = (("chat", "latency") if k == 0
+                           else ("batch", "throughput"))
+            rids[eng.submit(ids, tenant=tenant, slo=slo)] = p
+        else:
+            rids[eng.submit(ids)] = p
     outs = eng.run()
     for rid, p in rids.items():
         toks = [t % dec.cfg.vocab_size for t in outs[rid]]
@@ -128,6 +149,11 @@ def main():
           f"{s.get('prefill_chunks', 0)} ragged prompt chunks / "
           f"{s['prefill_syncs']} blocking prefill syncs, "
           f"p50 {s.get('token_p50_ms', 0)} ms/token")
+    if multi_tenant:
+        import json
+        summary = eng.tenancy_summary()
+        print("tenancy summary:")
+        print(json.dumps(summary, indent=1, sort_keys=True))
     if cache is not None:
         print(f"prefix cache ({'warm' if warm else 'cold'}): "
               f"{s.get('prefix_hits', 0)} block hits, "

@@ -25,7 +25,18 @@ Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
 import argparse
 import importlib
 import json
+import os
 import sys
+
+# The multi-device capture configs (gpt_tp_overlap's tp=4 mesh) need
+# virtual host devices; mirror tests/conftest.py so the bare CLI and
+# the CI `--check` gate see the same meshes as the tier-1 suite. XLA
+# only reads the flag at backend init, which hasn't happened yet at
+# CLI start even though the package import pulled in jax.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 
 def _build_spec(spec):

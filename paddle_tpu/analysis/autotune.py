@@ -49,6 +49,7 @@ class CandidateEstimate:
     flops: int
     recompute_pct: float
     advice: str
+    n_chunks: int = 1            # collective-matmul decomposition pick
 
     def to_dict(self):
         return {"batch": self.batch, "policy": self.policy,
@@ -58,7 +59,8 @@ class CandidateEstimate:
                 "bound": self.bound,
                 "throughput": round(self.throughput, 1),
                 "unit": self.unit,
-                "recompute_pct": round(self.recompute_pct, 2)}
+                "recompute_pct": round(self.recompute_pct, 2),
+                "n_chunks": self.n_chunks}
 
 
 @dataclass
@@ -318,11 +320,29 @@ def autotune(trainer, batch, hbm_budget=None, batch_sizes=None,
                 w, state_b, batch_b, params_b, items, unit, chip,
                 ici_b, dcn_b, batch_shard=bshard,
                 overlap_frac=overlap_frac)
+            # n_chunks is picked the way microbatch is — feasible-
+            # fastest through the chunked-overlap leg: the chip time
+            # (max of MXU and HBM legs) is what chunk t+1's matmul can
+            # hide chunk t's transfer behind. Wire-free candidates
+            # stay at the bulk n=1 (nothing to decompose).
+            n_best = 1
+            if rt.wire_s > 0.0:
+                from ..cost_model import best_n_chunks
+                n_best, ct = best_n_chunks(max(rt.compute_s, rt.hbm_s),
+                                           rt.wire_s)
+                if bs == advice_bs:
+                    advice.append(
+                        f"[{w.policy}] chunked overlap: n_chunks="
+                        f"{n_best} hides {ct.overlap_frac:.0%} of the "
+                        f"{rt.wire_s * 1e3:.2f} ms wire "
+                        f"(bulk step {ct.serial_s * 1e3:.2f} ms -> "
+                        f"{ct.step_s * 1e3:.2f} ms)")
             candidates.append(CandidateEstimate(
                 batch=bs, policy=w.policy, accum=1, peak_bytes=peak,
                 feasible=peak <= budget, step_s=rt.step_s,
                 bound=rt.bound, throughput=thr, unit=unit, flops=flops,
-                recompute_pct=w.recompute_pct, advice=w.advice))
+                recompute_pct=w.recompute_pct, advice=w.advice,
+                n_chunks=n_best))
             if bs == advice_bs:
                 advice.append(w.advice)
         del program

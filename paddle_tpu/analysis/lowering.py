@@ -16,7 +16,8 @@ from dataclasses import dataclass, field
 
 __all__ = ["ArgInfo", "HloOp", "LoweredProgram", "lower_layer",
            "lower_callable", "tensor_type_bytes", "sharding_shard_count",
-           "sharding_dim_counts", "tree_arg_infos",
+           "sharding_dim_counts", "spec_dim_axes", "sharding_dim_axes",
+           "tree_arg_infos",
            "parse_hlo_sharding", "harvest_hlo_shardings"]
 
 _OP_RE = re.compile(r'"?stablehlo\.([a-zA-Z0-9_]+)"?')
@@ -111,6 +112,34 @@ def sharding_dim_counts(sharding, ndim):
         for a in axes:
             dims[i] *= int(mesh.shape.get(a, 1))
     return tuple(dims)
+
+
+def spec_dim_axes(spec, ndim):
+    """Per-dim mesh-axis NAMES from PartitionSpec entries over an
+    `ndim`-rank value: a tuple of tuples of axis-name strings (empty
+    tuple = the dim is unsharded), or None when the spec itself is
+    unknown. The identity half of `sharding_dim_counts` — knowing a
+    dim is split 2-ways says how many shards, knowing it is split over
+    "dp" says WHICH 2-way split, so two specs naming distinct axes are
+    known to compose (their count product is exact, not a cap)."""
+    if spec is None or ndim is None:
+        return None
+    out = [()] * int(ndim)
+    for i, entry in enumerate(spec):
+        if i >= int(ndim) or entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        out[i] = tuple(str(a) for a in axes if a is not None)
+    return tuple(out)
+
+
+def sharding_dim_axes(sharding, ndim):
+    """`spec_dim_axes` lifted off a NamedSharding (constraint eqns carry
+    one in params["sharding"]); None for shardings without a spec."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return spec_dim_axes(tuple(spec), ndim)
 
 
 _MHLO_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')

@@ -143,6 +143,9 @@ def build_memory_manifest(name, report):
         },
         "note": "regenerate: python -m paddle_tpu.analysis "
                 "--write-manifests",
+        # dp-over-hosts captures only: the distinct-bytes-per-host
+        # block (absent keeps single-host manifests byte-stable)
+        **({"per_host": mem["per_host"]} if mem.get("per_host") else {}),
     }
 
 

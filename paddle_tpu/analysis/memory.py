@@ -125,15 +125,23 @@ class MemoryEstimate:
     peak_op: str = ""            # primitive at the peak point
     top: list = field(default_factory=list)   # top-k LiveBuffers at peak
     cpu_calibrated: bool = False
+    n_hosts: int = 1             # hosts the mesh spans (1 = single host)
+    host_peak_bytes: int = 0     # distinct bytes resident per host at peak
+    host_args_bytes: int = 0     # distinct argument bytes per host
 
     def to_dict(self):
-        return {"peak_bytes": self.peak_bytes,
-                "args_bytes": self.args_bytes,
-                "out_bytes": self.out_bytes,
-                "temp_peak_bytes": self.temp_peak_bytes,
-                "donated_bytes": self.donated_bytes,
-                "peak_eqn": self.peak_eqn, "peak_op": self.peak_op,
-                "top_live": [b.to_dict() for b in self.top]}
+        d = {"peak_bytes": self.peak_bytes,
+             "args_bytes": self.args_bytes,
+             "out_bytes": self.out_bytes,
+             "temp_peak_bytes": self.temp_peak_bytes,
+             "donated_bytes": self.donated_bytes,
+             "peak_eqn": self.peak_eqn, "peak_op": self.peak_op,
+             "top_live": [b.to_dict() for b in self.top]}
+        if self.n_hosts > 1:
+            d["per_host"] = {"n_hosts": self.n_hosts,
+                             "peak_bytes": self.host_peak_bytes,
+                             "args_bytes": self.host_args_bytes}
+        return d
 
     def __str__(self):
         gib = 1024.0 ** 3
@@ -142,6 +150,12 @@ class MemoryEstimate:
                  f"resident args {resident / gib:.4f} + working set "
                  f"{self.temp_peak_bytes / gib:.4f} (donation frees "
                  f"{self.donated_bytes / gib:.4f})"]
+        if self.n_hosts > 1:
+            lines.append(
+                f"per-host peak ({self.n_hosts} hosts): "
+                f"{self.host_peak_bytes / gib:.4f} GiB distinct bytes "
+                f"(args {self.host_args_bytes / gib:.4f}) — dp shards "
+                "replicated within a host are counted once")
         for b in self.top:
             lines.append(f"  {b.device_bytes:>12d} B  {b.op:<16} {b.name}")
         return "\n".join(lines)
@@ -177,7 +191,7 @@ def _inner_transient(jx, widen, memo):
 
 def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
           arg_infos=None, last_use_override=None, extra_after=None,
-          var_counts=None):
+          var_counts=None, count_cap=None):
     """Liveness walk of one jaxpr. Returns (peak, peak_eqn_idx,
     top_buffers_at_peak).
 
@@ -194,7 +208,11 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
     sees constraint pins and consumer-implied specs this single
     forward sweep can't, so its counts are used when available and the
     inline `_eqn_out_shard` result is the documented conservative
-    fallback for vars the pass left unknown."""
+    fallback for vars the pass left unknown.
+
+    `count_cap` clamps every shard count to at most this value — the
+    per-host accounting's knob: divided by min(count, n_hosts), a
+    buffer's contribution is its distinct bytes per host."""
     last_use = {}
     for i, eqn in enumerate(jx.eqns):
         for v in eqn.invars:
@@ -228,6 +246,8 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
         if v not in last_use:
             continue
         cnt = arg_counts[k] if arg_counts and k < len(arg_counts) else 1
+        if count_cap:
+            cnt = min(max(cnt, 1), count_cap)
         counts[v] = cnt
         info = (arg_infos[k] if arg_infos and k < len(arg_infos) else None)
         gb = _aval_bytes(v.aval)
@@ -272,6 +292,8 @@ def _walk(jx, arg_counts, donated, widen, pin_invars, memo, top_k=0,
                 cnt = (var_counts[v]
                        if var_counts is not None and v in var_counts
                        else out_count)
+                if count_cap:
+                    cnt = min(max(cnt, 1), count_cap)
                 counts[v] = cnt
                 gb = _aval_bytes(v.aval, widen_sub_f32=widen)
                 db = gb // max(cnt, 1)
@@ -677,7 +699,7 @@ def propagate_shard_counts(jx, arg_counts=None, arg_dims=None):
 
 def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
                           cpu_calibrated=False, last_use_override=None,
-                          extra_after=None, var_counts=None):
+                          extra_after=None, var_counts=None, n_hosts=1):
     """Static per-device HBM estimate of one closed jaxpr.
 
     `arg_infos`: optional list of `lowering.ArgInfo` aligned with the
@@ -696,6 +718,17 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
     inline forward propagation per var — the MemoryAnalyzer passes the
     propagation pass's result so pricing sees mid-graph constraint pins;
     without it the walk's own sweep is the conservative fallback.
+
+    `n_hosts` > 1 prices the dp-over-hosts view too: the SAME liveness
+    walk re-run with every shard count clamped to
+    `min(shard_count, n_hosts)`, so a buffer's contribution is its
+    DISTINCT bytes per host — replicated buffers (and dp shards
+    replicated across a host's local devices, host-major device order
+    as `build_mesh` lays out) count once per host, buffers sharded at
+    least n_hosts ways count 1/n_hosts. That is the per-host
+    checkpoint/offload footprint, not n_local_devices x per-device HBM
+    (which is just a multiplication the caller can do). Surfaced as
+    `host_peak_bytes` / `host_args_bytes` on the estimate.
     """
     jx = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
     infos = arg_infos or []
@@ -728,6 +761,24 @@ def estimate_jaxpr_memory(closed_jaxpr, arg_infos=None, top_k=8,
         peak_op=(jx.eqns[peak_idx].primitive.name
                  if 0 <= peak_idx < len(jx.eqns) else ""),
         top=top, cpu_calibrated=cpu_calibrated)
+    if n_hosts > 1:
+        # same walk, every shard count clamped to the host count: a
+        # buffer sharded fewer than n_hosts ways is (partly) replicated
+        # across hosts and costs global/min(cnt, n_hosts) distinct
+        # bytes on each
+        hpeak, _, _ = _walk(
+            jx, arg_counts=arg_counts, donated=donated,
+            widen=cpu_calibrated, pin_invars=True, memo={},
+            arg_infos=infos, last_use_override=last_use_override,
+            extra_after=extra_after, var_counts=var_counts,
+            count_cap=int(n_hosts))
+        est.n_hosts = int(n_hosts)
+        est.host_peak_bytes = hpeak
+        est.host_args_bytes = sum(
+            _aval_bytes(v.aval) // min(
+                max(arg_counts[k] if arg_counts and k < len(arg_counts)
+                    else 1, 1), int(n_hosts))
+            for k, v in enumerate(jx.invars))
     return est
 
 
@@ -764,10 +815,14 @@ class MemoryAnalyzer(Analyzer):
         # pass manager was bypassed or the program changed underneath
         from .propagation import result_for
         prop = result_for(program, ctx)
+        n_hosts = 1
+        for h in (ctx.extra.get("axis_host_counts") or {}).values():
+            n_hosts *= max(int(h), 1)
         est = estimate_jaxpr_memory(
             program.jaxpr, arg_infos=getattr(program, "arg_infos", None),
             top_k=ctx.extra.get("memory_top_k", 8),
-            var_counts=prop.counts if prop is not None else None)
+            var_counts=prop.counts if prop is not None else None,
+            n_hosts=n_hosts)
         self.metrics = {"available": True, **est.to_dict()}
         findings = []
         committed = (ctx.memory_manifest or {})

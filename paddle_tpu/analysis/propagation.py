@@ -85,6 +85,10 @@ class PropagationResult:
     """Outcome of one fixed-point propagation over a jaxpr."""
     dims: dict = field(default_factory=dict)    # var -> per-dim counts
     counts: dict = field(default_factory=dict)  # var -> total shard count
+    # var -> per-dim mesh-axis NAMES (tuple of tuples of strings; first
+    # slice: seeded vars only — entry args with a known PartitionSpec
+    # and sharding_constraint outputs; not yet propagated through eqns)
+    axes: dict = field(default_factory=dict)
     divergences: list = field(default_factory=list)
     loop_reshards: list = field(default_factory=list)
     n_vars: int = 0              # all vars (args, consts, eqn outputs)
@@ -121,6 +125,7 @@ class PropagationResult:
             "n_diverge": self.n_diverge,
             "n_unmapped": self.n_unmapped,
             "agreement_rate": round(self.agreement_rate, 4),
+            "n_axis_identified": len(self.axes),
             "n_divergences": len(self.divergences),
             "n_loop_carry_reshards": len(self.loop_reshards),
             "iterations": self.iterations,
@@ -430,22 +435,41 @@ def _report(jx, dims, res):
             _report(sub, dims, res)
 
 
-def _final_counts(jx, dims, arg_counts):
+def _axes_distinct(axes, v):
+    """True when `v` carries a per-dim axis-identity spec whose named
+    axes are all DISTINCT — the dim-count product is then exact (no two
+    dims can be splitting the same mesh axis), so the no-identity caps
+    below do not apply."""
+    a = axes.get(v) if axes else None
+    if a is None:
+        return False
+    named = [n for dim in a for n in dim]
+    return len(named) == len(set(named))
+
+
+def _final_counts(jx, dims, arg_counts, axes=None):
     """{var: total shard count} over the TOP-LEVEL jaxpr: the product of
     the fixed-point per-dim spec where known, the v1 forward heuristic
     (`_eqn_out_shard` with conservative caps) where not — byte-for-byte
     the old `propagate_shard_counts` on a program with no mid-graph
-    pins."""
+    pins.
+
+    `axes` (PropagationResult.axes) lifts the caps where it can: a var
+    whose per-dim AXIS NAMES are known and distinct takes its dim-spec
+    product verbatim — the identity proves the product is the real
+    shard count, not an over-claim."""
     from .memory import _eqn_out_shard, _is_var
     counts = {}
     for k, v in enumerate(jx.invars):
         d = dims.get(v)
         cnt = _prod(d) if d is not None else None
-        if arg_counts and k < len(arg_counts):
+        if arg_counts and k < len(arg_counts) and \
+                not _axes_distinct(axes, v):
             # per-dim counts carry no mesh-axis identity, so a dim-spec
             # product can over-claim vs the arg's actual shard count —
             # keep the v1 cap (min = fewer shards = per-device bytes
-            # OVERestimated, the safe direction)
+            # OVERestimated, the safe direction). Axis-identified vars
+            # skip it: their product is exact by construction.
             cnt = arg_counts[k] if cnt is None else min(cnt, arg_counts[k])
         counts[v] = cnt if cnt is not None else 1
     for eqn in jx.eqns:
@@ -453,12 +477,52 @@ def _final_counts(jx, dims, arg_counts):
         in_counts = [counts.get(v, 1) for v in ivs]
         out, _ = _eqn_out_shard(eqn, in_counts, [dims.get(v) for v in ivs])
         # the same no-axis-identity cap v1 applied: an output never
-        # claims finer sharding than its most-sharded operand
+        # claims finer sharding than its most-sharded operand — except
+        # a constraint-pinned output whose distinct axis names prove
+        # the finer sharding is real (a deliberate mid-graph reshard)
         cap = max(in_counts, default=1)
         for v in eqn.outvars:
             d = dims.get(v)
-            counts[v] = min(_prod(d), cap) if d is not None else out
+            if d is None:
+                counts[v] = out
+            elif _axes_distinct(axes, v):
+                counts[v] = _prod(d)
+            else:
+                counts[v] = min(_prod(d), cap)
     return counts
+
+
+def _seed_axes(jx, arg_infos):
+    """{var: per-dim axis names} — the mesh-axis IDENTITY first slice.
+    Only vars whose identity is stated outright are recorded: entry
+    args carrying a PartitionSpec (ArgInfo.spec) and every
+    sharding_constraint output (NamedSharding in params), recursively.
+    Counts say how many ways a dim splits; axes say over WHICH mesh
+    axis — the fact `_final_counts` needs to trust a dim product
+    outright instead of capping it (two dims splitting "dp" and "tp"
+    compose to dp x tp shards; two dims that might both be "dp" do
+    not)."""
+    from .lowering import sharding_dim_axes, spec_dim_axes
+    from .memory import _sub_jaxprs
+    axes = {}
+    for k, v in enumerate(jx.invars):
+        info = arg_infos[k] if arg_infos and k < len(arg_infos) else None
+        a = spec_dim_axes(getattr(info, "spec", None), _rank(v))
+        if a is not None:
+            axes[v] = a
+
+    def _collect(sub):
+        for eqn in sub.eqns:
+            if eqn.primitive.name == "sharding_constraint":
+                a = sharding_dim_axes(eqn.params.get("sharding"),
+                                      _rank(eqn.outvars[0]))
+                if a is not None:
+                    axes[eqn.outvars[0]] = a
+            for s in _sub_jaxprs(eqn):
+                _collect(s)
+
+    _collect(jx)
+    return axes
 
 
 def _cross_check_hlo(text, jx, dims, res):
@@ -574,7 +638,8 @@ def propagate_shardings(program_or_jaxpr, arg_infos=None, arg_counts=None,
     res = PropagationResult(dims=dims, iterations=iterations,
                             converged=converged, jaxpr_id=id(jx))
     _report(jx, dims, res)
-    res.counts = _final_counts(jx, dims, arg_counts)
+    res.axes = _seed_axes(jx, arg_infos)
+    res.counts = _final_counts(jx, dims, arg_counts, axes=res.axes)
     text = getattr(program, "text", None) if program is not None else None
     if text:
         _cross_check_hlo(text, jx, dims, res)

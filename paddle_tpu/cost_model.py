@@ -272,6 +272,78 @@ def roofline_step_time_overlap(flops, hbm_bytes, ici_bytes=0,
         overlap_frac=frac)
 
 
+# ------------------------------------------------- chunked-overlap leg
+
+# per-chunk dispatch floor: issuing one more async collective-permute +
+# matmul tile costs a scalar-core/launch slot even when the payload is
+# tiny — the reason n_chunks cannot grow without bound. Order of
+# magnitude of one async op issue; rankings are insensitive to the
+# constant, the knee location is honest with it.
+CHUNK_LAUNCH_OVERHEAD_S = 1e-6
+
+
+@dataclass
+class ChunkedOverlapTime:
+    """Step time of ONE overlapped site decomposed into n_chunks tiles
+    (ops/overlap.py): chunk t's transfer rides the wire while chunk
+    t+1's matmul runs, so the n-1 interior pairs cost max(compute,
+    wire) per chunk — but the FIRST chunk's compute and the LAST
+    chunk's transfer have nothing to hide behind (the exposed tails),
+    and every chunk pays the launch-overhead floor.  n_chunks=1 is the
+    bulk serial sum; n_chunks→inf approaches max(compute, wire) with
+    the overhead term eventually winning the argmin back down."""
+    compute_s: float
+    wire_s: float
+    n_chunks: int = 1
+    launch_overhead_s: float = CHUNK_LAUNCH_OVERHEAD_S
+
+    @property
+    def step_s(self):
+        n = max(1, int(self.n_chunks))
+        c = self.compute_s / n
+        w = self.wire_s / n
+        return c + (n - 1) * max(c, w) + w + n * self.launch_overhead_s
+
+    @property
+    def serial_s(self):
+        """The bulk twin: whole matmul, then the whole collective."""
+        return self.compute_s + self.wire_s + self.launch_overhead_s
+
+    @property
+    def overlap_frac(self):
+        """Fraction of the wire this decomposition hides (the same
+        quantity the Schedule Doctor reads off the real DAG)."""
+        if self.wire_s <= 0.0:
+            return 1.0
+        hidden = self.serial_s - self.step_s
+        return min(max(hidden / self.wire_s, 0.0), 1.0)
+
+
+def chunked_overlap_time(compute_s, wire_s, n_chunks=1,
+                         launch_overhead_s=CHUNK_LAUNCH_OVERHEAD_S):
+    """Price one matmul+collective site at a given chunk count."""
+    return ChunkedOverlapTime(compute_s=float(compute_s),
+                              wire_s=float(wire_s),
+                              n_chunks=max(1, int(n_chunks)),
+                              launch_overhead_s=launch_overhead_s)
+
+
+def best_n_chunks(compute_s, wire_s, max_chunks=64,
+                  launch_overhead_s=CHUNK_LAUNCH_OVERHEAD_S):
+    """Feasible-fastest chunk count for one overlapped site — the same
+    argmin the autotuner runs for microbatch, applied to the n_chunks
+    knob: walk 1..max_chunks, keep the step-time minimizer (ties break
+    LOW — fewer launches, same time).  Returns (n, ChunkedOverlapTime).
+    """
+    best = chunked_overlap_time(compute_s, wire_s, 1, launch_overhead_s)
+    best_n = 1
+    for n in range(2, max(1, int(max_chunks)) + 1):
+        t = chunked_overlap_time(compute_s, wire_s, n, launch_overhead_s)
+        if t.step_s < best.step_s - 1e-15:
+            best, best_n = t, n
+    return best_n, best
+
+
 # ------------------------------------------------------- decode horizon
 
 # Fallback python-dispatch + device->host-fetch cost of one decode sync

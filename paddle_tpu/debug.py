@@ -145,7 +145,7 @@ def autotune(target, *example_inputs, batch=None, hbm_budget=None,
 
 
 def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
-                  print_report=True):
+                  print_report=True, axis_host_counts=None):
     """Static per-device HBM report, before a chip sees the program.
 
     `target` may be a `distributed.Trainer` (pass the training `batch`;
@@ -156,7 +156,13 @@ def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
     args/transient split, the donation credit, and the top-k live
     tensors at the peak with their defining ops — the "what do I shard,
     remat or donate to fit" answer.  Estimates use native dtype widths
-    (the TPU numbers), chip-independent: lowering happens on CPU."""
+    (the TPU numbers), chip-independent: lowering happens on CPU.
+
+    `axis_host_counts` ({axis: hosts}, the schedule pass's convention)
+    marks a multi-host mesh: the report then also prices the
+    DISTINCT-bytes-per-host peak (dp shards replicated within a host
+    counted once) — the per-host checkpoint/offload footprint of a
+    dp-over-hosts layout."""
     from .analysis import estimate_jaxpr_memory
     from .analysis.lowering import lower_callable, lower_layer
     from .nn.layer_base import Layer
@@ -176,8 +182,12 @@ def memory_report(target, *example_inputs, batch=None, lr=0.0, top_k=8,
         args = [x._value if isinstance(x, Tensor) else x
                 for x in example_inputs]
         program = lower_callable(target, *args)
+    n_hosts = 1
+    for h in (axis_host_counts or {}).values():
+        n_hosts *= max(int(h), 1)
     est = estimate_jaxpr_memory(program.jaxpr,
-                                arg_infos=program.arg_infos, top_k=top_k)
+                                arg_infos=program.arg_infos, top_k=top_k,
+                                n_hosts=n_hosts)
     if print_report:
         print(f"== memory report: {program.name} ==")
         print(est)

@@ -181,12 +181,30 @@ class Trainer:
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, donate=True,
                  grad_accum_steps=1, grad_transform=None,
-                 batch_spec=("dp", "fsdp")):
+                 batch_spec=("dp", "fsdp"), dp_overlap="off",
+                 dp_overlap_buckets=2):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or get_mesh()
         self.grad_accum_steps = grad_accum_steps
+        # dp grad-reduction dispatch: 'off' leaves the reduction to
+        # GSPMD (one bulk all-reduce after the whole backward), 'bulk'
+        # issues an explicit per-parameter-BUCKET shard_map psum, 'ring'
+        # the chunked ascending ring (ops/overlap.py) — each bucket's
+        # wire overlaps the optimizer update consuming the previous
+        # bucket, and 'ring' is bit-identical to 'bulk' by the twin
+        # pin. Targets dp meshes (other axes stay size 1 on jax 0.4.x,
+        # where manual shard_map axes cannot be subset).
+        if dp_overlap not in ("off", "bulk", "ring"):
+            raise ValueError(f"dp_overlap must be 'off', 'bulk' or "
+                             f"'ring', got {dp_overlap!r}")
+        if dp_overlap != "off" and grad_transform is not None:
+            raise ValueError("dp_overlap decomposes the grad reduction "
+                             "per bucket; grad_transform expects the "
+                             "whole tree — use one or the other")
+        self.dp_overlap = dp_overlap
+        self.dp_overlap_buckets = int(dp_overlap_buckets)
         # grad_transform(grads, state) -> (grads, state): gradient
         # compression/filtering between backward and the optimizer (DGC
         # error-feedback sparsification, bf16 cast, custom clipping) —
@@ -317,7 +335,7 @@ class Trainer:
 
         grad_transform = self.grad_transform
 
-        def _inner(params, opt_state, gt_state, consts, lr, batch):
+        def _local_grads(params, consts, batch):
             if accum <= 1:
                 (loss_v, buf_updates), grads = jax.value_and_grad(
                     compute_loss, has_aux=True)(params, consts, batch)
@@ -344,6 +362,10 @@ class Trainer:
                 # per-microbatch stat updates all start from the same consts;
                 # carry the last microbatch's
                 buf_updates = jax.tree_util.tree_map(lambda v: v[-1], bus)
+            return loss_v, grads, buf_updates
+
+        def _inner(params, opt_state, gt_state, consts, lr, batch):
+            loss_v, grads, buf_updates = _local_grads(params, consts, batch)
             if grad_transform is not None:
                 grads, gt_state = grad_transform(grads, gt_state)
             new_params, new_state = optimizer.apply_gradients_pytree(
@@ -353,7 +375,66 @@ class Trainer:
                 new_consts[_RNG_STEP] = consts[_RNG_STEP] + 1
             return new_params, new_state, gt_state, new_consts, loss_v
 
-        return _inner
+        dp = int(self.mesh.shape.get("dp", 1))
+        if self.dp_overlap == "off" or dp <= 1:
+            return _inner
+
+        # dp-overlap path: per-shard grads under an explicit shard_map
+        # over 'dp', the grad reduction decomposed per parameter BUCKET
+        # and interleaved with the optimizer update consuming each
+        # bucket — bucket b's ring steps share no data edge with bucket
+        # b-1's update dots, so the two-stream schedule (and the chip)
+        # overlap them. The local loss/grads are per-shard MEANS, so the
+        # global ones are sum/dp — reduced with the same ascending fold
+        # ('ring') or bulk psum ('bulk'), bit-identical by the twin pin.
+        from jax.sharding import PartitionSpec as P
+        from ..ops.overlap import chunked_all_reduce
+        from .mesh import compat_shard_map
+        impl = "ring" if self.dp_overlap == "ring" else "bulk"
+        n_buckets = max(1, self.dp_overlap_buckets)
+        mesh = self.mesh
+
+        def _shard_body(params, opt_state, gt_state, consts, lr, batch):
+            loss_v, grads, buf_updates = _local_grads(params, consts, batch)
+            names = sorted(grads)
+            nb = max(1, min(n_buckets, len(names)))
+            bounds = [(i * len(names)) // nb for i in range(nb + 1)]
+            new_params, new_slots = {}, {}
+            new_step = opt_state["step"]
+            for i in range(nb):
+                bucket = names[bounds[i]:bounds[i + 1]]
+                if not bucket:
+                    continue
+                gb = {n: chunked_all_reduce(grads[n], "dp", impl=impl) / dp
+                      for n in bucket}
+                up, us = optimizer.apply_gradients_pytree(
+                    {n: params[n] for n in bucket}, gb,
+                    {"slots": {n: opt_state["slots"][n] for n in bucket},
+                     "step": opt_state["step"]}, lr)
+                new_params.update(up)
+                new_slots.update(us["slots"])
+                new_step = us["step"]
+            loss_v = chunked_all_reduce(loss_v, "dp", impl=impl) / dp
+            buf_updates = jax.tree_util.tree_map(
+                lambda v: (chunked_all_reduce(v, "dp", impl=impl) / dp
+                           if jnp.issubdtype(jnp.asarray(v).dtype,
+                                             jnp.floating) else v),
+                buf_updates)
+            new_consts = {**consts, **buf_updates}
+            if _RNG_STEP in consts:
+                new_consts[_RNG_STEP] = consts[_RNG_STEP] + 1
+            new_state = {"slots": new_slots, "step": new_step}
+            return new_params, new_state, gt_state, new_consts, loss_v
+
+        def _inner_dp(params, opt_state, gt_state, consts, lr, batch):
+            return compat_shard_map(
+                _shard_body, mesh,
+                in_specs=(P(), P(), P(), P(), P(), P("dp")),
+                out_specs=(P(), P(), P(), P(), P()),
+                axis_names={"dp"}, check=False)(
+                params, opt_state, gt_state, consts, lr, batch)
+
+        return _inner_dp
 
     def _build(self, donate, in_shardings=None):
         _inner = self._build_body()

@@ -60,6 +60,12 @@ class GPTConfig:
     #   matmul-activation memory) | 'none' ≈ remat=False
     tie_embeddings: bool = True
     init_std: float = 0.02
+    tp_overlap: str = "off"          # tensor-parallel collective dispatch at
+    #   the two row-parallel sites (attention proj, fc2): 'off' leaves the
+    #   dots to GSPMD (bulk psum, the COLL-SERIALIZED shape), 'bulk' issues
+    #   the explicit shard_map psum twin, 'ring' the chunked ring-overlapped
+    #   path (ops/overlap.py) — bit-identical to 'bulk' by the twin pin
+    tp_overlap_chunks: int = 4       # free-dim tiles per overlapped site
 
     def __post_init__(self):
         if self.ffn_hidden == 0:
@@ -67,6 +73,9 @@ class GPTConfig:
         if self.sp_mode not in ("ring", "zigzag", "ulysses"):
             raise ValueError(f"sp_mode must be 'ring', 'zigzag' or "
                              f"'ulysses', got {self.sp_mode!r}")
+        if self.tp_overlap not in ("off", "bulk", "ring"):
+            raise ValueError(f"tp_overlap must be 'off', 'bulk' or "
+                             f"'ring', got {self.tp_overlap!r}")
 
     @property
     def head_dim(self):
@@ -141,12 +150,36 @@ class GPTBlock(Layer):
                                                       dropout_p=cfg.dropout,
                                                       training=self.training)
         attn = reshape(attn, [B, L, cfg.hidden_size])
-        x = res + self.proj(attn)
+        x = res + self._row_parallel(self.proj, attn)
         res = x
         y = self.ln2(x)
-        y = self.fc2(F.gelu(self.fc1(y), approximate=True))
+        y = self._row_parallel(self.fc2, F.gelu(self.fc1(y),
+                                                approximate=True))
         out = res + y
         return out if cache is None else (out, cache)
+
+    def _row_parallel(self, linear, x):
+        """The two convicted COLL-SERIALIZED sites: a row-parallel dot
+        whose tp all-reduce GSPMD dispatches as one bulk psum nothing
+        can hide behind. With cfg.tp_overlap='ring' the dot+psum goes
+        through ops/overlap.py's chunked ring (per-chunk ppermutes
+        overlap the neighbour chunks' dots); 'bulk' is the explicit
+        shard_map psum twin (the A/B reference, bit-identical to
+        'ring'); 'off' keeps the plain Linear."""
+        cfg = self.cfg
+        if cfg.tp_overlap != "off":
+            from ..distributed.mesh import get_mesh
+            mesh = get_mesh(create_default=False)
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                from ..ops.overlap import overlap_matmul_all_reduce
+                impl = "ring" if cfg.tp_overlap == "ring" else "bulk"
+                return apply_op(
+                    lambda a, wt, b: overlap_matmul_all_reduce(
+                        a, wt, axis="tp",
+                        n_chunks=cfg.tp_overlap_chunks,
+                        mesh=mesh, impl=impl) + b,
+                    x, linear.weight, linear.bias)
+        return linear(x)
 
     def _attend_cached(self, q, k, v, cache, pos):
         """Decode-time attention against a static KV buffer (lengths stay

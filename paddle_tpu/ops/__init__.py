@@ -3,6 +3,7 @@ reference implementations (≈ the reference's paddle/phi/kernels/gpu fused
 ops: fused_attention, fused_layer_norm, fused_adam, …)."""
 from . import attention  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import overlap  # noqa: F401
 from . import paged_attention  # noqa: F401
 from . import ulysses  # noqa: F401
 

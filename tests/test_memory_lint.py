@@ -181,6 +181,63 @@ def test_per_device_division_by_shard_count():
     assert shard.peak_bytes <= rep.peak_bytes // 4
 
 
+def test_per_host_accounting_dp_over_hosts():
+    """dp-over-hosts distinct-bytes-per-host: an 8-way dp-sharded
+    batch on a 4-host mesh costs 1/4 per host (each host holds 2
+    distinct shards), while the replicated param costs its FULL size
+    on every host — per-device division would claim 1/8 and 1/1."""
+    xb = jnp.zeros((64, 64), jnp.float32)
+    wp = jnp.zeros((64, 64), jnp.float32)
+
+    def f(a, w):
+        return a @ w
+
+    traced = jax.jit(f).trace(xb, wp)
+    infos = [ArgInfo(name="batch", role="batch", shape=(64, 64),
+                     dtype="float32", bytes=xb.nbytes, shard_count=8),
+             ArgInfo(name="w", role="param", shape=(64, 64),
+                     dtype="float32", bytes=wp.nbytes, shard_count=1)]
+    est = estimate_jaxpr_memory(traced.jaxpr, arg_infos=infos, n_hosts=4)
+    assert est.n_hosts == 4
+    assert est.host_args_bytes == xb.nbytes // 4 + wp.nbytes
+    # per-host distinct bytes sit between per-device and global
+    assert est.host_peak_bytes >= est.peak_bytes
+    assert "per_host" in est.to_dict()
+    assert est.to_dict()["per_host"]["n_hosts"] == 4
+    # single-host estimates stay byte-stable: no per_host block at all
+    single = estimate_jaxpr_memory(traced.jaxpr, arg_infos=infos)
+    assert single.n_hosts == 1 and "per_host" not in single.to_dict()
+
+
+def test_per_host_accounting_via_analyzer_and_report(capsys):
+    """The two surfaces: MemoryAnalyzer picks n_hosts up from the
+    schedule pass's `axis_host_counts` convention (manifest grows the
+    per_host block), and debug.memory_report prints the per-host line."""
+    import paddle_tpu as paddle
+    from paddle_tpu import debug
+    from paddle_tpu.analysis.lowering import lower_callable
+
+    program = lower_callable(lambda a: (a * 2.0).sum(),
+                             np.zeros((32, 32), np.float32))
+    ctx = AnalysisContext(name="hosts",
+                          extra={"axis_host_counts": {"dp": 2}})
+    report = PassManager(["memory"]).run(program, ctx)
+    m = report.metrics["memory"]
+    assert m["per_host"]["n_hosts"] == 2
+    assert m["per_host"]["peak_bytes"] >= m["peak_bytes"]
+    from paddle_tpu.analysis import build_memory_manifest
+    assert build_memory_manifest("hosts", report)["per_host"] == \
+        m["per_host"]
+
+    paddle.seed(0)
+    est = debug.memory_report(lambda a: (a * 2.0).sum(),
+                              np.zeros((32, 32), np.float32),
+                              axis_host_counts={"dp": 2})
+    out = capsys.readouterr().out
+    assert est.n_hosts == 2
+    assert "per-host peak (2 hosts)" in out
+
+
 def test_trainer_analysis_program_captures_roles_and_donation():
     """The Trainer front door: per-arg roles/shardings/donation reach
     the passes; donate=False trips MEM-NO-DONATION."""
@@ -677,3 +734,76 @@ def test_propagation_threads_concat_pad_slice_dims():
     assert counts[pad.outvars[0]] == 2
     assert counts[sl_part.outvars[0]] == 2
     assert counts[jx.outvars[0]] == 2        # elementwise after concat
+
+
+def test_propagation_axis_identity_first_slice():
+    """Mesh-axis IDENTITY on a dp x tp mesh: seeded vars (entry args
+    with a PartitionSpec, sharding_constraint outputs) carry per-dim
+    axis NAMES alongside their counts, and `_final_counts` trusts a
+    distinct-axes dim product outright instead of capping it at the
+    most-sharded operand — the dp x tp cross product is real shards,
+    not an over-claim."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.analysis import propagate_shardings
+    from paddle_tpu.analysis.lowering import tree_arg_infos
+    from paddle_tpu.distributed import build_mesh
+
+    mesh = build_mesh(dp=2, tp=4)
+    jmesh = mesh._mesh if hasattr(mesh, "_mesh") else mesh
+    dp_tp = NamedSharding(jmesh, PartitionSpec("dp", "tp"))
+
+    def f(x, w):
+        y = x @ w                           # replicated operands
+        return jax.lax.with_sharding_constraint(y, dp_tp) + 1.0
+
+    x = jnp.zeros((8, 16), jnp.float32)
+    w = jnp.zeros((16, 32), jnp.float32)
+    traced = jax.jit(f, in_shardings=(dp_tp, None)).trace(x, w)
+    infos = (tree_arg_infos(jax.device_put(x, dp_tp), "batch") +
+             tree_arg_infos(w, "param"))
+    res = propagate_shardings(traced.jaxpr, arg_infos=infos)
+    jx = traced.jaxpr.jaxpr
+
+    # the sharded entry arg is axis-identified; the spec-less one is not
+    assert res.axes[jx.invars[0]] == (("dp",), ("tp",))
+    assert jx.invars[1] not in res.axes
+    # the constraint output carries its NamedSharding's axis names
+    cons = [e for e in jx.eqns
+            if e.primitive.name == "sharding_constraint"]
+    assert cons and res.axes[cons[0].outvars[0]] == (("dp",), ("tp",))
+    assert res.summary()["n_axis_identified"] == 2
+
+    # cap relaxed: both operands replicated (cap would clamp to 1), yet
+    # the constraint's distinct dp/tp axes prove the 8-way product
+    assert res.counts[cons[0].outvars[0]] == 8
+
+    # identity withheld -> the conservative cap still rules: same
+    # program analyzed WITHOUT arg_infos/constraint axes knowledge
+    blind = propagate_shardings(traced.jaxpr)
+    blind.axes.pop(cons[0].outvars[0], None)
+    from paddle_tpu.analysis.propagation import _final_counts
+    capped = _final_counts(jx, blind.dims, None, axes=blind.axes)
+    assert capped[cons[0].outvars[0]] == 1
+
+
+def test_propagation_axis_identity_repeated_axis_keeps_cap():
+    """Two dims naming the SAME mesh axis do not compose — the spec
+    (“dp”, “dp”) is not distinct, so the product cap stays."""
+    from paddle_tpu.analysis.propagation import _axes_distinct
+
+    v = object()
+    assert _axes_distinct({v: (("dp",), ("tp",))}, v)
+    assert not _axes_distinct({v: (("dp",), ("dp",))}, v)
+    assert not _axes_distinct({}, v)
+    assert _axes_distinct({v: ((), ())}, v)      # replicated is exact
+
+
+def test_spec_dim_axes_normalization():
+    from paddle_tpu.analysis.lowering import spec_dim_axes
+
+    assert spec_dim_axes(None, 2) is None
+    assert spec_dim_axes(("dp", None), 2) == (("dp",), ())
+    assert spec_dim_axes((("dp", "tp"),), 1) == (("dp", "tp"),)
+    # short spec pads with unsharded dims; overlong entries are ignored
+    assert spec_dim_axes(("tp",), 3) == (("tp",), (), ())
+    assert spec_dim_axes(("a", "b", "c"), 2) == (("a",), ("b",))

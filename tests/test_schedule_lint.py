@@ -38,10 +38,13 @@ def test_schedule_manifest_is_committed_and_current(name, pass_manager):
 @pytest.mark.parametrize("name", sorted(SCHEDULE_CONFIGS))
 def test_schedule_estimate_is_bracketed_and_clean(name, pass_manager):
     """Structural pins that outlive re-baselining: the overlap-aware
-    step time sits inside [roofline max, serial sum]; the committed
+    step time sits inside [roofline max, serial sum]. The committed
     single-device configs carry no collectives, so the bracket
-    COLLAPSES (nothing to overlap: overlap == max == sum, frac 1.0)
-    and COLL-SERIALIZED never fires on the committed state."""
+    COLLAPSES (nothing to overlap: overlap == max == sum, frac 1.0);
+    gpt_tp_overlap is the one config WITH a collective stream, and its
+    chunked ring must keep hiding the wire (the acceptance bar the
+    manifest pins). COLL-SERIALIZED never fires on the committed
+    state either way."""
     program, ctx, _ = lowered_program(name)
     report = pass_manager.run(program, ctx)
     m = report.metrics["schedule"]
@@ -49,14 +52,50 @@ def test_schedule_estimate_is_bracketed_and_clean(name, pass_manager):
     assert m["ideal_step_us"] <= m["overlap_step_us"] \
         <= m["serial_step_us"]
     assert m["overlap_step_us"] > 0
-    # committed configs are single-device: the wire stream is empty
-    assert m["n_collectives"] == 0
-    assert m["overlap_frac"] == 1.0
-    assert m["ideal_step_us"] == m["serial_step_us"]
+    if name == "gpt_tp_overlap":
+        # the chunked collective-matmul capture: a real wire stream,
+        # hidden behind the per-chunk matmul tiles
+        assert m["n_collectives"] > 0
+        assert m["overlap_frac"] >= 0.6
+        assert m["n_serialized_collectives"] == 0
+    else:
+        # the other committed configs are single-device: empty wire
+        assert m["n_collectives"] == 0
+        assert m["overlap_frac"] == 1.0
+        assert m["ideal_step_us"] == m["serial_step_us"]
     assert report.by_rule("COLL-SERIALIZED") == []
     # the critical path attributes real ops with source lines
     assert m["critical_path"], "empty critical path"
     assert any(".py:" in n["source"] for n in m["critical_path"])
+
+
+def test_bulk_twin_is_coll_serialized_red(pass_manager):
+    """The red/green story the overlap subsystem exists for: the SAME
+    tp block with its two row-parallel matmuls ending in bulk psums
+    puts both collectives alone on the critical path (COLL-SERIALIZED
+    red, overlap_frac 0), and flipping impl to the chunked ring turns
+    the capture green with >= 60% of the wire hidden — the committed
+    gpt_tp_overlap manifest pins the green side."""
+    from paddle_tpu.analysis import AnalysisContext
+    from paddle_tpu.analysis.baseline import (TP_OVERLAP_AXIS,
+                                              gpt_tp_overlap_program)
+
+    ctx = AnalysisContext(name="gpt_tp_overlap_bulk",
+                          mesh_axes={"tp": TP_OVERLAP_AXIS},
+                          expect_collectives=True)
+    bulk = pass_manager.run(gpt_tp_overlap_program(impl="bulk"), ctx)
+    mb = bulk.metrics["schedule"]
+    assert mb["n_collectives"] == 2
+    assert len(bulk.by_rule("COLL-SERIALIZED")) == 2
+    assert mb["overlap_frac"] < 0.1
+
+    ring = pass_manager.run(gpt_tp_overlap_program(impl="ring"), ctx)
+    mr = ring.metrics["schedule"]
+    assert ring.by_rule("COLL-SERIALIZED") == []
+    assert mr["overlap_frac"] >= 0.6
+    # both twins move the same traffic: the decomposition hides the
+    # wire, it does not shrink what crosses it
+    assert mr["wire_ici_bytes"] >= mb["wire_ici_bytes"]
 
 
 def test_estimate_schedule_brackets_on_sharded_program():
