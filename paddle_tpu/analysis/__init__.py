@@ -29,6 +29,8 @@ from . import propagation as _propagation  # noqa: F401
 from . import memory as _memory  # noqa: F401  (registers the memory pass)
 from . import sharding as _sharding  # noqa: F401  (registers sharding pass)
 from . import schedule as _schedule  # noqa: F401 (registers schedule pass)
+from . import determinism as _determinism  # noqa: F401 (determinism pass)
+from . import threads as _threads  # noqa: F401 (thread-discipline lint)
 from .analyzers import COLLECTIVE_OPS, MXU_OPS  # noqa: F401
 from .ast_lint import lint_function  # noqa: F401
 from .lowering import ArgInfo, sharding_shard_count  # noqa: F401
@@ -44,7 +46,14 @@ from .manifest import (build_manifest, load_manifest,  # noqa: F401
                        build_propagation_manifest,
                        load_propagation_manifest,
                        propagation_manifest_path,
-                       write_propagation_manifest)
+                       write_propagation_manifest,
+                       build_determinism_manifest,
+                       load_determinism_manifest,
+                       determinism_manifest_path,
+                       write_determinism_manifest)
+from .determinism import (DeterminismResult,  # noqa: F401
+                          analyze_determinism)
+from .threads import lint_thread_discipline  # noqa: F401
 from .memory import (MemoryEstimate, audit_page_ledger,  # noqa: F401
                      estimate_jaxpr_memory, propagate_shard_counts)
 from .propagation import (PropagationResult,  # noqa: F401
@@ -72,6 +81,10 @@ __all__ = [
     "schedule_manifest_path", "write_schedule_manifest",
     "build_propagation_manifest", "load_propagation_manifest",
     "propagation_manifest_path", "write_propagation_manifest",
+    "build_determinism_manifest", "load_determinism_manifest",
+    "determinism_manifest_path", "write_determinism_manifest",
+    "DeterminismResult", "analyze_determinism",
+    "lint_thread_discipline",
     "MemoryEstimate", "estimate_jaxpr_memory", "propagate_shard_counts",
     "PropagationResult", "propagate_shardings",
     "audit_page_ledger",

@@ -15,9 +15,13 @@ pins it); ``--schedule`` prints the overlap-aware schedule breakdown
 schedule_manifests/*.json pins it); ``--propagation`` prints the
 GSPMD fixed-point pass summary (exact/fallback coverage, XLA
 annotation agreement, divergences — propagation_manifests/*.json pins
-it); ``--check`` regenerates every committed manifest in-memory
-(lint, memory, tuning, schedule AND propagation) and fails on any
-drift — the CI answer to stale manifests.
+it); ``--determinism`` prints the Determinism Doctor summary
+(canonical pool writes, RNG key provenance, scatter-overlap proofs,
+thread-discipline counters — determinism_manifests/*.json pins it for
+the serving configs); ``--check`` regenerates every committed
+manifest in-memory (lint, memory, tuning, schedule, propagation AND
+determinism) and fails on any drift — the CI answer to stale
+manifests.
 
 Exit code: 0 clean / manifest-matching, 1 any ERROR finding or drift
 (the CI gate), 2 usage problems.
@@ -66,12 +70,13 @@ def _build_spec(spec):
 
 def _run_spec(spec, write, as_json, no_manifest, show_memory,
               show_autotune=False, show_schedule=False,
-              show_propagation=False):
+              show_propagation=False, show_determinism=False):
     from . import (PassManager, load_manifest, load_memory_manifest,
-                   write_manifest, write_memory_manifest,
-                   write_propagation_manifest, write_schedule_manifest,
-                   write_tuning_manifest)
-    from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
+                   write_determinism_manifest, write_manifest,
+                   write_memory_manifest, write_propagation_manifest,
+                   write_schedule_manifest, write_tuning_manifest)
+    from .baseline import (BASELINE_CONFIGS, DETERMINISM_CONFIGS,
+                           SCHEDULE_CONFIGS)
 
     pm = PassManager()
     program, ctx, fwd, built = _build_spec(spec)
@@ -95,6 +100,11 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
             sch = write_schedule_manifest(ctx.name, report)
             msg += (f", overlap step {sch['overlap_step_us']} us "
                     f"(frac {sch['overlap_frac']})")
+        if spec in DETERMINISM_CONFIGS:
+            det = write_determinism_manifest(ctx.name, report)
+            msg += (f", determinism "
+                    f"{det['graph']['n_canonical_writes']}/"
+                    f"{det['graph']['n_pool_writes']} canonical writes")
         if spec in BASELINE_CONFIGS:
             tun = write_tuning_manifest(ctx.name, _tuning_report(spec))
             msg += f", best remat={tun['best']}"
@@ -115,6 +125,8 @@ def _run_spec(spec, write, as_json, no_manifest, show_memory,
             _print_schedule(report)
         if show_propagation:
             _print_propagation(report)
+        if show_determinism:
+            _print_determinism(report)
         if show_autotune:
             from .baseline import PROGRAM_CONFIGS
             if spec in PROGRAM_CONFIGS:
@@ -195,18 +207,47 @@ def _print_propagation(report):
           f"{prop['n_loop_carry_reshards']} loop-carry reshard(s)")
 
 
+def _print_determinism(report):
+    det = report.metrics.get("determinism", {})
+    if not det.get("available"):
+        print("   determinism: no jaxpr available")
+        return
+    print(f"   determinism: {det['n_canonical_writes']}/"
+          f"{det['n_pool_writes']} pool writes canonical over "
+          f"{det['n_pool_buffers']} pool buffer(s), "
+          f"{det['n_rng_sites']} RNG site(s); overlap pairs "
+          f"{det['n_proven_disjoint']}/{det['n_overlap_pairs']} proven "
+          f"disjoint; {det['n_alias_outputs']} alias output(s) of "
+          f"{det['n_donated_args']} donated arg(s)")
+    th = report.metrics.get("threads", {})
+    if th.get("available"):
+        print(f"   threads: {th['n_threaded_classes']}/"
+              f"{th['n_classes']} classes threaded across "
+              f"{th['n_files']} file(s), {th['n_shared_paths']} "
+              f"unlocked shared path(s), {th['n_lock_attrs']} "
+              "lock attr(s)")
+    rules = dict(det.get("rules", ()))
+    rules.update(th.get("rules", ()))
+    fired = {k: v for k, v in sorted(rules.items()) if v}
+    if fired:
+        print("     fired: " + ", ".join(f"{k}={v}"
+                                         for k, v in fired.items()))
+
+
 def _check_manifests(names):
     """Regenerate every manifest in-memory (lint, memory, tuning,
     schedule AND propagation) and diff against the committed files.
     Returns the number of drifting/missing manifests (the --check CI
     mode: stale manifests fail instead of silently re-baselining)."""
-    from . import (PassManager, build_manifest, build_memory_manifest,
+    from . import (PassManager, build_determinism_manifest,
+                   build_manifest, build_memory_manifest,
                    build_propagation_manifest, build_schedule_manifest,
-                   build_tuning_manifest, load_manifest,
-                   load_memory_manifest, load_propagation_manifest,
-                   load_schedule_manifest, load_tuning_manifest,
-                   manifest_drift)
-    from .baseline import BASELINE_CONFIGS, SCHEDULE_CONFIGS
+                   build_tuning_manifest, load_determinism_manifest,
+                   load_manifest, load_memory_manifest,
+                   load_propagation_manifest, load_schedule_manifest,
+                   load_tuning_manifest, manifest_drift)
+    from .baseline import (BASELINE_CONFIGS, DETERMINISM_CONFIGS,
+                           SCHEDULE_CONFIGS)
 
     pm = PassManager()
     n_bad = 0
@@ -227,6 +268,10 @@ def _check_manifests(names):
             drift += manifest_drift(
                 build_schedule_manifest(name, report),
                 load_schedule_manifest(name), path="schedule")
+        if name in DETERMINISM_CONFIGS:
+            drift += manifest_drift(
+                build_determinism_manifest(name, report),
+                load_determinism_manifest(name), path="determinism")
         if name in BASELINE_CONFIGS:
             drift += manifest_drift(
                 build_tuning_manifest(name, _tuning_report(name)),
@@ -274,6 +319,10 @@ def main(argv=None):
                         help="print the GSPMD fixed-point propagation "
                              "summary (exact/fallback coverage, XLA "
                              "annotation agreement, divergences)")
+    parser.add_argument("--determinism", action="store_true",
+                        help="print the Determinism Doctor summary "
+                             "(canonical pool writes, RNG provenance, "
+                             "scatter-overlap proofs, thread lint)")
     parser.add_argument("--autotune", action="store_true",
                         help="print the remat advisor's what-if table "
                              "(per-policy peak, recompute FLOPs, "
@@ -309,7 +358,8 @@ def main(argv=None):
                            args.no_manifest_check, args.memory,
                            show_autotune=args.autotune,
                            show_schedule=args.schedule,
-                           show_propagation=args.propagation)
+                           show_propagation=args.propagation,
+                           show_determinism=args.determinism)
         sev = report.max_severity
         if sev is not None and (worst is None or sev > worst):
             worst = sev

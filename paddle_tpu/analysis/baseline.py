@@ -15,8 +15,8 @@ import jax.numpy as jnp
 from .pass_manager import AnalysisContext
 
 __all__ = ["BASELINE_CONFIGS", "PROGRAM_CONFIGS", "SCHEDULE_CONFIGS",
-           "build_config", "lowered_program", "forward_fn",
-           "tuning_report"]
+           "DETERMINISM_CONFIGS", "build_config", "lowered_program",
+           "forward_fn", "tuning_report"]
 
 _CACHE = {}   # name -> (LoweredProgram, AnalysisContext, forward fn)
 _TUNING_CACHE = {}   # name -> AutotuneReport (autotune.autotune_layer)
@@ -566,6 +566,20 @@ PROGRAM_CONFIGS = {
 SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",
                                               "gpt_decode_mt",
                                               "gpt_tp_overlap")
+
+# configs whose determinism manifest is committed
+# (determinism_manifests/): every SERVING capture — the programs whose
+# byte-identical-stream invariant the Determinism Doctor proves
+# statically (taint-canonical pool writes, clean RNG key derivation,
+# no unprovable scatter overlap, no donated-alias outputs, and the
+# host-side thread/lock discipline).  The training and tp-overlap
+# captures stay excluded: no pool buffers, nothing for the pass to
+# pin.  The SpeculativeEngine verify window is deliberately NOT here:
+# it is the documented expected red (tests/test_determinism_lint.py
+# pins it red until commit-on-accept lands).
+DETERMINISM_CONFIGS = ("gpt_decode", "gpt_decode_prefix",
+                       "gpt_decode_ragged", "gpt_decode_kv8",
+                       "gpt_decode_mt")
 
 
 def build_config(name):

@@ -28,13 +28,17 @@ __all__ = ["manifest_dir", "manifest_path", "load_manifest",
            "write_schedule_manifest",
            "propagation_manifest_dir", "propagation_manifest_path",
            "load_propagation_manifest", "build_propagation_manifest",
-           "write_propagation_manifest"]
+           "write_propagation_manifest",
+           "determinism_manifest_dir", "determinism_manifest_path",
+           "load_determinism_manifest", "build_determinism_manifest",
+           "write_determinism_manifest"]
 
 _SCHEMA = 1
 _MEMORY_SCHEMA = 1
 _TUNING_SCHEMA = 1
 _SCHEDULE_SCHEMA = 1
 _PROPAGATION_SCHEMA = 1
+_DETERMINISM_SCHEMA = 1
 
 
 def manifest_dir():
@@ -335,6 +339,85 @@ def write_propagation_manifest(name, report):
     os.makedirs(propagation_manifest_dir(), exist_ok=True)
     data = build_propagation_manifest(name, report)
     with open(propagation_manifest_path(name), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+# ----------------------------------------------------------- determinism
+
+
+def determinism_manifest_dir():
+    """Repo-root determinism_manifests/ (next to schedule_manifests/)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, "determinism_manifests")
+
+
+def determinism_manifest_path(name):
+    return os.path.join(determinism_manifest_dir(), f"{name}.json")
+
+
+def load_determinism_manifest(name):
+    """The committed determinism manifest dict, or None when absent."""
+    try:
+        with open(determinism_manifest_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def build_determinism_manifest(name, report):
+    """Determinism manifest dict from one pass-manager run: the graph
+    leg's taint/write/race coverage (analysis/determinism.py metrics)
+    plus the host leg's thread-discipline counters
+    (analysis/threads.py).  Committed GREEN for every serving PROGRAM
+    config — the one expected red (the SpeculativeEngine verify
+    window) is a separate, uncommitted program pinned red by
+    tests/test_determinism_lint.py.  Deterministic: the taint fixed
+    point walks one cached CPU trace and the thread lint walks the
+    checked-in sources, so every machine agrees byte-for-byte."""
+    det = report.metrics.get("determinism", {})
+    thr = report.metrics.get("threads", {})
+    fnd = [f for f in report.findings
+           if f.analyzer in ("determinism", "threads")]
+    rules = dict(det.get("rules", {}))
+    for k, v in thr.get("rules", {}).items():
+        rules[k] = rules.get(k, 0) + v
+    return {
+        "schema": _DETERMINISM_SCHEMA,
+        "model": name,
+        "graph": {
+            "n_eqns": det.get("n_eqns", 0),
+            "n_pool_buffers": det.get("n_pool_buffers", 0),
+            "n_pool_writes": det.get("n_pool_writes", 0),
+            "n_canonical_writes": det.get("n_canonical_writes", 0),
+            "n_rng_sites": det.get("n_rng_sites", 0),
+            "n_overlap_pairs": det.get("n_overlap_pairs", 0),
+            "n_proven_disjoint": det.get("n_proven_disjoint", 0),
+            "n_donated_args": det.get("n_donated_args", 0),
+            "n_alias_outputs": det.get("n_alias_outputs", 0),
+        },
+        "threads": {
+            "n_files": thr.get("n_files", 0),
+            "n_classes": thr.get("n_classes", 0),
+            "n_threaded_classes": thr.get("n_threaded_classes", 0),
+            "n_shared_paths": thr.get("n_shared_paths", 0),
+            "n_lock_attrs": thr.get("n_lock_attrs", 0),
+        },
+        "rules": rules,
+        "n_findings": len(fnd),
+        "max_severity": (str(max(f.severity for f in fnd))
+                         if fnd else None),
+        "note": "regenerate: python -m paddle_tpu.analysis "
+                "--write-manifests",
+    }
+
+
+def write_determinism_manifest(name, report):
+    os.makedirs(determinism_manifest_dir(), exist_ok=True)
+    data = build_determinism_manifest(name, report)
+    with open(determinism_manifest_path(name), "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
     return data

@@ -45,6 +45,8 @@ def default_catalog():
     from . import memory as _m        # noqa: F401  (registers memory pass)
     from . import sharding as _s      # noqa: F401  (registers sharding pass)
     from . import ast_lint as _l      # noqa: F401  (registers source pass)
+    from . import determinism as _d   # noqa: F401  (registers determinism)
+    from . import threads as _t       # noqa: F401  (registers thread lint)
     return list(_REGISTRY)
 
 

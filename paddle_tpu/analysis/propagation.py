@@ -85,9 +85,12 @@ class PropagationResult:
     """Outcome of one fixed-point propagation over a jaxpr."""
     dims: dict = field(default_factory=dict)    # var -> per-dim counts
     counts: dict = field(default_factory=dict)  # var -> total shard count
-    # var -> per-dim mesh-axis NAMES (tuple of tuples of strings; first
-    # slice: seeded vars only — entry args with a known PartitionSpec
-    # and sharding_constraint outputs; not yet propagated through eqns)
+    # var -> per-dim mesh-axis NAMES (tuple of tuples of strings):
+    # seeded from entry args with a known PartitionSpec and from
+    # sharding_constraint outputs, then propagated forward through the
+    # structural eqn-rule slice (`_propagate_axes`: elementwise
+    # inherit, transpose permute, dot_general batch+free with
+    # contracted-drop) so derived vars keep their identity too
     axes: dict = field(default_factory=dict)
     divergences: list = field(default_factory=list)
     loop_reshards: list = field(default_factory=list)
@@ -447,6 +450,41 @@ def _axes_distinct(axes, v):
     return len(named) == len(set(named))
 
 
+def _axis_sizes(axes, dims):
+    """{mesh axis name: size}, recovered from vars carrying BOTH an
+    axis identity and a per-dim count spec: a dim split over exactly
+    one named axis splits that many ways, so the count IS the axis
+    size (PartitionSpec semantics — "dp" means the whole dp axis).
+    First observation wins; multi-name dims are skipped (their count
+    is a product this inversion cannot decompose)."""
+    sizes = {}
+    for v, a in axes.items():
+        d = dims.get(v)
+        if d is None or len(d) != len(a):
+            continue
+        for names, cnt in zip(a, d):
+            if len(names) == 1:
+                sizes.setdefault(names[0], int(cnt))
+    return sizes
+
+
+def _axes_product(axes, v, sizes):
+    """The shard count an axis identity PROVES: the product of the
+    named axes' sizes, for a var whose axes are distinct and all
+    sized. None when the identity is missing, conflicted, or names an
+    axis no seed sized — callers fall back to the caps."""
+    a = axes.get(v) if axes else None
+    if a is None or not _axes_distinct(axes, v):
+        return None
+    total = 1
+    for dim in a:
+        for n in dim:
+            if n not in sizes:
+                return None
+            total *= int(sizes[n])
+    return max(total, 1)
+
+
 def _final_counts(jx, dims, arg_counts, axes=None):
     """{var: total shard count} over the TOP-LEVEL jaxpr: the product of
     the fixed-point per-dim spec where known, the v1 forward heuristic
@@ -457,8 +495,14 @@ def _final_counts(jx, dims, arg_counts, axes=None):
     `axes` (PropagationResult.axes) lifts the caps where it can: a var
     whose per-dim AXIS NAMES are known and distinct takes its dim-spec
     product verbatim — the identity proves the product is the real
-    shard count, not an over-claim."""
+    shard count, not an over-claim. DERIVED axis-identified vars (the
+    `_propagate_axes` eqn-rule slice) often have NO dim spec at all —
+    the dims sweep capped e.g. a dp x tp dot at its most-sharded
+    operand and recorded nothing — so their count comes from the
+    identity directly: the product of the named axes' sizes
+    (`_axes_product` over `_axis_sizes` recovered from the seeds)."""
     from .memory import _eqn_out_shard, _is_var
+    sizes = _axis_sizes(axes, dims) if axes else {}
     counts = {}
     for k, v in enumerate(jx.invars):
         d = dims.get(v)
@@ -483,10 +527,11 @@ def _final_counts(jx, dims, arg_counts, axes=None):
         cap = max(in_counts, default=1)
         for v in eqn.outvars:
             d = dims.get(v)
+            ap = _axes_product(axes, v, sizes)
             if d is None:
-                counts[v] = out
+                counts[v] = ap if ap is not None else out
             elif _axes_distinct(axes, v):
-                counts[v] = _prod(d)
+                counts[v] = ap if ap is not None else _prod(d)
             else:
                 counts[v] = min(_prod(d), cap)
     return counts
@@ -523,6 +568,119 @@ def _seed_axes(jx, arg_infos):
 
     _collect(jx)
     return axes
+
+
+# shape-preserving prims whose output is computed position-by-position
+# from same-shape operands: the output splits exactly the way every
+# operand splits, so mesh-axis identity carries through verbatim
+_ELEMENTWISE_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "rem", "pow", "max", "min", "atan2",
+    "nextafter", "and", "or", "xor", "not", "neg", "sign", "abs",
+    "exp", "exp2", "expm1", "log", "log1p", "tanh", "logistic", "erf",
+    "erfc", "erf_inv", "sqrt", "rsqrt", "cbrt", "square", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "floor", "ceil",
+    "round", "is_finite", "integer_pow", "convert_element_type",
+    "bitcast_convert_type", "real", "imag", "conj", "clamp",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "copy",
+    "stop_gradient", "reduce_precision"})
+
+
+def _axes_of(axes, v):
+    """Axis spec of one eqn operand. Literals and consts are
+    REPLICATED — a concrete all-empty spec, not an unknown — so a
+    `x * 2.0` chain doesn't break the identity at every literal."""
+    from .memory import _is_var
+    if not _is_var(v):
+        return ((),) * _rank(v)
+    return axes.get(v)
+
+
+def _set_axes(axes, v, spec):
+    """Monotone write, mirroring `_set`: first identity wins, rank
+    checked, never overwrite."""
+    from .memory import _is_var
+    if spec is None or not _is_var(v) or v in axes:
+        return False
+    if len(spec) != _rank(v):
+        return False
+    axes[v] = tuple(tuple(a) for a in spec)
+    return True
+
+
+def _propagate_axes(jx, axes, max_iters=_MAX_ITERS):
+    """Mesh-axis IDENTITY propagation, eqn-rule slice: forward-only,
+    monotone, run to a fixed point over the top-level jaxpr after
+    `_seed_axes`. Three structural rules — the ones whose output
+    identity is forced by the input identity with no mesh knowledge:
+
+    * same-shape elementwise: the output inherits its operands' axes
+      when every same-shape operand's identity is KNOWN and they all
+      AGREE (conflict or an unknown operand -> skip: the unknown side
+      might be sharded over a different axis, and guessing here would
+      let `_final_counts` lift a cap it must not);
+    * `transpose`: the per-dim names permute with the dims;
+    * `dot_general`: batch and free dims thread through in output
+      order (batch, lhs free, rhs free); CONTRACTED dims drop — the
+      partial products are all-reduced over those axes, so the result
+      carries no split (and hence no identity) there.
+
+    Everything else (reshape factor groups, gather/scatter, reductions,
+    sub-jaxpr bodies) stays out of this slice: their outputs simply
+    keep no identity and `_final_counts` falls back to the
+    conservative caps, the safe direction."""
+    from .memory import _sub_jaxprs
+    for _ in range(max_iters):
+        changed = False
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "sharding_constraint" or _sub_jaxprs(eqn) or \
+                    len(eqn.outvars) != 1:
+                continue
+            ov = eqn.outvars[0]
+            if ov in axes:
+                continue
+            if name == "transpose":
+                ia = _axes_of(axes, eqn.invars[0])
+                perm = eqn.params.get("permutation")
+                if ia is not None and perm is not None and \
+                        len(perm) == len(ia):
+                    changed |= _set_axes(
+                        axes, ov, tuple(ia[int(p)] for p in perm))
+                continue
+            if name == "dot_general":
+                la = _axes_of(axes, eqn.invars[0])
+                ra = _axes_of(axes, eqn.invars[1])
+                if la is None or ra is None:
+                    continue
+                (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+                batch = [la[int(i)] for i in lb]
+                lfree = [la[i] for i in range(len(la))
+                         if i not in set(lc) | set(lb)]
+                rfree = [ra[i] for i in range(len(ra))
+                         if i not in set(rc) | set(rb)]
+                changed |= _set_axes(axes, ov,
+                                     tuple(batch + lfree + rfree))
+                continue
+            if name not in _ELEMENTWISE_PRIMS:
+                continue
+            out_shape = tuple(getattr(ov.aval, "shape", ()))
+            specs, known = [], True
+            for v in eqn.invars:
+                shp = tuple(getattr(getattr(v, "aval", None),
+                                    "shape", ()) or ())
+                if shp != out_shape or shp == ():
+                    continue      # scalars don't constrain the split
+                a = _axes_of(axes, v)
+                if a is None:
+                    known = False
+                    break
+                specs.append(a)
+            if known and specs and all(s == specs[0]
+                                       for s in specs[1:]):
+                changed |= _set_axes(axes, ov, specs[0])
+        if not changed:
+            return
 
 
 def _cross_check_hlo(text, jx, dims, res):
@@ -639,6 +797,7 @@ def propagate_shardings(program_or_jaxpr, arg_infos=None, arg_counts=None,
                             converged=converged, jaxpr_id=id(jx))
     _report(jx, dims, res)
     res.axes = _seed_axes(jx, arg_infos)
+    _propagate_axes(jx, res.axes)
     res.counts = _final_counts(jx, dims, arg_counts, axes=res.axes)
     text = getattr(program, "text", None) if program is not None else None
     if text:

@@ -1,0 +1,376 @@
+"""Thread-discipline lint over the serving/IO host runtime — the
+Determinism Doctor's host-side leg (graph-side: determinism.py).
+
+The device-side taint pass can prove a pool write canonical, but the
+HOST decides which requests enter which tick: a racy prefetch worker,
+an unlocked HostKVTier LRU, or a FlightRecorder hook mutated from two
+threads reorders *admissions*, and byte-identical pages no longer mean
+byte-identical streams.  Before the cross-process HostKVTier (ROADMAP)
+multiplies the thread count, this lint walks every class in
+`paddle_tpu/serving/` + `paddle_tpu/io/` and checks the lock
+discipline statically, extending the PR-1 dy2static AST-linter idiom
+(ast walk, findings with file:line, zero imports of the target).
+
+Model (deliberately conservative about *sides*, precise about
+*paths*):
+
+  * a class is THREADED when it spawns `threading.Thread(target=
+    self.<m>)`: `<m>` and everything it calls is the WORKER side;
+    every other method (minus `__init__`, which runs before the
+    thread is published) and everything *it* calls is the MAIN side.
+    Classes that spawn no threads produce NO findings — single-
+    threaded user code can't false-positive (the r5 fuzz-corpus bar).
+  * accesses are keyed by full attribute PATH (`self._stats.batches`,
+    not `self._stats`): the prefetch iterator's worker and consumer
+    legally own different fields of one stats object.
+  * attributes initialised from `Queue`/`Event`/`Lock`/`Condition`/
+    `Semaphore`/`threading.local` are thread-safe by construction and
+    exempt.
+
+Rules:
+
+  SERVE-UNLOCKED-SHARED  one attribute path is WRITTEN from both
+                         sides and at least one of those writes is
+                         not inside a `with self.<lock>` block — an
+                         unsynchronized write-write on shared
+                         mutable state.
+  SERVE-LOCK-ORDER       two lock attributes are acquired in opposite
+                         nesting orders by different methods — the
+                         classic ABBA deadlock once both sides run.
+"""
+import ast
+import os
+
+from .findings import Finding, Severity
+from .pass_manager import Analyzer, register_analyzer
+
+__all__ = ["ThreadDisciplineAnalyzer", "lint_thread_discipline",
+           "lint_module_source", "default_thread_lint_paths"]
+
+_THREADSAFE_CTORS = frozenset({
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event",
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "local"})
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+# method calls that mutate their receiver in place
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "add", "discard", "setdefault",
+    "move_to_end", "appendleft", "sort", "reverse"})
+
+
+def _call_ctor_name(node):
+    """`Queue` for `queue.Queue(...)` / `Queue(...)`, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _self_attr_path(node):
+    """('_stats', 'batches') for `self._stats.batches`, None when the
+    chain is not rooted at `self` (subscripts terminate the path at
+    the base attribute: `self._live[i]` -> ('_live',))."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    while isinstance(node, ast.Subscript):
+        node = node.value
+        if isinstance(node, ast.Attribute):
+            parts = []
+            while isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and parts:
+        return tuple(reversed(parts))
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: self-attr writes (with the lock-attr context
+    each occurred under), self-method calls, and nested lock orders."""
+
+    def __init__(self, lock_attrs):
+        self.lock_attrs = lock_attrs
+        self.writes = []         # (path, lineno, frozenset(held locks))
+        self.calls = set()       # self.<m>() method names
+        self.lock_pairs = []     # (outer, inner, lineno)
+        self._held = []
+
+    # ---- lock context
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            p = None
+            ce = item.context_expr
+            if isinstance(ce, ast.Attribute):
+                p = _self_attr_path(ce)
+            elif isinstance(ce, ast.Call) and \
+                    isinstance(ce.func, ast.Attribute):
+                # `with self._lock.acquire_timeout(...)`-style wrappers
+                p = _self_attr_path(ce.func.value)
+            if p and len(p) == 1 and p[0] in self.lock_attrs:
+                for outer in self._held:
+                    self.lock_pairs.append((outer, p[0], node.lineno))
+                acquired.append(p[0])
+                self._held.append(p[0])
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    # ---- writes
+
+    def _record_write(self, target, lineno):
+        p = _self_attr_path(target)
+        if p is not None:
+            self.writes.append((p, lineno, frozenset(self._held)))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._record_write(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _MUTATORS:
+                p = _self_attr_path(f.value)
+                if p is not None:
+                    self.writes.append(
+                        (p, node.lineno, frozenset(self._held)))
+            elif isinstance(f.value, ast.Name) and \
+                    f.value.id == "self":
+                self.calls.add(f.attr)
+        self.generic_visit(node)
+
+
+def _thread_targets(cls_node):
+    """Names of methods passed as `threading.Thread(target=self.<m>)`
+    anywhere in the class body."""
+    targets = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Call) and \
+                _call_ctor_name(node) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    p = _self_attr_path(kw.value)
+                    if p and len(p) == 1:
+                        targets.add(p[0])
+    return targets
+
+
+def _closure(roots, calls):
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        m = frontier.pop()
+        for callee in calls.get(m, ()):
+            if callee in calls and callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+def _conflicts(a, b):
+    """Two attr paths alias when one is a prefix of the other."""
+    n = min(len(a), len(b))
+    return a[:n] == b[:n]
+
+
+def _lint_class(cls_node, filename, findings):
+    """Run both rules over one ClassDef.  Returns per-class metric
+    counters (threaded?, shared paths, lock attrs)."""
+    methods = {n.name: n for n in cls_node.body
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))}
+    # attr typing from constructor-looking assignments anywhere
+    lock_attrs, safe_attrs = set(), set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            p = _self_attr_path(node.targets[0])
+            ctor = _call_ctor_name(node.value)
+            if p and len(p) == 1 and ctor in _THREADSAFE_CTORS:
+                safe_attrs.add(p[0])
+                if ctor in _LOCK_CTORS:
+                    lock_attrs.add(p[0])
+
+    workers = _thread_targets(cls_node) & set(methods)
+    stats = {"threaded": bool(workers), "n_lock_attrs": len(lock_attrs),
+             "n_shared_paths": 0}
+    scans = {}
+    for name, node in methods.items():
+        s = _MethodScan(lock_attrs)
+        for stmt in node.body:
+            s.visit(stmt)
+        scans[name] = s
+    calls = {name: s.calls for name, s in scans.items()}
+
+    # SERVE-LOCK-ORDER needs no worker: inconsistent nesting is a
+    # hazard the moment any caller threads (and the committed runtime
+    # is about to)
+    order = {}                   # (A, B) -> first lineno
+    for name, s in scans.items():
+        for a, b, line in s.lock_pairs:
+            order.setdefault((a, b), (name, line))
+    for (a, b), (name, line) in sorted(order.items()):
+        if a != b and (b, a) in order and (a, b) < (b, a):
+            oname, oline = order[(b, a)]
+            findings.append(Finding(
+                "SERVE-LOCK-ORDER", Severity.ERROR,
+                f"class {cls_node.name} acquires lock '{a}' then "
+                f"'{b}' in {name} (line {line}) but '{b}' then '{a}' "
+                f"in {oname} (line {oline}) — opposite nesting "
+                "orders deadlock once both run concurrently",
+                op=f"{cls_node.name}.{name}",
+                location=f"{os.path.basename(filename)}:{line}",
+                suggested_fix="pick one global acquisition order for "
+                "the class's locks and make every method follow it"))
+
+    if not workers:
+        return stats
+
+    worker_side = _closure(workers, calls)
+    main_roots = (set(methods) - worker_side) - {"__init__"}
+    main_side = _closure(main_roots, calls)
+
+    def side_writes(side):
+        out = {}
+        for m in sorted(side):
+            for p, line, held in scans[m].writes:
+                if p[0] in safe_attrs or p[0] in lock_attrs:
+                    continue
+                out.setdefault(p, []).append((m, line, held))
+        return out
+
+    ww, mw = side_writes(worker_side), side_writes(main_side)
+    flagged = set()
+    for wp in sorted(ww):
+        for mp in sorted(mw):
+            if not _conflicts(wp, mp):
+                continue
+            key = min(wp, mp)
+            if key in flagged:
+                continue
+            accesses = ww[wp] + mw[mp]
+            held_everywhere = frozenset.intersection(
+                *[h for _, _, h in accesses])
+            stats["n_shared_paths"] += 1
+            if held_everywhere:
+                continue          # one common lock guards every write
+            flagged.add(key)
+            attr = "self." + ".".join(key)
+            sides = ", ".join(
+                f"{m} line {ln}" + (" [unlocked]" if not h else "")
+                for m, ln, h in accesses[:4])
+            findings.append(Finding(
+                "SERVE-UNLOCKED-SHARED", Severity.ERROR,
+                f"class {cls_node.name} writes {attr} from both the "
+                f"worker thread and the main thread with no common "
+                f"lock held ({sides}) — an unsynchronized write-write "
+                "on shared mutable state; admission order becomes "
+                "schedule-dependent",
+                op=f"{cls_node.name}: {attr}",
+                location=(f"{os.path.basename(filename)}:"
+                          f"{accesses[0][1]}"),
+                suggested_fix="guard every write with one owning "
+                "`with self.<lock>:` block, or hand the value across "
+                "threads through the Queue instead of a shared "
+                "attribute"))
+    return stats
+
+
+def lint_module_source(src, filename="<module>"):
+    """Lint one module's SOURCE TEXT.  Returns (findings, stats) —
+    the entry the fuzz-corpus tests drive directly."""
+    findings = []
+    stats = {"n_classes": 0, "n_threaded_classes": 0,
+             "n_shared_paths": 0, "n_lock_attrs": 0}
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return findings, stats
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            stats["n_classes"] += 1
+            cs = _lint_class(node, filename, findings)
+            stats["n_threaded_classes"] += int(cs["threaded"])
+            stats["n_shared_paths"] += cs["n_shared_paths"]
+            stats["n_lock_attrs"] += cs["n_lock_attrs"]
+    return findings, stats
+
+
+def default_thread_lint_paths():
+    """The serving-runtime surface the lint audits: every module of
+    `paddle_tpu/serving/` and `paddle_tpu/io/`."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = []
+    for sub in ("serving", "io"):
+        d = os.path.join(pkg, sub)
+        if os.path.isdir(d):
+            out.extend(sorted(
+                os.path.join(d, f) for f in os.listdir(d)
+                if f.endswith(".py")))
+    return out
+
+
+def lint_thread_discipline(paths=None):
+    """Lint every module in `paths` (default: serving/ + io/).
+    Returns (findings, metrics) — deterministic: files sorted, classes
+    in file order."""
+    findings = []
+    metrics = {"n_files": 0, "n_classes": 0, "n_threaded_classes": 0,
+               "n_shared_paths": 0, "n_lock_attrs": 0}
+    for path in (paths if paths is not None
+                 else default_thread_lint_paths()):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        metrics["n_files"] += 1
+        found, stats = lint_module_source(src, filename=path)
+        findings.extend(found)
+        for k, v in stats.items():
+            metrics[k] += v
+    rules = {}
+    for f in findings:
+        rules[f.rule_id] = rules.get(f.rule_id, 0) + 1
+    metrics["rules"] = rules
+    return findings, metrics
+
+
+@register_analyzer
+class ThreadDisciplineAnalyzer(Analyzer):
+    """Host-side Determinism Doctor leg: SERVE-UNLOCKED-SHARED +
+    SERVE-LOCK-ORDER over the serving/IO runtime modules.  A `source`
+    analyzer that audits the REPO surface rather than the passed
+    target, so it only runs when the context opts in
+    (`ctx.extra["thread_lint"]` or a serving capture's
+    `serving_decode`) — layer lints stay unaffected."""
+    name = "threads"
+    kind = "source"
+
+    def run(self, target, ctx):
+        extra = getattr(ctx, "extra", None) or {}
+        if not (extra.get("thread_lint") or extra.get("serving_decode")):
+            self.metrics = {"available": False}
+            return []
+        paths = extra.get("thread_lint_paths")
+        findings, metrics = lint_thread_discipline(paths)
+        self.metrics = {"available": True, **metrics}
+        return findings

@@ -14,6 +14,7 @@ from .framework.core import Tensor, apply_op
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
            "assert_finite_pytree", "TensorCheckerConfig", "diagnose",
            "input_pipeline_stats", "memory_report", "schedule_report",
+           "determinism_report",
            "autotune", "serving_stats", "serving_report"]
 
 
@@ -244,6 +245,70 @@ def schedule_report(target, *example_inputs, batch=None, lr=0.0,
         print(f"== schedule report: {program.name} ==")
         print(est)
     return est
+
+
+def determinism_report(target=None, print_report=True, thread_paths=None,
+                       **program_kw):
+    """Determinism Doctor front door: prove (or refute) the
+    byte-identical-stream invariant statically, before a request ever
+    reaches a chip.
+
+    `target` may be a serving decoder — anything with
+    `analysis_program`, e.g. `serving.PagedGPTDecoder`; `program_kw`
+    forwards, so `determinism_report(dec, k=4)` audits the same fused
+    multi-step program the engine dispatches — or an already-lowered
+    `analysis.LoweredProgram`. The graph side runs the write-site
+    taint analysis (KV-WRITE-NONCANONICAL, RNG-KEY-TAINT), the
+    scatter-race prover (SCATTER-WRITE-OVERLAP) and the donation
+    audit (DONATE-HOST-ALIAS). The host side always runs the
+    thread-discipline lint (SERVE-UNLOCKED-SHARED, SERVE-LOCK-ORDER)
+    over serving/ + io/ (or `thread_paths`). With `target=None` only
+    the host-side lint runs. Returns
+    ``{"findings": [Finding...], "graph": {...}, "threads": {...}}``;
+    the same data the CLI's ``--determinism`` flag prints and
+    determinism_manifests/*.json pins per serving config (the
+    `lint_determinism` gate)."""
+    from .analysis.determinism import analyze_determinism
+    from .analysis.lowering import LoweredProgram
+    from .analysis.threads import lint_thread_discipline
+
+    findings, graph = [], {}
+    program = None
+    if target is not None:
+        if isinstance(target, LoweredProgram):
+            program = target
+        elif hasattr(target, "analysis_program"):
+            program = target.analysis_program(**program_kw)
+        else:
+            raise TypeError(
+                "determinism_report wants a serving decoder (an object "
+                "with .analysis_program) or a LoweredProgram, got "
+                f"{type(target).__name__}")
+        res = analyze_determinism(program)
+        findings += res.findings
+        graph = res.metrics
+    tfound, threads = lint_thread_discipline(paths=thread_paths)
+    findings += tfound
+    if print_report:
+        if graph:
+            print(f"== determinism: {program.name} ==")
+            print(f"  pool writes {graph['n_canonical_writes']}/"
+                  f"{graph['n_pool_writes']} canonical over "
+                  f"{graph['n_pool_buffers']} buffer(s); "
+                  f"{graph['n_rng_sites']} RNG site(s); overlap pairs "
+                  f"{graph['n_proven_disjoint']}/"
+                  f"{graph['n_overlap_pairs']} proven disjoint; "
+                  f"{graph['n_alias_outputs']} alias output(s) of "
+                  f"{graph['n_donated_args']} donated arg(s)")
+        print(f"== threads: {threads['n_threaded_classes']}/"
+              f"{threads['n_classes']} classes threaded, "
+              f"{threads['n_shared_paths']} unlocked shared path(s) ==")
+        if findings:
+            for f in findings:
+                print(f"  {f}")
+        else:
+            print("  clean (0 findings)")
+    return {"findings": findings, "graph": graph, "threads": threads}
 
 
 def input_pipeline_stats():

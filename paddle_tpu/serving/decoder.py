@@ -1599,7 +1599,7 @@ class PagedGPTDecoder:
         return repr(parts).encode()
 
     def analysis_program(self, donate=True, k=None, prefix_w=None,
-                         ragged=None):
+                         ragged=None, verify_w=None):
         """Graph Doctor view of the compiled decode program: one fresh
         trace with per-argument role capture — weights/embeddings are
         `param` (read-only across steps, NOT donated: that's correct
@@ -1626,7 +1626,17 @@ class PagedGPTDecoder:
         the `gpt_decode_ragged` PROGRAM config gates it with
         SERVE-HOST-SYNC-DECODE and (via an engine schedule trace on
         the context) SERVE-PREFILL-STALL. `donate=False` traces the
-        defective variant the planted-defect tests lint."""
+        defective variant the planted-defect tests lint.
+
+        With `verify_w` the SPECULATIVE verify-window program
+        (`_verify_step`, the SpeculativeEngine's target forward over
+        the last accepted token + W-1 draft proposals) is traced with
+        the window tokens captured as "draft_tokens" — request-
+        EXTRINSIC bytes under the Determinism Doctor's provenance
+        lattice, so KV-WRITE-NONCANONICAL fires on its pool writes:
+        the documented expected red (draft bytes land in real pages
+        BEFORE acceptance; the ROADMAP's commit-on-accept work must
+        turn this program green)."""
         from ..analysis.lowering import LoweredProgram, tree_arg_infos
 
         S = self.max_batch
@@ -1639,8 +1649,30 @@ class PagedGPTDecoder:
         aid_in = (jnp.zeros((S,), jnp.int32)
                   if self.lora is not None else None)
         aid_tail = () if aid_in is None else (aid_in,)
-        if sum(map(bool, (k, prefix_w, ragged))) > 1:
-            raise ValueError("pass only one of k=, prefix_w=, ragged=")
+        if sum(map(bool, (k, prefix_w, ragged, verify_w))) > 1:
+            raise ValueError(
+                "pass only one of k=, prefix_w=, ragged=, verify_w=")
+        if verify_w:
+            W = int(verify_w)
+            draft = jnp.zeros((S, W), jnp.int32)
+            lens = jnp.zeros((S,), jnp.int32)
+            inputs = [("draft_tokens", draft), ("lens", lens),
+                      ("table", table)]
+            fn = jax.jit(self._verify_step,
+                         donate_argnums=(1, 2) if donate else ())
+            traced = fn.trace(self.weights, self.k_pages, self.v_pages,
+                              draft, lens, table)
+            name = f"verify_w{W}"
+            infos = tree_arg_infos(self.weights, "param")
+            infos += tree_arg_infos(self.k_pages, "cache",
+                                    prefix="k_pages", donated=donate)
+            infos += tree_arg_infos(self.v_pages, "cache",
+                                    prefix="v_pages", donated=donate)
+            for nm, v in inputs:
+                infos += tree_arg_infos(v, "input", prefix=nm)
+            return LoweredProgram(traced.lower().as_text(),
+                                  jaxpr=traced.jaxpr, name=name,
+                                  arg_infos=infos)
         if ragged:
             rk, rw = map(int, ragged)
             P = self.pend_capacity

@@ -240,3 +240,45 @@ def test_while_loop_programs(seed, tmp_path):
             continue
         np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-6,
                                    err_msg=src)
+
+
+def _classify(src, seed):
+    """Wrap one generated fuzz function as a method of a stateful but
+    SINGLE-THREADED class: attribute writes from several methods, no
+    thread spawned anywhere."""
+    lines = src.splitlines()
+    idx = next(i for i, ln in enumerate(lines)
+               if ln.startswith(f"def f{seed}(x):"))
+    method = ["    " + ln for ln in lines[idx:] if ln]
+    method[0] = f"    def f{seed}(self, x):"
+    method.insert(1, "        self.calls += 1")
+    method.insert(2, "        self.hist.append(x)")
+    return "\n".join(
+        lines[:idx]
+        + [f"class Fuzz{seed}:",
+           "    def __init__(self):",
+           "        self.calls = 0",
+           "        self.hist = []"]
+        + method
+        + ["    def reset(self):",
+           "        self.calls = 0",
+           "        self.hist.clear()", ""])
+
+
+@pytest.mark.parametrize("seed", range(N_PROGRAMS))
+def test_fuzz_corpus_thread_lint_silent(seed):
+    """The r5 fuzz corpus vs the thread-discipline lint: every
+    generated control-flow program, wrapped as a stateful class with
+    unlocked attribute writes from MULTIPLE methods but no spawned
+    thread, must produce zero findings — single-threaded user code
+    cannot false-positive (threads.py's conservative-sides bar)."""
+    from paddle_tpu.analysis.threads import lint_module_source
+    src = _classify(_make_program(seed), seed)
+    try:
+        compile(src, f"fuzz_cls_{seed}.py", "exec")
+    except SyntaxError:
+        pytest.fail(f"class wrap produced bad syntax:\n{src}")
+    findings, stats = lint_module_source(src, f"fuzz_cls_{seed}.py")
+    assert findings == [], "\n".join(str(f) for f in findings) + src
+    assert stats["n_classes"] == 1
+    assert stats["n_threaded_classes"] == 0

@@ -771,7 +771,13 @@ def test_propagation_axis_identity_first_slice():
     cons = [e for e in jx.eqns
             if e.primitive.name == "sharding_constraint"]
     assert cons and res.axes[cons[0].outvars[0]] == (("dp",), ("tp",))
-    assert res.summary()["n_axis_identified"] == 2
+    # the eqn-rule slice carries identity THROUGH the elementwise add:
+    # `+ 1.0` inherits the constraint output's axes (the literal is
+    # replicated and does not constrain), so 3 vars are identified —
+    # the two seeds plus the derived add output
+    add = [e for e in jx.eqns if e.primitive.name == "add"]
+    assert add and res.axes[add[-1].outvars[0]] == (("dp",), ("tp",))
+    assert res.summary()["n_axis_identified"] == 3
 
     # cap relaxed: both operands replicated (cap would clamp to 1), yet
     # the constraint's distinct dp/tp axes prove the 8-way product

@@ -188,6 +188,63 @@ def test_backward_through_transpose_and_dot():
     assert res.converged
 
 
+def test_axis_identity_reaches_derived_vars_dp_x_tp():
+    """The eqn-rule slice of mesh-axis identity: an elementwise chain
+    keeps the dp identity of its input, the dot output composes the
+    lhs rows' "dp" with the rhs cols' "tp" (contracted dims drop), and
+    `_final_counts` trusts the 2x2=4 distinct-axes product on that
+    DERIVED var — past the max-operand cap of 2 that bounds an
+    identity-free run of the same program."""
+    from paddle_tpu.analysis.lowering import ArgInfo
+
+    def f(x, w):
+        h = x * 2.0 + 1.0
+        return jnp.dot(h, w)
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 16), jnp.float32),
+                           jnp.zeros((16, 8), jnp.float32))
+    infos = [ArgInfo(name="x", role="input", spec=P("dp", None)),
+             ArgInfo(name="w", role="param", spec=P(None, "tp"))]
+    res = propagate_shardings(jx, arg_infos=infos, arg_counts=[2, 2],
+                              arg_dims=[(2, 1), (1, 2)])
+    eqns = jx.jaxpr.eqns
+    h = next(e.outvars[0] for e in eqns
+             if e.primitive.name == "add")
+    out = jx.jaxpr.outvars[0]
+    assert res.axes[h] == (("dp",), ())          # derived, not seeded
+    assert res.axes[out] == (("dp",), ("tp",))   # contracted dim drops
+    assert res.counts[out] == 4                  # beyond the cap of 2
+    # the identity-free control: same program, no specs — the dot
+    # output stays capped at its most-sharded operand
+    blind = propagate_shardings(jx, arg_counts=[2, 2],
+                                arg_dims=[(2, 1), (1, 2)])
+    assert blind.counts[jx.jaxpr.outvars[0]] <= 2
+
+
+def test_axis_identity_transpose_permutes_and_conflict_skips():
+    """transpose permutes the per-dim names with the dims; an
+    elementwise op whose same-shape operands DISAGREE on identity
+    (dp-rows + dp-cols) keeps NO identity — the conflict-skip that
+    stops `_final_counts` from ever lifting a cap on a guess."""
+    from paddle_tpu.analysis.lowering import ArgInfo
+
+    def f(x, y):
+        return x.T + y
+
+    jx = jax.make_jaxpr(f)(jnp.zeros((8, 8), jnp.float32),
+                           jnp.zeros((8, 8), jnp.float32))
+    infos = [ArgInfo(name="x", role="input", spec=P("dp", None)),
+             ArgInfo(name="y", role="input", spec=P("dp", None))]
+    res = propagate_shardings(jx, arg_infos=infos, arg_counts=[2, 2],
+                              arg_dims=[(2, 1), (2, 1)])
+    t = next(e.outvars[0] for e in jx.jaxpr.eqns
+             if e.primitive.name == "transpose")
+    assert res.axes[t] == ((), ("dp",))          # names moved with dims
+    out = jx.jaxpr.outvars[0]
+    assert out not in res.axes                   # (,dp) vs (dp,) clash
+    assert res.counts[out] <= 2                  # cap stays
+
+
 def test_fixed_point_terminates_within_bound():
     """A long elementwise chain converges in a handful of sweeps (each
     sweep is forward AND backward, so depth doesn't multiply rounds),
