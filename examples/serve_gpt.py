@@ -6,6 +6,10 @@ Run:  JAX_PLATFORMS=cpu PYTHONPATH=. python examples/serve_gpt.py [a8w8|w4a16]
 point is the full serving path: tokenize -> prefill -> batched sampled
 decode -> detokenize. Swap in converted weights via
 utils.apply_reference_checkpoint for real outputs.)
+
+--replicas N serves the same prompts through a FleetRouter over N
+engine replicas sharing one host KV tier (docs/serving.md "Fleet
+serving") — the printed streams are byte-identical to the N=1 run.
 """
 
 import os
@@ -30,15 +34,84 @@ def build_tokenizer():
     return WordPieceTokenizer(vocab), len(vocab)
 
 
+def serve_fleet(model, tok, quant, n, trace_path, cache_dir,
+                multi_tenant):
+    """--replicas N: the same prompts through a FleetRouter over N
+    engine replicas. Requests route by prefix affinity — the shared
+    system page sends every prompt here to ONE replica, so its pages
+    prefill once fleet-wide — with a global rid order that makes the
+    token streams byte-identical to the single-engine run. The
+    replicas share one host KV tier (file-backed under --cache-dir,
+    else a temp dir), so a respawned replica warm-starts from its
+    siblings' spilled pages."""
+    import tempfile
+
+    from paddle_tpu.serving import (FleetRouter, PagedGPTDecoder,
+                                    PrefixCache, SharedHostKVTier,
+                                    TenantEngine)
+    tier_dir = cache_dir or tempfile.mkdtemp(prefix="serve_gpt_tier_")
+    engines = []
+    for _ in range(n):
+        dec = PagedGPTDecoder(model, num_pages=64, page_size=16,
+                              max_batch=4, temperature=0.8, top_p=0.95,
+                              seed=0, quant=quant)
+        tier = SharedHostKVTier(tier_dir, fingerprint=dec)
+        cache = PrefixCache(dec.page_size, salt=dec.cache_fingerprint(),
+                            tier=tier)
+        engines.append(TenantEngine(dec, max_new_tokens=16,
+                                    trace=bool(trace_path),
+                                    prefix_cache=cache))
+    router = FleetRouter(engines)
+    dec = engines[0].d
+    system = (tok.encode("the quick brown fox jumps over the lazy dog")
+              * 4)[:dec.page_size]
+    prompts = ["the quick brown fox", "tpu chips compile fast",
+               "the lazy dog"]
+    gids = {}
+    for k, p in enumerate(prompts):
+        ids = np.asarray(system + tok.encode(p), np.int32) % 256
+        tenant, slo = (("chat", "latency") if multi_tenant and k == 0
+                       else ("default", "throughput"))
+        gids[router.submit(ids, tenant=tenant, slo=slo)] = p
+    outs = router.run()
+    for gid, p in gids.items():
+        toks = [t % dec.cfg.vocab_size for t in outs[gid]]
+        print(f"{p!r} -> replica {router.replica_of(gid)}: "
+              f"{len(outs[gid])} tokens: {toks[:8]}...")
+    s = router.merged_stats().summary()
+    print(f"fleet of {n}: {s['requests']} prompts, {s['tokens']} "
+          f"tokens, prefix hit rate {s.get('prefix_hit_rate', 0.0):.3f}"
+          f" (the shared system page prefills ONCE fleet-wide), "
+          f"shared tier {engines[0].cache.tier.n_entries} entr(ies) "
+          f"under {tier_dir}")
+    if multi_tenant:
+        import json
+        print("fleet tenancy summary:")
+        print(json.dumps(router.tenancy_summary(), indent=1,
+                         sort_keys=True))
+    if trace_path:
+        router.export_trace(trace_path)
+        print(f"fleet flight trace -> {trace_path} (one merged "
+              "timeline, a pid block per replica; load in Perfetto)")
+
+
 def main():
     argv = sys.argv[1:]
     args, trace_path, cache_dir = [], None, None
     multi_tenant = False
+    replicas = 1
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--multi-tenant":
             multi_tenant = True
+        elif a.startswith("--replicas="):
+            replicas = int(a.split("=", 1)[1])
+        elif a == "--replicas":
+            if i + 1 >= len(argv):
+                sys.exit("usage: serve_gpt.py [--replicas N]")
+            replicas = int(argv[i + 1])
+            i += 1
         elif a.startswith("--trace="):
             trace_path = a.split("=", 1)[1]
         elif a == "--trace":
@@ -67,6 +140,10 @@ def main():
     model = GPT(gpt_tiny(vocab_size=256, max_seq_len=128,
                          dtype="float32", remat=False))
     model.eval()
+    if replicas > 1:
+        serve_fleet(model, tok, quant, replicas, trace_path, cache_dir,
+                    multi_tenant)
+        return
     dec = PagedGPTDecoder(model, num_pages=64, page_size=16, max_batch=4,
                           temperature=0.8, top_p=0.95, seed=0, quant=quant)
     # k_max defaults to cost_model.decode_horizon's priced K: blocks of

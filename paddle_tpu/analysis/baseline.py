@@ -426,6 +426,101 @@ def _gpt_decode_mt():
     return program, ctx, PagedGPTDecoder._packed_multi_step
 
 
+def _gpt_decode_fleet():
+    """The FLEET serving config (serving.fleet): the ragged mixed
+    horizon program served through a `FleetRouter` over TWO engine
+    replicas sharing ONE file-backed `SharedHostKVTier`, captured with
+    a page LEDGER from a replica whose pool overflowed into the
+    shared tier mid-run — so the committed ledger's `host` rows are
+    SHARED-tier rows (`"page": None`: a cross-process tier holds no
+    device-twin backrefs, the audit must accept ownerless host
+    entries) next to live slots. The workload is real fleet churn:
+    three 2-block templates route by prefix affinity (pigeonhole
+    lands >=2 on one replica), the 6-allocatable-page pool can't park
+    both next to active slots, evictions spill to the shared tier,
+    and a second admission round restores from it (asserted). Gated
+    by SERVE-HOST-SYNC-DECODE, SERVE-PREFILL-STALL and
+    MEM-PAGE-REFCOUNT like every serving capture; its determinism
+    manifest additionally pins the fleet thread/lock discipline
+    (analysis.threads covers serving/fleet.py)."""
+    import tempfile
+
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import (FleetRouter, PagedGPTDecoder,
+                                    PrefixCache, SharedHostKVTier,
+                                    TenantEngine)
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    tier_dir = tempfile.mkdtemp(prefix="gpt_decode_fleet_tier_")
+    engines = []
+    for _ in range(2):
+        dec = PagedGPTDecoder(model, num_pages=7, page_size=16,
+                              max_batch=2)
+        tier = SharedHostKVTier(tier_dir, fingerprint=dec)
+        engines.append(TenantEngine(
+            dec, max_new_tokens=6, k_max=2, tier_policy="restore",
+            prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint(),
+                                     tier=tier)))
+    router = FleetRouter(engines)
+    rng = np.random.RandomState(3)
+    V = cfg.vocab_size
+    templates = [rng.randint(0, V, 32).tolist() for _ in range(3)]
+
+    def round_of(seed):
+        # two requests per template: the home replica of a doubled-up
+        # template must evict parked blocks to admit the second wave,
+        # which is what pushes them through the shared tier
+        r = np.random.RandomState(seed)
+        return [t + r.randint(0, V, 4).tolist()
+                for t in templates for _ in range(2)]
+
+    for p in round_of(11):
+        router.submit(np.asarray(p, np.int32))
+    cap = {}
+
+    def on_sync(rt, i, eng):
+        # the live window: this replica has spilled into the shared
+        # tier AND still holds slots — the committed ledger carries
+        # shared host rows next to live ownership
+        if "ledger" not in cap and eng.stats.tier_spills and \
+                any(r is not None for r in eng._slot_req):
+            cap["ledger"] = eng.page_ledger()
+            cap["schedule"] = eng.serve_schedule()
+            cap["replica"] = i
+
+    router.run(on_sync=on_sync, parallel=False)
+    for p in round_of(12):                # re-admission: restores
+        router.submit(np.asarray(p, np.int32))
+    router.run(on_sync=on_sync, parallel=False)
+    tier = engines[0].cache.tier
+    merged = router.merged_stats()
+    assert tier.n_entries and merged.tier_spills, \
+        "fleet ledger workload lost its shared-tier spill shape"
+    assert merged.tier_restores and not merged.tier_recomputes, \
+        "fleet re-admission round did not restore from the shared tier"
+    assert cap.get("ledger") and cap["ledger"].get("host"), \
+        "ledger capture missed the live shared-tier window"
+    dec = engines[cap["replica"]].d
+    program = dec.analysis_program(ragged=(4, 8))
+    ctx = AnalysisContext(
+        name="gpt_decode_fleet",
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + RAGGED_ATTENTION_TRANSPOSES,
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "page_ledger": cap["ledger"],
+               "serve_schedule": cap["schedule"],
+               "fleet": {"replicas": 2,
+                         "tier_entries": tier.n_entries,
+                         "tier_bytes": tier.bytes_used,
+                         "tier_restores": int(merged.tier_restores)}})
+    return program, ctx, PagedGPTDecoder._packed_multi_step
+
+
 TP_OVERLAP_SIZES = dict(B=2, L=512, H=1024, F=4096, head_dim=64)
 TP_OVERLAP_AXIS = 4
 
@@ -547,6 +642,7 @@ PROGRAM_CONFIGS = {
     "gpt_decode_ragged": _gpt_decode_ragged,   # mixed chunked-prefill+decode
     "gpt_decode_kv8": _gpt_decode_kv8,         # int8 KV pool decode loop
     "gpt_decode_mt": _gpt_decode_mt,           # multi-tenant + multi-LoRA
+    "gpt_decode_fleet": _gpt_decode_fleet,     # fleet + shared host KV tier
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
     "gpt_tp_overlap": _gpt_tp_overlap,     # chunked collective-matmul tp block
 }
@@ -579,7 +675,7 @@ SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",
 # pins it red until commit-on-accept lands).
 DETERMINISM_CONFIGS = ("gpt_decode", "gpt_decode_prefix",
                        "gpt_decode_ragged", "gpt_decode_kv8",
-                       "gpt_decode_mt")
+                       "gpt_decode_mt", "gpt_decode_fleet")
 
 
 def build_config(name):

@@ -62,17 +62,27 @@ class ChipSpec:
     # figures (PCIe gen3/gen4-class hosts); they feed the RELATIVE
     # restore-vs-recompute decision, not accounting.
     host_bw: float = 1.6e10
+    # host-tier READ bytes/s — the extra leg a CROSS-PROCESS shared
+    # host tier (serving.fleet.SharedHostKVTier) pays BEFORE the PCIe
+    # DMA: the payload lives in an shm-/file-backed store another
+    # replica wrote, so a restore first copies it host-RAM -> host-RAM
+    # (page-cache read + memcpy, roughly DRAM-copy bandwidth) and only
+    # then crosses the wire. Distinct from `host_bw` so
+    # `restore_beats_recompute(shared=True)` stays honest for the
+    # fleet: the shared read never makes restore cheaper, only
+    # costlier, and pricing it at PCIe alone would overclaim the wire.
+    host_read_bw: float = 6.4e10
 
 
 CHIP_SPECS = {
     "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30, 300e9, 3.1e9,
-                   host_bw=1.6e10),
+                   host_bw=1.6e10, host_read_bw=6.4e10),
     "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30, 200e9, 3.1e9,
-                    host_bw=1.6e10),
+                    host_bw=1.6e10, host_read_bw=6.4e10),
     "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30, 600e9, 3.1e9,
-                    host_bw=3.2e10),
+                    host_bw=3.2e10, host_read_bw=1.2e11),
     "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 << 30, 448e9, 3.1e9,
-                    host_bw=3.2e10),
+                    host_bw=3.2e10, host_read_bw=1.2e11),
 }
 
 
@@ -564,7 +574,7 @@ def prefill_ttft_s(prompt_tokens, flops_per_token, cached_frac=0.0,
     return compute + host_sync_s
 
 
-def kv_restore_s(restore_bytes, chip=None):
+def kv_restore_s(restore_bytes, chip=None, shared=False):
     """Analytic floor of re-mounting spilled KV pages from pinned host
     RAM: bytes over the host<->chip wire (`ChipSpec.host_bw` — the PCIe
     DMA leg). The tiered-KV admission compares this against the
@@ -573,9 +583,19 @@ def kv_restore_s(restore_bytes, chip=None):
     only when the wire beats the prefill — big-model pages win (KV
     bytes/token are fixed but recompute FLOPs grow with params), tiny
     models recompute (serving.kv_tier owns the decision; ServeStats
-    tier_restores/tier_recomputes make it observable)."""
+    tier_restores/tier_recomputes make it observable).
+
+    `shared=True` prices the CROSS-PROCESS tier
+    (serving.fleet.SharedHostKVTier): the payload sits in an shm-/
+    file-backed store another replica wrote, so the restore pays a
+    host-RAM read leg (`ChipSpec.host_read_bw`) before the DMA — the
+    two legs are serial (read, then enqueue H2D), so they add."""
     chip = chip if isinstance(chip, ChipSpec) else chip_spec(chip)
-    return max(float(restore_bytes), 0.0) / chip.host_bw
+    b = max(float(restore_bytes), 0.0)
+    t = b / chip.host_bw
+    if shared:
+        t += b / chip.host_read_bw
+    return t
 
 
 def train_horizon(step_s, host_sync_s=None, n_cap=32,

@@ -105,6 +105,42 @@ def serving_report(drift_factor=None, print_report=False):
     return report
 
 
+def fleet_report(router, print_report=False):
+    """Fleet-wide serving observability (`serving.FleetRouter`): the
+    `ServeStats.merge()` summary over every replica (counters summed,
+    latency windows pooled in the deterministic replica order — the
+    fleet p50/p99 is the pooled math, not an average of averages),
+    the merged per-tenant/SLO ledgers, per-replica one-line stats,
+    and the shared host tier's occupancy. The fleet face of
+    `serving_report`: when the merged `prefix_hit_rate` sits below a
+    single replica's, the affinity split is fragmenting the template
+    working set; when `tier.n_entries` grows while hit rate holds,
+    the shared tier is absorbing an HBM cliff (docs/serving.md
+    "Fleet serving")."""
+    merged = router.merged_stats().summary()
+    tier = getattr(router.engines[0], "cache", None)
+    tier = getattr(tier, "tier", None)
+    report = {
+        "stats": merged,
+        "tenancy": router.tenancy_summary(),
+        "replicas": [e.stats.summary() for e in router.engines],
+    }
+    if tier is not None and getattr(tier, "shared", False):
+        report["shared_tier"] = {"entries": tier.n_entries,
+                                 "bytes": tier.bytes_used,
+                                 "path": str(tier.path)}
+    if print_report:
+        print(f"== fleet of {len(router.engines)} ==")
+        print(f"  merged: {merged}")
+        for i, r in enumerate(report["replicas"]):
+            print(f"  replica{i}: requests {r.get('requests', 0)}, "
+                  f"tokens {r.get('tokens', 0)}, hit_rate "
+                  f"{r.get('prefix_hit_rate', 0.0)}")
+        if "shared_tier" in report:
+            print(f"  shared_tier: {report['shared_tier']}")
+    return report
+
+
 def autotune(target, *example_inputs, batch=None, hbm_budget=None,
              print_report=True, **kw):
     """Static (microbatch, remat) autotuner — the front door of

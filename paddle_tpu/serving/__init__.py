@@ -60,6 +60,19 @@ decoding).  TPU-native design, split across this package:
   `PagedGPTDecoder.attach_adapters` — with per-adapter chain-key salts
   so pages never alias across variants).  docs/serving.md
   "Multi-tenant serving".
+- `fleet.py` — fleet-scale serving on one host: `SharedHostKVTier`
+  re-homes the host tier onto a file/shm-backed store every replica
+  on the host shares (same chain keys, same `PrefixCache.save` byte
+  format, flock + atomic-replace discipline; restores price a
+  host-RAM read leg via `cost_model.kv_restore_s(shared=True)`), and
+  `FleetRouter` fronts N `TenantEngine` replicas with prefix-affinity
+  routing (the cache's chain keys ARE the routing key) + SLO-aware
+  least-loaded escape, global rid allocation (N-replica streams are
+  byte-identical to the 1-replica twin), `run(on_sync=)` admission
+  churn, kill/respawn warm-start, and fleet-wide observability
+  (`ServeStats.merge`, pooled `tenancy_summary`, one Perfetto
+  timeline with per-(replica, tenant) pids).  docs/serving.md
+  "Fleet serving".
 - `stats.py` — per-engine `ServeStats` (host syncs/token, prefix-cache
   hit/evict/bytes-saved counters, tiered-KV spill/restore/recompute
   counters, tenancy preemption/resume counters, TTFT/queue-wait/
@@ -81,6 +94,7 @@ from .decoder import (MultiDecodeOut, PagedGPTDecoder, RaggedMultiOut,
                       _quantize_w, _sample_tokens,
                       _spec_accept)
 from .engine import ContinuousBatchingEngine, SpeculativeEngine
+from .fleet import FleetRouter, SharedHostKVTier
 from .kv_tier import HostKVTier, restore_beats_recompute
 from .prefix_cache import PrefixCache
 from .scheduler import RaggedScheduler
@@ -93,6 +107,7 @@ from .trace import (FlightRecorder, export_chrome_trace,
 __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "SpeculativeEngine", "ServeStats", "serving_stats",
            "PrefixCache", "HostKVTier", "restore_beats_recompute",
+           "SharedHostKVTier", "FleetRouter",
            "MultiDecodeOut", "RaggedMultiOut",
            "RaggedScheduler", "FlightRecorder", "export_chrome_trace",
            "validate_chrome_trace",

@@ -404,11 +404,20 @@ class ContinuousBatchingEngine:
             span = keys[n_dev:n_dev + n_tier]
             restore = restore_beats_recompute(
                 sum(tier.entry_bytes(k) for k in span),
-                n_tier * self.d.page_size, self._flops_per_token())
+                n_tier * self.d.page_size, self._flops_per_token(),
+                # the cross-process tier (fleet.SharedHostKVTier) pays
+                # a host-RAM read leg before the wire — price it
+                shared=getattr(tier, "shared", False))
         hold = None
         if restore:
-            hold = [(k, tier.get(k), tier.entry_bytes(k))
-                    for k in keys[n_dev:n_dev + n_tier]]
+            try:
+                hold = [(k, tier.get(k), tier.entry_bytes(k))
+                        for k in keys[n_dev:n_dev + n_tier]]
+            except KeyError:
+                # shared-tier churn: a sibling replica evicted part of
+                # the span between the membership walk and the hold —
+                # fall back to recompute (bytes stay correct either way)
+                return n_tier, False, None
         return n_tier, restore, hold
 
     def _tier_recompute(self, keys, lo, n):
@@ -461,7 +470,8 @@ class ContinuousBatchingEngine:
             out.append((pid, ok))
         dt = time.perf_counter() - t0
         from ..cost_model import kv_restore_s
-        pred = kv_restore_s(tot_bytes)
+        pred = kv_restore_s(tot_bytes,
+                            shared=getattr(tier, "shared", False))
         self.stats.tier_restores += len(pages)
         self.stats.host_tier_bytes = tier.bytes_used
         self._note_restore(pred)

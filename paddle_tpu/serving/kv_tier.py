@@ -65,16 +65,22 @@ def payload_bytes(payload):
 
 
 def restore_beats_recompute(restore_bytes, span_tokens, flops_per_token,
-                            chip=None):
+                            chip=None, shared=False):
     """THE tier decision: is re-mounting `restore_bytes` over the host
     wire cheaper than recomputing `span_tokens` of prefill?  Pure
     pricing (`cost_model.kv_restore_s` vs the compute leg of
     `prefill_ttft_s` with no sync floor — admission pays no extra sync
     either way), so the call sites (engine admission, tests) can never
-    disagree on the formula."""
+    disagree on the formula. `shared=True` prices the cross-process
+    tier (`serving.fleet.SharedHostKVTier`): the payload is read out
+    of an shm-/file-backed store first (`ChipSpec.host_read_bw`),
+    THEN crosses PCIe — the engine passes the tier's own `shared`
+    attribute so the fleet's restore decision never flatters the
+    wire."""
     from ..cost_model import kv_restore_s, prefill_ttft_s
-    return kv_restore_s(restore_bytes, chip=chip) < prefill_ttft_s(
-        span_tokens, flops_per_token, chip=chip, host_sync_s=0.0)
+    return kv_restore_s(restore_bytes, chip=chip, shared=shared) < \
+        prefill_ttft_s(span_tokens, flops_per_token, chip=chip,
+                       host_sync_s=0.0)
 
 
 class _TierEntry:
@@ -103,6 +109,12 @@ class HostKVTier:
     the host cost is the QUANTIZED cost. `capacity_bytes=0` refuses
     every put — the exact tier-off twin the equivalence tests compare
     against (mirroring `PrefixCache(capacity=0)`)."""
+
+    # per-process tier: restores pay PCIe only. The cross-process twin
+    # (serving.fleet.SharedHostKVTier) flips this — the engine reads
+    # it (getattr-defaulted) to price the shared host-read leg into
+    # restore_beats_recompute
+    shared = False
 
     def __init__(self, capacity_bytes=DEFAULT_CAPACITY_BYTES):
         self.capacity_bytes = int(capacity_bytes)

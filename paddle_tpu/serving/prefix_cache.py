@@ -45,7 +45,31 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "pack_array", "unpack_array"]
+
+
+def pack_array(arr):
+    """(raw uint8 view, {"shape","dtype"} meta) of one pool/payload
+    leaf — THE persisted byte format: npz can't serialize ml_dtypes
+    (bf16) leaves directly, so every array is stored as its raw bytes
+    with shape+dtype carried out-of-band in JSON. `save()` below and
+    the cross-process shared tier (`serving.fleet.SharedHostKVTier`)
+    both write exactly this encoding, so a spilled page is one wire
+    format everywhere it lands (disk snapshot or shm/file store)."""
+    arr = np.asarray(arr)
+    return (np.frombuffer(arr.tobytes(), np.uint8),
+            {"shape": list(arr.shape), "dtype": str(arr.dtype)})
+
+
+def unpack_array(raw, meta):
+    """Inverse of `pack_array`. The `.copy()` matters: frombuffer
+    views are read-only and may be ZERO-copied into device buffers by
+    the CPU backend — which the decode programs then DONATE (XLA
+    recycling memory it doesn't own). A writable owned copy keeps the
+    decoded leaf safely donatable/mountable."""
+    return np.frombuffer(
+        np.asarray(raw).tobytes(), np.dtype(meta["dtype"])
+    ).reshape(meta["shape"]).copy()
 
 
 @dataclass
@@ -303,12 +327,10 @@ class PrefixCache:
         arrays, meta = {}, {}
 
         def add(name, arr):
-            arr = np.asarray(arr)
-            # raw-byte view: npz can't serialize ml_dtypes (bf16)
-            # leaves directly; shape+dtype live in the JSON index
-            arrays[name] = np.frombuffer(arr.tobytes(), np.uint8)
-            meta[name] = {"shape": list(arr.shape),
-                          "dtype": str(arr.dtype)}
+            # raw-byte view + JSON-carried shape/dtype (pack_array —
+            # the one persisted byte format, shared with the fleet's
+            # cross-process tier)
+            arrays[name], meta[name] = pack_array(arr)
 
         for pool in ("k_pages", "v_pages"):
             leaves = state[pool] if isinstance(state[pool], tuple) \
@@ -390,15 +412,9 @@ class PrefixCache:
         meta = index["arrays"]
 
         def get(name):
-            m = meta[name]
-            # .copy(): frombuffer views are read-only and may be
-            # ZERO-copied into device buffers by the CPU backend —
-            # which the decode programs then DONATE (XLA recycling
-            # memory it doesn't own). A writable owned copy keeps the
-            # loaded pool safely donatable.
-            return np.frombuffer(
-                data[name].tobytes(), np.dtype(m["dtype"])
-            ).reshape(m["shape"]).copy()
+            # unpack_array owns the .copy() that keeps the loaded
+            # pool donatable (frombuffer views are read-only)
+            return unpack_array(data[name], meta[name])
 
         def pool(name):
             leaves = tuple(get(f"{name}.{i}")

@@ -56,6 +56,12 @@ class ServeStats:
     pages reclaimed from the cache under pool pressure."""
     engine: str = ""
     engine_id: int = -1          # creation order (set in __post_init__)
+    # fleet position (serving.fleet.FleetRouter stamps it; -1 = not a
+    # fleet member). `engine_id` alone orders engines within ONE
+    # process — across processes the per-process counters collide, so
+    # the merge/ordering contract is (engine, replica, engine_id):
+    # the replica id is the cross-process leg of the identity
+    replica: int = -1
     k_max: int = 1
     requests: int = 0            # submitted
     completed: int = 0           # retired with output
@@ -128,6 +134,60 @@ class ServeStats:
         if self.engine_id < 0:
             self.engine_id = next(_STATS_SEQ)
 
+    # ordering contract of every multi-engine view (live_engines,
+    # merge, the fleet's summaries): name, then fleet replica, then
+    # per-process creation id. engine_id alone is only unique within
+    # one process — the replica id disambiguates across them
+    def order_key(self):
+        return (self.engine, self.replica, self.engine_id)
+
+    @classmethod
+    def merge(cls, stats_list):
+        """One fleet-wide ServeStats from N engines' (possibly
+        N processes') ledgers: counters sum, the sliding windows pool
+        in `order_key` order into windows of the SAME bound (oldest
+        samples fall off exactly like a single long-lived engine's
+        would — the merged view stays O(window)), and percentile math
+        on a 1-engine merge reproduces the single engine's numbers
+        bit-for-bit (same samples, same deque).
+
+        Gauges need care: `host_tier_bytes` merges by MAX, not sum —
+        the fleet's replicas share ONE host tier
+        (serving.fleet.SharedHostKVTier), so every replica's gauge
+        reads the same store and summing would count one warm set N
+        times. `kv_pool_bytes`/`max_resident_slots` DO sum (each
+        replica owns its device pool and slots); `kv_bytes_per_token`
+        and `k_max` merge by max (homogeneous fleets agree on them
+        anyway)."""
+        stats = sorted(stats_list, key=lambda s: s.order_key())
+        if not stats:
+            return cls(engine="fleet[0]")
+        names = sorted({s.engine for s in stats})
+        out = cls(engine=(names[0] if len(names) == 1
+                          else "+".join(names)))
+        # a merge is a pure function of the stats SET: the fresh
+        # per-process engine_id the ctor drew would make two merges of
+        # the same set compare unequal — inherit the smallest input id
+        out.engine_id = min(s.engine_id for s in stats)
+        for f in ("requests", "completed", "tokens", "ticks",
+                  "decode_syncs", "prefill_syncs", "prefill_stall_syncs",
+                  "prefill_chunks", "prefill_chunk_tokens",
+                  "tokens_dispatched", "tokens_padded", "prefix_hits",
+                  "prefix_misses", "prefix_evictions", "prefix_cow",
+                  "prefix_tokens_saved", "prefix_bytes_saved",
+                  "tier_spills", "tier_restores", "tier_recomputes",
+                  "preemptions", "resumes", "kv_pool_bytes",
+                  "max_resident_slots"):
+            setattr(out, f, sum(getattr(s, f) for s in stats))
+        for f in ("k_max", "kv_bytes_per_token", "host_tier_bytes"):
+            setattr(out, f, max(getattr(s, f) for s in stats))
+        for f in ("queue_wait_s", "occupancy", "ttft_s",
+                  "token_time_s"):
+            win = getattr(out, f)
+            for s in stats:
+                win.extend(getattr(s, f))
+        return out
+
     @property
     def host_syncs_per_token(self):
         return self.decode_syncs / self.tokens if self.tokens else 0.0
@@ -146,6 +206,7 @@ class ServeStats:
 
     def summary(self):
         d = {"engine": self.engine, "engine_id": self.engine_id,
+             **({"replica": self.replica} if self.replica >= 0 else {}),
              "k_max": self.k_max,
              "requests": self.requests, "completed": self.completed,
              "tokens": self.tokens, "ticks": self.ticks,
@@ -212,12 +273,14 @@ class ServeStats:
 
 def live_engines():
     """Every live engine, deterministically ordered by (engine name,
-    creation id) — THE ordering contract for serving telemetry
-    front doors (`serving_stats`, `debug.serving_report`): the WeakSet
-    iterates in hash order, which would make logs and doctests flap
-    across runs."""
-    return sorted(_ENGINES,
-                  key=lambda e: (e.stats.engine, e.stats.engine_id))
+    fleet replica, creation id) — THE ordering contract for serving
+    telemetry front doors (`serving_stats`, `debug.serving_report`,
+    `ServeStats.merge`): the WeakSet iterates in hash order, which
+    would make logs and doctests flap across runs, and `engine_id`
+    alone is only unique within one process — the replica id
+    (`serving.fleet.FleetRouter` stamps it) is the cross-process leg
+    of the identity."""
+    return sorted(_ENGINES, key=lambda e: e.stats.order_key())
 
 
 def serving_stats():

@@ -60,10 +60,61 @@ from .scheduler import RaggedScheduler
 from .stats import _window
 
 __all__ = ["SLO_LATENCY", "SLO_THROUGHPUT", "TenantStats",
-           "TenantScheduler", "TenantEngine", "make_lora_bank"]
+           "TenantScheduler", "TenantEngine", "make_lora_bank",
+           "summarize_tenancy"]
 
 SLO_LATENCY = "latency"
 SLO_THROUGHPUT = "throughput"
+
+
+def summarize_tenancy(tenants, slo_targets_s=None, preemptions=0,
+                      resumes=0):
+    """THE tenancy-summary math, over any {(tenant, slo):
+    TenantStats} map: per-tenant ledgers (sorted keys), per-class
+    pooled p50/p99 tails next to the roofline-derived targets, and
+    Jain fairness over per-tenant token shares. One implementation
+    for `TenantEngine.tenancy_summary` (its own `_tenants`) and the
+    fleet's pooled view (`serving.fleet.FleetRouter.tenancy_summary`
+    merges per-replica TenantStats first, then calls this) — a
+    1-replica fleet therefore reproduces the single engine's numbers
+    bit-for-bit, by construction rather than by parallel code."""
+    rows = [tenants[k].summary() for k in sorted(tenants)]
+    classes = {}
+    for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+        ttft = [v for ts in tenants.values()
+                if ts.slo == slo for v in ts.ttft_s]
+        qw = [v for ts in tenants.values()
+              if ts.slo == slo for v in ts.queue_wait_s]
+        row = {}
+        if ttft:
+            row["ttft_p50_ms"] = round(
+                float(np.percentile(ttft, 50)) * 1e3, 3)
+            row["ttft_p99_ms"] = round(
+                float(np.percentile(ttft, 99)) * 1e3, 3)
+        if qw:
+            row["queue_wait_p99_ms"] = round(
+                float(np.percentile(qw, 99)) * 1e3, 3)
+        if slo_targets_s is not None:
+            row["roofline_target_ms"] = round(
+                slo_targets_s[slo] * 1e3, 4)
+        if row:
+            classes[slo] = row
+    # Jain's index over per-TENANT token shares (a tenant active in
+    # both SLO classes is ONE entity — its ledgers merge here):
+    # 1.0 = every tenant got an equal share, 1/n = one got it all
+    by_tenant = {}
+    for ts in tenants.values():
+        if ts.requests:
+            by_tenant[ts.tenant] = by_tenant.get(ts.tenant, 0) + ts.tokens
+    toks = list(by_tenant.values())
+    fairness = None
+    if toks and sum(toks):
+        fairness = round(
+            (sum(toks) ** 2) / (len(toks) * sum(t * t
+                                                for t in toks)), 4)
+    return {"tenants": rows, "classes": classes,
+            "fairness_jain": fairness,
+            "preemptions": preemptions, "resumes": resumes}
 
 
 def make_lora_bank(cfg, n_adapters, rank=4, seed=0, scale=0.05):
@@ -324,47 +375,14 @@ class TenantEngine(ContinuousBatchingEngine):
         """Per-tenant ledgers + per-class pooled tails next to the
         scheduler's roofline-derived targets + fairness: the
         multi-tenant observability front door (the bench's JSON line
-        and debug.serving_report read it)."""
-        tenants = [self._tenants[k].summary()
-                   for k in sorted(self._tenants)]
-        classes = {}
-        for slo in (SLO_LATENCY, SLO_THROUGHPUT):
-            ttft = [v for ts in self._tenants.values()
-                    if ts.slo == slo for v in ts.ttft_s]
-            qw = [v for ts in self._tenants.values()
-                  if ts.slo == slo for v in ts.queue_wait_s]
-            row = {}
-            if ttft:
-                row["ttft_p50_ms"] = round(
-                    float(np.percentile(ttft, 50)) * 1e3, 3)
-                row["ttft_p99_ms"] = round(
-                    float(np.percentile(ttft, 99)) * 1e3, 3)
-            if qw:
-                row["queue_wait_p99_ms"] = round(
-                    float(np.percentile(qw, 99)) * 1e3, 3)
-            if hasattr(self.scheduler, "slo_targets_s"):
-                row["roofline_target_ms"] = round(
-                    self.scheduler.slo_targets_s[slo] * 1e3, 4)
-            if row:
-                classes[slo] = row
-        # Jain's index over per-TENANT token shares (a tenant active
-        # in both SLO classes is ONE entity — its ledgers merge here):
-        # 1.0 = every tenant got an equal share, 1/n = one got it all
-        by_tenant = {}
-        for ts in self._tenants.values():
-            if ts.requests:
-                by_tenant[ts.tenant] = \
-                    by_tenant.get(ts.tenant, 0) + ts.tokens
-        toks = list(by_tenant.values())
-        fairness = None
-        if toks and sum(toks):
-            fairness = round(
-                (sum(toks) ** 2) / (len(toks) * sum(t * t
-                                                    for t in toks)), 4)
-        return {"tenants": tenants, "classes": classes,
-                "fairness_jain": fairness,
-                "preemptions": self.stats.preemptions,
-                "resumes": self.stats.resumes}
+        and debug.serving_report read it). The math lives in
+        `summarize_tenancy` — shared with the fleet's pooled view."""
+        return summarize_tenancy(
+            self._tenants,
+            slo_targets_s=getattr(self.scheduler, "slo_targets_s",
+                                  None),
+            preemptions=self.stats.preemptions,
+            resumes=self.stats.resumes)
 
     # ------------------------------------------------------- scheduling
 

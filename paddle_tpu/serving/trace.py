@@ -338,13 +338,31 @@ def export_chrome_trace(path, recorders=(), profiler=None):
     shared perf_counter time base, sorted so each (pid, tid) track is
     ts-monotonic (the schema `validate_chrome_trace` checks). Load in
     Perfetto / chrome://tracing, or back via
-    `profiler.load_profiler_result`."""
+    `profiler.load_profiler_result`.
+
+    `recorders` may be one FlightRecorder, a sequence of them, or a
+    LABELED collection — a {label: recorder} dict or (label,
+    recorder) pairs. Labels flow into every process_name/thread_name
+    the recorder emits, so an N-replica fleet
+    (`serving.fleet.FleetRouter.export_trace` passes
+    {"replica0": rec0, ...}) lands on ONE Perfetto timeline with
+    distinct pids per (replica, tenant): each recorder claims a
+    contiguous pid block (requests row, tick track, then one pid per
+    tenant), and the next replica's block starts past the largest pid
+    the previous one actually emitted."""
     events = []
     if isinstance(recorders, FlightRecorder):
         recorders = (recorders,)
+    if hasattr(recorders, "items"):
+        recorders = list(recorders.items())
     next_pid = 1
-    for rec in recorders:
-        evs = rec.chrome_events(pid=next_pid)
+    for item in recorders:
+        if isinstance(item, (tuple, list)) and len(item) == 2 and \
+                not isinstance(item, FlightRecorder):
+            label, rec = item
+            evs = rec.chrome_events(pid=next_pid, label=str(label))
+        else:
+            evs = item.chrome_events(pid=next_pid)
         events.extend(evs)
         # a recorder's pid footprint is variable now (tenant grouping
         # adds one pid per tenant past the tick row) — the next

@@ -2,67 +2,23 @@
 SIGKILLed mid-train, the supervisor restarts the group, and training
 resumes from the orbax checkpoint with an identical loss trajectory
 (reference python/paddle/distributed/fleet/elastic/manager.py — fault
-watch + restart; etcd lease replaced by the heartbeat file)."""
-import functools
+watch + restart; etcd lease replaced by the heartbeat file).
+
+Process-spawn plumbing (child env, load-flake retry) lives in
+tests/_mp_harness.py, shared with the launch smoke tests and the
+fleet-serving cross-process tests."""
 import json
 import os
 import signal
-import subprocess
-import sys
 import threading
 import time
 
 import numpy as np
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._mp_harness import mp_env, retry_under_load
 
-
-def _retry_under_load(test):
-    """Load-flake containment for the two kill/resume integration tests
-    (the PR-12 flake, still seen rarely after the 180 s init-timeout
-    widening): each spawns 2 python ranks that must import jax and meet
-    a coordinator barrier on wall-clock deadlines, which no timeout can
-    make robust on a box that is ALSO running the rest of the tier-1
-    sweep's GC cliff. Policy: one clean retry in a fresh subdir; if the
-    1-minute load average says the box is saturated (beyond ~1.5x its
-    cores), skip instead — a deadline test on a saturated box measures
-    the box, not the supervisor. A real supervisor bug still fails: it
-    reproduces on the quiet retry.
-
-    The bar is 1.5x cores with NO absolute floor: the old
-    `max(2.0, ...)` floor let a 1-core box retry at load 2.0 (200%
-    saturated) and fail the retry too. Load is sampled twice — at the
-    first failure AND again right before the retry — because the
-    1-minute average lags the GC cliff that caused the failure; a
-    retry launched into the same spike measures the spike."""
-    @functools.wraps(test)
-    def wrapper(tmp_path):
-        bar = 1.5 * (os.cpu_count() or 1)
-
-        def saturated():
-            return os.getloadavg()[0] > bar
-
-        try:
-            return test(tmp_path)
-        except Exception as e:
-            if saturated():
-                pytest.skip(f"box saturated (load "
-                            f"{os.getloadavg()[0]:.1f} on "
-                            f"{os.cpu_count()} cores) — elastic deadline "
-                            f"test skipped after: {e!r:.200}")
-            # give the lagging average a beat to see the spike that
-            # just failed us, then re-check before burning the retry
-            time.sleep(5.0)
-            if saturated():
-                pytest.skip(f"box saturated before retry (load "
-                            f"{os.getloadavg()[0]:.1f} on "
-                            f"{os.cpu_count()} cores) — elastic deadline "
-                            f"test skipped after: {e!r:.200}")
-            retry_dir = tmp_path / "retry"
-            retry_dir.mkdir(exist_ok=True)
-            return test(retry_dir)
-    return wrapper
+_retry_under_load = retry_under_load
 
 TRAIN_SCRIPT = """
 import json, os, sys, time
@@ -166,18 +122,7 @@ def test_multihost_kill_restarts_both_groups(tmp_path):
     log_path = tmp_path / "log.jsonl"
     coord = tmp_path / "coord"
 
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # late in a full tier-1 sweep this box is under GC/RSS load and the
-    # freshly spawned ranks can take >60 s just to import jax and reach
-    # the coordinator barrier — the supervisor's 60 s fail-fast default
-    # (sized for the RESTART loop, where the peer is known alive) then
-    # kills healthy first-boot groups until max_restarts runs out (the
-    # load-flake noted in PR 12). An explicit value beats the
-    # launcher's setdefault; the restart path inherits it too, where a
-    # wedged peer is still detected by the heartbeat watch.
-    env["PADDLE_TPU_DIST_INIT_TIMEOUT"] = "180"
+    env = mp_env()
 
     killed = {}
 
@@ -239,13 +184,7 @@ def test_kill_and_resume_two_process(tmp_path):
     total_steps = 7
     log_path = tmp_path / "log.jsonl"
 
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # widen the coordinator-barrier fail-fast under suite load (see the
-    # multihost twin above): 60 s is the restart-loop number, first
-    # boots late in a loaded sweep legitimately exceed it
-    env["PADDLE_TPU_DIST_INIT_TIMEOUT"] = "180"
+    env = mp_env()
 
     killed = {}
 
@@ -313,10 +252,6 @@ def test_multihost_heartbeat_detects_wedged_node(tmp_path):
             str(script), nnodes=2, coord_dir=str(tmp_path / "coord"),
             nproc_per_node=1, max_restarts=0,
             heartbeat_path=str(tmp_path / "beat.json"),
-            heartbeat_timeout_s=5, env={
-                **{k: v for k, v in os.environ.items()
-                   if k not in ("XLA_FLAGS", "JAX_PLATFORMS")},
-                "PYTHONPATH": REPO + os.pathsep +
-                os.environ.get("PYTHONPATH", "")})
+            heartbeat_timeout_s=5, env=mp_env())
     assert time.time() - t0 < 120
     assert (tmp_path / "coord" / "reason.e1").exists()

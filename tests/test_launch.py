@@ -11,7 +11,7 @@ import sys
 import numpy as np
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._mp_harness import REPO, mp_env
 
 TRAIN_SCRIPT = """
 import os, sys
@@ -57,9 +57,7 @@ def test_launch_two_process_grads_match(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(TRAIN_SCRIPT)
     out = tmp_path / "grads.npz"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = mp_env()
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nnodes", "1", "--nproc_per_node", "2",
