@@ -1344,19 +1344,22 @@ def run_ragged_pad(gen=40, long_prompt=224, chunk=16, k_max=2,
 
 def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
     """Concurrent-slot capacity at a fixed per-token p99: bf16 vs int8
-    KV pool.  Decode is HBM-bound, so at a per-token latency SLO the
-    admissible slot count is set by how many KV byte-streams fit under
-    the tick budget: slots = (p99·BW − weight_bytes) / ctx·kv_bytes_tok.
+    vs int4 KV pool.  Decode is HBM-bound, so at a per-token latency
+    SLO the admissible slot count is set by how many KV byte-streams
+    fit under the tick budget: slots = (p99·BW − weight_bytes) /
+    ctx·kv_bytes_tok.
     The SLO is anchored at the BF16 pool's tick with `p99_batch` slots
     at avg_ctx = max_seq/2 (the KV-bound operating point — each slot's
     prefix, not the weights, dominates the stream), so the bf16 column
-    reads back ~p99_batch and the int8 column shows the capacity the
-    halved KV stream buys under the SAME SLO.  Priced on the v5e chip
-    spec (`PagedGPTDecoder.step_hbm_bytes(batch=...)` — deterministic,
-    CPU-runnable); the measured half runs both pools through a real
-    tiny-GPT engine for tokens/s (CPU numbers carry dispatch overhead,
-    the committed evidence is the SLOTS ratio like the other serving
-    scenarios' ratios)."""
+    reads back ~p99_batch, the int8 column shows the capacity the
+    halved KV stream buys, and the int4 column what the nibble-packed
+    pool (0.5 B/elem + per-group scales) banks on top under the SAME
+    SLO.  Priced on the v5e chip spec
+    (`PagedGPTDecoder.step_hbm_bytes(batch=...)` — deterministic,
+    CPU-runnable); the measured half runs all three pools through a
+    real tiny-GPT engine for tokens/s (CPU numbers carry dispatch
+    overhead, the committed evidence is the SLOTS ratio like the other
+    serving scenarios' ratios)."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -1385,6 +1388,8 @@ def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
     kv16 = cfg_big.num_layers * avg_ctx * pool_token_bytes(cfg_big)
     kv8 = cfg_big.num_layers * avg_ctx * pool_token_bytes(
         cfg_big, kv_quant="int8")
+    kv4 = cfg_big.num_layers * avg_ctx * pool_token_bytes(
+        cfg_big, kv_quant="int4")
     # the fixed SLO: the bf16 pool's tick with p99_batch slots. Slots
     # are recovered in INTEGER byte arithmetic (a float divide/multiply
     # round-trip through p99_s can floor the bf16 column to
@@ -1393,20 +1398,24 @@ def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
     budget_bytes = w_bytes + p99_batch * kv16
     p99_s = budget_bytes / chip.hbm_bw
     slots = {"bf16": (budget_bytes - w_bytes) // kv16,
-             "int8": (budget_bytes - w_bytes) // kv8}
+             "int8": (budget_bytes - w_bytes) // kv8,
+             "int4": (budget_bytes - w_bytes) // kv4}
     assert slots["bf16"] == p99_batch
     ratio = slots["int8"] / max(slots["bf16"], 1)
+    ratio4 = slots["int4"] / max(slots["bf16"], 1)
     dec16 = PagedGPTDecoder(model, num_pages=32, page_size=16,
                             max_batch=2)
     dec8 = PagedGPTDecoder(model, num_pages=32, page_size=16,
                            max_batch=2, kv_quant="int8")
+    dec4 = PagedGPTDecoder(model, num_pages=32, page_size=16,
+                           max_batch=2, kv_quant="int4")
 
-    # measured half: both pools through a real engine (tiny GPT, CPU)
+    # measured half: all pools through a real engine (tiny GPT, CPU)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, 12).astype(np.int32)
                for _ in range(4)]
     tok_s = {}
-    for name, dec in (("bf16", dec16), ("int8", dec8)):
+    for name, dec in (("bf16", dec16), ("int8", dec8), ("int4", dec4)):
         def run_once():
             eng = ContinuousBatchingEngine(dec, max_new_tokens=gen,
                                            k_max=8)
@@ -1419,27 +1428,34 @@ def run_decode_capacity(model_scale="gpt_1p3b", gen=24, p99_batch=8):
         run_once()                       # warm the compiles
         tok_s[name], _ = run_once()
     row = {"slots_bf16": slots["bf16"], "slots_int8": slots["int8"],
+           "slots_int4": slots["int4"],
            "slots_ratio": round(ratio, 2),
+           "slots_ratio_int4": round(ratio4, 2),
            "p99_budget_ms": round(p99_s * 1e3, 3),
            "avg_ctx": avg_ctx, "model": model_scale,
            # KV bytes one context token costs across ALL layers (the
            # ServeStats.kv_bytes_per_token view at cfg_big shapes)
            "kv_bytes_per_token_bf16": kv16 // avg_ctx,
            "kv_bytes_per_token_int8": kv8 // avg_ctx,
+           "kv_bytes_per_token_int4": kv4 // avg_ctx,
            # measured on the tiny-GPT engines only — keep tiny-scale
            # stats (pool bytes, resident slots) OUT of this row: every
            # other field describes cfg_big shapes, and mixing scales
            # invites misreading (debug.serving_stats() has them live)
            "measured_tok_s_bf16": round(tok_s["bf16"], 1),
-           "measured_tok_s_int8": round(tok_s["int8"], 1)}
+           "measured_tok_s_int8": round(tok_s["int8"], 1),
+           "measured_tok_s_int4": round(tok_s["int4"], 1)}
     log(f"decode_capacity[{model_scale}]: {slots['bf16']} -> "
-        f"{slots['int8']} slots ({ratio:.2f}x) at p99 "
+        f"{slots['int8']} -> {slots['int4']} slots ({ratio:.2f}x / "
+        f"{ratio4:.2f}x) at p99 "
         f"{p99_s*1e3:.2f} ms, avg_ctx={avg_ctx} (KV "
         f"{row['kv_bytes_per_token_bf16']} -> "
-        f"{row['kv_bytes_per_token_int8']} B/token; measured tiny-GPT "
-        f"{tok_s['bf16']:.0f} vs {tok_s['int8']:.0f} tok/s on this host)")
+        f"{row['kv_bytes_per_token_int8']} -> "
+        f"{row['kv_bytes_per_token_int4']} B/token; measured tiny-GPT "
+        f"{tok_s['bf16']:.0f} vs {tok_s['int8']:.0f} vs "
+        f"{tok_s['int4']:.0f} tok/s on this host)")
     print(json.dumps({"metric": "gpt_decode_capacity",
-                      "value": slots["int8"], "unit": "slots",
+                      "value": slots["int4"], "unit": "slots",
                       **row}), flush=True)
     return row
 

@@ -352,6 +352,60 @@ def _gpt_decode_kv8():
     return program, ctx, PagedGPTDecoder._decode_multi_step
 
 
+def _gpt_decode_kv4():
+    """The INT4-KV serving config: the fused K=4 decode loop over a
+    nibble-packed int4 pool with per-GROUP f32 scale planes
+    (`kv_quant="int4"` — uint8 pages [L,P,ps,PB] + scales [L,P,ps,G];
+    the pool's byte stream behind the decode roofline drops ~4x vs
+    bf16). Same gate set as gpt_decode_kv8, re-proven on the packed
+    layout: SERVE-HOST-SYNC-DECODE (zero host transfers, four donated
+    cache leaves), DTYPE-KV-SCALE-WIDTH (group-scale planes exactly
+    f32), DTYPE-KV-DEQUANT-HBM (the nibble unpack's int8->f32 convert
+    stays per-page inside the shared attention update — a full-pool
+    dequant materialized in HBM is the defect), and MEM-PAGE-REFCOUNT
+    over a page ledger committed from a real shared-prefix int4
+    workload including a full-hit copy-on-write (CoW moves nibble
+    bytes AND group-scale rows together)."""
+    import numpy as np
+    paddle = _fresh()
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedGPTDecoder, PrefixCache)
+    cfg = gpt_tiny(max_seq_len=64, dtype="float32", remat=False)
+    model = GPT(cfg)
+    model.eval()
+    dec = PagedGPTDecoder(model, num_pages=16, page_size=16, max_batch=2,
+                          kv_quant="int4")
+    eng = ContinuousBatchingEngine(
+        dec, max_new_tokens=4, k_max=2,
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+    base = list(range(1, 17))            # one full shareable block
+    for tail in ([21, 22, 23], []):      # miss+insert, then a FULL hit
+        eng.submit(np.asarray(base + tail, np.int32))
+        eng.run()
+    program = dec.analysis_program(k=4)
+    ctx = AnalysisContext(
+        name="gpt_decode_kv4",
+        # the shared ragged-attention reorders, plus the int4 pool's
+        # page gathers: packed nibbles and group scales are rank-4
+        # [n,MP,ps,X] -> [MP,n,ps,X] layout moves (X = PB or G)
+        allowed_activation_transposes=gpt_mod.ATTENTION_TRANSPOSES
+        + RAGGED_ATTENTION_TRANSPOSES + (r"dims = \[1, 0, 2, 3\]",),
+        expect_collectives=False,
+        extra={"serving_decode": True,
+               "kv_quant": "int4",
+               # one per-layer [P, ps, H, D] pool's worth of ELEMENTS:
+               # the packed payload holds 2*PB >= H*D nibbles per
+               # token, so a convert of this many unpacked elements to
+               # a wide float IS the dequantized pool landing in HBM
+               # (legit per-page converts stay n*ps*2*PB — far under)
+               "kv_pool_block_elems": (dec.num_pages * dec.page_size *
+                                       cfg.num_heads * cfg.head_dim),
+               "page_ledger": eng.page_ledger()})
+    return program, ctx, PagedGPTDecoder._decode_multi_step
+
+
 def _gpt_decode_mt():
     """The MULTI-TENANT serving config (serving.tenancy): the PACKED
     mixed horizon program WITH the multi-LoRA adapter gather —
@@ -641,6 +695,7 @@ PROGRAM_CONFIGS = {
     "gpt_decode_prefix": _gpt_decode_prefix,   # chunked prefix-cache prefill
     "gpt_decode_ragged": _gpt_decode_ragged,   # mixed chunked-prefill+decode
     "gpt_decode_kv8": _gpt_decode_kv8,         # int8 KV pool decode loop
+    "gpt_decode_kv4": _gpt_decode_kv4,         # int4 nibble-packed KV pool
     "gpt_decode_mt": _gpt_decode_mt,           # multi-tenant + multi-LoRA
     "gpt_decode_fleet": _gpt_decode_fleet,     # fleet + shared host KV tier
     "gpt_train_multi": _gpt_train_multi,   # fused multi-step train scan
@@ -675,7 +730,8 @@ SCHEDULE_CONFIGS = tuple(BASELINE_CONFIGS) + ("gpt_train_multi",
 # pins it red until commit-on-accept lands).
 DETERMINISM_CONFIGS = ("gpt_decode", "gpt_decode_prefix",
                        "gpt_decode_ragged", "gpt_decode_kv8",
-                       "gpt_decode_mt", "gpt_decode_fleet")
+                       "gpt_decode_kv4", "gpt_decode_mt",
+                       "gpt_decode_fleet")
 
 
 def build_config(name):

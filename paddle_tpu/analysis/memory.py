@@ -1035,17 +1035,21 @@ def audit_page_ledger(ledger):
 
 
 def audit_kv_scale_planes(decoder, pages):
-    """MEM-PAGE-REFCOUNT scale-plane consistency audit of an int8 KV
-    pool: for every page in `pages` (slot-held or cache-tracked), any
-    position holding nonzero quantized bytes must carry a nonzero
+    """MEM-PAGE-REFCOUNT scale-plane consistency audit of a quantized
+    KV pool: for every page in `pages` (slot-held or cache-tracked),
+    any position holding nonzero quantized bytes must carry a nonzero
     write-time scale.  The write path stores bytes and scale together
     (`serving.decoder._kv_set`) and the floor scale is positive even
     for an all-zero vector, so a written position ALWAYS has scale > 0
     — a zero scale under live bytes means some copy path (typically a
     copy-on-write that moved page bytes but not the scale plane) split
-    the two, and the page dequantizes to garbage.  Reads the pool from
-    device; audit-time only, never on the serving hot path.  Returns
-    Finding list (empty = consistent)."""
+    the two, and the page dequantizes to garbage.  int8 pools carry
+    one scale per (layer, pos); int4 pools (uint8 nibble payload) one
+    per (layer, pos, group) — there the check demands EVERY group
+    scale positive at a written position, since the write quantizes
+    all groups together.  Reads the pool from device; audit-time only,
+    never on the serving hot path.  Returns Finding list (empty =
+    consistent)."""
     import numpy as np
     findings = []
     k_pool, v_pool = decoder.k_pages, decoder.v_pages
@@ -1055,10 +1059,15 @@ def audit_kv_scale_planes(decoder, pages):
         pg = np.asarray(page_arr)
         sc = np.asarray(scale_arr)
         for p in pages:
-            # [L, ps]: does any head/dim byte live at (layer, position)?
-            wrote = np.abs(pg[:, p].astype(np.int32)).max(
-                axis=(-2, -1)) > 0
-            orphan = wrote & (sc[:, p] <= 0.0)
+            if pg.dtype == np.uint8:
+                # int4: payload [L, ps, PB], scales [L, ps, G]
+                wrote = np.abs(pg[:, p].astype(np.int32)).max(axis=-1) > 0
+                orphan = wrote & (sc[:, p].min(axis=-1) <= 0.0)
+            else:
+                # [L, ps]: any head/dim byte live at (layer, position)?
+                wrote = np.abs(pg[:, p].astype(np.int32)).max(
+                    axis=(-2, -1)) > 0
+                orphan = wrote & (sc[:, p] <= 0.0)
             if orphan.any():
                 ls, ps_ = np.nonzero(orphan)
                 findings.append(Finding(

@@ -17,9 +17,14 @@ Shapes:
   k_pages/v_pages : (P, page_size, H, D)  one layer's page pool, OR an
                     int8 pool as the tuple (pages int8, scales f32
                     (P, page_size)) — per-token write-time scales (the
-                    serving decoder's kv_quant="int8" layout); dequant
-                    happens inside the shared per-page update, so the
-                    dequantized pool never materializes in HBM
+                    serving decoder's kv_quant="int8" layout), OR an
+                    int4 pool as the tuple (nibble-packed uint8
+                    (P, page_size, PB), per-GROUP scales f32
+                    (P, page_size, G)) — the kv_quant="int4" layout
+                    (`serving.decoder._quantize_kv_int4`). Dequant
+                    happens per page next to the shared per-page
+                    update, so the dequantized pool never materializes
+                    in HBM
   page_table      : (n, max_pages) int32 page ids per row
   start           : (n,)           already-cached length per row
 
@@ -87,6 +92,44 @@ def _page_update(m, s, acc, logits, v, kpos, qpos, k_scale=None,
     return m_new, s_new, acc_new
 
 
+def _dequant_page_int4(packed, gscale, heads):
+    """ONE page's int4 dequant — shared by the jnp reference and the
+    Pallas kernel exactly like `_page_update` (both call this same
+    function immediately before it, so the two paths cannot drift and
+    bit-identity extends to the nibble-packed pool).
+
+    packed [..., ps, PB] uint8 nibble pairs (low nibble = element 2i —
+    `serving.decoder._pack_int4`'s layout), gscale [..., ps, G] f32
+    per-group write-time scales, heads = (H, D). Returns f32
+    [..., ps, H, D].
+
+    Unlike the int8 pool's per-TOKEN scale — a scalar that commutes out
+    of the q·k contraction, so `_page_update` can apply it to the
+    finished logits — an int4 group scale varies ALONG the contraction
+    (groups tile the flattened H*D axis), so K must dequantize before
+    the logits dot and V before the accumulator dot. Everything here is
+    elementwise and exact in f32 (integer unpack, one cast, one
+    multiply), so ref == kernel bit-identity needs only this function
+    to be shared."""
+    H, D = int(heads[0]), int(heads[1])
+    PB = packed.shape[-1]
+    G = gscale.shape[-1]
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    q = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * PB,)).astype(jnp.float32)
+    # stored group width: 2*PB == G*group except when the pack-parity
+    # nibble padded an odd G*group — only possible at G == 1, where the
+    # wider pseudo-group is harmless (the pad nibble is 0 and the H*D
+    # slice below drops it)
+    group = (2 * PB) // G
+    g = q.reshape(packed.shape[:-1] + (G, group)) * gscale[..., None]
+    flat = g.reshape(packed.shape[:-1] + (G * group,))[..., :H * D]
+    return flat.reshape(packed.shape[:-1] + (H, D))
+
+
 # page counts up to this unroll the reference's page loop into straight
 # line code (XLA fuses across pages; a lax.scan pays while-loop overhead
 # per page — measurable on CPU where the decode tick is host-bound).
@@ -96,30 +139,56 @@ _UNROLL_PAGES = 32
 
 
 def _ragged_ref(q, k_pages, v_pages, page_table, start, scale,
-                k_scale=None, v_scale=None):
+                k_scale=None, v_scale=None, int4=False):
     """jnp reference: the kernel's page loop as an unrolled loop (small
     tables) or a lax.scan — the same per-page update in the same order
     either way (see _page_update). With an int8 pool, `k_scale`/
     `v_scale` [P, ps] carry the per-token write-time scales; the gather
-    stays int8 and only one page dequantizes per step."""
+    stays int8 and only one page dequantizes per step. With an int4
+    pool (`int4=True`) the payload is nibble-packed [P, ps, PB] and
+    `k_scale`/`v_scale` [P, ps, G] carry per-GROUP scales; each page
+    dequantizes through the shared `_dequant_page_int4` before its
+    update — the gather stays packed, one page unpacks per step."""
     n, W, H, D = q.shape
     ps = k_pages.shape[1]
     MP = page_table.shape[1]
     safe = jnp.maximum(page_table, 0)
-    # [n, MP, ps, H, D] -> per-page [MP][n, H, ps, D]
-    kg = jnp.moveaxis(k_pages[safe], (1, 3), (0, 2))
-    vg = jnp.moveaxis(v_pages[safe], (1, 3), (0, 2))
-    quantized = k_scale is not None
-    if quantized:
-        # [n, MP, ps] -> per-page [MP][n, ps]
+    quantized = k_scale is not None and not int4
+    if int4:
+        # packed payload [n, MP, ps, PB] -> per-page [MP][n, ps, PB];
+        # group scales [n, MP, ps, G] -> per-page [MP][n, ps, G]
+        kg = jnp.moveaxis(k_pages[safe], 1, 0)
+        vg = jnp.moveaxis(v_pages[safe], 1, 0)
         ksg = jnp.moveaxis(k_scale[safe], 1, 0)
         vsg = jnp.moveaxis(v_scale[safe], 1, 0)
+    else:
+        # [n, MP, ps, H, D] -> per-page [MP][n, H, ps, D]
+        kg = jnp.moveaxis(k_pages[safe], (1, 3), (0, 2))
+        vg = jnp.moveaxis(v_pages[safe], (1, 3), (0, 2))
+        if quantized:
+            # [n, MP, ps] -> per-page [MP][n, ps]
+            ksg = jnp.moveaxis(k_scale[safe], 1, 0)
+            vsg = jnp.moveaxis(v_scale[safe], 1, 0)
     qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [n,H,W,D]
     qpos = (start[:, None] + jnp.arange(W))[:, None, :]         # [n,1,W]
 
     def page_step(carry, inputs):
         m, s, acc = carry
-        if quantized:
+        if int4:
+            j, kj, vj, ksj, vsj = inputs       # [n, ps, PB], [n, ps, G]
+            # barrier: the dequantized page must MATERIALIZE before the
+            # dot. Without it XLA fuses the group-scale multiply into
+            # the contraction and the fused gemm's rounding shifts with
+            # the window shape (observed: last-ulp drift at G > 1) —
+            # breaking both ref==kernel bit-identity and the
+            # W-independence the schedule-equivalence tests pin. The
+            # interpret-mode kernel runs op-by-op (dequant, then dot),
+            # so the barrier makes the compiled ref match it exactly.
+            kj = jax.lax.optimization_barrier(
+                _dequant_page_int4(kj, ksj, (H, D))).transpose(0, 2, 1, 3)
+            vj = jax.lax.optimization_barrier(
+                _dequant_page_int4(vj, vsj, (H, D))).transpose(0, 2, 1, 3)
+        elif quantized:
             j, kj, vj, ksj, vsj = inputs
         else:
             j, kj, vj = inputs                 # [n, H, ps, D]
@@ -137,7 +206,7 @@ def _ragged_ref(q, k_pages, v_pages, page_table, start, scale,
     carry = (jnp.full((n, H, W, 1), _MASK, jnp.float32),
              jnp.zeros((n, H, W, 1), jnp.float32),
              jnp.zeros((n, H, W, D), jnp.float32))
-    pages = (kg, vg) + ((ksg, vsg) if quantized else ())
+    pages = (kg, vg) + ((ksg, vsg) if (quantized or int4) else ())
     if MP <= _UNROLL_PAGES:
         for j in range(MP):
             carry, _ = page_step(carry, (j,) + tuple(x[j] for x in pages))
@@ -154,20 +223,28 @@ def _ragged_ref(q, k_pages, v_pages, page_table, start, scale,
 # lowering, and the bit-identity contract with the interpret-mode
 # kernel (which runs compiled) is pinned at the compiled semantics.
 # Inside a jitted caller (the decoder's programs) this inlines away.
-_ragged_ref_jit = jax.jit(_ragged_ref, static_argnames=("scale",))
+_ragged_ref_jit = jax.jit(_ragged_ref, static_argnames=("scale", "int4"))
 
 
 def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, *rest,
-                   scale, page_size, max_pages, quantized):
+                   scale, page_size, max_pages, quant, heads=None):
     """Grid (n, H, max_pages): one page of K/V in VMEM per step, online
     softmax in scratch — the scalar-prefetched page_table drives the
-    K/V BlockSpec index maps, so the pool never leaves HBM whole. With
-    an int8 pool two more page-indexed refs carry the [ps] per-token
-    scales; dequant runs inside `_page_update`, on the one VMEM-resident
-    page — the f32 pool never exists."""
+    K/V BlockSpec index maps, so the pool never leaves HBM whole.
+    `quant` is the pool's mode (None | "int8" | "int4"). int8: two more
+    page-indexed refs carry the [ps] per-token scales; dequant runs
+    inside `_page_update`, on the one VMEM-resident page — the f32
+    pool never exists. int4: the page block is the WHOLE packed page
+    (nibbles mix heads — the [ps, PB] payload plus [ps, G] group-scale
+    refs stream via their own page-indexed BlockSpecs), the nibble
+    unpack + group dequant run in VMEM through the shared
+    `_dequant_page_int4`, and the body slices its own head (grid axis
+    1; `heads` = (H, D) — every grid step along H re-reads the same
+    packed page, an interpret-mode correctness cost a production TPU
+    kernel would fold into a head-blocked grid)."""
     from jax.experimental import pallas as pl
 
-    if quantized:
+    if quant:
         ks_ref, vs_ref, o_ref, m_scr, s_scr, acc_scr = rest
     else:
         o_ref, m_scr, s_scr, acc_scr = rest
@@ -181,8 +258,15 @@ def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, *rest,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     q = q_ref[0, :, 0, :].astype(jnp.float32) * scale        # [W, D]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)                # [ps, D]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    if quant == "int4":
+        hi = pl.program_id(1)
+        kd = _dequant_page_int4(k_ref[0], ks_ref[0], heads)  # [ps, H, D]
+        vd = _dequant_page_int4(v_ref[0], vs_ref[0], heads)
+        k = jax.lax.dynamic_index_in_dim(kd, hi, 1, keepdims=False)
+        v = jax.lax.dynamic_index_in_dim(vd, hi, 1, keepdims=False)
+    else:
+        k = k_ref[0, :, 0, :].astype(jnp.float32)            # [ps, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
     logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     kpos = j * page_size + jax.lax.broadcasted_iota(
@@ -192,8 +276,8 @@ def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, *rest,
         jnp.int32, (W, 1), 0)[:, 0]                          # [W]
     m_new, s_new, acc_new = _page_update(
         m_scr[...], s_scr[...], acc_scr[...], logits, v, kpos, qpos,
-        k_scale=ks_ref[0, :] if quantized else None,
-        v_scale=vs_ref[0, :] if quantized else None)
+        k_scale=ks_ref[0, :] if quant == "int8" else None,
+        v_scale=vs_ref[0, :] if quant == "int8" else None)
     m_scr[...] = m_new
     s_scr[...] = s_new
     acc_scr[...] = acc_new
@@ -205,14 +289,15 @@ def _ragged_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
-                        interpret, k_scale=None, v_scale=None):
+                        interpret, k_scale=None, v_scale=None,
+                        int4=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, W, H, D = q.shape
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
-    quantized = k_scale is not None
+    quant = "int4" if int4 else ("int8" if k_scale is not None else None)
 
     def page_map(bi, hi, j, pt, st):
         return (jnp.maximum(pt[bi, j], 0), 0, hi, 0)
@@ -220,17 +305,31 @@ def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
     def scale_map(bi, hi, j, pt, st):
         return (jnp.maximum(pt[bi, j], 0), 0)
 
+    def packed_map(bi, hi, j, pt, st):
+        # int4 blocks carry the whole page (nibble groups mix heads):
+        # page-indexed on axis 0, full ps x PB/G extent
+        return (jnp.maximum(pt[bi, j], 0), 0, 0)
+
     in_specs = [
         pl.BlockSpec((1, W, 1, D),
                      lambda bi, hi, j, pt, st: (bi, 0, hi, 0)),
-        pl.BlockSpec((1, page_size, 1, D), page_map),
-        pl.BlockSpec((1, page_size, 1, D), page_map),
     ]
-    operands = (q, k_pages, v_pages)
-    if quantized:
-        in_specs += [pl.BlockSpec((1, page_size), scale_map),
-                     pl.BlockSpec((1, page_size), scale_map)]
-        operands += (k_scale, v_scale)
+    if int4:
+        PB = k_pages.shape[-1]
+        G = k_scale.shape[-1]
+        in_specs += [pl.BlockSpec((1, page_size, PB), packed_map),
+                     pl.BlockSpec((1, page_size, PB), packed_map),
+                     pl.BlockSpec((1, page_size, G), packed_map),
+                     pl.BlockSpec((1, page_size, G), packed_map)]
+        operands = (q, k_pages, v_pages, k_scale, v_scale)
+    else:
+        in_specs += [pl.BlockSpec((1, page_size, 1, D), page_map),
+                     pl.BlockSpec((1, page_size, 1, D), page_map)]
+        operands = (q, k_pages, v_pages)
+        if quant:
+            in_specs += [pl.BlockSpec((1, page_size), scale_map),
+                         pl.BlockSpec((1, page_size), scale_map)]
+            operands += (k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, start
         grid=(n, H, max_pages),
@@ -246,7 +345,7 @@ def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
     return pl.pallas_call(
         functools.partial(_ragged_kernel, scale=scale,
                           page_size=page_size, max_pages=max_pages,
-                          quantized=quantized),
+                          quant=quant, heads=(H, D)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, W, H, D), q.dtype),
         interpret=interpret,
@@ -255,7 +354,8 @@ def _ragged_kernel_call(q, k_pages, v_pages, page_table, start, scale,
 
 
 def _packed_kernel_call(q2, k_pages, v_pages, page_table, row_ids, pos,
-                        scale, interpret, k_scale=None, v_scale=None):
+                        scale, interpret, k_scale=None, v_scale=None,
+                        int4=False):
     """Pallas call for the PACKED layout: grid (T, H, max_pages) — one
     token's one page per step. The page/scale BlockSpec index maps
     indirect through TWO scalar-prefetched vectors: `row_ids[t]` picks
@@ -271,7 +371,7 @@ def _packed_kernel_call(q2, k_pages, v_pages, page_table, row_ids, pos,
     T, W, H, D = q2.shape
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
-    quantized = k_scale is not None
+    quant = "int4" if int4 else ("int8" if k_scale is not None else None)
 
     def page_map(ti, hi, j, pt, rid, ps_):
         return (jnp.maximum(pt[rid[ti], j], 0), 0, hi, 0)
@@ -279,17 +379,29 @@ def _packed_kernel_call(q2, k_pages, v_pages, page_table, row_ids, pos,
     def scale_map(ti, hi, j, pt, rid, ps_):
         return (jnp.maximum(pt[rid[ti], j], 0), 0)
 
+    def packed_map(ti, hi, j, pt, rid, ps_):
+        return (jnp.maximum(pt[rid[ti], j], 0), 0, 0)
+
     in_specs = [
         pl.BlockSpec((1, W, 1, D),
                      lambda ti, hi, j, pt, rid, ps_: (ti, 0, hi, 0)),
-        pl.BlockSpec((1, page_size, 1, D), page_map),
-        pl.BlockSpec((1, page_size, 1, D), page_map),
     ]
-    operands = (q2, k_pages, v_pages)
-    if quantized:
-        in_specs += [pl.BlockSpec((1, page_size), scale_map),
-                     pl.BlockSpec((1, page_size), scale_map)]
-        operands += (k_scale, v_scale)
+    if int4:
+        PB = k_pages.shape[-1]
+        G = k_scale.shape[-1]
+        in_specs += [pl.BlockSpec((1, page_size, PB), packed_map),
+                     pl.BlockSpec((1, page_size, PB), packed_map),
+                     pl.BlockSpec((1, page_size, G), packed_map),
+                     pl.BlockSpec((1, page_size, G), packed_map)]
+        operands = (q2, k_pages, v_pages, k_scale, v_scale)
+    else:
+        in_specs += [pl.BlockSpec((1, page_size, 1, D), page_map),
+                     pl.BlockSpec((1, page_size, 1, D), page_map)]
+        operands = (q2, k_pages, v_pages)
+        if quant:
+            in_specs += [pl.BlockSpec((1, page_size), scale_map),
+                         pl.BlockSpec((1, page_size), scale_map)]
+            operands += (k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,   # page_table, row_ids, pos
         grid=(T, H, max_pages),
@@ -308,7 +420,7 @@ def _packed_kernel_call(q2, k_pages, v_pages, page_table, row_ids, pos,
         # dense kernel with `pos` in the start slot
         return _ragged_kernel(pt_ref, pos_ref, *args, scale=scale,
                               page_size=page_size, max_pages=max_pages,
-                              quantized=quantized)
+                              quant=quant, heads=(H, D))
 
     return pl.pallas_call(
         body, grid_spec=grid_spec,
@@ -338,16 +450,18 @@ def ragged_paged_attention_packed(q, k_pages, v_pages, page_table,
     engine's A/B twin pins. The Pallas kernel scalar-prefetches
     `row_ids` and `pos` next to the page table and resolves
     `page_table[row_ids[t], j]` inside the BlockSpec index maps (see
-    `_packed_kernel_call`). int8 pools pass as (pages, scales) tuples
-    exactly like the dense entry point. Returns [T, H, D]."""
+    `_packed_kernel_call`). int8/int4 pools pass as (pages, scales)
+    tuples exactly like the dense entry point. Returns [T, H, D]."""
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     row_ids = jnp.asarray(row_ids, jnp.int32)
     pos = jnp.asarray(pos, jnp.int32)
     ks = vs = None
+    int4 = False
     if isinstance(k_pages, tuple):
         k_pages, ks = k_pages
         v_pages, vs = v_pages
+        int4 = k_pages.dtype == jnp.uint8    # nibble-packed payload
     # the same 2-wide padding the dense W=1 path uses (degenerate
     # matvec lowering drifts a ulp at W=1): one zero query per token,
     # discarded — bit-identity with the dense path rides on both
@@ -359,19 +473,20 @@ def ragged_paged_attention_packed(q, k_pages, v_pages, page_table,
         table_tok = page_table[row_ids]                 # [T, max_pages]
         return _ragged_ref_jit(q2, k_pages, v_pages, table_tok, pos,
                                scale=float(scale), k_scale=ks,
-                               v_scale=vs)[:, 0]
+                               v_scale=vs, int4=int4)[:, 0]
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     try:
         return _packed_kernel_call(q2, k_pages, v_pages, page_table,
                                    row_ids, pos, scale, interpret,
-                                   k_scale=ks, v_scale=vs)[:, 0]
+                                   k_scale=ks, v_scale=vs,
+                                   int4=int4)[:, 0]
     except Exception as e:
         kernel_fallback("ragged_paged_attention_packed", e)
         table_tok = page_table[row_ids]
         return _ragged_ref_jit(q2, k_pages, v_pages, table_tok, pos,
                                scale=float(scale), k_scale=ks,
-                               v_scale=vs)[:, 0]
+                               v_scale=vs, int4=int4)[:, 0]
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
@@ -384,9 +499,13 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
     positions in the chunked prefill). Decode rows are simply W=1 (or a
     width-W window with one real query). Returns (n, W, H, D).
 
-    `k_pages`/`v_pages` may each be an int8 pool tuple (pages int8,
-    scales f32 [P, ps]) — the serving decoder's kv_quant="int8" layout.
-    Both paths dequantize per page inside `_page_update`."""
+    `k_pages`/`v_pages` may each be a quantized pool tuple: int8 as
+    (pages int8, scales f32 [P, ps]) — the serving decoder's
+    kv_quant="int8" layout — or int4 as (nibble-packed uint8
+    [P, ps, PB], per-group scales f32 [P, ps, G]) — kv_quant="int4".
+    Both paths dequantize per page next to the shared `_page_update`
+    (int8 inside it, int4 through `_dequant_page_int4` right before
+    it — group scales cannot be folded post-dot)."""
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     start = jnp.asarray(start, jnp.int32)
@@ -403,21 +522,23 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, start,
                                       use_kernel=use_kernel,
                                       interpret=interpret)[:, :1]
     ks = vs = None
+    int4 = False
     if isinstance(k_pages, tuple):
         k_pages, ks = k_pages
         v_pages, vs = v_pages
+        int4 = k_pages.dtype == jnp.uint8    # nibble-packed payload
     if not use_kernel:
         return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
                                scale=float(scale), k_scale=ks,
-                               v_scale=vs)
+                               v_scale=vs, int4=int4)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     try:
         return _ragged_kernel_call(q, k_pages, v_pages, page_table,
                                    start, scale, interpret,
-                                   k_scale=ks, v_scale=vs)
+                                   k_scale=ks, v_scale=vs, int4=int4)
     except Exception as e:
         kernel_fallback("ragged_paged_attention", e)
         return _ragged_ref_jit(q, k_pages, v_pages, page_table, start,
                                scale=float(scale), k_scale=ks,
-                               v_scale=vs)
+                               v_scale=vs, int4=int4)

@@ -99,7 +99,8 @@ from .kv_tier import HostKVTier, restore_beats_recompute
 from .prefix_cache import PrefixCache
 from .scheduler import RaggedScheduler
 from .stats import _ENGINES, _STATS_WINDOW, ServeStats, serving_stats
-from .tenancy import (SLO_LATENCY, SLO_THROUGHPUT, TenantEngine,
+from .tenancy import (SLO_LATENCY, SLO_THROUGHPUT,
+                      PrecisionRoutedEngine, TenantEngine,
                       TenantScheduler, TenantStats, make_lora_bank)
 from .trace import (FlightRecorder, export_chrome_trace,
                     validate_chrome_trace)
@@ -112,4 +113,5 @@ __all__ = ["PagedGPTDecoder", "ContinuousBatchingEngine",
            "RaggedScheduler", "FlightRecorder", "export_chrome_trace",
            "validate_chrome_trace",
            "SLO_LATENCY", "SLO_THROUGHPUT", "TenantEngine",
+           "PrecisionRoutedEngine",
            "TenantScheduler", "TenantStats", "make_lora_bank"]

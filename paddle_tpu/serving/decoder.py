@@ -112,8 +112,9 @@ def _quantize_kv(val):
 # head*dim elements (per-token scales, as int8, would leave int4's
 # narrow range too coarse across heads with very different magnitudes;
 # per-group recovers most of the accuracy at 4/GROUP bytes/elem of
-# metadata). Pricing + primitive land now (pool_token_bytes /
-# _quantize_kv_int4); pool wiring is the named follow-up.
+# metadata). The pool stores (uint8 nibble pages, f32 group-scale
+# planes); dequant happens inside the attention body
+# (ops/ragged_paged_attention._dequant_page_int4), never in HBM.
 INT4_GROUP = 32
 
 
@@ -146,9 +147,9 @@ def _quantize_kv_int4(val, group=INT4_GROUP):
     (`_pack_int4`). Like the int8 path, the scales depend only on the
     token's own values, so stored bytes stay a pure function of
     (request, position) — the byte-identical-stream discipline carries
-    over unchanged when the pool wiring lands (this PR lands the
-    pricing leg + primitive; `pool_token_bytes(kv_quant="int4")`
-    prices it today). val [..., H, D] -> (packed uint8
+    over unchanged (`_kv_set` dispatches here for uint8 pools;
+    `pool_token_bytes(kv_quant="int4")` prices the stored layout).
+    val [..., H, D] -> (packed uint8
     [..., ceil(ceil(H*D/group)*group / 2)] — H*D zero-padded up to a
     whole number of groups and an even nibble count — f32 scales
     [..., ceil(H*D/group)])."""
@@ -224,12 +225,17 @@ def _kv_set(pool, pids, offs, val):
     — the single KV write primitive behind every serving path (decode
     ticks, chunked suffix prefill, the verify window, ragged horizons;
     scratch routing is the caller's pids). A plain pool stores the
-    cast value; an int8 pool (pages, scales) quantizes from the
-    token's own amax (`_quantize_kv`) and stores bytes + scale
-    together, so no write site can ever drift from the others."""
+    cast value; a quantized pool (pages, scales) quantizes from the
+    token's own amax and stores bytes + scales together, so no write
+    site can ever drift from the others — int8 pools (int8 payload)
+    take the per-token-scale path (`_quantize_kv`), int4 pools (uint8
+    nibble payload) the per-group path (`_quantize_kv_int4`)."""
     if isinstance(pool, tuple):
         pages, scales = pool
-        q, s = _quantize_kv(val)
+        if pages.dtype == jnp.uint8:
+            q, s = _quantize_kv_int4(val)
+        else:
+            q, s = _quantize_kv(val)
         return (pages.at[pids, offs].set(q),
                 scales.at[pids, offs].set(s))
     return pool.at[pids, offs].set(val.astype(pool.dtype))
@@ -366,7 +372,7 @@ class PagedGPTDecoder:
         # against.
         self.packed = bool(packed)
         assert quant in (None, "a8w8", "w4a16"), quant
-        assert kv_quant in (None, "int8"), kv_quant
+        assert kv_quant in (None, "int8", "int4"), kv_quant
         # temperature 0 = greedy (reference decode convention)
         self.sampling = None if not temperature else \
             (float(temperature), int(top_k), float(top_p))
@@ -434,7 +440,24 @@ class PagedGPTDecoder:
         # pool stores (the int8 pool dequantizes inside the attention
         # body, never in HBM)
         self.compute_dtype = dtype
-        if kv_quant:
+        if kv_quant == "int4":
+            # nibble-packed pages + one f32 write-time scale per
+            # (layer, token, group) for each of K and V: the token's
+            # H*D elements pack two-per-byte with a per-INT4_GROUP
+            # scale plane next to them (`_quantize_kv_int4` pads the
+            # tail group and the odd nibble) — the KV byte stream
+            # behind the decode roofline drops ~4x vs bf16
+            hd = H * D
+            grp = min(INT4_GROUP, hd)
+            G = (hd + grp - 1) // grp
+            PB = (G * grp + 1) // 2
+            self.k_pages = (
+                jnp.zeros((L, num_pages, page_size, PB), jnp.uint8),
+                jnp.zeros((L, num_pages, page_size, G), jnp.float32))
+            self.v_pages = (
+                jnp.zeros((L, num_pages, page_size, PB), jnp.uint8),
+                jnp.zeros((L, num_pages, page_size, G), jnp.float32))
+        elif kv_quant:
             # int8 pages + one f32 write-time scale per (layer, token)
             # for each of K and V: 4 bytes/token/layer of metadata per
             # plane next to the H*D int8 payload — the KV byte stream
@@ -637,9 +660,15 @@ class PagedGPTDecoder:
         # (int8 pools shard the byte payload the same way; the per-token
         # scale planes have no head axis and replicate — their amax
         # reduces over ALL heads, a tiny per-layer collective GSPMD
-        # inserts at the write)
+        # inserts at the write). int4 pools replicate BOTH leaves: the
+        # nibble axis is the flattened H*D stream packed two-per-byte,
+        # so a head boundary can land mid-byte and mid-group — there is
+        # no clean head shard of the packed payload.
         def put_pool(pool):
             if isinstance(pool, tuple):
+                if pool[0].dtype == jnp.uint8:
+                    return (put(pool[0], None, None, None, None),
+                            put(pool[1], None, None, None, None))
                 return (put(pool[0], None, None, None, "tp", None),
                         put(pool[1], None, None, None))
             return put(pool, None, None, None, "tp", None)
@@ -1562,6 +1591,16 @@ class PagedGPTDecoder:
         return pool_token_bytes(self.cfg, kv_quant=self.kv_quant,
                                 itemsize=self._pool_itemsize)
 
+    def kv_token_bytes_by_layer(self):
+        """Per-LAYER KV bytes one token costs — the pricing hook for
+        layer-mixed precision pools. Today every layer stores the same
+        width, so this is `kv_token_bytes` repeated num_layers times;
+        `step_hbm_bytes` sums THIS list for the live-pool leg, so the
+        day a pool mixes widths across layers (e.g. int8 first/last,
+        int4 middle) only this method changes and every capacity /
+        horizon / admission consumer re-prices automatically."""
+        return [self.kv_token_bytes] * self.cfg.num_layers
+
     @property
     def kv_page_bytes(self):
         """KV bytes one page holds across all layers (K and V, scale
@@ -1811,9 +1850,12 @@ class PagedGPTDecoder:
         max slots under a fixed per-token p99). `kv_quant` overrides
         the pool's quant mode for WHAT-IF pricing — e.g.
         ``kv_quant="int4"`` prices the per-group-scale int4 pool
-        (packed nibbles + f32 group scales, `pool_token_bytes`) before
-        the pool wiring lands, so capacity planning can already rank
-        bf16 vs int8 vs int4 streams."""
+        (packed nibbles + f32 group scales, `pool_token_bytes`) on a
+        decoder whose live pool runs another width, so capacity
+        planning can rank bf16 vs int8 vs int4 streams from one
+        decoder. The live-pool path sums `kv_token_bytes_by_layer`, so
+        a future per-layer mixed-precision pool re-prices here with no
+        caller changes."""
         cfg = self.cfg
         n = cfg.num_params()
         per = {"a8w8": 1.0, "w4a16": 0.5}.get(self.quant)
@@ -1828,7 +1870,8 @@ class PagedGPTDecoder:
         if batch is None:
             batch = self.max_batch
         if kv_quant == "pool":
-            tok_bytes = self.kv_token_bytes
+            return int(w_bytes +
+                       batch * avg_ctx * sum(self.kv_token_bytes_by_layer()))
         else:
             # what-if override: an UNQUANTIZED what-if must price the
             # compute dtype's width, not the live pool's leaf itemsize

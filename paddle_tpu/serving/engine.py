@@ -1694,15 +1694,18 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 "(attach_adapters): the verify window does not gather "
                 "adapters — use ContinuousBatchingEngine/TenantEngine")
         if decoder.kv_quant or draft_decoder.kv_quant:
-            # out of scope for the int8 pool (docs/serving.md): verify
-            # windows write up to k positions past the accepted length,
-            # and the twin-pool rollback discipline for quantized
-            # bytes+scales is unproven — refuse rather than risk a
-            # silent drift between the pools
+            # out of scope for quantized pools (docs/serving.md):
+            # verify windows write up to k positions past the accepted
+            # length, and the twin-pool rollback discipline for
+            # quantized bytes+scales — per-token int8 planes and
+            # packed-nibble int4 group planes alike — is unproven;
+            # refuse rather than risk a silent drift between the pools
+            quant = decoder.kv_quant or draft_decoder.kv_quant
             raise ValueError(
-                "SpeculativeEngine does not support int8 KV pools "
-                "(kv_quant): use ContinuousBatchingEngine, or plain "
-                "bf16 pools for speculation")
+                f"SpeculativeEngine does not support quantized KV "
+                f"pools (kv_quant={quant!r}; int8 and int4 alike): "
+                "use ContinuousBatchingEngine, or plain bf16 pools "
+                "for speculation")
         # k_max=1: the verify cadence IS this engine's horizon — each
         # step() already moves a k-token window; the draft's ticks are
         # device-resident via decode_multi below. (No prefix_cache:

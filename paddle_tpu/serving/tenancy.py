@@ -60,8 +60,8 @@ from .scheduler import RaggedScheduler
 from .stats import _window
 
 __all__ = ["SLO_LATENCY", "SLO_THROUGHPUT", "TenantStats",
-           "TenantScheduler", "TenantEngine", "make_lora_bank",
-           "summarize_tenancy"]
+           "TenantScheduler", "TenantEngine", "PrecisionRoutedEngine",
+           "make_lora_bank", "summarize_tenancy"]
 
 SLO_LATENCY = "latency"
 SLO_THROUGHPUT = "throughput"
@@ -546,3 +546,167 @@ class TenantEngine(ContinuousBatchingEngine):
                               tenant=self._rid_tenant[rid][0],
                               tokens=len(outputs), parked=parked,
                               freed=len(freed))
+
+
+class PrecisionRoutedEngine:
+    """Per-SLO-class KV precision policy: ONE logical engine whose
+    latency and throughput tiers run pools of DIFFERENT quant widths —
+    e.g. ``kv_precision={"latency": "int8", "throughput": "int4"}``
+    serves interactive traffic from the wider (more accurate) pool
+    while the batch tier banks the nibble-packed pool's ~1.65x extra
+    KV capacity. KV capacity-vs-quality becomes a scheduler knob, not
+    a build flag.
+
+    Mechanics: each distinct precision gets its own `PagedGPTDecoder`
+    (its own physical pool) + `PrefixCache` salted by that decoder's
+    `cache_fingerprint()` + `TenantEngine` (whose `TenantScheduler`
+    prices the class horizon cap and p99 targets from THAT pool's
+    `step_hbm_bytes()` — per-class admission capacity reflects the
+    real byte stream, not a shared average). Classes sharing a
+    precision share one engine. Pages can never alias across
+    precision classes: the pools are physically separate arrays AND
+    the fingerprint salt differs (`kv_quant` + pool leaf dtype are
+    folded in), so even an external shared tier keys them apart.
+
+    Request identity: ONE global rid counter spans the classes and is
+    stamped into the owning engine's allocator before each submit
+    (the `FleetRouter` idiom) — rid is the sampling-key id, so a
+    request's stream is byte-identical to what a single-class engine
+    would emit for the same (seed, rid, position) draws."""
+
+    def __init__(self, model, kv_precision=None, eos_token_id=None,
+                 max_new_tokens=64, num_pages=32, page_size=16,
+                 max_batch=2, k_max=None, chunk_tokens=None,
+                 prefix_cache=True, dec_kw=None, eng_kw=None):
+        from .decoder import PagedGPTDecoder
+        from .prefix_cache import PrefixCache
+        kv_precision = dict(kv_precision or {})
+        unknown = set(kv_precision) - {SLO_LATENCY, SLO_THROUGHPUT}
+        if unknown:
+            raise ValueError(
+                f"kv_precision keys must be SLO classes "
+                f"({SLO_LATENCY!r}/{SLO_THROUGHPUT!r}), got "
+                f"{sorted(unknown)!r}")
+        for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+            kv_precision.setdefault(slo, None)
+        self.kv_precision = kv_precision
+        self.decoders = {}           # slo -> PagedGPTDecoder
+        self.engines = {}            # slo -> TenantEngine
+        by_quant = {}                # quant -> engine (shared pools)
+        for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+            quant = kv_precision[slo]
+            if quant in by_quant:
+                eng = by_quant[quant]
+                self.decoders[slo] = eng.d
+                self.engines[slo] = eng
+                continue
+            dec = PagedGPTDecoder(model, num_pages=num_pages,
+                                  page_size=page_size,
+                                  max_batch=max_batch, kv_quant=quant,
+                                  **(dec_kw or {}))
+            cache = PrefixCache(dec.page_size,
+                                salt=dec.cache_fingerprint()) \
+                if prefix_cache else None
+            eng = TenantEngine(dec, eos_token_id=eos_token_id,
+                               max_new_tokens=max_new_tokens,
+                               k_max=k_max, chunk_tokens=chunk_tokens,
+                               prefix_cache=cache, **(eng_kw or {}))
+            by_quant[quant] = eng
+            self.decoders[slo] = dec
+            self.engines[slo] = eng
+        self._next_rid = 0           # global rid: THE sampling identity
+        self._rid_slo = {}
+
+    def submit(self, prompt_ids, tenant="default", slo=SLO_THROUGHPUT,
+               adapter=None):
+        """Queue one prompt on its class's engine; returns the GLOBAL
+        request id (unique across classes — streams keyed by it)."""
+        if slo not in self.engines:
+            raise ValueError(
+                f"slo must be {SLO_LATENCY!r} or {SLO_THROUGHPUT!r}, "
+                f"got {slo!r}")
+        eng = self.engines[slo]
+        gid = self._next_rid
+        self._next_rid = gid + 1
+        eng._next_id = gid           # rid IS the sampling key id
+        rid = eng.submit(prompt_ids, tenant=tenant, slo=slo,
+                         adapter=adapter)
+        assert rid == gid, (rid, gid)
+        self._rid_slo[gid] = slo
+        return gid
+
+    def _unique_engines(self):
+        seen, order = set(), []
+        for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+            eng = self.engines[slo]
+            if id(eng) not in seen:
+                seen.add(id(eng))
+                order.append(eng)
+        return order
+
+    def run(self, on_sync=None):
+        """Drain every class engine (latency first, then throughput,
+        looped until no churn — `on_sync(router, engine)` callbacks
+        may submit more work mid-run). Returns {global rid: token
+        list} across all classes."""
+        outputs = {}
+        hookof = (lambda e: (lambda en: on_sync(self, en))) \
+            if on_sync is not None else (lambda e: None)
+        while True:
+            progressed = False
+            for eng in self._unique_engines():
+                if eng._queue:
+                    outputs.update(eng.run(on_sync=hookof(eng)))
+                    progressed = True
+            if not progressed:
+                return outputs
+
+    def class_capacity(self):
+        """Per-class admission economics, each priced from its OWN
+        pool: quant mode, per-token/per-step bytes, pool capacity in
+        tokens, and the scheduler's roofline-derived latency horizon
+        cap + p99 target. The observability hook the capacity bench
+        and tests pin the policy through."""
+        out = {}
+        for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+            dec, eng = self.decoders[slo], self.engines[slo]
+            out[slo] = {
+                "kv_quant": dec.kv_quant,
+                "kv_token_bytes": int(dec.kv_token_bytes *
+                                      dec.cfg.num_layers),
+                "step_hbm_bytes": dec.step_hbm_bytes(),
+                "pool_tokens": (dec.num_pages - 1) * dec.page_size,
+                "k_latency": eng.scheduler.k_latency,
+                "slo_target_s": eng.scheduler.slo_targets_s[slo],
+            }
+        return out
+
+    def tenancy_summary(self):
+        """Pooled tenancy view over the class engines — the same
+        merge-then-`summarize_tenancy` math as the fleet, with each
+        class's roofline target taken from ITS OWN scheduler (they
+        differ when the pools do: that asymmetry is the policy)."""
+        merged = {}
+        for eng in self._unique_engines():
+            for key, ts in eng._tenants.items():
+                m = merged.get(key)
+                if m is None:
+                    m = merged[key] = TenantStats(tenant=ts.tenant,
+                                                  slo=ts.slo)
+                m.requests += ts.requests
+                m.completed += ts.completed
+                m.tokens += ts.tokens
+                m.preemptions += ts.preemptions
+                m.resumes += ts.resumes
+                m.queue_wait_s.extend(ts.queue_wait_s)
+                m.ttft_s.extend(ts.ttft_s)
+                m.occupancy.extend(ts.occupancy)
+        targets = {
+            slo: self.engines[slo].scheduler.slo_targets_s[slo]
+            for slo in (SLO_LATENCY, SLO_THROUGHPUT)}
+        return summarize_tenancy(
+            merged, slo_targets_s=targets,
+            preemptions=sum(e.stats.preemptions
+                            for e in self._unique_engines()),
+            resumes=sum(e.stats.resumes
+                        for e in self._unique_engines()))

@@ -1008,6 +1008,95 @@ def test_kv_quant_rule_catches_non_f32_scale_plane():
     assert report.metrics["kv-quant"]["n_bad_scale_planes"] == 1
 
 
+def _kv4_decoder(num_pages=8):
+    from paddle_tpu.models import GPT, gpt_tiny
+    from paddle_tpu.serving import PagedGPTDecoder
+    paddle.seed(0)
+    build_mesh(dp=1)
+    model = GPT(gpt_tiny(max_seq_len=64, dtype="float32", remat=False))
+    model.eval()
+    return PagedGPTDecoder(model, num_pages=num_pages, page_size=16,
+                           max_batch=2, kv_quant="int4")
+
+
+def _kv4_ctx(dec):
+    cfg = dec.cfg
+    return AnalysisContext(
+        name="decode_kv4",
+        extra={"serving_decode": True, "kv_quant": "int4",
+               "kv_pool_block_elems": (dec.num_pages * dec.page_size *
+                                       cfg.num_heads * cfg.head_dim)})
+
+
+def test_kv_quant_dequant_rule_reproves_on_packed_int4_pool():
+    """DTYPE-KV-DEQUANT-HBM re-proven on the nibble-packed layout: a
+    whole-pool int4 dequant still funnels through an i8 -> wide-float
+    convert at full pool shape (the nibble unpack lands in int8 BEFORE
+    the float convert; the uint8 bit-twiddling itself is integer-only
+    and can never match), so the same regex catches it. The real
+    capture — per-page unpack next to the shared attention update, a
+    page-sized convert — stays clean."""
+    from paddle_tpu.serving.decoder import _dequantize_kv_int4
+    dec = _kv4_decoder()
+    ctx = _kv4_ctx(dec)
+    pm = PassManager(["kv-quant"])
+
+    good = dec.analysis_program(k=2)
+    report = pm.run(good, ctx)
+    assert report.by_rule("DTYPE-KV-DEQUANT-HBM") == []
+    assert report.by_rule("DTYPE-KV-SCALE-WIDTH") == []
+    m = report.metrics["kv-quant"]
+    assert m["checked"] and m["kv_quant"] == "int4"
+    assert m["n_pool_dequants"] == 0
+    assert m["n_scale_planes"] == 2          # K and V group planes
+
+    hd = (dec.cfg.num_heads, dec.cfg.head_dim)
+
+    def bad_step(weights, k_pages, v_pages, tokens, lens, table, kids):
+        (kq, ks), (vq, vs) = k_pages, v_pages
+        kf = _dequantize_kv_int4(kq, ks, hd)     # FULL pool in HBM
+        vf = _dequantize_kv_int4(vq, vs, hd)
+        return dec._decode_step(weights, kf, vf, tokens, lens, table,
+                                kids)
+
+    from paddle_tpu.analysis.lowering import tree_arg_infos
+    S = dec.max_batch
+    args = (dec.weights, dec.k_pages, dec.v_pages,
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S, dec.max_pages), jnp.int32),
+            jnp.arange(S, dtype=jnp.int32))
+    traced = jax.jit(bad_step).trace(*args)
+    infos = tree_arg_infos(dec.weights, "param")
+    infos += tree_arg_infos(dec.k_pages, "cache", prefix="k_pages",
+                            donated=True)
+    infos += tree_arg_infos(dec.v_pages, "cache", prefix="v_pages",
+                            donated=True)
+    bad = LoweredProgram(traced.lower().as_text(), jaxpr=traced.jaxpr,
+                         name="bad_dequant4", arg_infos=infos)
+    report2 = pm.run(bad, ctx)
+    hits = report2.by_rule("DTYPE-KV-DEQUANT-HBM")
+    assert hits and all(h.severity == Severity.ERROR for h in hits)
+    assert report2.metrics["kv-quant"]["n_pool_dequants"] >= 2
+
+
+def test_kv_quant_scale_rule_reproves_on_packed_int4_pool():
+    """DTYPE-KV-SCALE-WIDTH re-proven on the packed layout: an int4
+    GROUP-scale plane cast to bf16 (quantizing the scales themselves)
+    is an ERROR on the cache args, exactly like the int8 per-token
+    plane."""
+    dec = _kv4_decoder()
+    ctx = _kv4_ctx(dec)
+    pm = PassManager(["kv-quant"])
+    kq, ks = dec.k_pages
+    dec.k_pages = (kq, ks.astype(jnp.bfloat16))
+    bad = dec.analysis_program(k=2)
+    report = pm.run(bad, ctx)
+    hits = report.by_rule("DTYPE-KV-SCALE-WIDTH")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert "bfloat16" in hits[0].message
+    assert report.metrics["kv-quant"]["n_bad_scale_planes"] == 1
+
+
 def test_page_refcount_audit_catches_cow_without_scales():
     """MEM-PAGE-REFCOUNT scale audit planted defect: a copy-on-write
     that moves a page's int8 BYTES but not its scale plane leaves the

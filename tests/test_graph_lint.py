@@ -183,3 +183,31 @@ def test_gpt_decode_kv8_program_is_device_resident_and_quant_clean(
     assert q["n_pool_dequants"] == 0
     pr = report.metrics["page-refcount"]
     assert pr["checked"] and pr["n_cached"] >= 1
+
+
+def test_gpt_decode_kv4_program_is_device_resident_and_quant_clean(
+        pass_manager):
+    """The committed gpt_decode_kv4 capture (fused K-tick decode loop
+    over the NIBBLE-PACKED int4 pool) holds the same bar as kv8: zero
+    host transfers, four donated cache leaves (uint8 nibble pages +
+    f32 GROUP-scale planes), a real device loop, no full-pool dequant
+    in HBM (the per-page unpack stays page-sized), and a page ledger
+    from a real shared-prefix int4 workload (incl. full-hit CoW)
+    auditing clean."""
+    program, ctx, _ = lowered_program("gpt_decode_kv4")
+    report = pass_manager.run(program, ctx)
+    assert report.by_rule("SERVE-HOST-SYNC-DECODE") == []
+    assert report.by_rule("DTYPE-KV-SCALE-WIDTH") == []
+    assert report.by_rule("DTYPE-KV-DEQUANT-HBM") == []
+    assert report.by_rule("MEM-PAGE-REFCOUNT") == []
+    m = report.metrics["serving"]
+    assert m["checked"] and m["cache_donated"]
+    assert m["n_host_transfers"] == 0
+    assert m["n_device_loops"] >= 1
+    assert m["n_cache_args"] == 4      # nibble pages + group planes
+    q = report.metrics["kv-quant"]
+    assert q["checked"] and q["kv_quant"] == "int4"
+    assert q["n_scale_planes"] == 2 and q["n_bad_scale_planes"] == 0
+    assert q["n_pool_dequants"] == 0
+    pr = report.metrics["page-refcount"]
+    assert pr["checked"] and pr["n_cached"] >= 1

@@ -23,9 +23,10 @@ def tiny_model():
     return model
 
 
-def _stream(model, prompts, max_new, eos=None, dec_kw=None, **eng_kw):
+def _stream(model, prompts, max_new, eos=None, dec_kw=None,
+            kv_quant="int8", **eng_kw):
     dec = PagedGPTDecoder(model, num_pages=48, page_size=16,
-                          max_batch=2, kv_quant="int8", **(dec_kw or {}))
+                          max_batch=2, kv_quant=kv_quant, **(dec_kw or {}))
     eng = ContinuousBatchingEngine(dec, eos_token_id=eos,
                                    max_new_tokens=max_new, **eng_kw)
     rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
@@ -143,14 +144,17 @@ def test_int8_cow_copies_scales_with_bytes(tiny_model):
 
 # ------------------------------------------------------- accuracy gate
 
-def test_int8_pool_perplexity_delta_bounded(tiny_model):
+def test_quantized_pool_perplexity_delta_bounded(tiny_model):
     """The accuracy acceptance gate: greedy-decode >=256 tokens with
     the bf16-pool engine, then teacher-force the SAME stream through a
-    bf16-pool and an int8-pool decoder (verify windows — per-position
-    logits) and compare perplexities. COMMITTED BOUND: the int8 pool
-    moves mean NLL by at most 0.05 nats (~5% perplexity) on the tiny
-    GPT. Per-token write-time scales bound each token's dequant error
-    at ~0.4% of its own amax, so the drift is far inside the bound."""
+    bf16-pool, an int8-pool and an int4-pool decoder (verify windows —
+    per-position logits) and compare perplexities. COMMITTED BOUND:
+    each quantized pool moves mean NLL by at most 0.05 nats (~5%
+    perplexity) on the tiny GPT. int8's per-token write-time scales
+    bound each token's dequant error at ~0.4% of its own amax; int4's
+    per-GROUP scales keep the nibble pool's coarser step (~7%) local
+    to each 32-element group, so one outlier head cannot flatten the
+    rest — both land far inside the bound."""
     paddle.seed(7)
     cfg = gpt_tiny(max_seq_len=320, dtype="float32", remat=False)
     model = GPT(cfg)
@@ -186,12 +190,13 @@ def test_int8_pool_perplexity_delta_bounded(tiny_model):
         return float(np.mean(nll))
 
     nll16 = mean_nll(None)
-    nll8 = mean_nll("int8")
-    delta = abs(nll8 - nll16)
-    assert delta <= 0.05, (
-        f"int8 KV pool moved mean NLL by {delta:.4f} nats "
-        f"(ppl {np.exp(nll16):.2f} -> {np.exp(nll8):.2f}); "
-        "bound is 0.05")
+    for kq in ("int8", "int4"):
+        nllq = mean_nll(kq)
+        delta = abs(nllq - nll16)
+        assert delta <= 0.05, (
+            f"{kq} KV pool moved mean NLL by {delta:.4f} nats "
+            f"(ppl {np.exp(nll16):.2f} -> {np.exp(nllq):.2f}); "
+            "bound is 0.05")
 
 
 # -------------------------------------------------- capacity economics
@@ -250,19 +255,22 @@ def test_pool_state_quant_mismatch_raises(tiny_model):
              "v_pages": d16.v_pages})
 
 
-def test_speculative_engine_refuses_int8_pool(tiny_model):
-    """Scope pin (docs/serving.md): the int8 pool is out of scope for
-    SpeculativeEngine this PR — verify windows write past the accepted
-    length and the twin-pool rollback discipline is unproven."""
+def test_speculative_engine_refuses_quantized_pools(tiny_model):
+    """Scope pin (docs/serving.md): quantized pools — int8 AND the
+    nibble-packed int4 — are out of scope for SpeculativeEngine:
+    verify windows write past the accepted length and the twin-pool
+    rollback discipline for quantized bytes+scales is unproven. The
+    error must NAME the offending quant mode."""
     from paddle_tpu.serving import SpeculativeEngine
-    d8 = PagedGPTDecoder(tiny_model, num_pages=8, page_size=16,
-                         max_batch=1, kv_quant="int8")
     draft = PagedGPTDecoder(tiny_model, num_pages=8, page_size=16,
                             max_batch=1)
-    with pytest.raises(ValueError, match="int8 KV pools"):
-        SpeculativeEngine(d8, draft)
-    with pytest.raises(ValueError, match="int8 KV pools"):
-        SpeculativeEngine(draft, d8)
+    for kq in ("int8", "int4"):
+        dq = PagedGPTDecoder(tiny_model, num_pages=8, page_size=16,
+                             max_batch=1, kv_quant=kq)
+        with pytest.raises(ValueError, match=f"quantized KV.*{kq}"):
+            SpeculativeEngine(dq, draft)
+        with pytest.raises(ValueError, match=f"quantized KV.*{kq}"):
+            SpeculativeEngine(draft, dq)
 
 
 def test_serve_stats_capacity_fields(tiny_model):
@@ -327,8 +335,8 @@ def test_int8_kernel_path_matches_jnp_through_engine(tiny_model):
 
 def test_int4_pack_unpack_round_trip():
     """The nibble layout is exactly invertible for every int4 value
-    (pricing + primitive land now; pool wiring is the named
-    follow-up)."""
+    (the primitive behind the wired `kv_quant="int4"` pool's
+    `_kv_set` path)."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving.decoder import _pack_int4, _unpack_int4
@@ -437,3 +445,222 @@ def test_int4_pricing_leg(tiny_model):
     sync = 1e-3
     assert decode_horizon(w4, host_sync_s=sync) >= \
         decode_horizon(full, host_sync_s=sync)
+
+
+# ------------------------------------------------- int4 pool end-to-end
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_int4_streams_byte_identical_across_schedules(tiny_model, seed):
+    """THE int4 acceptance bar, mirroring the int8 pin: the
+    nibble-packed pool's streams are byte-identical to THEMSELVES
+    across every schedule — per-tick vs ragged vs blocking horizons
+    under randomized admission churn (sampled config + EOS retirement
+    + more requests than slots, prompts long enough to chunk).
+    Write-time per-GROUP scales depend only on the token's own values,
+    so the (request, position) discipline — and the byte-identical
+    stream — survives the third precision unchanged."""
+    rng = np.random.RandomState(700 + seed)
+    V = tiny_model.cfg.vocab_size
+    prompts = [list(rng.randint(0, V, rng.randint(1, 40)).astype(int))
+               for _ in range(4)]
+    eos = int(rng.randint(0, V))
+    max_new = int(rng.randint(3, 12))
+    dec_kw = dict(temperature=0.8, top_k=40, seed=11)
+    base, _ = _stream(tiny_model, prompts, max_new, eos, dec_kw,
+                      kv_quant="int4", k_max=1)
+    k_max = 4 if seed % 2 == 0 else 8       # both k buckets across seeds
+    blocking, _ = _stream(tiny_model, prompts, max_new, eos, dec_kw,
+                          kv_quant="int4", k_max=k_max, ragged=False)
+    assert blocking == base, (seed, k_max, "blocking")
+    ragged, _ = _stream(tiny_model, prompts, max_new, eos, dec_kw,
+                        kv_quant="int4", k_max=k_max, chunk_tokens=8)
+    assert ragged == base, (seed, k_max, "ragged")
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_int4_prefix_cache_matches_capacity_zero(tiny_model, seed):
+    """Prefix cache on vs capacity-0 over the int4 pool: mounted
+    shared pages, CoW on the full hit, and the scale-plane audit all
+    packed-layout-aware — streams identical either way."""
+    rng = np.random.RandomState(900 + seed)
+    V = tiny_model.cfg.vocab_size
+    shared = rng.randint(0, V, 16).astype(int)      # one full block
+    prompts = [list(shared) + list(rng.randint(0, V, rng.randint(1, 8))
+                                   .astype(int)) for _ in range(3)]
+    prompts.append(list(shared))                    # a FULL hit (CoW)
+    eos = int(rng.randint(0, V))
+    dec_kw = dict(temperature=0.7, seed=3)
+
+    def run(capacity):
+        dec = PagedGPTDecoder(tiny_model, num_pages=48, page_size=16,
+                              max_batch=2, kv_quant="int4", **dec_kw)
+        eng = ContinuousBatchingEngine(
+            dec, eos_token_id=eos, max_new_tokens=6, k_max=4,
+            prefix_cache=PrefixCache(dec.page_size, capacity=capacity,
+                                     salt=dec.cache_fingerprint()))
+        rids = [eng.submit(np.asarray(p, np.int32)) for p in prompts]
+        hits = []
+        res = eng.run(on_sync=lambda e: hits.extend(e.audit_pages()))
+        assert hits == [], hits          # ledger + scale audit clean
+        return [res[r] for r in rids], eng
+
+    cached, eng = run(capacity=None)
+    off, _ = run(capacity=0)
+    assert cached == off, seed
+    assert eng.stats.prefix_hits >= 1
+
+
+def test_int4_cow_copies_group_scales_with_bytes(tiny_model):
+    """A full-prompt hit copy-on-writes the final mounted page before
+    re-consuming its last token: with an int4 pool the private copy
+    must carry the per-group scale planes next to the packed nibbles,
+    and its bytes must equal the original's outside the re-consumed
+    position (which recomputes bit-equal bytes anyway — prefill is
+    deterministic)."""
+    import jax
+    dec = PagedGPTDecoder(tiny_model, num_pages=16, page_size=16,
+                          max_batch=2, kv_quant="int4")
+    eng = ContinuousBatchingEngine(
+        dec, max_new_tokens=2, k_max=2,
+        prefix_cache=PrefixCache(16, salt=dec.cache_fingerprint()))
+    base = list(range(1, 17))                # one full shareable block
+    eng.submit(np.asarray(base + [21, 22], np.int32))
+    eng.run()
+
+    snapshots = []
+
+    def grab(e):
+        if e.stats.prefix_cow and not snapshots:
+            slot = next(s for s in range(e.d.max_batch)
+                        if e._slot_req[s] is not None)
+            snapshots.append((e._slot_pages[slot][0],
+                              jax.tree_util.tree_map(np.asarray,
+                                                     e.d.k_pages)))
+    eng.submit(np.asarray(base, np.int32))   # FULL hit -> CoW
+    eng.run(on_sync=grab)
+    assert eng.stats.prefix_cow == 1 and snapshots
+    dst, (kq, ks) = snapshots[0]             # [L,P,ps,PB], [L,P,ps,G]
+    cached_page = next(iter(eng.cache.pages()))
+    # group scales came along: every written position of the copy has
+    # the original's positive per-group scales. Like the byte check
+    # below, the re-consumed LAST position is excluded: it recomputes
+    # through a different program shape, and a per-group amax over 32
+    # elements can expose an ulp of XLA fusion drift that int8's
+    # whole-token amax masks — the stream bytes the engine serves are
+    # the recomputed ones either way
+    np.testing.assert_array_equal(ks[:, dst, :15], ks[:, cached_page, :15])
+    assert (ks[:, dst] > 0).all()
+    # packed bytes identical outside the re-consumed last position
+    np.testing.assert_array_equal(kq[:, dst, :15], kq[:, cached_page, :15])
+    assert eng.audit_pages() == []
+
+
+def test_int4_kernel_path_matches_jnp_through_engine(tiny_model):
+    """use_kernel=True (interpret-mode Pallas with in-VMEM nibble
+    unpack + page-indexed group-scale BlockSpecs) end-to-end through
+    the engine: identical streams to the jnp reference path — the
+    bit-identity contract extends to the packed pool."""
+    prompt = [3, 141, 59, 26]
+    outs = {}
+    for kernel in (False, True):
+        dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                              max_batch=1, kv_quant="int4",
+                              use_kernel=kernel)
+        eng = ContinuousBatchingEngine(dec, max_new_tokens=5)
+        rid = eng.submit(np.asarray(prompt, np.int32))
+        outs[kernel] = eng.run()[rid]
+    assert outs[False] == outs[True]
+
+
+def test_int4_pool_state_round_trip_and_fingerprint(tiny_model):
+    """pool_state()/load_pool_state round-trips the packed layout
+    (uint8 nibble leaves + f32 group-scale planes, bit-exact), quant
+    mismatches refuse — int4 state into an int8 or bf16 decoder and
+    vice versa — and `cache_fingerprint` separates all three precision
+    classes (pages must never alias across them)."""
+    mk = lambda kv: PagedGPTDecoder(tiny_model, num_pages=8,
+                                    page_size=16, max_batch=1,
+                                    kv_quant=kv)
+    d4 = mk("int4")
+    d4.prefill([3, 141, 59, 26], [0])
+    st = d4.pool_state()
+    d4b = mk("int4")
+    d4b.load_pool_state(st)
+    for a, b in ((d4.k_pages, d4b.k_pages), (d4.v_pages, d4b.v_pages)):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    d8, d16 = mk("int8"), mk(None)
+    for other in (d8, d16):
+        with pytest.raises(ValueError, match="quant config mismatch"):
+            other.load_pool_state(st)
+        with pytest.raises(ValueError, match="quant config mismatch"):
+            d4.load_pool_state(other.pool_state())
+    fps = {kv: mk(kv).cache_fingerprint() for kv in (None, "int8",
+                                                     "int4")}
+    assert len(set(fps.values())) == 3, fps
+
+
+def test_serve_stats_int4_capacity_fields(tiny_model):
+    """ServeStats satellite on the nibble-packed pool: kv_pool_bytes /
+    kv_bytes_per_token surface the TRUE int4 stream — packed payload +
+    per-group f32 scale planes included, scratch page excluded —
+    wraparound-safe (sliding windows overflow without touching the
+    capacity counters)."""
+    from paddle_tpu.serving.decoder import INT4_GROUP
+    dec = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                          max_batch=2, kv_quant="int4")
+    eng = ContinuousBatchingEngine(dec, max_new_tokens=4, k_max=4)
+    for p in ([3, 141, 59], [9, 8, 7], [1, 2]):
+        eng.submit(np.asarray(p, np.int32))
+    eng.run()
+    s = eng.stats.summary()
+    cfg = tiny_model.cfg
+    hd = cfg.num_heads * cfg.head_dim
+    G = (hd + INT4_GROUP - 1) // INT4_GROUP
+    per_tok = 2 * ((G * INT4_GROUP + 1) // 2 + 4 * G) * cfg.num_layers
+    assert s["kv_bytes_per_token"] == per_tok
+    assert s["kv_pool_bytes"] == 31 * dec.kv_page_bytes  # scratch excluded
+    # the int8 twin streams more bytes per token; bf16 more still
+    d8 = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                         max_batch=2, kv_quant="int8")
+    e8 = ContinuousBatchingEngine(d8, max_new_tokens=2)
+    assert e8.stats.kv_bytes_per_token > s["kv_bytes_per_token"]
+    # wraparound: overflow the sliding windows; counters stay intact
+    for _ in range(5000):
+        eng.stats.token_time_s.append(1e-3)
+        eng.stats.occupancy.append(0.5)
+    s2 = eng.stats.summary()
+    assert len(eng.stats.token_time_s) == 4096       # window bounded
+    assert s2["kv_pool_bytes"] == s["kv_pool_bytes"]
+    assert s2["kv_bytes_per_token"] == s["kv_bytes_per_token"]
+    assert s2["requests"] == 3 and s2["completed"] == 3
+
+
+def test_kv_token_bytes_by_layer_prices_step(tiny_model):
+    """The per-layer pricing hook (layer-mixed precision's landing
+    pad): `kv_token_bytes_by_layer` returns one entry per layer,
+    uniform today, and `step_hbm_bytes` sums exactly that list for the
+    live-pool KV leg — so a future mixed-width pool re-prices every
+    capacity consumer by changing only the hook."""
+    for kv in (None, "int8", "int4"):
+        dec = PagedGPTDecoder(tiny_model, num_pages=16, page_size=16,
+                              max_batch=2, kv_quant=kv)
+        per_layer = dec.kv_token_bytes_by_layer()
+        assert len(per_layer) == tiny_model.cfg.num_layers
+        assert all(b == dec.kv_token_bytes for b in per_layer)
+        ctx = 64
+        w = dec.step_hbm_bytes(avg_ctx=ctx) - \
+            dec.max_batch * ctx * sum(per_layer)
+        assert w > 0                       # the weight leg remains
+        # the sum IS the KV leg: doubling one layer's width through a
+        # patched hook must reprice step_hbm_bytes by exactly that much
+        bumped = list(per_layer)
+        bumped[0] *= 2
+        orig = dec.kv_token_bytes_by_layer
+        try:
+            dec.kv_token_bytes_by_layer = lambda: bumped
+            assert dec.step_hbm_bytes(avg_ctx=ctx) == \
+                w + dec.max_batch * ctx * sum(bumped)
+        finally:
+            dec.kv_token_bytes_by_layer = orig

@@ -146,14 +146,18 @@ def test_streams_byte_identical_tier_on_off_capacity0(tiny_model, seed):
     """THE acceptance bar: tier-on (restore-pinned), tier-off and
     tier-capacity-0 engines emit byte-identical streams under
     randomized admission churn — sampled config, EOS retirement,
-    ragged multi-tick horizons (k 4 and 8), int8 pools, eviction
-    pressure — and every pool reclaims its pages."""
+    ragged multi-tick horizons (k 4 and 8), int8 AND nibble-packed
+    int4 pools (a spilled int4 payload carries uint8 nibble rows plus
+    f32 group-scale rows; a restore must remount BOTH bit-exactly),
+    eviction pressure — and every pool reclaims its pages."""
     rng = np.random.RandomState(700 + seed)
     V = tiny_model.cfg.vocab_size
     k_max = 8 if seed == 1 else 4
     dec_kw = dict(temperature=0.8, top_k=40, seed=11)
     if seed == 2:
         dec_kw["kv_quant"] = "int8"
+    elif seed == 0:
+        dec_kw["kv_quant"] = "int4"
     templates = [list(rng.randint(0, V, 32).astype(int))
                  for _ in range(3)]
     prompts = [templates[0] + [1, 2]]
@@ -228,11 +232,12 @@ def test_auto_policy_recomputes_for_tiny_model_and_refreshes(tiny_model):
     assert _pages_balanced(eng)
 
 
-def test_int8_pool_spills_quantized_payload(tiny_model):
-    """An int8 pool's spill carries int8 page bytes + f32 scale rows —
-    under half the host bytes of the same pool spilled at f32 width
-    (the 'quantized spill for free' claim, measured not asserted by
-    construction)."""
+def test_quantized_pools_spill_quantized_payload(tiny_model):
+    """A quantized pool's spill carries its pool-width bytes, not f32:
+    int8 pages + f32 per-token scale rows land under half the f32
+    spill, and the int4 nibble pages + f32 group-scale rows land below
+    int8 again (the 'quantized spill for free' claim, measured not
+    asserted by construction)."""
     def spill_bytes(dec_kw):
         rng = np.random.RandomState(5)
         V = tiny_model.cfg.vocab_size
@@ -247,8 +252,10 @@ def test_int8_pool_spills_quantized_payload(tiny_model):
         return eng.stats.host_tier_bytes / eng.stats.tier_spills
 
     full = spill_bytes(None)                      # f32 pool
-    quant = spill_bytes(dict(kv_quant="int8"))
-    assert quant < full / 2, (quant, full)
+    quant8 = spill_bytes(dict(kv_quant="int8"))
+    quant4 = spill_bytes(dict(kv_quant="int4"))
+    assert quant8 < full / 2, (quant8, full)
+    assert quant4 < quant8, (quant4, quant8)
 
 
 def test_tier_counters_in_summary_and_window_wraparound(tiny_model):
@@ -419,6 +426,56 @@ def test_persistence_fingerprint_mismatch_refuses(tiny_model, tmp_path):
                            max_batch=2)
     with pytest.raises(ValueError, match="fingerprint"):
         PrefixCache.load(d, dec2)
+
+
+def test_persistence_int4_round_trip_fresh_engine(tiny_model, tmp_path):
+    """int4 persistence: the saved cache restores into a FRESH int4
+    engine keyed by the int4 `cache_fingerprint` — the remounted
+    nibble pages AND group-scale planes are bit-exact copies of the
+    saving pool's, warm streams equal the cold engine's, and the same
+    save refuses a bf16 or int8 decoder (kv_quant is part of the
+    fingerprint; mounting another precision's bytes would be silent
+    garbage)."""
+    d = str(tmp_path / "cache")
+    prompt = list(range(1, 33)) + [44, 45]
+    dec, eng = _engine(tiny_model, tier=HostKVTier(), num_pages=32,
+                       dec_kw=dict(kv_quant="int4"))
+    r1 = eng.submit(np.asarray(prompt, np.int32))
+    o1 = eng.run()[r1]
+    eng.cache.save(d)
+
+    dec2 = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                           max_batch=2, kv_quant="int4")
+    cache2 = PrefixCache.load(d, dec2)
+    eng2 = ContinuousBatchingEngine(dec2, max_new_tokens=6,
+                                    prefix_cache=cache2)
+    # the mounted pages carry the exact spilled bytes: nibbles AND
+    # f32 group-scale planes, per layer, both pools
+    keys = eng.cache.block_keys(prompt)
+    src, dst = eng.cache.match(keys), cache2.match(keys)
+    assert len(src) == len(dst) == 2
+    for s_pg, d_pg in zip(src, dst):
+        for pool_a, pool_b in ((dec.k_pages, dec2.k_pages),
+                               (dec.v_pages, dec2.v_pages)):
+            np.testing.assert_array_equal(
+                np.asarray(pool_a[0][:, s_pg]),
+                np.asarray(pool_b[0][:, d_pg]))
+            np.testing.assert_array_equal(
+                np.asarray(pool_a[1][:, s_pg]),
+                np.asarray(pool_b[1][:, d_pg]))
+    r2 = eng2.submit(np.asarray(prompt, np.int32))
+    o2 = eng2.run()[r2]
+    assert o2 == o1
+    s = eng2.stats
+    assert s.prefix_hits == 2 and s.prefix_tokens_saved == 32
+    assert eng2.audit_pages() == []
+
+    # precision is identity: other-width decoders refuse the save
+    for other_kw in ({}, {"kv_quant": "int8"}):
+        dec3 = PagedGPTDecoder(tiny_model, num_pages=32, page_size=16,
+                               max_batch=2, **other_kw)
+        with pytest.raises(ValueError, match="fingerprint"):
+            PrefixCache.load(d, dec3)
 
 
 def test_engine_refuses_preloaded_cache_on_wrong_decoder(tiny_model,

@@ -1,0 +1,108 @@
+"""Unit tests for the load-flake containment policy itself
+(tests/_mp_harness.retry_under_load), driven by a FAKE load average —
+no processes spawned, no real saturation needed.
+
+The policy under test: one clean retry in a fresh subdir; a skip
+whenever the 1-minute load average says the box is saturated — sampled
+at the first failure, again right before the retry (the lagging
+average), and once more AROUND a failing retry (a box that saturated
+mid-retry gets a skip, not a fail). Only a retry that fails on a QUIET
+box is ruled a real bug."""
+import pytest
+
+from tests import _mp_harness as harness
+
+QUIET = 0.0
+SLAMMED = 1e9          # safely past 1.5x cores on any box
+
+
+@pytest.fixture
+def fake_load(monkeypatch):
+    """Patch the harness's load probe and its pre-retry sleep; returns
+    the mutable cell the test scripts the 'load average' through."""
+    load = {"v": QUIET, "on_sleep": None}
+
+    def sleep(_s):
+        if load["on_sleep"] is not None:
+            load["v"] = load["on_sleep"]
+
+    monkeypatch.setattr(harness, "_loadavg", lambda: load["v"])
+    monkeypatch.setattr(harness.time, "sleep", sleep)
+    return load
+
+
+def test_one_flake_retries_in_fresh_subdir_and_passes(tmp_path,
+                                                      fake_load):
+    calls = []
+
+    @harness.retry_under_load
+    def t(p):
+        calls.append(p)
+        if len(calls) == 1:
+            raise RuntimeError("transient flake")
+        return "ok"
+
+    assert t(tmp_path) == "ok"
+    assert len(calls) == 2
+    assert calls[0] == tmp_path
+    assert calls[1] == tmp_path / "retry"       # fresh subdir
+
+
+def test_saturated_at_failure_skips_without_retry(tmp_path, fake_load):
+    fake_load["v"] = SLAMMED
+    calls = []
+
+    @harness.retry_under_load
+    def t(p):
+        calls.append(p)
+        raise RuntimeError("boom")
+
+    with pytest.raises(pytest.skip.Exception, match="saturated"):
+        t(tmp_path)
+    assert len(calls) == 1                      # retry never burned
+
+
+def test_saturated_before_retry_skips(tmp_path, fake_load):
+    # quiet at the failure, but the lagging average catches the spike
+    # during the pre-retry beat — the retry must not launch into it
+    fake_load["on_sleep"] = SLAMMED
+    calls = []
+
+    @harness.retry_under_load
+    def t(p):
+        calls.append(p)
+        raise RuntimeError("boom")
+
+    with pytest.raises(pytest.skip.Exception, match="before retry"):
+        t(tmp_path)
+    assert len(calls) == 1
+
+
+def test_saturation_during_retry_skips_not_fails(tmp_path, fake_load):
+    # quiet at launch, box saturates WHILE the retry runs (the
+    # mid-sweep GC cliff): the failing retry is a skip, not a fail
+    calls = []
+
+    @harness.retry_under_load
+    def t(p):
+        calls.append(p)
+        if len(calls) == 2:
+            fake_load["v"] = SLAMMED
+        raise RuntimeError("boom")
+
+    with pytest.raises(pytest.skip.Exception, match="during retry"):
+        t(tmp_path)
+    assert len(calls) == 2
+
+
+def test_quiet_retry_failure_is_a_real_bug(tmp_path, fake_load):
+    calls = []
+
+    @harness.retry_under_load
+    def t(p):
+        calls.append(p)
+        raise RuntimeError("real bug")
+
+    with pytest.raises(RuntimeError, match="real bug"):
+        t(tmp_path)
+    assert len(calls) == 2                      # retried, still failed

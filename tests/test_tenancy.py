@@ -468,3 +468,103 @@ def test_tenancy_tracing_off_is_dead_branch(tiny_model):
         lat_prompts=[list(rng.randint(0, V, 36))], arrive_at=[3])
     assert eng.stats.preemptions >= 1
     assert FlightRecorder.total_events == before
+
+
+# ------------------------------------------- per-class KV precision
+
+
+def test_precision_routed_engine_policy_pinned(tiny_model):
+    """The per-SLO-class KV precision policy on the canonical
+    latency=int8 / throughput=int4 pair: each class admits from ITS
+    OWN pool's `step_hbm_bytes` (the int4 class's byte stream is
+    strictly cheaper), the pools are physically separate arrays whose
+    caches key on DIFFERENT fingerprints (pages can never alias
+    across classes), and every stream is byte-identical to a
+    single-precision engine given the same (seed, rid) sampling
+    identity."""
+    from paddle_tpu.serving import PrecisionRoutedEngine
+    dec_kw = dict(temperature=0.8, top_k=40, seed=11)
+    eng = PrecisionRoutedEngine(
+        tiny_model,
+        kv_precision={SLO_LATENCY: "int8", SLO_THROUGHPUT: "int4"},
+        max_new_tokens=6, num_pages=16, max_batch=2, dec_kw=dec_kw)
+    dlat = eng.decoders[SLO_LATENCY]
+    dthr = eng.decoders[SLO_THROUGHPUT]
+    assert dlat.kv_quant == "int8" and dthr.kv_quant == "int4"
+    # physically separate pools: different arrays, different layouts
+    assert dlat.k_pages[0] is not dthr.k_pages[0]
+    assert str(dlat.k_pages[0].dtype) == "int8"
+    assert str(dthr.k_pages[0].dtype) == "uint8"     # nibble-packed
+    # fingerprint-keyed caches: the salt differs, so no external tier
+    # can ever serve one class's pages to the other
+    assert dlat.cache_fingerprint() != dthr.cache_fingerprint()
+    assert eng.engines[SLO_LATENCY].cache.salt != \
+        eng.engines[SLO_THROUGHPUT].cache.salt
+
+    # per-class admission economics come from each class's OWN pool
+    cap = eng.class_capacity()
+    assert cap[SLO_LATENCY]["kv_quant"] == "int8"
+    assert cap[SLO_THROUGHPUT]["kv_quant"] == "int4"
+    for slo in (SLO_LATENCY, SLO_THROUGHPUT):
+        assert cap[slo]["step_hbm_bytes"] == \
+            eng.decoders[slo].step_hbm_bytes()
+        assert cap[slo]["slo_target_s"] > 0
+    assert cap[SLO_THROUGHPUT]["kv_token_bytes"] < \
+        cap[SLO_LATENCY]["kv_token_bytes"]
+    assert cap[SLO_THROUGHPUT]["step_hbm_bytes"] < \
+        cap[SLO_LATENCY]["step_hbm_bytes"]
+
+    # interleaved submits across classes; rids are global
+    rng = np.random.RandomState(31)
+    V = tiny_model.cfg.vocab_size
+    prompts = [list(rng.randint(0, V, 12).astype(int))
+               for _ in range(4)]
+    slos = [SLO_LATENCY, SLO_THROUGHPUT, SLO_THROUGHPUT, SLO_LATENCY]
+    rids = [eng.submit(np.asarray(p, np.int32), slo=s)
+            for p, s in zip(prompts, slos)]
+    assert rids == [0, 1, 2, 3]
+    outs = eng.run()
+    assert set(outs) == set(rids)
+
+    # byte-identity vs single-precision twins with the same rids
+    for quant, idxs in (("int8", (0, 3)), ("int4", (1, 2))):
+        dec = PagedGPTDecoder(tiny_model, num_pages=16, page_size=16,
+                              max_batch=2, kv_quant=quant, **dec_kw)
+        twin = TenantEngine(dec, max_new_tokens=6,
+                            prefix_cache=PrefixCache(
+                                16, salt=dec.cache_fingerprint()))
+        for i in idxs:
+            twin._next_id = rids[i]
+            assert twin.submit(np.asarray(prompts[i], np.int32),
+                               slo=slos[i]) == rids[i]
+        twin_outs = twin.run()
+        for i in idxs:
+            assert twin_outs[rids[i]] == outs[rids[i]], (quant, i)
+
+    # tenancy summary pools the classes but keeps per-class targets
+    summ = eng.tenancy_summary()
+    assert summ["classes"][SLO_LATENCY]["roofline_target_ms"] > 0
+    assert summ["classes"][SLO_THROUGHPUT]["roofline_target_ms"] > 0
+
+
+def test_precision_routed_engine_shared_and_invalid(tiny_model):
+    """Classes sharing one precision share ONE engine and pool (no
+    double allocation); unknown policy keys and unknown submit SLOs
+    refuse loudly."""
+    from paddle_tpu.serving import PrecisionRoutedEngine
+    eng = PrecisionRoutedEngine(
+        tiny_model, kv_precision={SLO_LATENCY: "int4",
+                                  SLO_THROUGHPUT: "int4"},
+        max_new_tokens=4, num_pages=16)
+    assert eng.engines[SLO_LATENCY] is eng.engines[SLO_THROUGHPUT]
+    assert eng.decoders[SLO_LATENCY] is eng.decoders[SLO_THROUGHPUT]
+    r0 = eng.submit(np.asarray([3, 141, 59], np.int32),
+                    slo=SLO_LATENCY)
+    r1 = eng.submit(np.asarray([5, 9, 2], np.int32),
+                    slo=SLO_THROUGHPUT)
+    outs = eng.run()
+    assert set(outs) == {r0, r1}
+    with pytest.raises(ValueError, match="kv_precision"):
+        PrecisionRoutedEngine(tiny_model, kv_precision={"gold": None})
+    with pytest.raises(ValueError, match="slo"):
+        eng.submit(np.asarray([1, 2], np.int32), slo="gold")
